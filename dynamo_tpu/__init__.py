@@ -1,0 +1,31 @@
+"""dynamo_tpu — a TPU-native distributed LLM inference serving framework.
+
+Capabilities (mirroring NVIDIA Dynamo, reference at /root/reference — see SURVEY.md):
+
+- OpenAI-compatible HTTP frontend with prompt templating, tokenization and SSE
+  streaming (``dynamo_tpu.http``, ``dynamo_tpu.preprocessor``).
+- A distributed runtime with service discovery, leases/liveness, prefix watches,
+  streaming RPC and load-aware request routing (``dynamo_tpu.runtime``).  The
+  reference uses etcd + NATS + raw TCP (reference ``lib/runtime/``); we ship a
+  self-contained coordinator + direct-TCP data plane with the same semantics.
+- KV-cache-aware routing: radix-tree prefix indexer, event planes, load-aware
+  scheduler (``dynamo_tpu.kv_router``; reference ``lib/llm/src/kv_router/``).
+- A TPU model engine that owns the model loop natively via jax/XLA/Pallas:
+  continuous batching, paged attention kernels, pjit/GSPMD sharding for
+  TP/DP/EP/SP (``dynamo_tpu.engine``, ``dynamo_tpu.models``, ``dynamo_tpu.ops``,
+  ``dynamo_tpu.parallel``).  The reference delegates this to vLLM/SGLang/TRT-LLM.
+- Multi-tier KV block management (HBM -> host RAM -> disk) replacing the
+  reference's KVBM + NIXL (``dynamo_tpu.block_manager``).
+- Disaggregated prefill/decode, request migration, mock engine, planner.
+"""
+
+__version__ = "0.1.0"
+
+from dynamo_tpu.tokens import TokenBlock, TokenBlockSequence, compute_block_hash_for_seq
+
+__all__ = [
+    "__version__",
+    "TokenBlock",
+    "TokenBlockSequence",
+    "compute_block_hash_for_seq",
+]

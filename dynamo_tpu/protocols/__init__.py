@@ -1,0 +1,23 @@
+"""Wire protocols: internal request/response types, OpenAI API types, SSE codec,
+KV-cache events and worker metrics.
+
+Parity: reference ``lib/llm/src/protocols/`` (~5,400 LoC Rust) — see SURVEY.md §2.2.
+"""
+
+from dynamo_tpu.protocols.common import (
+    BackendOutput,
+    FinishReason,
+    LLMEngineOutput,
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+
+__all__ = [
+    "BackendOutput",
+    "FinishReason",
+    "LLMEngineOutput",
+    "PreprocessedRequest",
+    "SamplingOptions",
+    "StopConditions",
+]

@@ -1,0 +1,137 @@
+"""Endpoint client: watches live instances and issues streamed requests.
+
+Parity: reference ``lib/runtime/src/component/client.rs`` (264 LoC) —
+``Client::new_dynamic`` with an etcd prefix watch keeping an atomic snapshot of
+instance ids, plus ``report_instance_down`` local pruning ahead of lease
+expiry.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any, Dict, List, Optional
+
+from dynamo_tpu.runtime.component import Endpoint, Instance
+from dynamo_tpu.runtime.rpc import ResponseStream
+
+logger = logging.getLogger(__name__)
+
+
+class Client:
+    """Dynamic client for one endpoint."""
+
+    def __init__(self, drt, endpoint: Endpoint):
+        self._drt = drt
+        self.endpoint = endpoint
+        self._instances: Dict[int, Instance] = {}
+        self._down: set = set()  # locally-reported-down instance ids
+        self._watch = None
+        self._watch_task: Optional[asyncio.Task] = None
+        self._changed = asyncio.Event()
+
+    @classmethod
+    async def create(cls, drt, endpoint: Endpoint, static: bool = False) -> "Client":
+        self = cls(drt, endpoint)
+        if static:
+            for inst in await endpoint.list_instances():
+                self._instances[inst.instance_id] = inst
+        else:
+            self._watch = await drt.coord.watch_prefix(endpoint.instance_prefix)
+            for _key, value in self._watch.snapshot:
+                inst = Instance.from_json(value)
+                self._instances[inst.instance_id] = inst
+            self._watch_task = asyncio.create_task(self._watch_loop())
+        return self
+
+    async def _watch_loop(self) -> None:
+        try:
+            async for ev in self._watch:
+                if ev.type == "put" and ev.value is not None:
+                    inst = Instance.from_json(ev.value)
+                    self._instances[inst.instance_id] = inst
+                    self._down.discard(inst.instance_id)
+                elif ev.type == "delete":
+                    iid = self._id_from_key(ev.key)
+                    if iid is not None:
+                        self._instances.pop(iid, None)
+                        self._down.discard(iid)
+                self._changed.set()
+                self._changed = asyncio.Event()
+        except asyncio.CancelledError:
+            pass
+
+    @staticmethod
+    def _id_from_key(key: str) -> Optional[int]:
+        _, _, hexid = key.rpartition(":")
+        try:
+            return int(hexid, 16)
+        except ValueError:
+            return None
+
+    # -- instance visibility ----------------------------------------------
+
+    def instance_ids(self) -> List[int]:
+        return [i for i in self._instances if i not in self._down]
+
+    def instances(self) -> List[Instance]:
+        return [v for k, v in self._instances.items() if k not in self._down]
+
+    def get_instance(self, instance_id: int) -> Optional[Instance]:
+        if instance_id in self._down:
+            return None
+        return self._instances.get(instance_id)
+
+    def report_instance_down(self, instance_id: int) -> None:
+        """Locally mark an instance dead before the lease expiry catches up."""
+        if instance_id in self._instances:
+            logger.warning("instance %x of %s reported down",
+                           instance_id, self.endpoint.path)
+            self._down.add(instance_id)
+            inst = self._instances.get(instance_id)
+            if inst is not None:
+                self._drt.rpc_pool.drop(inst.address)
+
+    async def wait_for_instances(self, n: int = 1, timeout: float = 30.0) -> List[Instance]:
+        """Block until at least ``n`` instances are visible."""
+        deadline = asyncio.get_running_loop().time() + timeout
+        while len(self.instance_ids()) < n:
+            remaining = deadline - asyncio.get_running_loop().time()
+            if remaining <= 0:
+                raise TimeoutError(
+                    f"timed out waiting for {n} instances of {self.endpoint.path} "
+                    f"(have {len(self.instance_ids())})")
+            changed = self._changed
+            try:
+                await asyncio.wait_for(changed.wait(), timeout=min(remaining, 0.5))
+            except asyncio.TimeoutError:
+                pass
+        return self.instances()
+
+    # -- request issuing ---------------------------------------------------
+
+    async def direct(self, payload: Any, instance_id: int,
+                     headers: Optional[Dict[str, Any]] = None) -> ResponseStream:
+        """Issue a request to a specific instance."""
+        inst = self._instances.get(instance_id)
+        if inst is None or instance_id in self._down:
+            raise ConnectionError(
+                f"instance {instance_id:x} of {self.endpoint.path} not available")
+        conn = await self._drt.rpc_pool.get(inst.address)
+        return await conn.request(f"{self.endpoint.path}", payload, headers)
+
+    async def close(self) -> None:
+        if self._watch_task:
+            self._watch_task.cancel()
+            try:
+                await self._watch_task
+            except asyncio.CancelledError:
+                pass
+        if self._watch is not None:
+            try:
+                await self._watch.cancel()
+            except Exception:
+                pass
+
+
+__all__ = ["Client"]

@@ -1,0 +1,58 @@
+"""Length-prefixed msgpack framing over asyncio streams.
+
+Every frame on a control- or data-plane connection is ``<u32 big-endian
+length><msgpack payload>``.  Parity in spirit with the reference's two-part
+codec (``lib/runtime/src/pipeline/network/codec/two_part.rs``): a frame is a
+msgpack map whose "header" fields (op, ids) and "payload" (bin) travel
+together; msgpack bin avoids a second length-prefix layer.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+from typing import Any, Optional
+
+import msgpack
+
+MAX_FRAME = 512 * 1024 * 1024  # 512 MiB hard cap (KV block transfers ride this)
+
+_LEN = struct.Struct(">I")
+
+
+def pack(obj: Any) -> bytes:
+    return msgpack.packb(obj, use_bin_type=True)
+
+
+def unpack(data: bytes) -> Any:
+    return msgpack.unpackb(data, raw=False, strict_map_key=False)
+
+
+async def read_frame(reader: asyncio.StreamReader) -> Optional[Any]:
+    """Read one frame; returns None on clean EOF."""
+    try:
+        hdr = await reader.readexactly(4)
+    except (asyncio.IncompleteReadError, ConnectionResetError):
+        return None
+    (length,) = _LEN.unpack(hdr)
+    if length > MAX_FRAME:
+        raise ValueError(f"frame length {length} exceeds cap {MAX_FRAME}")
+    try:
+        body = await reader.readexactly(length)
+    except (asyncio.IncompleteReadError, ConnectionResetError):
+        return None
+    return unpack(body)
+
+
+def write_frame(writer: asyncio.StreamWriter, obj: Any) -> None:
+    """Queue one frame on the writer (call ``await writer.drain()`` for backpressure)."""
+    body = pack(obj)
+    writer.write(_LEN.pack(len(body)) + body)
+
+
+async def send_frame(writer: asyncio.StreamWriter, obj: Any) -> None:
+    write_frame(writer, obj)
+    await writer.drain()
+
+
+__all__ = ["pack", "unpack", "read_frame", "write_frame", "send_frame", "MAX_FRAME"]

@@ -1,0 +1,202 @@
+"""Component model: Namespace -> Component -> Endpoint naming tree + instances.
+
+Parity: reference ``lib/runtime/src/component.rs`` (633 LoC) and
+``component/{endpoint,namespace,client}.rs``.  We mirror the instance-key
+scheme: a served endpoint writes
+``instances/{namespace}/{component}/{endpoint}:{lease_id:x}`` into the
+coordinator KV under its primary lease; clients discover instances by prefix
+watch on ``instances/{namespace}/{component}/{endpoint}``.  Event subjects use
+``{namespace}.{component}.{endpoint}`` dotted naming.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, TYPE_CHECKING
+
+from dynamo_tpu.runtime.rpc import Handler
+
+if TYPE_CHECKING:
+    from dynamo_tpu.runtime.runtime import DistributedRuntime
+    from dynamo_tpu.runtime.client import Client
+
+logger = logging.getLogger(__name__)
+
+INSTANCE_ROOT = "instances/"
+MODEL_ROOT = "models/"  # ModelEntry registrations (reference MODEL_ROOT_PATH)
+
+
+@dataclass(frozen=True)
+class Instance:
+    """One live served endpoint (serialized into the coordinator KV)."""
+
+    namespace: str
+    component: str
+    endpoint: str
+    instance_id: int  # == lease id, like the reference (component.rs:379-386)
+    address: str  # host:port of the worker's RpcServer
+
+    @property
+    def etcd_key(self) -> str:
+        return (f"{INSTANCE_ROOT}{self.namespace}/{self.component}/"
+                f"{self.endpoint}:{self.instance_id:x}")
+
+    @property
+    def subject(self) -> str:
+        return f"{self.namespace}.{self.component}.{self.endpoint}"
+
+    def to_json(self) -> bytes:
+        return json.dumps({
+            "namespace": self.namespace,
+            "component": self.component,
+            "endpoint": self.endpoint,
+            "instance_id": self.instance_id,
+            "address": self.address,
+        }).encode()
+
+    @classmethod
+    def from_json(cls, data: bytes) -> "Instance":
+        d = json.loads(data)
+        return cls(
+            namespace=d["namespace"], component=d["component"],
+            endpoint=d["endpoint"], instance_id=d["instance_id"],
+            address=d["address"])
+
+
+class Namespace:
+    def __init__(self, drt: "DistributedRuntime", name: str):
+        self._drt = drt
+        self.name = name
+
+    def component(self, name: str) -> "Component":
+        return Component(self._drt, self.name, name)
+
+    def event_subject(self, suffix: str) -> str:
+        return f"{self.name}.{suffix}"
+
+    def __repr__(self) -> str:
+        return f"Namespace({self.name})"
+
+
+class Component:
+    def __init__(self, drt: "DistributedRuntime", namespace: str, name: str):
+        self._drt = drt
+        self.namespace = namespace
+        self.name = name
+
+    def endpoint(self, name: str) -> "Endpoint":
+        return Endpoint(self._drt, self.namespace, self.name, name)
+
+    @property
+    def path(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+    def event_subject(self, suffix: str) -> str:
+        """Subject for component-scoped events, e.g. ``kv_events``."""
+        return f"{self.namespace}.{self.name}.{suffix}"
+
+    async def list_instances(self) -> List[Instance]:
+        """All live instances of all endpoints of this component."""
+        items = await self._drt.coord.get_prefix(f"{INSTANCE_ROOT}{self.path}/")
+        return [Instance.from_json(v) for _, v in items]
+
+    async def scrape_stats(self) -> Dict[int, Dict[str, Any]]:
+        """Scrape ``__stats__`` from every live instance of this component.
+
+        Parity: NATS ``$SRV.STATS`` scraping (reference
+        ``kv_router/metrics_aggregator.rs``). Returns {instance_id: stats}.
+        """
+        out: Dict[int, Dict[str, Any]] = {}
+        for inst in await self.list_instances():
+            try:
+                conn = await self._drt.rpc_pool.get(inst.address)
+                stream = await conn.request("__stats__", None)
+                async for payload in stream:
+                    out[inst.instance_id] = payload
+            except (ConnectionError, RuntimeError) as e:
+                logger.debug("stats scrape of %s failed: %s", inst.address, e)
+        return out
+
+    def __repr__(self) -> str:
+        return f"Component({self.path})"
+
+
+class Endpoint:
+    def __init__(self, drt: "DistributedRuntime", namespace: str,
+                 component: str, name: str):
+        self._drt = drt
+        self.namespace = namespace
+        self.component = component
+        self.name = name
+
+    @property
+    def path(self) -> str:
+        return f"{self.namespace}/{self.component}/{self.name}"
+
+    @property
+    def instance_prefix(self) -> str:
+        # the trailing ':' stops a watch for endpoint "gen" from also matching
+        # a sibling endpoint named "generate"
+        return f"{INSTANCE_ROOT}{self.path}:"
+
+    @property
+    def subject(self) -> str:
+        return f"{self.namespace}.{self.component}.{self.name}"
+
+    async def serve(self, handler: Handler,
+                    stats_provider: Optional[Callable[[], Any]] = None,
+                    graceful_shutdown: bool = True) -> "ServedEndpoint":
+        """Register the handler on the local RpcServer and announce the
+        instance in the coordinator under the primary lease.
+
+        Parity: reference ``component/endpoint.rs:25-120``
+        (``EndpointConfigBuilder::start``) + PushEndpoint.
+        """
+        drt = self._drt
+        server = await drt.ensure_rpc_server()
+        rpc_name = f"{self.path}"
+        server.register(rpc_name, handler, stats_provider)
+        lease = await drt.primary_lease()
+        inst = Instance(
+            namespace=self.namespace, component=self.component,
+            endpoint=self.name, instance_id=lease.lease_id,
+            address=server.address)
+        await drt.coord.put(inst.etcd_key, inst.to_json(), lease_id=lease.lease_id)
+        logger.info("serving endpoint %s as instance %x at %s",
+                    self.path, inst.instance_id, inst.address)
+        return ServedEndpoint(self, inst, rpc_name)
+
+    async def client(self, **kw: Any) -> "Client":
+        from dynamo_tpu.runtime.client import Client
+        return await Client.create(self._drt, self, **kw)
+
+    async def list_instances(self) -> List[Instance]:
+        items = await self._drt.coord.get_prefix(self.instance_prefix)
+        return [Instance.from_json(v) for _, v in items]
+
+    def __repr__(self) -> str:
+        return f"Endpoint({self.path})"
+
+
+class ServedEndpoint:
+    """Handle for a live served endpoint; ``shutdown()`` deregisters it."""
+
+    def __init__(self, endpoint: Endpoint, instance: Instance, rpc_name: str):
+        self.endpoint = endpoint
+        self.instance = instance
+        self._rpc_name = rpc_name
+
+    async def shutdown(self) -> None:
+        drt = self.endpoint._drt
+        try:
+            await drt.coord.delete(self.instance.etcd_key)
+        except Exception:
+            pass
+        if drt.rpc_server is not None:
+            drt.rpc_server.unregister(self._rpc_name)
+
+
+__all__ = ["Namespace", "Component", "Endpoint", "Instance", "ServedEndpoint",
+           "INSTANCE_ROOT", "MODEL_ROOT"]

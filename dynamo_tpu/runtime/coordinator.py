@@ -1,0 +1,638 @@
+"""Coordinator: the framework's self-contained control-plane service.
+
+One asyncio TCP server provides what the reference gets from two external
+services (SURVEY.md §2.1, L0):
+
+- **KV plane** (etcd-equivalent; reference ``lib/runtime/src/transports/etcd.rs``):
+  put/get/delete with prefix queries, *leases* with TTL + keep-alive (all keys
+  attached to a lease vanish when it expires — that is the liveness mechanism),
+  and *prefix watches* that stream put/delete events to clients.
+- **Event plane** (NATS-equivalent; reference ``transports/nats.rs``):
+  subject-based pub/sub with trailing-wildcard subscriptions (``a.b.>``), used
+  for KV-cache events, metrics broadcasts and the prefill queue.
+- **Object store** (reference uses NATS object store for model-card artifacts):
+  named buckets of binary blobs, implemented on the KV plane with chunking.
+- **Barrier** (reference ``utils/leader_worker_barrier.rs``): implemented
+  client-side on KV + watch (see ``LeaderWorkerBarrier`` in barrier.py).
+
+Wire protocol: length-prefixed msgpack frames (codec.py).  Requests carry a
+client-assigned ``rid`` and are answered with ``{"rid", "ok", ...}``; server-
+initiated traffic (watch events, pub/sub messages) carries ``evt`` instead.
+
+The coordinator is deliberately a single-threaded asyncio process: control
+plane operations are low-rate (registrations, watches, metrics) while the hot
+request path rides direct worker TCP connections and never touches it.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import fnmatch
+import itertools
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Any, AsyncIterator, Callable, Dict, List, Optional, Tuple
+
+from dynamo_tpu.runtime.codec import read_frame, send_frame, write_frame
+
+logger = logging.getLogger(__name__)
+
+LEASE_SCAN_INTERVAL = 0.5  # seconds between lease-expiry scans
+
+
+def _subject_matches(pattern: str, subject: str) -> bool:
+    """NATS-style match: exact, or trailing ``>`` wildcard matching the rest."""
+    if pattern == subject:
+        return True
+    if pattern.endswith(".>"):
+        return subject.startswith(pattern[:-1])  # keep the dot
+    if pattern == ">":
+        return True
+    return False
+
+
+@dataclass
+class _KvEntry:
+    value: bytes
+    lease_id: int = 0
+    version: int = 1
+
+
+@dataclass
+class _Lease:
+    lease_id: int
+    ttl: float
+    expires_at: float
+    keys: set = field(default_factory=set)
+
+
+@dataclass
+class _Watch:
+    watch_id: int
+    prefix: str
+    conn: "_Conn"
+
+
+@dataclass
+class _Subscription:
+    sub_id: int
+    pattern: str
+    conn: "_Conn"
+    queue_group: Optional[str] = None
+
+
+class _Conn:
+    """Server-side state for one client connection."""
+
+    def __init__(self, server: "Coordinator", reader: asyncio.StreamReader,
+                 writer: asyncio.StreamWriter):
+        self.server = server
+        self.reader = reader
+        self.writer = writer
+        self.watches: Dict[int, _Watch] = {}
+        self.subs: Dict[int, _Subscription] = {}
+        self.leases: set = set()
+        self.alive = True
+        self._wlock = asyncio.Lock()
+
+    async def send(self, obj: Any) -> None:
+        if not self.alive:
+            return
+        try:
+            async with self._wlock:
+                await send_frame(self.writer, obj)
+        except (ConnectionError, RuntimeError):
+            self.alive = False
+
+
+class Coordinator:
+    """The control-plane server.  ``async with Coordinator(port=0) as c: ...``"""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.host = host
+        self.port = port
+        self._kv: Dict[str, _KvEntry] = {}
+        self._leases: Dict[int, _Lease] = {}
+        self._watches: Dict[int, _Watch] = {}
+        self._subs: List[_Subscription] = []
+        self._queue_rr: Dict[Tuple[str, str], int] = {}  # (pattern, group) -> rr counter
+        self._ids = itertools.count(1)
+        self._server: Optional[asyncio.base_events.Server] = None
+        self._lease_task: Optional[asyncio.Task] = None
+        self._conns: set = set()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self) -> "Coordinator":
+        self._server = await asyncio.start_server(self._handle_conn, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        self._lease_task = asyncio.create_task(self._lease_scanner())
+        logger.info("coordinator listening on %s:%d", self.host, self.port)
+        return self
+
+    async def stop(self) -> None:
+        if self._lease_task:
+            self._lease_task.cancel()
+            try:
+                await self._lease_task
+            except asyncio.CancelledError:
+                pass
+        if self._server:
+            self._server.close()
+            await self._server.wait_closed()
+        for conn in list(self._conns):
+            conn.alive = False
+            try:
+                conn.writer.close()
+            except Exception:
+                pass
+
+    async def __aenter__(self) -> "Coordinator":
+        return await self.start()
+
+    async def __aexit__(self, *exc) -> None:
+        await self.stop()
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    # -- connection handling ----------------------------------------------
+
+    async def _handle_conn(self, reader: asyncio.StreamReader,
+                           writer: asyncio.StreamWriter) -> None:
+        conn = _Conn(self, reader, writer)
+        self._conns.add(conn)
+        try:
+            while True:
+                frame = await read_frame(reader)
+                if frame is None:
+                    break
+                try:
+                    await self._dispatch(conn, frame)
+                except Exception as e:  # protocol error -> report, keep conn
+                    logger.exception("coordinator dispatch error")
+                    rid = frame.get("rid") if isinstance(frame, dict) else None
+                    if rid is not None:
+                        await conn.send({"rid": rid, "ok": False, "error": str(e)})
+        finally:
+            conn.alive = False
+            self._conns.discard(conn)
+            for w in list(conn.watches.values()):
+                self._watches.pop(w.watch_id, None)
+            self._subs = [s for s in self._subs if s.conn is not conn]
+            # leases owned by a dropped connection keep ticking until TTL expiry
+            # (matches etcd semantics: reconnect within TTL keeps instances alive)
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def _dispatch(self, conn: _Conn, f: Dict[str, Any]) -> None:
+        op = f.get("op")
+        rid = f.get("rid")
+        if op == "put":
+            await self._op_put(f["key"], f["value"], f.get("lease", 0))
+            await conn.send({"rid": rid, "ok": True})
+        elif op == "get":
+            e = self._kv.get(f["key"])
+            await conn.send({"rid": rid, "ok": True,
+                             "value": e.value if e else None,
+                             "lease": e.lease_id if e else 0})
+        elif op == "get_prefix":
+            items = [
+                {"key": k, "value": e.value, "lease": e.lease_id}
+                for k, e in sorted(self._kv.items()) if k.startswith(f["prefix"])
+            ]
+            await conn.send({"rid": rid, "ok": True, "items": items})
+        elif op == "delete":
+            n = await self._op_delete(f["key"])
+            await conn.send({"rid": rid, "ok": True, "deleted": n})
+        elif op == "delete_prefix":
+            keys = [k for k in self._kv if k.startswith(f["prefix"])]
+            for k in keys:
+                await self._op_delete(k)
+            await conn.send({"rid": rid, "ok": True, "deleted": len(keys)})
+        elif op == "put_if_absent":
+            if f["key"] in self._kv:
+                await conn.send({"rid": rid, "ok": True, "created": False})
+            else:
+                await self._op_put(f["key"], f["value"], f.get("lease", 0))
+                await conn.send({"rid": rid, "ok": True, "created": True})
+        elif op == "grant_lease":
+            lease = self._grant_lease(float(f.get("ttl", 10.0)))
+            conn.leases.add(lease.lease_id)
+            await conn.send({"rid": rid, "ok": True, "lease": lease.lease_id,
+                             "ttl": lease.ttl})
+        elif op == "keepalive":
+            lease = self._leases.get(f["lease"])
+            if lease is None:
+                await conn.send({"rid": rid, "ok": False, "error": "lease not found"})
+            else:
+                lease.expires_at = time.monotonic() + lease.ttl
+                await conn.send({"rid": rid, "ok": True})
+        elif op == "revoke":
+            await self._revoke_lease(f["lease"])
+            await conn.send({"rid": rid, "ok": True})
+        elif op == "watch_prefix":
+            watch_id = next(self._ids)
+            w = _Watch(watch_id=watch_id, prefix=f["prefix"], conn=conn)
+            self._watches[watch_id] = w
+            conn.watches[watch_id] = w
+            # initial snapshot rides the response so watchers never miss state
+            items = [
+                {"key": k, "value": e.value, "lease": e.lease_id}
+                for k, e in sorted(self._kv.items()) if k.startswith(f["prefix"])
+            ]
+            await conn.send({"rid": rid, "ok": True, "watch_id": watch_id,
+                             "items": items})
+        elif op == "unwatch":
+            w = conn.watches.pop(f["watch_id"], None)
+            if w:
+                self._watches.pop(w.watch_id, None)
+            await conn.send({"rid": rid, "ok": True})
+        elif op == "publish":
+            n = await self._op_publish(f["subject"], f["payload"])
+            await conn.send({"rid": rid, "ok": True, "delivered": n})
+        elif op == "subscribe":
+            sub_id = next(self._ids)
+            sub = _Subscription(sub_id=sub_id, pattern=f["subject"], conn=conn,
+                                queue_group=f.get("queue_group"))
+            self._subs.append(sub)
+            conn.subs[sub_id] = sub
+            await conn.send({"rid": rid, "ok": True, "sub_id": sub_id})
+        elif op == "unsubscribe":
+            sub = conn.subs.pop(f["sub_id"], None)
+            if sub:
+                self._subs = [s for s in self._subs if s.sub_id != sub.sub_id]
+            await conn.send({"rid": rid, "ok": True})
+        elif op == "ping":
+            await conn.send({"rid": rid, "ok": True, "time": time.time()})
+        else:
+            await conn.send({"rid": rid, "ok": False, "error": f"unknown op {op!r}"})
+
+    # -- KV ----------------------------------------------------------------
+
+    async def _op_put(self, key: str, value: bytes, lease_id: int) -> None:
+        if lease_id:
+            lease = self._leases.get(lease_id)
+            if lease is None:
+                raise ValueError(f"lease {lease_id} not found")
+            lease.keys.add(key)
+        prev = self._kv.get(key)
+        self._kv[key] = _KvEntry(value=value, lease_id=lease_id,
+                                 version=(prev.version + 1) if prev else 1)
+        await self._notify_watchers("put", key, value, lease_id)
+
+    async def _op_delete(self, key: str) -> int:
+        e = self._kv.pop(key, None)
+        if e is None:
+            return 0
+        if e.lease_id and e.lease_id in self._leases:
+            self._leases[e.lease_id].keys.discard(key)
+        await self._notify_watchers("delete", key, None, e.lease_id)
+        return 1
+
+    async def _notify_watchers(self, etype: str, key: str,
+                               value: Optional[bytes], lease_id: int) -> None:
+        for w in list(self._watches.values()):
+            if key.startswith(w.prefix):
+                await w.conn.send({"evt": "watch", "watch_id": w.watch_id,
+                                   "type": etype, "key": key, "value": value,
+                                   "lease": lease_id})
+
+    # -- leases ------------------------------------------------------------
+
+    def _grant_lease(self, ttl: float) -> _Lease:
+        lease_id = next(self._ids)
+        lease = _Lease(lease_id=lease_id, ttl=ttl,
+                       expires_at=time.monotonic() + ttl)
+        self._leases[lease_id] = lease
+        return lease
+
+    async def _revoke_lease(self, lease_id: int) -> None:
+        lease = self._leases.pop(lease_id, None)
+        if lease is None:
+            return
+        for key in list(lease.keys):
+            await self._op_delete(key)
+
+    async def _lease_scanner(self) -> None:
+        while True:
+            await asyncio.sleep(LEASE_SCAN_INTERVAL)
+            now = time.monotonic()
+            expired = [lid for lid, l in self._leases.items() if l.expires_at < now]
+            for lid in expired:
+                logger.info("lease %d expired; revoking %d keys",
+                            lid, len(self._leases[lid].keys))
+                await self._revoke_lease(lid)
+
+    # -- pub/sub -----------------------------------------------------------
+
+    async def _op_publish(self, subject: str, payload: bytes) -> int:
+        delivered = 0
+        # queue groups: of the members subscribed with the same (pattern, group),
+        # exactly one receives each message (NATS queue semantics — the
+        # reference uses this for the JetStream prefill queue).
+        groups: Dict[Tuple[str, str], List[_Subscription]] = {}
+        for s in self._subs:
+            if not s.conn.alive or not _subject_matches(s.pattern, subject):
+                continue
+            if s.queue_group:
+                groups.setdefault((s.pattern, s.queue_group), []).append(s)
+            else:
+                await s.conn.send({"evt": "msg", "sub_id": s.sub_id,
+                                   "subject": subject, "payload": payload})
+                delivered += 1
+        for gkey, members in groups.items():
+            idx = self._queue_rr.get(gkey, 0) % len(members)
+            self._queue_rr[gkey] = idx + 1
+            s = members[idx]
+            await s.conn.send({"evt": "msg", "sub_id": s.sub_id,
+                               "subject": subject, "payload": payload})
+            delivered += 1
+        return delivered
+
+
+# ---------------------------------------------------------------------------
+# Client
+# ---------------------------------------------------------------------------
+
+
+class WatchEvent:
+    __slots__ = ("type", "key", "value", "lease_id")
+
+    def __init__(self, type: str, key: str, value: Optional[bytes], lease_id: int = 0):
+        self.type = type
+        self.key = key
+        self.value = value
+        self.lease_id = lease_id
+
+    def __repr__(self) -> str:
+        return f"WatchEvent({self.type}, {self.key!r})"
+
+
+class Watch:
+    """A live prefix watch: initial snapshot + async iterator of events."""
+
+    def __init__(self, client: "CoordClient", watch_id: int,
+                 snapshot: List[Dict[str, Any]]):
+        self._client = client
+        self.watch_id = watch_id
+        self.snapshot = [(i["key"], i["value"]) for i in snapshot]
+        self.queue: asyncio.Queue = asyncio.Queue()
+
+    def __aiter__(self) -> AsyncIterator[WatchEvent]:
+        return self
+
+    async def __anext__(self) -> WatchEvent:
+        ev = await self.queue.get()
+        if ev is None:
+            raise StopAsyncIteration
+        return ev
+
+    async def cancel(self) -> None:
+        await self._client.unwatch(self.watch_id)
+
+
+class Subscription:
+    """A live pub/sub subscription: async iterator of (subject, payload)."""
+
+    def __init__(self, client: "CoordClient", sub_id: int):
+        self._client = client
+        self.sub_id = sub_id
+        self.queue: asyncio.Queue = asyncio.Queue()
+
+    def __aiter__(self) -> "Subscription":
+        return self
+
+    async def __anext__(self) -> Tuple[str, bytes]:
+        item = await self.queue.get()
+        if item is None:
+            raise StopAsyncIteration
+        return item
+
+    async def cancel(self) -> None:
+        await self._client.unsubscribe(self.sub_id)
+
+
+class Lease:
+    """Client-side lease handle with automatic keep-alive task."""
+
+    def __init__(self, client: "CoordClient", lease_id: int, ttl: float):
+        self.client = client
+        self.lease_id = lease_id
+        self.ttl = ttl
+        self._task: Optional[asyncio.Task] = None
+        self.lost = asyncio.Event()
+
+    def start_keepalive(self) -> None:
+        self._task = asyncio.create_task(self._keepalive_loop())
+
+    async def _keepalive_loop(self) -> None:
+        interval = max(self.ttl / 3.0, 0.1)
+        try:
+            while True:
+                await asyncio.sleep(interval)
+                try:
+                    await self.client.keepalive(self.lease_id)
+                except Exception:
+                    logger.warning("lease %d keep-alive failed", self.lease_id)
+                    self.lost.set()
+                    return
+        except asyncio.CancelledError:
+            pass
+
+    async def revoke(self) -> None:
+        if self._task:
+            self._task.cancel()
+        try:
+            await self.client.revoke(self.lease_id)
+        except Exception:
+            pass
+
+
+class CoordClient:
+    """Async client for the Coordinator."""
+
+    def __init__(self, address: str):
+        host, _, port = address.rpartition(":")
+        self.host, self.port = host or "127.0.0.1", int(port)
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._rids = itertools.count(1)
+        self._pending: Dict[int, asyncio.Future] = {}
+        self._watches: Dict[int, Watch] = {}
+        self._subs: Dict[int, Subscription] = {}
+        # events/messages that raced ahead of watch/subscription registration
+        # (the server's response and a first event can share one TCP segment)
+        self._orphan_events: Dict[int, list] = {}
+        self._orphan_msgs: Dict[int, list] = {}
+        self._reader_task: Optional[asyncio.Task] = None
+        self._wlock: Optional[asyncio.Lock] = None
+        self.closed = asyncio.Event()
+
+    async def connect(self) -> "CoordClient":
+        self._reader, self._writer = await asyncio.open_connection(self.host, self.port)
+        self._wlock = asyncio.Lock()
+        self._reader_task = asyncio.create_task(self._read_loop())
+        return self
+
+    async def close(self) -> None:
+        if self._reader_task:
+            self._reader_task.cancel()
+            try:
+                await self._reader_task
+            except asyncio.CancelledError:
+                pass
+        if self._writer:
+            try:
+                self._writer.close()
+                await self._writer.wait_closed()
+            except Exception:
+                pass
+        self.closed.set()
+
+    async def __aenter__(self) -> "CoordClient":
+        return await self.connect()
+
+    async def __aexit__(self, *exc) -> None:
+        await self.close()
+
+    async def _read_loop(self) -> None:
+        try:
+            while True:
+                frame = await read_frame(self._reader)
+                if frame is None:
+                    break
+                if "rid" in frame and frame["rid"] is not None:
+                    fut = self._pending.pop(frame["rid"], None)
+                    if fut and not fut.done():
+                        fut.set_result(frame)
+                elif frame.get("evt") == "watch":
+                    ev = WatchEvent(frame["type"], frame["key"],
+                                    frame.get("value"), frame.get("lease", 0))
+                    w = self._watches.get(frame["watch_id"])
+                    if w:
+                        w.queue.put_nowait(ev)
+                    else:
+                        buf = self._orphan_events.setdefault(frame["watch_id"], [])
+                        if len(buf) < 10_000:
+                            buf.append(ev)
+                elif frame.get("evt") == "msg":
+                    item = (frame["subject"], frame["payload"])
+                    s = self._subs.get(frame["sub_id"])
+                    if s:
+                        s.queue.put_nowait(item)
+                    else:
+                        buf = self._orphan_msgs.setdefault(frame["sub_id"], [])
+                        if len(buf) < 10_000:
+                            buf.append(item)
+        except (ConnectionError, asyncio.CancelledError):
+            pass
+        finally:
+            self.closed.set()
+            for fut in self._pending.values():
+                if not fut.done():
+                    fut.set_exception(ConnectionError("coordinator connection lost"))
+            self._pending.clear()
+            for w in self._watches.values():
+                w.queue.put_nowait(None)
+            for s in self._subs.values():
+                s.queue.put_nowait(None)
+
+    async def _call(self, op: str, **kw: Any) -> Dict[str, Any]:
+        if self._writer is None:
+            raise ConnectionError("not connected")
+        rid = next(self._rids)
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending[rid] = fut
+        frame = {"op": op, "rid": rid, **kw}
+        async with self._wlock:
+            await send_frame(self._writer, frame)
+        resp = await fut
+        if not resp.get("ok"):
+            raise RuntimeError(f"coordinator {op} failed: {resp.get('error')}")
+        return resp
+
+    # -- KV API ------------------------------------------------------------
+
+    async def put(self, key: str, value: bytes, lease_id: int = 0) -> None:
+        await self._call("put", key=key, value=value, lease=lease_id)
+
+    async def put_if_absent(self, key: str, value: bytes, lease_id: int = 0) -> bool:
+        resp = await self._call("put_if_absent", key=key, value=value, lease=lease_id)
+        return bool(resp["created"])
+
+    async def get(self, key: str) -> Optional[bytes]:
+        resp = await self._call("get", key=key)
+        return resp.get("value")
+
+    async def get_prefix(self, prefix: str) -> List[Tuple[str, bytes]]:
+        resp = await self._call("get_prefix", prefix=prefix)
+        return [(i["key"], i["value"]) for i in resp["items"]]
+
+    async def delete(self, key: str) -> int:
+        return (await self._call("delete", key=key))["deleted"]
+
+    async def delete_prefix(self, prefix: str) -> int:
+        return (await self._call("delete_prefix", prefix=prefix))["deleted"]
+
+    # -- leases ------------------------------------------------------------
+
+    async def grant_lease(self, ttl: float = 10.0, keepalive: bool = True) -> Lease:
+        resp = await self._call("grant_lease", ttl=ttl)
+        lease = Lease(self, resp["lease"], resp["ttl"])
+        if keepalive:
+            lease.start_keepalive()
+        return lease
+
+    async def keepalive(self, lease_id: int) -> None:
+        await self._call("keepalive", lease=lease_id)
+
+    async def revoke(self, lease_id: int) -> None:
+        await self._call("revoke", lease=lease_id)
+
+    # -- watches -----------------------------------------------------------
+
+    async def watch_prefix(self, prefix: str) -> Watch:
+        resp = await self._call("watch_prefix", prefix=prefix)
+        w = Watch(self, resp["watch_id"], resp.get("items", []))
+        self._watches[w.watch_id] = w
+        # drain events that arrived between the server registering the watch
+        # and us registering the Watch object (no await between these lines)
+        for ev in self._orphan_events.pop(w.watch_id, []):
+            w.queue.put_nowait(ev)
+        return w
+
+    async def unwatch(self, watch_id: int) -> None:
+        self._watches.pop(watch_id, None)
+        await self._call("unwatch", watch_id=watch_id)
+        self._orphan_events.pop(watch_id, None)  # drop in-flight stragglers
+
+    # -- pub/sub -----------------------------------------------------------
+
+    async def publish(self, subject: str, payload: bytes) -> int:
+        return (await self._call("publish", subject=subject, payload=payload))["delivered"]
+
+    async def subscribe(self, subject: str,
+                        queue_group: Optional[str] = None) -> Subscription:
+        resp = await self._call("subscribe", subject=subject, queue_group=queue_group)
+        s = Subscription(self, resp["sub_id"])
+        self._subs[s.sub_id] = s
+        for item in self._orphan_msgs.pop(s.sub_id, []):
+            s.queue.put_nowait(item)
+        return s
+
+    async def unsubscribe(self, sub_id: int) -> None:
+        self._subs.pop(sub_id, None)
+        await self._call("unsubscribe", sub_id=sub_id)
+        self._orphan_msgs.pop(sub_id, None)
+
+    async def ping(self) -> float:
+        return (await self._call("ping"))["time"]
+
+
+__all__ = ["Coordinator", "CoordClient", "Watch", "WatchEvent", "Subscription",
+           "Lease"]

@@ -1,0 +1,94 @@
+"""PushRouter: load-balancing request fan-out with fault detection.
+
+Parity: reference ``lib/runtime/src/pipeline/network/egress/push_router.rs``
+(``RouterMode::{RoundRobin, Random, Direct, KV}``, NoResponders/stream-drop
+instance-down marking).  The KV mode lives in ``dynamo_tpu.kv_router`` and
+wraps this router.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import enum
+import logging
+import random
+from typing import Any, AsyncIterator, Dict, Optional
+
+from dynamo_tpu.runtime.client import Client
+from dynamo_tpu.runtime.rpc import ResponseStream, StreamEndedError
+
+logger = logging.getLogger(__name__)
+
+
+class RouterMode(enum.Enum):
+    ROUND_ROBIN = "round-robin"
+    RANDOM = "random"
+    DIRECT = "direct"
+    KV = "kv"
+
+
+class PushRouter:
+    """Routes requests across an endpoint's live instances."""
+
+    def __init__(self, client: Client, mode: RouterMode = RouterMode.ROUND_ROBIN,
+                 retries: int = 3):
+        self.client = client
+        self.mode = mode
+        self.retries = retries
+        self._rr = 0
+
+    def select_instance(self) -> int:
+        ids = sorted(self.client.instance_ids())
+        if not ids:
+            raise ConnectionError(
+                f"no instances available for {self.client.endpoint.path}")
+        if self.mode == RouterMode.RANDOM:
+            return random.choice(ids)
+        chosen = ids[self._rr % len(ids)]
+        self._rr += 1
+        return chosen
+
+    async def _open(self, payload: Any, instance_id: Optional[int],
+                    headers: Optional[Dict[str, Any]]
+                    ) -> "tuple[int, ResponseStream]":
+        """Open a response stream; returns (chosen_instance_id, stream).
+
+        Connect-level failures on router-selected instances fail over to other
+        instances (up to ``retries``) and mark the unreachable one down.  A
+        caller-pinned ``instance_id`` is never silently rerouted.
+        """
+        last_err: Optional[Exception] = None
+        for _attempt in range(max(1, self.retries)):
+            iid = instance_id if instance_id is not None else self.select_instance()
+            try:
+                return iid, await self.client.direct(payload, iid, headers)
+            except ConnectionError as e:
+                last_err = e
+                self.client.report_instance_down(iid)
+                if instance_id is not None:
+                    break  # caller pinned the instance; don't fail over silently
+        raise ConnectionError(
+            f"all attempts to reach {self.client.endpoint.path} failed: {last_err}")
+
+    async def generate(self, payload: Any, instance_id: Optional[int] = None,
+                       headers: Optional[Dict[str, Any]] = None) -> ResponseStream:
+        _iid, stream = await self._open(payload, instance_id, headers)
+        return stream
+
+    async def generate_stream(self, payload: Any,
+                              instance_id: Optional[int] = None,
+                              headers: Optional[Dict[str, Any]] = None
+                              ) -> AsyncIterator[Any]:
+        """Convenience: iterate response payloads; marks the instance down on
+        mid-stream drop and re-raises ``StreamEndedError`` for the migration
+        operator to handle."""
+        iid, stream = await self._open(payload, instance_id, headers)
+        try:
+            async for item in stream:
+                yield item
+        except StreamEndedError:
+            self.client.report_instance_down(iid)
+            raise
+
+
+__all__ = ["PushRouter", "RouterMode"]

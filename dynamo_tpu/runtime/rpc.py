@@ -1,0 +1,407 @@
+"""Streaming RPC data plane: direct duplex TCP between callers and workers.
+
+The reference splits the data plane: requests ride NATS to the worker's service
+subject, and the worker dials back a raw TCP stream for the response
+(``lib/runtime/src/pipeline/network/egress/addressed_router.rs:86-161``,
+``ingress/push_handler.rs:25-133``).  That split exists because NATS provides
+the discovery/queueing.  Here discovery comes from the coordinator, so we use
+one duplex TCP connection per (caller, worker) pair and multiplex many
+concurrent request streams over it with stream ids — fewer hops, same
+semantics: a request frame out, a stream of response frames back, terminated by
+a ``final`` sentinel (the sentinel is how stream-drop faults are detected:
+missing ``final`` == "stream ended before generation completed").
+
+Server side: ``RpcServer`` hosts named endpoints.  A handler is an async
+callable ``handler(payload, ctx) -> AsyncIterator[Any]``; whatever it yields is
+msgpack-framed back.  Cancellation: callers send a ``cancel`` frame; the
+handler's task is cancelled and ``ctx.cancelled`` is set (parity with
+``AsyncEngineContext.stop_generating``, reference ``lib/runtime/src/engine.rs``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Any, AsyncIterator, Awaitable, Callable, Dict, Optional
+
+from dynamo_tpu.runtime.codec import read_frame, send_frame
+
+logger = logging.getLogger(__name__)
+
+Handler = Callable[[Any, "RequestContext"], AsyncIterator[Any]]
+
+
+class StreamEndedError(ConnectionError):
+    """Response stream dropped before the final sentinel arrived.
+
+    The migration operator keys on this (reference ``lib/llm/src/migration.rs``:
+    "Stream ended before generation completed")."""
+
+
+@dataclass
+class RequestContext:
+    """Per-request context passed to endpoint handlers."""
+
+    request_id: str
+    endpoint: str
+    headers: Dict[str, Any] = field(default_factory=dict)
+    _cancel_event: asyncio.Event = field(default_factory=asyncio.Event)
+
+    @property
+    def cancelled(self) -> bool:
+        return self._cancel_event.is_set()
+
+    def cancel(self) -> None:
+        self._cancel_event.set()
+
+    async def wait_cancelled(self) -> None:
+        await self._cancel_event.wait()
+
+
+@dataclass
+class EndpointStats:
+    """Per-endpoint counters, scraped via the ``__stats__`` builtin endpoint
+    (parity: NATS ``$SRV.STATS`` scraping, reference ``metrics_aggregator.rs``)."""
+
+    requests: int = 0
+    active: int = 0
+    errors: int = 0
+    data: Dict[str, Any] = field(default_factory=dict)  # custom stats-handler payload
+
+
+class RpcServer:
+    """Hosts endpoint handlers on one TCP listen port per process."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.host = host
+        self.port = port
+        self._handlers: Dict[str, Handler] = {}
+        self._stats: Dict[str, EndpointStats] = {}
+        self._stats_providers: Dict[str, Callable[[], Any]] = {}
+        self._server: Optional[asyncio.base_events.Server] = None
+        self._active_tasks: set = set()
+        self._conn_writers: set = set()
+
+    def register(self, endpoint: str, handler: Handler,
+                 stats_provider: Optional[Callable[[], Any]] = None) -> None:
+        self._handlers[endpoint] = handler
+        self._stats.setdefault(endpoint, EndpointStats())
+        if stats_provider is not None:
+            self._stats_providers[endpoint] = stats_provider
+
+    def unregister(self, endpoint: str) -> None:
+        self._handlers.pop(endpoint, None)
+        self._stats_providers.pop(endpoint, None)
+
+    def stats(self, endpoint: str) -> EndpointStats:
+        return self._stats.setdefault(endpoint, EndpointStats())
+
+    async def start(self) -> "RpcServer":
+        self._server = await asyncio.start_server(self._handle_conn, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self
+
+    async def stop(self) -> None:
+        if self._server:
+            self._server.close()
+        for t in list(self._active_tasks):
+            t.cancel()
+        if self._active_tasks:
+            await asyncio.gather(*self._active_tasks, return_exceptions=True)
+        # close live connections BEFORE wait_closed: since py3.12 wait_closed
+        # blocks until every connection handler returns
+        for w in list(self._conn_writers):
+            try:
+                w.close()
+            except Exception:
+                pass
+        if self._server:
+            await self._server.wait_closed()
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    async def _handle_conn(self, reader: asyncio.StreamReader,
+                           writer: asyncio.StreamWriter) -> None:
+        wlock = asyncio.Lock()
+        streams: Dict[int, RequestContext] = {}
+        stream_tasks: Dict[int, asyncio.Task] = {}
+        self._conn_writers.add(writer)
+
+        async def send(obj: Any) -> None:
+            async with wlock:
+                await send_frame(writer, obj)
+
+        try:
+            while True:
+                frame = await read_frame(reader)
+                if frame is None:
+                    break
+                op = frame.get("op")
+                if op == "req":
+                    sid = frame["sid"]
+                    ctx = RequestContext(
+                        request_id=frame.get("headers", {}).get("request_id", str(sid)),
+                        endpoint=frame["endpoint"],
+                        headers=frame.get("headers", {}),
+                    )
+                    streams[sid] = ctx
+                    task = asyncio.create_task(
+                        self._run_stream(send, sid, ctx, frame.get("payload")))
+                    self._active_tasks.add(task)
+                    stream_tasks[sid] = task
+                    task.add_done_callback(self._active_tasks.discard)
+                    task.add_done_callback(lambda _t, s=sid: streams.pop(s, None))
+                    task.add_done_callback(lambda _t, s=sid: stream_tasks.pop(s, None))
+                elif op == "cancel":
+                    # cooperative signal first (handlers can flush/cleanup via
+                    # ctx.cancelled), then hard-cancel so a handler blocked in
+                    # an await can't leak the stream slot forever
+                    ctx = streams.get(frame["sid"])
+                    if ctx:
+                        ctx.cancel()
+                    task = stream_tasks.get(frame["sid"])
+                    if task is not None:
+                        task.cancel()
+                elif op == "ping":
+                    await send({"op": "pong", "rid": frame.get("rid")})
+        except (ConnectionError, asyncio.CancelledError):
+            pass
+        finally:
+            self._conn_writers.discard(writer)
+            for ctx in streams.values():
+                ctx.cancel()
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def _run_stream(self, send, sid: int, ctx: RequestContext,
+                          payload: Any) -> None:
+        name = ctx.endpoint
+        if name == "__stats__":
+            out = {
+                ep: {"requests": st.requests, "active": st.active,
+                     "errors": st.errors,
+                     "data": (self._stats_providers[ep]()
+                              if ep in self._stats_providers else st.data)}
+                for ep, st in self._stats.items()
+            }
+            await send({"op": "data", "sid": sid, "payload": out})
+            await send({"op": "final", "sid": sid})
+            return
+        handler = self._handlers.get(name)
+        if handler is None:
+            await send({"op": "err", "sid": sid,
+                        "error": f"no such endpoint: {name}"})
+            return
+        st = self.stats(name)
+        st.requests += 1
+        st.active += 1
+        try:
+            agen = handler(payload, ctx)
+            async for item in agen:
+                if ctx.cancelled:
+                    await agen.aclose()
+                    break
+                await send({"op": "data", "sid": sid, "payload": item})
+            await send({"op": "final", "sid": sid})
+        except asyncio.CancelledError:
+            # caller cancelled (or server stopping): nothing more to send; the
+            # client side tears its stream down locally on cancel
+            raise
+        except (ConnectionError, RuntimeError) as e:
+            # connection gone: nothing more to send
+            logger.debug("stream %d connection lost: %s", sid, e)
+            st.errors += 1
+        except Exception as e:
+            st.errors += 1
+            logger.exception("endpoint %s handler error", name)
+            try:
+                await send({"op": "err", "sid": sid, "error": str(e)})
+            except Exception:
+                pass
+        finally:
+            st.active -= 1
+
+
+# ---------------------------------------------------------------------------
+# Client
+# ---------------------------------------------------------------------------
+
+
+class ResponseStream:
+    """Async iterator over one request's response frames.
+
+    Raises ``StreamEndedError`` if the connection drops before ``final``; a
+    server-reported handler error raises ``RuntimeError``.
+    """
+
+    def __init__(self, conn: "RpcConnection", sid: int):
+        self._conn = conn
+        self.sid = sid
+        self.queue: asyncio.Queue = asyncio.Queue()
+        self.finished = False
+
+    def __aiter__(self) -> "ResponseStream":
+        return self
+
+    async def __anext__(self) -> Any:
+        if self.finished:
+            raise StopAsyncIteration
+        kind, value = await self.queue.get()
+        if kind == "data":
+            return value
+        self.finished = True
+        self._conn._streams.pop(self.sid, None)
+        if kind == "final":
+            raise StopAsyncIteration
+        if kind == "err":
+            raise RuntimeError(value)
+        raise StreamEndedError("stream ended before generation completed")
+
+    async def cancel(self) -> None:
+        """Tell the worker to stop and finish this stream locally (the worker
+        may be hard-cancelled mid-await and never send a final frame)."""
+        await self._conn.send_cancel(self.sid)
+        if not self.finished:
+            self.finished = True
+            self._conn._streams.pop(self.sid, None)
+
+
+class RpcConnection:
+    """One multiplexed duplex connection to a worker's RpcServer."""
+
+    def __init__(self, address: str):
+        host, _, port = address.rpartition(":")
+        self.address = address
+        self.host, self.port = host or "127.0.0.1", int(port)
+        self._sids = itertools.count(1)
+        self._streams: Dict[int, ResponseStream] = {}
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._wlock: Optional[asyncio.Lock] = None
+        self._reader_task: Optional[asyncio.Task] = None
+        self.alive = False
+
+    async def connect(self) -> "RpcConnection":
+        self._reader, self._writer = await asyncio.open_connection(self.host, self.port)
+        self._wlock = asyncio.Lock()
+        self._reader_task = asyncio.create_task(self._read_loop())
+        self.alive = True
+        return self
+
+    async def close(self) -> None:
+        self.alive = False
+        if self._reader_task:
+            self._reader_task.cancel()
+            try:
+                await self._reader_task
+            except asyncio.CancelledError:
+                pass
+        if self._writer:
+            try:
+                self._writer.close()
+                await self._writer.wait_closed()
+            except Exception:
+                pass
+
+    async def _read_loop(self) -> None:
+        try:
+            while True:
+                frame = await read_frame(self._reader)
+                if frame is None:
+                    break
+                op = frame.get("op")
+                sid = frame.get("sid")
+                stream = self._streams.get(sid)
+                if stream is None:
+                    continue
+                if op == "data":
+                    stream.queue.put_nowait(("data", frame.get("payload")))
+                elif op == "final":
+                    stream.queue.put_nowait(("final", None))
+                elif op == "err":
+                    stream.queue.put_nowait(("err", frame.get("error")))
+        except (ConnectionError, asyncio.CancelledError):
+            pass
+        finally:
+            self.alive = False
+            for stream in list(self._streams.values()):
+                stream.queue.put_nowait(("drop", None))
+            self._streams.clear()
+
+    async def request(self, endpoint: str, payload: Any,
+                      headers: Optional[Dict[str, Any]] = None) -> ResponseStream:
+        if not self.alive:
+            raise ConnectionError(f"connection to {self.address} is down")
+        sid = next(self._sids)
+        stream = ResponseStream(self, sid)
+        self._streams[sid] = stream
+        try:
+            async with self._wlock:
+                await send_frame(self._writer, {
+                    "op": "req", "sid": sid, "endpoint": endpoint,
+                    "payload": payload, "headers": headers or {}})
+        except (ConnectionError, RuntimeError) as e:
+            self._streams.pop(sid, None)
+            self.alive = False
+            raise ConnectionError(str(e)) from e
+        return stream
+
+    async def send_cancel(self, sid: int) -> None:
+        if not self.alive:
+            return
+        try:
+            async with self._wlock:
+                await send_frame(self._writer, {"op": "cancel", "sid": sid})
+        except (ConnectionError, RuntimeError):
+            self.alive = False
+
+
+class RpcClientPool:
+    """Connection pool: one live RpcConnection per worker address."""
+
+    def __init__(self) -> None:
+        self._conns: Dict[str, RpcConnection] = {}
+        self._locks: Dict[str, asyncio.Lock] = {}
+
+    async def get(self, address: str) -> RpcConnection:
+        conn = self._conns.get(address)
+        if conn is not None and conn.alive:
+            return conn
+        lock = self._locks.setdefault(address, asyncio.Lock())
+        async with lock:
+            conn = self._conns.get(address)
+            if conn is not None and conn.alive:
+                return conn
+            conn = RpcConnection(address)
+            await conn.connect()
+            self._conns[address] = conn
+            return conn
+
+    def drop(self, address: str) -> None:
+        conn = self._conns.pop(address, None)
+        if conn is not None:
+            asyncio.ensure_future(conn.close())
+
+    async def close(self) -> None:
+        for conn in list(self._conns.values()):
+            await conn.close()
+        self._conns.clear()
+
+
+__all__ = [
+    "RpcServer",
+    "RpcConnection",
+    "RpcClientPool",
+    "ResponseStream",
+    "RequestContext",
+    "StreamEndedError",
+    "EndpointStats",
+    "Handler",
+]

@@ -1,0 +1,216 @@
+"""Runtime and DistributedRuntime: process harness + node-level singleton.
+
+Parity: reference ``lib/runtime/src/{runtime,distributed,worker}.rs`` —
+``Runtime`` (cancellation tree, task spawning), ``DistributedRuntime`` (etcd +
+NATS clients, lazy TCP server, component registry), ``Worker::execute``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import logging
+import os
+import signal
+from typing import Any, Awaitable, Callable, Dict, Optional
+
+from dynamo_tpu.runtime import codec
+from dynamo_tpu.runtime.component import Namespace
+from dynamo_tpu.runtime.coordinator import CoordClient, Coordinator, Lease, Subscription
+from dynamo_tpu.runtime.rpc import RpcClientPool, RpcServer
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_COORDINATOR = os.environ.get("DYN_COORDINATOR", "127.0.0.1:6650")
+DEFAULT_LEASE_TTL = float(os.environ.get("DYN_LEASE_TTL", "5.0"))
+
+
+class Runtime:
+    """Process-local runtime: shutdown token + supervised background tasks."""
+
+    def __init__(self) -> None:
+        self._shutdown = asyncio.Event()
+        self._tasks: set = set()
+
+    @property
+    def is_shutdown(self) -> bool:
+        return self._shutdown.is_set()
+
+    def shutdown(self) -> None:
+        self._shutdown.set()
+
+    async def wait_shutdown(self) -> None:
+        await self._shutdown.wait()
+
+    def spawn(self, coro: Awaitable[Any], name: Optional[str] = None) -> asyncio.Task:
+        task = asyncio.create_task(coro, name=name)
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
+        return task
+
+    def spawn_critical(self, coro: Awaitable[Any],
+                       name: Optional[str] = None) -> asyncio.Task:
+        """Supervised task: if it raises, the whole runtime shuts down.
+
+        Parity: reference ``CriticalTaskExecutionHandle``
+        (``lib/runtime/src/utils/task.rs``).
+        """
+        async def _wrapped() -> None:
+            try:
+                await coro
+            except asyncio.CancelledError:
+                pass
+            except Exception:
+                logger.exception("critical task %s failed; shutting down", name)
+                self.shutdown()
+        return self.spawn(_wrapped(), name=name)
+
+    async def drain(self) -> None:
+        for t in list(self._tasks):
+            t.cancel()
+        if self._tasks:
+            await asyncio.gather(*self._tasks, return_exceptions=True)
+
+
+class DistributedRuntime:
+    """Node-level singleton: coordinator client, lease, RPC server, event bus.
+
+    ``DistributedRuntime.create()`` connects to an existing coordinator (or, in
+    ``standalone=True`` mode, embeds one in-process — handy for tests and
+    single-node deployments; the reference instead requires external
+    etcd+NATS).
+    """
+
+    def __init__(self, runtime: Runtime, coord: CoordClient,
+                 embedded: Optional[Coordinator] = None):
+        self.runtime = runtime
+        self.coord = coord
+        self._embedded = embedded
+        self.rpc_server: Optional[RpcServer] = None
+        self.rpc_pool = RpcClientPool()
+        self._primary_lease: Optional[Lease] = None
+        self._rpc_host = os.environ.get("DYN_RPC_HOST", "127.0.0.1")
+        # serialize lazy init: concurrent serve() calls must share one lease
+        # and one RpcServer
+        self._init_lock = asyncio.Lock()
+
+    @classmethod
+    async def create(cls, coordinator: str = DEFAULT_COORDINATOR,
+                     runtime: Optional[Runtime] = None,
+                     standalone: bool = False) -> "DistributedRuntime":
+        runtime = runtime or Runtime()
+        embedded = None
+        if standalone:
+            embedded = await Coordinator(port=0).start()
+            coordinator = embedded.address
+        coord = await CoordClient(coordinator).connect()
+        return cls(runtime, coord, embedded)
+
+    async def close(self) -> None:
+        if self._primary_lease is not None:
+            await self._primary_lease.revoke()
+            self._primary_lease = None
+        await self.rpc_pool.close()
+        if self.rpc_server is not None:
+            await self.rpc_server.stop()
+            self.rpc_server = None
+        await self.coord.close()
+        if self._embedded is not None:
+            await self._embedded.stop()
+            self._embedded = None
+        await self.runtime.drain()
+
+    async def __aenter__(self) -> "DistributedRuntime":
+        return self
+
+    async def __aexit__(self, *exc) -> None:
+        await self.close()
+
+    # -- naming ------------------------------------------------------------
+
+    def namespace(self, name: str) -> Namespace:
+        return Namespace(self, name)
+
+    # -- serving infra -----------------------------------------------------
+
+    async def ensure_rpc_server(self) -> RpcServer:
+        async with self._init_lock:
+            if self.rpc_server is None:
+                port = int(os.environ.get("DYN_RPC_PORT", "0"))
+                self.rpc_server = await RpcServer(host=self._rpc_host, port=port).start()
+                logger.info("rpc server listening on %s", self.rpc_server.address)
+            return self.rpc_server
+
+    async def primary_lease(self) -> Lease:
+        """The process's liveness lease: all instance registrations attach to
+        it, so losing it (crash, hang) deregisters everything within TTL.
+        Parity: reference primary lease (``distributed.rs:45-136``)."""
+        async with self._init_lock:
+            if self._primary_lease is None:
+                self._primary_lease = await self.coord.grant_lease(
+                    ttl=DEFAULT_LEASE_TTL, keepalive=True)
+                self.runtime.spawn_critical(
+                    self._watch_lease(self._primary_lease), name="primary-lease-watch")
+            return self._primary_lease
+
+    async def _watch_lease(self, lease: Lease) -> None:
+        await lease.lost.wait()
+        raise ConnectionError("primary lease lost")
+
+    # -- typed event bus ---------------------------------------------------
+
+    async def publish_event(self, subject: str, obj: Any) -> int:
+        """Publish a msgpack-encoded event object."""
+        return await self.coord.publish(subject, codec.pack(obj))
+
+    async def subscribe_events(self, subject: str,
+                               queue_group: Optional[str] = None) -> "TypedSubscription":
+        sub = await self.coord.subscribe(subject, queue_group=queue_group)
+        return TypedSubscription(sub)
+
+
+class TypedSubscription:
+    """Wraps a raw Subscription, msgpack-decoding payloads."""
+
+    def __init__(self, sub: Subscription):
+        self._sub = sub
+
+    def __aiter__(self) -> "TypedSubscription":
+        return self
+
+    async def __anext__(self):
+        subject, payload = await self._sub.__anext__()
+        return subject, codec.unpack(payload)
+
+    async def cancel(self) -> None:
+        await self._sub.cancel()
+
+
+async def worker_main(app: Callable[[DistributedRuntime], Awaitable[None]],
+                      coordinator: str = DEFAULT_COORDINATOR,
+                      standalone: bool = False) -> None:
+    """Process harness: build the DRT, install signal handlers, run ``app``,
+    drain on shutdown.  Parity: reference ``Worker::execute`` +
+    ``@dynamo_worker()`` decorator."""
+    drt = await DistributedRuntime.create(coordinator, standalone=standalone)
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        with contextlib.suppress(NotImplementedError):
+            loop.add_signal_handler(sig, drt.runtime.shutdown)
+    try:
+        app_task = asyncio.create_task(app(drt))
+        shutdown_task = asyncio.create_task(drt.runtime.wait_shutdown())
+        done, _pending = await asyncio.wait(
+            {app_task, shutdown_task}, return_when=asyncio.FIRST_COMPLETED)
+        if app_task in done:
+            app_task.result()  # propagate app errors
+        else:
+            app_task.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await app_task
+    finally:
+        await drt.close()
+
+
+__all__ = ["Runtime", "DistributedRuntime", "TypedSubscription", "worker_main",
+           "DEFAULT_COORDINATOR"]

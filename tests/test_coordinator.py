@@ -1,0 +1,113 @@
+"""Tests for the coordinator control plane (KV/lease/watch/pub-sub)."""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.runtime.coordinator import Coordinator, CoordClient
+
+
+async def test_kv_put_get_delete():
+    async with Coordinator() as coord:
+        async with CoordClient(coord.address) as c:
+            await c.put("a/b", b"1")
+            await c.put("a/c", b"2")
+            await c.put("x/y", b"3")
+            assert await c.get("a/b") == b"1"
+            assert await c.get("missing") is None
+            items = await c.get_prefix("a/")
+            assert [(k, v) for k, v in items] == [("a/b", b"1"), ("a/c", b"2")]
+            assert await c.delete("a/b") == 1
+            assert await c.delete("a/b") == 0
+            assert await c.get("a/b") is None
+
+
+async def test_put_if_absent():
+    async with Coordinator() as coord:
+        async with CoordClient(coord.address) as c:
+            assert await c.put_if_absent("k", b"first") is True
+            assert await c.put_if_absent("k", b"second") is False
+            assert await c.get("k") == b"first"
+
+
+async def test_lease_expiry_removes_keys():
+    async with Coordinator() as coord:
+        async with CoordClient(coord.address) as c:
+            lease = await c.grant_lease(ttl=0.6, keepalive=False)
+            await c.put("inst/worker1", b"addr", lease_id=lease.lease_id)
+            assert await c.get("inst/worker1") == b"addr"
+            await asyncio.sleep(1.5)  # TTL + scanner interval
+            assert await c.get("inst/worker1") is None
+
+
+async def test_lease_keepalive_sustains_keys():
+    async with Coordinator() as coord:
+        async with CoordClient(coord.address) as c:
+            lease = await c.grant_lease(ttl=0.6, keepalive=True)
+            await c.put("inst/worker1", b"addr", lease_id=lease.lease_id)
+            await asyncio.sleep(1.5)
+            assert await c.get("inst/worker1") == b"addr"
+            await lease.revoke()
+            await asyncio.sleep(0.1)
+            assert await c.get("inst/worker1") is None
+
+
+async def test_watch_prefix_snapshot_and_events():
+    async with Coordinator() as coord:
+        async with CoordClient(coord.address) as c1, CoordClient(coord.address) as c2:
+            await c1.put("w/a", b"1")
+            watch = await c2.watch_prefix("w/")
+            assert watch.snapshot == [("w/a", b"1")]
+            await c1.put("w/b", b"2")
+            ev = await asyncio.wait_for(watch.queue.get(), 2)
+            assert (ev.type, ev.key, ev.value) == ("put", "w/b", b"2")
+            await c1.delete("w/a")
+            ev = await asyncio.wait_for(watch.queue.get(), 2)
+            assert (ev.type, ev.key) == ("delete", "w/a")
+            # keys outside the prefix don't notify
+            await c1.put("other/z", b"9")
+            await c1.put("w/c", b"3")
+            ev = await asyncio.wait_for(watch.queue.get(), 2)
+            assert ev.key == "w/c"
+
+
+async def test_pubsub_exact_and_wildcard():
+    async with Coordinator() as coord:
+        async with CoordClient(coord.address) as pub, CoordClient(coord.address) as s:
+            exact = await s.subscribe("ns.comp.kv_events")
+            wild = await s.subscribe("ns.>")
+            n = await pub.publish("ns.comp.kv_events", b"evt")
+            assert n == 2
+            subj, payload = await asyncio.wait_for(exact.queue.get(), 2)
+            assert (subj, payload) == ("ns.comp.kv_events", b"evt")
+            subj, payload = await asyncio.wait_for(wild.queue.get(), 2)
+            assert payload == b"evt"
+            n = await pub.publish("other.subject", b"x")
+            assert n == 0
+
+
+async def test_queue_group_delivers_to_one():
+    async with Coordinator() as coord:
+        async with CoordClient(coord.address) as pub, \
+                CoordClient(coord.address) as s1, CoordClient(coord.address) as s2:
+            q1 = await s1.subscribe("prefill", queue_group="g")
+            q2 = await s2.subscribe("prefill", queue_group="g")
+            for i in range(4):
+                n = await pub.publish("prefill", str(i).encode())
+                assert n == 1
+            await asyncio.sleep(0.2)
+            total = q1.queue.qsize() + q2.queue.qsize()
+            assert total == 4
+            assert q1.queue.qsize() == 2 and q2.queue.qsize() == 2  # round-robin
+
+
+async def test_concurrent_clients():
+    async with Coordinator() as coord:
+        async def worker(i: int):
+            async with CoordClient(coord.address) as c:
+                for j in range(20):
+                    await c.put(f"load/{i}/{j}", str(j).encode())
+                items = await c.get_prefix(f"load/{i}/")
+                assert len(items) == 20
+
+        await asyncio.gather(*[worker(i) for i in range(8)])

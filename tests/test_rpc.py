@@ -1,0 +1,173 @@
+"""Tests for the streaming RPC data plane."""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.runtime.rpc import (
+    RpcConnection,
+    RpcServer,
+    StreamEndedError,
+)
+
+
+async def echo_handler(payload, ctx):
+    for tok in payload["tokens"]:
+        yield {"tok": tok}
+
+
+async def test_basic_stream():
+    server = await RpcServer().start()
+    server.register("gen", echo_handler)
+    try:
+        conn = await RpcConnection(server.address).connect()
+        stream = await conn.request("gen", {"tokens": [1, 2, 3]})
+        out = [item async for item in stream]
+        assert out == [{"tok": 1}, {"tok": 2}, {"tok": 3}]
+        await conn.close()
+    finally:
+        await server.stop()
+
+
+async def test_multiplexed_concurrent_streams():
+    async def slow_echo(payload, ctx):
+        for tok in payload["tokens"]:
+            await asyncio.sleep(0.01)
+            yield tok
+
+    server = await RpcServer().start()
+    server.register("gen", slow_echo)
+    try:
+        conn = await RpcConnection(server.address).connect()
+
+        async def run(n):
+            stream = await conn.request("gen", {"tokens": list(range(n))})
+            return [i async for i in stream]
+
+        results = await asyncio.gather(*[run(5) for _ in range(10)])
+        assert all(r == list(range(5)) for r in results)
+        await conn.close()
+    finally:
+        await server.stop()
+
+
+async def test_handler_error_propagates():
+    async def bad(payload, ctx):
+        yield 1
+        raise ValueError("boom")
+
+    server = await RpcServer().start()
+    server.register("gen", bad)
+    try:
+        conn = await RpcConnection(server.address).connect()
+        stream = await conn.request("gen", {})
+        assert await stream.__anext__() == 1
+        with pytest.raises(RuntimeError, match="boom"):
+            await stream.__anext__()
+        await conn.close()
+    finally:
+        await server.stop()
+
+
+async def test_unknown_endpoint():
+    server = await RpcServer().start()
+    try:
+        conn = await RpcConnection(server.address).connect()
+        stream = await conn.request("nope", {})
+        with pytest.raises(RuntimeError, match="no such endpoint"):
+            await stream.__anext__()
+        await conn.close()
+    finally:
+        await server.stop()
+
+
+async def test_cancellation_reaches_handler():
+    started = asyncio.Event()
+    handler_done = asyncio.Event()
+
+    async def endless(payload, ctx):
+        started.set()
+        try:
+            i = 0
+            while not ctx.cancelled:
+                yield i
+                i += 1
+                await asyncio.sleep(0.01)
+        finally:
+            handler_done.set()  # fires on cooperative exit OR hard cancel
+
+    server = await RpcServer().start()
+    server.register("gen", endless)
+    try:
+        conn = await RpcConnection(server.address).connect()
+        stream = await conn.request("gen", {})
+        await asyncio.wait_for(started.wait(), 2)
+        await stream.__anext__()
+        await stream.cancel()
+        await asyncio.wait_for(handler_done.wait(), 2)
+        assert stream.finished  # cancel finishes the client stream locally
+        await conn.close()
+    finally:
+        await server.stop()
+
+
+async def test_cancellation_unblocks_stuck_handler():
+    """A handler blocked in an await (never yielding) must still be reaped."""
+    entered = asyncio.Event()
+    reaped = asyncio.Event()
+
+    async def stuck(payload, ctx):
+        entered.set()
+        try:
+            await asyncio.sleep(300)  # blocked: no yield, no ctx poll
+            yield 0
+        finally:
+            reaped.set()
+
+    server = await RpcServer().start()
+    server.register("gen", stuck)
+    try:
+        conn = await RpcConnection(server.address).connect()
+        stream = await conn.request("gen", {})
+        await asyncio.wait_for(entered.wait(), 2)
+        await stream.cancel()
+        await asyncio.wait_for(reaped.wait(), 2)
+        assert server.stats("gen").active == 0  # slot not leaked
+        await conn.close()
+    finally:
+        await server.stop()
+
+
+async def test_server_death_raises_stream_ended():
+    async def hang(payload, ctx):
+        yield 1
+        await asyncio.sleep(30)
+        yield 2
+
+    server = await RpcServer().start()
+    server.register("gen", hang)
+    conn = await RpcConnection(server.address).connect()
+    stream = await conn.request("gen", {})
+    assert await stream.__anext__() == 1
+    await server.stop()  # kill mid-stream
+    with pytest.raises(StreamEndedError):
+        await asyncio.wait_for(stream.__anext__(), 5)
+    await conn.close()
+
+
+async def test_stats_endpoint():
+    server = await RpcServer().start()
+    server.register("gen", echo_handler,
+                    stats_provider=lambda: {"kv_active_blocks": 7})
+    try:
+        conn = await RpcConnection(server.address).connect()
+        s = await conn.request("gen", {"tokens": [1]})
+        async for _ in s:
+            pass
+        stats_stream = await conn.request("__stats__", None)
+        stats = await stats_stream.__anext__()
+        assert stats["gen"]["requests"] == 1
+        assert stats["gen"]["data"] == {"kv_active_blocks": 7}
+        await conn.close()
+    finally:
+        await server.stop()

@@ -1,0 +1,218 @@
+"""Tests for DistributedRuntime: component model, discovery, routing."""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.runtime import DistributedRuntime, PushRouter, RouterMode
+from dynamo_tpu.runtime.rpc import StreamEndedError
+
+
+async def make_drt(coordinator=None, standalone=False):
+    return await DistributedRuntime.create(
+        coordinator=coordinator or "127.0.0.1:1", standalone=standalone)
+
+
+async def echo_handler(payload, ctx):
+    for t in payload.get("tokens", []):
+        yield {"tok": t}
+
+
+async def test_serve_and_call_endpoint():
+    drt = await make_drt(standalone=True)
+    try:
+        ep = drt.namespace("ns").component("worker").endpoint("generate")
+        served = await ep.serve(echo_handler)
+        client = await ep.client()
+        insts = await client.wait_for_instances(1, timeout=5)
+        assert len(insts) == 1
+        stream = await client.direct({"tokens": [1, 2]}, insts[0].instance_id)
+        out = [x async for x in stream]
+        assert out == [{"tok": 1}, {"tok": 2}]
+        await served.shutdown()
+        await client.close()
+    finally:
+        await drt.close()
+
+
+async def test_cross_process_discovery():
+    """Two DRTs sharing one coordinator: worker in one, client in the other."""
+    worker_drt = await make_drt(standalone=True)
+    coord_addr = worker_drt._embedded.address
+    client_drt = await DistributedRuntime.create(coordinator=coord_addr)
+    try:
+        ep_w = worker_drt.namespace("ns").component("w").endpoint("generate")
+        await ep_w.serve(echo_handler)
+
+        ep_c = client_drt.namespace("ns").component("w").endpoint("generate")
+        client = await ep_c.client()
+        insts = await client.wait_for_instances(1, timeout=5)
+        stream = await client.direct({"tokens": [7]}, insts[0].instance_id)
+        assert [x async for x in stream] == [{"tok": 7}]
+        await client.close()
+    finally:
+        await client_drt.close()
+        await worker_drt.close()
+
+
+async def test_instance_removed_on_shutdown():
+    drt = await make_drt(standalone=True)
+    try:
+        ep = drt.namespace("ns").component("w").endpoint("gen")
+        served = await ep.serve(echo_handler)
+        client = await ep.client()
+        await client.wait_for_instances(1, timeout=5)
+        await served.shutdown()
+        for _ in range(50):
+            if not client.instance_ids():
+                break
+            await asyncio.sleep(0.05)
+        assert client.instance_ids() == []
+        await client.close()
+    finally:
+        await drt.close()
+
+
+async def test_round_robin_router():
+    drt = await make_drt(standalone=True)
+    coord_addr = drt._embedded.address
+    worker2 = await DistributedRuntime.create(coordinator=coord_addr)
+    try:
+        seen = []
+
+        def make_handler(tag):
+            async def h(payload, ctx):
+                seen.append(tag)
+                yield tag
+            return h
+
+        await drt.namespace("ns").component("w").endpoint("gen").serve(make_handler("a"))
+        await worker2.namespace("ns").component("w").endpoint("gen").serve(make_handler("b"))
+
+        client = await drt.namespace("ns").component("w").endpoint("gen").client()
+        await client.wait_for_instances(2, timeout=5)
+        router = PushRouter(client, RouterMode.ROUND_ROBIN)
+        for _ in range(4):
+            stream = await router.generate({})
+            async for _ in stream:
+                pass
+        assert sorted(seen) == ["a", "a", "b", "b"]
+        await client.close()
+    finally:
+        await worker2.close()
+        await drt.close()
+
+
+async def test_router_fails_over_dead_instance():
+    drt = await make_drt(standalone=True)
+    coord_addr = drt._embedded.address
+    worker2 = await DistributedRuntime.create(coordinator=coord_addr)
+    try:
+        await drt.namespace("ns").component("w").endpoint("gen").serve(echo_handler)
+        served2 = await worker2.namespace("ns").component("w").endpoint("gen").serve(echo_handler)
+
+        client = await drt.namespace("ns").component("w").endpoint("gen").client()
+        await client.wait_for_instances(2, timeout=5)
+
+        # kill worker2's rpc server without deregistering (simulates crash)
+        await worker2.rpc_server.stop()
+
+        router = PushRouter(client, RouterMode.ROUND_ROBIN, retries=3)
+        for _ in range(4):  # every request must succeed via failover
+            stream = await router.generate({"tokens": [1]})
+            out = [x async for x in stream]
+            assert out == [{"tok": 1}]
+        # the dead instance got marked down locally
+        assert len(client.instance_ids()) == 1
+        await client.close()
+    finally:
+        await worker2.close()
+        await drt.close()
+
+
+async def test_component_scrape_stats():
+    drt = await make_drt(standalone=True)
+    try:
+        comp = drt.namespace("ns").component("w")
+        await comp.endpoint("gen").serve(
+            echo_handler, stats_provider=lambda: {"load": 0.5})
+        client = await comp.endpoint("gen").client()
+        insts = await client.wait_for_instances(1, timeout=5)
+        stream = await client.direct({"tokens": [1]}, insts[0].instance_id)
+        async for _ in stream:
+            pass
+        stats = await comp.scrape_stats()
+        iid = insts[0].instance_id
+        assert stats[iid]["ns/w/gen"]["requests"] == 1
+        assert stats[iid]["ns/w/gen"]["data"] == {"load": 0.5}
+        await client.close()
+    finally:
+        await drt.close()
+
+
+async def test_typed_event_bus():
+    drt = await make_drt(standalone=True)
+    try:
+        sub = await drt.subscribe_events("ns.w.kv_events")
+        await drt.publish_event("ns.w.kv_events", {"event_id": 1, "blocks": [3, 4]})
+        subject, obj = await asyncio.wait_for(sub.__anext__(), 2)
+        assert subject == "ns.w.kv_events"
+        assert obj == {"event_id": 1, "blocks": [3, 4]}
+        await sub.cancel()
+    finally:
+        await drt.close()
+
+
+async def test_sibling_endpoint_prefix_no_collision():
+    """A client for endpoint "gen" must not discover sibling "generate"."""
+    drt = await make_drt(standalone=True)
+    try:
+        comp = drt.namespace("ns").component("w")
+        await comp.endpoint("generate").serve(echo_handler)
+        gen_client = await comp.endpoint("gen").client()
+        await asyncio.sleep(0.3)
+        assert gen_client.instance_ids() == []
+        with pytest.raises(TimeoutError):
+            await gen_client.wait_for_instances(1, timeout=0.5)
+        await gen_client.close()
+    finally:
+        await drt.close()
+
+
+async def test_concurrent_serve_single_lease_and_server():
+    """Concurrent serve() calls must share one lease and one RpcServer."""
+    drt = await make_drt(standalone=True)
+    try:
+        comp = drt.namespace("ns").component("w")
+        served = await asyncio.gather(
+            comp.endpoint("a").serve(echo_handler),
+            comp.endpoint("b").serve(echo_handler),
+            comp.endpoint("c").serve(echo_handler),
+        )
+        ids = {s.instance.instance_id for s in served}
+        addrs = {s.instance.address for s in served}
+        assert len(ids) == 1, f"expected one shared lease, got {ids}"
+        assert len(addrs) == 1, f"expected one shared RpcServer, got {addrs}"
+    finally:
+        await drt.close()
+
+
+async def test_router_generate_stream_fails_over():
+    """generate_stream (unpinned) must fail over connect-level failures."""
+    drt = await make_drt(standalone=True)
+    coord_addr = drt._embedded.address
+    worker2 = await DistributedRuntime.create(coordinator=coord_addr)
+    try:
+        await drt.namespace("ns").component("w").endpoint("gen").serve(echo_handler)
+        await worker2.namespace("ns").component("w").endpoint("gen").serve(echo_handler)
+        client = await drt.namespace("ns").component("w").endpoint("gen").client()
+        await client.wait_for_instances(2, timeout=5)
+        await worker2.rpc_server.stop()  # crash one worker's data plane
+        router = PushRouter(client, RouterMode.ROUND_ROBIN, retries=3)
+        for _ in range(4):
+            out = [x async for x in router.generate_stream({"tokens": [9]})]
+            assert out == [{"tok": 9}]
+        await client.close()
+    finally:
+        await worker2.close()
+        await drt.close()

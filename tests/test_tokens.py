@@ -1,0 +1,97 @@
+"""Tests for the token block / hashing library (dynamo_tpu.tokens)."""
+
+import pytest
+
+from dynamo_tpu.tokens import (
+    TokenBlockSequence,
+    compute_block_hash_for_seq,
+    compute_local_block_hash,
+)
+
+
+def test_empty_sequence():
+    seq = TokenBlockSequence(block_size=4)
+    assert len(seq) == 0
+    assert seq.num_complete_blocks == 0
+    assert seq.block_hashes() == []
+    assert seq.tokens() == []
+
+
+def test_append_seals_blocks():
+    seq = TokenBlockSequence(block_size=4)
+    completed = []
+    for t in range(10):
+        b = seq.append(t)
+        if b is not None:
+            completed.append(b)
+    assert len(seq) == 10
+    assert seq.num_complete_blocks == 2
+    assert [b.position for b in completed] == [0, 1]
+    assert seq.partial_tokens == [8, 9]
+    assert seq.tokens() == list(range(10))
+
+
+def test_hash_chaining_prefix_property():
+    # same prefix -> same block hashes; divergence changes all later hashes
+    a = TokenBlockSequence(range(16), block_size=4)
+    b = TokenBlockSequence(list(range(8)) + [99] + list(range(9, 16)), block_size=4)
+    ha, hb = a.block_hashes(), b.block_hashes()
+    assert ha[:2] == hb[:2]  # shared prefix blocks
+    assert ha[2] != hb[2]  # divergent block
+    assert ha[3] != hb[3]  # chained: divergence propagates
+
+
+def test_salt_changes_all_hashes():
+    a = TokenBlockSequence(range(8), block_size=4, salt_hash=0)
+    b = TokenBlockSequence(range(8), block_size=4, salt_hash=7)
+    assert a.block_hashes() != b.block_hashes()
+    assert a.blocks[0].local_hash == b.blocks[0].local_hash  # local unsalted
+
+
+def test_compute_block_hash_for_seq_matches_sequence():
+    toks = list(range(23))
+    seq = TokenBlockSequence(toks, block_size=8)
+    assert compute_block_hash_for_seq(toks, 8) == seq.block_hashes()
+    # partial final block is excluded
+    assert len(compute_block_hash_for_seq(toks, 8)) == 2
+
+
+def test_truncate_and_unwind():
+    seq = TokenBlockSequence(range(20), block_size=4)
+    ref_hashes = seq.block_hashes()
+    seq.truncate(10)
+    assert len(seq) == 10
+    assert seq.num_complete_blocks == 2
+    assert seq.block_hashes() == ref_hashes[:2]
+    assert seq.partial_tokens == [8, 9]
+    # re-extend reproduces identical hashes (determinism after rollback)
+    seq.extend(range(10, 20))
+    assert seq.block_hashes() == ref_hashes
+    seq.unwind(3)
+    assert len(seq) == 17
+    assert seq.tokens() == list(range(17))
+
+
+def test_truncate_validation():
+    seq = TokenBlockSequence(range(5), block_size=4)
+    with pytest.raises(ValueError):
+        seq.truncate(6)
+    with pytest.raises(ValueError):
+        seq.truncate(-1)
+
+
+def test_local_hash_position_independent():
+    seq = TokenBlockSequence(list(range(4)) * 3, block_size=4)
+    blocks = seq.blocks
+    # identical token content -> identical local hash, distinct chained hash
+    assert blocks[0].local_hash == blocks[1].local_hash == blocks[2].local_hash
+    assert len({b.block_hash for b in blocks}) == 3
+    assert blocks[0].local_hash == compute_local_block_hash(list(range(4)))
+
+
+def test_determinism_across_instances():
+    t = [5, 1, 9, 9, 2, 6, 8, 8, 3]
+    h1 = compute_block_hash_for_seq(t, 4, salt_hash=42)
+    h2 = compute_block_hash_for_seq(t, 4, salt_hash=42)
+    assert h1 == h2
+    assert all(isinstance(h, int) and h > 0 for h in h1)
