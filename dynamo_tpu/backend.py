@@ -1,0 +1,136 @@
+"""Backend stage: incremental detokenization + stop handling between the
+engine and the frontend.
+
+Parity: reference ``lib/llm/src/backend.rs:67-477`` (``Backend::from_mdc``,
+``Decoder``/``DecodeStream``, the stop-sequence "jail", eos handling).
+
+The *jail* holds back emitted text whenever its tail could be the start of a
+stop sequence; once the tail provably can't complete any stop string, the held
+text is released.  On a confirmed stop match, text is truncated at the match
+and the stream finishes with ``FinishReason.STOP``.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import AsyncIterator, List, Optional
+
+from dynamo_tpu.model_card import ModelDeploymentCard
+from dynamo_tpu.preprocessor.tokenizer import DecodeStream, HfTokenizer
+from dynamo_tpu.protocols.common import (
+    BackendOutput,
+    FinishReason,
+    LLMEngineOutput,
+    PreprocessedRequest,
+)
+
+logger = logging.getLogger(__name__)
+
+
+def _longest_suffix_prefix(text: str, stops: List[str]) -> int:
+    """Length of the longest suffix of ``text`` that is a proper prefix of any
+    stop string (i.e. text that must stay jailed)."""
+    best = 0
+    for stop in stops:
+        maxlen = min(len(text), len(stop) - 1)
+        for n in range(maxlen, 0, -1):
+            if stop.startswith(text[-n:]):
+                best = max(best, n)
+                break
+    return best
+
+
+class StopJail:
+    """Streaming stop-sequence matcher over text deltas."""
+
+    def __init__(self, stops: List[str]):
+        self.stops = [s for s in stops if s]
+        self._held = ""
+        self.matched: Optional[str] = None
+
+    def push(self, delta: str) -> str:
+        """Feed a text delta; returns text safe to emit now.  After a match,
+        ``self.matched`` is set and everything from the stop string on is
+        swallowed."""
+        if self.matched is not None:
+            return ""
+        if not self.stops:
+            return delta
+        text = self._held + delta
+        for stop in self.stops:
+            idx = text.find(stop)
+            if idx >= 0:
+                self.matched = stop
+                self._held = ""
+                return text[:idx]
+        keep = _longest_suffix_prefix(text, self.stops)
+        self._held = text[len(text) - keep:] if keep else ""
+        return text[:len(text) - keep] if keep else text
+
+    def flush(self) -> str:
+        """Release any jailed text at end of stream (no match happened)."""
+        out, self._held = self._held, ""
+        return out
+
+
+class Backend:
+    """Per-model detokenizer stage factory."""
+
+    def __init__(self, card: ModelDeploymentCard,
+                 tokenizer: Optional[HfTokenizer] = None):
+        self.card = card
+        self.tokenizer = tokenizer if tokenizer is not None else card.load_tokenizer()
+
+    async def transform(self, request: PreprocessedRequest,
+                        engine_stream: AsyncIterator[LLMEngineOutput]
+                        ) -> AsyncIterator[BackendOutput]:
+        """Wrap an engine output stream with detokenization + stop handling."""
+        decoder = self.tokenizer.decode_stream()
+        jail = StopJail(request.stop_conditions.stop or [])
+        eos_ids = set(request.eos_token_ids or self.card.eos_token_ids)
+        ignore_eos = request.stop_conditions.ignore_eos
+        stop_ids = set(request.stop_conditions.stop_token_ids or [])
+        completion = 0
+
+        async for out in engine_stream:
+            if out.error:
+                yield BackendOutput(error=out.error,
+                                    finish_reason=FinishReason.ERROR)
+                return
+            emit_ids: List[int] = []
+            finish: Optional[FinishReason] = out.finish_reason
+            for tok in out.token_ids:
+                completion += 1
+                if not ignore_eos and tok in eos_ids:
+                    finish = FinishReason.EOS
+                    break
+                if tok in stop_ids:
+                    finish = FinishReason.STOP
+                    break
+                emit_ids.append(tok)
+            text = jail.push(decoder.extend(emit_ids)) if emit_ids else ""
+            if jail.matched is not None:
+                finish = FinishReason.STOP
+            if finish is not None:
+                if jail.matched is None:
+                    text += jail.flush()
+                yield BackendOutput(
+                    token_ids=emit_ids, text=text or None,
+                    finish_reason=finish,
+                    cum_log_probs=out.cum_log_probs, log_probs=out.log_probs,
+                    prompt_tokens=out.prompt_tokens or len(request.token_ids),
+                    completion_tokens=out.completion_tokens or completion,
+                    cached_tokens=out.cached_tokens)
+                return
+            if emit_ids or text:
+                yield BackendOutput(
+                    token_ids=emit_ids, text=text or None,
+                    cum_log_probs=out.cum_log_probs, log_probs=out.log_probs)
+        # engine ended without a finish reason: surface what we have
+        tail = jail.flush()
+        yield BackendOutput(
+            token_ids=[], text=tail or None, finish_reason=FinishReason.LENGTH,
+            prompt_tokens=len(request.token_ids), completion_tokens=completion)
+
+
+__all__ = ["Backend", "StopJail"]

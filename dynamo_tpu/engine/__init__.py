@@ -1,0 +1,17 @@
+"""Model engines: the components that actually generate tokens.
+
+``EngineBase`` is the AsyncEngine-equivalent protocol (reference
+``lib/runtime/src/engine.rs``: ``AsyncEngine<Req, Resp, E>::generate``).
+Engines stream ``LLMEngineOutput`` frames for a ``PreprocessedRequest``.
+
+Implementations:
+- ``EchoEngine`` (here): deterministic test engine (reference
+  ``lib/llm/src/engines.rs`` echo_core/echo_full).
+- ``dynamo_tpu.engine.tpu_engine.TpuEngine``: the jax/Pallas continuous
+  batching engine — the reason this framework exists.
+- ``dynamo_tpu.mocker.MockerEngine``: vLLM-simulator with KV events/timing.
+"""
+
+from dynamo_tpu.engine.base import EngineBase, EchoEngine
+
+__all__ = ["EngineBase", "EchoEngine"]

@@ -1,0 +1,57 @@
+"""Engine protocol + echo test engine.
+
+Parity: reference ``lib/runtime/src/engine.rs`` (``AsyncEngine`` trait) and
+``lib/llm/src/engines.rs`` (echo engines used for pipeline tests).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import AsyncIterator, Optional
+
+from dynamo_tpu.protocols.common import (
+    FinishReason,
+    LLMEngineOutput,
+    PreprocessedRequest,
+)
+
+
+class EngineBase:
+    """Protocol: stream LLMEngineOutput frames for a preprocessed request."""
+
+    async def generate(self, request: PreprocessedRequest,
+                       ctx=None) -> AsyncIterator[LLMEngineOutput]:
+        raise NotImplementedError
+        yield  # pragma: no cover
+
+    async def start(self) -> None:  # optional lifecycle
+        pass
+
+    async def stop(self) -> None:
+        pass
+
+
+class EchoEngine(EngineBase):
+    """Echoes the prompt tokens back, one frame per token, with an optional
+    per-token delay (for streaming/timing tests)."""
+
+    def __init__(self, delay_s: float = 0.0):
+        self.delay_s = delay_s
+
+    async def generate(self, request: PreprocessedRequest,
+                       ctx=None) -> AsyncIterator[LLMEngineOutput]:
+        max_tokens = request.stop_conditions.max_tokens or len(request.token_ids)
+        n = min(len(request.token_ids), max_tokens)
+        for i in range(n):
+            if ctx is not None and getattr(ctx, "cancelled", False):
+                yield LLMEngineOutput(finish_reason=FinishReason.CANCELLED)
+                return
+            if self.delay_s:
+                await asyncio.sleep(self.delay_s)
+            yield LLMEngineOutput(token_ids=[request.token_ids[i]])
+        yield LLMEngineOutput(
+            finish_reason=FinishReason.LENGTH,
+            prompt_tokens=len(request.token_ids), completion_tokens=n)
+
+
+__all__ = ["EngineBase", "EchoEngine"]

@@ -1,0 +1,8 @@
+"""CLI entrypoints (parity: reference ``components/`` deployables).
+
+- ``python -m dynamo_tpu.frontend.coordinator`` — control-plane service
+- ``python -m dynamo_tpu.frontend.main`` — OpenAI frontend (HTTP + discovery)
+- ``python -m dynamo_tpu.frontend.echo_worker`` — echo test worker
+- ``python -m dynamo_tpu.frontend.mocker_worker`` — mock vLLM-style worker
+- ``python -m dynamo_tpu.frontend.tpu_worker`` — the jax/TPU model worker
+"""

@@ -1,0 +1,79 @@
+"""OpenAI frontend: HTTP service + model discovery + router in one process.
+
+Parity: reference ``components/frontend/src/dynamo/frontend/main.py`` —
+flags ``--router-mode {round-robin,random,kv}``, ``--kv-overlap-score-weight``,
+``--router-temperature``, ``--http-port``; plus ``--standalone`` to embed a
+coordinator (for single-node / dev runs).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+
+from dynamo_tpu.http.service import HttpService
+from dynamo_tpu.llm.model_manager import ModelManager, ModelWatcher
+from dynamo_tpu.runtime.push_router import RouterMode
+from dynamo_tpu.runtime.runtime import DEFAULT_COORDINATOR, DistributedRuntime
+from dynamo_tpu.utils.logging import configure_logging
+
+logger = logging.getLogger(__name__)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(description="dynamo_tpu OpenAI frontend")
+    parser.add_argument("--coordinator", default=DEFAULT_COORDINATOR)
+    parser.add_argument("--standalone", action="store_true",
+                        help="embed a coordinator in this process")
+    parser.add_argument("--http-host", default="0.0.0.0")
+    parser.add_argument("--http-port", type=int, default=8080)
+    parser.add_argument("--router-mode", default="round-robin",
+                        choices=["round-robin", "random", "kv"])
+    parser.add_argument("--kv-overlap-score-weight", type=float, default=1.0)
+    parser.add_argument("--router-temperature", type=float, default=0.0)
+    parser.add_argument("--no-kv-events", action="store_true",
+                        help="KV router predicts cache contents instead of "
+                             "subscribing to worker events")
+    return parser
+
+
+async def amain(args: argparse.Namespace) -> None:
+    drt = await DistributedRuntime.create(
+        coordinator=args.coordinator, standalone=args.standalone)
+    manager = ModelManager()
+    watcher = ModelWatcher(
+        drt, manager,
+        router_mode=RouterMode(args.router_mode),
+        kv_router_config={
+            "overlap_score_weight": args.kv_overlap_score_weight,
+            "temperature": args.router_temperature,
+            "use_kv_events": not args.no_kv_events,
+        })
+    await watcher.start()
+    service = await HttpService(manager, host=args.http_host,
+                                port=args.http_port).start()
+    if args.standalone:
+        print(f"coordinator listening on {drt._embedded.address}", flush=True)
+    print(f"frontend listening on {service.host}:{service.port}", flush=True)
+    try:
+        await drt.runtime.wait_shutdown()
+    except asyncio.CancelledError:
+        pass
+    finally:
+        await service.stop()
+        await watcher.stop()
+        await drt.close()
+
+
+def main() -> None:
+    args = build_parser().parse_args()
+    configure_logging()
+    try:
+        asyncio.run(amain(args))
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

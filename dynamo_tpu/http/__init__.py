@@ -1,0 +1,5 @@
+"""OpenAI-compatible HTTP frontend service."""
+
+from dynamo_tpu.http.service import HttpService
+
+__all__ = ["HttpService"]

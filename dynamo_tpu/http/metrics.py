@@ -1,0 +1,97 @@
+"""Per-request Prometheus metrics for the HTTP frontend.
+
+Parity: reference ``lib/llm/src/http/service/metrics.rs`` (~500 LoC): request
+counters by model/endpoint/status, TTFT and inter-token-latency histograms,
+inflight gauge, request duration.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from prometheus_client import (
+    CollectorRegistry,
+    Counter,
+    Gauge,
+    Histogram,
+    generate_latest,
+)
+
+_TTFT_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+                 5.0, 10.0, 30.0)
+_ITL_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                0.5, 1.0)
+_DUR_BUCKETS = (0.01, 0.05, 0.1, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0,
+                300.0)
+
+
+class FrontendMetrics:
+    def __init__(self, registry: Optional[CollectorRegistry] = None):
+        self.registry = registry or CollectorRegistry()
+        ns = "dynamo_frontend"
+        self.requests_total = Counter(
+            f"{ns}_requests_total", "HTTP requests",
+            ["model", "endpoint", "status"], registry=self.registry)
+        self.inflight = Gauge(
+            f"{ns}_inflight_requests", "Concurrent requests",
+            ["model"], registry=self.registry)
+        self.ttft = Histogram(
+            f"{ns}_time_to_first_token_seconds", "TTFT",
+            ["model"], buckets=_TTFT_BUCKETS, registry=self.registry)
+        self.itl = Histogram(
+            f"{ns}_inter_token_latency_seconds", "ITL",
+            ["model"], buckets=_ITL_BUCKETS, registry=self.registry)
+        self.duration = Histogram(
+            f"{ns}_request_duration_seconds", "Request duration",
+            ["model", "endpoint"], buckets=_DUR_BUCKETS, registry=self.registry)
+        self.input_tokens = Counter(
+            f"{ns}_input_tokens_total", "Prompt tokens",
+            ["model"], registry=self.registry)
+        self.output_tokens = Counter(
+            f"{ns}_output_tokens_total", "Generated tokens",
+            ["model"], registry=self.registry)
+
+    def render(self) -> bytes:
+        return generate_latest(self.registry)
+
+
+class RequestTimer:
+    """Tracks one request's TTFT/ITL/duration and reports on completion."""
+
+    def __init__(self, metrics: FrontendMetrics, model: str, endpoint: str):
+        self.m = metrics
+        self.model = model
+        self.endpoint = endpoint
+        self.start = time.perf_counter()
+        self.last_token: Optional[float] = None
+        self.first_token: Optional[float] = None
+        self._done = False
+        self.m.inflight.labels(model).inc()
+
+    def on_token(self, n: int = 1) -> None:
+        if n <= 0:
+            return  # role-only / finish-only chunks don't define TTFT
+        now = time.perf_counter()
+        if self.first_token is None:
+            self.first_token = now
+            self.m.ttft.labels(self.model).observe(now - self.start)
+        elif self.last_token is not None and n:
+            self.m.itl.labels(self.model).observe((now - self.last_token) / n)
+        self.last_token = now
+        if n:
+            self.m.output_tokens.labels(self.model).inc(n)
+
+    def done(self, status: str, prompt_tokens: int = 0) -> None:
+        if self._done:  # idempotent: unwind paths may overlap
+            return
+        self._done = True
+        self.m.inflight.labels(self.model).dec()
+        self.m.requests_total.labels(self.model, self.endpoint, status).inc()
+        self.m.duration.labels(self.model, self.endpoint).observe(
+            time.perf_counter() - self.start)
+        if prompt_tokens:
+            self.m.input_tokens.labels(self.model).inc(prompt_tokens)
+
+
+__all__ = ["FrontendMetrics", "RequestTimer"]

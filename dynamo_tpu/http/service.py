@@ -1,0 +1,304 @@
+"""OpenAI-compatible HTTP service (aiohttp).
+
+Parity: reference ``lib/llm/src/http/service/`` (axum): ``/v1/chat/completions``,
+``/v1/completions``, ``/v1/models``, ``/health``, ``/live``, ``/metrics``,
+``/clear_kv_blocks``; SSE streaming with client-disconnect detection; stream
+aggregation for non-streaming requests; per-request Prometheus metrics.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from typing import Any, Dict, List, Optional
+
+from aiohttp import web
+from pydantic import ValidationError
+
+from dynamo_tpu.http.metrics import FrontendMetrics, RequestTimer
+from dynamo_tpu.llm.model_manager import ModelManager
+from dynamo_tpu.protocols import sse
+from dynamo_tpu.protocols.common import FinishReason
+from dynamo_tpu.protocols.openai import (
+    ChatChoice,
+    ChatCompletionRequest,
+    ChatCompletionResponse,
+    ChatMessage,
+    CompletionChoice,
+    CompletionRequest,
+    CompletionResponse,
+    ModelInfo,
+    ModelList,
+    Usage,
+    new_request_id,
+    now_unix,
+)
+
+logger = logging.getLogger(__name__)
+
+
+def _error(status: int, message: str, etype: str = "invalid_request_error") -> web.Response:
+    return web.json_response(
+        {"error": {"message": message, "type": etype, "code": status}},
+        status=status)
+
+
+class HttpService:
+    """The frontend HTTP server; routes into a ModelManager's pipelines."""
+
+    def __init__(self, manager: ModelManager, host: str = "0.0.0.0",
+                 port: int = 8080, metrics: Optional[FrontendMetrics] = None):
+        self.manager = manager
+        self.host = host
+        self.port = port
+        self.metrics = metrics or FrontendMetrics()
+        self.app = web.Application(client_max_size=64 * 1024 * 1024)
+        self.app.router.add_post("/v1/chat/completions", self.handle_chat)
+        self.app.router.add_post("/v1/completions", self.handle_completions)
+        self.app.router.add_get("/v1/models", self.handle_models)
+        self.app.router.add_get("/health", self.handle_health)
+        self.app.router.add_get("/live", self.handle_live)
+        self.app.router.add_get("/metrics", self.handle_metrics)
+        self.app.router.add_post("/clear_kv_blocks", self.handle_clear_kv)
+        self._runner: Optional[web.AppRunner] = None
+        self._clear_kv_hook = None  # async () -> dict
+
+    async def start(self) -> "HttpService":
+        self._runner = web.AppRunner(self.app, access_log=None)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        if self.port == 0:
+            self.port = self._runner.addresses[0][1]
+        logger.info("http service on %s:%d", self.host, self.port)
+        return self
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+
+    # -- handlers ----------------------------------------------------------
+
+    async def handle_health(self, request: web.Request) -> web.Response:
+        return web.json_response({
+            "status": "healthy" if self.manager.names() else "no_models",
+            "models": self.manager.names()})
+
+    async def handle_live(self, request: web.Request) -> web.Response:
+        return web.json_response({"live": True})
+
+    async def handle_metrics(self, request: web.Request) -> web.Response:
+        return web.Response(body=self.metrics.render(),
+                            content_type="text/plain", charset="utf-8")
+
+    async def handle_models(self, request: web.Request) -> web.Response:
+        models = ModelList(data=[
+            ModelInfo(id=name, created=now_unix()) for name in self.manager.names()])
+        return web.json_response(models.model_dump())
+
+    async def handle_clear_kv(self, request: web.Request) -> web.Response:
+        if self._clear_kv_hook is None:
+            return web.json_response({"cleared": []})
+        return web.json_response(await self._clear_kv_hook())
+
+    def set_clear_kv_hook(self, hook) -> None:
+        self._clear_kv_hook = hook
+
+    async def handle_chat(self, request: web.Request) -> web.StreamResponse:
+        try:
+            req = ChatCompletionRequest.model_validate(await request.json())
+        except (ValidationError, json.JSONDecodeError, UnicodeDecodeError) as e:
+            return _error(400, f"invalid request: {e}")
+        pipeline = self.manager.get(req.model)
+        if pipeline is None:
+            return _error(404, f"model {req.model!r} not found", "model_not_found")
+        request_id = new_request_id()
+        timer = RequestTimer(self.metrics, req.model, "chat")
+        try:
+            if req.stream:
+                return await self._stream_chat(request, req, pipeline,
+                                               request_id, timer)
+            return await self._aggregate_chat(req, pipeline, request_id, timer)
+        except ValueError as e:
+            timer.done("400")
+            return _error(400, str(e))
+        except ConnectionResetError:
+            timer.done("499")  # client went away mid-write
+            raise
+        except ConnectionError as e:
+            timer.done("503")
+            return _error(503, str(e), "service_unavailable")
+        except asyncio.CancelledError:
+            timer.done("499")
+            raise
+        except Exception as e:
+            logger.exception("chat handler error")
+            timer.done("500")
+            return _error(500, str(e), "internal_error")
+
+    async def _stream_chat(self, http_req: web.Request,
+                           req: ChatCompletionRequest, pipeline,
+                           request_id: str, timer: RequestTimer
+                           ) -> web.StreamResponse:
+        # preprocess before preparing the response so validation errors can
+        # still produce a clean HTTP 400
+        preprocessed, delta = pipeline.prepare_chat(req, request_id)
+        resp = web.StreamResponse(headers={
+            "Content-Type": "text/event-stream",
+            "Cache-Control": "no-cache",
+            "Connection": "keep-alive"})
+        await resp.prepare(http_req)
+        status = "200"
+        include_usage = bool(req.stream_options and req.stream_options.include_usage)
+        try:
+            # requested annotations (formatted_prompt, token_ids, ...) ride as
+            # named SSE events ahead of the deltas (parity: nvext annotations)
+            for name, value in preprocessed.annotations_payload.items():
+                await resp.write(sse.SseEvent(
+                    event=name,
+                    data=json.dumps(value, separators=(",", ":"))).encode())
+            async for chunk in pipeline.run_chat(preprocessed, delta):
+                if chunk.usage is not None and not chunk.choices:
+                    if not include_usage:
+                        continue  # client didn't opt into the usage chunk
+                ntokens = sum(1 for c in chunk.choices if c.delta.content)
+                timer.on_token(ntokens)
+                await resp.write(sse.encode_data(
+                    chunk.model_dump(exclude_none=True)))
+            await resp.write(sse.encode_done())
+        except (ConnectionResetError, asyncio.CancelledError):
+            # client disconnected: stop generating (parity: disconnect.rs)
+            status = "499"
+            raise
+        except Exception as e:
+            logger.exception("stream error for %s", request_id)
+            status = "500"
+            await resp.write(sse.encode_data(
+                {"error": {"message": str(e), "type": "internal_error"}}))
+            await resp.write(sse.encode_done())
+        finally:
+            timer.done(status)
+        await resp.write_eof()
+        return resp
+
+    async def _aggregate_chat(self, req: ChatCompletionRequest, pipeline,
+                              request_id: str, timer: RequestTimer
+                              ) -> web.Response:
+        """Aggregate the chunk stream into one response (parity:
+        ``protocols/openai/chat_completions/aggregator.rs``)."""
+        text_parts: List[str] = []
+        finish_reason: Optional[str] = None
+        usage = Usage()
+        async for chunk in pipeline.generate_chat(req, request_id):
+            for choice in chunk.choices:
+                if choice.delta.content:
+                    text_parts.append(choice.delta.content)
+                    timer.on_token()
+                if choice.finish_reason:
+                    finish_reason = choice.finish_reason
+            if chunk.usage is not None:
+                usage = chunk.usage
+        body = ChatCompletionResponse(
+            id=request_id, created=now_unix(), model=req.model,
+            choices=[ChatChoice(
+                message=ChatMessage(role="assistant", content="".join(text_parts)),
+                finish_reason=finish_reason or "stop")],
+            usage=usage)
+        timer.done("200", usage.prompt_tokens)
+        return web.json_response(body.model_dump(exclude_none=True))
+
+    async def handle_completions(self, request: web.Request) -> web.StreamResponse:
+        try:
+            req = CompletionRequest.model_validate(await request.json())
+        except (ValidationError, json.JSONDecodeError, UnicodeDecodeError) as e:
+            return _error(400, f"invalid request: {e}")
+        pipeline = self.manager.get(req.model)
+        if pipeline is None:
+            return _error(404, f"model {req.model!r} not found", "model_not_found")
+        request_id = new_request_id("cmpl")
+        timer = RequestTimer(self.metrics, req.model, "completions")
+        try:
+            if req.stream:
+                return await self._stream_completion(request, req, pipeline,
+                                                     request_id, timer)
+            text_parts: List[str] = []
+            finish = None
+            usage = Usage()
+            async for out in pipeline.generate_completion(req, request_id):
+                if out.error:
+                    raise RuntimeError(out.error)
+                if out.text:
+                    text_parts.append(out.text)
+                    timer.on_token(len(out.token_ids) or 1)
+                if out.finish_reason is not None:
+                    finish = out.finish_reason.to_openai()
+                    usage = Usage(
+                        prompt_tokens=out.prompt_tokens or 0,
+                        completion_tokens=out.completion_tokens or 0,
+                        total_tokens=(out.prompt_tokens or 0) + (out.completion_tokens or 0))
+            body = CompletionResponse(
+                id=request_id, created=now_unix(), model=req.model,
+                choices=[CompletionChoice(text="".join(text_parts),
+                                          finish_reason=finish or "stop")],
+                usage=usage)
+            timer.done("200", usage.prompt_tokens)
+            return web.json_response(body.model_dump(exclude_none=True))
+        except ValueError as e:
+            timer.done("400")
+            return _error(400, str(e))
+        except ConnectionResetError:
+            timer.done("499")
+            raise
+        except ConnectionError as e:
+            timer.done("503")
+            return _error(503, str(e), "service_unavailable")
+        except asyncio.CancelledError:
+            timer.done("499")
+            raise
+        except Exception as e:
+            logger.exception("completions handler error")
+            timer.done("500")
+            return _error(500, str(e), "internal_error")
+
+    async def _stream_completion(self, http_req: web.Request,
+                                 req: CompletionRequest, pipeline,
+                                 request_id: str, timer: RequestTimer
+                                 ) -> web.StreamResponse:
+        resp = web.StreamResponse(headers={
+            "Content-Type": "text/event-stream",
+            "Cache-Control": "no-cache"})
+        await resp.prepare(http_req)
+        status = "200"
+        created = now_unix()
+        try:
+            async for out in pipeline.generate_completion(req, request_id):
+                if out.error:
+                    raise RuntimeError(out.error)
+                if out.text or out.finish_reason is not None:
+                    timer.on_token(len(out.token_ids) or (1 if out.text else 0))
+                    chunk = CompletionResponse(
+                        id=request_id, created=created, model=req.model,
+                        choices=[CompletionChoice(
+                            text=out.text or "",
+                            finish_reason=(out.finish_reason.to_openai()
+                                           if out.finish_reason else None))])
+                    await resp.write(sse.encode_data(
+                        chunk.model_dump(exclude_none=True)))
+            await resp.write(sse.encode_done())
+        except (ConnectionResetError, asyncio.CancelledError):
+            status = "499"
+            raise
+        except Exception as e:
+            logger.exception("completion stream error for %s", request_id)
+            status = "500"
+            await resp.write(sse.encode_data(
+                {"error": {"message": str(e), "type": "internal_error"}}))
+            await resp.write(sse.encode_done())
+        finally:
+            timer.done(status)
+        await resp.write_eof()
+        return resp
+
+
+__all__ = ["HttpService"]

@@ -1,0 +1,155 @@
+"""Service pipelines: Frontend -> Preprocessor -> [Migration -> Router] -> Backend.
+
+Parity: reference ``entrypoint/input/common.rs:126-155`` (``build_pipeline``)
+and ``discovery/watcher.rs:163-310`` (client pipeline built per discovered
+model), plus the ``Migration`` retry operator (``lib/llm/src/migration.rs``):
+on a mid-stream drop the request is rebuilt with the tokens generated so far
+appended and re-issued to another worker, up to ``migration_limit`` times.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, AsyncIterator, Dict, Optional
+
+from dynamo_tpu.backend import Backend
+from dynamo_tpu.engine.base import EngineBase
+from dynamo_tpu.model_card import ModelDeploymentCard
+from dynamo_tpu.preprocessor import OpenAIPreprocessor
+from dynamo_tpu.preprocessor.preprocessor import DeltaGenerator
+from dynamo_tpu.protocols.common import (
+    BackendOutput,
+    FinishReason,
+    LLMEngineOutput,
+    PreprocessedRequest,
+)
+from dynamo_tpu.protocols.openai import (
+    ChatCompletionRequest,
+    ChatCompletionChunk,
+    CompletionRequest,
+)
+from dynamo_tpu.runtime.push_router import PushRouter
+from dynamo_tpu.runtime.rpc import StreamEndedError
+
+logger = logging.getLogger(__name__)
+
+
+class ServicePipeline:
+    """Base: owns preprocessor + backend; subclasses provide the engine hop."""
+
+    def __init__(self, card: ModelDeploymentCard):
+        self.card = card
+        self.preprocessor = OpenAIPreprocessor(card)
+        self.backend = Backend(card, tokenizer=self.preprocessor.tokenizer)
+
+    # subclasses implement: stream LLMEngineOutput for a preprocessed request
+    def engine_stream(self, request: PreprocessedRequest
+                      ) -> AsyncIterator[LLMEngineOutput]:
+        raise NotImplementedError
+
+    def prepare_chat(self, req: ChatCompletionRequest,
+                     request_id: Optional[str] = None):
+        """Preprocess only; lets the HTTP layer inspect annotations before
+        streaming.  Returns (PreprocessedRequest, DeltaGenerator)."""
+        preprocessed = self.preprocessor.preprocess_chat(req, request_id)
+        delta = DeltaGenerator(
+            model=req.model, request_id=request_id,
+            include_usage=bool(req.stream_options and req.stream_options.include_usage))
+        return preprocessed, delta
+
+    async def run_chat(self, preprocessed: PreprocessedRequest,
+                       delta: DeltaGenerator
+                       ) -> AsyncIterator[ChatCompletionChunk]:
+        async for out in self.backend.transform(
+                preprocessed, self.engine_stream(preprocessed)):
+            for chunk in delta.chunk_from(out):
+                yield chunk
+        # always emit the final usage chunk; the streaming HTTP layer drops it
+        # unless the client asked via stream_options.include_usage
+        yield delta.usage_chunk()
+
+    async def generate_chat(self, req: ChatCompletionRequest,
+                            request_id: Optional[str] = None
+                            ) -> AsyncIterator[ChatCompletionChunk]:
+        """Full chat pipeline: returns a stream of OpenAI chunk objects."""
+        preprocessed, delta = self.prepare_chat(req, request_id)
+        async for chunk in self.run_chat(preprocessed, delta):
+            yield chunk
+
+    async def generate_completion(self, req: CompletionRequest,
+                                  request_id: Optional[str] = None
+                                  ) -> AsyncIterator[BackendOutput]:
+        """Completions pipeline: streams BackendOutput (text deltas)."""
+        preprocessed = self.preprocessor.preprocess_completion(req, request_id)
+        async for out in self.backend.transform(
+                preprocessed, self.engine_stream(preprocessed)):
+            yield out
+
+
+class LocalEnginePipeline(ServicePipeline):
+    """Pipeline with an in-process engine (reference: EngineConfig::StaticCore)."""
+
+    def __init__(self, card: ModelDeploymentCard, engine: EngineBase):
+        super().__init__(card)
+        self.engine = engine
+
+    async def engine_stream(self, request: PreprocessedRequest
+                            ) -> AsyncIterator[LLMEngineOutput]:
+        async for out in self.engine.generate(request):
+            yield out
+
+
+class RemotePipeline(ServicePipeline):
+    """Pipeline routing to remote workers through a PushRouter, with the
+    migration (retry-on-stream-drop) operator built in."""
+
+    def __init__(self, card: ModelDeploymentCard, router: PushRouter,
+                 migration_limit: Optional[int] = None):
+        super().__init__(card)
+        self.router = router
+        self.migration_limit = (migration_limit if migration_limit is not None
+                                else card.migration_limit)
+
+    async def engine_stream(self, request: PreprocessedRequest
+                            ) -> AsyncIterator[LLMEngineOutput]:
+        generated: list = []  # tokens already yielded downstream
+        attempt = 0
+        req = request
+        while True:
+            try:
+                async for payload in self.router.generate_stream(req.to_dict()):
+                    out = LLMEngineOutput.from_dict(payload)
+                    generated.extend(out.token_ids)
+                    yield out
+                    if out.finish_reason is not None:
+                        return
+                return  # clean final without an explicit finish frame
+            except (StreamEndedError, ConnectionError) as e:
+                attempt += 1
+                if attempt > self.migration_limit:
+                    logger.error("request %s exhausted %d migrations: %s",
+                                 request.request_id, self.migration_limit, e)
+                    yield LLMEngineOutput(
+                        error="stream ended before generation completed "
+                              f"(after {attempt - 1} migrations)",
+                        finish_reason=FinishReason.ERROR)
+                    return
+                # Migration: rebuild the request with tokens generated so far
+                # appended so the next worker continues where the dead one
+                # stopped (reference migration.rs:38-131).
+                req = self._rebuild(request, generated)
+                logger.warning("migrating request %s (attempt %d/%d, %d tokens done)",
+                               request.request_id, attempt, self.migration_limit,
+                               len(generated))
+
+    @staticmethod
+    def _rebuild(original: PreprocessedRequest, generated: list) -> PreprocessedRequest:
+        req = PreprocessedRequest.from_dict(original.to_dict())
+        req.token_ids = list(original.token_ids) + list(generated)
+        sc = req.stop_conditions
+        if sc.max_tokens is not None:
+            sc.max_tokens = max(1, sc.max_tokens - len(generated))
+        return req
+
+
+__all__ = ["ServicePipeline", "LocalEnginePipeline", "RemotePipeline"]

@@ -1,0 +1,62 @@
+"""Worker-side model registration and engine serving.
+
+Parity: reference ``register_llm`` (bindings ``rust/lib.rs:133-178``) +
+``LocalModel.attach`` (``local_model.rs:220+``): build the MDC, publish the
+ModelEntry into the coordinator KV under the worker's lease, and serve the
+engine's ``generate`` endpoint.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, AsyncIterator, Callable, Optional
+
+from dynamo_tpu.engine.base import EngineBase
+from dynamo_tpu.model_card import ModelDeploymentCard, ModelEntry
+from dynamo_tpu.protocols.common import LLMEngineOutput, PreprocessedRequest
+from dynamo_tpu.runtime.component import Endpoint, ServedEndpoint
+from dynamo_tpu.runtime.runtime import DistributedRuntime
+
+logger = logging.getLogger(__name__)
+
+
+def engine_handler(engine: EngineBase) -> Callable:
+    """Bridge an EngineBase into an RPC endpoint handler (dict payloads)."""
+
+    async def handler(payload: Any, ctx) -> AsyncIterator[Any]:
+        request = PreprocessedRequest.from_dict(payload)
+        async for out in engine.generate(request, ctx):
+            yield out.to_dict()
+
+    return handler
+
+
+async def serve_engine(endpoint: Endpoint, engine: EngineBase,
+                       stats_provider: Optional[Callable[[], Any]] = None
+                       ) -> ServedEndpoint:
+    """Serve an engine's generate loop on a runtime endpoint."""
+    await engine.start()
+    return await endpoint.serve(engine_handler(engine),
+                                stats_provider=stats_provider)
+
+
+async def register_llm(drt: DistributedRuntime, endpoint: Endpoint,
+                       card: ModelDeploymentCard,
+                       model_type: str = "chat") -> ModelEntry:
+    """Publish the model registration so frontends can discover it.
+
+    The entry is written under the worker's primary lease: if the worker dies,
+    the registration vanishes with the lease and frontends drop the model.
+    """
+    entry = ModelEntry(
+        name=card.name, namespace=endpoint.namespace,
+        component=endpoint.component, endpoint=endpoint.name,
+        model_type=model_type, card=card)
+    lease = await drt.primary_lease()
+    await drt.coord.put(entry.key(lease.lease_id), entry.to_json(),
+                        lease_id=lease.lease_id)
+    logger.info("registered model %s at %s", card.name, endpoint.path)
+    return entry
+
+
+__all__ = ["register_llm", "serve_engine", "engine_handler"]

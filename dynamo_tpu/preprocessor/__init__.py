@@ -1,0 +1,13 @@
+"""OpenAI-request preprocessor: templating + tokenization -> PreprocessedRequest.
+
+Parity: reference ``lib/llm/src/preprocessor.rs:92-424``
+(``OpenAIPreprocessor::{new, preprocess_request}``) and
+``preprocessor/prompt/template/*`` (minijinja chat templating from HF
+``chat_template``).
+"""
+
+from dynamo_tpu.preprocessor.preprocessor import OpenAIPreprocessor
+from dynamo_tpu.preprocessor.template import PromptFormatter
+from dynamo_tpu.preprocessor.tokenizer import DecodeStream, HfTokenizer
+
+__all__ = ["OpenAIPreprocessor", "PromptFormatter", "HfTokenizer", "DecodeStream"]

@@ -1,0 +1,170 @@
+"""OpenAIPreprocessor: OpenAI request -> PreprocessedRequest (fwd) and
+LLMEngineOutput/BackendOutput stream -> OpenAI deltas (bwd).
+
+Parity: reference ``lib/llm/src/preprocessor.rs:92-424`` (forward:
+template + tokenize + sampling/stop extraction + annotations) and the
+``DeltaGenerator`` SSE backward pass (``preprocessor.rs:320-424``).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, AsyncIterator, Dict, List, Optional, Tuple, Union
+
+from dynamo_tpu.model_card import ModelDeploymentCard
+from dynamo_tpu.preprocessor.template import PromptFormatter
+from dynamo_tpu.preprocessor.tokenizer import HfTokenizer
+from dynamo_tpu.protocols.common import (
+    BackendOutput,
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.protocols.openai import (
+    ChatChunkChoice,
+    ChatCompletionChunk,
+    ChatCompletionRequest,
+    CompletionRequest,
+    DeltaMessage,
+    Usage,
+    new_request_id,
+    now_unix,
+)
+
+logger = logging.getLogger(__name__)
+
+# annotation keys (parity: reference nvext annotations "formatted_prompt",
+# "token_ids", "query_instance_id")
+ANNOTATION_FORMATTED_PROMPT = "formatted_prompt"
+ANNOTATION_TOKEN_IDS = "token_ids"
+ANNOTATION_QUERY_INSTANCE_ID = "query_instance_id"
+
+
+class OpenAIPreprocessor:
+    """Stateless per-model request preprocessor."""
+
+    def __init__(self, card: ModelDeploymentCard, tokenizer: Optional[HfTokenizer] = None):
+        self.card = card
+        self.tokenizer = tokenizer if tokenizer is not None else card.load_tokenizer()
+        self.formatter = PromptFormatter(card.chat_template)
+
+    # -- forward pass ------------------------------------------------------
+
+    def preprocess_chat(self, req: ChatCompletionRequest,
+                        request_id: Optional[str] = None) -> PreprocessedRequest:
+        prompt = self.formatter.render(
+            [m.model_dump(exclude_none=True) for m in req.messages],
+            add_generation_prompt=True,
+            tools=req.tools)
+        token_ids = self.tokenizer.encode(prompt)
+        out = self._build(req, token_ids, request_id)
+        annotations = (req.nvext.annotations if req.nvext else None) or []
+        out.annotations = list(annotations)
+        if ANNOTATION_FORMATTED_PROMPT in annotations:
+            out.annotations_payload[ANNOTATION_FORMATTED_PROMPT] = prompt
+        if ANNOTATION_TOKEN_IDS in annotations:
+            out.annotations_payload[ANNOTATION_TOKEN_IDS] = list(token_ids)
+        return out
+
+    def preprocess_completion(self, req: CompletionRequest,
+                              request_id: Optional[str] = None) -> PreprocessedRequest:
+        prompt = req.prompt
+        if isinstance(prompt, str):
+            token_ids = self.tokenizer.encode(prompt)
+        elif prompt and isinstance(prompt[0], int):
+            token_ids = list(prompt)  # pre-tokenized
+        else:
+            raise ValueError("batch prompts must be fanned out by the caller")
+        out = self._build(req, token_ids, request_id)
+        out.annotations = list((req.nvext.annotations if req.nvext else None) or [])
+        return out
+
+    def _build(self, req: Union[ChatCompletionRequest, CompletionRequest],
+               token_ids: List[int], request_id: Optional[str]) -> PreprocessedRequest:
+        if len(token_ids) >= self.card.context_length:
+            raise ValueError(
+                f"prompt is {len(token_ids)} tokens but the model context "
+                f"length is {self.card.context_length}")
+        max_tokens = (req.effective_max_tokens()
+                      if isinstance(req, ChatCompletionRequest) else req.max_tokens)
+        budget = self.card.context_length - len(token_ids)
+        max_tokens = min(max_tokens, budget) if max_tokens else budget
+        ignore_eos = bool(req.nvext.ignore_eos) if (
+            req.nvext and req.nvext.ignore_eos is not None) else False
+        stop_conditions = StopConditions(
+            max_tokens=max_tokens,
+            stop=req.stop_list(),
+            min_tokens=req.min_tokens,
+            ignore_eos=ignore_eos,
+        )
+        sampling = SamplingOptions(
+            temperature=req.temperature,
+            top_p=req.top_p,
+            top_k=req.top_k,
+            frequency_penalty=req.frequency_penalty,
+            presence_penalty=req.presence_penalty,
+            repetition_penalty=req.repetition_penalty,
+            seed=req.seed,
+            n=req.n,
+        )
+        return PreprocessedRequest(
+            token_ids=token_ids,
+            request_id=request_id or new_request_id("req"),
+            model=req.model,
+            stop_conditions=stop_conditions,
+            sampling_options=sampling,
+            eos_token_ids=list(self.card.eos_token_ids),
+            mdc_sum=self.card.checksum(),
+        )
+
+
+class DeltaGenerator:
+    """Backward pass: BackendOutput stream -> OpenAI chat-completion chunks.
+
+    Parity: reference ``DeltaGenerator`` (``preprocessor.rs:320-424``).
+    """
+
+    def __init__(self, model: str, request_id: Optional[str] = None,
+                 include_usage: bool = False):
+        self.id = request_id or new_request_id()
+        self.model = model
+        self.created = now_unix()
+        self.include_usage = include_usage
+        self._first = True
+        self.prompt_tokens = 0
+        self.completion_tokens = 0
+
+    def chunk_from(self, out: BackendOutput) -> List[ChatCompletionChunk]:
+        chunks: List[ChatCompletionChunk] = []
+        self.completion_tokens += len(out.token_ids)
+        if out.prompt_tokens is not None:
+            self.prompt_tokens = out.prompt_tokens
+        if out.completion_tokens is not None:
+            self.completion_tokens = out.completion_tokens
+        role = "assistant" if self._first else None
+        self._first = False
+        if out.text or role is not None:
+            chunks.append(ChatCompletionChunk(
+                id=self.id, created=self.created, model=self.model,
+                choices=[ChatChunkChoice(
+                    delta=DeltaMessage(role=role, content=out.text or ""))]))
+        if out.finish_reason is not None:
+            chunks.append(ChatCompletionChunk(
+                id=self.id, created=self.created, model=self.model,
+                choices=[ChatChunkChoice(
+                    delta=DeltaMessage(),
+                    finish_reason=out.finish_reason.to_openai())]))
+        return chunks
+
+    def usage_chunk(self) -> ChatCompletionChunk:
+        return ChatCompletionChunk(
+            id=self.id, created=self.created, model=self.model, choices=[],
+            usage=Usage(
+                prompt_tokens=self.prompt_tokens,
+                completion_tokens=self.completion_tokens,
+                total_tokens=self.prompt_tokens + self.completion_tokens))
+
+
+__all__ = ["OpenAIPreprocessor", "DeltaGenerator",
+           "ANNOTATION_FORMATTED_PROMPT", "ANNOTATION_TOKEN_IDS",
+           "ANNOTATION_QUERY_INSTANCE_ID"]

@@ -1,0 +1,91 @@
+"""Tokenizer wrapper: HF `tokenizers` fast tokenizer + incremental decoding.
+
+Parity: reference ``lib/llm/src/tokenizers.rs`` (encode/decode wrappers,
+lifetime-safe ``DecodeStream``).  The incremental decoder uses the
+prefix-window technique (decode a sliding window, emit only once the new
+suffix no longer ends in an incomplete UTF-8/byte-fallback sequence), which is
+the standard approach for streaming detokenization with byte-level BPE.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+from tokenizers import Tokenizer
+
+# replacement char appears while a multi-byte sequence is still incomplete
+_REPLACEMENT = "�"
+
+
+class HfTokenizer:
+    """Thin wrapper over a `tokenizers.Tokenizer` (thread-safe encode/decode)."""
+
+    def __init__(self, tokenizer: Tokenizer):
+        self._tk = tokenizer
+        self._lock = threading.Lock()
+
+    @classmethod
+    def from_file(cls, path: str) -> "HfTokenizer":
+        return cls(Tokenizer.from_file(path))
+
+    @classmethod
+    def from_json(cls, json_str: str) -> "HfTokenizer":
+        return cls(Tokenizer.from_str(json_str))
+
+    def encode(self, text: str, add_special_tokens: bool = False) -> List[int]:
+        with self._lock:
+            return self._tk.encode(text, add_special_tokens=add_special_tokens).ids
+
+    def decode(self, ids: List[int], skip_special_tokens: bool = True) -> str:
+        with self._lock:
+            return self._tk.decode(ids, skip_special_tokens=skip_special_tokens)
+
+    def token_to_id(self, token: str) -> Optional[int]:
+        with self._lock:
+            return self._tk.token_to_id(token)
+
+    @property
+    def vocab_size(self) -> int:
+        return self._tk.get_vocab_size()
+
+    def decode_stream(self, skip_special_tokens: bool = True) -> "DecodeStream":
+        return DecodeStream(self, skip_special_tokens)
+
+
+class DecodeStream:
+    """Incremental detokenizer: feed token ids one at a time, get text deltas.
+
+    Keeps ``prefix_offset``/``read_offset`` into the accumulated id list; each
+    step decodes ``ids[prefix:]`` and emits the part beyond the previously read
+    text, holding back output while it ends in an incomplete byte sequence.
+    """
+
+    def __init__(self, tokenizer: HfTokenizer, skip_special_tokens: bool = True):
+        self._tk = tokenizer
+        self._skip_special = skip_special_tokens
+        self._ids: List[int] = []
+        self._prefix_offset = 0
+        self._read_offset = 0
+
+    def step(self, token_id: int) -> str:
+        self._ids.append(token_id)
+        prefix_text = self._tk.decode(
+            self._ids[self._prefix_offset:self._read_offset],
+            skip_special_tokens=self._skip_special)
+        new_text = self._tk.decode(
+            self._ids[self._prefix_offset:],
+            skip_special_tokens=self._skip_special)
+        if new_text.endswith(_REPLACEMENT):
+            # mid-multibyte: hold output until the sequence completes
+            return ""
+        delta = new_text[len(prefix_text):]
+        self._prefix_offset = self._read_offset
+        self._read_offset = len(self._ids)
+        return delta
+
+    def extend(self, token_ids: List[int]) -> str:
+        return "".join(self.step(t) for t in token_ids)
+
+
+__all__ = ["HfTokenizer", "DecodeStream"]

@@ -121,6 +121,9 @@ class PreprocessedRequest:
     estimated_prefix_hit_num_blocks: Optional[int] = None
     kv_transfer_params: Optional[Dict[str, Any]] = None
     prefill_only: bool = False
+    # local-only (not serialized): annotation responses filled by the
+    # preprocessor/router, emitted as SSE events by the HTTP layer
+    annotations_payload: Dict[str, Any] = field(default_factory=dict)
 
     def to_dict(self) -> Dict[str, Any]:
         return {

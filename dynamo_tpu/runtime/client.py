@@ -14,6 +14,7 @@ from typing import Any, Dict, List, Optional
 
 from dynamo_tpu.runtime.component import Endpoint, Instance
 from dynamo_tpu.runtime.rpc import ResponseStream
+from dynamo_tpu.utils.aio import reap_task
 
 logger = logging.getLogger(__name__)
 
@@ -45,21 +46,21 @@ class Client:
         return self
 
     async def _watch_loop(self) -> None:
-        try:
-            async for ev in self._watch:
-                if ev.type == "put" and ev.value is not None:
-                    inst = Instance.from_json(ev.value)
-                    self._instances[inst.instance_id] = inst
-                    self._down.discard(inst.instance_id)
-                elif ev.type == "delete":
-                    iid = self._id_from_key(ev.key)
-                    if iid is not None:
-                        self._instances.pop(iid, None)
-                        self._down.discard(iid)
-                self._changed.set()
-                self._changed = asyncio.Event()
-        except asyncio.CancelledError:
-            pass
+        # NOTE: never catch CancelledError here — swallowing it breaks
+        # cancellation of any task awaiting this one (asyncio delegates
+        # A.cancel() to B.cancel() when A awaits B).
+        async for ev in self._watch:
+            if ev.type == "put" and ev.value is not None:
+                inst = Instance.from_json(ev.value)
+                self._instances[inst.instance_id] = inst
+                self._down.discard(inst.instance_id)
+            elif ev.type == "delete":
+                iid = self._id_from_key(ev.key)
+                if iid is not None:
+                    self._instances.pop(iid, None)
+                    self._down.discard(iid)
+            self._changed.set()
+            self._changed = asyncio.Event()
 
     @staticmethod
     def _id_from_key(key: str) -> Optional[int]:
@@ -121,12 +122,7 @@ class Client:
         return await conn.request(f"{self.endpoint.path}", payload, headers)
 
     async def close(self) -> None:
-        if self._watch_task:
-            self._watch_task.cancel()
-            try:
-                await self._watch_task
-            except asyncio.CancelledError:
-                pass
+        await reap_task(self._watch_task)
         if self._watch is not None:
             try:
                 await self._watch.cancel()

@@ -35,6 +35,7 @@ from dataclasses import dataclass, field
 from typing import Any, AsyncIterator, Callable, Dict, List, Optional, Tuple
 
 from dynamo_tpu.runtime.codec import read_frame, send_frame, write_frame
+from dynamo_tpu.utils.aio import reap_task
 
 logger = logging.getLogger(__name__)
 
@@ -132,12 +133,7 @@ class Coordinator:
         return self
 
     async def stop(self) -> None:
-        if self._lease_task:
-            self._lease_task.cancel()
-            try:
-                await self._lease_task
-            except asyncio.CancelledError:
-                pass
+        await reap_task(self._lease_task)
         if self._server:
             self._server.close()
             await self._server.wait_closed()
@@ -431,18 +427,16 @@ class Lease:
         self._task = asyncio.create_task(self._keepalive_loop())
 
     async def _keepalive_loop(self) -> None:
+        # (no CancelledError catch: see utils/aio.reap_task)
         interval = max(self.ttl / 3.0, 0.1)
-        try:
-            while True:
-                await asyncio.sleep(interval)
-                try:
-                    await self.client.keepalive(self.lease_id)
-                except Exception:
-                    logger.warning("lease %d keep-alive failed", self.lease_id)
-                    self.lost.set()
-                    return
-        except asyncio.CancelledError:
-            pass
+        while True:
+            await asyncio.sleep(interval)
+            try:
+                await self.client.keepalive(self.lease_id)
+            except Exception:
+                logger.warning("lease %d keep-alive failed", self.lease_id)
+                self.lost.set()
+                return
 
     async def revoke(self) -> None:
         if self._task:
@@ -480,12 +474,7 @@ class CoordClient:
         return self
 
     async def close(self) -> None:
-        if self._reader_task:
-            self._reader_task.cancel()
-            try:
-                await self._reader_task
-            except asyncio.CancelledError:
-                pass
+        await reap_task(self._reader_task)
         if self._writer:
             try:
                 self._writer.close()
@@ -529,8 +518,8 @@ class CoordClient:
                         buf = self._orphan_msgs.setdefault(frame["sub_id"], [])
                         if len(buf) < 10_000:
                             buf.append(item)
-        except (ConnectionError, asyncio.CancelledError):
-            pass
+        except ConnectionError:
+            pass  # CancelledError must propagate (see utils/aio.reap_task)
         finally:
             self.closed.set()
             for fut in self._pending.values():

@@ -28,6 +28,7 @@ from dataclasses import dataclass, field
 from typing import Any, AsyncIterator, Awaitable, Callable, Dict, Optional
 
 from dynamo_tpu.runtime.codec import read_frame, send_frame
+from dynamo_tpu.utils.aio import reap_task
 
 logger = logging.getLogger(__name__)
 
@@ -107,10 +108,14 @@ class RpcServer:
     async def stop(self) -> None:
         if self._server:
             self._server.close()
-        for t in list(self._active_tasks):
+        # never cancel/await the task calling stop() (a handler may trigger
+        # shutdown of its own server) — that would self-cancel forever
+        cur = asyncio.current_task()
+        tasks = [t for t in self._active_tasks if t is not cur]
+        for t in tasks:
             t.cancel()
-        if self._active_tasks:
-            await asyncio.gather(*self._active_tasks, return_exceptions=True)
+        if tasks:
+            await asyncio.gather(*tasks, return_exceptions=True)
         # close live connections BEFORE wait_closed: since py3.12 wait_closed
         # blocks until every connection handler returns
         for w in list(self._conn_writers):
@@ -169,8 +174,8 @@ class RpcServer:
                         task.cancel()
                 elif op == "ping":
                     await send({"op": "pong", "rid": frame.get("rid")})
-        except (ConnectionError, asyncio.CancelledError):
-            pass
+        except ConnectionError:
+            pass  # CancelledError must propagate (see utils/aio.reap_task)
         finally:
             self._conn_writers.discard(writer)
             for ctx in streams.values():
@@ -297,12 +302,7 @@ class RpcConnection:
 
     async def close(self) -> None:
         self.alive = False
-        if self._reader_task:
-            self._reader_task.cancel()
-            try:
-                await self._reader_task
-            except asyncio.CancelledError:
-                pass
+        await reap_task(self._reader_task)
         if self._writer:
             try:
                 self._writer.close()
@@ -327,8 +327,8 @@ class RpcConnection:
                     stream.queue.put_nowait(("final", None))
                 elif op == "err":
                     stream.queue.put_nowait(("err", frame.get("error")))
-        except (ConnectionError, asyncio.CancelledError):
-            pass
+        except ConnectionError:
+            pass  # CancelledError must propagate (see utils/aio.reap_task)
         finally:
             self.alive = False
             for stream in list(self._streams.values()):

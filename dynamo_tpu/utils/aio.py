@@ -1,0 +1,30 @@
+"""Small asyncio helpers."""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Optional
+
+
+async def reap_task(task: Optional[asyncio.Task]) -> None:
+    """Cancel a child task and await it, without eating the caller's own
+    cancellation.
+
+    ``try: await task except CancelledError: pass`` is subtly wrong: if the
+    *caller* is cancelled while awaiting the child, the same exception type is
+    raised and gets swallowed — the caller keeps running and (since asyncio
+    delivers cancellation once) can never be cancelled again.  Re-raise when
+    our own task has a pending cancellation.
+    """
+    if task is None:
+        return
+    task.cancel()
+    try:
+        await task
+    except asyncio.CancelledError:
+        cur = asyncio.current_task()
+        if cur is not None and cur.cancelling():
+            raise
+
+
+__all__ = ["reap_task"]

@@ -1,0 +1,185 @@
+"""Tests for templating, tokenization, preprocessing and detokenization."""
+
+import pytest
+
+from dynamo_tpu.backend import Backend, StopJail
+from dynamo_tpu.preprocessor import HfTokenizer, OpenAIPreprocessor
+from dynamo_tpu.preprocessor.template import PromptFormatter
+from dynamo_tpu.protocols.common import FinishReason, LLMEngineOutput
+from dynamo_tpu.protocols.openai import ChatCompletionRequest, CompletionRequest
+from dynamo_tpu.utils.testing import make_test_card, make_test_tokenizer
+
+
+@pytest.fixture
+def card():
+    return make_test_card()
+
+
+@pytest.fixture
+def tokenizer(card):
+    return HfTokenizer.from_json(card.tokenizer_json)
+
+
+def test_tokenizer_round_trip(tokenizer):
+    for text in ["hello world", "múltí-byte ünïcode ✓", "  spaces  and\nnewlines"]:
+        ids = tokenizer.encode(text)
+        assert tokenizer.decode(ids) == text
+
+
+def test_decode_stream_incremental(tokenizer):
+    text = "héllo wörld ✓ done"
+    ids = tokenizer.encode(text)
+    ds = tokenizer.decode_stream()
+    out = "".join(ds.step(t) for t in ids)
+    assert out == text
+
+
+def test_prompt_formatter_renders_chat_template(card):
+    fmt = PromptFormatter(card.chat_template)
+    text = fmt.render([
+        {"role": "system", "content": "be nice"},
+        {"role": "user", "content": "hi"},
+    ])
+    assert text == "<|system|>be nice<|end|><|user|>hi<|end|><|assistant|>"
+
+
+def test_prompt_formatter_default_template():
+    fmt = PromptFormatter(None)
+    text = fmt.render([{"role": "user", "content": "hi"}])
+    assert "user: hi" in text
+    assert text.endswith("assistant:")
+
+
+def test_preprocess_chat(card):
+    pre = OpenAIPreprocessor(card)
+    req = ChatCompletionRequest(
+        model="m", messages=[{"role": "user", "content": "hello"}],
+        max_tokens=10, temperature=0.5, stop=["END"])
+    out = pre.preprocess_chat(req)
+    assert out.token_ids == pre.tokenizer.encode(
+        "<|user|>hello<|end|><|assistant|>")
+    assert out.stop_conditions.max_tokens == 10
+    assert out.stop_conditions.stop == ["END"]
+    assert out.sampling_options.temperature == 0.5
+    assert out.eos_token_ids == card.eos_token_ids
+    assert out.mdc_sum == card.checksum()
+
+
+def test_preprocess_completion_pretokenized(card):
+    pre = OpenAIPreprocessor(card)
+    req = CompletionRequest(model="m", prompt=[1, 2, 3], max_tokens=5)
+    out = pre.preprocess_completion(req)
+    assert out.token_ids == [1, 2, 3]
+
+
+def test_preprocess_rejects_overlong_prompt(card):
+    card.context_length = 8
+    pre = OpenAIPreprocessor(card)
+    req = CompletionRequest(model="m", prompt="this is a long prompt", max_tokens=5)
+    with pytest.raises(ValueError, match="context length"):
+        pre.preprocess_completion(req)
+
+
+def test_max_tokens_clamped_to_context(card):
+    card.context_length = 16
+    pre = OpenAIPreprocessor(card)
+    req = CompletionRequest(model="m", prompt="abc", max_tokens=10_000)
+    out = pre.preprocess_completion(req)
+    assert out.stop_conditions.max_tokens == 16 - len(out.token_ids)
+
+
+# -- stop jail -------------------------------------------------------------
+
+
+def test_stop_jail_immediate_match():
+    j = StopJail(["STOP"])
+    assert j.push("hello STOP world") == "hello "
+    assert j.matched == "STOP"
+    assert j.push("more") == ""
+
+
+def test_stop_jail_split_across_deltas():
+    j = StopJail(["STOP"])
+    assert j.push("abc ST") == "abc "  # "ST" jailed
+    assert j.push("O") == ""  # "STO" still jailed
+    assert j.push("P!") == ""
+    assert j.matched == "STOP"
+
+
+def test_stop_jail_false_prefix_released():
+    j = StopJail(["STOP"])
+    assert j.push("ab ST") == "ab "
+    assert j.push("ART") == "START"  # "ST"+"ART" can't complete "STOP"
+    assert j.matched is None
+    assert j.flush() == ""
+
+
+def test_stop_jail_no_stops_passthrough():
+    j = StopJail([])
+    assert j.push("anything") == "anything"
+
+
+# -- backend transform -----------------------------------------------------
+
+
+async def _collect(backend, request, frames):
+    async def engine():
+        for f in frames:
+            yield f
+    return [o async for o in backend.transform(request, engine())]
+
+
+async def test_backend_eos_handling(card):
+    pre = OpenAIPreprocessor(card)
+    backend = Backend(card, tokenizer=pre.tokenizer)
+    req = pre.preprocess_completion(
+        CompletionRequest(model="m", prompt="hi", max_tokens=10))
+    eos = card.eos_token_ids[0]
+    toks = pre.tokenizer.encode("ok")
+    frames = [LLMEngineOutput(token_ids=[t]) for t in toks]
+    frames.append(LLMEngineOutput(token_ids=[eos]))
+    outs = await _collect(backend, req, frames)
+    assert outs[-1].finish_reason == FinishReason.EOS
+    text = "".join(o.text or "" for o in outs)
+    assert text == "ok"
+
+
+async def test_backend_stop_string_truncates(card):
+    pre = OpenAIPreprocessor(card)
+    backend = Backend(card, tokenizer=pre.tokenizer)
+    req = pre.preprocess_completion(
+        CompletionRequest(model="m", prompt="hi", max_tokens=50, stop=["XY"]))
+    toks = pre.tokenizer.encode("hello XY there")
+    frames = [LLMEngineOutput(token_ids=[t]) for t in toks]
+    frames.append(LLMEngineOutput(finish_reason=FinishReason.LENGTH))
+    outs = await _collect(backend, req, frames)
+    text = "".join(o.text or "" for o in outs)
+    assert text == "hello "
+    assert outs[-1].finish_reason == FinishReason.STOP
+
+
+async def test_backend_ignore_eos(card):
+    pre = OpenAIPreprocessor(card)
+    backend = Backend(card, tokenizer=pre.tokenizer)
+    from dynamo_tpu.protocols.openai import Extensions
+    req = pre.preprocess_completion(
+        CompletionRequest(model="m", prompt="hi", max_tokens=10,
+                          nvext=Extensions(ignore_eos=True)))
+    eos = card.eos_token_ids[0]
+    frames = [LLMEngineOutput(token_ids=[eos]),
+              LLMEngineOutput(token_ids=pre.tokenizer.encode("z")),
+              LLMEngineOutput(finish_reason=FinishReason.LENGTH)]
+    outs = await _collect(backend, req, frames)
+    assert outs[-1].finish_reason == FinishReason.LENGTH
+    # eos token decoded as text rather than terminating
+    assert any(o.text for o in outs)
+
+
+async def test_backend_engine_error_propagates(card):
+    pre = OpenAIPreprocessor(card)
+    backend = Backend(card, tokenizer=pre.tokenizer)
+    req = pre.preprocess_completion(
+        CompletionRequest(model="m", prompt="hi", max_tokens=10))
+    outs = await _collect(backend, req, [LLMEngineOutput(error="engine exploded")])
+    assert outs[-1].finish_reason == FinishReason.ERROR
+    assert outs[-1].error == "engine exploded"
