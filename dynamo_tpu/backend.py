@@ -57,12 +57,16 @@ class StopJail:
         if not self.stops:
             return delta
         text = self._held + delta
+        # earliest occurrence in the text wins, not list order
+        best_idx, best_stop = -1, None
         for stop in self.stops:
             idx = text.find(stop)
-            if idx >= 0:
-                self.matched = stop
-                self._held = ""
-                return text[:idx]
+            if idx >= 0 and (best_idx < 0 or idx < best_idx):
+                best_idx, best_stop = idx, stop
+        if best_stop is not None:
+            self.matched = best_stop
+            self._held = ""
+            return text[:best_idx]
         keep = _longest_suffix_prefix(text, self.stops)
         self._held = text[len(text) - keep:] if keep else ""
         return text[:len(text) - keep] if keep else text
@@ -92,45 +96,53 @@ class Backend:
         stop_ids = set(request.stop_conditions.stop_token_ids or [])
         completion = 0
 
-        async for out in engine_stream:
-            if out.error:
-                yield BackendOutput(error=out.error,
-                                    finish_reason=FinishReason.ERROR)
-                return
-            emit_ids: List[int] = []
-            finish: Optional[FinishReason] = out.finish_reason
-            for tok in out.token_ids:
-                completion += 1
-                if not ignore_eos and tok in eos_ids:
-                    finish = FinishReason.EOS
-                    break
-                if tok in stop_ids:
+        try:
+            async for out in engine_stream:
+                if out.error:
+                    yield BackendOutput(error=out.error,
+                                        finish_reason=FinishReason.ERROR)
+                    return
+                emit_ids: List[int] = []
+                finish: Optional[FinishReason] = out.finish_reason
+                for tok in out.token_ids:
+                    completion += 1
+                    if not ignore_eos and tok in eos_ids:
+                        finish = FinishReason.EOS
+                        break
+                    if tok in stop_ids:
+                        finish = FinishReason.STOP
+                        break
+                    emit_ids.append(tok)
+                text = jail.push(decoder.extend(emit_ids)) if emit_ids else ""
+                if jail.matched is not None:
                     finish = FinishReason.STOP
-                    break
-                emit_ids.append(tok)
-            text = jail.push(decoder.extend(emit_ids)) if emit_ids else ""
-            if jail.matched is not None:
-                finish = FinishReason.STOP
-            if finish is not None:
-                if jail.matched is None:
-                    text += jail.flush()
-                yield BackendOutput(
-                    token_ids=emit_ids, text=text or None,
-                    finish_reason=finish,
-                    cum_log_probs=out.cum_log_probs, log_probs=out.log_probs,
-                    prompt_tokens=out.prompt_tokens or len(request.token_ids),
-                    completion_tokens=out.completion_tokens or completion,
-                    cached_tokens=out.cached_tokens)
-                return
-            if emit_ids or text:
-                yield BackendOutput(
-                    token_ids=emit_ids, text=text or None,
-                    cum_log_probs=out.cum_log_probs, log_probs=out.log_probs)
-        # engine ended without a finish reason: surface what we have
-        tail = jail.flush()
-        yield BackendOutput(
-            token_ids=[], text=tail or None, finish_reason=FinishReason.LENGTH,
-            prompt_tokens=len(request.token_ids), completion_tokens=completion)
+                if finish is not None:
+                    if jail.matched is None:
+                        text += jail.flush()
+                    yield BackendOutput(
+                        token_ids=emit_ids, text=text or None,
+                        finish_reason=finish,
+                        cum_log_probs=out.cum_log_probs, log_probs=out.log_probs,
+                        prompt_tokens=out.prompt_tokens or len(request.token_ids),
+                        completion_tokens=out.completion_tokens or completion,
+                        cached_tokens=out.cached_tokens)
+                    return
+                if emit_ids or text:
+                    yield BackendOutput(
+                        token_ids=emit_ids, text=text or None,
+                        cum_log_probs=out.cum_log_probs, log_probs=out.log_probs)
+            # engine ended without a finish reason: surface what we have
+            tail = jail.flush()
+            yield BackendOutput(
+                token_ids=[], text=tail or None, finish_reason=FinishReason.LENGTH,
+                prompt_tokens=len(request.token_ids), completion_tokens=completion)
+        finally:
+            # Deterministically close the engine hop on early exit (stop match,
+            # client disconnect): propagates GeneratorExit down the chain so
+            # remote streams send a cancel frame instead of generating on.
+            aclose = getattr(engine_stream, "aclose", None)
+            if aclose is not None:
+                await aclose()
 
 
 __all__ = ["Backend", "StopJail"]

@@ -151,6 +151,8 @@ class HttpService:
         await resp.prepare(http_req)
         status = "200"
         include_usage = bool(req.stream_options and req.stream_options.include_usage)
+        gen = pipeline.run_chat(preprocessed, delta)
+        emitted_tokens = 0
         try:
             # requested annotations (formatted_prompt, token_ids, ...) ride as
             # named SSE events ahead of the deltas (parity: nvext annotations)
@@ -158,12 +160,14 @@ class HttpService:
                 await resp.write(sse.SseEvent(
                     event=name,
                     data=json.dumps(value, separators=(",", ":"))).encode())
-            async for chunk in pipeline.run_chat(preprocessed, delta):
+            async for chunk in gen:
                 if chunk.usage is not None and not chunk.choices:
                     if not include_usage:
                         continue  # client didn't opt into the usage chunk
-                ntokens = sum(1 for c in chunk.choices if c.delta.content)
-                timer.on_token(ntokens)
+                # token accounting from the delta generator's counter (a chunk
+                # may carry text from several tokens; chunks != tokens)
+                timer.on_token(delta.completion_tokens - emitted_tokens)
+                emitted_tokens = delta.completion_tokens
                 await resp.write(sse.encode_data(
                     chunk.model_dump(exclude_none=True)))
             await resp.write(sse.encode_done())
@@ -178,6 +182,7 @@ class HttpService:
                 {"error": {"message": str(e), "type": "internal_error"}}))
             await resp.write(sse.encode_done())
         finally:
+            await gen.aclose()
             timer.done(status)
         await resp.write_eof()
         return resp
@@ -190,15 +195,22 @@ class HttpService:
         text_parts: List[str] = []
         finish_reason: Optional[str] = None
         usage = Usage()
-        async for chunk in pipeline.generate_chat(req, request_id):
-            for choice in chunk.choices:
-                if choice.delta.content:
-                    text_parts.append(choice.delta.content)
-                    timer.on_token()
-                if choice.finish_reason:
-                    finish_reason = choice.finish_reason
-            if chunk.usage is not None:
-                usage = chunk.usage
+        preprocessed, delta = pipeline.prepare_chat(req, request_id)
+        gen = pipeline.run_chat(preprocessed, delta)
+        emitted_tokens = 0
+        try:
+            async for chunk in gen:
+                for choice in chunk.choices:
+                    if choice.delta.content:
+                        text_parts.append(choice.delta.content)
+                    if choice.finish_reason:
+                        finish_reason = choice.finish_reason
+                if chunk.usage is not None:
+                    usage = chunk.usage
+                timer.on_token(delta.completion_tokens - emitted_tokens)
+                emitted_tokens = delta.completion_tokens
+        finally:
+            await gen.aclose()
         body = ChatCompletionResponse(
             id=request_id, created=now_unix(), model=req.model,
             choices=[ChatChoice(
@@ -225,18 +237,22 @@ class HttpService:
             text_parts: List[str] = []
             finish = None
             usage = Usage()
-            async for out in pipeline.generate_completion(req, request_id):
-                if out.error:
-                    raise RuntimeError(out.error)
-                if out.text:
-                    text_parts.append(out.text)
-                    timer.on_token(len(out.token_ids) or 1)
-                if out.finish_reason is not None:
-                    finish = out.finish_reason.to_openai()
-                    usage = Usage(
-                        prompt_tokens=out.prompt_tokens or 0,
-                        completion_tokens=out.completion_tokens or 0,
-                        total_tokens=(out.prompt_tokens or 0) + (out.completion_tokens or 0))
+            gen = pipeline.generate_completion(req, request_id)
+            try:
+                async for out in gen:
+                    if out.error:
+                        raise RuntimeError(out.error)
+                    if out.text:
+                        text_parts.append(out.text)
+                        timer.on_token(len(out.token_ids) or 1)
+                    if out.finish_reason is not None:
+                        finish = out.finish_reason.to_openai()
+                        usage = Usage(
+                            prompt_tokens=out.prompt_tokens or 0,
+                            completion_tokens=out.completion_tokens or 0,
+                            total_tokens=(out.prompt_tokens or 0) + (out.completion_tokens or 0))
+            finally:
+                await gen.aclose()
             body = CompletionResponse(
                 id=request_id, created=now_unix(), model=req.model,
                 choices=[CompletionChoice(text="".join(text_parts),
@@ -271,8 +287,9 @@ class HttpService:
         await resp.prepare(http_req)
         status = "200"
         created = now_unix()
+        gen = pipeline.generate_completion(req, request_id)
         try:
-            async for out in pipeline.generate_completion(req, request_id):
+            async for out in gen:
                 if out.error:
                     raise RuntimeError(out.error)
                 if out.text or out.finish_reason is not None:
@@ -296,6 +313,7 @@ class HttpService:
                 {"error": {"message": str(e), "type": "internal_error"}}))
             await resp.write(sse.encode_done())
         finally:
+            await gen.aclose()
             timer.done(status)
         await resp.write_eof()
         return resp

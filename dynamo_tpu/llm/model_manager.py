@@ -68,7 +68,12 @@ class ModelWatcher:
     async def start(self) -> "ModelWatcher":
         self._watch = await self.drt.coord.watch_prefix(MODEL_ROOT_PREFIX)
         for key, value in self._watch.snapshot:
-            await self._handle_put(key, value)
+            try:
+                await self._handle_put(key, value)
+            except Exception:
+                # a bad registration must not take the frontend down at boot
+                # (the watch loop below tolerates the same entry arriving live)
+                logger.exception("ignoring bad model registration %s", key)
         self.ready.set()
         self._task = asyncio.create_task(self._loop())
         return self

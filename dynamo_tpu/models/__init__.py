@@ -1,0 +1,11 @@
+"""Model family implementations (pure-functional jax).
+
+Each model exposes: ``init_params(cfg, rng)``, ``forward(params, cfg, ...)``
+over a paged KV cache, and an HF-checkpoint loader. The registry maps HF
+``model_type`` strings to implementations.
+"""
+
+from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.models.llama import forward, init_params, make_pages
+
+__all__ = ["ModelConfig", "forward", "init_params", "make_pages"]

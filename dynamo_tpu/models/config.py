@@ -1,0 +1,95 @@
+"""Model architecture config, constructed from HF ``config.json``.
+
+Capability parity: reference ``lib/llm/src/model_card/model.rs:87-230`` reads
+HF config for context length / arch metadata; here the config additionally
+drives the native jax model (the reference never builds the model itself).
+
+Covers the Llama family tree: llama/llama-3, mistral, qwen2/qwen3 (qwen3 adds
+per-head q/k RMS norm), and the MoE variants (mixtral/qwen3_moe/deepseek-style
+``num_experts``/``top_k`` routing) handled by ``models/moe.py``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    vocab_size: int
+    hidden_size: int
+    intermediate_size: int
+    num_layers: int
+    num_heads: int
+    num_kv_heads: int
+    head_dim: int
+    rope_theta: float = 10000.0
+    rms_norm_eps: float = 1e-5
+    max_position_embeddings: int = 8192
+    tie_word_embeddings: bool = False
+    qk_norm: bool = False          # qwen3-style per-head q/k RMSNorm
+    attention_bias: bool = False   # qwen2-style qkv bias
+    model_type: str = "llama"
+    dtype: str = "bfloat16"
+    # MoE (0 experts => dense MLP)
+    num_experts: int = 0
+    num_experts_per_tok: int = 2
+    moe_intermediate_size: int = 0
+    norm_topk_prob: bool = True
+
+    @property
+    def q_size(self) -> int:
+        return self.num_heads * self.head_dim
+
+    @property
+    def kv_size(self) -> int:
+        return self.num_kv_heads * self.head_dim
+
+    @classmethod
+    def from_hf(cls, hf: Dict[str, Any], dtype: str = "bfloat16") -> "ModelConfig":
+        heads = hf["num_attention_heads"]
+        mt = hf.get("model_type", "llama")
+        num_experts = hf.get("num_local_experts", hf.get("num_experts", 0)) or 0
+        return cls(
+            vocab_size=hf["vocab_size"],
+            hidden_size=hf["hidden_size"],
+            intermediate_size=hf["intermediate_size"],
+            num_layers=hf["num_hidden_layers"],
+            num_heads=heads,
+            num_kv_heads=hf.get("num_key_value_heads", heads),
+            head_dim=hf.get("head_dim") or hf["hidden_size"] // heads,
+            rope_theta=float(hf.get("rope_theta", 10000.0)),
+            rms_norm_eps=float(hf.get("rms_norm_eps", 1e-5)),
+            max_position_embeddings=hf.get("max_position_embeddings", 8192),
+            tie_word_embeddings=bool(hf.get("tie_word_embeddings", False)),
+            qk_norm=mt in ("qwen3", "qwen3_moe"),
+            attention_bias=bool(hf.get("attention_bias", mt == "qwen2")),
+            model_type=mt,
+            dtype=dtype,
+            num_experts=num_experts,
+            num_experts_per_tok=hf.get("num_experts_per_tok", 2),
+            moe_intermediate_size=hf.get("moe_intermediate_size",
+                                         hf.get("intermediate_size", 0)),
+            norm_topk_prob=bool(hf.get("norm_topk_prob", True)),
+        )
+
+    @classmethod
+    def from_pretrained(cls, path: str, dtype: str = "bfloat16") -> "ModelConfig":
+        with open(os.path.join(path, "config.json")) as f:
+            return cls.from_hf(json.load(f), dtype=dtype)
+
+    @classmethod
+    def tiny(cls, **kw) -> "ModelConfig":
+        """A toy config for tests (runs in ms on CPU)."""
+        defaults = dict(vocab_size=256, hidden_size=64, intermediate_size=128,
+                        num_layers=2, num_heads=4, num_kv_heads=2, head_dim=16,
+                        rope_theta=10000.0, max_position_embeddings=512,
+                        dtype="float32")
+        defaults.update(kw)
+        return cls(**defaults)
+
+
+__all__ = ["ModelConfig"]

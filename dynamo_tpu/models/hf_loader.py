@@ -1,0 +1,133 @@
+"""Load HF safetensors checkpoints into the stacked-layer param pytree.
+
+Capability parity: reference ``lib/llm/src/local_model.rs`` resolves an HF repo
+directory for its engines; here the weights are actually consumed natively.
+Torch ``Linear`` stores [out, in]; we transpose to [in, out] and stack all
+layers on a leading axis (the ``lax.scan`` layout of ``models/llama.py``).
+
+Sharded checkpoints (``model.safetensors.index.json``) are supported; tensors
+are loaded one file at a time to bound host RAM. Optionally a sharding pytree
+can be supplied so each stacked array is placed directly with
+``jax.device_put`` (avoids a full host copy of the assembled model).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dynamo_tpu.models.config import ModelConfig
+
+try:
+    from safetensors import safe_open
+except ImportError:  # pragma: no cover
+    safe_open = None
+
+
+def _checkpoint_files(path: str) -> List[str]:
+    index = os.path.join(path, "model.safetensors.index.json")
+    if os.path.exists(index):
+        with open(index) as f:
+            weight_map = json.load(f)["weight_map"]
+        return sorted({os.path.join(path, v) for v in weight_map.values()})
+    single = os.path.join(path, "model.safetensors")
+    if os.path.exists(single):
+        return [single]
+    raise FileNotFoundError(f"no safetensors checkpoint under {path}")
+
+
+# HF tensor name -> (pytree path, transpose?). "{i}" is the layer index.
+def _name_map(cfg: ModelConfig) -> Dict[str, Any]:
+    m = {
+        "model.embed_tokens.weight": (("embed",), False),
+        "model.norm.weight": (("final_norm",), False),
+        "model.layers.{i}.input_layernorm.weight": (("layers", "attn_norm"), False),
+        "model.layers.{i}.self_attn.q_proj.weight": (("layers", "wq"), True),
+        "model.layers.{i}.self_attn.k_proj.weight": (("layers", "wk"), True),
+        "model.layers.{i}.self_attn.v_proj.weight": (("layers", "wv"), True),
+        "model.layers.{i}.self_attn.o_proj.weight": (("layers", "wo"), True),
+        "model.layers.{i}.post_attention_layernorm.weight": (("layers", "mlp_norm"), False),
+        "model.layers.{i}.mlp.gate_proj.weight": (("layers", "w_gate"), True),
+        "model.layers.{i}.mlp.up_proj.weight": (("layers", "w_up"), True),
+        "model.layers.{i}.mlp.down_proj.weight": (("layers", "w_down"), True),
+    }
+    if not cfg.tie_word_embeddings:
+        m["lm_head.weight"] = (("lm_head",), True)
+    if cfg.attention_bias:
+        m["model.layers.{i}.self_attn.q_proj.bias"] = (("layers", "bq"), False)
+        m["model.layers.{i}.self_attn.k_proj.bias"] = (("layers", "bk"), False)
+        m["model.layers.{i}.self_attn.v_proj.bias"] = (("layers", "bv"), False)
+    if cfg.qk_norm:
+        m["model.layers.{i}.self_attn.q_norm.weight"] = (("layers", "q_norm"), False)
+        m["model.layers.{i}.self_attn.k_norm.weight"] = (("layers", "k_norm"), False)
+    return m
+
+
+def _match(name: str, patterns: Dict[str, Any]):
+    if name in patterns:
+        return patterns[name], None
+    if name.startswith("model.layers."):
+        rest = name[len("model.layers."):]
+        idx, _, tail = rest.partition(".")
+        key = f"model.layers.{{i}}.{tail}"
+        if key in patterns:
+            return patterns[key], int(idx)
+    return None, None
+
+
+def load_hf_params(cfg: ModelConfig, path: str,
+                   shardings: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    """Assemble the param pytree from an HF checkpoint directory."""
+    if safe_open is None:  # pragma: no cover
+        raise RuntimeError("safetensors not available")
+    dtype = np.dtype(jnp.dtype(cfg.dtype).name) if cfg.dtype != "bfloat16" else None
+    patterns = _name_map(cfg)
+    # First pass: collect per-layer slices on host.
+    staged: Dict[tuple, Any] = {}
+    per_layer: Dict[tuple, Dict[int, np.ndarray]] = {}
+    for f in _checkpoint_files(path):
+        with safe_open(f, framework="np") as sf:
+            for name in sf.keys():
+                spec, layer = _match(name, patterns)
+                if spec is None:
+                    continue
+                (tree_path, transpose) = spec
+                t = sf.get_tensor(name)
+                if transpose:
+                    t = np.ascontiguousarray(t.T)
+                if layer is None:
+                    staged[tree_path] = t
+                else:
+                    per_layer.setdefault(tree_path, {})[layer] = t
+
+    for tree_path, by_layer in per_layer.items():
+        missing = set(range(cfg.num_layers)) - set(by_layer)
+        if missing:
+            raise ValueError(f"checkpoint missing layers {sorted(missing)} for {tree_path}")
+        staged[tree_path] = np.stack([by_layer[i] for i in range(cfg.num_layers)])
+
+    params: Dict[str, Any] = {}
+    target_dtype = jnp.dtype(cfg.dtype)
+    for tree_path, arr in staged.items():
+        node = params
+        for k in tree_path[:-1]:
+            node = node.setdefault(k, {})
+        leaf = jnp.asarray(arr).astype(target_dtype)
+        if shardings is not None:
+            spec = shardings
+            for k in tree_path:
+                spec = spec.get(k) if isinstance(spec, dict) else None
+                if spec is None:
+                    break
+            if spec is not None:
+                leaf = jax.device_put(leaf, spec)
+        node[tree_path[-1]] = leaf
+    return params
+
+
+__all__ = ["load_hf_params"]

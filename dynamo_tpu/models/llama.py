@@ -1,0 +1,161 @@
+"""Llama-family decoder (llama 2/3, mistral, qwen2/qwen3) — pure-functional jax.
+
+The reference framework never implements a model; it shells out to vLLM/SGLang
+on CUDA (SURVEY §2.5). Here the model loop is native and TPU-first:
+
+- Params are a pytree of stacked per-layer arrays (leading ``L`` axis) and the
+  decoder runs as ONE ``lax.scan`` over layers: a single compiled layer body,
+  fast compiles, and XLA while-loop buffer aliasing so the paged KV cache
+  (part of the scan carry) is updated in place — no per-step cache copies.
+- One forward serves prefill chunks and decode steps (S = 1): new K/V is
+  scattered into the paged cache, then queries attend to the gathered context
+  (``dynamo_tpu.ops.attention``).
+- Only the last real token's logits are computed ([B, V]); full [B, S, V]
+  logit materialization would waste HBM on long prefill chunks.
+
+Weight layout matches HF checkpoints after transpose (torch Linear stores
+[out, in]; we store [in, out] so the forward is ``x @ w``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.ops.attention import paged_attention, write_kv
+from dynamo_tpu.ops.rope import apply_rope
+
+Params = Dict[str, Any]
+
+
+def _rms_norm(x: jnp.ndarray, w: jnp.ndarray, eps: float) -> jnp.ndarray:
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    return (xf * jax.lax.rsqrt(var + eps)).astype(x.dtype) * w
+
+
+def _head_rms_norm(x: jnp.ndarray, w: jnp.ndarray, eps: float) -> jnp.ndarray:
+    """qwen3-style per-head norm: x is [B, S, H, Dh], w is [Dh]."""
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    return (xf * jax.lax.rsqrt(var + eps)).astype(x.dtype) * w
+
+
+def make_pages(cfg: ModelConfig, num_pages: int, page_size: int,
+               dtype=None) -> jnp.ndarray:
+    """Allocate the paged KV cache: [L, 2, N, page_size, Hkv, Dh].
+
+    Page 0 is reserved as the garbage page for padded writes — allocators must
+    hand out pages starting at index 1.
+    """
+    dtype = dtype or jnp.dtype(cfg.dtype)
+    return jnp.zeros((cfg.num_layers, 2, num_pages, page_size,
+                      cfg.num_kv_heads, cfg.head_dim), dtype=dtype)
+
+
+def init_params(cfg: ModelConfig, rng: jax.Array, scale: float = 0.02) -> Params:
+    """Random-normal init (for tests/benchmarks; real serving loads HF weights)."""
+    dtype = jnp.dtype(cfg.dtype)
+    keys = iter(jax.random.split(rng, 16))
+
+    def norm(shape):
+        return jnp.ones(shape, dtype=dtype)
+
+    def randn(key, shape):
+        return (jax.random.normal(key, shape, dtype=jnp.float32) * scale).astype(dtype)
+
+    L, H, I = cfg.num_layers, cfg.hidden_size, cfg.intermediate_size
+    layers: Dict[str, jnp.ndarray] = {
+        "attn_norm": norm((L, H)),
+        "wq": randn(next(keys), (L, H, cfg.q_size)),
+        "wk": randn(next(keys), (L, H, cfg.kv_size)),
+        "wv": randn(next(keys), (L, H, cfg.kv_size)),
+        "wo": randn(next(keys), (L, cfg.q_size, H)),
+        "mlp_norm": norm((L, H)),
+        "w_gate": randn(next(keys), (L, H, I)),
+        "w_up": randn(next(keys), (L, H, I)),
+        "w_down": randn(next(keys), (L, I, H)),
+    }
+    if cfg.attention_bias:
+        layers["bq"] = jnp.zeros((L, cfg.q_size), dtype=dtype)
+        layers["bk"] = jnp.zeros((L, cfg.kv_size), dtype=dtype)
+        layers["bv"] = jnp.zeros((L, cfg.kv_size), dtype=dtype)
+    if cfg.qk_norm:
+        layers["q_norm"] = norm((L, cfg.head_dim))
+        layers["k_norm"] = norm((L, cfg.head_dim))
+    params: Params = {
+        "embed": randn(next(keys), (cfg.vocab_size, H)),
+        "layers": layers,
+        "final_norm": norm((H,)),
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = randn(next(keys), (H, cfg.vocab_size))
+    return params
+
+
+def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
+            positions: jnp.ndarray, pages: jnp.ndarray,
+            page_table: jnp.ndarray, total_lens: jnp.ndarray,
+            new_lens: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Run the decoder over a batch of new tokens against the paged cache.
+
+    tokens:     [B, S] new token ids (padded; pads masked via new_lens)
+    positions:  [B, S] absolute positions of the new tokens
+    pages:      paged KV cache (see make_pages); returned updated
+    page_table: [B, P] physical page ids per sequence
+    total_lens: [B] context length including the new tokens
+    new_lens:   [B] real new tokens per sequence (<= S)
+
+    Returns (logits [B, vocab] at each sequence's last real new token, pages).
+    """
+    B, S = tokens.shape
+    eps = cfg.rms_norm_eps
+    sm_scale = cfg.head_dim ** -0.5
+    h = params["embed"][tokens]  # [B, S, H]
+
+    def body(carry, xs):
+        h, pages = carry
+        lp, lidx = xs
+        x = _rms_norm(h, lp["attn_norm"], eps)
+        q = x @ lp["wq"]
+        k = x @ lp["wk"]
+        v = x @ lp["wv"]
+        if cfg.attention_bias:
+            q = q + lp["bq"]
+            k = k + lp["bk"]
+            v = v + lp["bv"]
+        q = q.reshape(B, S, cfg.num_heads, cfg.head_dim)
+        k = k.reshape(B, S, cfg.num_kv_heads, cfg.head_dim)
+        v = v.reshape(B, S, cfg.num_kv_heads, cfg.head_dim)
+        if cfg.qk_norm:
+            q = _head_rms_norm(q, lp["q_norm"], eps)
+            k = _head_rms_norm(k, lp["k_norm"], eps)
+        q = apply_rope(q, positions, cfg.rope_theta)
+        k = apply_rope(k, positions, cfg.rope_theta)
+        pages = write_kv(pages, lidx, k, v, page_table, positions, new_lens)
+        attn = paged_attention(q, pages, lidx, page_table, positions,
+                               total_lens, sm_scale)
+        h = h + attn.reshape(B, S, cfg.q_size) @ lp["wo"]
+        x = _rms_norm(h, lp["mlp_norm"], eps)
+        h = h + (jax.nn.silu(x @ lp["w_gate"]) * (x @ lp["w_up"])) @ lp["w_down"]
+        return (h, pages), None
+
+    (h, pages), _ = jax.lax.scan(
+        body, (h, pages),
+        (params["layers"], jnp.arange(cfg.num_layers)))
+
+    h = _rms_norm(h, params["final_norm"], eps)
+    last = jnp.maximum(new_lens - 1, 0)                    # [B]
+    h_last = jnp.take_along_axis(
+        h, last[:, None, None].astype(jnp.int32), axis=1)[:, 0]  # [B, H]
+    lm_head = params.get("lm_head")
+    if lm_head is None:
+        lm_head = params["embed"].T
+    logits = h_last.astype(jnp.float32) @ lm_head.astype(jnp.float32)
+    return logits, pages
+
+
+__all__ = ["init_params", "forward", "make_pages"]

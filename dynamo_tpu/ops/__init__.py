@@ -1,0 +1,18 @@
+"""TPU compute ops: RoPE, paged attention, sampling, norms.
+
+These are the building blocks of the jax worker's model forward. Everything is
+jit-compatible (static shapes, no Python control flow on traced values).
+"""
+
+from dynamo_tpu.ops.rope import apply_rope, rope_cos_sin
+from dynamo_tpu.ops.attention import paged_attention, write_kv
+from dynamo_tpu.ops.sampling import SamplingParamsBatch, sample_tokens
+
+__all__ = [
+    "apply_rope",
+    "rope_cos_sin",
+    "paged_attention",
+    "write_kv",
+    "SamplingParamsBatch",
+    "sample_tokens",
+]

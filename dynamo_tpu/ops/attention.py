@@ -1,0 +1,99 @@
+"""Paged attention over a block-paged KV cache — unified prefill/decode path.
+
+Capability parity with the reference's engine-internal paged attention (the
+reference delegates this to vLLM/SGLang CUDA kernels; here it is native).
+Design is TPU-first:
+
+- The KV cache is ONE stacked array ``pages[L, 2, N, page_size, Hkv, Dh]``
+  carried through a ``lax.scan`` over layers, so XLA's while-loop buffer
+  aliasing keeps every per-layer scatter in place (no cache copies per step).
+- Page 0 is a reserved garbage page: padded token positions write there, which
+  makes every scatter shape-static and mask-free.
+- One code path serves prefill (S = chunk length) and decode (S = 1): new K/V
+  is scattered into the cache first, then the full context is gathered from the
+  page table and attended with a causal mask on absolute positions. Chunked
+  prefill with a prefix-cache hit falls out for free — queries attend to
+  whatever the page table already holds.
+
+The gather materializes ``[B, T, Hkv, Dh]`` per layer; the Pallas decode kernel
+(``dynamo_tpu.ops.pallas.paged_decode``) fuses that gather away on TPU. This
+XLA path is the portable reference implementation and the CPU-test path.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def write_kv(pages: jnp.ndarray, layer_idx, k_new: jnp.ndarray,
+             v_new: jnp.ndarray, page_table: jnp.ndarray,
+             positions: jnp.ndarray, new_lens: jnp.ndarray) -> jnp.ndarray:
+    """Scatter new K/V into the paged cache.
+
+    pages:      [L, 2, N, page_size, Hkv, Dh]
+    k_new/v_new:[B, S, Hkv, Dh]
+    page_table: [B, P] logical-page -> physical-page map (int32)
+    positions:  [B, S] absolute token positions of the new tokens
+    new_lens:   [B] number of real (non-pad) new tokens per sequence
+    """
+    page_size = pages.shape[3]
+    B, S = positions.shape
+    logical = positions // page_size                       # [B, S]
+    slot = positions % page_size                           # [B, S]
+    phys = jnp.take_along_axis(page_table, logical, axis=1)  # [B, S]
+    # Padded tokens (s >= new_lens[b]) go to the reserved garbage page 0.
+    pad = jnp.arange(S)[None, :] >= new_lens[:, None]
+    phys = jnp.where(pad, 0, phys)
+    slot = jnp.where(pad, 0, slot)
+    pages = pages.at[layer_idx, 0, phys, slot].set(
+        k_new.astype(pages.dtype), mode="drop")
+    pages = pages.at[layer_idx, 1, phys, slot].set(
+        v_new.astype(pages.dtype), mode="drop")
+    return pages
+
+
+def paged_attention(q: jnp.ndarray, pages: jnp.ndarray, layer_idx,
+                    page_table: jnp.ndarray, positions: jnp.ndarray,
+                    total_lens: jnp.ndarray, sm_scale: float) -> jnp.ndarray:
+    """Attend queries to the paged context (new K/V must already be written).
+
+    q:          [B, S, Hq, Dh]
+    page_table: [B, P]
+    positions:  [B, S] absolute positions of the queries
+    total_lens: [B] total context length (cached + new)
+    returns     [B, S, Hq, Dh]
+    """
+    B, S, Hq, Dh = q.shape
+    page_size = pages.shape[3]
+    Hkv = pages.shape[4]
+    G = Hq // Hkv
+    P = page_table.shape[1]
+    T = P * page_size
+
+    # Single fused gather: a traced layer_idx participates as an advanced
+    # index, so XLA reads only the gathered pages (indexing pages[layer_idx]
+    # first would dynamic-slice-copy the whole layer's cache).
+    k = pages[layer_idx, 0, page_table]  # [B, P, page_size, Hkv, Dh]
+    v = pages[layer_idx, 1, page_table]
+    k = k.reshape(B, T, Hkv, Dh)
+    v = v.reshape(B, T, Hkv, Dh)
+
+    qg = q.reshape(B, S, Hkv, G, Dh)
+    scores = jnp.einsum("bsngd,btnd->bnsgt", qg.astype(jnp.float32),
+                        k.astype(jnp.float32)) * sm_scale  # [B,Hkv,S,G,T]
+
+    t_pos = jnp.arange(T)[None, None, :]                   # [1, 1, T]
+    causal = t_pos <= positions[:, :, None]                # [B, S, T]
+    valid = t_pos < total_lens[:, None, None]              # [B, 1, T]
+    mask = (causal & valid)[:, None, :, None, :]           # [B, 1, S, 1, T]
+    scores = jnp.where(mask, scores, NEG_INF)
+
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bnsgt,btnd->bsngd", probs, v.astype(jnp.float32))
+    return out.reshape(B, S, Hq, Dh).astype(q.dtype)
+
+
+__all__ = ["write_kv", "paged_attention", "NEG_INF"]

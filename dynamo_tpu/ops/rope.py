@@ -1,0 +1,44 @@
+"""Rotary position embeddings (HF half-split convention).
+
+Uses the ``rotate_half`` formulation so weights loaded from HF checkpoints
+(llama/mistral/qwen) produce identical activations: for a head vector split
+into halves ``[x1, x2]``, ``rope(x) = x * cos + [-x2, x1] * sin`` with
+``cos/sin`` built from ``inv_freq = theta^(-2i/d)`` and tiled twice.
+
+Computed on the fly from positions (no precomputed table): a decode step's
+positions are dynamic, and the trig is negligible next to the matmuls.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rope_cos_sin(positions: jnp.ndarray, head_dim: int, theta: float,
+                 dtype=jnp.float32):
+    """cos/sin tables for absolute ``positions`` (any shape), returned with a
+    trailing ``head_dim`` axis: shape ``positions.shape + (head_dim,)``."""
+    half = head_dim // 2
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
+    angles = positions.astype(jnp.float32)[..., None] * inv_freq  # (..., half)
+    angles = jnp.concatenate([angles, angles], axis=-1)  # (..., head_dim)
+    return jnp.cos(angles).astype(dtype), jnp.sin(angles).astype(dtype)
+
+
+def _rotate_half(x: jnp.ndarray) -> jnp.ndarray:
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate([-x2, x1], axis=-1)
+
+
+def apply_rope(x: jnp.ndarray, positions: jnp.ndarray, theta: float) -> jnp.ndarray:
+    """Rotate ``x`` of shape ``[B, S, num_heads, head_dim]`` by per-token
+    absolute ``positions`` of shape ``[B, S]``."""
+    cos, sin = rope_cos_sin(positions, x.shape[-1], theta)
+    cos = cos[:, :, None, :]  # broadcast over heads
+    sin = sin[:, :, None, :]
+    out = x.astype(jnp.float32) * cos + _rotate_half(x.astype(jnp.float32)) * sin
+    return out.astype(x.dtype)
+
+
+__all__ = ["rope_cos_sin", "apply_rope"]

@@ -88,7 +88,7 @@ class OpenAIPreprocessor:
         max_tokens = (req.effective_max_tokens()
                       if isinstance(req, ChatCompletionRequest) else req.max_tokens)
         budget = self.card.context_length - len(token_ids)
-        max_tokens = min(max_tokens, budget) if max_tokens else budget
+        max_tokens = min(max_tokens, budget) if max_tokens is not None else budget
         ignore_eos = bool(req.nvext.ignore_eos) if (
             req.nvext and req.nvext.ignore_eos is not None) else False
         stop_conditions = StopConditions(

@@ -89,6 +89,12 @@ class PushRouter:
         except StreamEndedError:
             self.client.report_instance_down(iid)
             raise
+        finally:
+            # Consumer stopped early (stop string, disconnect, GeneratorExit):
+            # tell the worker to abort generation instead of streaming into a
+            # queue nobody reads.
+            if not stream.finished:
+                await stream.cancel()
 
 
 __all__ = ["PushRouter", "RouterMode"]

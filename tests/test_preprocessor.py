@@ -183,3 +183,47 @@ async def test_backend_engine_error_propagates(card):
     outs = await _collect(backend, req, [LLMEngineOutput(error="engine exploded")])
     assert outs[-1].finish_reason == FinishReason.ERROR
     assert outs[-1].error == "engine exploded"
+
+
+def test_stopjail_earliest_occurrence_wins():
+    from dynamo_tpu.backend import StopJail
+    jail = StopJail(["bc", "abc"])
+    out = jail.push("xabcy")
+    assert out == "x"
+    assert jail.matched == "abc"
+
+
+def test_max_tokens_zero_is_respected():
+    from dynamo_tpu.protocols.openai import CompletionRequest
+    from dynamo_tpu.preprocessor import OpenAIPreprocessor
+    from dynamo_tpu.utils.testing import make_test_card
+    card = make_test_card()
+    pre = OpenAIPreprocessor(card)
+    req = CompletionRequest(model="m", prompt="hello world", max_tokens=0)
+    out = pre.preprocess_completion(req, "rid")
+    assert out.stop_conditions.max_tokens == 0
+
+
+async def test_backend_closes_engine_stream_on_early_exit():
+    from dynamo_tpu.backend import Backend
+    from dynamo_tpu.protocols.common import (LLMEngineOutput,
+                                             PreprocessedRequest,
+                                             StopConditions)
+    from dynamo_tpu.utils.testing import make_test_card
+    card = make_test_card()
+    backend = Backend(card)
+    closed = []
+
+    async def engine_stream():
+        try:
+            for _ in range(1000):
+                yield LLMEngineOutput(token_ids=[5])
+        finally:
+            closed.append(True)
+
+    req = PreprocessedRequest(token_ids=[1, 2], request_id="r",
+                              stop_conditions=StopConditions(max_tokens=1000))
+    gen = backend.transform(req, engine_stream())
+    await gen.__anext__()
+    await gen.aclose()
+    assert closed == [True]
