@@ -7,11 +7,26 @@ Engines stream ``LLMEngineOutput`` frames for a ``PreprocessedRequest``.
 Implementations:
 - ``EchoEngine`` (here): deterministic test engine (reference
   ``lib/llm/src/engines.rs`` echo_core/echo_full).
-- ``dynamo_tpu.engine.tpu_engine.TpuEngine``: the jax/Pallas continuous
+- ``dynamo_tpu.engine.jax_engine.JaxEngine``: the jax/Pallas continuous
   batching engine — the reason this framework exists.
 - ``dynamo_tpu.mocker.MockerEngine``: vLLM-simulator with KV events/timing.
+
+``JaxEngine`` is imported lazily (pulls in jax); ``from dynamo_tpu.engine
+import JaxEngine`` works via ``__getattr__``.
 """
 
 from dynamo_tpu.engine.base import EngineBase, EchoEngine
 
-__all__ = ["EngineBase", "EchoEngine"]
+
+def __getattr__(name):
+    if name in ("JaxEngine", "JaxEngineConfig"):
+        from dynamo_tpu.engine import jax_engine
+        return getattr(jax_engine, name)
+    if name in ("PageAllocator", "Scheduler", "SchedulerConfig"):
+        from dynamo_tpu.engine import pages, scheduler
+        return getattr(pages, name, None) or getattr(scheduler, name)
+    raise AttributeError(name)
+
+
+__all__ = ["EngineBase", "EchoEngine", "JaxEngine", "JaxEngineConfig",
+           "PageAllocator", "Scheduler", "SchedulerConfig"]

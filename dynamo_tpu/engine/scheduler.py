@@ -1,0 +1,328 @@
+"""Continuous-batching scheduler for the TPU serving engine.
+
+Request lifecycle (capability parity with the reference's engine-internal
+schedulers — vLLM/SGLang on CUDA, and the rust mocker's chunked scheduler
+``lib/llm/src/mocker/scheduler.rs:249-520`` — re-designed for a jit-compiled
+engine):
+
+  WAITING --admit (prefix-match + allocate pages)--> PREFILL
+  PREFILL --chunked prefill steps--> RUNNING (first token sampled)
+  RUNNING --decode steps, page-by-page growth--> FINISHED
+  RUNNING --page pressure--> PREEMPTED (pages released) --> WAITING (re-admit,
+           prefix cache usually revives the computed prefix)
+
+The scheduler is pure host-side bookkeeping: it never touches device arrays.
+Each call to :meth:`schedule` returns ONE step plan — either a prefill chunk
+for a single sequence or a decode batch over all running sequences — and the
+engine turns the plan into padded/bucketed device arrays. Prefill and decode
+alternate when both are runnable so neither starves.
+
+Token accounting: ``num_computed`` counts positions whose KV is written to the
+cache. A decode step feeds the single newest token (position ``len-1``),
+samples the next, appends it. A prefill chunk feeds prompt positions
+``[num_computed, num_computed+chunk)``; the final chunk's logits produce the
+first generated token. Pages whose every position is computed are committed to
+the allocator under their chained block hash (``block_size == page_size``),
+which both enables prefix reuse and emits the router-facing ``stored`` events.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Deque, Dict, List, Optional, Union
+
+from dynamo_tpu.engine.pages import OutOfPages, PageAllocator
+from dynamo_tpu.protocols.common import PreprocessedRequest
+from dynamo_tpu.protocols.events import ForwardPassMetrics, KvStats, WorkerStats
+from dynamo_tpu.tokens import TokenBlockSequence
+
+
+class Phase(Enum):
+    WAITING = "waiting"
+    PREFILL = "prefill"
+    RUNNING = "running"
+    FINISHED = "finished"
+
+
+class Sequence:
+    """Host-side state of one in-flight request."""
+
+    __slots__ = ("request", "tokens", "page_ids", "committed_pages",
+                 "num_computed", "cached_tokens", "num_prompt", "generated",
+                 "phase", "cancelled", "arrival", "salt_hash")
+
+    def __init__(self, request: PreprocessedRequest, page_size: int,
+                 salt_hash: int = 0):
+        self.request = request
+        self.salt_hash = salt_hash
+        self.tokens = TokenBlockSequence(request.token_ids,
+                                         block_size=page_size,
+                                         salt_hash=salt_hash)
+        self.num_prompt = len(request.token_ids)
+        self.page_ids: List[int] = []
+        self.committed_pages = 0
+        self.num_computed = 0
+        self.cached_tokens = 0
+        self.generated: List[int] = []
+        self.phase = Phase.WAITING
+        self.cancelled = False
+        self.arrival = time.monotonic()
+
+    def __len__(self) -> int:
+        return len(self.tokens)
+
+
+@dataclass
+class PrefillChunk:
+    seq: Sequence
+    start: int      # first position fed this step (== seq.num_computed)
+    length: int     # real tokens in the chunk
+    is_last: bool   # final chunk => sample the first generated token
+
+
+@dataclass
+class DecodeBatch:
+    seqs: List[Sequence]
+
+
+StepPlan = Union[PrefillChunk, DecodeBatch]
+
+
+@dataclass
+class SchedulerConfig:
+    max_num_seqs: int = 64           # concurrent running+prefill sequences
+    max_prefill_chunk: int = 512     # max prompt tokens per prefill step
+    watermark: float = 0.01          # keep this fraction of pages free at admit
+    max_queue: int = 4096
+
+
+class Scheduler:
+    """Chunked-prefill continuous batching over a :class:`PageAllocator`."""
+
+    def __init__(self, allocator: PageAllocator, config: SchedulerConfig):
+        self.alloc = allocator
+        self.cfg = config
+        self.page_size = allocator.page_size
+        self.waiting: Deque[Sequence] = deque()
+        self.active: Dict[str, Sequence] = {}  # request_id -> seq (prefill+running)
+        self._prefer_prefill = True
+        self.num_preemptions = 0
+        # cancelled sequences reaped outside an engine step; the engine drains
+        # this to emit their CANCELLED frames (otherwise the caller's stream
+        # would never terminate)
+        self.reaped: List[Sequence] = []
+
+    def drain_reaped(self) -> List[Sequence]:
+        out, self.reaped = self.reaped, []
+        return out
+
+    # -- intake ------------------------------------------------------------
+
+    def add_request(self, request: PreprocessedRequest) -> Sequence:
+        if len(self.waiting) >= self.cfg.max_queue:
+            raise RuntimeError("scheduler queue full")
+        seq = Sequence(request, self.page_size)
+        self.waiting.append(seq)
+        return seq
+
+    def cancel(self, request_id: str) -> None:
+        seq = self.active.get(request_id)
+        if seq is not None:
+            seq.cancelled = True
+            return
+        for seq in self.waiting:
+            if seq.request.request_id == request_id:
+                seq.cancelled = True
+                self.waiting.remove(seq)
+                self.reaped.append(seq)
+                return
+
+    # -- admission ---------------------------------------------------------
+
+    def _watermark_pages(self) -> int:
+        return max(1, int(self.alloc.num_pages * self.cfg.watermark))
+
+    def _try_admit(self) -> Optional[Sequence]:
+        while self.waiting and self.waiting[0].cancelled:
+            self.reaped.append(self.waiting.popleft())
+        if not self.waiting:
+            return None
+        if len(self.active) >= self.cfg.max_num_seqs:
+            return None
+        seq = self.waiting[0]
+        hashes = seq.tokens.block_hashes()
+        # Prefix-cache hit: claim resident pages, but always leave >=1 token
+        # to compute so the final-chunk logits exist. (For a preempted
+        # sequence len(seq) includes generated tokens; the revive covers them
+        # too since its full pages were committed before release.)
+        match = self.alloc.match_prefix(hashes)
+        cached = min(match.num_pages * self.page_size, len(seq) - 1)
+        full_cached_pages = cached // self.page_size
+        if full_cached_pages < match.num_pages:
+            self.alloc.release(match.page_ids[full_cached_pages:])
+            match.page_ids = match.page_ids[:full_cached_pages]
+        cached = full_cached_pages * self.page_size
+        need = self._pages_needed(len(seq)) - len(match.page_ids)
+        if need > self.alloc.num_free - self._watermark_pages():
+            self.alloc.release(match.page_ids)
+            return None
+        try:
+            fresh = self.alloc.allocate(need) if need else []
+        except OutOfPages:
+            self.alloc.release(match.page_ids)
+            return None
+        self.alloc.count_lookup(hits=full_cached_pages,
+                                misses=len(hashes) - full_cached_pages)
+        self.waiting.popleft()
+        seq.page_ids = match.page_ids + fresh
+        seq.committed_pages = len(match.page_ids)
+        seq.num_computed = cached
+        if not seq.generated:  # first admission: report the prefix hit
+            seq.cached_tokens = cached
+        seq.phase = Phase.PREFILL
+        self.active[seq.request.request_id] = seq
+        return seq
+
+    def _pages_needed(self, num_tokens: int) -> int:
+        # positions [0, num_tokens-1] must be addressable
+        return (num_tokens + self.page_size - 1) // self.page_size
+
+    # -- per-step bookkeeping ---------------------------------------------
+
+    def _commit_full_pages(self, seq: Sequence) -> None:
+        full = seq.num_computed // self.page_size
+        blocks = seq.tokens.blocks
+        for i in range(seq.committed_pages, min(full, len(seq.page_ids))):
+            b = blocks[i]
+            self.alloc.commit(seq.page_ids[i], b.block_hash, b.local_hash,
+                              b.parent_hash if b.position > 0 else None)
+        seq.committed_pages = max(seq.committed_pages, full)
+
+    def finish(self, seq: Sequence) -> None:
+        """Release a sequence's resources (idempotent)."""
+        if seq.phase == Phase.FINISHED:
+            return
+        self._commit_full_pages(seq)
+        self.alloc.release(seq.page_ids)
+        seq.page_ids = []
+        seq.phase = Phase.FINISHED
+        self.active.pop(seq.request.request_id, None)
+
+    def _preempt_one(self) -> bool:
+        """Evict the newest running sequence back to the waiting queue."""
+        victims = [s for s in self.active.values() if s.phase == Phase.RUNNING]
+        if not victims:
+            return False
+        victim = max(victims, key=lambda s: s.arrival)
+        self._commit_full_pages(victim)
+        self.alloc.release(victim.page_ids)
+        victim.page_ids = []
+        victim.committed_pages = 0
+        victim.num_computed = 0
+        victim.phase = Phase.WAITING
+        self.active.pop(victim.request.request_id)
+        self.waiting.appendleft(victim)
+        self.num_preemptions += 1
+        return True
+
+    def _grow_for_decode(self, seq: Sequence) -> bool:
+        """Ensure the page for position ``len-1`` exists; may preempt others."""
+        need = self._pages_needed(len(seq)) - len(seq.page_ids)
+        while need > 0:
+            try:
+                seq.page_ids.extend(self.alloc.allocate(need))
+                return True
+            except OutOfPages:
+                if not self._preempt_one() or seq.phase != Phase.RUNNING:
+                    return False
+        return True
+
+    # -- the step ----------------------------------------------------------
+
+    def _prefill_plan(self, seq: Sequence) -> PrefillChunk:
+        # len(seq), not num_prompt: a revived preempted sequence must also
+        # re-prefill the tokens it had generated before eviction
+        remaining = len(seq) - seq.num_computed
+        length = min(remaining, self.cfg.max_prefill_chunk)
+        return PrefillChunk(seq=seq, start=seq.num_computed, length=length,
+                            is_last=(length == remaining))
+
+    def schedule(self) -> Optional[StepPlan]:
+        """Pick the next engine step, or None if there is nothing to run."""
+        # drop cancelled active sequences
+        for seq in [s for s in self.active.values() if s.cancelled]:
+            self.finish(seq)
+            self.reaped.append(seq)
+
+        prefilling = next((s for s in self.active.values()
+                           if s.phase == Phase.PREFILL), None)
+        if prefilling is None:
+            admitted = self._try_admit()
+            if admitted is not None:
+                prefilling = admitted
+
+        decodable = [s for s in self.active.values() if s.phase == Phase.RUNNING]
+
+        run_prefill = prefilling is not None and (
+            self._prefer_prefill or not decodable)
+        if run_prefill:
+            self._prefer_prefill = False
+            return self._prefill_plan(prefilling)
+        self._prefer_prefill = True
+        if not decodable:
+            if prefilling is not None:
+                # only prefill work exists
+                self._prefer_prefill = False
+                return self._prefill_plan(prefilling)
+            return None
+        # decode: grow pages first (may preempt newest sequences)
+        ready: List[Sequence] = []
+        for seq in sorted(decodable, key=lambda s: s.arrival):
+            if seq.phase != Phase.RUNNING:
+                continue  # preempted by an earlier grow
+            if self._grow_for_decode(seq):
+                ready.append(seq)
+        ready = [s for s in ready if s.phase == Phase.RUNNING]
+        if not ready:
+            return None
+        return DecodeBatch(seqs=ready)
+
+    def on_step_done(self, plan: StepPlan) -> None:
+        """Advance accounting after the engine ran the planned step."""
+        if isinstance(plan, PrefillChunk):
+            seq = plan.seq
+            seq.num_computed += plan.length
+            if plan.is_last:
+                seq.phase = Phase.RUNNING
+            self._commit_full_pages(seq)
+        else:
+            for seq in plan.seqs:
+                seq.num_computed += 1
+                self._commit_full_pages(seq)
+
+    # -- metrics -----------------------------------------------------------
+
+    def metrics(self) -> ForwardPassMetrics:
+        total = self.alloc.num_pages - 1
+        hits = self.alloc.hits
+        lookups = hits + self.alloc.misses
+        return ForwardPassMetrics(
+            worker_stats=WorkerStats(
+                request_active_slots=len(self.active),
+                request_total_slots=self.cfg.max_num_seqs,
+                num_requests_waiting=len(self.waiting),
+            ),
+            kv_stats=KvStats(
+                kv_active_blocks=total - self.alloc.num_free,
+                kv_total_blocks=total,
+                gpu_cache_usage_perc=self.alloc.usage(),
+                gpu_prefix_cache_hit_rate=(hits / lookups) if lookups else 0.0,
+            ),
+        )
+
+
+__all__ = ["Scheduler", "SchedulerConfig", "Sequence", "Phase",
+           "PrefillChunk", "DecodeBatch"]

@@ -85,7 +85,9 @@ def load_hf_params(cfg: ModelConfig, path: str,
     """Assemble the param pytree from an HF checkpoint directory."""
     if safe_open is None:  # pragma: no cover
         raise RuntimeError("safetensors not available")
-    dtype = np.dtype(jnp.dtype(cfg.dtype).name) if cfg.dtype != "bfloat16" else None
+    if cfg.num_experts:
+        raise NotImplementedError(
+            "MoE checkpoints are loaded via dynamo_tpu.models.moe")
     patterns = _name_map(cfg)
     # First pass: collect per-layer slices on host.
     staged: Dict[tuple, Any] = {}
@@ -110,6 +112,15 @@ def load_hf_params(cfg: ModelConfig, path: str,
         if missing:
             raise ValueError(f"checkpoint missing layers {sorted(missing)} for {tree_path}")
         staged[tree_path] = np.stack([by_layer[i] for i in range(cfg.num_layers)])
+
+    # every expected weight family must have appeared — catches truncated
+    # checkpoints and architectures whose tensor names we didn't map (which
+    # would otherwise surface as a KeyError deep inside the jitted forward)
+    absent = {tp for tp, _ in patterns.values()} - set(staged)
+    if absent:
+        raise ValueError(
+            f"checkpoint at {path} is missing weights for {sorted(absent)}; "
+            f"unsupported architecture or incomplete download")
 
     params: Dict[str, Any] = {}
     target_dtype = jnp.dtype(cfg.dtype)

@@ -101,7 +101,11 @@ class DistributedRuntime:
         runtime = runtime or Runtime()
         embedded = None
         if standalone:
-            embedded = await Coordinator(port=0).start()
+            # honor the requested address so other processes can join with
+            # the same --coordinator value
+            host, _, port = coordinator.rpartition(":")
+            embedded = await Coordinator(host=host or "127.0.0.1",
+                                         port=int(port)).start()
             coordinator = embedded.address
         coord = await CoordClient(coordinator).connect()
         return cls(runtime, coord, embedded)

@@ -1,0 +1,7 @@
+"""The native TPU worker process (``python -m dynamo_tpu.worker.main``).
+
+Role parity: the reference's backend worker processes
+(``components/backends/vllm/src/dynamo/vllm/main.py`` etc.) — but where those
+wrap external CUDA engines, this worker owns the model loop natively via
+``dynamo_tpu.engine.jax_engine.JaxEngine``.
+"""

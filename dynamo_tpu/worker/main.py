@@ -1,0 +1,135 @@
+"""TPU worker: serve a Llama-family model on jax behind the runtime.
+
+Flow parity with the reference worker startup (SURVEY §3.2;
+``components/backends/vllm/src/dynamo/vllm/main.py:43-65``):
+connect the distributed runtime → build the model card → spin up the engine →
+publish KV events + load metrics → ``serve_endpoint`` + ``register_llm``.
+The engine here is the native ``JaxEngine`` rather than a subprocess CUDA
+stack, so "spin up" is: load HF weights into a stacked-layer pytree (sharded
+onto the TPU mesh when ``--tensor-parallel-size`` > 1) and allocate the paged
+KV cache.
+
+KV events ride the coordinator event bus on subject
+``{namespace}.{component}.kv_events`` (reference: per-worker NATS ``kv_events``
+subject, ``lib/llm/src/kv_router/publisher.rs:57-99``); worker load metrics are
+served to stat scrapers via the endpoint stats hook (reference:
+``WorkerMetricsPublisher`` + ``$SRV.STATS``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+from typing import List
+
+import jax
+
+from dynamo_tpu.engine.jax_engine import JaxEngine, JaxEngineConfig
+from dynamo_tpu.llm.register import register_llm, serve_engine
+from dynamo_tpu.model_card import ModelDeploymentCard
+from dynamo_tpu.models import llama
+from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.models.hf_loader import load_hf_params
+from dynamo_tpu.protocols.events import KvCacheEvent, RouterEvent
+from dynamo_tpu.runtime.runtime import DEFAULT_COORDINATOR, DistributedRuntime
+from dynamo_tpu.utils.logging import configure_logging
+
+logger = logging.getLogger(__name__)
+
+
+def kv_events_subject(namespace: str, component: str) -> str:
+    return f"{namespace}.{component}.kv_events"
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description="dynamo_tpu jax worker")
+    p.add_argument("--coordinator", default=DEFAULT_COORDINATOR)
+    p.add_argument("--namespace", default="dynamo")
+    p.add_argument("--component", default="tpu")
+    p.add_argument("--endpoint", default="generate")
+    p.add_argument("--model-path", required=True,
+                   help="HF-style model dir (config/tokenizer[/safetensors])")
+    p.add_argument("--model-name", default=None)
+    p.add_argument("--random-weights", action="store_true",
+                   help="skip checkpoint load; random init (dev/benchmarks)")
+    p.add_argument("--dtype", default="bfloat16")
+    p.add_argument("--num-pages", type=int, default=2048)
+    p.add_argument("--page-size", type=int, default=16)
+    p.add_argument("--max-num-seqs", type=int, default=64)
+    p.add_argument("--max-prefill-chunk", type=int, default=1024)
+    p.add_argument("--max-context", type=int, default=8192)
+    p.add_argument("--tensor-parallel-size", type=int, default=1,
+                   help="shard the model over this many local devices")
+    p.add_argument("--no-kv-events", action="store_true")
+    p.add_argument("--prefill-only", action="store_true",
+                   help="serve as a disaggregated prefill worker")
+    return p
+
+
+def build_engine(args: argparse.Namespace) -> JaxEngine:
+    cfg = ModelConfig.from_pretrained(args.model_path, dtype=args.dtype)
+    engine_cfg = JaxEngineConfig(
+        num_pages=args.num_pages, page_size=args.page_size,
+        max_num_seqs=args.max_num_seqs,
+        max_prefill_chunk=args.max_prefill_chunk,
+        max_context=min(args.max_context, cfg.max_position_embeddings))
+    if args.tensor_parallel_size > 1:
+        from dynamo_tpu.parallel import tp_sharding
+        shard = tp_sharding(cfg, args.tensor_parallel_size)
+        engine_cfg.shard_params_fn = shard.shard_params
+        engine_cfg.shard_pages_fn = shard.shard_pages
+    if args.random_weights:
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    else:
+        params = load_hf_params(cfg, args.model_path)
+    return JaxEngine(cfg, params, engine_cfg)
+
+
+async def amain(args: argparse.Namespace) -> None:
+    drt = await DistributedRuntime.create(coordinator=args.coordinator)
+    card = ModelDeploymentCard.from_local_path(args.model_path,
+                                               name=args.model_name)
+    card.kv_cache_block_size = args.page_size
+    endpoint = (drt.namespace(args.namespace).component(args.component)
+                .endpoint(args.endpoint))
+    engine = build_engine(args)
+
+    if not args.no_kv_events:
+        lease = await drt.primary_lease()
+        subject = kv_events_subject(args.namespace, args.component)
+
+        def publish_events(events: List[KvCacheEvent]) -> None:
+            async def _send() -> None:
+                for ev in events:
+                    rev = RouterEvent(worker_id=lease.lease_id, event=ev)
+                    await drt.publish_event(subject, rev.to_dict())
+            asyncio.get_running_loop().create_task(_send())
+
+        engine.kv_event_cb = publish_events
+
+    await serve_engine(endpoint, engine,
+                       stats_provider=lambda: engine.stats().to_dict())
+    await register_llm(
+        drt, endpoint, card,
+        model_type="prefill" if args.prefill_only else "chat")
+    print(f"jax worker serving model {card.name} "
+          f"on {len(jax.devices())} device(s)", flush=True)
+    try:
+        await drt.runtime.wait_shutdown()
+    finally:
+        await engine.stop()
+        await drt.close()
+
+
+def main() -> None:
+    args = build_parser().parse_args()
+    configure_logging()
+    try:
+        asyncio.run(amain(args))
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

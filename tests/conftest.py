@@ -9,11 +9,24 @@
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force pure-CPU jax for the test suite. Three layers, all needed:
+# - JAX_PLATFORMS / XLA_FLAGS for any jax that honors env (and children);
+# - drop PALLAS_AXON_POOL_IPS so child *processes* spawned by e2e tests
+#   don't re-register the axon TPU tunnel backend via sitecustomize;
+# - jax.config.update, because this process's sitecustomize may have
+#   already registered the axon plugin and set jax_platforms="axon,cpu"
+#   (initializing that backend dials the TPU tunnel and can block for
+#   minutes — it must never happen under pytest).
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import asyncio
 import inspect

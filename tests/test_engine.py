@@ -1,0 +1,383 @@
+"""Tests for the serving engine: page allocator, scheduler, JaxEngine e2e.
+
+Model for coverage: the reference's engine-behavior tests live inside vLLM;
+its own suites test the mocker scheduler (``lib/llm/src/mocker/scheduler.rs``)
+and KV manager. Here the engine is native, so these tests cover admission,
+chunked prefill, prefix reuse, eviction events, preemption, stop conditions,
+and streamed generation on the tiny model (CPU).
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine.jax_engine import JaxEngine, JaxEngineConfig
+from dynamo_tpu.engine.pages import OutOfPages, PageAllocator
+from dynamo_tpu.engine.scheduler import (
+    DecodeBatch,
+    Phase,
+    PrefillChunk,
+    Scheduler,
+    SchedulerConfig,
+)
+from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.protocols.common import (
+    FinishReason,
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.tokens import TokenBlockSequence
+
+
+# ---------------------------------------------------------------- allocator
+
+def seq_hashes(tokens, page_size=4):
+    return TokenBlockSequence(tokens, block_size=page_size).blocks
+
+
+class TestPageAllocator:
+    def test_allocate_and_free_cycle(self):
+        a = PageAllocator(num_pages=5, page_size=4)
+        pages = a.allocate(4)
+        assert sorted(pages) == [1, 2, 3, 4]
+        assert a.num_free == 0
+        with pytest.raises(OutOfPages):
+            a.allocate(1)
+        a.release(pages)
+        assert a.num_free == 4
+
+    def test_commit_emits_stored_event(self):
+        a = PageAllocator(num_pages=5, page_size=4)
+        [p] = a.allocate(1)
+        blk = seq_hashes([1, 2, 3, 4])[0]
+        a.commit(p, blk.block_hash, blk.local_hash, None)
+        evs = a.drain_events()
+        assert len(evs) == 1
+        assert evs[0].stored_blocks[0].block_hash == blk.block_hash
+        assert not a.drain_events()
+
+    def test_prefix_match_revives_lru(self):
+        a = PageAllocator(num_pages=5, page_size=4)
+        blocks = seq_hashes([1, 2, 3, 4, 5, 6, 7, 8])
+        pages = a.allocate(2)
+        for p, b in zip(pages, blocks):
+            a.commit(p, b.block_hash, b.local_hash,
+                     b.parent_hash if b.position else None)
+        a.release(pages)  # refcount 0 -> LRU, still matchable
+        assert a.peek_prefix([b.block_hash for b in blocks]) == 2
+        m = a.match_prefix([b.block_hash for b in blocks])
+        assert m.page_ids == pages
+
+    def test_eviction_emits_removed_and_breaks_match(self):
+        a = PageAllocator(num_pages=3, page_size=4)
+        blocks = seq_hashes([1, 2, 3, 4, 5, 6, 7, 8])
+        pages = a.allocate(2)
+        for p, b in zip(pages, blocks):
+            a.commit(p, b.block_hash, b.local_hash,
+                     b.parent_hash if b.position else None)
+        a.release(pages)
+        a.drain_events()
+        # allocating both pages again must evict both cached blocks (LRU)
+        a.allocate(2)
+        evs = a.drain_events()
+        removed = [h for e in evs for h in e.removed_block_hashes]
+        assert set(removed) == {b.block_hash for b in blocks}
+        m = a.match_prefix([b.block_hash for b in blocks])
+        assert m.num_pages == 0
+
+    def test_duplicate_commit_frees_quietly(self):
+        a = PageAllocator(num_pages=4, page_size=4)
+        blk = seq_hashes([1, 2, 3, 4])[0]
+        [p1] = a.allocate(1)
+        [p2] = a.allocate(1)
+        a.commit(p1, blk.block_hash, blk.local_hash, None)
+        a.commit(p2, blk.block_hash, blk.local_hash, None)
+        evs = a.drain_events()
+        assert sum(len(e.stored_blocks) for e in evs) == 1  # registered once
+        a.release([p2])  # duplicate page frees, registry untouched
+        assert a.match_prefix([blk.block_hash]).page_ids == [p1]
+
+    def test_clear_evicts_cached(self):
+        a = PageAllocator(num_pages=3, page_size=4)
+        blk = seq_hashes([1, 2, 3, 4])[0]
+        [p] = a.allocate(1)
+        a.commit(p, blk.block_hash, blk.local_hash, None)
+        a.release([p])
+        a.clear()
+        evs = a.drain_events()
+        assert any(e.all_blocks_cleared for e in evs)
+        assert a.match_prefix([blk.block_hash]).num_pages == 0
+        assert a.num_free == 2
+
+
+# ---------------------------------------------------------------- scheduler
+
+def make_req(tokens, rid="r1", max_tokens=8, **kw):
+    return PreprocessedRequest(
+        token_ids=list(tokens), request_id=rid,
+        stop_conditions=StopConditions(max_tokens=max_tokens, **kw),
+        sampling_options=SamplingOptions(temperature=0.0),
+        eos_token_ids=[0])
+
+
+class TestScheduler:
+    def make(self, num_pages=17, page_size=4, **cfg):
+        alloc = PageAllocator(num_pages, page_size)
+        return Scheduler(alloc, SchedulerConfig(
+            max_num_seqs=4, max_prefill_chunk=8, **cfg)), alloc
+
+    def test_chunked_prefill_then_decode(self):
+        sched, _ = self.make()
+        sched.add_request(make_req(range(1, 13), "a"))  # 12 tokens, chunk=8
+        p1 = sched.schedule()
+        assert isinstance(p1, PrefillChunk) and p1.length == 8 and not p1.is_last
+        sched.on_step_done(p1)
+        p2 = sched.schedule()
+        assert isinstance(p2, PrefillChunk) and p2.length == 4 and p2.is_last
+        sched.on_step_done(p2)
+        seq = p2.seq
+        assert seq.phase == Phase.RUNNING
+        seq.tokens.append(99)  # engine appends sampled token
+        seq.generated.append(99)
+        d = sched.schedule()
+        assert isinstance(d, DecodeBatch) and d.seqs == [seq]
+
+    def test_prefill_decode_alternation(self):
+        sched, _ = self.make()
+        sched.add_request(make_req(range(1, 5), "a"))
+        p = sched.schedule()
+        sched.on_step_done(p)
+        p.seq.tokens.append(9); p.seq.generated.append(9)
+        sched.add_request(make_req(range(1, 5), "b"))
+        kinds = []
+        for _ in range(2):
+            plan = sched.schedule()
+            kinds.append(type(plan))
+            sched.on_step_done(plan)
+            if isinstance(plan, PrefillChunk) and plan.is_last:
+                plan.seq.tokens.append(9); plan.seq.generated.append(9)
+        assert set(kinds) == {PrefillChunk, DecodeBatch}
+
+    def test_prefix_reuse_on_second_request(self):
+        sched, alloc = self.make()
+        prompt = list(range(1, 13))
+        sched.add_request(make_req(prompt, "a"))
+        plan = sched.schedule()
+        sched.on_step_done(plan)
+        plan = sched.schedule()
+        sched.on_step_done(plan)
+        sched.finish(plan.seq)  # releases pages -> LRU with 3 committed blocks
+        sched.add_request(make_req(prompt, "b"))
+        plan = sched.schedule()
+        assert isinstance(plan, PrefillChunk)
+        # 12 tokens = 3 blocks cached, but at least 1 token must recompute:
+        # usable cached = 8 tokens (2 full pages)
+        assert plan.seq.cached_tokens == 8
+        assert plan.start == 8 and plan.length == 4
+
+    def test_preemption_on_page_pressure(self):
+        sched, alloc = self.make(num_pages=6, page_size=4)  # 5 usable pages
+        # two seqs, distinct 7-token prompts (no prefix sharing): 2 pages each
+        sched.add_request(make_req(range(1, 8), "a", max_tokens=16))
+        sched.add_request(make_req(range(11, 18), "b", max_tokens=16))
+        # drive until both are running at 8 tokens (page boundary)
+        for _ in range(8):
+            if (len(sched.active) == 2 and
+                    all(s.phase == Phase.RUNNING and len(s) == 8
+                        for s in sched.active.values())):
+                break
+            plan = sched.schedule()
+            assert plan is not None
+            sched.on_step_done(plan)
+            if isinstance(plan, PrefillChunk) and plan.is_last:
+                plan.seq.tokens.append(9)
+                plan.seq.generated.append(9)
+        # decode once at len 8 (page 1 still has room), reaching len 9
+        plan = sched.schedule()
+        assert isinstance(plan, DecodeBatch) and len(plan.seqs) == 2
+        sched.on_step_done(plan)
+        for s in plan.seqs:
+            s.tokens.append(9)
+            s.generated.append(9)
+        # next decode: each needs a 3rd page but only 1 is free -> the
+        # newest sequence is preempted back to waiting
+        plan = sched.schedule()
+        assert isinstance(plan, DecodeBatch)
+        assert len(plan.seqs) == 1
+        assert plan.seqs[0].request.request_id == "a"
+        assert sched.num_preemptions == 1
+        assert len(sched.waiting) == 1
+
+    def test_metrics_shape(self):
+        sched, _ = self.make()
+        m = sched.metrics()
+        assert m.worker_stats.request_total_slots == 4
+        assert m.kv_stats.kv_total_blocks == 16
+
+
+# ------------------------------------------------------------------ engine
+
+def tiny_engine(**kw):
+    cfg = ModelConfig.tiny()
+    defaults = dict(num_pages=64, page_size=4, max_num_seqs=4,
+                    max_prefill_chunk=16, max_context=64,
+                    min_prefill_bucket=4)
+    defaults.update(kw)
+    return JaxEngine.random_init(cfg, JaxEngineConfig(**defaults))
+
+
+async def collect(engine, req):
+    frames = []
+    async for out in engine.generate(req):
+        frames.append(out)
+    return frames
+
+
+class TestJaxEngine:
+    async def test_generates_max_tokens(self):
+        eng = tiny_engine()
+        try:
+            req = make_req([1, 2, 3, 4, 5], "r1", max_tokens=6)
+            req.eos_token_ids = []  # random weights may emit any token
+            frames = await collect(eng, req)
+            toks = [t for f in frames for t in f.token_ids]
+            assert len(toks) == 6
+            final = frames[-1]
+            assert final.finish_reason == FinishReason.LENGTH
+            assert final.prompt_tokens == 5
+            assert final.completion_tokens == 6
+        finally:
+            await eng.stop()
+
+    async def test_greedy_determinism_and_prefix_cache(self):
+        eng = tiny_engine()
+        try:
+            req1 = make_req(list(range(1, 10)), "r1", max_tokens=5)
+            req1.eos_token_ids = []
+            f1 = await collect(eng, req1)
+            req2 = make_req(list(range(1, 10)), "r2", max_tokens=5)
+            req2.eos_token_ids = []
+            f2 = await collect(eng, req2)
+            t1 = [t for f in f1 for t in f.token_ids]
+            t2 = [t for f in f2 for t in f.token_ids]
+            assert t1 == t2  # greedy => identical
+            assert f2[-1].cached_tokens == 8  # 9-token prompt, 2 full pages
+        finally:
+            await eng.stop()
+
+    async def test_concurrent_requests(self):
+        eng = tiny_engine()
+        try:
+            reqs = []
+            for i in range(4):
+                r = make_req([i + 1, i + 2, i + 3, i + 4], f"c{i}", max_tokens=4)
+                r.eos_token_ids = []
+                reqs.append(r)
+            results = await asyncio.gather(*[collect(eng, r) for r in reqs])
+            for frames in results:
+                toks = [t for f in frames for t in f.token_ids]
+                assert len(toks) == 4
+        finally:
+            await eng.stop()
+
+    async def test_stop_token(self):
+        eng = tiny_engine()
+        try:
+            # discover greedy first token, then stop on it
+            probe = make_req([5, 6, 7], "p", max_tokens=1)
+            probe.eos_token_ids = []
+            first = (await collect(eng, probe))[-1].token_ids
+            req = make_req([5, 6, 7], "s", max_tokens=8)
+            req.eos_token_ids = []
+            req.stop_conditions.stop_token_ids = first
+            frames = await collect(eng, req)
+            assert frames[-1].finish_reason == FinishReason.STOP
+            assert frames[-1].completion_tokens == 1
+        finally:
+            await eng.stop()
+
+    async def test_oversized_prompt_fails_cleanly(self):
+        eng = tiny_engine()
+        try:
+            req = make_req(list(range(100)), "big")
+            frames = await collect(eng, req)
+            assert frames[-1].finish_reason == FinishReason.ERROR
+        finally:
+            await eng.stop()
+
+    async def test_kv_events_published(self):
+        eng = tiny_engine()
+        events = []
+        eng.kv_event_cb = events.extend
+        try:
+            req = make_req(list(range(1, 10)), "e", max_tokens=4)
+            req.eos_token_ids = []
+            await collect(eng, req)
+            stored = [b for e in events for b in e.stored_blocks]
+            assert stored  # prompt blocks were committed and published
+        finally:
+            await eng.stop()
+
+    async def test_cancel_mid_stream_and_while_waiting(self):
+        class Ctx:
+            cancelled = False
+
+        eng = tiny_engine()
+        try:
+            ctx = Ctx()
+            req = make_req([1, 2, 3], "cx", max_tokens=1000)
+            req.eos_token_ids = []
+            frames = []
+            async for out in eng.generate(req, ctx=ctx):
+                frames.append(out)
+                ctx.cancelled = True  # cancel after the first frame
+            assert frames[-1].finish_reason == FinishReason.CANCELLED
+
+            # cancel while still WAITING (queue head blocked is hard to force;
+            # cancelling before the loop picks it up exercises the reap path)
+            ctx2 = Ctx()
+            ctx2.cancelled = True
+            req2 = make_req([4, 5, 6], "cw", max_tokens=1000)
+            req2.eos_token_ids = []
+            frames2 = [f async for f in eng.generate(req2, ctx=ctx2)]
+            assert frames2[-1].finish_reason == FinishReason.CANCELLED
+        finally:
+            await eng.stop()
+
+    async def test_preemption_resume_correctness(self):
+        """A preempted sequence must resume and produce the same greedy
+        tokens it would have produced without contention."""
+        solo = tiny_engine()
+        try:
+            ref = make_req(list(range(11, 18)), "solo", max_tokens=9)
+            ref.eos_token_ids = []
+            want = [t for f in await collect(solo, ref) for t in f.token_ids]
+        finally:
+            await solo.stop()
+
+        # 7 usable pages; each request eventually needs 4 -> contention
+        eng = tiny_engine(num_pages=8, max_context=32)
+        try:
+            a = make_req(list(range(1, 8)), "a", max_tokens=9)
+            b = make_req(list(range(11, 18)), "b", max_tokens=9)
+            a.eos_token_ids = []
+            b.eos_token_ids = []
+            ra, rb = await asyncio.gather(collect(eng, a), collect(eng, b))
+            for frames in (ra, rb):
+                toks = [t for f in frames for t in f.token_ids]
+                assert len(toks) == 9
+                assert frames[-1].finish_reason == FinishReason.LENGTH
+            got = [t for f in rb for t in f.token_ids]
+            assert got == want
+        finally:
+            await eng.stop()
+
+    async def test_engine_stats(self):
+        eng = tiny_engine()
+        try:
+            m = eng.stats()
+            assert m.kv_stats.kv_total_blocks == 63
+        finally:
+            await eng.stop()
