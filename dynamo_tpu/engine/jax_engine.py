@@ -72,6 +72,10 @@ class JaxEngineConfig:
     max_prefill_chunk: int = 512  # longest single prefill step
     max_context: int = 2048       # max prompt+generation length
     min_prefill_bucket: int = 16
+    # floor for the padded decode batch: raising it to max_num_seqs gives ONE
+    # compiled decode shape (fewer compiles, steadier step time); leaving it
+    # at 1 compiles each power-of-two batch as load ramps
+    min_decode_bucket: int = 1
     seed: int = 0
     # mesh/sharding hooks (filled by dynamo_tpu.parallel when multi-chip)
     shard_params_fn: Optional[Callable] = None
@@ -159,7 +163,8 @@ class JaxEngine(EngineBase):
                              np.float32)
         else:
             seqs = plan.seqs
-            B = _bucket(len(seqs), 1, self.cfg.max_num_seqs)
+            B = _bucket(len(seqs), self.cfg.min_decode_bucket,
+                        self.cfg.max_num_seqs)
             toks = np.zeros((B, 1), np.int32)
             pos = np.zeros((B, 1), np.int32)
             table = np.zeros((B, P), np.int32)

@@ -1,0 +1,17 @@
+"""Parallelism: device meshes, model shardings, sequence/context parallelism.
+
+The reference delegates every intra-engine parallelism strategy to vLLM/
+SGLang/TRT-LLM flags over NCCL (SURVEY §2.7); here they are native jax:
+
+- ``mesh.py`` — the named device mesh (axes ``dp``/``tp``/``sp``/``ep``) and
+  helpers to build it from local or multi-host device sets.
+- ``sharding.py`` — GSPMD shardings for the Llama-family param pytree and the
+  paged KV cache: annotate once, let XLA insert the ICI collectives.
+- ``ring_attention.py`` — sequence/context parallelism (net-new vs the
+  reference, which has none — SURVEY §5).
+"""
+
+from dynamo_tpu.parallel.mesh import MeshSpec, make_mesh
+from dynamo_tpu.parallel.sharding import ModelSharding, tp_sharding
+
+__all__ = ["MeshSpec", "make_mesh", "ModelSharding", "tp_sharding"]

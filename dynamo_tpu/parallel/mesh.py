@@ -1,0 +1,70 @@
+"""Named device meshes for TPU slices.
+
+Axis convention (orthogonal, any subset may be 1):
+
+- ``dp``  — data parallel: independent model replicas (batch-sharded).
+- ``tp``  — tensor parallel: attention heads / MLP columns over ICI.
+- ``sp``  — sequence/context parallel: ring-attention over the sequence axis.
+- ``ep``  — expert parallel: MoE experts over chips.
+
+The reference exposes these only as engine flags (``--tensor-parallel-size``,
+``--ep-num-redundant-experts`` …, SURVEY §2.7); here the mesh is the single
+source of truth and every sharding is expressed against its axis names, so
+XLA lays collectives onto ICI links.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXES = ("dp", "tp", "sp", "ep")
+
+
+@dataclass(frozen=True)
+class MeshSpec:
+    """Logical mesh shape; unspecified axes default to 1."""
+
+    dp: int = 1
+    tp: int = 1
+    sp: int = 1
+    ep: int = 1
+
+    @property
+    def size(self) -> int:
+        return self.dp * self.tp * self.sp * self.ep
+
+    def shape(self) -> Dict[str, int]:
+        return {"dp": self.dp, "tp": self.tp, "sp": self.sp, "ep": self.ep}
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, int]) -> "MeshSpec":
+        unknown = set(d) - set(AXES)
+        if unknown:
+            raise ValueError(f"unknown mesh axes {sorted(unknown)}")
+        return cls(**{k: int(v) for k, v in d.items()})
+
+
+def make_mesh(spec: Optional[MeshSpec] = None,
+              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build a ``jax.sharding.Mesh`` with the canonical axis order.
+
+    Axis order is (dp, tp, sp, ep) — innermost axes get the
+    fastest-varying device dimension, which on a TPU slice means ``tp``/``sp``
+    neighbors sit on adjacent ICI links (jax device order is torus-major).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    spec = spec or MeshSpec(tp=len(devices))
+    if spec.size != len(devices):
+        raise ValueError(
+            f"mesh spec {spec.shape()} needs {spec.size} devices, "
+            f"have {len(devices)}")
+    arr = np.array(devices).reshape(spec.dp, spec.tp, spec.sp, spec.ep)
+    return Mesh(arr, AXES)
+
+
+__all__ = ["MeshSpec", "make_mesh", "AXES"]

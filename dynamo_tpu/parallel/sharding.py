@@ -1,0 +1,114 @@
+"""GSPMD shardings for the Llama-family pytree and the paged KV cache.
+
+Tensor parallelism the XLA way (reference: ``--tensor-parallel-size`` handed
+to vLLM's NCCL Megatron kernels, SURVEY §2.7): annotate the weight shardings,
+keep activations replicated-per-``dp``-shard, and let the partitioner insert
+the two all-reduces per layer (after attention out-proj and after mlp
+down-proj) on ICI.
+
+Layout (params carry a leading ``L`` layer axis from the ``lax.scan`` stack):
+
+- ``wq/wk/wv`` ``[L, H, out]``  — shard ``out`` (head) dim over ``tp``
+- ``wo``       ``[L, q, H]``    — shard ``q`` (head) dim over ``tp``
+- ``w_gate/w_up`` ``[L, H, I]`` — shard ``I`` over ``tp``
+- ``w_down``   ``[L, I, H]``    — shard ``I`` over ``tp``
+- ``embed``    ``[V, H]``       — replicated (all-gather-free lookup)
+- ``lm_head``  ``[H, V]``       — shard ``V`` over ``tp`` (logits sharded,
+  top-k/sampling runs fine on sharded logits)
+- KV pages ``[L, 2, N, page, Hkv, Dh]`` — shard ``Hkv`` over ``tp``; each
+  chip holds its own heads' cache, so paged writes/gathers are chip-local.
+
+``num_kv_heads`` must be divisible by ``tp`` (e.g. Llama-3-8B: 8 KV heads →
+tp ∈ {1,2,4,8}); for tp > Hkv one would replicate KV heads — rejected for
+now with a clear error.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.parallel.mesh import MeshSpec, make_mesh
+
+
+class ModelSharding:
+    """Sharding specs bound to a mesh for one model configuration."""
+
+    def __init__(self, cfg: ModelConfig, mesh: Mesh):
+        self.cfg = cfg
+        self.mesh = mesh
+        tp = mesh.shape.get("tp", 1)
+        if tp > 1:
+            if cfg.num_kv_heads % tp:
+                raise ValueError(
+                    f"num_kv_heads={cfg.num_kv_heads} not divisible by tp={tp}")
+            if cfg.intermediate_size % tp:
+                raise ValueError(
+                    f"intermediate_size={cfg.intermediate_size} not divisible "
+                    f"by tp={tp}")
+
+    # -- specs -------------------------------------------------------------
+
+    def param_specs(self) -> Dict[str, Any]:
+        layers = {
+            "attn_norm": P(),
+            "wq": P(None, None, "tp"),
+            "wk": P(None, None, "tp"),
+            "wv": P(None, None, "tp"),
+            "wo": P(None, "tp", None),
+            "mlp_norm": P(),
+            "w_gate": P(None, None, "tp"),
+            "w_up": P(None, None, "tp"),
+            "w_down": P(None, "tp", None),
+        }
+        if self.cfg.attention_bias:
+            layers.update(bq=P(None, "tp"), bk=P(None, "tp"), bv=P(None, "tp"))
+        if self.cfg.qk_norm:
+            layers.update(q_norm=P(), k_norm=P())
+        specs: Dict[str, Any] = {
+            "embed": P(),
+            "layers": layers,
+            "final_norm": P(),
+        }
+        if not self.cfg.tie_word_embeddings:
+            specs["lm_head"] = P(None, "tp")
+        return specs
+
+    def pages_spec(self) -> P:
+        return P(None, None, None, None, "tp", None)
+
+    # -- application -------------------------------------------------------
+
+    def _named(self, spec: P) -> NamedSharding:
+        return NamedSharding(self.mesh, spec)
+
+    def shard_params(self, params: Dict[str, Any]) -> Dict[str, Any]:
+        specs = self.param_specs()
+
+        def place(path, leaf):
+            node = specs
+            for k in path:
+                node = node[k.key]
+            return jax.device_put(leaf, self._named(node))
+
+        return jax.tree_util.tree_map_with_path(place, params)
+
+    def shard_pages(self, pages: jax.Array) -> jax.Array:
+        return jax.device_put(pages, self._named(self.pages_spec()))
+
+    def replicate(self, x):
+        return jax.device_put(x, self._named(P()))
+
+
+def tp_sharding(cfg: ModelConfig, tp_size: int,
+                devices: Optional[list] = None) -> ModelSharding:
+    """Pure tensor-parallel sharding over the first ``tp_size`` devices."""
+    devs = list(devices if devices is not None else jax.devices())[:tp_size]
+    mesh = make_mesh(MeshSpec(tp=tp_size), devices=devs)
+    return ModelSharding(cfg, mesh)
+
+
+__all__ = ["ModelSharding", "tp_sharding"]
