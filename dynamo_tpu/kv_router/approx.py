@@ -1,0 +1,60 @@
+"""Predictive (event-free) prefix index.
+
+Parity: reference ``lib/llm/src/kv_router/approx.rs`` (``ApproxKvIndexer``) —
+for engines that publish no KV events, predict cache contents purely from this
+router's own decisions: when a request is routed to a worker, assume its
+prompt blocks are cached there for ``ttl`` seconds.
+
+Same ``find_matches`` interface as ``KvIndexer`` so the scheduler/router are
+agnostic. Expiry is lazy (pruned on lookup) plus a bounded sweep to stop
+unbounded growth under skewed traffic.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Tuple
+
+DEFAULT_TTL_S = 120.0
+
+
+class ApproxKvIndexer:
+    def __init__(self, block_size: int, ttl: float = DEFAULT_TTL_S):
+        self.block_size = block_size
+        self.ttl = ttl
+        # (worker, block_hash) -> expiry monotonic time
+        self._expiry: Dict[Tuple[int, int], float] = {}
+
+    def record_routing(self, worker: int, block_hashes: List[int]) -> None:
+        exp = time.monotonic() + self.ttl
+        for h in block_hashes:
+            self._expiry[(worker, h)] = exp
+
+    def remove_worker(self, worker: int) -> None:
+        for key in [k for k in self._expiry if k[0] == worker]:
+            del self._expiry[key]
+
+    def _sweep(self, now: float) -> None:
+        if len(self._expiry) < 65536:
+            return
+        for key in [k for k, t in self._expiry.items() if t <= now]:
+            del self._expiry[key]
+
+    def find_matches(self, block_hashes: List[int]) -> Dict[int, int]:
+        now = time.monotonic()
+        self._sweep(now)
+        workers = {w for (w, _h) in self._expiry}
+        overlaps: Dict[int, int] = {}
+        for w in workers:
+            n = 0
+            for h in block_hashes:
+                t = self._expiry.get((w, h))
+                if t is None or t <= now:
+                    break
+                n += 1
+            if n:
+                overlaps[w] = n
+        return overlaps
+
+
+__all__ = ["ApproxKvIndexer", "DEFAULT_TTL_S"]

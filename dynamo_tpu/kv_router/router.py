@@ -1,0 +1,183 @@
+"""KvPushRouter: the pipeline-facing KV-aware router.
+
+Parity: reference ``lib/llm/src/kv_router/kv_router.rs`` (``KvRouter`` +
+``KvPushRouter``): hash the tokenized prompt, match against the global index,
+pick a worker via the scheduler, stamp ``estimated_prefix_hit_num_blocks``,
+``direct()`` the request to that worker, then track decoded blocks via
+``push``/``free``; plus the event/metrics feedback loops
+(``kv_router.rs:178-201``, ``metrics_aggregator.rs``) and the
+``KVHitRateEvent`` emission (``scheduler.rs:36-40``).
+
+Feedback planes:
+- KV events: subscribes ``{ns}.{component}.kv_events`` (what workers publish
+  via ``dynamo_tpu.worker.main``) into the ``KvIndexer``; with
+  ``use_kv_events=False`` an ``ApproxKvIndexer`` predicts instead.
+- Load metrics: periodic ``component.scrape_stats()`` (the ``__stats__``
+  builtin every served endpoint answers) parsed as ``ForwardPassMetrics``.
+- Instance liveness: workers that leave the client's instance set are pruned
+  from the index and scheduler.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any, AsyncIterator, Dict, List, Optional, Tuple
+
+from dynamo_tpu.kv_router.approx import ApproxKvIndexer
+from dynamo_tpu.kv_router.indexer import KvIndexer
+from dynamo_tpu.kv_router.scheduler import KvScheduler, WorkerSelector
+from dynamo_tpu.model_card import ModelDeploymentCard
+from dynamo_tpu.protocols.events import (
+    ForwardPassMetrics,
+    KVHitRateEvent,
+    RouterEvent,
+)
+from dynamo_tpu.runtime.push_router import PushRouter, RouterMode
+from dynamo_tpu.tokens import compute_block_hash_for_seq
+from dynamo_tpu.utils.aio import reap_task
+
+logger = logging.getLogger(__name__)
+
+
+def kv_events_subject(namespace: str, component: str) -> str:
+    return f"{namespace}.{component}.kv_events"
+
+
+def kv_hit_rate_subject(namespace: str, component: str) -> str:
+    return f"{namespace}.{component}.kv_hit_rate"
+
+
+class KvPushRouter:
+    """Drop-in for PushRouter with KV-aware placement."""
+
+    def __init__(self, drt, client, card: ModelDeploymentCard,
+                 overlap_score_weight: float = 1.0,
+                 temperature: float = 0.0,
+                 use_kv_events: bool = True,
+                 stats_interval: float = 1.0,
+                 selector: Optional[WorkerSelector] = None):
+        self.drt = drt
+        self.client = client
+        self.block_size = card.kv_cache_block_size
+        self.use_kv_events = use_kv_events
+        self.stats_interval = stats_interval
+        self.indexer = (KvIndexer(self.block_size) if use_kv_events
+                        else ApproxKvIndexer(self.block_size))
+        self.scheduler = KvScheduler(
+            self.block_size, overlap_score_weight=overlap_score_weight,
+            temperature=temperature, selector=selector)
+        self.inner = PushRouter(client, RouterMode.DIRECT)
+        self._namespace = client.endpoint.namespace
+        self._component = client.endpoint.component
+        self._event_sub = None
+        self._event_task: Optional[asyncio.Task] = None
+        self._stats_task: Optional[asyncio.Task] = None
+
+    @classmethod
+    async def create(cls, drt, client, card: ModelDeploymentCard,
+                     **kwargs) -> "KvPushRouter":
+        self = cls(drt, client, card, **kwargs)
+        if self.use_kv_events:
+            self._event_sub = await drt.subscribe_events(
+                kv_events_subject(self._namespace, self._component))
+            self._event_task = asyncio.create_task(self._event_loop())
+        self._stats_task = asyncio.create_task(self._stats_loop())
+        return self
+
+    async def close(self) -> None:
+        await reap_task(self._event_task)
+        await reap_task(self._stats_task)
+        if self._event_sub is not None:
+            try:
+                await self._event_sub.cancel()
+            except Exception:
+                pass
+        await self.client.close()
+
+    # -- feedback loops ----------------------------------------------------
+
+    async def _event_loop(self) -> None:
+        async for _subject, payload in self._event_sub:
+            try:
+                self.indexer.apply_event(RouterEvent.from_dict(payload))
+            except Exception:
+                logger.exception("bad kv event %r", payload)
+
+    async def _stats_loop(self) -> None:
+        component = (self.drt.namespace(self._namespace)
+                     .component(self._component))
+        while True:
+            try:
+                scraped = await component.scrape_stats()
+                metrics: Dict[int, ForwardPassMetrics] = {}
+                ep_path = self.client.endpoint.path
+                for iid, stats in scraped.items():
+                    # response is keyed by endpoint rpc name (see
+                    # rpc.py __stats__): {path: {requests, active, data}}
+                    ep_stats = stats.get(ep_path) if isinstance(stats, dict) else None
+                    data = ep_stats.get("data") if isinstance(ep_stats, dict) else None
+                    if data:
+                        metrics[iid] = ForwardPassMetrics.from_dict(data)
+                self.scheduler.update_metrics(metrics)
+                live = set(self.client.instance_ids())
+                for wid in [w for w in self._known_workers() if w not in live]:
+                    self.indexer.remove_worker(wid)
+                    self.scheduler.remove_worker(wid)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.exception("stats scrape failed")
+            await asyncio.sleep(self.stats_interval)
+
+    def _known_workers(self) -> List[int]:
+        if isinstance(self.indexer, KvIndexer):
+            return self.indexer.workers()
+        return []
+
+    # -- routing -----------------------------------------------------------
+
+    def find_best_match(self, token_ids: List[int]) -> Tuple[int, int]:
+        """(worker_id, overlap_blocks) for a prompt — the routing decision
+        without routing (parity: ``query_instance_id`` annotation,
+        ``kv_router.rs:331-337``)."""
+        hashes = compute_block_hash_for_seq(token_ids, self.block_size)
+        overlaps = self.indexer.find_matches(hashes)
+        return self.scheduler.select(self.client.instance_ids(), overlaps,
+                                     len(hashes))
+
+    async def generate_stream(self, payload: Dict[str, Any],
+                              instance_id: Optional[int] = None,
+                              headers: Optional[Dict[str, Any]] = None
+                              ) -> AsyncIterator[Any]:
+        token_ids = payload.get("token_ids") or []
+        rid = payload.get("request_id") or f"kv-{id(payload):x}"
+        hashes = compute_block_hash_for_seq(token_ids, self.block_size)
+        if instance_id is None:
+            overlaps = self.indexer.find_matches(hashes)
+            worker, overlap = self.scheduler.select(
+                self.client.instance_ids(), overlaps, len(hashes))
+        else:
+            worker, overlap = instance_id, 0
+        payload = dict(payload)
+        payload["estimated_prefix_hit_num_blocks"] = overlap
+        if isinstance(self.indexer, ApproxKvIndexer):
+            self.indexer.record_routing(worker, hashes)
+        self.scheduler.begin(rid, worker, len(hashes), overlap)
+        self.drt.runtime.spawn(self.drt.publish_event(
+            kv_hit_rate_subject(self._namespace, self._component),
+            KVHitRateEvent(worker_id=worker, isl_blocks=len(hashes),
+                           overlap_blocks=overlap).to_dict()),
+            name="kv-hit-rate")
+        try:
+            async for item in self.inner.generate_stream(
+                    payload, instance_id=worker, headers=headers):
+                ntok = len(item.get("token_ids") or []) if isinstance(item, dict) else 0
+                if ntok:
+                    self.scheduler.push(rid, ntok)
+                yield item
+        finally:
+            self.scheduler.free(rid)
+
+
+__all__ = ["KvPushRouter", "kv_events_subject", "kv_hit_rate_subject"]

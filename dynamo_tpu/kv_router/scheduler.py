@@ -1,0 +1,138 @@
+"""Worker selection: cost model over prefix overlap and predicted load.
+
+Parity: reference ``lib/llm/src/kv_router/{scheduler,scoring,sequence}.rs`` —
+``DefaultWorkerSelector`` cost ``logit = overlap_weight *
+potential_prefill_blocks + potential_decode_blocks`` with softmax-temperature
+sampling, fed by (a) scraped ``ForwardPassMetrics`` and (b) the scheduler's
+own per-worker prediction of active decode blocks (``ActiveSequences``). Here
+both live in one object; the per-worker sharded threads of the reference are
+unnecessary (this runs in the frontend's event loop).
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import random
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+from dynamo_tpu.protocols.events import ForwardPassMetrics
+
+logger = logging.getLogger(__name__)
+
+# A selector maps (candidate ids, overlaps, isl_blocks, scheduler) to a
+# worker id — pluggable like the reference's WorkerSelector trait
+# (kv_router.rs:55-62).
+WorkerSelector = Callable[[List[int], Dict[int, int], int, "KvScheduler"], int]
+
+
+@dataclass
+class _ActiveSeq:
+    worker: int
+    blocks: int          # predicted blocks attributable to this request
+    partial_tokens: int  # decode tokens since the last block boundary
+
+
+@dataclass
+class _WorkerState:
+    active_blocks: int = 0
+    metrics: Optional[ForwardPassMetrics] = None
+
+
+class KvScheduler:
+    """Predicts per-worker load and picks the cheapest worker."""
+
+    def __init__(self, block_size: int, overlap_score_weight: float = 1.0,
+                 temperature: float = 0.0,
+                 selector: Optional[WorkerSelector] = None):
+        self.block_size = block_size
+        self.overlap_score_weight = overlap_score_weight
+        self.temperature = temperature
+        self.selector = selector
+        self._workers: Dict[int, _WorkerState] = {}
+        self._seqs: Dict[str, _ActiveSeq] = {}
+
+    # -- load inputs -------------------------------------------------------
+
+    def update_metrics(self, metrics: Dict[int, ForwardPassMetrics]) -> None:
+        for wid, m in metrics.items():
+            self._workers.setdefault(wid, _WorkerState()).metrics = m
+        for wid in [w for w in self._workers if w not in metrics]:
+            # keep predicted state; scraped metrics just went stale
+            self._workers[wid].metrics = None
+
+    def remove_worker(self, worker: int) -> None:
+        self._workers.pop(worker, None)
+        for rid in [r for r, s in self._seqs.items() if s.worker == worker]:
+            del self._seqs[rid]
+
+    # -- active-sequence prediction ---------------------------------------
+
+    def begin(self, request_id: str, worker: int, isl_blocks: int,
+              overlap_blocks: int) -> None:
+        """Record a routing decision: the worker will hold the prompt's
+        blocks (new prefill allocations + revived prefix)."""
+        st = self._workers.setdefault(worker, _WorkerState())
+        st.active_blocks += isl_blocks
+        self._seqs[request_id] = _ActiveSeq(worker=worker, blocks=isl_blocks,
+                                            partial_tokens=0)
+
+    def push(self, request_id: str, n_tokens: int) -> None:
+        """Account decoded tokens; every block_size tokens adds a block."""
+        seq = self._seqs.get(request_id)
+        if seq is None:
+            return
+        seq.partial_tokens += n_tokens
+        new_blocks, seq.partial_tokens = divmod(seq.partial_tokens,
+                                                self.block_size)
+        if new_blocks:
+            seq.blocks += new_blocks
+            st = self._workers.get(seq.worker)
+            if st is not None:
+                st.active_blocks += new_blocks
+
+    def free(self, request_id: str) -> None:
+        seq = self._seqs.pop(request_id, None)
+        if seq is None:
+            return
+        st = self._workers.get(seq.worker)
+        if st is not None:
+            st.active_blocks = max(0, st.active_blocks - seq.blocks)
+
+    # -- selection ---------------------------------------------------------
+
+    def cost(self, worker: int, overlap_blocks: int, isl_blocks: int) -> float:
+        st = self._workers.setdefault(worker, _WorkerState())
+        potential_prefill = max(0, isl_blocks - overlap_blocks)
+        potential_decode = st.active_blocks
+        if st.metrics is not None:
+            # blend in the worker's own view: waiting requests mean queued
+            # prefill work this prediction can't see
+            potential_decode += st.metrics.worker_stats.num_requests_waiting
+        return (self.overlap_score_weight * potential_prefill
+                + potential_decode)
+
+    def select(self, candidates: List[int], overlaps: Dict[int, int],
+               isl_blocks: int) -> Tuple[int, int]:
+        """Pick a worker; returns (worker_id, its overlap blocks)."""
+        if not candidates:
+            raise ConnectionError("no workers available for KV routing")
+        if self.selector is not None:
+            chosen = self.selector(candidates, overlaps, isl_blocks, self)
+            return chosen, overlaps.get(chosen, 0)
+        costs = [self.cost(w, overlaps.get(w, 0), isl_blocks)
+                 for w in candidates]
+        if self.temperature <= 0.0:
+            best = min(costs)
+            chosen = random.choice(
+                [w for w, c in zip(candidates, costs) if c == best])
+        else:
+            # softmax over negative cost (cheaper => likelier)
+            lo = min(costs)
+            weights = [math.exp(-(c - lo) / self.temperature) for c in costs]
+            chosen = random.choices(candidates, weights=weights, k=1)[0]
+        return chosen, overlaps.get(chosen, 0)
+
+
+__all__ = ["KvScheduler", "WorkerSelector"]

@@ -1,0 +1,258 @@
+"""KV router tests: indexer semantics, scheduler cost model, and the full
+routing feedback loop against two live engine-backed workers in-process.
+
+Model: reference router tests (``lib/llm/src/kv_router/*`` inline tests and
+``tests/router/test_router_e2e_with_mockers.py``) — here the e2e uses two
+real ``JaxEngine`` workers on the tiny model, whose allocators emit real KV
+events.
+"""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.engine.jax_engine import JaxEngine, JaxEngineConfig
+from dynamo_tpu.kv_router import ApproxKvIndexer, KvIndexer, KvPushRouter, KvScheduler
+from dynamo_tpu.kv_router.router import kv_events_subject
+from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.protocols.common import (
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.protocols.events import (
+    KvCacheEvent,
+    KvCacheStoredBlock,
+    RouterEvent,
+)
+from dynamo_tpu.llm.register import register_llm, serve_engine
+from dynamo_tpu.runtime.runtime import DistributedRuntime
+from dynamo_tpu.tokens import compute_block_hash_for_seq
+from dynamo_tpu.utils.testing import make_test_card
+
+
+def stored(worker, event_id, hashes, parent=None):
+    return RouterEvent(worker_id=worker, event=KvCacheEvent(
+        event_id=event_id,
+        stored_blocks=[KvCacheStoredBlock(block_hash=h, tokens_hash=h)
+                       for h in hashes],
+        stored_parent_hash=parent))
+
+
+def removed(worker, event_id, hashes):
+    return RouterEvent(worker_id=worker, event=KvCacheEvent(
+        event_id=event_id, removed_block_hashes=list(hashes)))
+
+
+class TestKvIndexer:
+    def test_consecutive_prefix_matching(self):
+        idx = KvIndexer(block_size=4)
+        idx.apply_event(stored(1, 0, [10, 11, 12]))
+        idx.apply_event(stored(2, 0, [10, 12]))  # holds 10 but not 11
+        m = idx.find_matches([10, 11, 12, 13])
+        assert m == {1: 3, 2: 1}  # worker 2 can't extend past missing 11
+
+    def test_removal_breaks_runs(self):
+        idx = KvIndexer(block_size=4)
+        idx.apply_event(stored(1, 0, [10, 11, 12]))
+        idx.apply_event(removed(1, 1, [11]))
+        assert idx.find_matches([10, 11, 12]) == {1: 1}
+
+    def test_clear_and_worker_removal(self):
+        idx = KvIndexer(block_size=4)
+        idx.apply_event(stored(1, 0, [10, 11]))
+        idx.apply_event(RouterEvent(worker_id=1, event=KvCacheEvent(
+            event_id=1, all_blocks_cleared=True)))
+        assert idx.find_matches([10, 11]) == {}
+        idx.apply_event(stored(2, 0, [10]))
+        idx.remove_worker(2)
+        assert idx.find_matches([10]) == {}
+        assert idx.num_blocks() == 0
+
+    def test_unknown_block_stops_walk(self):
+        idx = KvIndexer(block_size=4)
+        idx.apply_event(stored(1, 0, [10, 12]))
+        # block 11 unknown globally: nobody can match past it
+        assert idx.find_matches([10, 11, 12]) == {1: 1}
+
+
+class TestApproxIndexer:
+    def test_record_and_expire(self):
+        idx = ApproxKvIndexer(block_size=4, ttl=1000.0)
+        idx.record_routing(7, [1, 2, 3])
+        assert idx.find_matches([1, 2, 3, 4]) == {7: 3}
+        idx2 = ApproxKvIndexer(block_size=4, ttl=-1.0)  # instantly stale
+        idx2.record_routing(7, [1, 2])
+        assert idx2.find_matches([1, 2]) == {}
+
+
+class TestKvScheduler:
+    def test_prefers_overlap(self):
+        s = KvScheduler(block_size=4, overlap_score_weight=1.0)
+        w, ov = s.select([1, 2], {1: 5}, isl_blocks=8)
+        assert (w, ov) == (1, 5)
+
+    def test_prefers_idle_on_tie(self):
+        s = KvScheduler(block_size=4)
+        s.begin("r1", 1, isl_blocks=10, overlap_blocks=0)
+        w, _ = s.select([1, 2], {}, isl_blocks=4)
+        assert w == 2  # worker 1 carries 10 active blocks
+
+    def test_push_free_accounting(self):
+        s = KvScheduler(block_size=4)
+        s.begin("r1", 1, isl_blocks=2, overlap_blocks=0)
+        s.push("r1", 9)  # 2 full blocks + 1 partial
+        assert s._workers[1].active_blocks == 4
+        s.free("r1")
+        assert s._workers[1].active_blocks == 0
+
+    def test_overlap_weight_tradeoff(self):
+        # high overlap weight: prefer cache hit despite load
+        s = KvScheduler(block_size=4, overlap_score_weight=10.0)
+        s.begin("busy", 1, isl_blocks=20, overlap_blocks=0)
+        w, _ = s.select([1, 2], {1: 8}, isl_blocks=8)
+        assert w == 1
+
+    def test_custom_selector(self):
+        s = KvScheduler(block_size=4, selector=lambda c, o, i, sch: c[-1])
+        w, _ = s.select([1, 2, 3], {}, 4)
+        assert w == 3
+
+
+def tiny_engine_cfg():
+    return JaxEngineConfig(num_pages=128, page_size=4, max_num_seqs=4,
+                           max_prefill_chunk=16, max_context=128,
+                           min_prefill_bucket=4)
+
+
+async def start_worker(coordinator, name):
+    """One engine-backed worker with KV event publishing (as worker.main does)."""
+    drt = await DistributedRuntime.create(coordinator=coordinator)
+    engine = JaxEngine.random_init(ModelConfig.tiny(), tiny_engine_cfg())
+    card = make_test_card(name=name, kv_cache_block_size=4)
+    endpoint = drt.namespace("ns").component("tpu").endpoint("generate")
+    lease = await drt.primary_lease()
+    subject = kv_events_subject("ns", "tpu")
+
+    def publish(events):
+        async def _send():
+            for ev in events:
+                await drt.publish_event(
+                    subject, RouterEvent(worker_id=lease.lease_id,
+                                         event=ev).to_dict())
+        asyncio.get_running_loop().create_task(_send())
+
+    engine.kv_event_cb = publish
+    await serve_engine(endpoint, engine,
+                       stats_provider=lambda: engine.stats().to_dict())
+    await register_llm(drt, endpoint, card)
+    return drt, engine, lease.lease_id
+
+
+def make_req(tokens, rid, max_tokens=4):
+    return PreprocessedRequest(
+        token_ids=list(tokens), request_id=rid,
+        stop_conditions=StopConditions(max_tokens=max_tokens),
+        sampling_options=SamplingOptions(temperature=0.0))
+
+
+class TestKvRoutingE2E:
+    async def test_prefix_affinity_via_events(self):
+        from dynamo_tpu.runtime.coordinator import Coordinator
+        coord = await Coordinator(port=0).start()
+        drts = []
+        try:
+            w1, e1, id1 = await start_worker(coord.address, "m")
+            w2, e2, id2 = await start_worker(coord.address, "m")
+            drts += [w1, w2]
+
+            frontend = await DistributedRuntime.create(coordinator=coord.address)
+            drts.append(frontend)
+            endpoint = (frontend.namespace("ns").component("tpu")
+                        .endpoint("generate"))
+            client = await endpoint.client()
+            await client.wait_for_instances(2, timeout=10)
+            card = make_test_card(name="m", kv_cache_block_size=4)
+            router = await KvPushRouter.create(
+                frontend, client, card, stats_interval=0.2)
+
+            prompt = list(range(1, 18))  # 17 tokens -> 4 complete blocks
+            req = make_req(prompt, "r1").to_dict()
+            frames = [f async for f in router.generate_stream(req)]
+            assert any(f.get("finish_reason") for f in frames)
+
+            # wait for the worker's stored events to reach the indexer
+            for _ in range(50):
+                if router.indexer.find_matches(
+                        compute_block_hash_for_seq(prompt, 4)):
+                    break
+                await asyncio.sleep(0.1)
+            hashes = compute_block_hash_for_seq(prompt, 4)
+            overlaps = router.indexer.find_matches(hashes)
+            assert len(overlaps) == 1
+            first_worker = next(iter(overlaps))
+            assert overlaps[first_worker] >= 4  # prompt blocks published
+
+            # the same prompt must now route to the same worker, with the
+            # prefix-hit estimate stamped on the request
+            worker, overlap = router.find_best_match(prompt)
+            assert worker == first_worker
+            assert overlap >= 4
+
+            # with the first worker carrying active load, a distinct prompt
+            # must land on the other (idle) worker
+            router.scheduler.begin("busy", first_worker, isl_blocks=10,
+                                   overlap_blocks=0)
+            other = list(range(100, 117))
+            worker2, overlap2 = router.find_best_match(other)
+            assert worker2 != first_worker
+            assert overlap2 == 0
+            router.scheduler.free("busy")
+
+            await router.close()
+        finally:
+            for d in drts:
+                await d.close()
+            await coord.stop()
+
+    async def test_stats_scrape_feeds_scheduler(self):
+        from dynamo_tpu.runtime.coordinator import Coordinator
+        coord = await Coordinator(port=0).start()
+        drts = []
+        try:
+            w1, e1, id1 = await start_worker(coord.address, "m")
+            drts.append(w1)
+            frontend = await DistributedRuntime.create(coordinator=coord.address)
+            drts.append(frontend)
+            endpoint = (frontend.namespace("ns").component("tpu")
+                        .endpoint("generate"))
+            client = await endpoint.client()
+            await client.wait_for_instances(1, timeout=10)
+            card = make_test_card(name="m", kv_cache_block_size=4)
+            router = await KvPushRouter.create(
+                frontend, client, card, stats_interval=0.1)
+            for _ in range(50):
+                if self_metrics := router.scheduler._workers.get(id1):
+                    if self_metrics.metrics is not None:
+                        break
+                await asyncio.sleep(0.1)
+            st = router.scheduler._workers[id1]
+            assert st.metrics is not None
+            assert st.metrics.kv_stats.kv_total_blocks == 127
+            await router.close()
+        finally:
+            for d in drts:
+                await d.close()
+            await coord.stop()
+
+
+class TestRecorder:
+    def test_record_replay(self, tmp_path):
+        from dynamo_tpu.kv_router import KvRecorder, replay
+        p = str(tmp_path / "events.jsonl")
+        with KvRecorder(p) as rec:
+            rec.record(stored(1, 0, [10, 11]))
+            rec.record(removed(1, 1, [11]))
+        idx = KvIndexer(block_size=4)
+        assert replay(p, idx) == 2
+        assert idx.find_matches([10, 11]) == {1: 1}
