@@ -13,10 +13,9 @@ the framework and designed for XLA:
 - Sampling runs on device in the same program as the forward pass
   (``ops/sampling.sample_tokens``): one host round-trip per step (the sampled
   token ids), nothing else.
-- The asyncio step loop runs jitted calls in a worker thread
-  (``asyncio.to_thread``) so request intake / streaming stays responsive while
-  the device is busy; host-side bookkeeping (stop conditions, block hashing,
-  event emission) overlaps the next dispatch.
+- The asyncio step loop (``engine/loop.py``) runs jitted calls in a worker
+  thread so request intake / streaming stays responsive while the device is
+  busy; host-side bookkeeping overlaps the next dispatch.
 
 Capability parity: the role of vLLM's ``AsyncLLM`` behind the reference's
 worker handlers (``components/backends/vllm/src/dynamo/vllm/handlers.py``),
@@ -26,40 +25,21 @@ load-metric publication.
 
 from __future__ import annotations
 
-import asyncio
 import logging
-from dataclasses import dataclass, field
-from functools import partial
-from typing import AsyncIterator, Callable, Dict, List, Optional
+from dataclasses import dataclass
+from typing import Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from dynamo_tpu.engine.base import EngineBase
-from dynamo_tpu.engine.pages import PageAllocator
-from dynamo_tpu.engine.scheduler import (
-    DecodeBatch,
-    Phase,
-    PrefillChunk,
-    Scheduler,
-    SchedulerConfig,
-    Sequence,
-    StepPlan,
-)
+from dynamo_tpu.engine.loop import ScheduledEngineBase
+from dynamo_tpu.engine.scheduler import PrefillChunk, StepPlan
 from dynamo_tpu.models.config import ModelConfig
 from dynamo_tpu.models import llama
 from dynamo_tpu.ops.sampling import sample_tokens
-from dynamo_tpu.protocols.common import (
-    FinishReason,
-    LLMEngineOutput,
-    PreprocessedRequest,
-)
-from dynamo_tpu.protocols.events import ForwardPassMetrics, KvCacheEvent
 
 logger = logging.getLogger(__name__)
-
-_SENTINEL_FINISHED = object()
 
 
 @dataclass
@@ -89,7 +69,7 @@ def _bucket(n: int, lo: int, hi: int) -> int:
     return min(b, hi)
 
 
-class JaxEngine(EngineBase):
+class JaxEngine(ScheduledEngineBase):
     """Continuous-batching paged-KV engine over a jax Llama-family model."""
 
     def __init__(self, model_cfg: ModelConfig, params,
@@ -97,15 +77,13 @@ class JaxEngine(EngineBase):
                  forward_fn: Callable = llama.forward):
         self.model_cfg = model_cfg
         self.cfg = config or JaxEngineConfig()
-        if self.cfg.max_context % self.cfg.page_size:
-            raise ValueError("max_context must be a multiple of page_size")
-        self.params = params
-        self._forward = forward_fn
-        self.allocator = PageAllocator(self.cfg.num_pages, self.cfg.page_size)
-        self.scheduler = Scheduler(self.allocator, SchedulerConfig(
+        super().__init__(
+            num_pages=self.cfg.num_pages, page_size=self.cfg.page_size,
             max_num_seqs=self.cfg.max_num_seqs,
             max_prefill_chunk=self.cfg.max_prefill_chunk,
-        ))
+            max_context=self.cfg.max_context)
+        self.params = params
+        self._forward = forward_fn
         self.pages = llama.make_pages(model_cfg, self.cfg.num_pages,
                                       self.cfg.page_size)
         if self.cfg.shard_params_fn is not None:
@@ -115,13 +93,7 @@ class JaxEngine(EngineBase):
         self.table_width = self.cfg.max_context // self.cfg.page_size
         self._rng = jax.random.PRNGKey(self.cfg.seed)
         self._step_counter = 0
-        self._queues: Dict[str, asyncio.Queue] = {}
-        self._work = asyncio.Event()
-        self._loop_task: Optional[asyncio.Task] = None
-        self._stopping = False
-        self.kv_event_cb: Optional[Callable[[List[KvCacheEvent]], None]] = None
-        self._jit_step = jax.jit(
-            self._step_impl, static_argnames=(), donate_argnums=(1,))
+        self._jit_step = jax.jit(self._step_impl, donate_argnums=(1,))
 
     # -- compiled step -----------------------------------------------------
 
@@ -136,11 +108,8 @@ class JaxEngine(EngineBase):
 
     # -- plan -> device arrays --------------------------------------------
 
-    def _run_plan(self, plan: StepPlan):
-        """Build padded arrays, run the jitted step, fetch sampled tokens.
-
-        Runs in a worker thread; touches no scheduler state.
-        """
+    def _execute_plan(self, plan: StepPlan) -> Tuple[np.ndarray, np.ndarray]:
+        """Build padded arrays, run the jitted step, fetch sampled tokens."""
         P = self.table_width
         if isinstance(plan, PrefillChunk):
             seq = plan.seq
@@ -193,184 +162,6 @@ class JaxEngine(EngineBase):
             jnp.asarray(top_k), jnp.asarray(top_p))
         self._step_counter += 1
         return np.asarray(sampled), np.asarray(logprobs)
-
-    # -- host-side token processing ---------------------------------------
-
-    def _emit(self, seq: Sequence, out: LLMEngineOutput) -> None:
-        q = self._queues.get(seq.request.request_id)
-        if q is not None:
-            q.put_nowait(out)
-
-    def _finish(self, seq: Sequence, reason: FinishReason,
-                token: Optional[int] = None,
-                logprob: Optional[float] = None) -> None:
-        self.scheduler.finish(seq)
-        self._emit(seq, LLMEngineOutput(
-            token_ids=[token] if token is not None else [],
-            log_probs=[logprob] if logprob is not None else None,
-            finish_reason=reason,
-            prompt_tokens=seq.num_prompt,
-            completion_tokens=len(seq.generated),
-            cached_tokens=seq.cached_tokens,
-        ))
-
-    def _accept_token(self, seq: Sequence, token: int, logprob: float) -> None:
-        """Append a sampled token and resolve stop conditions."""
-        req = seq.request
-        sc = req.stop_conditions
-        seq.tokens.append(token)
-        seq.generated.append(token)
-        n = len(seq.generated)
-        min_ok = sc.min_tokens is None or n >= sc.min_tokens
-        if (not sc.ignore_eos and min_ok and token in req.eos_token_ids):
-            self._finish(seq, FinishReason.EOS, token, logprob)
-            return
-        if min_ok and sc.stop_token_ids and token in sc.stop_token_ids:
-            self._finish(seq, FinishReason.STOP, token, logprob)
-            return
-        max_new = sc.max_tokens if sc.max_tokens is not None else (
-            self.cfg.max_context - seq.num_prompt)
-        if n >= max_new or len(seq) >= self.cfg.max_context:
-            self._finish(seq, FinishReason.LENGTH, token, logprob)
-            return
-        self._emit(seq, LLMEngineOutput(token_ids=[token],
-                                        log_probs=[logprob]))
-
-    def _process(self, plan: StepPlan, sampled: np.ndarray,
-                 logprobs: np.ndarray) -> None:
-        self.scheduler.on_step_done(plan)
-        if isinstance(plan, PrefillChunk):
-            seq = plan.seq
-            if seq.cancelled:
-                self._finish(seq, FinishReason.CANCELLED)
-            elif plan.is_last:
-                if seq.request.prefill_only:
-                    # disagg prefill worker: one token, KV stays cached
-                    tok = int(sampled[0])
-                    seq.tokens.append(tok)
-                    seq.generated.append(tok)
-                    self._finish(seq, FinishReason.LENGTH, tok,
-                                 float(logprobs[0]))
-                else:
-                    self._accept_token(seq, int(sampled[0]), float(logprobs[0]))
-        else:
-            for i, seq in enumerate(plan.seqs):
-                if seq.phase is not Phase.RUNNING:
-                    continue  # finished/preempted during this step
-                if seq.cancelled:
-                    self._finish(seq, FinishReason.CANCELLED)
-                    continue
-                self._accept_token(seq, int(sampled[i]), float(logprobs[i]))
-        # always drain (unbounded growth otherwise); publish if anyone listens
-        events = self.allocator.drain_events()
-        if events and self.kv_event_cb is not None:
-            self.kv_event_cb(events)
-
-    # -- the engine loop ---------------------------------------------------
-
-    def _drain_reaped(self) -> None:
-        for seq in self.scheduler.drain_reaped():
-            self._emit(seq, LLMEngineOutput(finish_reason=FinishReason.CANCELLED,
-                                            prompt_tokens=seq.num_prompt,
-                                            completion_tokens=len(seq.generated)))
-
-    async def _loop(self) -> None:
-        while not self._stopping:
-            plan = self.scheduler.schedule()
-            self._drain_reaped()
-            if plan is None:
-                self._work.clear()
-                if self.scheduler.waiting:
-                    if not self.scheduler.active:
-                        # nothing running and the head request still cannot be
-                        # admitted: it can never fit — fail it
-                        seq = self.scheduler.waiting.popleft()
-                        self._emit(seq, LLMEngineOutput(
-                            finish_reason=FinishReason.ERROR,
-                            error="request cannot fit in KV cache"))
-                        continue
-                    # cache full; yield to let running streams drain, retry
-                    await asyncio.sleep(0.005)
-                    continue
-                await self._work.wait()
-                continue
-            try:
-                sampled, logprobs = await asyncio.to_thread(self._run_plan, plan)
-            except Exception as e:  # noqa: BLE001 — engine must not die silently
-                logger.exception("engine step failed")
-                victims = (plan.seqs if isinstance(plan, DecodeBatch)
-                           else [plan.seq])
-                for seq in victims:
-                    self.scheduler.finish(seq)
-                    self._emit(seq, LLMEngineOutput(
-                        finish_reason=FinishReason.ERROR, error=str(e)))
-                continue
-            self._process(plan, sampled, logprobs)
-
-    async def start(self) -> None:
-        if self._loop_task is None:
-            self._stopping = False
-            self._loop_task = asyncio.ensure_future(self._loop())
-
-    async def stop(self) -> None:
-        self._stopping = True
-        self._work.set()
-        if self._loop_task is not None:
-            self._loop_task.cancel()
-            try:
-                await self._loop_task
-            except (asyncio.CancelledError, Exception):  # noqa: BLE001
-                pass
-            self._loop_task = None
-
-    # -- public API --------------------------------------------------------
-
-    async def generate(self, request: PreprocessedRequest,
-                       ctx=None) -> AsyncIterator[LLMEngineOutput]:
-        await self.start()
-        rid = request.request_id or f"req-{id(request):x}"
-        request.request_id = rid
-        if len(request.token_ids) >= self.cfg.max_context:
-            yield LLMEngineOutput(
-                finish_reason=FinishReason.ERROR,
-                error=(f"prompt of {len(request.token_ids)} tokens exceeds "
-                       f"max context {self.cfg.max_context}"))
-            return
-        q: asyncio.Queue = asyncio.Queue()
-        self._queues[rid] = q
-        try:
-            try:
-                self.scheduler.add_request(request)
-            except RuntimeError as e:
-                yield LLMEngineOutput(finish_reason=FinishReason.ERROR,
-                                      error=str(e))
-                return
-            self._work.set()
-            while True:
-                cancelled = (ctx is not None
-                             and getattr(ctx, "cancelled", False))
-                if cancelled:
-                    self.scheduler.cancel(rid)
-                    self._work.set()
-                if ctx is None:
-                    out = await q.get()
-                else:
-                    # poll the context so a cancel set while we're blocked
-                    # still terminates the stream
-                    try:
-                        out = await asyncio.wait_for(q.get(), timeout=0.05)
-                    except asyncio.TimeoutError:
-                        continue
-                yield out
-                if out.finish_reason is not None:
-                    return
-        finally:
-            self.scheduler.cancel(rid)
-            self._queues.pop(rid, None)
-            self._work.set()
-
-    def stats(self) -> ForwardPassMetrics:
-        return self.scheduler.metrics()
 
     @classmethod
     def random_init(cls, model_cfg: ModelConfig,

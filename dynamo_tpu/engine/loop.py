@@ -1,0 +1,248 @@
+"""Shared continuous-batching engine loop.
+
+Everything between the scheduler and the caller-facing ``generate`` stream is
+execution-agnostic: admission, the step loop, stop conditions, cancellation,
+KV-event draining, metrics. ``ScheduledEngineBase`` owns all of that;
+subclasses provide only ``_execute_plan`` — the actual compute for one step:
+
+- ``JaxEngine`` (``jax_engine.py``): jit-compiled model step on TPU.
+- ``MockerEngine`` (``dynamo_tpu.mocker``): timing model, no compute —
+  identical scheduling/KV/event behavior at zero cost (the reference's rust
+  mocker plays this role, ``lib/llm/src/mocker/``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import AsyncIterator, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from dynamo_tpu.engine.base import EngineBase
+from dynamo_tpu.engine.pages import PageAllocator
+from dynamo_tpu.engine.scheduler import (
+    DecodeBatch,
+    Phase,
+    PrefillChunk,
+    Scheduler,
+    SchedulerConfig,
+    Sequence,
+    StepPlan,
+)
+from dynamo_tpu.protocols.common import (
+    FinishReason,
+    LLMEngineOutput,
+    PreprocessedRequest,
+)
+from dynamo_tpu.protocols.events import ForwardPassMetrics, KvCacheEvent
+
+logger = logging.getLogger(__name__)
+
+
+class ScheduledEngineBase(EngineBase):
+    """Continuous batching over a PageAllocator; subclasses do the math."""
+
+    def __init__(self, num_pages: int, page_size: int, max_num_seqs: int,
+                 max_prefill_chunk: int, max_context: int):
+        if max_context % page_size:
+            raise ValueError("max_context must be a multiple of page_size")
+        self.max_context = max_context
+        self.allocator = PageAllocator(num_pages, page_size)
+        self.scheduler = Scheduler(self.allocator, SchedulerConfig(
+            max_num_seqs=max_num_seqs, max_prefill_chunk=max_prefill_chunk))
+        self._queues: Dict[str, asyncio.Queue] = {}
+        self._work = asyncio.Event()
+        self._loop_task: Optional[asyncio.Task] = None
+        self._stopping = False
+        self.kv_event_cb: Optional[Callable[[List[KvCacheEvent]], None]] = None
+
+    # -- subclass hook -----------------------------------------------------
+
+    def _execute_plan(self, plan: StepPlan) -> Tuple[np.ndarray, np.ndarray]:
+        """Run one step; returns (sampled_tokens, logprobs) aligned with the
+        plan (prefill: length-1 arrays; decode: one entry per plan.seqs).
+        Runs in a worker thread — must not touch scheduler state."""
+        raise NotImplementedError
+
+    # -- frame emission ----------------------------------------------------
+
+    def _emit(self, seq: Sequence, out: LLMEngineOutput) -> None:
+        q = self._queues.get(seq.request.request_id)
+        if q is not None:
+            q.put_nowait(out)
+
+    def _finish(self, seq: Sequence, reason: FinishReason,
+                token: Optional[int] = None,
+                logprob: Optional[float] = None) -> None:
+        self.scheduler.finish(seq)
+        self._emit(seq, LLMEngineOutput(
+            token_ids=[token] if token is not None else [],
+            log_probs=[logprob] if logprob is not None else None,
+            finish_reason=reason,
+            prompt_tokens=seq.num_prompt,
+            completion_tokens=len(seq.generated),
+            cached_tokens=seq.cached_tokens,
+        ))
+
+    def _accept_token(self, seq: Sequence, token: int, logprob: float) -> None:
+        """Append a sampled token and resolve stop conditions."""
+        req = seq.request
+        sc = req.stop_conditions
+        seq.tokens.append(token)
+        seq.generated.append(token)
+        n = len(seq.generated)
+        min_ok = sc.min_tokens is None or n >= sc.min_tokens
+        if (not sc.ignore_eos and min_ok and token in req.eos_token_ids):
+            self._finish(seq, FinishReason.EOS, token, logprob)
+            return
+        if min_ok and sc.stop_token_ids and token in sc.stop_token_ids:
+            self._finish(seq, FinishReason.STOP, token, logprob)
+            return
+        max_new = sc.max_tokens if sc.max_tokens is not None else (
+            self.max_context - seq.num_prompt)
+        if n >= max_new or len(seq) >= self.max_context:
+            self._finish(seq, FinishReason.LENGTH, token, logprob)
+            return
+        self._emit(seq, LLMEngineOutput(token_ids=[token],
+                                        log_probs=[logprob]))
+
+    def _process(self, plan: StepPlan, sampled: np.ndarray,
+                 logprobs: np.ndarray) -> None:
+        self.scheduler.on_step_done(plan)
+        if isinstance(plan, PrefillChunk):
+            seq = plan.seq
+            if seq.cancelled:
+                self._finish(seq, FinishReason.CANCELLED)
+            elif plan.is_last:
+                if seq.request.prefill_only:
+                    # disagg prefill worker: one token, KV stays cached
+                    tok = int(sampled[0])
+                    seq.tokens.append(tok)
+                    seq.generated.append(tok)
+                    self._finish(seq, FinishReason.LENGTH, tok,
+                                 float(logprobs[0]))
+                else:
+                    self._accept_token(seq, int(sampled[0]), float(logprobs[0]))
+        else:
+            for i, seq in enumerate(plan.seqs):
+                if seq.phase is not Phase.RUNNING:
+                    continue  # finished/preempted during this step
+                if seq.cancelled:
+                    self._finish(seq, FinishReason.CANCELLED)
+                    continue
+                self._accept_token(seq, int(sampled[i]), float(logprobs[i]))
+        # always drain (unbounded growth otherwise); publish if anyone listens
+        events = self.allocator.drain_events()
+        if events and self.kv_event_cb is not None:
+            self.kv_event_cb(events)
+
+    # -- the engine loop ---------------------------------------------------
+
+    def _drain_reaped(self) -> None:
+        for seq in self.scheduler.drain_reaped():
+            self._emit(seq, LLMEngineOutput(finish_reason=FinishReason.CANCELLED,
+                                            prompt_tokens=seq.num_prompt,
+                                            completion_tokens=len(seq.generated)))
+
+    async def _loop(self) -> None:
+        while not self._stopping:
+            plan = self.scheduler.schedule()
+            self._drain_reaped()
+            if plan is None:
+                self._work.clear()
+                if self.scheduler.waiting:
+                    if not self.scheduler.active:
+                        # nothing running and the head request still cannot be
+                        # admitted: it can never fit — fail it
+                        seq = self.scheduler.waiting.popleft()
+                        self._emit(seq, LLMEngineOutput(
+                            finish_reason=FinishReason.ERROR,
+                            error="request cannot fit in KV cache"))
+                        continue
+                    # cache full; yield to let running streams drain, retry
+                    await asyncio.sleep(0.005)
+                    continue
+                await self._work.wait()
+                continue
+            try:
+                sampled, logprobs = await asyncio.to_thread(
+                    self._execute_plan, plan)
+            except Exception as e:  # noqa: BLE001 — engine must not die silently
+                logger.exception("engine step failed")
+                victims = (plan.seqs if isinstance(plan, DecodeBatch)
+                           else [plan.seq])
+                for seq in victims:
+                    self.scheduler.finish(seq)
+                    self._emit(seq, LLMEngineOutput(
+                        finish_reason=FinishReason.ERROR, error=str(e)))
+                continue
+            self._process(plan, sampled, logprobs)
+
+    async def start(self) -> None:
+        if self._loop_task is None:
+            self._stopping = False
+            self._loop_task = asyncio.ensure_future(self._loop())
+
+    async def stop(self) -> None:
+        self._stopping = True
+        self._work.set()
+        if self._loop_task is not None:
+            self._loop_task.cancel()
+            try:
+                await self._loop_task
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+            self._loop_task = None
+
+    # -- public API --------------------------------------------------------
+
+    async def generate(self, request: PreprocessedRequest,
+                       ctx=None) -> AsyncIterator[LLMEngineOutput]:
+        await self.start()
+        rid = request.request_id or f"req-{id(request):x}"
+        request.request_id = rid
+        if len(request.token_ids) >= self.max_context:
+            yield LLMEngineOutput(
+                finish_reason=FinishReason.ERROR,
+                error=(f"prompt of {len(request.token_ids)} tokens exceeds "
+                       f"max context {self.max_context}"))
+            return
+        q: asyncio.Queue = asyncio.Queue()
+        self._queues[rid] = q
+        try:
+            try:
+                self.scheduler.add_request(request)
+            except RuntimeError as e:
+                yield LLMEngineOutput(finish_reason=FinishReason.ERROR,
+                                      error=str(e))
+                return
+            self._work.set()
+            while True:
+                cancelled = (ctx is not None
+                             and getattr(ctx, "cancelled", False))
+                if cancelled:
+                    self.scheduler.cancel(rid)
+                    self._work.set()
+                if ctx is None:
+                    out = await q.get()
+                else:
+                    # poll the context so a cancel set while we're blocked
+                    # still terminates the stream
+                    try:
+                        out = await asyncio.wait_for(q.get(), timeout=0.05)
+                    except asyncio.TimeoutError:
+                        continue
+                yield out
+                if out.finish_reason is not None:
+                    return
+        finally:
+            self.scheduler.cancel(rid)
+            self._queues.pop(rid, None)
+            self._work.set()
+
+    def stats(self) -> ForwardPassMetrics:
+        return self.scheduler.metrics()
+
+
+__all__ = ["ScheduledEngineBase"]

@@ -1,0 +1,94 @@
+"""The mock engine: production scheduling + a calibrated timing model.
+
+Cost model (defaults approximate one v5e chip serving an 8B model, scaled by
+``speedup_ratio`` like the reference's ``MockEngineArgs.speedup_ratio``):
+
+- prefill chunk of ``n`` tokens against ``c`` cached tokens:
+  ``base + n * per_prefill_token + n * c * attn_quadratic`` — the quadratic
+  term models attention against the growing context, which is what makes
+  chunked prefill of long prompts progressively slower (the reference's
+  prefill-cost model serves the same purpose, ``mocker/scheduler.rs``).
+- decode step over a batch of ``b`` sequences: ``base + b * per_decode_token``.
+
+Tokens are sampled deterministically from the request id (stable across
+migrations/retries) unless the request carries nonzero temperature, in which
+case they are pseudo-random.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import time
+from dataclasses import dataclass
+from typing import Tuple
+
+import numpy as np
+
+from dynamo_tpu.engine.loop import ScheduledEngineBase
+from dynamo_tpu.engine.scheduler import PrefillChunk, StepPlan
+
+
+@dataclass
+class MockEngineArgs:
+    """Parity: reference ``mocker/protocols.rs:80-142`` ``MockEngineArgs``."""
+
+    num_pages: int = 512           # reference: num_gpu_blocks
+    page_size: int = 16            # reference: block_size
+    max_num_seqs: int = 64
+    max_prefill_chunk: int = 512
+    max_context: int = 4096
+    speedup_ratio: float = 1.0     # >1 = faster than real time
+    vocab_size: int = 32000
+    # timing model (seconds)
+    prefill_base_s: float = 0.004
+    prefill_per_token_s: float = 25e-6
+    prefill_attn_quadratic_s: float = 3e-9
+    decode_base_s: float = 0.006
+    decode_per_seq_s: float = 120e-6
+    dp_size: int = 1               # metadata only (reported in stats)
+
+
+class MockerEngine(ScheduledEngineBase):
+    def __init__(self, args: MockEngineArgs = None):
+        self.args = args or MockEngineArgs()
+        a = self.args
+        super().__init__(num_pages=a.num_pages, page_size=a.page_size,
+                         max_num_seqs=a.max_num_seqs,
+                         max_prefill_chunk=a.max_prefill_chunk,
+                         max_context=a.max_context)
+        self._rng = np.random.default_rng(0)
+
+    def _simulate(self, seconds: float) -> None:
+        if self.args.speedup_ratio > 0:
+            time.sleep(seconds / self.args.speedup_ratio)
+
+    def _token_for(self, request_id: str, position: int,
+                   temperature: float) -> int:
+        if temperature and temperature > 0:
+            return int(self._rng.integers(1, self.args.vocab_size))
+        digest = hashlib.blake2b(f"{request_id}:{position}".encode(),
+                                 digest_size=4).digest()
+        return int.from_bytes(digest, "little") % self.args.vocab_size
+
+    def _execute_plan(self, plan: StepPlan) -> Tuple[np.ndarray, np.ndarray]:
+        a = self.args
+        if isinstance(plan, PrefillChunk):
+            n, cached = plan.length, plan.start
+            self._simulate(a.prefill_base_s + n * a.prefill_per_token_s
+                           + n * cached * a.prefill_attn_quadratic_s)
+            seq = plan.seq
+            so = seq.request.sampling_options
+            tok = self._token_for(seq.request.request_id, len(seq),
+                                  so.temperature or 0.0)
+            return np.array([tok]), np.array([-1.0], np.float32)
+        b = len(plan.seqs)
+        self._simulate(a.decode_base_s + b * a.decode_per_seq_s)
+        toks = np.empty(b, np.int64)
+        for i, seq in enumerate(plan.seqs):
+            so = seq.request.sampling_options
+            toks[i] = self._token_for(seq.request.request_id, len(seq),
+                                      so.temperature or 0.0)
+        return toks, np.full(b, -1.0, np.float32)
+
+
+__all__ = ["MockerEngine", "MockEngineArgs"]

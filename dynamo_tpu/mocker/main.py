@@ -1,0 +1,91 @@
+"""Mocker worker process: serve a simulated engine behind the runtime.
+
+Parity: reference ``components/backends/mocker/src/dynamo/mocker/main.py`` —
+full distributed-stack testing (router, planner, fault tolerance) with no
+accelerator: real registration, real KV events, real metrics, simulated
+timing.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+
+from dynamo_tpu.llm.register import register_llm, serve_engine
+from dynamo_tpu.mocker.engine import MockEngineArgs, MockerEngine
+from dynamo_tpu.model_card import ModelDeploymentCard
+from dynamo_tpu.protocols.events import RouterEvent
+from dynamo_tpu.runtime.runtime import DEFAULT_COORDINATOR, DistributedRuntime
+from dynamo_tpu.utils.logging import configure_logging
+from dynamo_tpu.worker.main import kv_events_subject
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description="dynamo_tpu mocker worker")
+    p.add_argument("--coordinator", default=DEFAULT_COORDINATOR)
+    p.add_argument("--namespace", default="dynamo")
+    p.add_argument("--component", default="mocker")
+    p.add_argument("--endpoint", default="generate")
+    p.add_argument("--model-name", default="mock-model")
+    p.add_argument("--model-path", default=None,
+                   help="optional HF dir for a real tokenizer/card")
+    p.add_argument("--num-pages", type=int, default=512)
+    p.add_argument("--page-size", type=int, default=16)
+    p.add_argument("--max-num-seqs", type=int, default=64)
+    p.add_argument("--max-context", type=int, default=4096)
+    p.add_argument("--speedup-ratio", type=float, default=1.0)
+    p.add_argument("--no-kv-events", action="store_true")
+    return p
+
+
+async def amain(args: argparse.Namespace) -> None:
+    drt = await DistributedRuntime.create(coordinator=args.coordinator)
+    if args.model_path:
+        card = ModelDeploymentCard.from_local_path(args.model_path,
+                                                   name=args.model_name)
+    else:
+        from dynamo_tpu.utils.testing import make_test_card
+        card = make_test_card(name=args.model_name,
+                              kv_cache_block_size=args.page_size)
+    card.kv_cache_block_size = args.page_size
+    engine = MockerEngine(MockEngineArgs(
+        num_pages=args.num_pages, page_size=args.page_size,
+        max_num_seqs=args.max_num_seqs, max_context=args.max_context,
+        speedup_ratio=args.speedup_ratio))
+    endpoint = (drt.namespace(args.namespace).component(args.component)
+                .endpoint(args.endpoint))
+    if not args.no_kv_events:
+        lease = await drt.primary_lease()
+        subject = kv_events_subject(args.namespace, args.component)
+
+        def publish(events):
+            async def _send():
+                for ev in events:
+                    await drt.publish_event(
+                        subject, RouterEvent(worker_id=lease.lease_id,
+                                             event=ev).to_dict())
+            asyncio.get_running_loop().create_task(_send())
+
+        engine.kv_event_cb = publish
+    await serve_engine(endpoint, engine,
+                       stats_provider=lambda: engine.stats().to_dict())
+    await register_llm(drt, endpoint, card)
+    print(f"mocker worker serving model {card.name}", flush=True)
+    try:
+        await drt.runtime.wait_shutdown()
+    finally:
+        await engine.stop()
+        await drt.close()
+
+
+def main() -> None:
+    args = build_parser().parse_args()
+    configure_logging()
+    try:
+        asyncio.run(amain(args))
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,195 @@
+"""Mocker engine tests + the full-stack router e2e with mockers.
+
+The e2e is the port of the reference's signature no-GPU distributed test
+(``tests/router/test_router_e2e_with_mockers.py:26-90``): N mocker workers +
+KV router + OpenAI HTTP frontend, asserting KV-routing prefix affinity from
+the outside.
+"""
+
+import asyncio
+import json
+import time
+
+import aiohttp
+import pytest
+
+from dynamo_tpu.http.service import HttpService
+from dynamo_tpu.llm.model_manager import ModelManager, ModelWatcher
+from dynamo_tpu.llm.register import register_llm, serve_engine
+from dynamo_tpu.mocker import MockEngineArgs, MockerEngine
+from dynamo_tpu.protocols.common import (
+    FinishReason,
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.protocols.events import RouterEvent
+from dynamo_tpu.kv_router.router import kv_events_subject
+from dynamo_tpu.runtime.push_router import RouterMode
+from dynamo_tpu.runtime.runtime import DistributedRuntime
+from dynamo_tpu.utils.testing import make_test_card
+
+
+def make_req(tokens, rid, max_tokens=8, temperature=0.0):
+    return PreprocessedRequest(
+        token_ids=list(tokens), request_id=rid,
+        stop_conditions=StopConditions(max_tokens=max_tokens),
+        sampling_options=SamplingOptions(temperature=temperature))
+
+
+def fast_args(**kw):
+    defaults = dict(num_pages=64, page_size=4, max_num_seqs=8,
+                    max_prefill_chunk=16, max_context=256,
+                    speedup_ratio=1000.0)
+    defaults.update(kw)
+    return MockEngineArgs(**defaults)
+
+
+async def collect(engine, req):
+    return [f async for f in engine.generate(req)]
+
+
+class TestMockerEngine:
+    async def test_deterministic_greedy_tokens(self):
+        e1 = MockerEngine(fast_args())
+        e2 = MockerEngine(fast_args())
+        try:
+            f1 = await collect(e1, make_req(range(1, 10), "same-id"))
+            f2 = await collect(e2, make_req(range(1, 10), "same-id"))
+            t1 = [t for f in f1 for t in f.token_ids]
+            t2 = [t for f in f2 for t in f.token_ids]
+            assert t1 == t2 and len(t1) == 8
+        finally:
+            await e1.stop()
+            await e2.stop()
+
+    async def test_emits_kv_events_and_metrics(self):
+        eng = MockerEngine(fast_args())
+        events = []
+        eng.kv_event_cb = events.extend
+        try:
+            await collect(eng, make_req(range(1, 14), "e"))
+            assert any(e.stored_blocks for e in events)
+            m = eng.stats()
+            assert m.kv_stats.kv_total_blocks == 63
+        finally:
+            await eng.stop()
+
+    async def test_speedup_ratio_scales_time(self):
+        slow = MockerEngine(fast_args(speedup_ratio=1.0,
+                                      decode_base_s=0.01,
+                                      prefill_base_s=0.01))
+        fast = MockerEngine(fast_args(speedup_ratio=100.0,
+                                      decode_base_s=0.01,
+                                      prefill_base_s=0.01))
+        try:
+            t0 = time.perf_counter()
+            await collect(slow, make_req(range(1, 6), "s", max_tokens=5))
+            slow_t = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            await collect(fast, make_req(range(1, 6), "f", max_tokens=5))
+            fast_t = time.perf_counter() - t0
+            assert slow_t > fast_t * 3
+        finally:
+            await slow.stop()
+            await fast.stop()
+
+    async def test_concurrent_load(self):
+        eng = MockerEngine(fast_args())
+        try:
+            results = await asyncio.gather(*[
+                collect(eng, make_req(range(i, i + 8), f"c{i}", max_tokens=6))
+                for i in range(8)])
+            for frames in results:
+                assert frames[-1].finish_reason == FinishReason.LENGTH
+        finally:
+            await eng.stop()
+
+
+async def start_mock_worker(coordinator, name="mock-model"):
+    drt = await DistributedRuntime.create(coordinator=coordinator)
+    engine = MockerEngine(fast_args())
+    card = make_test_card(name=name, kv_cache_block_size=4)
+    endpoint = drt.namespace("dynamo").component("mocker").endpoint("generate")
+    lease = await drt.primary_lease()
+    subject = kv_events_subject("dynamo", "mocker")
+
+    def publish(events):
+        async def _send():
+            for ev in events:
+                await drt.publish_event(
+                    subject, RouterEvent(worker_id=lease.lease_id,
+                                         event=ev).to_dict())
+        asyncio.get_running_loop().create_task(_send())
+
+    engine.kv_event_cb = publish
+    await serve_engine(endpoint, engine,
+                       stats_provider=lambda: engine.stats().to_dict())
+    await register_llm(drt, endpoint, card)
+    return drt, engine
+
+
+class TestRouterE2EWithMockers:
+    async def test_full_stack_kv_routing(self):
+        """Frontend HTTP + KV router + 2 mocker workers, driven over HTTP."""
+        from dynamo_tpu.runtime.coordinator import Coordinator
+        coord = await Coordinator(port=0).start()
+        drts, engines, service, watcher = [], [], None, None
+        try:
+            for _ in range(2):
+                drt, eng = await start_mock_worker(coord.address)
+                drts.append(drt)
+                engines.append(eng)
+            frontend = await DistributedRuntime.create(coordinator=coord.address)
+            drts.append(frontend)
+            manager = ModelManager()
+            watcher = ModelWatcher(frontend, manager,
+                                   router_mode=RouterMode.KV,
+                                   kv_router_config={"stats_interval": 0.2})
+            await watcher.start()
+            service = await HttpService(manager, host="127.0.0.1",
+                                        port=0).start()
+            base = f"http://127.0.0.1:{service.port}"
+
+            body = {"model": "mock-model",
+                    "messages": [{"role": "user",
+                                  "content": "the quick brown fox " * 8}],
+                    "max_tokens": 8}
+            async with aiohttp.ClientSession() as s:
+                r1 = await (await s.post(f"{base}/v1/chat/completions",
+                                         json=body)).json()
+                assert r1["choices"][0]["finish_reason"] == "length"
+
+                # give the stored events time to land in the router index
+                router = watcher._clients and next(iter(
+                    manager._pipelines.values())).router
+                for _ in range(50):
+                    if isinstance(router.indexer.find_matches, object) and \
+                       router.indexer.num_blocks() > 0:
+                        break
+                    await asyncio.sleep(0.05)
+                assert router.indexer.num_blocks() > 0
+
+                # same prompt again: the router must see a prefix overlap on
+                # exactly one worker and keep the request there
+                from dynamo_tpu.tokens import compute_block_hash_for_seq
+                pre = next(iter(manager._pipelines.values())).preprocessor
+                r2 = await (await s.post(f"{base}/v1/chat/completions",
+                                         json=body)).json()
+                assert r2["choices"][0]["finish_reason"] == "length"
+                assert r2["usage"]["completion_tokens"] == 8
+            # affinity observed from the engines themselves: exactly one
+            # worker handled traffic, and its prefix cache scored hits on
+            # the repeated prompt
+            touched = [e for e in engines
+                       if e.allocator.hits + e.allocator.misses > 0]
+            assert len(touched) == 1
+            assert touched[0].allocator.hits > 0
+        finally:
+            if service is not None:
+                await service.stop()
+            if watcher is not None:
+                await watcher.stop()
+            for d in drts:
+                await d.close()
+            await coord.stop()
