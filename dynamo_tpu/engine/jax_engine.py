@@ -57,6 +57,14 @@ class JaxEngineConfig:
     # at 1 compiles each power-of-two batch as load ramps
     min_decode_bucket: int = 1
     seed: int = 0
+    # attention implementation:
+    #   "scan"     — lax.scan over layers, stacked cache, XLA gather attention
+    #                (portable; CPU tests)
+    #   "unrolled" — python loop over layers, per-layer cache buffers, XLA
+    #                gather attention (pallas minus the kernel; CPU-testable)
+    #   "pallas"   — unrolled + Pallas paged decode kernel (TPU)
+    #   "auto"     — pallas on TPU, scan elsewhere
+    attn_impl: str = "auto"
     # mesh/sharding hooks (filled by dynamo_tpu.parallel when multi-chip)
     shard_params_fn: Optional[Callable] = None
     shard_pages_fn: Optional[Callable] = None
@@ -84,8 +92,18 @@ class JaxEngine(ScheduledEngineBase):
             max_context=self.cfg.max_context)
         self.params = params
         self._forward = forward_fn
-        self.pages = llama.make_pages(model_cfg, self.cfg.num_pages,
-                                      self.cfg.page_size)
+        impl = self.cfg.attn_impl
+        if impl == "auto":
+            impl = "pallas" if jax.devices()[0].platform == "tpu" else "scan"
+        self.attn_impl = impl
+        if impl == "scan":
+            self.pages = llama.make_pages(model_cfg, self.cfg.num_pages,
+                                          self.cfg.page_size)
+        elif impl in ("unrolled", "pallas"):
+            self.pages = llama.make_pages_list(model_cfg, self.cfg.num_pages,
+                                               self.cfg.page_size)
+        else:
+            raise ValueError(f"unknown attn_impl {impl!r}")
         if self.cfg.shard_params_fn is not None:
             self.params = self.cfg.shard_params_fn(self.params)
         if self.cfg.shard_pages_fn is not None:
@@ -99,9 +117,18 @@ class JaxEngine(ScheduledEngineBase):
 
     def _step_impl(self, params, pages, tokens, positions, page_table,
                    total_lens, new_lens, rng, step, temperature, top_k, top_p):
-        logits, pages = self._forward(params, self.model_cfg, tokens,
-                                      positions, pages, page_table,
-                                      total_lens, new_lens)
+        if self.attn_impl == "scan":
+            logits, pages = self._forward(params, self.model_cfg, tokens,
+                                          positions, pages, page_table,
+                                          total_lens, new_lens)
+        else:
+            attn = None
+            if self.attn_impl == "pallas" and tokens.shape[1] == 1:
+                from dynamo_tpu.ops.pallas import paged_decode_attention
+                attn = paged_decode_attention
+            logits, pages = llama.forward_unrolled(
+                params, self.model_cfg, tokens, positions, pages,
+                page_table, total_lens, new_lens, attn_impl=attn)
         key = jax.random.fold_in(rng, step)
         sampled, logprobs = sample_tokens(logits, key, temperature, top_k, top_p)
         return pages, sampled, logprobs

@@ -1,17 +1,23 @@
 """Llama-family decoder (llama 2/3, mistral, qwen2/qwen3) — pure-functional jax.
 
 The reference framework never implements a model; it shells out to vLLM/SGLang
-on CUDA (SURVEY §2.5). Here the model loop is native and TPU-first:
+on CUDA (SURVEY §2.5). Here the model loop is native and TPU-first, with two
+interchangeable forwards over the same weights:
 
-- Params are a pytree of stacked per-layer arrays (leading ``L`` axis) and the
-  decoder runs as ONE ``lax.scan`` over layers: a single compiled layer body,
-  fast compiles, and XLA while-loop buffer aliasing so the paged KV cache
-  (part of the scan carry) is updated in place — no per-step cache copies.
-- One forward serves prefill chunks and decode steps (S = 1): new K/V is
-  scattered into the paged cache, then queries attend to the gathered context
-  (``dynamo_tpu.ops.attention``).
-- Only the last real token's logits are computed ([B, V]); full [B, S, V]
-  logit materialization would waste HBM on long prefill chunks.
+- ``forward`` — ONE ``lax.scan`` over stacked per-layer params: a single
+  compiled layer body, fast compiles, XLA while-loop buffer aliasing keeps the
+  stacked paged KV cache (scan carry) updated in place. This is the portable
+  path (CPU tests, prefill-heavy work).
+- ``forward_unrolled`` — python loop over layers with a *list* of per-layer KV
+  buffers. Needed for the Pallas decode kernel: a Pallas call can't fuse a
+  dynamic layer-slice of a stacked cache (it would copy the whole layer per
+  step), but with per-layer buffers the kernel reads HBM directly. Longer
+  compile, fastest decode; the serving engine uses it on TPU.
+
+Both share the exact same math (``_layer_step``); equivalence is tested.
+
+Only the last real token's logits are computed ([B, V]); full [B, S, V]
+logit materialization would waste HBM on long prefill chunks.
 
 Weight layout matches HF checkpoints after transpose (torch Linear stores
 [out, in]; we store [in, out] so the forward is ``x @ w``).
@@ -19,13 +25,18 @@ Weight layout matches HF checkpoints after transpose (torch Linear stores
 
 from __future__ import annotations
 
-from typing import Any, Dict, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
 from dynamo_tpu.models.config import ModelConfig
-from dynamo_tpu.ops.attention import paged_attention, write_kv
+from dynamo_tpu.ops.attention import (
+    paged_attention,
+    paged_attention_layer,
+    write_kv,
+    write_kv_layer,
+)
 from dynamo_tpu.ops.rope import apply_rope
 
 Params = Dict[str, Any]
@@ -46,14 +57,23 @@ def _head_rms_norm(x: jnp.ndarray, w: jnp.ndarray, eps: float) -> jnp.ndarray:
 
 def make_pages(cfg: ModelConfig, num_pages: int, page_size: int,
                dtype=None) -> jnp.ndarray:
-    """Allocate the paged KV cache: [L, 2, N, page_size, Hkv, Dh].
+    """Stacked paged KV cache: [L, 2, Hkv, N, page_size, Dh] (scan path).
 
     Page 0 is reserved as the garbage page for padded writes — allocators must
     hand out pages starting at index 1.
     """
     dtype = dtype or jnp.dtype(cfg.dtype)
-    return jnp.zeros((cfg.num_layers, 2, num_pages, page_size,
-                      cfg.num_kv_heads, cfg.head_dim), dtype=dtype)
+    return jnp.zeros((cfg.num_layers, 2, cfg.num_kv_heads, num_pages,
+                      page_size, cfg.head_dim), dtype=dtype)
+
+
+def make_pages_list(cfg: ModelConfig, num_pages: int, page_size: int,
+                    dtype=None) -> List[jnp.ndarray]:
+    """Per-layer KV buffers [2, Hkv, N, page_size, Dh] (unrolled path)."""
+    dtype = dtype or jnp.dtype(cfg.dtype)
+    return [jnp.zeros((2, cfg.num_kv_heads, num_pages, page_size,
+                       cfg.head_dim), dtype=dtype)
+            for _ in range(cfg.num_layers)]
 
 
 def init_params(cfg: ModelConfig, rng: jax.Array, scale: float = 0.02) -> Params:
@@ -96,66 +116,113 @@ def init_params(cfg: ModelConfig, rng: jax.Array, scale: float = 0.02) -> Params
     return params
 
 
-def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
-            positions: jnp.ndarray, pages: jnp.ndarray,
-            page_table: jnp.ndarray, total_lens: jnp.ndarray,
-            new_lens: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Run the decoder over a batch of new tokens against the paged cache.
-
-    tokens:     [B, S] new token ids (padded; pads masked via new_lens)
-    positions:  [B, S] absolute positions of the new tokens
-    pages:      paged KV cache (see make_pages); returned updated
-    page_table: [B, P] physical page ids per sequence
-    total_lens: [B] context length including the new tokens
-    new_lens:   [B] real new tokens per sequence (<= S)
-
-    Returns (logits [B, vocab] at each sequence's last real new token, pages).
-    """
-    B, S = tokens.shape
+def _project_qkv(cfg: ModelConfig, lp: Dict[str, jnp.ndarray],
+                 h: jnp.ndarray, positions: jnp.ndarray
+                 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Shared per-layer pre-attention math: norm, qkv, qk-norm, rope."""
+    B, S, _ = h.shape
     eps = cfg.rms_norm_eps
-    sm_scale = cfg.head_dim ** -0.5
-    h = params["embed"][tokens]  # [B, S, H]
+    x = _rms_norm(h, lp["attn_norm"], eps)
+    q = x @ lp["wq"]
+    k = x @ lp["wk"]
+    v = x @ lp["wv"]
+    if cfg.attention_bias:
+        q = q + lp["bq"]
+        k = k + lp["bk"]
+        v = v + lp["bv"]
+    q = q.reshape(B, S, cfg.num_heads, cfg.head_dim)
+    k = k.reshape(B, S, cfg.num_kv_heads, cfg.head_dim)
+    v = v.reshape(B, S, cfg.num_kv_heads, cfg.head_dim)
+    if cfg.qk_norm:
+        q = _head_rms_norm(q, lp["q_norm"], eps)
+        k = _head_rms_norm(k, lp["k_norm"], eps)
+    q = apply_rope(q, positions, cfg.rope_theta)
+    k = apply_rope(k, positions, cfg.rope_theta)
+    return q, k, v
 
-    def body(carry, xs):
-        h, pages = carry
-        lp, lidx = xs
-        x = _rms_norm(h, lp["attn_norm"], eps)
-        q = x @ lp["wq"]
-        k = x @ lp["wk"]
-        v = x @ lp["wv"]
-        if cfg.attention_bias:
-            q = q + lp["bq"]
-            k = k + lp["bk"]
-            v = v + lp["bv"]
-        q = q.reshape(B, S, cfg.num_heads, cfg.head_dim)
-        k = k.reshape(B, S, cfg.num_kv_heads, cfg.head_dim)
-        v = v.reshape(B, S, cfg.num_kv_heads, cfg.head_dim)
-        if cfg.qk_norm:
-            q = _head_rms_norm(q, lp["q_norm"], eps)
-            k = _head_rms_norm(k, lp["k_norm"], eps)
-        q = apply_rope(q, positions, cfg.rope_theta)
-        k = apply_rope(k, positions, cfg.rope_theta)
-        pages = write_kv(pages, lidx, k, v, page_table, positions, new_lens)
-        attn = paged_attention(q, pages, lidx, page_table, positions,
-                               total_lens, sm_scale)
-        h = h + attn.reshape(B, S, cfg.q_size) @ lp["wo"]
-        x = _rms_norm(h, lp["mlp_norm"], eps)
-        h = h + (jax.nn.silu(x @ lp["w_gate"]) * (x @ lp["w_up"])) @ lp["w_down"]
-        return (h, pages), None
 
-    (h, pages), _ = jax.lax.scan(
-        body, (h, pages),
-        (params["layers"], jnp.arange(cfg.num_layers)))
+def _finish_layer(cfg: ModelConfig, lp: Dict[str, jnp.ndarray],
+                  h: jnp.ndarray, attn: jnp.ndarray) -> jnp.ndarray:
+    """Shared post-attention math: out-proj residual + gated MLP residual."""
+    B, S, _ = h.shape
+    eps = cfg.rms_norm_eps
+    h = h + attn.reshape(B, S, cfg.q_size) @ lp["wo"]
+    x = _rms_norm(h, lp["mlp_norm"], eps)
+    return h + (jax.nn.silu(x @ lp["w_gate"]) * (x @ lp["w_up"])) @ lp["w_down"]
 
-    h = _rms_norm(h, params["final_norm"], eps)
+
+def _logits(cfg: ModelConfig, params: Params, h: jnp.ndarray,
+            new_lens: jnp.ndarray) -> jnp.ndarray:
+    h = _rms_norm(h, params["final_norm"], cfg.rms_norm_eps)
     last = jnp.maximum(new_lens - 1, 0)                    # [B]
     h_last = jnp.take_along_axis(
         h, last[:, None, None].astype(jnp.int32), axis=1)[:, 0]  # [B, H]
     lm_head = params.get("lm_head")
     if lm_head is None:
         lm_head = params["embed"].T
-    logits = h_last.astype(jnp.float32) @ lm_head.astype(jnp.float32)
-    return logits, pages
+    return h_last.astype(jnp.float32) @ lm_head.astype(jnp.float32)
 
 
-__all__ = ["init_params", "forward", "make_pages"]
+def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
+            positions: jnp.ndarray, pages: jnp.ndarray,
+            page_table: jnp.ndarray, total_lens: jnp.ndarray,
+            new_lens: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Scan-over-layers forward against the stacked paged cache.
+
+    tokens:     [B, S] new token ids (padded; pads masked via new_lens)
+    positions:  [B, S] absolute positions of the new tokens
+    pages:      stacked paged KV cache (see make_pages); returned updated
+    page_table: [B, P] physical page ids per sequence
+    total_lens: [B] context length including the new tokens
+    new_lens:   [B] real new tokens per sequence (<= S)
+
+    Returns (logits [B, vocab] at each sequence's last real new token, pages).
+    """
+    sm_scale = cfg.head_dim ** -0.5
+    h = params["embed"][tokens]  # [B, S, H]
+
+    def body(carry, xs):
+        h, pages = carry
+        lp, lidx = xs
+        q, k, v = _project_qkv(cfg, lp, h, positions)
+        pages = write_kv(pages, lidx, k, v, page_table, positions, new_lens)
+        attn = paged_attention(q, pages, lidx, page_table, positions,
+                               total_lens, sm_scale)
+        h = _finish_layer(cfg, lp, h, attn)
+        return (h, pages), None
+
+    (h, pages), _ = jax.lax.scan(
+        body, (h, pages),
+        (params["layers"], jnp.arange(cfg.num_layers)))
+    return _logits(cfg, params, h, new_lens), pages
+
+
+def forward_unrolled(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
+                     positions: jnp.ndarray, pages_list: List[jnp.ndarray],
+                     page_table: jnp.ndarray, total_lens: jnp.ndarray,
+                     new_lens: jnp.ndarray,
+                     attn_impl: Optional[Callable] = None
+                     ) -> Tuple[jnp.ndarray, List[jnp.ndarray]]:
+    """Unrolled forward over per-layer KV buffers (Pallas-kernel path).
+
+    ``attn_impl(q, kv_layer, page_table, positions, total_lens, sm_scale)``
+    defaults to the XLA gather path; the engine passes the Pallas decode
+    kernel for S == 1 steps on TPU.
+    """
+    sm_scale = cfg.head_dim ** -0.5
+    attn_impl = attn_impl or paged_attention_layer
+    h = params["embed"][tokens]
+    out_pages: List[jnp.ndarray] = []
+    for l in range(cfg.num_layers):
+        lp = {k: v[l] for k, v in params["layers"].items()}
+        q, k, v = _project_qkv(cfg, lp, h, positions)
+        kv = write_kv_layer(pages_list[l], k, v, page_table, positions,
+                            new_lens)
+        attn = attn_impl(q, kv, page_table, positions, total_lens, sm_scale)
+        h = _finish_layer(cfg, lp, h, attn)
+        out_pages.append(kv)
+    return _logits(cfg, params, h, new_lens), out_pages
+
+
+__all__ = ["init_params", "forward", "forward_unrolled", "make_pages",
+           "make_pages_list"]

@@ -4,9 +4,11 @@ Capability parity with the reference's engine-internal paged attention (the
 reference delegates this to vLLM/SGLang CUDA kernels; here it is native).
 Design is TPU-first:
 
-- The KV cache is ONE stacked array ``pages[L, 2, N, page_size, Hkv, Dh]``
-  carried through a ``lax.scan`` over layers, so XLA's while-loop buffer
-  aliasing keeps every per-layer scatter in place (no cache copies per step).
+- Cache layout is kernel-native: per layer ``[2, Hkv, N, page_size, Dh]``
+  (k/v, kv-head-major) — exactly what the Pallas paged decode kernel
+  (``ops/pallas/decode.py``) consumes with zero reshuffling, and stacked to
+  ``pages[L, 2, Hkv, N, page_size, Dh]`` for the ``lax.scan`` forward where
+  XLA's while-loop buffer aliasing keeps every per-layer scatter in place.
 - Page 0 is a reserved garbage page: padded token positions write there, which
   makes every scatter shape-static and mask-free.
 - One code path serves prefill (S = chunk length) and decode (S = 1): new K/V
@@ -15,9 +17,9 @@ Design is TPU-first:
   prefill with a prefix-cache hit falls out for free — queries attend to
   whatever the page table already holds.
 
-The gather materializes ``[B, T, Hkv, Dh]`` per layer; the Pallas decode kernel
-(``dynamo_tpu.ops.pallas.paged_decode``) fuses that gather away on TPU. This
-XLA path is the portable reference implementation and the CPU-test path.
+The XLA gather path materializes ``[B, T, Hkv, Dh]`` per layer; the Pallas
+decode kernel fuses that gather away on TPU. This XLA path is the portable
+reference implementation and the CPU-test path.
 """
 
 from __future__ import annotations
@@ -28,18 +30,18 @@ import jax.numpy as jnp
 NEG_INF = -1e30
 
 
-def write_kv(pages: jnp.ndarray, layer_idx, k_new: jnp.ndarray,
-             v_new: jnp.ndarray, page_table: jnp.ndarray,
-             positions: jnp.ndarray, new_lens: jnp.ndarray) -> jnp.ndarray:
-    """Scatter new K/V into the paged cache.
+def write_kv_layer(kv_layer: jnp.ndarray, k_new: jnp.ndarray,
+                   v_new: jnp.ndarray, page_table: jnp.ndarray,
+                   positions: jnp.ndarray, new_lens: jnp.ndarray) -> jnp.ndarray:
+    """Scatter new K/V into one layer's paged cache.
 
-    pages:      [L, 2, N, page_size, Hkv, Dh]
+    kv_layer:   [2, Hkv, N, page_size, Dh]
     k_new/v_new:[B, S, Hkv, Dh]
     page_table: [B, P] logical-page -> physical-page map (int32)
     positions:  [B, S] absolute token positions of the new tokens
     new_lens:   [B] number of real (non-pad) new tokens per sequence
     """
-    page_size = pages.shape[3]
+    page_size = kv_layer.shape[3]
     B, S = positions.shape
     logical = positions // page_size                       # [B, S]
     slot = positions % page_size                           # [B, S]
@@ -48,52 +50,103 @@ def write_kv(pages: jnp.ndarray, layer_idx, k_new: jnp.ndarray,
     pad = jnp.arange(S)[None, :] >= new_lens[:, None]
     phys = jnp.where(pad, 0, phys)
     slot = jnp.where(pad, 0, slot)
-    pages = pages.at[layer_idx, 0, phys, slot].set(
-        k_new.astype(pages.dtype), mode="drop")
-    pages = pages.at[layer_idx, 1, phys, slot].set(
-        v_new.astype(pages.dtype), mode="drop")
-    return pages
+    # (phys, slot) are contiguous advanced indices, so their broadcast dims
+    # stay in place: the scatter slice is [2, Hkv, B, S, Dh]
+    new = jnp.stack([k_new, v_new]).transpose(0, 3, 1, 2, 4)
+    return kv_layer.at[:, :, phys, slot].set(new.astype(kv_layer.dtype),
+                                             mode="drop")
+
+
+def write_kv(pages: jnp.ndarray, layer_idx, k_new: jnp.ndarray,
+             v_new: jnp.ndarray, page_table: jnp.ndarray,
+             positions: jnp.ndarray, new_lens: jnp.ndarray) -> jnp.ndarray:
+    """Scatter new K/V into the stacked cache ``[L, 2, Hkv, N, ps, Dh]``."""
+    page_size = pages.shape[4]
+    B, S = positions.shape
+    logical = positions // page_size
+    slot = positions % page_size
+    phys = jnp.take_along_axis(page_table, logical, axis=1)
+    pad = jnp.arange(S)[None, :] >= new_lens[:, None]
+    phys = jnp.where(pad, 0, phys)
+    slot = jnp.where(pad, 0, slot)
+    # layer_idx and (phys, slot) are separated by slices, so the advanced
+    # dims [B, S] move to the FRONT of the scatter slice: value layout is
+    # [B, S, 2, Hkv, Dh]
+    new = jnp.stack([k_new, v_new]).transpose(1, 2, 0, 3, 4)
+    return pages.at[layer_idx, :, :, phys, slot].set(
+        new.astype(pages.dtype), mode="drop")
+
+
+def _attend(qg: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+            positions: jnp.ndarray, total_lens: jnp.ndarray,
+            sm_scale: float) -> jnp.ndarray:
+    """qg [B,S,Hkv,G,Dh]; k/v [B,Hkv,T,Dh] -> [B,S,Hkv*G,Dh]."""
+    B, S, Hkv, G, Dh = qg.shape
+    T = k.shape[2]
+    scores = jnp.einsum("bsngd,bntd->bnsgt", qg.astype(jnp.float32),
+                        k.astype(jnp.float32)) * sm_scale  # [B,Hkv,S,G,T]
+    t_pos = jnp.arange(T)[None, None, :]                   # [1, 1, T]
+    causal = t_pos <= positions[:, :, None]                # [B, S, T]
+    valid = t_pos < total_lens[:, None, None]              # [B, 1, T]
+    mask = (causal & valid)[:, None, :, None, :]           # [B, 1, S, 1, T]
+    scores = jnp.where(mask, scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bnsgt,bntd->bsngd", probs, v.astype(jnp.float32))
+    return out.reshape(B, S, Hkv * G, Dh)
+
+
+def paged_attention_layer(q: jnp.ndarray, kv_layer: jnp.ndarray,
+                          page_table: jnp.ndarray, positions: jnp.ndarray,
+                          total_lens: jnp.ndarray, sm_scale: float
+                          ) -> jnp.ndarray:
+    """XLA-path attention against one layer's cache.
+
+    q: [B, S, Hq, Dh]; kv_layer: [2, Hkv, N, ps, Dh] -> [B, S, Hq, Dh]
+    """
+    B, S, Hq, Dh = q.shape
+    Hkv, _N, page_size, _ = kv_layer.shape[1:]
+    P = page_table.shape[1]
+    T = P * page_size
+    k = kv_layer[0][:, page_table]  # [Hkv, B, P, ps, Dh]
+    v = kv_layer[1][:, page_table]
+    k = k.transpose(1, 0, 2, 3, 4).reshape(B, Hkv, T, Dh)
+    v = v.transpose(1, 0, 2, 3, 4).reshape(B, Hkv, T, Dh)
+    qg = q.reshape(B, S, Hkv, Hq // Hkv, Dh)
+    return _attend(qg, k, v, positions, total_lens,
+                   sm_scale).astype(q.dtype)
 
 
 def paged_attention(q: jnp.ndarray, pages: jnp.ndarray, layer_idx,
                     page_table: jnp.ndarray, positions: jnp.ndarray,
                     total_lens: jnp.ndarray, sm_scale: float) -> jnp.ndarray:
-    """Attend queries to the paged context (new K/V must already be written).
+    """Attend queries to the stacked paged context (scan path).
 
     q:          [B, S, Hq, Dh]
+    pages:      [L, 2, Hkv, N, page_size, Dh]
     page_table: [B, P]
     positions:  [B, S] absolute positions of the queries
     total_lens: [B] total context length (cached + new)
     returns     [B, S, Hq, Dh]
     """
     B, S, Hq, Dh = q.shape
-    page_size = pages.shape[3]
-    Hkv = pages.shape[4]
-    G = Hq // Hkv
+    Hkv = pages.shape[2]
+    page_size = pages.shape[4]
     P = page_table.shape[1]
     T = P * page_size
 
-    # Single fused gather: a traced layer_idx participates as an advanced
-    # index, so XLA reads only the gathered pages (indexing pages[layer_idx]
+    # Single fused gather: the traced layer_idx participates as an advanced
+    # index so XLA reads only the gathered pages (slicing pages[layer_idx]
     # first would dynamic-slice-copy the whole layer's cache).
-    k = pages[layer_idx, 0, page_table]  # [B, P, page_size, Hkv, Dh]
-    v = pages[layer_idx, 1, page_table]
-    k = k.reshape(B, T, Hkv, Dh)
-    v = v.reshape(B, T, Hkv, Dh)
-
-    qg = q.reshape(B, S, Hkv, G, Dh)
-    scores = jnp.einsum("bsngd,btnd->bnsgt", qg.astype(jnp.float32),
-                        k.astype(jnp.float32)) * sm_scale  # [B,Hkv,S,G,T]
-
-    t_pos = jnp.arange(T)[None, None, :]                   # [1, 1, T]
-    causal = t_pos <= positions[:, :, None]                # [B, S, T]
-    valid = t_pos < total_lens[:, None, None]              # [B, 1, T]
-    mask = (causal & valid)[:, None, :, None, :]           # [B, 1, S, 1, T]
-    scores = jnp.where(mask, scores, NEG_INF)
-
-    probs = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bnsgt,btnd->bsngd", probs, v.astype(jnp.float32))
-    return out.reshape(B, S, Hq, Dh).astype(q.dtype)
+    # Advanced-index result: [B, P, ps, Dh] per k/v with Hkv slicing -> use
+    # explicit gather over (layer, kv, head, page).
+    k = pages[layer_idx, 0, :, page_table]  # [B, P, Hkv, ps, Dh]
+    v = pages[layer_idx, 1, :, page_table]
+    k = k.transpose(0, 2, 1, 3, 4).reshape(B, Hkv, T, Dh)
+    v = v.transpose(0, 2, 1, 3, 4).reshape(B, Hkv, T, Dh)
+    qg = q.reshape(B, S, Hkv, Hq // Hkv, Dh)
+    return _attend(qg, k, v, positions, total_lens,
+                   sm_scale).astype(q.dtype)
 
 
-__all__ = ["write_kv", "paged_attention", "NEG_INF"]
+__all__ = ["write_kv", "write_kv_layer", "paged_attention",
+           "paged_attention_layer", "NEG_INF"]

@@ -1,0 +1,12 @@
+"""Pallas TPU kernels for the serving hot loops.
+
+- ``decode.paged_decode_attention`` — decode-step attention that reads KV
+  pages directly from HBM (fuses away the XLA path's [B, T, Hkv, Dh] gather).
+
+The XLA implementations in ``dynamo_tpu.ops.attention`` remain the portable
+reference (CPU tests) and the prefill path.
+"""
+
+from dynamo_tpu.ops.pallas.decode import paged_decode_attention
+
+__all__ = ["paged_decode_attention"]

@@ -1,0 +1,55 @@
+"""Decode-step paged attention on TPU.
+
+Replaces the reference's CUDA paged-attention kernels (vLLM's, reached via
+``components/backends/vllm``) with the TPU-native equivalent: jax's public
+Pallas paged-attention kernel
+(``jax.experimental.pallas.ops.tpu.paged_attention``), which DMAs exactly the
+pages named in the page table from HBM into VMEM and runs flash-style online
+softmax per KV head — no [B, T, Hkv, Dh] materialization, HBM traffic is the
+live context only.
+
+Our cache layout ``[2, Hkv, N, page_size, Dh]`` is the kernel's native
+``k_pages``/``v_pages`` layout, so the call is zero-copy.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+
+def _pick_block(pages_per_seq: int, want: int = 8) -> int:
+    """Largest divisor of pages_per_seq that is <= want (kernel requires the
+    compute block to divide the page-table width)."""
+    for b in range(min(want, pages_per_seq), 0, -1):
+        if pages_per_seq % b == 0:
+            return b
+    return 1
+
+
+def paged_decode_attention(q: jnp.ndarray, kv_layer: jnp.ndarray,
+                           page_table: jnp.ndarray, positions: jnp.ndarray,
+                           total_lens: jnp.ndarray, sm_scale: float
+                           ) -> jnp.ndarray:
+    """Drop-in for ``ops.attention.paged_attention_layer`` when S == 1.
+
+    q:          [B, 1, Hq, Dh]
+    kv_layer:   [2, Hkv, N, page_size, Dh]
+    page_table: [B, P]
+    total_lens: [B] context length including the query token
+    """
+    B, S, Hq, Dh = q.shape
+    if S != 1:
+        raise ValueError(f"decode kernel requires S=1, got S={S}")
+    from jax.experimental.pallas.ops.tpu.paged_attention import (
+        paged_attention as kernel,
+    )
+    qs = (q[:, 0] * sm_scale).astype(q.dtype)          # [B, Hq, Dh]
+    block = _pick_block(page_table.shape[1])
+    out = kernel(qs, kv_layer[0], kv_layer[1], total_lens, page_table,
+                 pages_per_compute_block=block)
+    return out[:, None].astype(q.dtype)                # [B, 1, Hq, Dh]
+
+
+__all__ = ["paged_decode_attention"]

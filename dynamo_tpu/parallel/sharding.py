@@ -15,8 +15,10 @@ Layout (params carry a leading ``L`` layer axis from the ``lax.scan`` stack):
 - ``embed``    ``[V, H]``       — replicated (all-gather-free lookup)
 - ``lm_head``  ``[H, V]``       — shard ``V`` over ``tp`` (logits sharded,
   top-k/sampling runs fine on sharded logits)
-- KV pages ``[L, 2, N, page, Hkv, Dh]`` — shard ``Hkv`` over ``tp``; each
-  chip holds its own heads' cache, so paged writes/gathers are chip-local.
+- KV pages ``[L, 2, Hkv, N, page, Dh]`` (stacked) or per-layer
+  ``[2, Hkv, N, page, Dh]`` — shard ``Hkv`` over ``tp``; each chip holds its
+  own heads' cache, so paged writes/gathers (and the Pallas decode kernel's
+  page DMAs) are chip-local.
 
 ``num_kv_heads`` must be divisible by ``tp`` (e.g. Llama-3-8B: 8 KV heads →
 tp ∈ {1,2,4,8}); for tp > Hkv one would replicate KV heads — rejected for
@@ -78,7 +80,12 @@ class ModelSharding:
         return specs
 
     def pages_spec(self) -> P:
-        return P(None, None, None, None, "tp", None)
+        """Stacked cache [L, 2, Hkv, N, page, Dh]: Hkv over tp."""
+        return P(None, None, "tp", None, None, None)
+
+    def pages_layer_spec(self) -> P:
+        """Per-layer cache [2, Hkv, N, page, Dh]: Hkv over tp."""
+        return P(None, "tp", None, None, None)
 
     # -- application -------------------------------------------------------
 
@@ -96,7 +103,10 @@ class ModelSharding:
 
         return jax.tree_util.tree_map_with_path(place, params)
 
-    def shard_pages(self, pages: jax.Array) -> jax.Array:
+    def shard_pages(self, pages):
+        if isinstance(pages, list):
+            spec = self._named(self.pages_layer_spec())
+            return [jax.device_put(p, spec) for p in pages]
         return jax.device_put(pages, self._named(self.pages_spec()))
 
     def replicate(self, x):

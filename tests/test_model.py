@@ -9,6 +9,7 @@ import jax
 import jax.numpy as jnp
 
 from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.models import llama
 from dynamo_tpu.models.llama import forward, init_params, make_pages
 from dynamo_tpu.ops.sampling import sample_tokens
 
@@ -143,3 +144,82 @@ def test_sampling_greedy_and_topk():
                            jnp.full((4,), 0.9))
     np.testing.assert_array_equal(np.asarray(t3a), np.asarray(t3b))
     assert np.all((np.asarray(t3a) >= 0) & (np.asarray(t3a) < 50))
+
+
+class TestUnrolledForward:
+    def test_unrolled_matches_scan(self):
+        """forward_unrolled (per-layer buffers) must produce identical logits
+        and cache contents to the scan forward."""
+        import numpy as np
+        cfg = ModelConfig.tiny()
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        stacked = llama.make_pages(cfg, 8, 4)
+        layered = llama.make_pages_list(cfg, 8, 4)
+        B, S = 2, 8
+        tokens = jnp.arange(B * S, dtype=jnp.int32).reshape(B, S) % 100
+        positions = jnp.tile(jnp.arange(S, dtype=jnp.int32)[None], (B, 1))
+        table = jnp.array([[1, 2, 0], [3, 4, 0]], jnp.int32)
+        total = jnp.full((B,), S, jnp.int32)
+        new = jnp.full((B,), S, jnp.int32)
+
+        l1, p1 = llama.forward(params, cfg, tokens, positions, stacked,
+                               table, total, new)
+        l2, p2 = llama.forward_unrolled(params, cfg, tokens, positions,
+                                        layered, table, total, new)
+        np.testing.assert_allclose(np.asarray(l1), np.asarray(l2),
+                                   rtol=2e-5, atol=2e-5)
+        for l in range(cfg.num_layers):
+            np.testing.assert_allclose(np.asarray(p1[l]), np.asarray(p2[l]),
+                                       rtol=1e-6, atol=1e-6)
+
+    async def test_engine_unrolled_matches_scan_tokens(self):
+        from dynamo_tpu.engine.jax_engine import JaxEngine, JaxEngineConfig
+        from dynamo_tpu.protocols.common import (
+            PreprocessedRequest, SamplingOptions, StopConditions)
+
+        def req(rid):
+            return PreprocessedRequest(
+                token_ids=list(range(1, 11)), request_id=rid,
+                stop_conditions=StopConditions(max_tokens=6),
+                sampling_options=SamplingOptions(temperature=0.0))
+
+        outs = {}
+        for impl in ("scan", "unrolled"):
+            eng = JaxEngine.random_init(ModelConfig.tiny(), JaxEngineConfig(
+                num_pages=32, page_size=4, max_num_seqs=2,
+                max_prefill_chunk=8, max_context=64, min_prefill_bucket=4,
+                attn_impl=impl))
+            try:
+                toks = []
+                async for f in eng.generate(req(impl)):
+                    toks.extend(f.token_ids)
+                outs[impl] = toks
+            finally:
+                await eng.stop()
+        assert outs["scan"] == outs["unrolled"]
+        assert len(outs["scan"]) == 6
+
+
+@pytest.mark.skipif(jax.devices()[0].platform != "tpu",
+                    reason="pallas paged decode kernel needs a TPU")
+class TestPallasDecode:
+    def test_kernel_matches_xla_path(self):
+        import numpy as np
+        from dynamo_tpu.ops.attention import paged_attention_layer, write_kv_layer
+        from dynamo_tpu.ops.pallas import paged_decode_attention
+        cfg = ModelConfig.tiny(num_kv_heads=2, num_heads=4, head_dim=128,
+                               dtype="bfloat16")
+        kv = jnp.asarray(
+            jax.random.normal(jax.random.PRNGKey(0), (2, 2, 16, 4, 128)),
+            dtype=jnp.bfloat16)
+        B, P = 2, 8
+        table = jnp.arange(1, 1 + B * P, dtype=jnp.int32).reshape(B, P) % 15 + 1
+        q = jnp.asarray(jax.random.normal(jax.random.PRNGKey(1), (B, 1, 4, 128)),
+                        dtype=jnp.bfloat16)
+        total = jnp.array([9, 17], jnp.int32)
+        positions = (total - 1)[:, None]
+        ref = paged_attention_layer(q, kv, table, positions, total, 0.088)
+        out = paged_decode_attention(q, kv, table, positions, total, 0.088)
+        np.testing.assert_allclose(np.asarray(ref, np.float32),
+                                   np.asarray(out, np.float32),
+                                   rtol=2e-2, atol=2e-2)
