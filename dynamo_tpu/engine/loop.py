@@ -74,7 +74,8 @@ class ScheduledEngineBase(EngineBase):
 
     def _finish(self, seq: Sequence, reason: FinishReason,
                 token: Optional[int] = None,
-                logprob: Optional[float] = None) -> None:
+                logprob: Optional[float] = None,
+                kv_transfer_params: Optional[dict] = None) -> None:
         self.scheduler.finish(seq)
         self._emit(seq, LLMEngineOutput(
             token_ids=[token] if token is not None else [],
@@ -83,6 +84,7 @@ class ScheduledEngineBase(EngineBase):
             prompt_tokens=seq.num_prompt,
             completion_tokens=len(seq.generated),
             cached_tokens=seq.cached_tokens,
+            kv_transfer_params=kv_transfer_params,
         ))
 
     def _accept_token(self, seq: Sequence, token: int, logprob: float) -> None:
@@ -116,12 +118,22 @@ class ScheduledEngineBase(EngineBase):
                 self._finish(seq, FinishReason.CANCELLED)
             elif plan.is_last:
                 if seq.request.prefill_only:
-                    # disagg prefill worker: one token, KV stays cached
+                    # disagg prefill worker: one token, KV stays cached; the
+                    # final frame advertises the transferable blocks
                     tok = int(sampled[0])
                     seq.tokens.append(tok)
                     seq.generated.append(tok)
+                    blocks = seq.tokens.blocks[:seq.committed_pages]
+                    params = {
+                        "blocks": [[b.block_hash, b.local_hash,
+                                    b.parent_hash if b.position else None]
+                                   for b in blocks],
+                        "page_size": self.allocator.page_size,
+                        "num_tokens_cached": len(blocks)
+                        * self.allocator.page_size,
+                    }
                     self._finish(seq, FinishReason.LENGTH, tok,
-                                 float(logprobs[0]))
+                                 float(logprobs[0]), kv_transfer_params=params)
                 else:
                     self._accept_token(seq, int(sampled[0]), float(logprobs[0]))
         else:

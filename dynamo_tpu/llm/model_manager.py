@@ -101,6 +101,10 @@ class ModelWatcher:
 
     async def _handle_put(self, key: str, value: bytes) -> None:
         entry = ModelEntry.from_json(value)
+        if entry.model_type == "prefill":
+            # disagg prefill workers are internal: decode workers discover
+            # them by component; frontends must not route chat traffic there
+            return
         instances = self._model_instances.setdefault(entry.name, set())
         instances.add(key)
         if entry.name in self.manager:

@@ -1,0 +1,188 @@
+"""Disaggregated prefill/decode: the decode-side orchestration.
+
+Decode-first flow, capability parity with the reference's vLLM disagg path
+(SURVEY §3.4; ``components/backends/vllm/src/dynamo/vllm/handlers.py:107-183``):
+the decode worker receives the request, round-robins it to a prefill worker
+with ``prefill_only`` set, receives the first token plus
+``kv_transfer_params`` (the prefix's block hashes), pulls those KV blocks
+over the runtime RPC plane (``engine/transfer.py`` — the NIXL replacement),
+injects them into the local cache, and decodes from the prefix hit.
+
+Short prompts skip the remote hop: ``max_local_prefill_length`` is
+hot-reloaded from the coordinator KV (parity: ``DisaggRouterConf`` etcd watch,
+``lib/llm/src/disagg_router.rs:25-120``). If no prefill worker is live, or the
+remote leg fails, the decode worker silently falls back to local prefill —
+disagg is an optimization, never a point of failure.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from typing import Any, AsyncIterator, Optional
+
+from dynamo_tpu.engine.jax_engine import JaxEngine
+from dynamo_tpu.engine.transfer import BlockPayload, inject_blocks
+from dynamo_tpu.protocols.common import LLMEngineOutput, PreprocessedRequest
+from dynamo_tpu.runtime.push_router import PushRouter, RouterMode
+from dynamo_tpu.runtime.runtime import DistributedRuntime
+from dynamo_tpu.utils.aio import reap_task
+
+logger = logging.getLogger(__name__)
+
+KV_EXPORT_ENDPOINT = "kv_export"
+
+
+def disagg_conf_key(namespace: str) -> str:
+    return f"disagg/{namespace}/conf"
+
+
+class DisaggConfig:
+    """Hot-reloadable disagg policy."""
+
+    def __init__(self, max_local_prefill_length: int = 0):
+        # prompts up to this length prefill locally; 0 = always remote
+        self.max_local_prefill_length = max_local_prefill_length
+
+    @classmethod
+    def from_json(cls, raw: bytes) -> "DisaggConfig":
+        d = json.loads(raw)
+        return cls(max_local_prefill_length=int(
+            d.get("max_local_prefill_length", 0)))
+
+
+class DisaggDecodeHandler:
+    """Wraps a decode engine with the remote-prefill leg."""
+
+    def __init__(self, engine: JaxEngine, drt: DistributedRuntime,
+                 namespace: str, prefill_component: str,
+                 conf: Optional[DisaggConfig] = None):
+        self.engine = engine
+        self.drt = drt
+        self.namespace = namespace
+        self.prefill_component = prefill_component
+        self.conf = conf or DisaggConfig()
+        self._gen_client = None
+        self._kv_client = None
+        self._router: Optional[PushRouter] = None
+        self._conf_watch = None
+        self._conf_task: Optional[asyncio.Task] = None
+
+    async def start(self) -> "DisaggDecodeHandler":
+        ns = self.drt.namespace(self.namespace)
+        comp = ns.component(self.prefill_component)
+        self._gen_client = await comp.endpoint("generate").client()
+        self._kv_client = await comp.endpoint(KV_EXPORT_ENDPOINT).client()
+        self._router = PushRouter(self._gen_client, RouterMode.ROUND_ROBIN)
+        self._conf_watch = await self.drt.coord.watch_prefix(
+            disagg_conf_key(self.namespace))
+        for _key, value in self._conf_watch.snapshot:
+            self._apply_conf(value)
+        self._conf_task = asyncio.create_task(self._conf_loop())
+        return self
+
+    async def stop(self) -> None:
+        await reap_task(self._conf_task)
+        if self._conf_watch is not None:
+            try:
+                await self._conf_watch.cancel()
+            except Exception:
+                pass
+        for c in (self._gen_client, self._kv_client):
+            if c is not None:
+                await c.close()
+
+    def _apply_conf(self, raw: bytes) -> None:
+        try:
+            self.conf = DisaggConfig.from_json(raw)
+            logger.info("disagg conf updated: max_local_prefill_length=%d",
+                        self.conf.max_local_prefill_length)
+        except Exception:
+            logger.exception("bad disagg conf %r", raw)
+
+    async def _conf_loop(self) -> None:
+        async for ev in self._conf_watch:
+            if ev.type == "put" and ev.value is not None:
+                self._apply_conf(ev.value)
+
+    # -- the disagg leg ----------------------------------------------------
+
+    def _use_remote_prefill(self, request: PreprocessedRequest) -> bool:
+        if not self._gen_client.instance_ids():
+            return False
+        n = len(request.token_ids)
+        return n > self.conf.max_local_prefill_length
+
+    async def _remote_prefill(self, request: PreprocessedRequest
+                              ) -> Optional[LLMEngineOutput]:
+        """Run the prefill leg; returns the final prefill frame (first token +
+        kv_transfer_params) or None on any failure (-> local fallback)."""
+        preq = PreprocessedRequest.from_dict(request.to_dict())
+        preq.prefill_only = True
+        try:
+            iid = self._router.select_instance()
+            final: Optional[LLMEngineOutput] = None
+            stream = await self._gen_client.direct(preq.to_dict(), iid)
+            async for payload in stream:
+                out = LLMEngineOutput.from_dict(payload)
+                if out.finish_reason is not None:
+                    final = out
+            if final is None or final.error:
+                return None
+            params = final.kv_transfer_params or {}
+            hashes = [b[0] for b in params.get("blocks", [])]
+            if hashes:
+                kv_stream = await self._kv_client.direct(
+                    {"block_hashes": hashes}, iid)
+                blocks = []
+                async for frame in kv_stream:
+                    blocks.append(BlockPayload.from_wire(frame))
+                if blocks:
+                    n = await asyncio.to_thread(
+                        inject_blocks, self.engine, blocks)
+                    logger.debug("injected %d/%d transferred blocks",
+                                 n, len(blocks))
+            return final
+        except ConnectionError as e:
+            logger.warning("remote prefill failed (%s); falling back local", e)
+            return None
+
+    async def generate(self, request: PreprocessedRequest,
+                       ctx=None) -> AsyncIterator[LLMEngineOutput]:
+        first: Optional[LLMEngineOutput] = None
+        if self._use_remote_prefill(request):
+            first = await self._remote_prefill(request)
+        if first is not None and first.token_ids:
+            tok = first.token_ids[0]
+            yield LLMEngineOutput(token_ids=[tok],
+                                  log_probs=first.log_probs)
+            sc = request.stop_conditions
+            if (not sc.ignore_eos and tok in request.eos_token_ids) or \
+               (sc.stop_token_ids and tok in sc.stop_token_ids):
+                yield LLMEngineOutput(
+                    finish_reason=first.finish_reason,
+                    prompt_tokens=len(request.token_ids),
+                    completion_tokens=1)
+                return
+            if sc.max_tokens is not None and sc.max_tokens <= 1:
+                yield LLMEngineOutput(
+                    finish_reason=first.finish_reason,
+                    prompt_tokens=len(request.token_ids),
+                    completion_tokens=1)
+                return
+            request = PreprocessedRequest.from_dict(request.to_dict())
+            request.token_ids = list(request.token_ids) + [tok]
+            if request.stop_conditions.max_tokens is not None:
+                request.stop_conditions.max_tokens -= 1
+        async for out in self.engine.generate(request, ctx):
+            if (first is not None and out.finish_reason is not None
+                    and out.completion_tokens is not None):
+                # the handed-off first token counts as completion, not prompt
+                out.prompt_tokens = (out.prompt_tokens or 1) - 1
+                out.completion_tokens = out.completion_tokens + 1
+            yield out
+
+
+__all__ = ["DisaggDecodeHandler", "DisaggConfig", "disagg_conf_key",
+           "KV_EXPORT_ENDPOINT"]

@@ -62,8 +62,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--tensor-parallel-size", type=int, default=1,
                    help="shard the model over this many local devices")
     p.add_argument("--no-kv-events", action="store_true")
-    p.add_argument("--prefill-only", action="store_true",
-                   help="serve as a disaggregated prefill worker")
+    p.add_argument("--disagg", choices=["none", "prefill", "decode"],
+                   default="none",
+                   help="disaggregated role: 'prefill' serves prefill+KV "
+                        "export; 'decode' pulls prefixes from the prefill "
+                        "component and decodes")
+    p.add_argument("--prefill-component", default="prefill",
+                   help="component name of the prefill workers (decode role)")
     return p
 
 
@@ -108,16 +113,37 @@ async def amain(args: argparse.Namespace) -> None:
 
         engine.kv_event_cb = publish_events
 
-    await serve_engine(endpoint, engine,
-                       stats_provider=lambda: engine.stats().to_dict())
-    await register_llm(
-        drt, endpoint, card,
-        model_type="prefill" if args.prefill_only else "chat")
+    handler = None
+    if args.disagg == "decode":
+        from dynamo_tpu.worker.disagg import DisaggDecodeHandler
+        handler = await DisaggDecodeHandler(
+            engine, drt, args.namespace, args.prefill_component).start()
+        from dynamo_tpu.llm.register import engine_handler
+        await engine.start()
+        await endpoint.serve(engine_handler(handler),
+                             stats_provider=lambda: engine.stats().to_dict())
+    else:
+        await serve_engine(endpoint, engine,
+                           stats_provider=lambda: engine.stats().to_dict())
+    if args.disagg == "prefill":
+        # serve the KV block fetch endpoint for decode workers; register as
+        # model_type=prefill so frontends don't route chat traffic here
+        from dynamo_tpu.engine.transfer import serve_kv_export
+        from dynamo_tpu.worker.disagg import KV_EXPORT_ENDPOINT
+        kv_ep = (drt.namespace(args.namespace).component(args.component)
+                 .endpoint(KV_EXPORT_ENDPOINT))
+        await kv_ep.serve(serve_kv_export(engine))
+        await register_llm(drt, endpoint, card, model_type="prefill")
+    else:
+        await register_llm(drt, endpoint, card)
     print(f"jax worker serving model {card.name} "
-          f"on {len(jax.devices())} device(s)", flush=True)
+          f"on {len(jax.devices())} device(s) (disagg={args.disagg})",
+          flush=True)
     try:
         await drt.runtime.wait_shutdown()
     finally:
+        if handler is not None:
+            await handler.stop()
         await engine.stop()
         await drt.close()
 
