@@ -1,0 +1,127 @@
+"""Ring attention: sequence/context parallelism over the ``sp`` mesh axis.
+
+Net-new capability vs the reference, which has no sequence/context
+parallelism anywhere (SURVEY §5 — it delegates long context to paged
+attention + KV offload inside engines). For prompts too long for one chip's
+HBM or prefill latency budget, the sequence axis is sharded over ``sp`` and
+K/V shards rotate around the ring via ``jax.lax.ppermute`` — each hop rides
+a single ICI neighbor link while every chip computes flash-style online
+softmax against the shard it currently holds (blockwise/ring attention,
+Liu et al. 2023).
+
+Numerics: online softmax accumulation in float32 with a running row max —
+the same update flash attention uses, so the result is bit-comparable to
+single-device attention up to float32 reduction order.
+
+``ring_attention`` is the shard_map-level primitive (callers are inside
+``shard_map`` with a named ``sp`` axis); ``ring_self_attention`` is the
+convenience wrapper that shards full arrays over a mesh and runs it.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def _block_attend(q, k, v, q_pos, kv_pos, kv_valid, sm_scale):
+    """One q-shard vs one kv-shard: returns (num, den, mx) partials.
+
+    q: [B, Sq, H, D]; k/v: [B, Sk, H, D] (kv heads already repeated to H)
+    q_pos: [B, Sq]; kv_pos: [B, Sk]; kv_valid: [B, Sk] bool
+    """
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * sm_scale
+    causal = kv_pos[:, None, None, :] <= q_pos[:, None, :, None]
+    valid = kv_valid[:, None, None, :]
+    scores = jnp.where(causal & valid, scores, NEG_INF)
+    mx = jnp.max(scores, axis=-1)                          # [B,H,Sq]
+    p = jnp.exp(scores - mx[..., None])
+    # rows with no visible kv yet: mx = NEG_INF; zero their contribution
+    live = mx > NEG_INF / 2
+    p = jnp.where(live[..., None], p, 0.0)
+    num = jnp.einsum("bhqk,bkhd->bhqd", p, v.astype(jnp.float32))
+    den = jnp.sum(p, axis=-1)                              # [B,H,Sq]
+    return num, den, mx, live
+
+
+def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                   q_pos: jnp.ndarray, kv_pos: jnp.ndarray,
+                   kv_valid: Optional[jnp.ndarray] = None,
+                   sm_scale: Optional[float] = None,
+                   axis_name: str = "sp") -> jnp.ndarray:
+    """Causal self-attention with the kv sequence sharded over a ring.
+
+    Call INSIDE shard_map. Shapes are per-shard:
+    q [B, Sq, Hq, D], k/v [B, Sk, Hkv, D], q_pos [B, Sq], kv_pos [B, Sk].
+    Returns [B, Sq, Hq, D] in q's dtype.
+    """
+    B, Sq, Hq, D = q.shape
+    Hkv = k.shape[2]
+    if sm_scale is None:
+        sm_scale = D ** -0.5
+    if kv_valid is None:
+        kv_valid = jnp.ones(kv_pos.shape, bool)
+    if Hq != Hkv:
+        rep = Hq // Hkv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    n = lax.psum(1, axis_name)
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def rotate(x):
+        return lax.ppermute(x, axis_name, perm)
+
+    def body(carry, _):
+        k_cur, v_cur, pos_cur, valid_cur, num, den, mx = carry
+        pnum, pden, pmx, plive = _block_attend(q, k_cur, v_cur, q_pos,
+                                               pos_cur, valid_cur, sm_scale)
+        new_mx = jnp.maximum(mx, pmx)
+        # rescale both accumulators to the new max; dead partials contribute 0
+        old_scale = jnp.where(mx > NEG_INF / 2, jnp.exp(mx - new_mx), 0.0)
+        p_scale = jnp.where(plive, jnp.exp(pmx - new_mx), 0.0)
+        num = num * old_scale[..., None] + pnum * p_scale[..., None]
+        den = den * old_scale + pden * p_scale
+        carry = (rotate(k_cur), rotate(v_cur), rotate(pos_cur),
+                 rotate(valid_cur), num, den, new_mx)
+        return carry, None
+
+    num0 = jnp.zeros((B, Hq, Sq, D), jnp.float32)
+    den0 = jnp.zeros((B, Hq, Sq), jnp.float32)
+    mx0 = jnp.full((B, Hq, Sq), NEG_INF, jnp.float32)
+    carry, _ = lax.scan(body, (k, v, kv_pos, kv_valid, num0, den0, mx0),
+                        None, length=n)
+    num, den = carry[4], carry[5]
+    out = num / jnp.maximum(den, 1e-20)[..., None]         # [B,Hq,Sq,D]
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)
+
+
+def ring_self_attention(mesh: Mesh, q: jnp.ndarray, k: jnp.ndarray,
+                        v: jnp.ndarray, positions: jnp.ndarray,
+                        sm_scale: Optional[float] = None,
+                        axis_name: str = "sp") -> jnp.ndarray:
+    """Full-array wrapper: shards the sequence axis over ``axis_name`` and
+    runs ring attention. q/k/v [B, S, H, D], positions [B, S]; S must divide
+    by the axis size."""
+    from jax import shard_map
+
+    seq_spec = P(None, axis_name, None, None)
+    pos_spec = P(None, axis_name)
+
+    fn = functools.partial(ring_attention, sm_scale=sm_scale,
+                           axis_name=axis_name)
+    sharded = shard_map(
+        fn, mesh=mesh,
+        in_specs=(seq_spec, seq_spec, seq_spec, pos_spec, pos_spec),
+        out_specs=seq_spec, check_vma=False)
+    return sharded(q, k, v, positions, positions)
+
+
+__all__ = ["ring_attention", "ring_self_attention"]
