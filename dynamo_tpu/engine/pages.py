@@ -78,6 +78,10 @@ class PageAllocator:
         # counters for metrics / tests
         self.hits = 0
         self.misses = 0
+        # KVBM hook: called as on_evict([(block_hash, page_id, _PageInfo)...])
+        # BEFORE the pages are handed out for reuse, so a tier manager can
+        # copy the block contents out (offload G1 -> G2)
+        self.on_evict = None
 
     # -- observers ---------------------------------------------------------
 
@@ -159,16 +163,21 @@ class PageAllocator:
             raise OutOfPages(f"need {n} pages, have {self.num_free}")
         out: List[int] = []
         removed: List[int] = []
+        evicted: List[tuple] = []
         for _ in range(n):
             if self._free:
                 page = self._free.pop()
             else:
                 h, page = self._lru.popitem(last=False)  # oldest first
+                evicted.append((h, page, self._info[page]))
                 del self._by_hash[h]
                 del self._info[page]
                 removed.append(h)
             self._info[page] = _PageInfo(refcount=1)
             out.append(page)
+        if evicted and self.on_evict is not None:
+            # offload hook runs before the caller can overwrite the pages
+            self.on_evict(evicted)
         if removed:
             self._emit(removed=removed)
         return out

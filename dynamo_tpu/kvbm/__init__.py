@@ -1,0 +1,30 @@
+"""KVBM: the multi-tier KV block manager.
+
+Capability parity with the reference's KVBM (``lib/llm/src/block_manager/``
+~12k LoC: CacheLevel G1 gpu / G2 host / G3 disk / G4 remote, offload +
+onboard managers, CUDA/NIXL transfer strategies), re-designed around this
+framework's content-addressed blocks:
+
+- **G1 (HBM)** is the engine's paged device cache + ``PageAllocator`` LRU.
+- **G2 (host RAM)** and **G3 (disk)** are byte-budgeted LRU pools of block
+  payloads keyed by chained block hash (``tiers.py``).
+- **Offload** is event-driven: the allocator's eviction hook fires before a
+  page is reused; the manager snapshots the block device->host (the jax
+  array is an immutable snapshot, so this is race-free against in-flight
+  steps). Host-pool overflow demotes G2 -> G3.
+- **Onboard** happens at request admission: prompt blocks missing from HBM
+  but resident in G2/G3 are injected back through the same content-addressed
+  path disaggregation uses (``engine/transfer.py``), after which the normal
+  prefix-match admission revives them — no scheduler changes.
+- **G4 (remote)** is the disagg block-transfer plane itself
+  (``worker/disagg.py``): remote workers' caches are reachable by the same
+  hashes over the RPC plane.
+
+Replaces ``block_copy.cu`` + CUDA-stream transfer contexts with jax
+device_get/device_put gathers (XLA handles batching/overlap).
+"""
+
+from dynamo_tpu.kvbm.manager import TieredEngine, TieredKvConfig
+from dynamo_tpu.kvbm.tiers import DiskTier, HostTier
+
+__all__ = ["TieredEngine", "TieredKvConfig", "HostTier", "DiskTier"]

@@ -1,0 +1,131 @@
+"""Offload/onboard orchestration across the KV tiers.
+
+Parity in role: reference ``OffloadManager`` (``block_manager/offload.rs`` —
+G1->G2->G3 offload, onboarding with batched transfers). Here transfers are
+jax gathers (device->host) and the content-addressed inject path
+(``engine/transfer.py``) — no CUDA streams/NIXL agents to manage.
+
+``TieredEngine`` wraps any ``JaxEngine``:
+- installs the allocator eviction hook: HBM-evicted blocks snapshot into G2
+  (host RAM), G2 overflow demotes to G3 (disk);
+- on ``generate``, prompt blocks missing from HBM but held by G2/G3 are
+  injected back into the device cache, then normal admission prefix-matches
+  them. Onboarding pulls G3 hits back through G2 (promotion on use).
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+from typing import AsyncIterator, List, Optional
+
+from dynamo_tpu.engine.jax_engine import JaxEngine
+from dynamo_tpu.engine.base import EngineBase
+from dynamo_tpu.engine.transfer import (
+    BlockPayload,
+    _gather_pages,
+    inject_blocks,
+)
+from dynamo_tpu.protocols.common import LLMEngineOutput, PreprocessedRequest
+from dynamo_tpu.kvbm.tiers import DiskTier, HostTier
+from dynamo_tpu.tokens import compute_block_hash_for_seq
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class TieredKvConfig:
+    host_budget_bytes: int = 1 << 30          # G2: 1 GiB default
+    disk_budget_bytes: int = 0                # G3: 0 = disabled
+    disk_path: str = "/tmp/dynamo_tpu_kvbm"
+    # cap on blocks onboarded per request (bound admission latency)
+    max_onboard_blocks: int = 256
+
+
+class TieredEngine(EngineBase):
+    """EngineBase wrapper adding G2/G3 offload tiers to a JaxEngine."""
+
+    def __init__(self, engine: JaxEngine,
+                 config: Optional[TieredKvConfig] = None):
+        self.engine = engine
+        self.cfg = config or TieredKvConfig()
+        self.host = HostTier(self.cfg.host_budget_bytes)
+        self.disk = (DiskTier(self.cfg.disk_path, self.cfg.disk_budget_bytes)
+                     if self.cfg.disk_budget_bytes > 0 else None)
+        self.offloaded = 0
+        self.onboarded = 0
+        engine.allocator.on_evict = self._on_evict
+
+    # -- offload (G1 -> G2 -> G3) -----------------------------------------
+
+    def _on_evict(self, evicted: List[tuple]) -> None:
+        """Allocator eviction hook: snapshot blocks to the host tier.
+
+        Runs synchronously before the pages are reused; the gather reads the
+        current immutable device array snapshot.
+        """
+        try:
+            data = _gather_pages(self.engine, [p for _h, p, _i in evicted])
+        except Exception:
+            logger.exception("kvbm offload gather failed; blocks dropped")
+            return
+        for i, (h, _page, info) in enumerate(evicted):
+            blk = BlockPayload(block_hash=h, local_hash=info.local_hash,
+                               parent_hash=info.parent_hash,
+                               data=data[:, :, :, i].copy())
+            self.offloaded += 1
+            for demoted in self.host.put(blk):
+                if self.disk is not None:
+                    self.disk.put(demoted)
+
+    # -- onboard (G2/G3 -> G1) --------------------------------------------
+
+    def _lookup(self, block_hash: int) -> Optional[BlockPayload]:
+        blk = self.host.get(block_hash)
+        if blk is None and self.disk is not None:
+            blk = self.disk.get(block_hash)
+            if blk is not None:
+                for demoted in self.host.put(blk):  # promote on use
+                    self.disk.put(demoted)
+        return blk
+
+    def _onboard_for(self, token_ids: List[int]) -> int:
+        """Inject tier-resident prompt blocks missing from HBM."""
+        page_size = self.engine.allocator.page_size
+        hashes = compute_block_hash_for_seq(token_ids, page_size)
+        resident = self.engine.allocator._by_hash
+        needed: List[BlockPayload] = []
+        for h in hashes[:self.cfg.max_onboard_blocks]:
+            if h in resident:
+                continue
+            blk = self._lookup(h)
+            if blk is None:
+                break  # chain broken: further blocks can't be used
+            needed.append(blk)
+        if not needed:
+            return 0
+        n = inject_blocks(self.engine, needed)
+        self.onboarded += n
+        return n
+
+    # -- EngineBase --------------------------------------------------------
+
+    async def generate(self, request: PreprocessedRequest,
+                       ctx=None) -> AsyncIterator[LLMEngineOutput]:
+        import asyncio
+        if request.token_ids:
+            await asyncio.to_thread(self._onboard_for, request.token_ids)
+        async for out in self.engine.generate(request, ctx):
+            yield out
+
+    async def start(self) -> None:
+        await self.engine.start()
+
+    async def stop(self) -> None:
+        await self.engine.stop()
+
+    def stats(self):
+        return self.engine.stats()
+
+
+__all__ = ["TieredEngine", "TieredKvConfig"]

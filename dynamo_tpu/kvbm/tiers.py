@@ -1,0 +1,127 @@
+"""Host-RAM and disk block pools: byte-budgeted LRU keyed by block hash.
+
+Parity in role with the reference's G2/G3 pools (``block_manager/pool/*``,
+``storage/{cuda,disk}.rs``): bounded capacity, LRU eviction, lookup by
+sequence/content hash. Demotion (G2 overflow -> G3) is the offload manager's
+job (``manager.py``); each tier only stores and evicts.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from collections import OrderedDict
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from dynamo_tpu.engine.transfer import BlockPayload
+
+logger = logging.getLogger(__name__)
+
+
+class HostTier:
+    """G2: host-RAM LRU of block payloads."""
+
+    def __init__(self, budget_bytes: int):
+        self.budget = budget_bytes
+        self.used = 0
+        self._blocks: "OrderedDict[int, BlockPayload]" = OrderedDict()
+
+    def __contains__(self, block_hash: int) -> bool:
+        return block_hash in self._blocks
+
+    def __len__(self) -> int:
+        return len(self._blocks)
+
+    def put(self, block: BlockPayload) -> List[BlockPayload]:
+        """Insert; returns demoted blocks evicted to make room."""
+        size = block.data.nbytes
+        if size > self.budget:
+            return [block]  # doesn't fit at all: demote immediately
+        if block.block_hash in self._blocks:
+            self._blocks.move_to_end(block.block_hash)
+            return []
+        demoted: List[BlockPayload] = []
+        while self.used + size > self.budget and self._blocks:
+            _h, old = self._blocks.popitem(last=False)
+            self.used -= old.data.nbytes
+            demoted.append(old)
+        self._blocks[block.block_hash] = block
+        self.used += size
+        return demoted
+
+    def get(self, block_hash: int) -> Optional[BlockPayload]:
+        blk = self._blocks.get(block_hash)
+        if blk is not None:
+            self._blocks.move_to_end(block_hash)
+        return blk
+
+    def pop(self, block_hash: int) -> Optional[BlockPayload]:
+        blk = self._blocks.pop(block_hash, None)
+        if blk is not None:
+            self.used -= blk.data.nbytes
+        return blk
+
+
+class DiskTier:
+    """G3: one ``.npy``-style file per block under a directory, LRU by
+    insertion/access order, byte-budgeted."""
+
+    def __init__(self, path: str, budget_bytes: int):
+        self.path = path
+        self.budget = budget_bytes
+        self.used = 0
+        os.makedirs(path, exist_ok=True)
+        # hash -> (filename, nbytes, local_hash, parent_hash, dtype, shape)
+        self._index: "OrderedDict[int, Tuple]" = OrderedDict()
+
+    def __contains__(self, block_hash: int) -> bool:
+        return block_hash in self._index
+
+    def __len__(self) -> int:
+        return len(self._index)
+
+    def _file(self, block_hash: int) -> str:
+        return os.path.join(self.path, f"{block_hash:016x}.kvblk")
+
+    def put(self, block: BlockPayload) -> None:
+        size = block.data.nbytes
+        if size > self.budget:
+            return
+        if block.block_hash in self._index:
+            self._index.move_to_end(block.block_hash)
+            return
+        while self.used + size > self.budget and self._index:
+            h, (fn, nbytes, *_rest) = self._index.popitem(last=False)
+            self.used -= nbytes
+            try:
+                os.unlink(fn)
+            except OSError:
+                pass
+        fn = self._file(block.block_hash)
+        with open(fn, "wb") as f:
+            f.write(block.data.tobytes())
+        self._index[block.block_hash] = (
+            fn, size, block.local_hash, block.parent_hash,
+            str(block.data.dtype), block.data.shape)
+        self.used += size
+
+    def get(self, block_hash: int) -> Optional[BlockPayload]:
+        meta = self._index.get(block_hash)
+        if meta is None:
+            return None
+        fn, _nbytes, local, parent, dtype, shape = meta
+        try:
+            with open(fn, "rb") as f:
+                arr = np.frombuffer(f.read(), dtype=np.dtype(dtype))
+        except OSError:
+            self._index.pop(block_hash, None)
+            return None
+        self._index.move_to_end(block_hash)
+        return BlockPayload(block_hash=block_hash, local_hash=local,
+                            parent_hash=parent, data=arr.reshape(shape))
+
+
+__all__ = ["HostTier", "DiskTier"]
