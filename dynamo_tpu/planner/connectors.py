@@ -1,0 +1,101 @@
+"""Planner connectors: how scale decisions become running workers.
+
+Parity: reference ``planner/local_connector.py`` (circus process watchers) and
+``kubernetes_connector.py`` (DynamoGraphDeployment CRD patch). Here:
+
+- ``LocalConnector`` owns worker subprocesses directly (spawn / SIGTERM,
+  newest-first shrink) — no circus dependency.
+- ``KvConnector`` publishes the desired counts to the coordinator KV
+  (``planner/{namespace}/desired``); a cluster operator (the k8s
+  reconciler in deploy/) watches that key and patches the deployment —
+  same division of labor as the CRD patch without requiring a k8s API
+  in-process.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import signal
+import sys
+from typing import Dict, List, Optional, Sequence
+
+logger = logging.getLogger(__name__)
+
+
+def planner_desired_key(namespace: str) -> str:
+    return f"planner/{namespace}/desired"
+
+
+class LocalConnector:
+    """Spawns/terminates local worker processes to match desired counts."""
+
+    def __init__(self, prefill_cmd: Sequence[str], decode_cmd: Sequence[str],
+                 term_grace_s: float = 10.0):
+        self.prefill_cmd = list(prefill_cmd)
+        self.decode_cmd = list(decode_cmd)
+        self.term_grace_s = term_grace_s
+        self._fleets: Dict[str, List[asyncio.subprocess.Process]] = {
+            "prefill": [], "decode": []}
+
+    def counts(self) -> Dict[str, int]:
+        self._reap()
+        return {k: len(v) for k, v in self._fleets.items()}
+
+    def _reap(self) -> None:
+        for fleet in self._fleets.values():
+            fleet[:] = [p for p in fleet if p.returncode is None]
+
+    async def _spawn(self, role: str) -> None:
+        cmd = self.prefill_cmd if role == "prefill" else self.decode_cmd
+        proc = await asyncio.create_subprocess_exec(
+            *cmd, stdout=asyncio.subprocess.DEVNULL,
+            stderr=asyncio.subprocess.DEVNULL)
+        self._fleets[role].append(proc)
+        logger.info("spawned %s worker pid=%d", role, proc.pid)
+
+    async def _shrink(self, role: str, n: int) -> None:
+        """Terminate the n newest workers (oldest keep their warm caches)."""
+        for _ in range(n):
+            if not self._fleets[role]:
+                return
+            proc = self._fleets[role].pop()
+            try:
+                proc.terminate()
+            except ProcessLookupError:
+                continue
+            try:
+                await asyncio.wait_for(proc.wait(), timeout=self.term_grace_s)
+            except asyncio.TimeoutError:
+                proc.kill()
+            logger.info("stopped %s worker pid=%d", role, proc.pid)
+
+    async def scale(self, prefill: int, decode: int) -> None:
+        self._reap()
+        for role, want in (("prefill", prefill), ("decode", decode)):
+            have = len(self._fleets[role])
+            if want > have:
+                for _ in range(want - have):
+                    await self._spawn(role)
+            elif want < have:
+                await self._shrink(role, have - want)
+
+    async def close(self) -> None:
+        await self.scale(0, 0)
+
+
+class KvConnector:
+    """Publishes desired counts for an external reconciler (k8s operator)."""
+
+    def __init__(self, drt, namespace: str):
+        self.drt = drt
+        self.namespace = namespace
+
+    async def scale(self, prefill: int, decode: int) -> None:
+        await self.drt.coord.put(
+            planner_desired_key(self.namespace),
+            json.dumps({"prefill": prefill, "decode": decode}).encode())
+
+
+__all__ = ["LocalConnector", "KvConnector", "planner_desired_key"]

@@ -1,0 +1,78 @@
+"""Load predictors: forecast the next interval's request rate / token loads.
+
+Parity: reference ``planner/utils/load_predictor.py:36-132`` (constant,
+ARIMA, Prophet). The image carries neither statsmodels nor prophet, so the
+family here is dependency-free: constant (last value), EWMA, and a
+linear-trend regressor over a sliding window — covering the same use cases
+(steady, smoothed, trending load).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Deque, Optional
+
+import numpy as np
+
+
+class BasePredictor:
+    def __init__(self, window: int = 60):
+        self.history: Deque[float] = deque(maxlen=window)
+
+    def observe(self, value: float) -> None:
+        self.history.append(float(value))
+
+    def predict(self) -> Optional[float]:
+        raise NotImplementedError
+
+
+class ConstantPredictor(BasePredictor):
+    """Next value = last observed value."""
+
+    def predict(self) -> Optional[float]:
+        return self.history[-1] if self.history else None
+
+
+class EwmaPredictor(BasePredictor):
+    """Exponentially weighted moving average."""
+
+    def __init__(self, window: int = 60, alpha: float = 0.3):
+        super().__init__(window)
+        self.alpha = alpha
+        self._ewma: Optional[float] = None
+
+    def observe(self, value: float) -> None:
+        super().observe(value)
+        self._ewma = (value if self._ewma is None
+                      else self.alpha * value + (1 - self.alpha) * self._ewma)
+
+    def predict(self) -> Optional[float]:
+        return self._ewma
+
+
+class TrendPredictor(BasePredictor):
+    """Least-squares linear trend over the window, extrapolated one step;
+    clamped at zero (a rate can't be negative)."""
+
+    def predict(self) -> Optional[float]:
+        n = len(self.history)
+        if n == 0:
+            return None
+        if n < 3:
+            return self.history[-1]
+        y = np.asarray(self.history, np.float64)
+        x = np.arange(n, dtype=np.float64)
+        slope, intercept = np.polyfit(x, y, 1)
+        return max(0.0, slope * n + intercept)
+
+
+def make_predictor(kind: str, window: int = 60) -> BasePredictor:
+    kinds = {"constant": ConstantPredictor, "ewma": EwmaPredictor,
+             "trend": TrendPredictor}
+    if kind not in kinds:
+        raise ValueError(f"unknown predictor {kind!r}; choose {sorted(kinds)}")
+    return kinds[kind](window=window)
+
+
+__all__ = ["BasePredictor", "ConstantPredictor", "EwmaPredictor",
+           "TrendPredictor", "make_predictor"]

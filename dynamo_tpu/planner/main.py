@@ -1,0 +1,79 @@
+"""Planner process: ``python -m dynamo_tpu.planner.main``.
+
+Parity: reference ``planner_sla.py`` entrypoint. Scrapes the frontend's
+/metrics, predicts load, scales prefill/decode worker fleets through the
+chosen connector.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import shlex
+
+from dynamo_tpu.planner.connectors import KvConnector, LocalConnector
+from dynamo_tpu.planner.metrics_source import PrometheusSource
+from dynamo_tpu.planner.perf_interpolation import PerfInterpolator
+from dynamo_tpu.planner.planner_core import Planner, PlannerConfig, SloSpec
+from dynamo_tpu.utils.logging import configure_logging
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description="dynamo_tpu planner")
+    p.add_argument("--metrics-url", default="http://127.0.0.1:8080/metrics")
+    p.add_argument("--profile", required=True,
+                   help="perf profile JSON (see planner/perf_interpolation.py)")
+    p.add_argument("--interval", type=float, default=30.0)
+    p.add_argument("--predictor", default="ewma",
+                   choices=["constant", "ewma", "trend"])
+    p.add_argument("--ttft-slo", type=float, default=0.5)
+    p.add_argument("--itl-slo", type=float, default=0.05)
+    p.add_argument("--min-prefill", type=int, default=1)
+    p.add_argument("--max-prefill", type=int, default=16)
+    p.add_argument("--min-decode", type=int, default=1)
+    p.add_argument("--max-decode", type=int, default=16)
+    p.add_argument("--connector", choices=["local", "kv"], default="local")
+    p.add_argument("--prefill-cmd", default="",
+                   help="command line to spawn one prefill worker (local)")
+    p.add_argument("--decode-cmd", default="",
+                   help="command line to spawn one decode worker (local)")
+    p.add_argument("--coordinator", default=None,
+                   help="coordinator address (kv connector)")
+    p.add_argument("--namespace", default="dynamo")
+    return p
+
+
+async def amain(args: argparse.Namespace) -> None:
+    interp = PerfInterpolator.from_file(args.profile)
+    source = PrometheusSource(args.metrics_url)
+    if args.connector == "local":
+        if not args.prefill_cmd or not args.decode_cmd:
+            raise SystemExit("--prefill-cmd/--decode-cmd required for local")
+        connector = LocalConnector(shlex.split(args.prefill_cmd),
+                                   shlex.split(args.decode_cmd))
+    else:
+        from dynamo_tpu.runtime.runtime import DistributedRuntime
+        drt = await DistributedRuntime.create(coordinator=args.coordinator)
+        connector = KvConnector(drt, args.namespace)
+    planner = Planner(
+        PlannerConfig(interval_s=args.interval, predictor=args.predictor,
+                      min_prefill=args.min_prefill,
+                      max_prefill=args.max_prefill,
+                      min_decode=args.min_decode,
+                      max_decode=args.max_decode),
+        SloSpec(ttft_s=args.ttft_slo, itl_s=args.itl_slo),
+        interp, source, connector)
+    print("planner running", flush=True)
+    await planner.run()
+
+
+def main() -> None:
+    configure_logging()
+    try:
+        asyncio.run(amain(build_parser().parse_args()))
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,73 @@
+"""Perf interpolators: profiled engine behavior -> capacity estimates.
+
+Parity: reference ``planner/utils/perf_interpolation.py:20-146`` — the
+planner never guesses engine throughput; it interpolates pre-deployment
+profiling data (the ``profile_sla``-style sweep in
+``dynamo_tpu.planner.profile``). Two surfaces:
+
+- prefill: isl -> ttft_s and prefill throughput (tokens/s per replica)
+- decode: (concurrency, context) -> itl_s and decode throughput
+
+Profiles are plain dicts (JSON-serializable):
+  {"prefill": [{"isl": 512, "ttft_s": 0.08, "tokens_per_s": 60000}, ...],
+   "decode":  [{"concurrency": 8, "itl_s": 0.012, "tokens_per_s": 4000}, ...]}
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List
+
+import numpy as np
+
+
+def _interp(x: float, xs: List[float], ys: List[float]) -> float:
+    """Piecewise-linear with flat extrapolation (np.interp semantics)."""
+    return float(np.interp(x, xs, ys))
+
+
+class PerfInterpolator:
+    def __init__(self, profile: Dict[str, Any]):
+        pre = sorted(profile.get("prefill", []), key=lambda r: r["isl"])
+        dec = sorted(profile.get("decode", []),
+                     key=lambda r: r["concurrency"])
+        if not pre or not dec:
+            raise ValueError("profile needs non-empty 'prefill' and 'decode'")
+        self._pre_isl = [r["isl"] for r in pre]
+        self._pre_ttft = [r["ttft_s"] for r in pre]
+        self._pre_tps = [r["tokens_per_s"] for r in pre]
+        self._dec_conc = [r["concurrency"] for r in dec]
+        self._dec_itl = [r["itl_s"] for r in dec]
+        self._dec_tps = [r["tokens_per_s"] for r in dec]
+
+    @classmethod
+    def from_file(cls, path: str) -> "PerfInterpolator":
+        with open(path) as f:
+            return cls(json.load(f))
+
+    # -- prefill -----------------------------------------------------------
+
+    def ttft(self, isl: float) -> float:
+        return _interp(isl, self._pre_isl, self._pre_ttft)
+
+    def prefill_tokens_per_s(self, isl: float) -> float:
+        return _interp(isl, self._pre_isl, self._pre_tps)
+
+    # -- decode ------------------------------------------------------------
+
+    def itl(self, concurrency: float) -> float:
+        return _interp(concurrency, self._dec_conc, self._dec_itl)
+
+    def decode_tokens_per_s(self, concurrency: float) -> float:
+        return _interp(concurrency, self._dec_conc, self._dec_tps)
+
+    def max_concurrency_for_itl(self, itl_target_s: float) -> float:
+        """Highest profiled concurrency whose itl stays within target."""
+        best = self._dec_conc[0]
+        for c, itl in zip(self._dec_conc, self._dec_itl):
+            if itl <= itl_target_s:
+                best = c
+        return float(best)
+
+
+__all__ = ["PerfInterpolator"]

@@ -1,0 +1,174 @@
+"""The observe -> predict -> adjust loop.
+
+Parity: reference ``planner/utils/planner_core.py:131-245``: each interval,
+observe traffic (request rate, input/output lengths) and SLO attainment,
+predict the next interval with a load predictor, convert predicted load to
+replica counts through the perf interpolators with correction factors (how
+far off the last prediction was), clamp, and ask the connector to scale.
+
+Observation source is pluggable: ``MetricsSource.sample()`` returns a
+``TrafficSample`` — production wires the frontend's metrics endpoint or the
+coordinator stats plane; tests inject synthetic samples.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import math
+from dataclasses import dataclass, field
+from typing import Optional, Protocol
+
+from dynamo_tpu.planner.load_predictor import BasePredictor, make_predictor
+from dynamo_tpu.planner.perf_interpolation import PerfInterpolator
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class TrafficSample:
+    request_rate: float         # requests/s over the interval
+    avg_isl: float              # mean prompt tokens
+    avg_osl: float              # mean generated tokens
+    observed_ttft_s: Optional[float] = None
+    observed_itl_s: Optional[float] = None
+
+
+@dataclass
+class SloSpec:
+    ttft_s: float = 0.5
+    itl_s: float = 0.05
+
+
+@dataclass
+class PlannerConfig:
+    interval_s: float = 30.0
+    predictor: str = "ewma"
+    min_prefill: int = 1
+    max_prefill: int = 16
+    min_decode: int = 1
+    max_decode: int = 16
+    # headroom multiplier on computed need (serve bursts without thrash)
+    headroom: float = 1.15
+
+
+class Connector(Protocol):
+    async def scale(self, prefill: int, decode: int) -> None: ...
+
+
+class MetricsSource(Protocol):
+    async def sample(self) -> Optional[TrafficSample]: ...
+
+
+@dataclass
+class PlanDecision:
+    prefill: int
+    decode: int
+    predicted_rate: float
+
+
+class Planner:
+    def __init__(self, config: PlannerConfig, slo: SloSpec,
+                 interp: PerfInterpolator, source: MetricsSource,
+                 connector: Connector):
+        self.cfg = config
+        self.slo = slo
+        self.interp = interp
+        self.source = source
+        self.connector = connector
+        self.rate_pred: BasePredictor = make_predictor(config.predictor)
+        self.isl_pred: BasePredictor = make_predictor(config.predictor)
+        self.osl_pred: BasePredictor = make_predictor(config.predictor)
+        # correction factors: observed latency / interpolated latency
+        self.prefill_correction = 1.0
+        self.decode_correction = 1.0
+        self.current = PlanDecision(config.min_prefill, config.min_decode, 0.0)
+        self._task: Optional[asyncio.Task] = None
+
+    # -- the math ----------------------------------------------------------
+
+    def decide(self, sample: TrafficSample) -> PlanDecision:
+        self.rate_pred.observe(sample.request_rate)
+        self.isl_pred.observe(sample.avg_isl)
+        self.osl_pred.observe(sample.avg_osl)
+        rate = self.rate_pred.predict() or 0.0
+        isl = self.isl_pred.predict() or sample.avg_isl
+        osl = self.osl_pred.predict() or sample.avg_osl
+
+        # correction: how much slower reality is than the profile says
+        if sample.observed_ttft_s:
+            expect = max(1e-9, self.interp.ttft(isl))
+            self.prefill_correction = max(
+                0.25, min(4.0, sample.observed_ttft_s / expect))
+        if sample.observed_itl_s:
+            conc = rate * osl * self.interp.itl(1.0)  # rough concurrency
+            expect = max(1e-9, self.interp.itl(max(1.0, conc)))
+            self.decode_correction = max(
+                0.25, min(4.0, sample.observed_itl_s / expect))
+
+        # prefill replicas: token arrival rate / per-replica prefill rate
+        prefill_tps = self.interp.prefill_tokens_per_s(isl)
+        need_prefill = (rate * isl / max(prefill_tps, 1e-9)
+                        * self.prefill_correction * self.cfg.headroom)
+
+        # decode replicas: sustained concurrency / per-replica concurrency
+        # budget at the itl SLO (Little's law: concurrency = rate * osl * itl)
+        conc_budget = self.interp.max_concurrency_for_itl(
+            self.slo.itl_s / self.decode_correction)
+        itl = self.interp.itl(conc_budget)
+        concurrency = rate * osl * itl
+        need_decode = (concurrency / max(conc_budget, 1e-9)
+                       * self.cfg.headroom)
+
+        decision = PlanDecision(
+            prefill=min(self.cfg.max_prefill,
+                        max(self.cfg.min_prefill, math.ceil(need_prefill))),
+            decode=min(self.cfg.max_decode,
+                       max(self.cfg.min_decode, math.ceil(need_decode))),
+            predicted_rate=rate)
+        return decision
+
+    # -- the loop ----------------------------------------------------------
+
+    async def step(self) -> Optional[PlanDecision]:
+        sample = await self.source.sample()
+        if sample is None:
+            return None
+        decision = self.decide(sample)
+        if (decision.prefill != self.current.prefill
+                or decision.decode != self.current.decode):
+            logger.info("planner scaling: prefill %d->%d decode %d->%d "
+                        "(pred rate %.2f req/s)",
+                        self.current.prefill, decision.prefill,
+                        self.current.decode, decision.decode,
+                        decision.predicted_rate)
+            await self.connector.scale(decision.prefill, decision.decode)
+        self.current = decision
+        return decision
+
+    async def run(self) -> None:
+        while True:
+            try:
+                await self.step()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.exception("planner step failed")
+            await asyncio.sleep(self.cfg.interval_s)
+
+    def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.create_task(self.run())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+
+__all__ = ["Planner", "PlannerConfig", "SloSpec", "TrafficSample",
+           "PlanDecision"]
