@@ -82,7 +82,7 @@ class JaxEngine(ScheduledEngineBase):
 
     def __init__(self, model_cfg: ModelConfig, params,
                  config: Optional[JaxEngineConfig] = None,
-                 forward_fn: Callable = llama.forward):
+                 forward_fn: Optional[Callable] = None):
         self.model_cfg = model_cfg
         self.cfg = config or JaxEngineConfig()
         super().__init__(
@@ -91,7 +91,10 @@ class JaxEngine(ScheduledEngineBase):
             max_prefill_chunk=self.cfg.max_prefill_chunk,
             max_context=self.cfg.max_context)
         self.params = params
-        self._forward = forward_fn
+        from dynamo_tpu.models import get_family
+        family = get_family(model_cfg)
+        self._forward = forward_fn or family.forward
+        self._forward_unrolled = family.forward_unrolled
         impl = self.cfg.attn_impl
         if impl == "auto":
             impl = "pallas" if jax.devices()[0].platform == "tpu" else "scan"
@@ -126,7 +129,7 @@ class JaxEngine(ScheduledEngineBase):
             if self.attn_impl == "pallas" and tokens.shape[1] == 1:
                 from dynamo_tpu.ops.pallas import paged_decode_attention
                 attn = paged_decode_attention
-            logits, pages = llama.forward_unrolled(
+            logits, pages = self._forward_unrolled(
                 params, self.model_cfg, tokens, positions, pages,
                 page_table, total_lens, new_lens, attn_impl=attn)
         key = jax.random.fold_in(rng, step)
@@ -195,7 +198,9 @@ class JaxEngine(ScheduledEngineBase):
                     config: Optional[JaxEngineConfig] = None,
                     seed: int = 0) -> "JaxEngine":
         """Engine with random weights (tests / benchmarks)."""
-        params = llama.init_params(model_cfg, jax.random.PRNGKey(seed))
+        from dynamo_tpu.models import get_family
+        params = get_family(model_cfg).init_params(
+            model_cfg, jax.random.PRNGKey(seed))
         return cls(model_cfg, params, config)
 
 

@@ -1,11 +1,25 @@
 """Model family implementations (pure-functional jax).
 
-Each model exposes: ``init_params(cfg, rng)``, ``forward(params, cfg, ...)``
-over a paged KV cache, and an HF-checkpoint loader. The registry maps HF
-``model_type`` strings to implementations.
+Each family exposes ``init_params(cfg, rng)``, a scan ``forward`` and an
+unrolled ``forward_unrolled`` over the paged KV cache. ``get_family(cfg)``
+maps a config to its implementation: MoE configs (``num_experts > 0``,
+covering mixtral / qwen3_moe / deepseek-style routing) use
+``models.moe``; everything else in the Llama tree (llama 2/3, mistral,
+qwen2/qwen3) uses ``models.llama``.
 """
 
 from dynamo_tpu.models.config import ModelConfig
 from dynamo_tpu.models.llama import forward, init_params, make_pages
 
-__all__ = ["ModelConfig", "forward", "init_params", "make_pages"]
+
+def get_family(cfg: ModelConfig):
+    """Return the module implementing this config's model family."""
+    if cfg.num_experts:
+        from dynamo_tpu.models import moe
+        return moe
+    from dynamo_tpu.models import llama
+    return llama
+
+
+__all__ = ["ModelConfig", "forward", "init_params", "make_pages",
+           "get_family"]

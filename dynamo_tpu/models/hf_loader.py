@@ -4,6 +4,8 @@ Capability parity: reference ``lib/llm/src/local_model.rs`` resolves an HF repo
 directory for its engines; here the weights are actually consumed natively.
 Torch ``Linear`` stores [out, in]; we transpose to [in, out] and stack all
 layers on a leading axis (the ``lax.scan`` layout of ``models/llama.py``).
+MoE checkpoints (mixtral ``block_sparse_moe``, qwen3-moe ``mlp.experts``)
+additionally stack the expert axis: ``[L, E, ...]``.
 
 Sharded checkpoints (``model.safetensors.index.json``) are supported; tensors
 are loaded one file at a time to bound host RAM. Optionally a sharding pytree
@@ -15,7 +17,8 @@ from __future__ import annotations
 
 import json
 import os
-from typing import Any, Dict, List, Optional
+import re
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -41,7 +44,8 @@ def _checkpoint_files(path: str) -> List[str]:
     raise FileNotFoundError(f"no safetensors checkpoint under {path}")
 
 
-# HF tensor name -> (pytree path, transpose?). "{i}" is the layer index.
+# HF tensor name -> (pytree path, transpose?). "{i}" is the layer index,
+# "{j}" the expert index (expert tensors stack on a second axis).
 def _name_map(cfg: ModelConfig) -> Dict[str, Any]:
     m = {
         "model.embed_tokens.weight": (("embed",), False),
@@ -52,10 +56,37 @@ def _name_map(cfg: ModelConfig) -> Dict[str, Any]:
         "model.layers.{i}.self_attn.v_proj.weight": (("layers", "wv"), True),
         "model.layers.{i}.self_attn.o_proj.weight": (("layers", "wo"), True),
         "model.layers.{i}.post_attention_layernorm.weight": (("layers", "mlp_norm"), False),
-        "model.layers.{i}.mlp.gate_proj.weight": (("layers", "w_gate"), True),
-        "model.layers.{i}.mlp.up_proj.weight": (("layers", "w_up"), True),
-        "model.layers.{i}.mlp.down_proj.weight": (("layers", "w_down"), True),
     }
+    if cfg.num_experts:
+        if cfg.model_type == "mixtral":
+            m.update({
+                "model.layers.{i}.block_sparse_moe.gate.weight":
+                    (("layers", "w_router"), True),
+                # mixtral naming: w1 = gate, w3 = up, w2 = down
+                "model.layers.{i}.block_sparse_moe.experts.{j}.w1.weight":
+                    (("layers", "w_gate"), True),
+                "model.layers.{i}.block_sparse_moe.experts.{j}.w3.weight":
+                    (("layers", "w_up"), True),
+                "model.layers.{i}.block_sparse_moe.experts.{j}.w2.weight":
+                    (("layers", "w_down"), True),
+            })
+        else:  # qwen3_moe / deepseek-style naming
+            m.update({
+                "model.layers.{i}.mlp.gate.weight":
+                    (("layers", "w_router"), True),
+                "model.layers.{i}.mlp.experts.{j}.gate_proj.weight":
+                    (("layers", "w_gate"), True),
+                "model.layers.{i}.mlp.experts.{j}.up_proj.weight":
+                    (("layers", "w_up"), True),
+                "model.layers.{i}.mlp.experts.{j}.down_proj.weight":
+                    (("layers", "w_down"), True),
+            })
+    else:
+        m.update({
+            "model.layers.{i}.mlp.gate_proj.weight": (("layers", "w_gate"), True),
+            "model.layers.{i}.mlp.up_proj.weight": (("layers", "w_up"), True),
+            "model.layers.{i}.mlp.down_proj.weight": (("layers", "w_down"), True),
+        })
     if not cfg.tie_word_embeddings:
         m["lm_head.weight"] = (("lm_head",), True)
     if cfg.attention_bias:
@@ -68,16 +99,32 @@ def _name_map(cfg: ModelConfig) -> Dict[str, Any]:
     return m
 
 
-def _match(name: str, patterns: Dict[str, Any]):
+_EXPERT_RE = re.compile(r"experts\.(\d+)\.")
+
+
+def _match(name: str, patterns: Dict[str, Any]
+           ) -> Tuple[Any, Optional[int], Optional[int]]:
+    """Returns (spec, layer_index, expert_index)."""
     if name in patterns:
-        return patterns[name], None
-    if name.startswith("model.layers."):
-        rest = name[len("model.layers."):]
-        idx, _, tail = rest.partition(".")
-        key = f"model.layers.{{i}}.{tail}"
+        return patterns[name], None, None
+    if not name.startswith("model.layers."):
+        return None, None, None
+    rest = name[len("model.layers."):]
+    idx, _, tail = rest.partition(".")
+    try:
+        layer = int(idx)
+    except ValueError:
+        return None, None, None
+    key = f"model.layers.{{i}}.{tail}"
+    if key in patterns:
+        return patterns[key], layer, None
+    m = _EXPERT_RE.search(tail)
+    if m:
+        tail2 = tail.replace(f"experts.{m.group(1)}.", "experts.{j}.", 1)
+        key = f"model.layers.{{i}}.{tail2}"
         if key in patterns:
-            return patterns[key], int(idx)
-    return None, None
+            return patterns[key], layer, int(m.group(1))
+    return None, None, None
 
 
 def load_hf_params(cfg: ModelConfig, path: str,
@@ -85,17 +132,14 @@ def load_hf_params(cfg: ModelConfig, path: str,
     """Assemble the param pytree from an HF checkpoint directory."""
     if safe_open is None:  # pragma: no cover
         raise RuntimeError("safetensors not available")
-    if cfg.num_experts:
-        raise NotImplementedError(
-            "MoE checkpoints are loaded via dynamo_tpu.models.moe")
     patterns = _name_map(cfg)
-    # First pass: collect per-layer slices on host.
     staged: Dict[tuple, Any] = {}
     per_layer: Dict[tuple, Dict[int, np.ndarray]] = {}
+    per_expert: Dict[tuple, Dict[Tuple[int, int], np.ndarray]] = {}
     for f in _checkpoint_files(path):
         with safe_open(f, framework="np") as sf:
             for name in sf.keys():
-                spec, layer = _match(name, patterns)
+                spec, layer, expert = _match(name, patterns)
                 if spec is None:
                     continue
                 (tree_path, transpose) = spec
@@ -104,14 +148,28 @@ def load_hf_params(cfg: ModelConfig, path: str,
                     t = np.ascontiguousarray(t.T)
                 if layer is None:
                     staged[tree_path] = t
-                else:
+                elif expert is None:
                     per_layer.setdefault(tree_path, {})[layer] = t
+                else:
+                    per_expert.setdefault(tree_path, {})[(layer, expert)] = t
 
     for tree_path, by_layer in per_layer.items():
         missing = set(range(cfg.num_layers)) - set(by_layer)
         if missing:
             raise ValueError(f"checkpoint missing layers {sorted(missing)} for {tree_path}")
         staged[tree_path] = np.stack([by_layer[i] for i in range(cfg.num_layers)])
+
+    for tree_path, by_le in per_expert.items():
+        want = {(i, j) for i in range(cfg.num_layers)
+                for j in range(cfg.num_experts)}
+        missing = want - set(by_le)
+        if missing:
+            raise ValueError(
+                f"checkpoint missing {len(missing)} expert tensors for "
+                f"{tree_path} (e.g. {sorted(missing)[:3]})")
+        staged[tree_path] = np.stack([
+            np.stack([by_le[(i, j)] for j in range(cfg.num_experts)])
+            for i in range(cfg.num_layers)])
 
     # every expected weight family must have appeared — catches truncated
     # checkpoints and architectures whose tensor names we didn't map (which

@@ -141,13 +141,18 @@ def _project_qkv(cfg: ModelConfig, lp: Dict[str, jnp.ndarray],
     return q, k, v
 
 
+def _finish_attn(cfg: ModelConfig, lp: Dict[str, jnp.ndarray],
+                 h: jnp.ndarray, attn: jnp.ndarray) -> jnp.ndarray:
+    """Out-projection residual (shared with the MoE decoder)."""
+    B, S, _ = h.shape
+    return h + attn.reshape(B, S, cfg.q_size) @ lp["wo"]
+
+
 def _finish_layer(cfg: ModelConfig, lp: Dict[str, jnp.ndarray],
                   h: jnp.ndarray, attn: jnp.ndarray) -> jnp.ndarray:
     """Shared post-attention math: out-proj residual + gated MLP residual."""
-    B, S, _ = h.shape
-    eps = cfg.rms_norm_eps
-    h = h + attn.reshape(B, S, cfg.q_size) @ lp["wo"]
-    x = _rms_norm(h, lp["mlp_norm"], eps)
+    h = _finish_attn(cfg, lp, h, attn)
+    x = _rms_norm(h, lp["mlp_norm"], cfg.rms_norm_eps)
     return h + (jax.nn.silu(x @ lp["w_gate"]) * (x @ lp["w_up"])) @ lp["w_down"]
 
 

@@ -1,0 +1,141 @@
+"""Mixture-of-Experts decoder (mixtral, qwen3-moe, deepseek-style top-k).
+
+The reference serves MoE models only through external engines (wide-EP
+DeepSeek-R1 via SGLang DeepEP, SURVEY §2.7); here the MoE layer is native
+jax, sharing the Llama attention path (``models/llama.py`` helpers) and
+swapping the dense MLP for routed experts:
+
+- router: softmax over expert logits, top-k selection, optional
+  renormalization (``norm_topk_prob``).
+- experts computed densely (every expert over every token) with the routing
+  weights applied as a mask — simple, fully static shapes, and under GSPMD
+  the expert axis shards over ``ep`` so each chip computes only its local
+  experts, with XLA inserting the combine all-reduce. This is the right
+  trade at serving batch sizes (decode steps see tens of tokens); a
+  capacity-based dispatch kernel is the later optimization, not a different
+  architecture.
+
+Weight layout (stacked for scan): ``w_router [L, H, E]``,
+``w_gate/w_up [L, E, H, I]``, ``w_down [L, E, I, H]``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.models.llama import (
+    Params,
+    _finish_attn,
+    _logits,
+    _project_qkv,
+    _rms_norm,
+)
+from dynamo_tpu.models import llama
+from dynamo_tpu.ops.attention import (
+    paged_attention,
+    paged_attention_layer,
+    write_kv,
+    write_kv_layer,
+)
+
+
+def moe_mlp(cfg: ModelConfig, lp: Dict[str, jnp.ndarray],
+            x: jnp.ndarray) -> jnp.ndarray:
+    """Routed expert MLP. x: [B, S, H] (already normed) -> [B, S, H]."""
+    k = cfg.num_experts_per_tok
+    logits = x @ lp["w_router"]                     # [B, S, E]
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    top_w, top_i = jax.lax.top_k(probs, k)          # [B, S, k]
+    if cfg.norm_topk_prob:
+        top_w = top_w / jnp.sum(top_w, axis=-1, keepdims=True)
+    # dense per-expert weights [B, S, E] (zero for unrouted experts)
+    weights = jnp.sum(
+        jax.nn.one_hot(top_i, cfg.num_experts, dtype=jnp.float32)
+        * top_w[..., None], axis=2)                 # [B, S, E]
+    gate = jnp.einsum("bsh,ehi->bsei", x, lp["w_gate"])
+    up = jnp.einsum("bsh,ehi->bsei", x, lp["w_up"])
+    act = jax.nn.silu(gate) * up
+    out = jnp.einsum("bsei,eih->bseh", act, lp["w_down"])  # [B, S, E, H]
+    return jnp.einsum("bse,bseh->bsh", weights.astype(out.dtype), out)
+
+
+def _moe_layer_tail(cfg: ModelConfig, lp: Dict[str, jnp.ndarray],
+                    h: jnp.ndarray, attn: jnp.ndarray) -> jnp.ndarray:
+    h = _finish_attn(cfg, lp, h, attn)
+    x = _rms_norm(h, lp["mlp_norm"], cfg.rms_norm_eps)
+    return h + moe_mlp(cfg, lp, x)
+
+
+def init_params(cfg: ModelConfig, rng: jax.Array,
+                scale: float = 0.02) -> Params:
+    """Random init; attention/embedding weights come from llama.init_params,
+    dense-MLP weights are replaced by the expert stack."""
+    params = llama.init_params(cfg, rng, scale)
+    layers = params["layers"]
+    for k in ("w_gate", "w_up", "w_down"):
+        del layers[k]
+    dtype = jnp.dtype(cfg.dtype)
+    L, H, E = cfg.num_layers, cfg.hidden_size, cfg.num_experts
+    I = cfg.moe_intermediate_size or cfg.intermediate_size
+    keys = iter(jax.random.split(jax.random.fold_in(rng, 7), 4))
+
+    def randn(key, shape):
+        return (jax.random.normal(key, shape, jnp.float32) * scale).astype(dtype)
+
+    layers["w_router"] = randn(next(keys), (L, H, E))
+    layers["w_gate"] = randn(next(keys), (L, E, H, I))
+    layers["w_up"] = randn(next(keys), (L, E, H, I))
+    layers["w_down"] = randn(next(keys), (L, E, I, H))
+    return params
+
+
+def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
+            positions: jnp.ndarray, pages: jnp.ndarray,
+            page_table: jnp.ndarray, total_lens: jnp.ndarray,
+            new_lens: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Scan-over-layers MoE forward (same contract as llama.forward)."""
+    sm_scale = cfg.head_dim ** -0.5
+    h = params["embed"][tokens]
+
+    def body(carry, xs):
+        h, pages = carry
+        lp, lidx = xs
+        q, k, v = _project_qkv(cfg, lp, h, positions)
+        pages = write_kv(pages, lidx, k, v, page_table, positions, new_lens)
+        attn = paged_attention(q, pages, lidx, page_table, positions,
+                               total_lens, sm_scale)
+        h = _moe_layer_tail(cfg, lp, h, attn)
+        return (h, pages), None
+
+    (h, pages), _ = jax.lax.scan(
+        body, (h, pages), (params["layers"], jnp.arange(cfg.num_layers)))
+    return _logits(cfg, params, h, new_lens), pages
+
+
+def forward_unrolled(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
+                     positions: jnp.ndarray, pages_list: List[jnp.ndarray],
+                     page_table: jnp.ndarray, total_lens: jnp.ndarray,
+                     new_lens: jnp.ndarray,
+                     attn_impl: Optional[Callable] = None
+                     ) -> Tuple[jnp.ndarray, List[jnp.ndarray]]:
+    """Unrolled MoE forward (same contract as llama.forward_unrolled)."""
+    sm_scale = cfg.head_dim ** -0.5
+    attn_impl = attn_impl or paged_attention_layer
+    h = params["embed"][tokens]
+    out_pages: List[jnp.ndarray] = []
+    for l in range(cfg.num_layers):
+        lp = {k: v[l] for k, v in params["layers"].items()}
+        q, k, v = _project_qkv(cfg, lp, h, positions)
+        kv = write_kv_layer(pages_list[l], k, v, page_table, positions,
+                            new_lens)
+        attn = attn_impl(q, kv, page_table, positions, total_lens, sm_scale)
+        h = _moe_layer_tail(cfg, lp, h, attn)
+        out_pages.append(kv)
+    return _logits(cfg, params, h, new_lens), out_pages
+
+
+__all__ = ["forward", "forward_unrolled", "init_params", "moe_mlp"]

@@ -43,6 +43,7 @@ class ModelSharding:
         self.cfg = cfg
         self.mesh = mesh
         tp = mesh.shape.get("tp", 1)
+        ep = mesh.shape.get("ep", 1)
         if tp > 1:
             if cfg.num_kv_heads % tp:
                 raise ValueError(
@@ -51,6 +52,9 @@ class ModelSharding:
                 raise ValueError(
                     f"intermediate_size={cfg.intermediate_size} not divisible "
                     f"by tp={tp}")
+        if ep > 1 and cfg.num_experts % ep:
+            raise ValueError(
+                f"num_experts={cfg.num_experts} not divisible by ep={ep}")
 
     # -- specs -------------------------------------------------------------
 
@@ -66,6 +70,16 @@ class ModelSharding:
             "w_up": P(None, None, "tp"),
             "w_down": P(None, "tp", None),
         }
+        if self.cfg.num_experts:
+            # MoE: experts over ep, expert-FFN width over tp; the dense
+            # routed-compute einsums then run expert-local per chip with one
+            # combine all-reduce inserted by the partitioner
+            layers.update(
+                w_router=P(),
+                w_gate=P(None, "ep", None, "tp"),
+                w_up=P(None, "ep", None, "tp"),
+                w_down=P(None, "ep", "tp", None),
+            )
         if self.cfg.attention_bias:
             layers.update(bq=P(None, "tp"), bk=P(None, "tp"), bv=P(None, "tp"))
         if self.cfg.qk_norm:

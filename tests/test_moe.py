@@ -1,0 +1,202 @@
+"""MoE decoder tests: routing math, scan/unrolled parity, EP sharding,
+engine e2e, and HF checkpoint loading (synthesized safetensors)."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine.jax_engine import JaxEngine, JaxEngineConfig
+from dynamo_tpu.models import get_family, moe
+from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.models import llama
+from dynamo_tpu.parallel import MeshSpec, ModelSharding, make_mesh
+from dynamo_tpu.protocols.common import (
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+
+
+def moe_cfg(**kw):
+    d = dict(num_experts=4, num_experts_per_tok=2, moe_intermediate_size=32,
+             model_type="qwen3_moe")
+    d.update(kw)
+    return ModelConfig.tiny(**d)
+
+
+def test_family_registry():
+    assert get_family(moe_cfg()) is moe
+    assert get_family(ModelConfig.tiny()) is llama
+
+
+class TestMoeMlp:
+    def test_matches_naive_per_token_routing(self):
+        cfg = moe_cfg()
+        rng = jax.random.PRNGKey(0)
+        p = moe.init_params(cfg, rng)
+        lp = {k: v[0] for k, v in p["layers"].items()}
+        x = jax.random.normal(jax.random.PRNGKey(1), (1, 3, cfg.hidden_size),
+                              jnp.float32)
+        got = np.asarray(moe.moe_mlp(cfg, lp, x))
+
+        # naive reference: per token, softmax -> top-k -> weighted experts
+        xn = np.asarray(x, np.float64)
+        router = np.asarray(lp["w_router"], np.float64)
+        want = np.zeros_like(xn)
+        for b in range(xn.shape[0]):
+            for s in range(xn.shape[1]):
+                t = xn[b, s]
+                logits = t @ router
+                e = np.exp(logits - logits.max())
+                probs = e / e.sum()
+                top = np.argsort(-probs)[:cfg.num_experts_per_tok]
+                w = probs[top] / probs[top].sum()
+                acc = np.zeros(cfg.hidden_size)
+                for wi, ei in zip(w, top):
+                    g = t @ np.asarray(lp["w_gate"][ei], np.float64)
+                    u = t @ np.asarray(lp["w_up"][ei], np.float64)
+                    act = (g / (1 + np.exp(-g))) * u
+                    acc += wi * (act @ np.asarray(lp["w_down"][ei], np.float64))
+                want[b, s] = acc
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+class TestMoeForward:
+    def test_scan_matches_unrolled(self):
+        cfg = moe_cfg()
+        params = moe.init_params(cfg, jax.random.PRNGKey(0))
+        stacked = llama.make_pages(cfg, 8, 4)
+        layered = llama.make_pages_list(cfg, 8, 4)
+        B, S = 2, 8
+        tokens = jnp.arange(B * S, dtype=jnp.int32).reshape(B, S) % 100
+        positions = jnp.tile(jnp.arange(S, dtype=jnp.int32)[None], (B, 1))
+        table = jnp.array([[1, 2, 0], [3, 4, 0]], jnp.int32)
+        total = jnp.full((B,), S, jnp.int32)
+        new = jnp.full((B,), S, jnp.int32)
+        l1, _ = moe.forward(params, cfg, tokens, positions, stacked,
+                            table, total, new)
+        l2, _ = moe.forward_unrolled(params, cfg, tokens, positions, layered,
+                                     table, total, new)
+        np.testing.assert_allclose(np.asarray(l1), np.asarray(l2),
+                                   rtol=2e-5, atol=2e-5)
+
+
+def make_req(tokens, rid, max_tokens=5):
+    return PreprocessedRequest(
+        token_ids=list(tokens), request_id=rid,
+        stop_conditions=StopConditions(max_tokens=max_tokens),
+        sampling_options=SamplingOptions(temperature=0.0))
+
+
+class TestMoeEngine:
+    async def test_generates(self):
+        eng = JaxEngine.random_init(moe_cfg(), JaxEngineConfig(
+            num_pages=32, page_size=4, max_num_seqs=2, max_prefill_chunk=8,
+            max_context=64, min_prefill_bucket=4))
+        try:
+            frames = [f async for f in eng.generate(make_req(range(1, 10), "m"))]
+            toks = [t for f in frames for t in f.token_ids]
+            assert len(toks) == 5
+        finally:
+            await eng.stop()
+
+    async def test_ep_sharded_matches_unsharded(self):
+        cfg = moe_cfg()
+        prompt = list(range(1, 10))
+        base = JaxEngine.random_init(cfg, JaxEngineConfig(
+            num_pages=32, page_size=4, max_num_seqs=2, max_prefill_chunk=8,
+            max_context=64, min_prefill_bucket=4))
+        try:
+            want = []
+            async for f in base.generate(make_req(prompt, "b")):
+                want.extend(f.token_ids)
+        finally:
+            await base.stop()
+
+        mesh = make_mesh(MeshSpec(tp=2, ep=2), devices=jax.devices()[:4])
+        shard = ModelSharding(cfg, mesh)
+        params = moe.init_params(cfg, jax.random.PRNGKey(0))
+        eng = JaxEngine(cfg, params, JaxEngineConfig(
+            num_pages=32, page_size=4, max_num_seqs=2, max_prefill_chunk=8,
+            max_context=64, min_prefill_bucket=4,
+            shard_params_fn=shard.shard_params,
+            shard_pages_fn=shard.shard_pages))
+        try:
+            got = []
+            async for f in eng.generate(make_req(prompt, "e")):
+                got.extend(f.token_ids)
+        finally:
+            await eng.stop()
+        assert got == want
+
+
+class TestMoeLoader:
+    def test_load_synthesized_qwen3_moe_checkpoint(self, tmp_path):
+        from safetensors.numpy import save_file
+        from dynamo_tpu.models.hf_loader import load_hf_params
+        cfg = moe_cfg()
+        rng = np.random.default_rng(0)
+        H, I, E, L = (cfg.hidden_size, cfg.moe_intermediate_size,
+                      cfg.num_experts, cfg.num_layers)
+        Dq, Dkv = cfg.q_size, cfg.kv_size
+        tensors = {
+            "model.embed_tokens.weight":
+                rng.standard_normal((cfg.vocab_size, H), np.float32),
+            "model.norm.weight": np.ones(H, np.float32),
+            "lm_head.weight":
+                rng.standard_normal((cfg.vocab_size, H), np.float32),
+        }
+        for i in range(L):
+            pre = f"model.layers.{i}"
+            tensors[f"{pre}.input_layernorm.weight"] = np.ones(H, np.float32)
+            tensors[f"{pre}.post_attention_layernorm.weight"] = np.ones(H, np.float32)
+            tensors[f"{pre}.self_attn.q_proj.weight"] = \
+                rng.standard_normal((Dq, H), np.float32)
+            tensors[f"{pre}.self_attn.k_proj.weight"] = \
+                rng.standard_normal((Dkv, H), np.float32)
+            tensors[f"{pre}.self_attn.v_proj.weight"] = \
+                rng.standard_normal((Dkv, H), np.float32)
+            tensors[f"{pre}.self_attn.o_proj.weight"] = \
+                rng.standard_normal((H, Dq), np.float32)
+            tensors[f"{pre}.mlp.gate.weight"] = \
+                rng.standard_normal((E, H), np.float32)
+            for j in range(E):
+                tensors[f"{pre}.mlp.experts.{j}.gate_proj.weight"] = \
+                    rng.standard_normal((I, H), np.float32)
+                tensors[f"{pre}.mlp.experts.{j}.up_proj.weight"] = \
+                    rng.standard_normal((I, H), np.float32)
+                tensors[f"{pre}.mlp.experts.{j}.down_proj.weight"] = \
+                    rng.standard_normal((H, I), np.float32)
+        save_file(tensors, str(tmp_path / "model.safetensors"))
+
+        params = load_hf_params(cfg, str(tmp_path))
+        assert params["layers"]["w_gate"].shape == (L, E, H, I)
+        assert params["layers"]["w_router"].shape == (L, H, E)
+        # transpose sanity: expert 2 gate row-major round trip
+        np.testing.assert_allclose(
+            np.asarray(params["layers"]["w_gate"][1, 2]),
+            tensors["model.layers.1.mlp.experts.2.gate_proj.weight"].T,
+            rtol=1e-6)
+        # loaded params must run
+        pages = llama.make_pages(cfg, 4, 4)
+        toks = jnp.array([[1, 2, 3]], jnp.int32)
+        pos = jnp.array([[0, 1, 2]], jnp.int32)
+        table = jnp.array([[1]], jnp.int32)
+        logits, _ = moe.forward(params, cfg, toks, pos, pages, table,
+                                jnp.array([3], jnp.int32),
+                                jnp.array([3], jnp.int32))
+        assert logits.shape == (1, cfg.vocab_size)
+
+    def test_missing_expert_tensor_rejected(self, tmp_path):
+        from safetensors.numpy import save_file
+        from dynamo_tpu.models.hf_loader import load_hf_params
+        cfg = moe_cfg()
+        save_file({"model.embed_tokens.weight":
+                   np.zeros((cfg.vocab_size, cfg.hidden_size), np.float32)},
+                  str(tmp_path / "model.safetensors"))
+        with pytest.raises(ValueError):
+            load_hf_params(cfg, str(tmp_path))
