@@ -1,0 +1,101 @@
+"""Per-process system HTTP server: /health, /live, /metrics.
+
+Parity: reference ``lib/runtime/src/http_server.rs:104-140`` — every process
+(worker, frontend, router) can expose a small operational server, enabled by
+``DYN_SYSTEM_ENABLED=1`` on port ``DYN_SYSTEM_PORT`` (0 = ephemeral).
+Health is endpoint-gated like the reference's ``SystemHealth``: the process
+is "ready" once every registered subsystem reports ready.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Callable, Dict, Optional
+
+from aiohttp import web
+from prometheus_client import CollectorRegistry, generate_latest
+
+logger = logging.getLogger(__name__)
+
+
+class SystemHealth:
+    """Named readiness flags; unhealthy until every flag is set."""
+
+    def __init__(self) -> None:
+        self._ready: Dict[str, bool] = {}
+
+    def register(self, name: str, ready: bool = False) -> None:
+        self._ready[name] = ready
+
+    def set_ready(self, name: str, ready: bool = True) -> None:
+        self._ready[name] = ready
+
+    @property
+    def healthy(self) -> bool:
+        return all(self._ready.values()) if self._ready else True
+
+    def snapshot(self) -> Dict[str, bool]:
+        return dict(self._ready)
+
+
+class SystemServer:
+    def __init__(self, health: Optional[SystemHealth] = None,
+                 registry: Optional[CollectorRegistry] = None,
+                 extra_metrics: Optional[Callable[[], bytes]] = None,
+                 host: str = "0.0.0.0", port: int = 0):
+        self.health = health or SystemHealth()
+        self.registry = registry
+        self.extra_metrics = extra_metrics
+        self.host = host
+        self.port = port
+        self.app = web.Application()
+        self.app.router.add_get("/health", self.handle_health)
+        self.app.router.add_get("/live", self.handle_live)
+        self.app.router.add_get("/metrics", self.handle_metrics)
+        self._runner: Optional[web.AppRunner] = None
+
+    @classmethod
+    def from_env(cls, **kwargs) -> Optional["SystemServer"]:
+        """None unless DYN_SYSTEM_ENABLED is truthy."""
+        if os.environ.get("DYN_SYSTEM_ENABLED", "").lower() not in (
+                "1", "true", "yes"):
+            return None
+        port = int(os.environ.get("DYN_SYSTEM_PORT", "0"))
+        return cls(port=port, **kwargs)
+
+    async def start(self) -> "SystemServer":
+        self._runner = web.AppRunner(self.app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        for s in self._runner.sites:
+            self.port = s._server.sockets[0].getsockname()[1]
+        logger.info("system server on %s:%d", self.host, self.port)
+        return self
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+
+    async def handle_health(self, request: web.Request) -> web.Response:
+        ok = self.health.healthy
+        return web.json_response(
+            {"status": "healthy" if ok else "unhealthy",
+             "subsystems": self.health.snapshot()},
+            status=200 if ok else 503)
+
+    async def handle_live(self, request: web.Request) -> web.Response:
+        return web.json_response({"status": "live"})
+
+    async def handle_metrics(self, request: web.Request) -> web.Response:
+        body = b""
+        if self.registry is not None:
+            body += generate_latest(self.registry)
+        if self.extra_metrics is not None:
+            body += self.extra_metrics()
+        return web.Response(body=body, content_type="text/plain")
+
+
+__all__ = ["SystemServer", "SystemHealth"]

@@ -136,12 +136,19 @@ async def amain(args: argparse.Namespace) -> None:
         await register_llm(drt, endpoint, card, model_type="prefill")
     else:
         await register_llm(drt, endpoint, card)
+    from dynamo_tpu.runtime.system_server import SystemServer
+    system = SystemServer.from_env()
+    if system is not None:
+        system.health.register("engine", ready=True)
+        await system.start()
     print(f"jax worker serving model {card.name} "
           f"on {len(jax.devices())} device(s) (disagg={args.disagg})",
           flush=True)
     try:
         await drt.runtime.wait_shutdown()
     finally:
+        if system is not None:
+            await system.stop()
         if handler is not None:
             await handler.stop()
         await engine.stop()
