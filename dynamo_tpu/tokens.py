@@ -31,6 +31,11 @@ from typing import Iterable, List, Optional, Sequence
 
 import xxhash
 
+try:  # native hot path (see native/dynamo_native.c); python is the fallback
+    from dynamo_tpu import _native
+except ImportError:  # pragma: no cover — image without the built extension
+    _native = None
+
 HASH_SEED = 1337
 
 
@@ -63,6 +68,9 @@ def compute_block_hash_for_seq(
     """
     if block_size <= 0:
         raise ValueError(f"block_size must be positive, got {block_size}")
+    if _native is not None:
+        return _native.chained_block_hashes(list(tokens), block_size,
+                                            salt_hash, HASH_SEED)
     out: List[int] = []
     parent = salt_hash
     for start in range(0, len(tokens) - block_size + 1, block_size):

@@ -1,0 +1,149 @@
+/* Native hot paths for dynamo_tpu.
+ *
+ * Role parity with the reference's native components: where the reference
+ * keeps its hashing/indexing hot loops in Rust (lib/llm/src/tokens.rs,
+ * kv_router/indexer.rs xxh3 block hashing), this extension implements the
+ * same chained-block-hash scheme in C behind the CPython API. The Python
+ * implementation in dynamo_tpu/tokens.py remains the reference/fallback;
+ * byte-for-byte hash equality between the two is enforced by tests.
+ *
+ * Hash scheme (must match tokens.py exactly):
+ *   block_hash[i] = XXH3_64(le64(parent) || le32(tok)*block_size, seed)
+ *   parent = salt_hash for the first block, previous block_hash after.
+ */
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+#include <stdint.h>
+#include <string.h>
+
+#define XXH_INLINE_ALL
+#include "xxhash.h"
+
+static const uint64_t DEFAULT_SEED = 1337;
+
+static void
+write_le64(uint8_t *dst, uint64_t v)
+{
+    for (int i = 0; i < 8; i++)
+        dst[i] = (uint8_t)(v >> (8 * i));
+}
+
+static void
+write_le32(uint8_t *dst, uint32_t v)
+{
+    for (int i = 0; i < 4; i++)
+        dst[i] = (uint8_t)(v >> (8 * i));
+}
+
+/* chained_block_hashes(tokens, block_size, salt_hash=0, seed=1337)
+ *   -> list[int] (one chained hash per complete block) */
+static PyObject *
+chained_block_hashes(PyObject *self, PyObject *args)
+{
+    PyObject *tokens_obj;
+    Py_ssize_t block_size;
+    unsigned long long salt = 0, seed = DEFAULT_SEED;
+    if (!PyArg_ParseTuple(args, "On|KK", &tokens_obj, &block_size,
+                          &salt, &seed))
+        return NULL;
+    if (block_size <= 0) {
+        PyErr_SetString(PyExc_ValueError, "block_size must be positive");
+        return NULL;
+    }
+    PyObject *fast = PySequence_Fast(tokens_obj, "tokens must be a sequence");
+    if (fast == NULL)
+        return NULL;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(fast);
+    Py_ssize_t nblocks = n / block_size;
+    PyObject *out = PyList_New(nblocks);
+    if (out == NULL) {
+        Py_DECREF(fast);
+        return NULL;
+    }
+    size_t payload_len = 8 + (size_t)block_size * 4;
+    uint8_t *payload = (uint8_t *)PyMem_Malloc(payload_len);
+    if (payload == NULL) {
+        Py_DECREF(fast);
+        Py_DECREF(out);
+        return PyErr_NoMemory();
+    }
+    uint64_t parent = (uint64_t)salt;
+    PyObject **items = PySequence_Fast_ITEMS(fast);
+    for (Py_ssize_t b = 0; b < nblocks; b++) {
+        write_le64(payload, parent);
+        for (Py_ssize_t i = 0; i < block_size; i++) {
+            /* matches python's (t & 0xFFFFFFFF), including negatives */
+            unsigned long long t =
+                PyLong_AsUnsignedLongLongMask(items[b * block_size + i]);
+            if (t == (unsigned long long)-1 && PyErr_Occurred())
+                goto fail;
+            write_le32(payload + 8 + i * 4, (uint32_t)t);
+        }
+        parent = XXH3_64bits_withSeed(payload, payload_len, (uint64_t)seed);
+        PyObject *h = PyLong_FromUnsignedLongLong(parent);
+        if (h == NULL)
+            goto fail;
+        PyList_SET_ITEM(out, b, h);
+    }
+    PyMem_Free(payload);
+    Py_DECREF(fast);
+    return out;
+fail:
+    PyMem_Free(payload);
+    Py_DECREF(fast);
+    Py_DECREF(out);
+    return NULL;
+}
+
+/* local_block_hash(tokens, seed=1337) -> int (unchained hash of tokens) */
+static PyObject *
+local_block_hash(PyObject *self, PyObject *args)
+{
+    PyObject *tokens_obj;
+    unsigned long long seed = DEFAULT_SEED;
+    if (!PyArg_ParseTuple(args, "O|K", &tokens_obj, &seed))
+        return NULL;
+    PyObject *fast = PySequence_Fast(tokens_obj, "tokens must be a sequence");
+    if (fast == NULL)
+        return NULL;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(fast);
+    uint8_t *buf = (uint8_t *)PyMem_Malloc((size_t)n * 4);
+    if (buf == NULL) {
+        Py_DECREF(fast);
+        return PyErr_NoMemory();
+    }
+    PyObject **items = PySequence_Fast_ITEMS(fast);
+    for (Py_ssize_t i = 0; i < n; i++) {
+        unsigned long long t = PyLong_AsUnsignedLongLongMask(items[i]);
+        if (t == (unsigned long long)-1 && PyErr_Occurred()) {
+            PyMem_Free(buf);
+            Py_DECREF(fast);
+            return NULL;
+        }
+        write_le32(buf + i * 4, (uint32_t)t);
+    }
+    uint64_t h = XXH3_64bits_withSeed(buf, (size_t)n * 4, (uint64_t)seed);
+    PyMem_Free(buf);
+    Py_DECREF(fast);
+    return PyLong_FromUnsignedLongLong(h);
+}
+
+static PyMethodDef methods[] = {
+    {"chained_block_hashes", chained_block_hashes, METH_VARARGS,
+     "chained_block_hashes(tokens, block_size, salt_hash=0, seed=1337)"},
+    {"local_block_hash", local_block_hash, METH_VARARGS,
+     "local_block_hash(tokens, seed=1337)"},
+    {NULL, NULL, 0, NULL},
+};
+
+static struct PyModuleDef module = {
+    PyModuleDef_HEAD_INIT, "_native",
+    "Native hot paths (chained xxh3 block hashing).", -1, methods,
+};
+
+PyMODINIT_FUNC
+PyInit__native(void)
+{
+    return PyModule_Create(&module);
+}

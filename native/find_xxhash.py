@@ -1,0 +1,42 @@
+"""Locate a vanilla xxhash.h single-header copy in the image (no network)."""
+
+import os
+import sys
+
+CANDIDATES = [
+    "/usr/include",
+    "/usr/local/include",
+]
+
+
+def vendored() -> list:
+    out = []
+    try:
+        import tensorflow  # noqa: F401  (only for its include tree)
+        tf_dir = os.path.dirname(tensorflow.__file__)
+        out.append(os.path.join(
+            tf_dir, "include", "external", "com_github_grpc_grpc",
+            "third_party", "xxhash"))
+    except Exception:
+        pass
+    try:
+        import pyarrow
+        pa_dir = os.path.dirname(pyarrow.__file__)
+        out.append(os.path.join(pa_dir, "include", "arrow", "vendored",
+                                "xxhash"))
+    except Exception:
+        pass
+    return out
+
+
+def main() -> None:
+    for d in CANDIDATES + vendored():
+        if os.path.exists(os.path.join(d, "xxhash.h")):
+            print(d)
+            return
+    print("")
+    sys.exit(0)
+
+
+if __name__ == "__main__":
+    main()

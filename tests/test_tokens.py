@@ -95,3 +95,51 @@ def test_determinism_across_instances():
     h2 = compute_block_hash_for_seq(t, 4, salt_hash=42)
     assert h1 == h2
     assert all(isinstance(h, int) and h > 0 for h in h1)
+
+
+class TestNativeHashing:
+    """The C extension must match the pure-python hashing bit-for-bit."""
+
+    def test_native_available(self):
+        from dynamo_tpu import tokens as T
+        assert T._native is not None, "native extension not built (make -C native)"
+
+    def test_chained_parity_with_python(self):
+        import struct
+        import xxhash
+        from dynamo_tpu import tokens as T
+
+        def python_chained(toks, bs, salt):
+            out, parent = [], salt
+            for start in range(0, len(toks) - bs + 1, bs):
+                chunk = toks[start:start + bs]
+                payload = struct.pack("<Q", parent) + struct.pack(
+                    f"<{len(chunk)}I", *[t & 0xFFFFFFFF for t in chunk])
+                parent = xxhash.xxh3_64_intdigest(payload, seed=T.HASH_SEED)
+                out.append(parent)
+            return out
+
+        cases = [
+            (list(range(100)), 16, 0),
+            (list(range(33)), 4, 12345),
+            ([2**31, 2**32 - 1, -1, 0, 7, 9, 11, 13], 4, 0),
+            ([], 16, 0),
+            ([1, 2, 3], 16, 0),  # no complete block
+        ]
+        for toks, bs, salt in cases:
+            assert T.compute_block_hash_for_seq(toks, bs, salt) == \
+                python_chained(toks, bs, salt), (toks, bs, salt)
+
+    def test_local_hash_parity(self):
+        from dynamo_tpu import tokens as T
+        if T._native is None:
+            pytest.skip("native extension not built")
+        toks = [5, 6, 7, 8]
+        assert T._native.local_block_hash(toks, T.HASH_SEED) == \
+            T.compute_local_block_hash(toks)
+
+    def test_sequence_blocks_match_native_chain(self):
+        from dynamo_tpu import tokens as T
+        toks = list(range(64))
+        seq = T.TokenBlockSequence(toks, block_size=16, salt_hash=9)
+        assert seq.block_hashes() == T.compute_block_hash_for_seq(toks, 16, 9)
