@@ -136,13 +136,18 @@ class Coordinator:
         await reap_task(self._lease_task)
         if self._server:
             self._server.close()
-            await self._server.wait_closed()
+        # close live connections BEFORE wait_closed(): on py3.12 wait_closed
+        # blocks until every connection handler exits, and handlers sit in
+        # read() until their socket dies — the old order deadlocked when a
+        # client was still attached (e.g. killing a coordinator under load)
         for conn in list(self._conns):
             conn.alive = False
             try:
                 conn.writer.close()
             except Exception:
                 pass
+        if self._server:
+            await self._server.wait_closed()
 
     async def __aenter__(self) -> "Coordinator":
         return await self.start()
@@ -534,13 +539,28 @@ class CoordClient:
     async def _call(self, op: str, **kw: Any) -> Dict[str, Any]:
         if self._writer is None:
             raise ConnectionError("not connected")
+        if self.closed.is_set():
+            raise ConnectionError("coordinator connection lost")
         rid = next(self._rids)
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[rid] = fut
         frame = {"op": op, "rid": rid, **kw}
         async with self._wlock:
             await send_frame(self._writer, frame)
-        resp = await fut
+        # A dead connection may accept the write (TCP buffering) while the
+        # read loop has already torn down — or tears down after we register
+        # the future but before the reply. Racing against `closed` turns
+        # every such case into a prompt ConnectionError instead of a hang.
+        closed_wait = asyncio.ensure_future(self.closed.wait())
+        try:
+            done, _ = await asyncio.wait({fut, closed_wait},
+                                         return_when=asyncio.FIRST_COMPLETED)
+            if fut not in done:
+                self._pending.pop(rid, None)
+                raise ConnectionError("coordinator connection lost")
+            resp = fut.result()
+        finally:
+            closed_wait.cancel()
         if not resp.get("ok"):
             raise RuntimeError(f"coordinator {op} failed: {resp.get('error')}")
         return resp

@@ -1,0 +1,203 @@
+"""Fault-tolerance scenarios: kill components mid-load, assert recovery.
+
+Parity with the reference's fault-injection suite
+(``tests/fault_tolerance/`` — kill decode worker / frontend / etcd under
+load, measure recovery): here the scenarios run in-process against real
+runtime objects, so each failure mode is provoked deterministically:
+
+- worker death mid-stream  -> migration operator replays on a survivor
+- worker death, no survivor -> clean error after migration budget
+- lease expiry              -> instance disappears from clients
+- coordinator death         -> worker runtime shuts itself down (critical
+                               task supervision), clients fail fast
+- leader/worker barrier     -> rendezvous, abort, crash-resilience
+"""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.llm.pipeline import RemotePipeline
+from dynamo_tpu.llm.register import register_llm, serve_engine
+from dynamo_tpu.mocker import MockEngineArgs, MockerEngine
+from dynamo_tpu.protocols.common import (
+    FinishReason,
+    LLMEngineOutput,
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.runtime.barrier import (
+    BarrierError,
+    leader_barrier,
+    worker_barrier,
+)
+from dynamo_tpu.runtime.coordinator import Coordinator
+from dynamo_tpu.runtime.push_router import PushRouter, RouterMode
+from dynamo_tpu.runtime.runtime import DistributedRuntime
+from dynamo_tpu.utils.testing import make_test_card
+
+
+def make_req(tokens, rid, max_tokens=30):
+    return PreprocessedRequest(
+        token_ids=list(tokens), request_id=rid,
+        stop_conditions=StopConditions(max_tokens=max_tokens),
+        sampling_options=SamplingOptions(temperature=0.0))
+
+
+async def start_slow_worker(coordinator, name="m", decode_s=0.05):
+    """Mocker worker with real-time decode pacing so we can kill mid-stream."""
+    drt = await DistributedRuntime.create(coordinator=coordinator)
+    engine = MockerEngine(MockEngineArgs(
+        num_pages=64, page_size=4, max_num_seqs=8, max_prefill_chunk=32,
+        max_context=256, speedup_ratio=1.0, prefill_base_s=0.001,
+        prefill_per_token_s=0.0, decode_base_s=decode_s, decode_per_seq_s=0.0))
+    card = make_test_card(name=name, kv_cache_block_size=4)
+    ep = drt.namespace("ns").component("w").endpoint("generate")
+    await serve_engine(ep, engine)
+    await register_llm(drt, ep, card)
+    return drt, engine
+
+
+class TestWorkerDeathMidStream:
+    async def test_migration_completes_on_survivor(self):
+        coord = await Coordinator(port=0).start()
+        drts = []
+        try:
+            w1, e1 = await start_slow_worker(coord.address)
+            w2, e2 = await start_slow_worker(coord.address)
+            drts += [w1, w2]
+            fe = await DistributedRuntime.create(coordinator=coord.address)
+            drts.append(fe)
+            client = await (fe.namespace("ns").component("w")
+                            .endpoint("generate").client())
+            await client.wait_for_instances(2, timeout=10)
+            card = make_test_card(name="m", kv_cache_block_size=4)
+            pipeline = RemotePipeline(card, PushRouter(client),
+                                      migration_limit=3)
+
+            req = make_req(range(1, 10), "r1", max_tokens=30)
+            frames = []
+            killed = False
+            async for out in pipeline.engine_stream(req):
+                frames.append(out)
+                n = sum(len(f.token_ids) for f in frames)
+                if n >= 5 and not killed:
+                    killed = True
+                    # kill whichever worker is serving (it has active slots)
+                    for drt, eng in ((w1, e1), (w2, e2)):
+                        if eng.scheduler.active:
+                            await drt.close()
+                            break
+            toks = [t for f in frames for t in f.token_ids]
+            assert len(toks) == 30  # completed despite the mid-stream kill
+            assert frames[-1].finish_reason == FinishReason.LENGTH
+        finally:
+            for d in drts:
+                try:
+                    await d.close()
+                except Exception:
+                    pass
+            await coord.stop()
+
+    async def test_error_after_migration_budget_exhausted(self):
+        coord = await Coordinator(port=0).start()
+        drts = []
+        try:
+            w1, e1 = await start_slow_worker(coord.address)
+            drts.append(w1)
+            fe = await DistributedRuntime.create(coordinator=coord.address)
+            drts.append(fe)
+            client = await (fe.namespace("ns").component("w")
+                            .endpoint("generate").client())
+            await client.wait_for_instances(1, timeout=10)
+            card = make_test_card(name="m", kv_cache_block_size=4)
+            pipeline = RemotePipeline(card, PushRouter(client),
+                                      migration_limit=1)
+            req = make_req(range(1, 10), "r1", max_tokens=50)
+            frames = []
+            async for out in pipeline.engine_stream(req):
+                frames.append(out)
+                if sum(len(f.token_ids) for f in frames) >= 3:
+                    if not w1.runtime.is_shutdown:
+                        await w1.close()  # only worker dies; nobody to migrate to
+            assert frames[-1].finish_reason == FinishReason.ERROR
+            assert "migrations" in (frames[-1].error or "")
+        finally:
+            for d in drts:
+                try:
+                    await d.close()
+                except Exception:
+                    pass
+            await coord.stop()
+
+
+class TestLeaseExpiry:
+    async def test_instance_vanishes_after_worker_death(self):
+        coord = await Coordinator(port=0).start()
+        try:
+            w, _e = await start_slow_worker(coord.address)
+            fe = await DistributedRuntime.create(coordinator=coord.address)
+            client = await (fe.namespace("ns").component("w")
+                            .endpoint("generate").client())
+            await client.wait_for_instances(1, timeout=10)
+            await w.close()  # revokes the lease -> keys deleted
+            for _ in range(100):
+                if not client.instance_ids():
+                    break
+                await asyncio.sleep(0.1)
+            assert client.instance_ids() == []
+            await fe.close()
+        finally:
+            await coord.stop()
+
+
+class TestCoordinatorDeath:
+    async def test_worker_shuts_down_on_lost_lease(self):
+        coord = await Coordinator(port=0).start()
+        w, _e = await start_slow_worker(coord.address)
+        assert not w.runtime.is_shutdown
+        await coord.stop()  # coordinator gone: keepalive fails -> lease lost
+        for _ in range(150):
+            if w.runtime.is_shutdown:
+                break
+            await asyncio.sleep(0.1)
+        assert w.runtime.is_shutdown
+        await w.close()
+
+
+class TestBarrier:
+    async def test_rendezvous_delivers_leader_data(self):
+        coord = await Coordinator(port=0).start()
+        try:
+            leader = await DistributedRuntime.create(coordinator=coord.address)
+            workers = [await DistributedRuntime.create(coordinator=coord.address)
+                       for _ in range(2)]
+            data = {"mesh": [2, 4], "leader_addr": "10.0.0.1:9999"}
+            results = await asyncio.gather(
+                leader_barrier(leader, "b1", data, num_workers=2, timeout=10),
+                worker_barrier(workers[0], "b1", "host1", timeout=10),
+                worker_barrier(workers[1], "b1", "host2", timeout=10))
+            assert results[1] == data and results[2] == data
+            for d in [leader] + workers:
+                await d.close()
+        finally:
+            await coord.stop()
+
+    async def test_leader_timeout_aborts_waiting_workers(self):
+        coord = await Coordinator(port=0).start()
+        try:
+            leader = await DistributedRuntime.create(coordinator=coord.address)
+            worker = await DistributedRuntime.create(coordinator=coord.address)
+            lead_task = asyncio.create_task(
+                leader_barrier(leader, "b2", {}, num_workers=3, timeout=0.5))
+            work_task = asyncio.create_task(
+                worker_barrier(worker, "b2", "only-one", timeout=10))
+            with pytest.raises(BarrierError):
+                await lead_task
+            with pytest.raises(BarrierError):
+                await work_task
+            await leader.close()
+            await worker.close()
+        finally:
+            await coord.stop()
