@@ -193,6 +193,37 @@ class JaxEngine(ScheduledEngineBase):
         self._step_counter += 1
         return np.asarray(sampled), np.asarray(logprobs)
 
+    # -- embeddings --------------------------------------------------------
+
+    def _embed_batch(self, token_lists) -> np.ndarray:
+        """Mean-pooled hidden-state embeddings (runs outside the scheduler;
+        embeddings are one-shot, no KV cache involvement)."""
+        from dynamo_tpu.models import get_family
+        family = get_family(self.model_cfg)
+        encode = getattr(family, "encode", None)
+        if encode is None:
+            raise NotImplementedError(
+                f"{self.model_cfg.model_type} has no embedding path")
+        if not hasattr(self, "_jit_encode"):
+            self._jit_encode = jax.jit(
+                lambda p, t, m: encode(p, self.model_cfg, t, m))
+        B = len(token_lists)
+        S = _bucket(max(len(t) for t in token_lists),
+                    self.cfg.min_prefill_bucket, self.cfg.max_prefill_chunk)
+        toks = np.zeros((B, S), np.int32)
+        mask = np.zeros((B, S), bool)
+        for i, ids in enumerate(token_lists):
+            n = min(len(ids), S)
+            toks[i, :n] = ids[:n]
+            mask[i, :n] = True
+        out = self._jit_encode(self.params, jnp.asarray(toks),
+                               jnp.asarray(mask))
+        return np.asarray(out)
+
+    async def embed(self, token_lists) -> np.ndarray:
+        import asyncio
+        return await asyncio.to_thread(self._embed_batch, token_lists)
+
     @classmethod
     def random_init(cls, model_cfg: ModelConfig,
                     config: Optional[JaxEngineConfig] = None,

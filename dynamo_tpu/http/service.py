@@ -56,6 +56,7 @@ class HttpService:
         self.app = web.Application(client_max_size=64 * 1024 * 1024)
         self.app.router.add_post("/v1/chat/completions", self.handle_chat)
         self.app.router.add_post("/v1/completions", self.handle_completions)
+        self.app.router.add_post("/v1/embeddings", self.handle_embeddings)
         self.app.router.add_get("/v1/models", self.handle_models)
         self.app.router.add_get("/health", self.handle_health)
         self.app.router.add_get("/live", self.handle_live)
@@ -104,6 +105,31 @@ class HttpService:
 
     def set_clear_kv_hook(self, hook) -> None:
         self._clear_kv_hook = hook
+
+    async def handle_embeddings(self, request: web.Request) -> web.Response:
+        from dynamo_tpu.protocols.openai import (
+            EmbeddingData, EmbeddingRequest, EmbeddingResponse)
+        try:
+            req = EmbeddingRequest.model_validate(await request.json())
+        except (ValidationError, json.JSONDecodeError, UnicodeDecodeError) as e:
+            return _error(400, f"invalid request: {e}")
+        pipeline = self.manager.get(req.model)
+        if pipeline is None:
+            return _error(404, f"model {req.model!r} not found")
+        try:
+            vectors, prompt_tokens = await pipeline.generate_embeddings(req)
+        except NotImplementedError as e:
+            return _error(501, str(e))
+        except Exception as e:  # noqa: BLE001
+            logger.exception("embeddings failed")
+            return _error(500, str(e), "internal_error")
+        resp = EmbeddingResponse(
+            data=[EmbeddingData(index=i, embedding=v)
+                  for i, v in enumerate(vectors)],
+            model=req.model,
+            usage=Usage(prompt_tokens=prompt_tokens,
+                        total_tokens=prompt_tokens))
+        return web.json_response(resp.model_dump(exclude_none=True))
 
     async def handle_chat(self, request: web.Request) -> web.StreamResponse:
         try:

@@ -85,6 +85,11 @@ class ServicePipeline:
                 preprocessed, self.engine_stream(preprocessed)):
             yield out
 
+    async def generate_embeddings(self, req) -> "tuple[list, int]":
+        """Tokenize the input(s) and embed. Returns (vectors, prompt_tokens).
+        Raises NotImplementedError when this pipeline's engine can't embed."""
+        raise NotImplementedError("this pipeline does not serve embeddings")
+
 
 class LocalEnginePipeline(ServicePipeline):
     """Pipeline with an in-process engine (reference: EngineConfig::StaticCore)."""
@@ -97,6 +102,23 @@ class LocalEnginePipeline(ServicePipeline):
                             ) -> AsyncIterator[LLMEngineOutput]:
         async for out in self.engine.generate(request):
             yield out
+
+    async def generate_embeddings(self, req) -> "tuple[list, int]":
+        embed = getattr(self.engine, "embed", None)
+        if embed is None:
+            raise NotImplementedError("engine has no embedding path")
+        inputs = req.input
+        if isinstance(inputs, str):
+            inputs = [inputs]
+        elif inputs and isinstance(inputs[0], int):
+            inputs = [inputs]  # single pre-tokenized prompt
+        token_lists = [
+            item if isinstance(item, list)
+            else self.preprocessor.tokenizer.encode(item)
+            for item in inputs]
+        vectors = await embed(token_lists)
+        return ([[float(x) for x in v] for v in vectors],
+                sum(len(t) for t in token_lists))
 
 
 class RemotePipeline(ServicePipeline):

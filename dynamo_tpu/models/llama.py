@@ -202,6 +202,44 @@ def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     return _logits(cfg, params, h, new_lens), pages
 
 
+def encode(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
+           mask: jnp.ndarray) -> jnp.ndarray:
+    """Dense (non-paged) forward for embeddings: mean-pooled final hidden
+    state over real tokens. tokens/mask: [B, S]; returns [B, H] float32.
+
+    Serves the /v1/embeddings surface (reference: ``http/service/openai.rs``
+    embeddings route; the reference delegates the model to an engine)."""
+    B, S = tokens.shape
+    sm_scale = cfg.head_dim ** -0.5
+    positions = jnp.tile(jnp.arange(S, dtype=jnp.int32)[None], (B, 1))
+    h = params["embed"][tokens]
+
+    causal = jnp.tril(jnp.ones((S, S), bool))
+    attn_mask = causal[None, None] & mask[:, None, None, :]  # [B,1,S,S]
+
+    def body(h, lp):
+        q, k, v = _project_qkv(cfg, lp, h, positions)
+        if cfg.num_kv_heads != cfg.num_heads:
+            rep = cfg.num_heads // cfg.num_kv_heads
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                            k.astype(jnp.float32)) * sm_scale
+        scores = jnp.where(attn_mask, scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        attn = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
+        attn = attn.astype(h.dtype)
+        h = _finish_layer(cfg, lp, h, attn)
+        return h, None
+
+    h, _ = jax.lax.scan(body, h, params["layers"])
+    h = _rms_norm(h, params["final_norm"], cfg.rms_norm_eps)
+    m = mask.astype(jnp.float32)[..., None]
+    pooled = jnp.sum(h.astype(jnp.float32) * m, axis=1) / jnp.maximum(
+        jnp.sum(m, axis=1), 1.0)
+    return pooled
+
+
 def forward_unrolled(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
                      positions: jnp.ndarray, pages_list: List[jnp.ndarray],
                      page_table: jnp.ndarray, total_lens: jnp.ndarray,
@@ -229,5 +267,5 @@ def forward_unrolled(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     return _logits(cfg, params, h, new_lens), out_pages
 
 
-__all__ = ["init_params", "forward", "forward_unrolled", "make_pages",
-           "make_pages_list"]
+__all__ = ["init_params", "forward", "forward_unrolled", "encode",
+           "make_pages", "make_pages_list"]

@@ -1,0 +1,93 @@
+"""Stream-level performance capture and analysis.
+
+Parity: reference ``lib/llm/src/perf.rs:84-296`` (``record_stream`` ->
+``RecordedStream`` of ``TimestampedResponse``) plus the latency summary the
+reference computes in its benchmark tooling: TTFT, inter-token latency
+percentiles, tokens/sec. Logprob analytics (``perf/logprobs.rs``): per-token
+chosen-logprob capture with low-confidence ("close call") detection.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, AsyncIterator, Dict, List, Optional
+
+
+@dataclass
+class TimestampedResponse:
+    t: float          # seconds since stream start
+    item: Any
+
+
+@dataclass
+class RecordedStream:
+    started_at: float = 0.0
+    responses: List[TimestampedResponse] = field(default_factory=list)
+
+    def __len__(self) -> int:
+        return len(self.responses)
+
+    # -- latency analysis --------------------------------------------------
+
+    def token_times(self) -> List[float]:
+        """Arrival time of each token (frames may carry several tokens)."""
+        out: List[float] = []
+        for r in self.responses:
+            ids = getattr(r.item, "token_ids", None)
+            if ids is None and isinstance(r.item, dict):
+                ids = r.item.get("token_ids")
+            out.extend([r.t] * len(ids or []))
+        return out
+
+    def summary(self) -> Dict[str, float]:
+        times = self.token_times()
+        if not times:
+            return {"tokens": 0}
+        ttft = times[0]
+        gaps = [b - a for a, b in zip(times, times[1:]) if b >= a]
+        total = times[-1]
+        out = {
+            "tokens": float(len(times)),
+            "ttft_s": ttft,
+            "total_s": total,
+            "tokens_per_s": (len(times) / total) if total > 0 else 0.0,
+        }
+        if gaps:
+            s = sorted(gaps)
+            out["itl_mean_s"] = sum(gaps) / len(gaps)
+            out["itl_p50_s"] = s[len(s) // 2]
+            out["itl_p99_s"] = s[min(len(s) - 1, int(len(s) * 0.99))]
+        return out
+
+    # -- logprob analysis --------------------------------------------------
+
+    def logprobs(self) -> List[float]:
+        out: List[float] = []
+        for r in self.responses:
+            lp = getattr(r.item, "log_probs", None)
+            if lp is None and isinstance(r.item, dict):
+                lp = r.item.get("log_probs")
+            out.extend(lp or [])
+        return out
+
+    def close_calls(self, threshold: float = -0.693) -> int:
+        """Tokens whose chosen logprob is below ``threshold`` (default ln 0.5
+        — the model was less than 50% sure). Parity in intent with the
+        reference's close-logprob detection (``perf/logprobs.rs``)."""
+        return sum(1 for lp in self.logprobs() if lp < threshold)
+
+
+async def record_stream(stream: AsyncIterator[Any],
+                        into: Optional[RecordedStream] = None
+                        ) -> AsyncIterator[Any]:
+    """Pass-through wrapper that timestamps every frame into ``into``."""
+    rec = into if into is not None else RecordedStream()
+    rec.started_at = time.perf_counter()
+    async for item in stream:
+        rec.responses.append(
+            TimestampedResponse(time.perf_counter() - rec.started_at, item))
+        yield item
+
+
+__all__ = ["RecordedStream", "TimestampedResponse", "record_stream"]

@@ -1,0 +1,63 @@
+"""Layered runtime configuration: defaults -> TOML file -> DYN_* env.
+
+Parity: reference figment-based config (``lib/runtime/src/config.rs:147-196``
+— defaults, then TOML, then ``DYN_RUNTIME_*`` env) without the framework:
+plain dataclass + ``tomllib`` + env overrides. Precedence (last wins):
+
+1. dataclass defaults
+2. TOML file (``DYN_CONFIG_PATH`` or explicit path), table ``[runtime]``
+3. environment: ``DYN_RUNTIME_<FIELD>`` (upper-case field name)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import tomllib
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+ENV_PREFIX = "DYN_RUNTIME_"
+CONFIG_PATH_ENV = "DYN_CONFIG_PATH"
+
+
+@dataclass
+class RuntimeConfig:
+    coordinator: str = "127.0.0.1:6650"
+    rpc_host: str = "127.0.0.1"
+    rpc_port: int = 0
+    lease_ttl: float = 5.0
+    log_level: str = "INFO"
+    system_enabled: bool = False
+    system_port: int = 0
+
+    @classmethod
+    def load(cls, path: Optional[str] = None,
+             env: Optional[Dict[str, str]] = None) -> "RuntimeConfig":
+        env = os.environ if env is None else env
+        values: Dict[str, Any] = {}
+        path = path or env.get(CONFIG_PATH_ENV)
+        if path:
+            with open(path, "rb") as f:
+                doc = tomllib.load(f)
+            values.update(doc.get("runtime", {}))
+        for f in dataclasses.fields(cls):
+            raw = env.get(f"{ENV_PREFIX}{f.name.upper()}")
+            if raw is None:
+                continue
+            if f.type in ("int", int):
+                values[f.name] = int(raw)
+            elif f.type in ("float", float):
+                values[f.name] = float(raw)
+            elif f.type in ("bool", bool):
+                values[f.name] = raw.lower() in ("1", "true", "yes")
+            else:
+                values[f.name] = raw
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(values) - known
+        if unknown:
+            raise ValueError(f"unknown config keys: {sorted(unknown)}")
+        return cls(**values)
+
+
+__all__ = ["RuntimeConfig"]

@@ -1,0 +1,121 @@
+"""Tests: layered config, stream perf recording, embeddings end-to-end."""
+
+import asyncio
+import json
+
+import aiohttp
+import numpy as np
+import pytest
+
+from dynamo_tpu.perf import RecordedStream, record_stream
+from dynamo_tpu.utils.config import RuntimeConfig
+
+
+class TestRuntimeConfig:
+    def test_defaults(self):
+        cfg = RuntimeConfig.load(env={})
+        assert cfg.coordinator == "127.0.0.1:6650"
+        assert cfg.lease_ttl == 5.0
+
+    def test_toml_then_env_precedence(self, tmp_path):
+        p = tmp_path / "dyn.toml"
+        p.write_text("[runtime]\ncoordinator = 'host-a:7000'\nlease_ttl = 9.0\n")
+        cfg = RuntimeConfig.load(path=str(p), env={})
+        assert cfg.coordinator == "host-a:7000"
+        assert cfg.lease_ttl == 9.0
+        cfg2 = RuntimeConfig.load(path=str(p), env={
+            "DYN_RUNTIME_COORDINATOR": "host-b:8000",
+            "DYN_RUNTIME_SYSTEM_ENABLED": "true",
+        })
+        assert cfg2.coordinator == "host-b:8000"  # env beats toml
+        assert cfg2.lease_ttl == 9.0              # toml beats default
+        assert cfg2.system_enabled is True
+
+    def test_config_path_env(self, tmp_path):
+        p = tmp_path / "dyn.toml"
+        p.write_text("[runtime]\nrpc_port = 1234\n")
+        cfg = RuntimeConfig.load(env={"DYN_CONFIG_PATH": str(p)})
+        assert cfg.rpc_port == 1234
+
+    def test_unknown_key_rejected(self, tmp_path):
+        p = tmp_path / "dyn.toml"
+        p.write_text("[runtime]\nbogus = 1\n")
+        with pytest.raises(ValueError):
+            RuntimeConfig.load(path=str(p), env={})
+
+
+class TestPerfRecorder:
+    async def test_records_and_summarizes(self):
+        from dynamo_tpu.protocols.common import LLMEngineOutput
+
+        async def stream():
+            for i in range(5):
+                await asyncio.sleep(0.01)
+                yield LLMEngineOutput(token_ids=[i], log_probs=[-0.1])
+
+        rec = RecordedStream()
+        items = [x async for x in record_stream(stream(), into=rec)]
+        assert len(items) == 5 and len(rec) == 5
+        s = rec.summary()
+        assert s["tokens"] == 5
+        assert s["ttft_s"] > 0.005
+        assert s["itl_p50_s"] > 0.005
+        assert rec.close_calls() == 0
+
+    async def test_close_call_detection(self):
+        async def stream():
+            yield {"token_ids": [1, 2], "log_probs": [-0.05, -2.0]}
+
+        rec = RecordedStream()
+        _ = [x async for x in record_stream(stream(), into=rec)]
+        assert rec.close_calls() == 1  # -2.0 < ln(0.5)
+
+
+class TestEmbeddings:
+    async def test_engine_embed_shapes_and_padding_invariance(self):
+        from dynamo_tpu.engine.jax_engine import JaxEngine, JaxEngineConfig
+        from dynamo_tpu.models.config import ModelConfig
+        eng = JaxEngine.random_init(ModelConfig.tiny(), JaxEngineConfig(
+            num_pages=16, page_size=4, max_prefill_chunk=32,
+            min_prefill_bucket=8, max_context=64))
+        v = await eng.embed([[1, 2, 3], [4, 5, 6, 7, 8]])
+        assert v.shape == (2, 64)
+        # same input alone (different padded batch) -> same embedding
+        v2 = await eng.embed([[1, 2, 3]])
+        np.testing.assert_allclose(np.asarray(v[0]), np.asarray(v2[0]),
+                                   rtol=1e-4, atol=1e-5)
+
+    async def test_http_embeddings_roundtrip(self):
+        from dynamo_tpu.engine.jax_engine import JaxEngine, JaxEngineConfig
+        from dynamo_tpu.http.service import HttpService
+        from dynamo_tpu.llm.model_manager import ModelManager
+        from dynamo_tpu.llm.pipeline import LocalEnginePipeline
+        from dynamo_tpu.models.config import ModelConfig
+        from dynamo_tpu.utils.testing import make_test_card
+
+        card = make_test_card(name="emb")
+        eng = JaxEngine.random_init(
+            ModelConfig.tiny(vocab_size=300), JaxEngineConfig(
+                num_pages=16, page_size=4, max_prefill_chunk=32,
+                min_prefill_bucket=8, max_context=64))
+        manager = ModelManager()
+        manager.add("emb", LocalEnginePipeline(card, eng))
+        service = await HttpService(manager, host="127.0.0.1", port=0).start()
+        try:
+            base = f"http://127.0.0.1:{service.port}"
+            async with aiohttp.ClientSession() as s:
+                r = await s.post(f"{base}/v1/embeddings", json={
+                    "model": "emb", "input": ["hello", "world"]})
+                assert r.status == 200, await r.text()
+                body = await r.json()
+                assert len(body["data"]) == 2
+                assert len(body["data"][0]["embedding"]) == 64
+                assert body["usage"]["prompt_tokens"] > 0
+
+                # echo pipelines don't embed: clean 501
+                r2 = await s.post(f"{base}/v1/embeddings", json={
+                    "model": "nope", "input": "x"})
+                assert r2.status == 404
+        finally:
+            await service.stop()
+            await eng.stop()
