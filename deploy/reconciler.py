@@ -1,0 +1,97 @@
+"""Planner -> Kubernetes reconciler.
+
+Role parity: the reference's Go operator (``deploy/cloud/operator``) reacting
+to planner scale decisions via CRD patches. Here the division of labor is:
+the planner's ``KvConnector`` publishes desired prefill/decode counts to the
+coordinator KV (``planner/{ns}/desired``); this reconciler watches that key
+and patches the two worker Deployments via ``kubectl scale``. It has no
+in-cluster dependencies beyond kubectl credentials.
+
+Run: ``python deploy/reconciler.py --coordinator dynamo-coordinator:6650``
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+import sys
+
+sys.path.insert(0, ".")  # repo root
+
+from dynamo_tpu.planner.connectors import planner_desired_key  # noqa: E402
+from dynamo_tpu.runtime.runtime import DistributedRuntime  # noqa: E402
+
+logger = logging.getLogger("reconciler")
+
+
+async def kubectl_scale(deployment: str, replicas: int,
+                        kube_namespace: str) -> bool:
+    proc = await asyncio.create_subprocess_exec(
+        "kubectl", "-n", kube_namespace, "scale", f"deployment/{deployment}",
+        f"--replicas={replicas}",
+        stdout=asyncio.subprocess.PIPE, stderr=asyncio.subprocess.PIPE)
+    _out, err = await proc.communicate()
+    if proc.returncode != 0:
+        logger.error("kubectl scale %s failed: %s", deployment, err.decode())
+        return False
+    logger.info("scaled %s to %d", deployment, replicas)
+    return True
+
+
+async def reconcile(drt: DistributedRuntime, namespace: str,
+                    kube_namespace: str, prefill_deploy: str,
+                    decode_deploy: str) -> None:
+    key = planner_desired_key(namespace)
+    watch = await drt.coord.watch_prefix(key)
+    applied = None
+
+    async def apply(raw: bytes) -> None:
+        nonlocal applied
+        desired = json.loads(raw)
+        if desired == applied:
+            return
+        ok1 = await kubectl_scale(prefill_deploy, int(desired["prefill"]),
+                                  kube_namespace)
+        ok2 = await kubectl_scale(decode_deploy, int(desired["decode"]),
+                                  kube_namespace)
+        if ok1 and ok2:
+            applied = desired
+
+    for _key, value in watch.snapshot:
+        await apply(value)
+    async for ev in watch:
+        if ev.type == "put" and ev.value is not None:
+            try:
+                await apply(ev.value)
+            except Exception:
+                logger.exception("reconcile failed")
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--coordinator", default="127.0.0.1:6650")
+    p.add_argument("--namespace", default="dynamo")
+    p.add_argument("--kube-namespace", default="default")
+    p.add_argument("--prefill-deployment", default="dynamo-worker-prefill")
+    p.add_argument("--decode-deployment", default="dynamo-worker-decode")
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    async def amain() -> None:
+        drt = await DistributedRuntime.create(coordinator=args.coordinator)
+        try:
+            await reconcile(drt, args.namespace, args.kube_namespace,
+                            args.prefill_deployment, args.decode_deployment)
+        finally:
+            await drt.close()
+
+    try:
+        asyncio.run(amain())
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()
