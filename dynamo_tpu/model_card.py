@@ -104,8 +104,11 @@ class ModelDeploymentCard:
         """Build a card from a local HuggingFace-style model directory
         (config.json, tokenizer.json, tokenizer_config.json).
 
-        Parity: reference ``model_card/create.rs`` (from_repo).
+        Parity: reference ``model_card/create.rs`` (from_repo); GGUF files
+        route through ``from_gguf`` (reference gguf loader).
         """
+        if path.endswith(".gguf") and os.path.isfile(path):
+            return cls.from_gguf(path, name=name, **overrides)
         card = cls(name=name or os.path.basename(os.path.normpath(path)),
                    model_path=path)
         cfg_path = os.path.join(path, "config.json")
@@ -154,6 +157,38 @@ class ModelDeploymentCard:
         if os.path.exists(ct_jinja):
             with open(ct_jinja) as f:
                 card.chat_template = f.read()
+        for k, v in overrides.items():
+            setattr(card, k, v)
+        return card
+
+    @classmethod
+    def from_gguf(cls, path: str, name: Optional[str] = None,
+                  **overrides: Any) -> "ModelDeploymentCard":
+        """Card from a GGUF single-file model (metadata-driven).
+
+        The GGUF vocab is not reconstructed into a fast tokenizer here; pair
+        the file with a ``tokenizer.json`` next to it (checked automatically)
+        or pass ``tokenizer_path`` explicitly.
+        """
+        from dynamo_tpu.models.gguf import GgufFile
+        gf = GgufFile(path)
+        cfg = gf.to_model_config()
+        base = os.path.basename(path)
+        card = cls(name=name or base.rsplit(".", 1)[0], model_path=path,
+                   context_length=cfg.max_position_embeddings)
+        specials = gf.special_token_ids()
+        if specials.get("eos") is not None:
+            card.eos_token_ids = [int(specials["eos"])]
+        if specials.get("bos") is not None:
+            card.bos_token_id = int(specials["bos"])
+        tmpl = gf.metadata.get("tokenizer.chat_template")
+        if isinstance(tmpl, str):
+            card.chat_template = tmpl
+        sibling = os.path.join(os.path.dirname(path), "tokenizer.json")
+        if os.path.exists(sibling):
+            card.tokenizer_path = sibling
+            with open(sibling) as f:
+                card.tokenizer_json = f.read()
         for k, v in overrides.items():
             setattr(card, k, v)
         return card

@@ -1,0 +1,231 @@
+"""GGUF single-file model support: metadata, config, tokenizer vocab, weights.
+
+Parity: reference ``lib/llm/src/gguf/*.rs`` (GGUF metadata/tokenizer parsing
+for llama.cpp-style models, used by ``LocalModel`` and the model card). This
+reader is written from the public GGUF v3 layout:
+
+  header:  magic "GGUF" | version u32 | tensor_count u64 | kv_count u64
+  kv:      key string | value_type u32 | value
+  tensors: name string | n_dims u32 | dims u64[n] | ggml_type u32 | offset u64
+  data:    aligned to general.alignment (default 32)
+
+Weights load for unquantized ggml types (F32, F16, BF16) into the same
+stacked-layer pytree the HF loader produces (llama.cpp ``blk.N.*`` naming).
+Quantized formats raise a clear error — dequantization is a follow-up, the
+metadata/tokenizer path works for any file.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any, BinaryIO, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from dynamo_tpu.models.config import ModelConfig
+
+GGUF_MAGIC = b"GGUF"
+
+# metadata value types
+_U8, _I8, _U16, _I16, _U32, _I32, _F32, _BOOL, _STR, _ARR, _U64, _I64, _F64 = \
+    range(13)
+
+_SCALAR_FMT = {_U8: "<B", _I8: "<b", _U16: "<H", _I16: "<h", _U32: "<I",
+               _I32: "<i", _F32: "<f", _U64: "<Q", _I64: "<q", _F64: "<d"}
+
+# ggml tensor types we can load without dequantization
+GGML_F32, GGML_F16 = 0, 1
+GGML_BF16 = 30
+_TENSOR_DTYPES = {GGML_F32: np.dtype("<f4"), GGML_F16: np.dtype("<f2"),
+                  GGML_BF16: np.dtype("<u2")}  # bf16 read as raw u16
+
+
+def _read(f: BinaryIO, fmt: str):
+    size = struct.calcsize(fmt)
+    data = f.read(size)
+    if len(data) != size:
+        raise ValueError("truncated GGUF file")
+    return struct.unpack(fmt, data)[0]
+
+
+def _read_string(f: BinaryIO) -> str:
+    n = _read(f, "<Q")
+    return f.read(n).decode("utf-8", errors="replace")
+
+
+def _read_value(f: BinaryIO, vtype: int):
+    if vtype in _SCALAR_FMT:
+        v = _read(f, _SCALAR_FMT[vtype])
+        return v
+    if vtype == _BOOL:
+        return bool(_read(f, "<B"))
+    if vtype == _STR:
+        return _read_string(f)
+    if vtype == _ARR:
+        elem_type = _read(f, "<I")
+        count = _read(f, "<Q")
+        return [_read_value(f, elem_type) for _ in range(count)]
+    raise ValueError(f"unknown GGUF metadata type {vtype}")
+
+
+class GgufFile:
+    """Parsed GGUF: metadata dict + tensor directory (lazy data loads)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.metadata: Dict[str, Any] = {}
+        # name -> (shape, ggml_type, absolute_offset)
+        self.tensors: Dict[str, Tuple[Tuple[int, ...], int, int]] = {}
+        with open(path, "rb") as f:
+            if f.read(4) != GGUF_MAGIC:
+                raise ValueError(f"{path} is not a GGUF file")
+            version = _read(f, "<I")
+            if version < 2:
+                raise ValueError(f"unsupported GGUF version {version}")
+            tensor_count = _read(f, "<Q")
+            kv_count = _read(f, "<Q")
+            for _ in range(kv_count):
+                key = _read_string(f)
+                vtype = _read(f, "<I")
+                self.metadata[key] = _read_value(f, vtype)
+            infos: List[Tuple[str, Tuple[int, ...], int, int]] = []
+            for _ in range(tensor_count):
+                name = _read_string(f)
+                n_dims = _read(f, "<I")
+                dims = tuple(_read(f, "<Q") for _ in range(n_dims))
+                ggml_type = _read(f, "<I")
+                offset = _read(f, "<Q")
+                # GGUF dims are stored innermost-first; numpy wants outermost
+                infos.append((name, tuple(reversed(dims)), ggml_type, offset))
+            align = int(self.metadata.get("general.alignment", 32))
+            base = f.tell()
+            base = (base + align - 1) // align * align
+            for name, shape, ggml_type, offset in infos:
+                self.tensors[name] = (shape, ggml_type, base + offset)
+
+    # -- tensor data -------------------------------------------------------
+
+    def load_tensor(self, name: str) -> np.ndarray:
+        shape, ggml_type, offset = self.tensors[name]
+        dtype = _TENSOR_DTYPES.get(ggml_type)
+        if dtype is None:
+            raise NotImplementedError(
+                f"tensor {name!r} uses quantized ggml type {ggml_type}; "
+                f"only F32/F16/BF16 GGUF files load directly")
+        count = int(np.prod(shape)) if shape else 1
+        with open(self.path, "rb") as f:
+            f.seek(offset)
+            raw = f.read(count * dtype.itemsize)
+        arr = np.frombuffer(raw, dtype=dtype).reshape(shape)
+        if ggml_type == GGML_BF16:
+            arr = (arr.astype(np.uint32) << 16).view(np.float32)
+        return np.ascontiguousarray(arr)
+
+    # -- model config ------------------------------------------------------
+
+    def to_model_config(self, dtype: str = "bfloat16") -> ModelConfig:
+        md = self.metadata
+        arch = md.get("general.architecture", "llama")
+
+        def g(key, default=None):
+            return md.get(f"{arch}.{key}", default)
+
+        heads = int(g("attention.head_count"))
+        hidden = int(g("embedding_length"))
+        vocab = md.get(f"{arch}.vocab_size")
+        if vocab is None:
+            vocab = len(md.get("tokenizer.ggml.tokens", [])) or 32000
+        return ModelConfig(
+            vocab_size=int(vocab),
+            hidden_size=hidden,
+            intermediate_size=int(g("feed_forward_length")),
+            num_layers=int(g("block_count")),
+            num_heads=heads,
+            num_kv_heads=int(g("attention.head_count_kv", heads)),
+            head_dim=int(g("attention.key_length", hidden // heads)),
+            rope_theta=float(g("rope.freq_base", 10000.0)),
+            rms_norm_eps=float(g("attention.layer_norm_rms_epsilon", 1e-5)),
+            max_position_embeddings=int(g("context_length", 8192)),
+            tie_word_embeddings="output.weight" not in self.tensors,
+            model_type=arch,
+            dtype=dtype,
+        )
+
+    def special_token_ids(self) -> Dict[str, Optional[int]]:
+        md = self.metadata
+        return {
+            "eos": md.get("tokenizer.ggml.eos_token_id"),
+            "bos": md.get("tokenizer.ggml.bos_token_id"),
+        }
+
+
+# llama.cpp tensor name -> (pytree path, transpose?)
+_GGUF_MAP = {
+    "token_embd.weight": (("embed",), False),
+    "output_norm.weight": (("final_norm",), False),
+    "output.weight": (("lm_head",), True),
+    "blk.{i}.attn_norm.weight": (("layers", "attn_norm"), False),
+    "blk.{i}.attn_q.weight": (("layers", "wq"), True),
+    "blk.{i}.attn_k.weight": (("layers", "wk"), True),
+    "blk.{i}.attn_v.weight": (("layers", "wv"), True),
+    "blk.{i}.attn_output.weight": (("layers", "wo"), True),
+    "blk.{i}.ffn_norm.weight": (("layers", "mlp_norm"), False),
+    "blk.{i}.ffn_gate.weight": (("layers", "w_gate"), True),
+    "blk.{i}.ffn_up.weight": (("layers", "w_up"), True),
+    "blk.{i}.ffn_down.weight": (("layers", "w_down"), True),
+}
+
+
+def load_gguf_params(cfg: ModelConfig, path: str) -> Dict[str, Any]:
+    """Assemble the stacked-layer param pytree from a GGUF file."""
+    import jax.numpy as jnp
+
+    gf = GgufFile(path)
+    staged: Dict[tuple, Any] = {}
+    per_layer: Dict[tuple, Dict[int, np.ndarray]] = {}
+    for name in gf.tensors:
+        layer = None
+        key = name
+        if name.startswith("blk."):
+            rest = name[len("blk."):]
+            idx, _, tail = rest.partition(".")
+            layer = int(idx)
+            key = f"blk.{{i}}.{tail}"
+        spec = _GGUF_MAP.get(key)
+        if spec is None:
+            continue
+        tree_path, transpose = spec
+        t = gf.load_tensor(name)
+        if transpose:
+            t = np.ascontiguousarray(t.T)
+        if layer is None:
+            staged[tree_path] = t
+        else:
+            per_layer.setdefault(tree_path, {})[layer] = t
+
+    for tree_path, by_layer in per_layer.items():
+        missing = set(range(cfg.num_layers)) - set(by_layer)
+        if missing:
+            raise ValueError(f"GGUF missing layers {sorted(missing)} "
+                             f"for {tree_path}")
+        staged[tree_path] = np.stack(
+            [by_layer[i] for i in range(cfg.num_layers)])
+
+    expected = {tp for tp, _ in _GGUF_MAP.values()}
+    if cfg.tie_word_embeddings:
+        expected.discard(("lm_head",))
+    absent = expected - set(staged)
+    if absent:
+        raise ValueError(f"GGUF at {path} missing weights for {sorted(absent)}")
+
+    params: Dict[str, Any] = {}
+    target = jnp.dtype(cfg.dtype)
+    for tree_path, arr in staged.items():
+        node = params
+        for k in tree_path[:-1]:
+            node = node.setdefault(k, {})
+        node[tree_path[-1]] = jnp.asarray(arr).astype(target)
+    return params
+
+
+__all__ = ["GgufFile", "load_gguf_params"]

@@ -73,7 +73,12 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def build_engine(args: argparse.Namespace) -> JaxEngine:
-    cfg = ModelConfig.from_pretrained(args.model_path, dtype=args.dtype)
+    is_gguf = args.model_path.endswith(".gguf")
+    if is_gguf:
+        from dynamo_tpu.models.gguf import GgufFile
+        cfg = GgufFile(args.model_path).to_model_config(dtype=args.dtype)
+    else:
+        cfg = ModelConfig.from_pretrained(args.model_path, dtype=args.dtype)
     engine_cfg = JaxEngineConfig(
         num_pages=args.num_pages, page_size=args.page_size,
         max_num_seqs=args.max_num_seqs,
@@ -85,7 +90,11 @@ def build_engine(args: argparse.Namespace) -> JaxEngine:
         engine_cfg.shard_params_fn = shard.shard_params
         engine_cfg.shard_pages_fn = shard.shard_pages
     if args.random_weights:
-        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        from dynamo_tpu.models import get_family
+        params = get_family(cfg).init_params(cfg, jax.random.PRNGKey(0))
+    elif is_gguf:
+        from dynamo_tpu.models.gguf import load_gguf_params
+        params = load_gguf_params(cfg, args.model_path)
     else:
         params = load_hf_params(cfg, args.model_path)
     return JaxEngine(cfg, params, engine_cfg)

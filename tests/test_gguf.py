@@ -1,0 +1,172 @@
+"""GGUF reader tests against a synthesized file (no network, no real model)."""
+
+import struct
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.models.gguf import GgufFile, load_gguf_params
+from dynamo_tpu.models import llama
+
+_U32, _F32T, _STR, _ARR, _U64 = 4, 6, 8, 9, 10
+GGML_F32, GGML_F16 = 0, 1
+Q4_0 = 2
+
+
+def w_str(s: str) -> bytes:
+    b = s.encode()
+    return struct.pack("<Q", len(b)) + b
+
+
+def w_kv(key: str, vtype: int, value) -> bytes:
+    out = w_str(key) + struct.pack("<I", vtype)
+    if vtype == _U32:
+        out += struct.pack("<I", value)
+    elif vtype == _F32T:
+        out += struct.pack("<f", value)
+    elif vtype == _STR:
+        out += w_str(value)
+    elif vtype == _U64:
+        out += struct.pack("<Q", value)
+    elif vtype == _ARR:
+        elem_type, items = value
+        out += struct.pack("<I", elem_type) + struct.pack("<Q", len(items))
+        for it in items:
+            out += w_str(it) if elem_type == _STR else struct.pack("<I", it)
+    return out
+
+
+def write_gguf(path, metadata, tensors):
+    """tensors: list of (name, np_array, ggml_type)."""
+    align = 32
+    header = bytearray()
+    header += b"GGUF" + struct.pack("<I", 3)
+    header += struct.pack("<Q", len(tensors)) + struct.pack("<Q", len(metadata))
+    for key, vtype, value in metadata:
+        header += w_kv(key, vtype, value)
+    # tensor infos with data offsets relative to the aligned data base
+    datas, offset = [], 0
+    infos = bytearray()
+    for name, arr, gtype in tensors:
+        infos += w_str(name)
+        infos += struct.pack("<I", arr.ndim)
+        for d in reversed(arr.shape):  # GGUF stores innermost-first
+            infos += struct.pack("<Q", d)
+        infos += struct.pack("<I", gtype) + struct.pack("<Q", offset)
+        raw = arr.tobytes()
+        pad = (-len(raw)) % align
+        datas.append(raw + b"\0" * pad)
+        offset += len(raw) + pad
+    body = bytes(header) + bytes(infos)
+    base_pad = (-len(body)) % align
+    with open(path, "wb") as f:
+        f.write(body + b"\0" * base_pad + b"".join(datas))
+
+
+def tiny_cfg():
+    return ModelConfig.tiny(vocab_size=64, tie_word_embeddings=True)
+
+
+def make_file(path, lm_head=False, quantized_block=False):
+    cfg = tiny_cfg()
+    rng = np.random.default_rng(0)
+    H, I = cfg.hidden_size, cfg.intermediate_size
+    md = [
+        ("general.architecture", _STR, "llama"),
+        ("general.alignment", _U32, 32),
+        ("llama.block_count", _U32, cfg.num_layers),
+        ("llama.embedding_length", _U32, H),
+        ("llama.feed_forward_length", _U32, I),
+        ("llama.attention.head_count", _U32, cfg.num_heads),
+        ("llama.attention.head_count_kv", _U32, cfg.num_kv_heads),
+        ("llama.attention.key_length", _U32, cfg.head_dim),
+        ("llama.rope.freq_base", _F32T, 10000.0),
+        ("llama.attention.layer_norm_rms_epsilon", _F32T, 1e-5),
+        ("llama.context_length", _U32, 512),
+        ("tokenizer.ggml.tokens", _ARR,
+         (_STR, [f"tok{i}" for i in range(cfg.vocab_size)])),
+        ("tokenizer.ggml.eos_token_id", _U32, 2),
+    ]
+    tensors = [("token_embd.weight",
+                rng.standard_normal((cfg.vocab_size, H)).astype(np.float32),
+                GGML_F32),
+               ("output_norm.weight", np.ones(H, np.float32), GGML_F32)]
+    for i in range(cfg.num_layers):
+        pre = f"blk.{i}"
+        tensors += [
+            (f"{pre}.attn_norm.weight", np.ones(H, np.float32), GGML_F32),
+            (f"{pre}.attn_q.weight",
+             rng.standard_normal((cfg.q_size, H)).astype(np.float16), GGML_F16),
+            (f"{pre}.attn_k.weight",
+             rng.standard_normal((cfg.kv_size, H)).astype(np.float32), GGML_F32),
+            (f"{pre}.attn_v.weight",
+             rng.standard_normal((cfg.kv_size, H)).astype(np.float32), GGML_F32),
+            (f"{pre}.attn_output.weight",
+             rng.standard_normal((H, cfg.q_size)).astype(np.float32), GGML_F32),
+            (f"{pre}.ffn_norm.weight", np.ones(H, np.float32), GGML_F32),
+            (f"{pre}.ffn_gate.weight",
+             rng.standard_normal((I, H)).astype(np.float32), GGML_F32),
+            (f"{pre}.ffn_up.weight",
+             rng.standard_normal((I, H)).astype(np.float32), GGML_F32),
+            (f"{pre}.ffn_down.weight",
+             rng.standard_normal((H, I)).astype(np.float32),
+             Q4_0 if quantized_block else GGML_F32),
+        ]
+    write_gguf(path, md, tensors)
+    return tensors
+
+
+class TestGguf:
+    def test_metadata_and_config(self, tmp_path):
+        p = str(tmp_path / "m.gguf")
+        make_file(p)
+        gf = GgufFile(p)
+        assert gf.metadata["general.architecture"] == "llama"
+        cfg = gf.to_model_config()
+        assert cfg.num_layers == 2
+        assert cfg.vocab_size == 64
+        assert cfg.num_kv_heads == 2
+        assert cfg.tie_word_embeddings  # no output.weight tensor
+        assert gf.special_token_ids()["eos"] == 2
+
+    def test_tensor_roundtrip_f32_and_f16(self, tmp_path):
+        p = str(tmp_path / "m.gguf")
+        tensors = make_file(p)
+        gf = GgufFile(p)
+        by_name = {n: (a, t) for n, a, t in tensors}
+        emb = gf.load_tensor("token_embd.weight")
+        np.testing.assert_array_equal(emb, by_name["token_embd.weight"][0])
+        q = gf.load_tensor("blk.0.attn_q.weight")
+        np.testing.assert_array_equal(
+            q, by_name["blk.0.attn_q.weight"][0])
+
+    def test_params_load_and_forward(self, tmp_path):
+        p = str(tmp_path / "m.gguf")
+        make_file(p)
+        gf = GgufFile(p)
+        cfg = gf.to_model_config(dtype="float32")
+        params = load_gguf_params(cfg, p)
+        assert params["layers"]["wq"].shape == (2, cfg.hidden_size, cfg.q_size)
+        pages = llama.make_pages(cfg, 4, 4)
+        logits, _ = llama.forward(
+            params, cfg, jnp.array([[1, 2, 3]], jnp.int32),
+            jnp.array([[0, 1, 2]], jnp.int32), pages,
+            jnp.array([[1]], jnp.int32), jnp.array([3], jnp.int32),
+            jnp.array([3], jnp.int32))
+        assert logits.shape == (1, cfg.vocab_size)
+
+    def test_quantized_tensor_rejected_clearly(self, tmp_path):
+        p = str(tmp_path / "q.gguf")
+        make_file(p, quantized_block=True)
+        gf = GgufFile(p)
+        cfg = gf.to_model_config()
+        with pytest.raises(NotImplementedError, match="quantized"):
+            load_gguf_params(cfg, p)
+
+    def test_not_gguf_rejected(self, tmp_path):
+        p = tmp_path / "x.gguf"
+        p.write_bytes(b"NOPE" + b"\0" * 100)
+        with pytest.raises(ValueError, match="not a GGUF"):
+            GgufFile(str(p))
