@@ -48,10 +48,16 @@ async def amain(args: argparse.Namespace) -> None:
         card = make_test_card(name=args.model_name,
                               kv_cache_block_size=args.page_size)
     card.kv_cache_block_size = args.page_size
+    try:
+        # sample inside the served tokenizer's vocab so detokenization
+        # produces real text downstream
+        vocab = card.load_tokenizer().vocab_size
+    except Exception:
+        vocab = 32000
     engine = MockerEngine(MockEngineArgs(
         num_pages=args.num_pages, page_size=args.page_size,
         max_num_seqs=args.max_num_seqs, max_context=args.max_context,
-        speedup_ratio=args.speedup_ratio))
+        speedup_ratio=args.speedup_ratio, vocab_size=vocab))
     endpoint = (drt.namespace(args.namespace).component(args.component)
                 .endpoint(args.endpoint))
     if not args.no_kv_events:

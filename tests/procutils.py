@@ -1,0 +1,105 @@
+"""ManagedProcess: spawn framework processes for e2e tests.
+
+Parity: reference ``tests/utils/managed_process.py:69-258`` — spawn a real
+CLI process, wait for a readiness condition (log line or open TCP port),
+capture output for debugging, and guarantee teardown. Child processes are
+forced onto CPU jax (the axon TPU plugin must never dial out under pytest —
+see conftest).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import socket
+import subprocess
+import sys
+import time
+from typing import List, Optional
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def cpu_env() -> dict:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.setdefault("PYTHONUNBUFFERED", "1")
+    return env
+
+
+class ManagedProcess:
+    def __init__(self, args: List[str], name: str = "proc",
+                 ready_line: Optional[str] = None,
+                 ready_port: Optional[int] = None,
+                 timeout: float = 60.0):
+        self.args = [sys.executable, "-m"] + args
+        self.name = name
+        self.ready_line = ready_line
+        self.ready_port = ready_port
+        self.timeout = timeout
+        self.proc: Optional[subprocess.Popen] = None
+        self.lines: List[str] = []
+
+    async def start(self) -> "ManagedProcess":
+        self.proc = subprocess.Popen(
+            self.args, cwd="/root/repo", env=cpu_env(),
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        deadline = time.monotonic() + self.timeout
+        loop = asyncio.get_running_loop()
+        while time.monotonic() < deadline:
+            if self.proc.poll() is not None:
+                raise RuntimeError(
+                    f"{self.name} exited rc={self.proc.returncode}:\n"
+                    + "".join(self.lines))
+            if self.ready_line is not None:
+                line = await loop.run_in_executor(
+                    None, self.proc.stdout.readline)
+                if line:
+                    self.lines.append(line)
+                    if self.ready_line in line:
+                        return self
+            elif self.ready_port is not None:
+                try:
+                    with socket.create_connection(
+                            ("127.0.0.1", self.ready_port), timeout=0.25):
+                        return self
+                except OSError:
+                    await asyncio.sleep(0.1)
+            else:
+                return self
+        raise TimeoutError(f"{self.name} not ready in {self.timeout}s:\n"
+                           + "".join(self.lines))
+
+    def kill(self, sig: int = 9) -> None:
+        if self.proc is not None and self.proc.poll() is None:
+            if sig == 9:
+                self.proc.kill()
+            else:
+                self.proc.send_signal(sig)
+
+    async def stop(self) -> None:
+        if self.proc is None:
+            return
+        if self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                await asyncio.get_running_loop().run_in_executor(
+                    None, lambda: self.proc.wait(timeout=10))
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+        if self.proc.stdout is not None:
+            self.proc.stdout.close()
+
+    async def __aenter__(self) -> "ManagedProcess":
+        return await self.start()
+
+    async def __aexit__(self, *exc) -> None:
+        await self.stop()
+
+
+__all__ = ["ManagedProcess", "free_port", "cpu_env"]

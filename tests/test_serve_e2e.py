@@ -1,0 +1,94 @@
+"""Process-level serve e2e: real CLI processes, real network, real kills.
+
+Parity: reference ``tests/serve/test_dynamo_serve.py`` family — spawn the
+actual frontend and worker executables, drive them over HTTP, and exercise
+worker death + replacement from the outside.
+"""
+
+import asyncio
+import json
+
+import aiohttp
+import pytest
+
+from tests.procutils import ManagedProcess, free_port
+
+
+def frontend(coord_port: int, http_port: int, router_mode: str = "round-robin"):
+    return ManagedProcess(
+        ["dynamo_tpu.frontend.main", "--standalone",
+         "--coordinator", f"127.0.0.1:{coord_port}",
+         "--http-host", "127.0.0.1", "--http-port", str(http_port),
+         "--router-mode", router_mode],
+        name="frontend", ready_line="frontend listening")
+
+
+def mock_worker(coord_port: int, name: str = "mock-model"):
+    return ManagedProcess(
+        ["dynamo_tpu.mocker.main", "--coordinator", f"127.0.0.1:{coord_port}",
+         "--model-name", name, "--speedup-ratio", "50", "--page-size", "4"],
+        name="mocker", ready_line="mocker worker serving")
+
+
+async def wait_model(base: str, model: str, timeout: float = 30.0) -> None:
+    deadline = asyncio.get_running_loop().time() + timeout
+    async with aiohttp.ClientSession() as s:
+        while asyncio.get_running_loop().time() < deadline:
+            try:
+                body = await (await s.get(f"{base}/v1/models")).json()
+                if any(m["id"] == model for m in body.get("data", [])):
+                    return
+            except aiohttp.ClientError:
+                pass
+            await asyncio.sleep(0.25)
+    raise TimeoutError(f"model {model} never appeared at {base}")
+
+
+class TestServeE2E:
+    async def test_full_serve_and_worker_replacement(self):
+        coord_port, http_port = free_port(), free_port()
+        base = f"http://127.0.0.1:{http_port}"
+        body = {"model": "mock-model",
+                "messages": [{"role": "user", "content": "hello there"}],
+                "max_tokens": 6}
+        async with frontend(coord_port, http_port) as fe:
+            async with mock_worker(coord_port) as w1:
+                await wait_model(base, "mock-model")
+                async with aiohttp.ClientSession() as s:
+                    r = await (await s.post(
+                        f"{base}/v1/chat/completions", json=body)).json()
+                    assert r["choices"][0]["finish_reason"] == "length"
+                    assert r["usage"]["completion_tokens"] == 6
+
+                    # streaming
+                    resp = await s.post(f"{base}/v1/chat/completions",
+                                        json={**body, "stream": True})
+                    chunks, text = 0, ""
+                    async for line in resp.content:
+                        if line.startswith(b"data: ") and b"[DONE]" not in line:
+                            chunks += 1
+                            payload = json.loads(line[6:])
+                            delta = payload["choices"][0].get("delta", {})
+                            text += delta.get("content") or ""
+                    # role + content frames + finish (multi-byte sequences
+                    # may jail/merge, so content frames can be < max_tokens)
+                    assert chunks >= 4
+                    assert text
+
+                # hard-kill the worker; model must drop off within lease TTL
+                w1.kill(9)
+                async with aiohttp.ClientSession() as s:
+                    for _ in range(100):
+                        models = await (await s.get(f"{base}/v1/models")).json()
+                        if not models["data"]:
+                            break
+                        await asyncio.sleep(0.2)
+                    assert not models["data"], "dead worker still registered"
+
+            # a replacement worker restores service
+            async with mock_worker(coord_port) as w2:
+                await wait_model(base, "mock-model")
+                async with aiohttp.ClientSession() as s:
+                    r = await (await s.post(
+                        f"{base}/v1/chat/completions", json=body)).json()
+                    assert r["choices"][0]["finish_reason"] == "length"
