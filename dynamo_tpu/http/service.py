@@ -170,11 +170,23 @@ class HttpService:
         # preprocess before preparing the response so validation errors can
         # still produce a clean HTTP 400
         preprocessed, delta = pipeline.prepare_chat(req, request_id)
+        annotation_only = pipeline.resolve_annotations(preprocessed)
         resp = web.StreamResponse(headers={
             "Content-Type": "text/event-stream",
             "Cache-Control": "no-cache",
             "Connection": "keep-alive"})
         await resp.prepare(http_req)
+        if annotation_only:
+            # e.g. query_instance_id: answer with the annotation events and
+            # no generation (parity: reference annotation short-circuit)
+            for name, value in preprocessed.annotations_payload.items():
+                await resp.write(sse.SseEvent(
+                    event=name,
+                    data=json.dumps(value, separators=(",", ":"))).encode())
+            await resp.write(sse.encode_done())
+            timer.done("200", prompt_tokens=len(preprocessed.token_ids))
+            await resp.write_eof()
+            return resp
         status = "200"
         include_usage = bool(req.stream_options and req.stream_options.include_usage)
         gen = pipeline.run_chat(preprocessed, delta)

@@ -90,6 +90,11 @@ class ServicePipeline:
         Raises NotImplementedError when this pipeline's engine can't embed."""
         raise NotImplementedError("this pipeline does not serve embeddings")
 
+    def resolve_annotations(self, preprocessed: PreprocessedRequest) -> bool:
+        """Fill router-level annotation responses. Returns True if the
+        request is annotation-only (answered without generating)."""
+        return False
+
 
 class LocalEnginePipeline(ServicePipeline):
     """Pipeline with an in-process engine (reference: EngineConfig::StaticCore)."""
@@ -131,6 +136,23 @@ class RemotePipeline(ServicePipeline):
         self.router = router
         self.migration_limit = (migration_limit if migration_limit is not None
                                 else card.migration_limit)
+
+    def resolve_annotations(self, preprocessed: PreprocessedRequest) -> bool:
+        from dynamo_tpu.preprocessor.preprocessor import (
+            ANNOTATION_QUERY_INSTANCE_ID)
+        if ANNOTATION_QUERY_INSTANCE_ID not in preprocessed.annotations:
+            return False
+        find = getattr(self.router, "find_best_match", None)
+        if find is None:
+            return False
+        # the routing decision without routing (parity: reference
+        # kv_router.rs:331-337 query_instance_id annotation)
+        worker, overlap = find(preprocessed.token_ids)
+        preprocessed.annotations_payload[ANNOTATION_QUERY_INSTANCE_ID] = {
+            "worker_instance_id": f"{worker:x}",
+            "overlap_blocks": overlap,
+        }
+        return True
 
     async def engine_stream(self, request: PreprocessedRequest
                             ) -> AsyncIterator[LLMEngineOutput]:

@@ -193,3 +193,45 @@ class TestRouterE2EWithMockers:
             for d in drts:
                 await d.close()
             await coord.stop()
+
+
+class TestQueryInstanceIdAnnotation:
+    async def test_annotation_returns_choice_without_routing(self):
+        """nvext annotation query_instance_id: SSE answers the routing
+        decision and generates nothing (parity: kv_router.rs:331-337)."""
+        from dynamo_tpu.runtime.coordinator import Coordinator
+        coord = await Coordinator(port=0).start()
+        drts, service, watcher = [], None, None
+        try:
+            drt, eng = await start_mock_worker(coord.address)
+            drts.append(drt)
+            frontend = await DistributedRuntime.create(coordinator=coord.address)
+            drts.append(frontend)
+            manager = ModelManager()
+            watcher = ModelWatcher(frontend, manager,
+                                   router_mode=RouterMode.KV,
+                                   kv_router_config={"stats_interval": 0.2})
+            await watcher.start()
+            service = await HttpService(manager, host="127.0.0.1",
+                                        port=0).start()
+            base = f"http://127.0.0.1:{service.port}"
+            body = {"model": "mock-model",
+                    "messages": [{"role": "user", "content": "route me"}],
+                    "stream": True,
+                    "nvext": {"annotations": ["query_instance_id"]}}
+            async with aiohttp.ClientSession() as s:
+                resp = await s.post(f"{base}/v1/chat/completions", json=body)
+                raw = await resp.text()
+            assert "event: query_instance_id" in raw
+            assert "worker_instance_id" in raw
+            assert "chat.completion.chunk" not in raw  # nothing generated
+            # and the worker really saw no request
+            assert eng.allocator.hits + eng.allocator.misses == 0
+        finally:
+            if service is not None:
+                await service.stop()
+            if watcher is not None:
+                await watcher.stop()
+            for d in drts:
+                await d.close()
+            await coord.stop()
