@@ -14,6 +14,13 @@ params plus the batch's KV context). 1.0 would be a perfect
 bandwidth-saturating engine, so this is comparable chip-to-chip — the
 reference's H100 stacks sit around 0.5-0.7 of their equivalent roofline.
 Diagnostics (TTFT, step counts) go to stderr.
+
+Robustness (round-1 lesson: the tunneled TPU backend can hang for minutes
+on init or fail UNAVAILABLE): the default entry is an ORCHESTRATOR that
+never imports jax itself. It runs the measurement in child subprocesses
+(``--_child``) under hard wall-clock timeouts, retries TPU init with
+backoff, and if the TPU never comes up, emits a CPU fallback number with an
+``"error"`` field — one JSON line on stdout no matter what.
 """
 
 from __future__ import annotations
@@ -21,12 +28,11 @@ from __future__ import annotations
 import argparse
 import asyncio
 import json
+import os
 import statistics
+import subprocess
 import sys
 import time
-
-import jax
-import numpy as np
 
 HBM_GBPS = {
     # chip generation -> HBM bandwidth (GB/s), public spec sheets
@@ -38,27 +44,38 @@ HBM_GBPS = {
     "cpu": 50.0,  # nominal, for local runs only
 }
 
+# the tunneled backend registers as platform "axon" but is a real TPU
+TPU_PLATFORMS = ("tpu", "axon")
+
 
 def detect_bandwidth() -> float:
+    import jax
+
     dev = jax.devices()[0]
     kind = getattr(dev, "device_kind", "cpu").lower()
     for key, bw in HBM_GBPS.items():
         if key in kind:
             return bw
-    return HBM_GBPS["v5e" if dev.platform == "tpu" else "cpu"]
+    return HBM_GBPS["v5e" if dev.platform in TPU_PLATFORMS else "cpu"]
 
 
 def tree_bytes(tree) -> int:
-    return sum(x.size * x.dtype.itemsize for x in jax.tree_util.tree_leaves(tree))
+    import jax
+
+    return sum(x.size * x.dtype.itemsize
+               for x in jax.tree_util.tree_leaves(tree))
 
 
 async def run_bench(args) -> dict:
+    import jax
+    import numpy as np
+
     from dynamo_tpu.engine.jax_engine import JaxEngine, JaxEngineConfig
     from dynamo_tpu.models.config import ModelConfig
     from dynamo_tpu.protocols.common import (
         PreprocessedRequest, SamplingOptions, StopConditions)
 
-    on_tpu = jax.devices()[0].platform == "tpu"
+    on_tpu = jax.devices()[0].platform in TPU_PLATFORMS
     if args.small or not on_tpu:
         cfg = ModelConfig.tiny(dtype="float32")
         seqs, prompt, gen = 4, 32, 16
@@ -146,15 +163,96 @@ async def run_bench(args) -> dict:
     }
 
 
-def main() -> None:
+def _parse_args(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("--seqs", type=int, default=32)
     p.add_argument("--prompt", type=int, default=512)
     p.add_argument("--gen", type=int, default=128)
     p.add_argument("--small", action="store_true",
                    help="tiny config (CI / CPU smoke)")
-    args = p.parse_args()
+    p.add_argument("--_child", action="store_true",
+                   help="internal: run the measurement in this process")
+    p.add_argument("--budget", type=float, default=520.0,
+                   help="orchestrator total wall-clock budget (s)")
+    return p.parse_args(argv)
+
+
+def _child_main(args) -> None:
+    if os.environ.get("BENCH_FORCE_CPU"):
+        from dynamo_tpu.utils.platform import force_cpu_platform
+
+        force_cpu_platform()
     result = asyncio.run(run_bench(args))
+    print(json.dumps(result), flush=True)
+
+
+def _run_attempt(argv: list[str], env: dict, timeout: float) -> dict | None:
+    """Run one child measurement; return its parsed JSON result or None."""
+    cmd = [sys.executable, os.path.abspath(__file__), "--_child"] + argv
+    print(f"bench: attempt {argv} timeout={timeout:.0f}s",
+          file=sys.stderr, flush=True)
+    try:
+        proc = subprocess.run(
+            cmd, env=env, timeout=timeout,
+            stdout=subprocess.PIPE, stderr=sys.stderr)
+    except subprocess.TimeoutExpired:
+        print("bench: attempt timed out", file=sys.stderr, flush=True)
+        return None
+    for line in reversed(proc.stdout.decode(errors="replace").splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    print(f"bench: attempt exited rc={proc.returncode} without a result",
+          file=sys.stderr, flush=True)
+    return None
+
+
+def main() -> None:
+    args = _parse_args()
+    if args._child:
+        _child_main(args)
+        return
+
+    # Orchestrator: never imports jax. TPU attempts with backoff under a
+    # global budget, reserving time for a CPU fallback measurement.
+    deadline = time.monotonic() + args.budget
+    cpu_reserve = 150.0
+    child_argv = ["--seqs", str(args.seqs), "--prompt", str(args.prompt),
+                  "--gen", str(args.gen)] + (["--small"] if args.small else [])
+
+    tpu_env = dict(os.environ)
+    tpu_env.pop("JAX_PLATFORMS", None)  # let the TPU plugin register
+    errors: list[str] = []
+    attempt = 0
+    while time.monotonic() + cpu_reserve < deadline and attempt < 3:
+        attempt += 1
+        remaining = deadline - time.monotonic() - cpu_reserve
+        result = _run_attempt(child_argv, tpu_env, min(remaining, 240.0))
+        if result is not None:
+            result["attempts"] = attempt
+            print(json.dumps(result), flush=True)
+            return
+        errors.append(f"tpu attempt {attempt} failed/timed out")
+        if attempt < 3 and time.monotonic() + cpu_reserve < deadline:
+            time.sleep(min(10.0 * attempt, 30.0))
+
+    # CPU fallback: a real (tiny) measurement so the driver always gets a
+    # number, with the failure recorded.
+    cpu_env = dict(os.environ)
+    cpu_env["JAX_PLATFORMS"] = "cpu"
+    cpu_env["BENCH_FORCE_CPU"] = "1"
+    result = _run_attempt(["--small"], cpu_env,
+                          max(deadline - time.monotonic(), 60.0))
+    if result is None:
+        result = {"metric": "decode_throughput", "value": 0.0,
+                  "unit": "tokens/sec", "vs_baseline": 0.0}
+        errors.append("cpu fallback failed too")
+    if not errors:
+        errors.append("tpu attempts skipped (budget)")
+    result["error"] = "; ".join(errors)
     print(json.dumps(result), flush=True)
 
 

@@ -1,0 +1,41 @@
+"""Backend platform pinning helpers.
+
+The TPU plugin environments this framework targets register a site hook that
+overrides ``jax_platforms`` at import time, so the ``JAX_PLATFORMS`` env var
+alone cannot keep a process off the (possibly hung/unavailable) TPU backend.
+``force_cpu_platform`` out-pins the hook: clear any initialized backends,
+then set the config directly. Used by the multichip dryrun
+(``__graft_entry__``) and the bench CPU-fallback child — anything that must
+never block on real-chip init.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def force_cpu_platform(n_devices: int | None = None) -> int:
+    """Pin this process's jax to the CPU platform, optionally with an
+    ``n_devices``-wide virtual device mesh. Safe to call after a backend was
+    already initialized. Returns the resulting device count."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    if n_devices is not None:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={n_devices}")
+
+    import jax
+    from jax.extend.backend import clear_backends
+
+    clear_backends()
+    jax.config.update("jax_platforms", "cpu")
+    if n_devices is not None:
+        try:
+            jax.config.update("jax_num_cpu_devices", n_devices)
+        except Exception:  # noqa: BLE001 — older jax: XLA_FLAGS path applies
+            pass
+    return len(jax.devices())
+
+
+__all__ = ["force_cpu_platform"]
