@@ -15,7 +15,17 @@ from __future__ import annotations
 
 import asyncio
 import logging
-from typing import AsyncIterator, Callable, Dict, List, Optional, Tuple
+from collections import deque
+from typing import (
+    Any,
+    AsyncIterator,
+    Callable,
+    Deque,
+    Dict,
+    List,
+    Optional,
+    Tuple,
+)
 
 import numpy as np
 
@@ -56,6 +66,10 @@ class ScheduledEngineBase(EngineBase):
         self._loop_task: Optional[asyncio.Task] = None
         self._stopping = False
         self.kv_event_cb: Optional[Callable[[List[KvCacheEvent]], None]] = None
+        # work serialized with the step loop (KV transfers, offload/onboard):
+        # drained between steps so nothing else ever touches pages/allocator
+        # while a (pages-donating) jitted step is in flight
+        self._exclusive: Deque[Tuple[Callable, tuple, asyncio.Future]] = deque()
 
     # -- subclass hook -----------------------------------------------------
 
@@ -149,6 +163,47 @@ class ScheduledEngineBase(EngineBase):
         if events and self.kv_event_cb is not None:
             self.kv_event_cb(events)
 
+    # -- serialized out-of-band work ---------------------------------------
+
+    async def run_exclusive(self, fn: Callable, *args) -> Any:
+        """Run ``fn(*args)`` in a worker thread, serialized with the step
+        loop: no jitted step is in flight while ``fn`` runs, and the loop
+        doesn't dispatch the next step until it returns.
+
+        Required for anything that reads or reassigns ``engine.pages`` or
+        mutates allocator state from outside the loop (KV block
+        export/inject, tier offload/onboard) — ``pages`` is donated through
+        every step, so a concurrent step would invalidate the buffer
+        mid-read or clobber the write.
+        """
+        await self.start()
+        if self._loop_task is not None and self._loop_task.done():
+            raise RuntimeError("engine loop is dead")
+        fut = asyncio.get_running_loop().create_future()
+        self._exclusive.append((fn, args, fut))
+        self._work.set()
+        return await fut
+
+    async def _drain_exclusive(self) -> None:
+        while self._exclusive:
+            fn, args, fut = self._exclusive.popleft()
+            if fut.done():
+                continue
+            try:
+                res = await asyncio.to_thread(fn, *args)
+            except asyncio.CancelledError:
+                # loop task cancelled mid-drain (stop()): the item is already
+                # popped, so fail its future here or the caller hangs forever
+                if not fut.done():
+                    fut.set_exception(RuntimeError("engine stopped"))
+                raise
+            except Exception as e:  # noqa: BLE001 — relay to the caller
+                if not fut.done():
+                    fut.set_exception(e)
+            else:
+                if not fut.done():
+                    fut.set_result(res)
+
     # -- the engine loop ---------------------------------------------------
 
     def _drain_reaped(self) -> None:
@@ -158,7 +213,22 @@ class ScheduledEngineBase(EngineBase):
                                             completion_tokens=len(seq.generated)))
 
     async def _loop(self) -> None:
+        try:
+            await self._loop_body()
+        finally:
+            # whether stopped or crashed, nobody will drain the queue again —
+            # fail pending exclusive work so callers don't hang forever
+            self._fail_exclusive("engine loop exited")
+
+    def _fail_exclusive(self, reason: str) -> None:
+        while self._exclusive:
+            _fn, _args, fut = self._exclusive.popleft()
+            if not fut.done():
+                fut.set_exception(RuntimeError(reason))
+
+    async def _loop_body(self) -> None:
         while not self._stopping:
+            await self._drain_exclusive()
             plan = self.scheduler.schedule()
             self._drain_reaped()
             if plan is None:
@@ -206,6 +276,7 @@ class ScheduledEngineBase(EngineBase):
             except (asyncio.CancelledError, Exception):  # noqa: BLE001
                 pass
             self._loop_task = None
+        self._fail_exclusive("engine stopped")
 
     # -- public API --------------------------------------------------------
 

@@ -228,6 +228,15 @@ class PageAllocator:
             self._free.append(page)
         self._lru.clear()
         self._emit(cleared=True)
+        # `cleared` wipes the router's whole view of this worker, but
+        # refcount>0 committed pages survive and stay matchable — re-advertise
+        # them (registry insertion order = commit order, so parents precede
+        # children and the indexer's chain walk stays valid)
+        for h, page in self._by_hash.items():
+            info = self._info[page]
+            self._emit(stored=[KvCacheStoredBlock(block_hash=h,
+                                                  tokens_hash=info.local_hash)],
+                       parent=info.parent_hash)
 
 
 __all__ = ["PageAllocator", "PrefixMatch", "OutOfPages"]

@@ -137,12 +137,13 @@ def serve_kv_export(engine: JaxEngine):
     """RPC handler factory: serves block fetches for disagg decode workers.
 
     Endpoint payload: {"block_hashes": [...]}; streams one frame per block.
+    The export runs via ``run_exclusive`` so it never races a
+    pages-donating engine step.
     """
-    import asyncio
 
     async def handler(payload: Any, ctx):
         hashes = list((payload or {}).get("block_hashes", []))
-        blocks = await asyncio.to_thread(export_blocks, engine, hashes)
+        blocks = await engine.run_exclusive(export_blocks, engine, hashes)
         for b in blocks:
             yield b.to_wire()
 

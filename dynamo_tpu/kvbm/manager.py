@@ -112,9 +112,11 @@ class TieredEngine(EngineBase):
 
     async def generate(self, request: PreprocessedRequest,
                        ctx=None) -> AsyncIterator[LLMEngineOutput]:
-        import asyncio
         if request.token_ids:
-            await asyncio.to_thread(self._onboard_for, request.token_ids)
+            # serialized with the step loop: onboarding reassigns
+            # engine.pages, which is donated through every step
+            await self.engine.run_exclusive(
+                self._onboard_for, request.token_ids)
         async for out in self.engine.generate(request, ctx):
             yield out
 

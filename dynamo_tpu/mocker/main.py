@@ -14,10 +14,9 @@ import asyncio
 from dynamo_tpu.llm.register import register_llm, serve_engine
 from dynamo_tpu.mocker.engine import MockEngineArgs, MockerEngine
 from dynamo_tpu.model_card import ModelDeploymentCard
-from dynamo_tpu.protocols.events import RouterEvent
 from dynamo_tpu.runtime.runtime import DEFAULT_COORDINATOR, DistributedRuntime
 from dynamo_tpu.utils.logging import configure_logging
-from dynamo_tpu.worker.main import kv_events_subject
+from dynamo_tpu.worker.events import kv_events_subject, ordered_kv_publisher
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -60,19 +59,12 @@ async def amain(args: argparse.Namespace) -> None:
         speedup_ratio=args.speedup_ratio, vocab_size=vocab))
     endpoint = (drt.namespace(args.namespace).component(args.component)
                 .endpoint(args.endpoint))
+    event_pump = None
     if not args.no_kv_events:
         lease = await drt.primary_lease()
-        subject = kv_events_subject(args.namespace, args.component)
-
-        def publish(events):
-            async def _send():
-                for ev in events:
-                    await drt.publish_event(
-                        subject, RouterEvent(worker_id=lease.lease_id,
-                                             event=ev).to_dict())
-            asyncio.get_running_loop().create_task(_send())
-
-        engine.kv_event_cb = publish
+        engine.kv_event_cb, event_pump = ordered_kv_publisher(
+            drt, kv_events_subject(args.namespace, args.component),
+            lease.lease_id)
     await serve_engine(endpoint, engine,
                        stats_provider=lambda: engine.stats().to_dict())
     await register_llm(drt, endpoint, card)
@@ -80,6 +72,8 @@ async def amain(args: argparse.Namespace) -> None:
     try:
         await drt.runtime.wait_shutdown()
     finally:
+        if event_pump is not None:
+            event_pump.cancel()
         await engine.stop()
         await drt.close()
 

@@ -139,13 +139,16 @@ class DisaggDecodeHandler:
                 async for frame in kv_stream:
                     blocks.append(BlockPayload.from_wire(frame))
                 if blocks:
-                    n = await asyncio.to_thread(
+                    n = await self.engine.run_exclusive(
                         inject_blocks, self.engine, blocks)
                     logger.debug("injected %d/%d transferred blocks",
                                  n, len(blocks))
             return final
-        except ConnectionError as e:
-            logger.warning("remote prefill failed (%s); falling back local", e)
+        except Exception as e:  # noqa: BLE001 — disagg must never fail a
+            # request: any remote-leg error (connection, malformed frame,
+            # inject failure) falls back to local prefill
+            logger.warning("remote prefill failed (%s); falling back local", e,
+                           exc_info=not isinstance(e, ConnectionError))
             return None
 
     async def generate(self, request: PreprocessedRequest,

@@ -21,7 +21,6 @@ from __future__ import annotations
 import argparse
 import asyncio
 import logging
-from typing import List
 
 import jax
 
@@ -31,15 +30,11 @@ from dynamo_tpu.model_card import ModelDeploymentCard
 from dynamo_tpu.models import llama
 from dynamo_tpu.models.config import ModelConfig
 from dynamo_tpu.models.hf_loader import load_hf_params
-from dynamo_tpu.protocols.events import KvCacheEvent, RouterEvent
 from dynamo_tpu.runtime.runtime import DEFAULT_COORDINATOR, DistributedRuntime
 from dynamo_tpu.utils.logging import configure_logging
+from dynamo_tpu.worker.events import kv_events_subject, ordered_kv_publisher
 
 logger = logging.getLogger(__name__)
-
-
-def kv_events_subject(namespace: str, component: str) -> str:
-    return f"{namespace}.{component}.kv_events"
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -109,18 +104,12 @@ async def amain(args: argparse.Namespace) -> None:
                 .endpoint(args.endpoint))
     engine = build_engine(args)
 
+    event_pump: asyncio.Task | None = None
     if not args.no_kv_events:
         lease = await drt.primary_lease()
-        subject = kv_events_subject(args.namespace, args.component)
-
-        def publish_events(events: List[KvCacheEvent]) -> None:
-            async def _send() -> None:
-                for ev in events:
-                    rev = RouterEvent(worker_id=lease.lease_id, event=ev)
-                    await drt.publish_event(subject, rev.to_dict())
-            asyncio.get_running_loop().create_task(_send())
-
-        engine.kv_event_cb = publish_events
+        engine.kv_event_cb, event_pump = ordered_kv_publisher(
+            drt, kv_events_subject(args.namespace, args.component),
+            lease.lease_id)
 
     handler = None
     if args.disagg == "decode":
@@ -160,6 +149,8 @@ async def amain(args: argparse.Namespace) -> None:
             await system.stop()
         if handler is not None:
             await handler.stop()
+        if event_pump is not None:
+            event_pump.cancel()
         await engine.stop()
         await drt.close()
 

@@ -12,7 +12,7 @@ from dynamo_tpu.models import llama
 
 _U32, _F32T, _STR, _ARR, _U64 = 4, 6, 8, 9, 10
 GGML_F32, GGML_F16 = 0, 1
-Q4_0 = 2
+UNSUPPORTED_QTYPE = 13  # Q5_K — not in this loader's dequant set
 
 
 def w_str(s: str) -> bytes:
@@ -69,7 +69,17 @@ def tiny_cfg():
     return ModelConfig.tiny(vocab_size=64, tie_word_embeddings=True)
 
 
+def permute_qk(w: np.ndarray, n_head: int) -> np.ndarray:
+    """llama.cpp converter's HF->GGUF per-head Q/K row permutation."""
+    out_dim, in_dim = w.shape
+    return (w.reshape(n_head, 2, out_dim // n_head // 2, in_dim)
+            .swapaxes(1, 2).reshape(out_dim, in_dim))
+
+
 def make_file(path, lm_head=False, quantized_block=False):
+    """Write a synthetic GGUF the way llama.cpp's converter would (Q/K rows
+    permuted into interleaved-rope layout). Returns the HF-layout arrays the
+    loader must recover."""
     cfg = tiny_cfg()
     rng = np.random.default_rng(0)
     H, I = cfg.hidden_size, cfg.intermediate_size
@@ -89,33 +99,54 @@ def make_file(path, lm_head=False, quantized_block=False):
          (_STR, [f"tok{i}" for i in range(cfg.vocab_size)])),
         ("tokenizer.ggml.eos_token_id", _U32, 2),
     ]
-    tensors = [("token_embd.weight",
-                rng.standard_normal((cfg.vocab_size, H)).astype(np.float32),
-                GGML_F32),
-               ("output_norm.weight", np.ones(H, np.float32), GGML_F32)]
+    hf = {"token_embd.weight":
+          rng.standard_normal((cfg.vocab_size, H)).astype(np.float32),
+          "output_norm.weight": np.ones(H, np.float32)}
+    tensors = [("token_embd.weight", hf["token_embd.weight"], GGML_F32),
+               ("output_norm.weight", hf["output_norm.weight"], GGML_F32)]
     for i in range(cfg.num_layers):
         pre = f"blk.{i}"
+        hf[f"{pre}.attn_q.weight"] = rng.standard_normal(
+            (cfg.q_size, H)).astype(np.float16)
+        hf[f"{pre}.attn_k.weight"] = rng.standard_normal(
+            (cfg.kv_size, H)).astype(np.float32)
+        for name, arr in [
+                (f"{pre}.attn_norm.weight", np.ones(H, np.float32)),
+                (f"{pre}.attn_v.weight",
+                 rng.standard_normal((cfg.kv_size, H)).astype(np.float32)),
+                (f"{pre}.attn_output.weight",
+                 rng.standard_normal((H, cfg.q_size)).astype(np.float32)),
+                (f"{pre}.ffn_norm.weight", np.ones(H, np.float32)),
+                (f"{pre}.ffn_gate.weight",
+                 rng.standard_normal((I, H)).astype(np.float32)),
+                (f"{pre}.ffn_up.weight",
+                 rng.standard_normal((I, H)).astype(np.float32)),
+                (f"{pre}.ffn_down.weight",
+                 rng.standard_normal((H, I)).astype(np.float32)),
+        ]:
+            hf[name] = arr
         tensors += [
-            (f"{pre}.attn_norm.weight", np.ones(H, np.float32), GGML_F32),
+            (f"{pre}.attn_norm.weight", hf[f"{pre}.attn_norm.weight"],
+             GGML_F32),
             (f"{pre}.attn_q.weight",
-             rng.standard_normal((cfg.q_size, H)).astype(np.float16), GGML_F16),
+             permute_qk(hf[f"{pre}.attn_q.weight"], cfg.num_heads),
+             GGML_F16),
             (f"{pre}.attn_k.weight",
-             rng.standard_normal((cfg.kv_size, H)).astype(np.float32), GGML_F32),
-            (f"{pre}.attn_v.weight",
-             rng.standard_normal((cfg.kv_size, H)).astype(np.float32), GGML_F32),
+             permute_qk(hf[f"{pre}.attn_k.weight"], cfg.num_kv_heads),
+             GGML_F32),
+            (f"{pre}.attn_v.weight", hf[f"{pre}.attn_v.weight"], GGML_F32),
             (f"{pre}.attn_output.weight",
-             rng.standard_normal((H, cfg.q_size)).astype(np.float32), GGML_F32),
-            (f"{pre}.ffn_norm.weight", np.ones(H, np.float32), GGML_F32),
-            (f"{pre}.ffn_gate.weight",
-             rng.standard_normal((I, H)).astype(np.float32), GGML_F32),
-            (f"{pre}.ffn_up.weight",
-             rng.standard_normal((I, H)).astype(np.float32), GGML_F32),
-            (f"{pre}.ffn_down.weight",
-             rng.standard_normal((H, I)).astype(np.float32),
-             Q4_0 if quantized_block else GGML_F32),
+             hf[f"{pre}.attn_output.weight"], GGML_F32),
+            (f"{pre}.ffn_norm.weight", hf[f"{pre}.ffn_norm.weight"],
+             GGML_F32),
+            (f"{pre}.ffn_gate.weight", hf[f"{pre}.ffn_gate.weight"],
+             GGML_F32),
+            (f"{pre}.ffn_up.weight", hf[f"{pre}.ffn_up.weight"], GGML_F32),
+            (f"{pre}.ffn_down.weight", hf[f"{pre}.ffn_down.weight"],
+             UNSUPPORTED_QTYPE if quantized_block else GGML_F32),
         ]
     write_gguf(path, md, tensors)
-    return tensors
+    return hf
 
 
 class TestGguf:
@@ -133,22 +164,30 @@ class TestGguf:
 
     def test_tensor_roundtrip_f32_and_f16(self, tmp_path):
         p = str(tmp_path / "m.gguf")
-        tensors = make_file(p)
+        hf = make_file(p)
         gf = GgufFile(p)
-        by_name = {n: (a, t) for n, a, t in tensors}
         emb = gf.load_tensor("token_embd.weight")
-        np.testing.assert_array_equal(emb, by_name["token_embd.weight"][0])
+        np.testing.assert_array_equal(emb, hf["token_embd.weight"])
+        # raw tensor read returns the on-file (converter-permuted) layout
         q = gf.load_tensor("blk.0.attn_q.weight")
         np.testing.assert_array_equal(
-            q, by_name["blk.0.attn_q.weight"][0])
+            q, permute_qk(hf["blk.0.attn_q.weight"], tiny_cfg().num_heads))
 
     def test_params_load_and_forward(self, tmp_path):
         p = str(tmp_path / "m.gguf")
-        make_file(p)
+        hf = make_file(p)
         gf = GgufFile(p)
         cfg = gf.to_model_config(dtype="float32")
         params = load_gguf_params(cfg, p)
         assert params["layers"]["wq"].shape == (2, cfg.hidden_size, cfg.q_size)
+        # the loader must UNDO the converter's Q/K permutation so rotate-half
+        # rope sees HF-layout rows (stored transposed: [hidden, out])
+        np.testing.assert_allclose(
+            np.asarray(params["layers"]["wq"][0]),
+            hf["blk.0.attn_q.weight"].astype(np.float32).T, rtol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(params["layers"]["wk"][1]),
+            hf["blk.1.attn_k.weight"].T, rtol=1e-6)
         pages = llama.make_pages(cfg, 4, 4)
         logits, _ = llama.forward(
             params, cfg, jnp.array([[1, 2, 3]], jnp.int32),
@@ -157,12 +196,12 @@ class TestGguf:
             jnp.array([3], jnp.int32))
         assert logits.shape == (1, cfg.vocab_size)
 
-    def test_quantized_tensor_rejected_clearly(self, tmp_path):
+    def test_unsupported_quant_rejected_clearly(self, tmp_path):
         p = str(tmp_path / "q.gguf")
         make_file(p, quantized_block=True)
         gf = GgufFile(p)
         cfg = gf.to_model_config()
-        with pytest.raises(NotImplementedError, match="quantized"):
+        with pytest.raises(NotImplementedError, match="unsupported"):
             load_gguf_params(cfg, p)
 
     def test_not_gguf_rejected(self, tmp_path):
@@ -170,3 +209,128 @@ class TestGguf:
         p.write_bytes(b"NOPE" + b"\0" * 100)
         with pytest.raises(ValueError, match="not a GGUF"):
             GgufFile(str(p))
+
+
+def quantize_q8_0(x: np.ndarray) -> bytes:
+    """Reference Q8_0 quantizer (public ggml block layout)."""
+    out = b""
+    for block in x.reshape(-1, 32):
+        d = np.abs(block).max() / 127.0
+        q = np.round(block / d).astype(np.int8) if d else np.zeros(32, np.int8)
+        out += np.float16(d).tobytes() + q.tobytes()
+    return out
+
+
+def quantize_q4_0(x: np.ndarray) -> bytes:
+    out = b""
+    for block in x.reshape(-1, 32):
+        amax = block[np.argmax(np.abs(block))]
+        d = amax / -8.0
+        q = (np.clip(np.round(block / d) if d else np.zeros(32), -8, 7)
+             .astype(np.int8) + 8).astype(np.uint8)
+        packed = (q[:16] | (q[16:] << 4)).astype(np.uint8)
+        out += np.float16(d).tobytes() + packed.tobytes()
+    return out
+
+
+class TestGgufDequant:
+    """Vectorized dequant vs independent scalar walks of the block layout."""
+
+    def _load_single(self, tmp_path, name, raw, shape, gtype):
+        from dynamo_tpu.models.gguf import GgufFile
+        p = str(tmp_path / "t.gguf")
+        md = [("general.architecture", _STR, "llama"),
+              ("general.alignment", _U32, 32)]
+        # write raw pre-quantized bytes via a fake ndarray of uint8
+        arr = np.frombuffer(raw, np.uint8)
+        align = 32
+        header = bytearray(b"GGUF" + struct.pack("<I", 3))
+        header += struct.pack("<Q", 1) + struct.pack("<Q", len(md))
+        for key, vtype, value in md:
+            header += w_kv(key, vtype, value)
+        infos = bytearray(w_str(name))
+        infos += struct.pack("<I", len(shape))
+        for d in reversed(shape):
+            infos += struct.pack("<Q", d)
+        infos += struct.pack("<I", gtype) + struct.pack("<Q", 0)
+        body = bytes(header) + bytes(infos)
+        pad = (-len(body)) % align
+        with open(p, "wb") as f:
+            f.write(body + b"\0" * pad + arr.tobytes())
+        return GgufFile(p).load_tensor(name)
+
+    def test_q8_0_roundtrip(self, tmp_path):
+        rng = np.random.default_rng(1)
+        x = rng.standard_normal((8, 64)).astype(np.float32)
+        got = self._load_single(tmp_path, "w", quantize_q8_0(x), (8, 64), 8)
+        np.testing.assert_allclose(got, x, atol=0.02)
+
+    def test_q4_0_roundtrip(self, tmp_path):
+        rng = np.random.default_rng(2)
+        x = rng.standard_normal((4, 64)).astype(np.float32)
+        got = self._load_single(tmp_path, "w", quantize_q4_0(x), (4, 64), 2)
+        np.testing.assert_allclose(got, x, atol=0.35)
+
+    def test_q4_k_matches_scalar_reference(self, tmp_path):
+        rng = np.random.default_rng(3)
+        n_blocks = 3
+        raw = b""
+        expect = []
+        for _ in range(n_blocks):
+            d, dmin = np.float16(0.03), np.float16(0.01)
+            scales = rng.integers(0, 256, 12, dtype=np.uint8)
+            qs = rng.integers(0, 256, 128, dtype=np.uint8)
+            raw += d.tobytes() + dmin.tobytes() + scales.tobytes() + qs.tobytes()
+            # scalar reference: unpack 6-bit (sc, m) pairs then nibbles
+            sc, m = [], []
+            for j in range(8):
+                if j < 4:
+                    sc.append(scales[j] & 63)
+                    m.append(scales[j + 4] & 63)
+                else:
+                    sc.append((scales[j + 4] & 0xF) | ((scales[j - 4] >> 6) << 4))
+                    m.append((scales[j + 4] >> 4) | ((scales[j] >> 6) << 4))
+            vals = np.empty(256, np.float32)
+            for j in range(4):
+                q = qs[32 * j:32 * j + 32]
+                for i in range(32):
+                    vals[64 * j + i] = (float(d) * sc[2 * j] * (q[i] & 0xF)
+                                        - float(dmin) * m[2 * j])
+                    vals[64 * j + 32 + i] = (float(d) * sc[2 * j + 1]
+                                             * (q[i] >> 4)
+                                             - float(dmin) * m[2 * j + 1])
+            expect.append(vals)
+        got = self._load_single(tmp_path, "w", raw, (n_blocks, 256), 12)
+        np.testing.assert_allclose(got, np.stack(expect), rtol=1e-5)
+
+    def test_q6_k_matches_scalar_reference(self, tmp_path):
+        rng = np.random.default_rng(4)
+        n_blocks = 2
+        raw = b""
+        expect = []
+        for _ in range(n_blocks):
+            ql = rng.integers(0, 256, 128, dtype=np.uint8)
+            qh = rng.integers(0, 256, 64, dtype=np.uint8)
+            scales = rng.integers(-128, 128, 16).astype(np.int8)
+            d = np.float16(0.02)
+            raw += ql.tobytes() + qh.tobytes() + scales.tobytes() + d.tobytes()
+            vals = np.empty(256, np.float32)
+            for half in range(2):
+                base = 128 * half
+                _ql = ql[64 * half:64 * half + 64]
+                _qh = qh[32 * half:32 * half + 32]
+                _sc = scales[8 * half:8 * half + 8]
+                for l in range(32):
+                    is_ = l // 16
+                    # int() so `- 32` can't wrap the uint8 scalars
+                    q1 = int(_ql[l] & 0xF) | ((int(_qh[l]) >> 0 & 3) << 4)
+                    q2 = int(_ql[l + 32] & 0xF) | ((int(_qh[l]) >> 2 & 3) << 4)
+                    q3 = int(_ql[l] >> 4) | ((int(_qh[l]) >> 4 & 3) << 4)
+                    q4 = int(_ql[l + 32] >> 4) | ((int(_qh[l]) >> 6 & 3) << 4)
+                    vals[base + l] = float(d) * _sc[is_] * (q1 - 32)
+                    vals[base + l + 32] = float(d) * _sc[is_ + 2] * (q2 - 32)
+                    vals[base + l + 64] = float(d) * _sc[is_ + 4] * (q3 - 32)
+                    vals[base + l + 96] = float(d) * _sc[is_ + 6] * (q4 - 32)
+            expect.append(vals)
+        got = self._load_single(tmp_path, "w", raw, (n_blocks, 256), 14)
+        np.testing.assert_allclose(got, np.stack(expect), rtol=1e-5)
