@@ -4,7 +4,8 @@ This is the component the reference never builds natively — its workers shell
 out to vLLM/SGLang CUDA engines (SURVEY §2.5); here the model loop is owned by
 the framework and designed for XLA:
 
-- TWO compiled step shapes, prefill (``[1, S]`` chunk) and decode (``[B, 1]``
+- TWO compiled step families, prefill (``[B, S]`` chunk batch — multiple
+  sequences share one step under a token budget) and decode (``[B, 1]``
   batch), with power-of-two bucketing on S and B so the set of compiled
   programs is small and fixed. The page-table width is static
   (``max_context / page_size``), so no shape depends on sequence length.
@@ -34,7 +35,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from dynamo_tpu.engine.loop import ScheduledEngineBase
-from dynamo_tpu.engine.scheduler import PrefillChunk, StepPlan
+from dynamo_tpu.engine.scheduler import PrefillBatch, StepPlan
 from dynamo_tpu.models.config import ModelConfig
 from dynamo_tpu.models import llama
 from dynamo_tpu.ops.sampling import sample_tokens
@@ -49,13 +50,17 @@ class JaxEngineConfig:
     num_pages: int = 512          # physical KV pages (page 0 reserved)
     page_size: int = 16           # tokens per page == router block size
     max_num_seqs: int = 8         # max concurrent sequences
-    max_prefill_chunk: int = 512  # longest single prefill step
+    max_prefill_chunk: int = 512  # prompt-token budget per prefill step
+    max_prefill_seqs: int = 8     # sequences sharing one prefill step
     max_context: int = 2048       # max prompt+generation length
     min_prefill_bucket: int = 16
     # floor for the padded decode batch: raising it to max_num_seqs gives ONE
     # compiled decode shape (fewer compiles, steadier step time); leaving it
     # at 1 compiles each power-of-two batch as load ramps
     min_decode_bucket: int = 1
+    # same knob for the prefill batch dimension: raising it pins B to fewer
+    # compiled (B, S) combinations at the cost of padded rows
+    min_prefill_seqs_bucket: int = 1
     seed: int = 0
     # attention implementation:
     #   "scan"     — lax.scan over layers, stacked cache, XLA gather attention
@@ -89,7 +94,8 @@ class JaxEngine(ScheduledEngineBase):
             num_pages=self.cfg.num_pages, page_size=self.cfg.page_size,
             max_num_seqs=self.cfg.max_num_seqs,
             max_prefill_chunk=self.cfg.max_prefill_chunk,
-            max_context=self.cfg.max_context)
+            max_context=self.cfg.max_context,
+            max_prefill_seqs=self.cfg.max_prefill_seqs)
         self.params = params
         from dynamo_tpu.models import get_family
         family = get_family(model_cfg)
@@ -141,25 +147,35 @@ class JaxEngine(ScheduledEngineBase):
     def _execute_plan(self, plan: StepPlan) -> Tuple[np.ndarray, np.ndarray]:
         """Build padded arrays, run the jitted step, fetch sampled tokens."""
         P = self.table_width
-        if isinstance(plan, PrefillChunk):
-            seq = plan.seq
-            S = _bucket(plan.length, self.cfg.min_prefill_bucket,
+        if isinstance(plan, PrefillBatch):
+            chunks = plan.chunks
+            B = _bucket(len(chunks), self.cfg.min_prefill_seqs_bucket,
+                        self.cfg.max_num_seqs)
+            S = _bucket(max(c.length for c in chunks),
+                        self.cfg.min_prefill_bucket,
                         self.cfg.max_prefill_chunk)
-            toks = np.zeros((1, S), np.int32)
-            all_tokens = seq.tokens.tokens()
-            toks[0, :plan.length] = all_tokens[plan.start:plan.start + plan.length]
-            pos = np.zeros((1, S), np.int32)
-            pos[0, :plan.length] = np.arange(plan.start, plan.start + plan.length)
-            table = np.zeros((1, P), np.int32)
-            table[0, :len(seq.page_ids)] = seq.page_ids
-            total = np.array([plan.start + plan.length], np.int32)
-            new = np.array([plan.length], np.int32)
-            so = seq.request.sampling_options
-            temp = np.array([so.temperature if so.temperature is not None else 0.0],
-                            np.float32)
-            top_k = np.array([so.top_k or 0], np.int32)
-            top_p = np.array([so.top_p if so.top_p is not None else 1.0],
-                             np.float32)
+            toks = np.zeros((B, S), np.int32)
+            pos = np.zeros((B, S), np.int32)
+            table = np.zeros((B, P), np.int32)
+            total = np.ones(B, np.int32)   # pad rows: 1 garbage-page token
+            new = np.zeros(B, np.int32)    # pad rows: write nothing
+            temp = np.zeros(B, np.float32)
+            top_k = np.zeros(B, np.int32)
+            top_p = np.ones(B, np.float32)
+            for i, c in enumerate(chunks):
+                seq = c.seq
+                all_tokens = seq.tokens.tokens()
+                toks[i, :c.length] = all_tokens[c.start:c.start + c.length]
+                pos[i, :c.length] = np.arange(c.start, c.start + c.length)
+                table[i, :len(seq.page_ids)] = seq.page_ids
+                total[i] = c.start + c.length
+                new[i] = c.length
+                so = seq.request.sampling_options
+                if so.temperature is not None:
+                    temp[i] = so.temperature
+                top_k[i] = so.top_k or 0
+                if so.top_p is not None:
+                    top_p[i] = so.top_p
         else:
             seqs = plan.seqs
             B = _bucket(len(seqs), self.cfg.min_decode_bucket,

@@ -32,9 +32,8 @@ import numpy as np
 from dynamo_tpu.engine.base import EngineBase
 from dynamo_tpu.engine.pages import PageAllocator
 from dynamo_tpu.engine.scheduler import (
-    DecodeBatch,
     Phase,
-    PrefillChunk,
+    PrefillBatch,
     Scheduler,
     SchedulerConfig,
     Sequence,
@@ -54,13 +53,15 @@ class ScheduledEngineBase(EngineBase):
     """Continuous batching over a PageAllocator; subclasses do the math."""
 
     def __init__(self, num_pages: int, page_size: int, max_num_seqs: int,
-                 max_prefill_chunk: int, max_context: int):
+                 max_prefill_chunk: int, max_context: int,
+                 max_prefill_seqs: int = 8):
         if max_context % page_size:
             raise ValueError("max_context must be a multiple of page_size")
         self.max_context = max_context
         self.allocator = PageAllocator(num_pages, page_size)
         self.scheduler = Scheduler(self.allocator, SchedulerConfig(
-            max_num_seqs=max_num_seqs, max_prefill_chunk=max_prefill_chunk))
+            max_num_seqs=max_num_seqs, max_prefill_chunk=max_prefill_chunk,
+            max_prefill_seqs=max_prefill_seqs))
         self._queues: Dict[str, asyncio.Queue] = {}
         self._work = asyncio.Event()
         self._loop_task: Optional[asyncio.Task] = None
@@ -75,8 +76,9 @@ class ScheduledEngineBase(EngineBase):
 
     def _execute_plan(self, plan: StepPlan) -> Tuple[np.ndarray, np.ndarray]:
         """Run one step; returns (sampled_tokens, logprobs) aligned with the
-        plan (prefill: length-1 arrays; decode: one entry per plan.seqs).
-        Runs in a worker thread — must not touch scheduler state."""
+        plan (prefill: one entry per plan.chunks; decode: one entry per
+        plan.seqs). Runs in a worker thread — must not touch scheduler
+        state."""
         raise NotImplementedError
 
     # -- frame emission ----------------------------------------------------
@@ -126,30 +128,33 @@ class ScheduledEngineBase(EngineBase):
     def _process(self, plan: StepPlan, sampled: np.ndarray,
                  logprobs: np.ndarray) -> None:
         self.scheduler.on_step_done(plan)
-        if isinstance(plan, PrefillChunk):
-            seq = plan.seq
-            if seq.cancelled:
-                self._finish(seq, FinishReason.CANCELLED)
-            elif plan.is_last:
-                if seq.request.prefill_only:
-                    # disagg prefill worker: one token, KV stays cached; the
-                    # final frame advertises the transferable blocks
-                    tok = int(sampled[0])
-                    seq.tokens.append(tok)
-                    seq.generated.append(tok)
-                    blocks = seq.tokens.blocks[:seq.committed_pages]
-                    params = {
-                        "blocks": [[b.block_hash, b.local_hash,
-                                    b.parent_hash if b.position else None]
-                                   for b in blocks],
-                        "page_size": self.allocator.page_size,
-                        "num_tokens_cached": len(blocks)
-                        * self.allocator.page_size,
-                    }
-                    self._finish(seq, FinishReason.LENGTH, tok,
-                                 float(logprobs[0]), kv_transfer_params=params)
-                else:
-                    self._accept_token(seq, int(sampled[0]), float(logprobs[0]))
+        if isinstance(plan, PrefillBatch):
+            for i, chunk in enumerate(plan.chunks):
+                seq = chunk.seq
+                if seq.cancelled:
+                    self._finish(seq, FinishReason.CANCELLED)
+                elif chunk.is_last:
+                    if seq.request.prefill_only:
+                        # disagg prefill worker: one token, KV stays cached;
+                        # the final frame advertises the transferable blocks
+                        tok = int(sampled[i])
+                        seq.tokens.append(tok)
+                        seq.generated.append(tok)
+                        blocks = seq.tokens.blocks[:seq.committed_pages]
+                        params = {
+                            "blocks": [[b.block_hash, b.local_hash,
+                                        b.parent_hash if b.position else None]
+                                       for b in blocks],
+                            "page_size": self.allocator.page_size,
+                            "num_tokens_cached": len(blocks)
+                            * self.allocator.page_size,
+                        }
+                        self._finish(seq, FinishReason.LENGTH, tok,
+                                     float(logprobs[i]),
+                                     kv_transfer_params=params)
+                    else:
+                        self._accept_token(seq, int(sampled[i]),
+                                           float(logprobs[i]))
         else:
             for i, seq in enumerate(plan.seqs):
                 if seq.phase is not Phase.RUNNING:
@@ -252,8 +257,7 @@ class ScheduledEngineBase(EngineBase):
                     self._execute_plan, plan)
             except Exception as e:  # noqa: BLE001 — engine must not die silently
                 logger.exception("engine step failed")
-                victims = (plan.seqs if isinstance(plan, DecodeBatch)
-                           else [plan.seq])
+                victims = plan.seqs
                 for seq in victims:
                     self.scheduler.finish(seq)
                     self._emit(seq, LLMEngineOutput(

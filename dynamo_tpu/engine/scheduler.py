@@ -12,10 +12,11 @@ engine):
            prefix cache usually revives the computed prefix)
 
 The scheduler is pure host-side bookkeeping: it never touches device arrays.
-Each call to :meth:`schedule` returns ONE step plan — either a prefill chunk
-for a single sequence or a decode batch over all running sequences — and the
-engine turns the plan into padded/bucketed device arrays. Prefill and decode
-alternate when both are runnable so neither starves.
+Each call to :meth:`schedule` returns ONE step plan — either a prefill batch
+(up to ``max_prefill_seqs`` sequences sharing the ``max_prefill_chunk`` token
+budget, one [B, S] step) or a decode batch over all running sequences — and
+the engine turns the plan into padded/bucketed device arrays. Prefill and
+decode alternate when both are runnable so neither starves.
 
 Token accounting: ``num_computed`` counts positions whose KV is written to the
 cache. A decode step feeds the single newest token (position ``len-1``),
@@ -84,17 +85,33 @@ class PrefillChunk:
 
 
 @dataclass
+class PrefillBatch:
+    """One prefill step advancing several sequences at once ([B, S] on
+    device, one row per chunk). Concurrent arrivals share a step instead of
+    serializing, so decode cadence stays bounded under bursts — the role of
+    the reference mocker's token-budget chunked scheduler
+    (``lib/llm/src/mocker/scheduler.rs:249-520``)."""
+
+    chunks: List[PrefillChunk]
+
+    @property
+    def seqs(self) -> List[Sequence]:
+        return [c.seq for c in self.chunks]
+
+
+@dataclass
 class DecodeBatch:
     seqs: List[Sequence]
 
 
-StepPlan = Union[PrefillChunk, DecodeBatch]
+StepPlan = Union[PrefillBatch, DecodeBatch]
 
 
 @dataclass
 class SchedulerConfig:
     max_num_seqs: int = 64           # concurrent running+prefill sequences
-    max_prefill_chunk: int = 512     # max prompt tokens per prefill step
+    max_prefill_chunk: int = 512     # prompt-token budget per prefill step
+    max_prefill_seqs: int = 8        # max sequences sharing one prefill step
     watermark: float = 0.01          # keep this fraction of pages free at admit
     max_queue: int = 4096
 
@@ -242,13 +259,38 @@ class Scheduler:
 
     # -- the step ----------------------------------------------------------
 
-    def _prefill_plan(self, seq: Sequence) -> PrefillChunk:
-        # len(seq), not num_prompt: a revived preempted sequence must also
-        # re-prefill the tokens it had generated before eviction
-        remaining = len(seq) - seq.num_computed
-        length = min(remaining, self.cfg.max_prefill_chunk)
-        return PrefillChunk(seq=seq, start=seq.num_computed, length=length,
-                            is_last=(length == remaining))
+    def _prefill_plan(self) -> Optional[PrefillBatch]:
+        """Admit waiting sequences (bounded by slots, pages, and batch
+        width), then pack up to ``max_prefill_seqs`` chunks into one step
+        under the ``max_prefill_chunk`` token budget, oldest first."""
+        n_prefill = sum(1 for s in self.active.values()
+                        if s.phase == Phase.PREFILL)
+        # cap admission at the batch width so admitted pages don't sit idle
+        # across many steps waiting for a row
+        while (n_prefill < self.cfg.max_prefill_seqs
+               and len(self.active) < self.cfg.max_num_seqs):
+            if self._try_admit() is None:
+                break
+            n_prefill += 1
+        prefilling = sorted(
+            (s for s in self.active.values() if s.phase == Phase.PREFILL),
+            key=lambda s: s.arrival)
+        if not prefilling:
+            return None
+        budget = self.cfg.max_prefill_chunk
+        chunks: List[PrefillChunk] = []
+        for seq in prefilling[:self.cfg.max_prefill_seqs]:
+            if budget <= 0:
+                break
+            # len(seq), not num_prompt: a revived preempted sequence must
+            # also re-prefill the tokens it had generated before eviction
+            remaining = len(seq) - seq.num_computed
+            length = min(remaining, budget)
+            chunks.append(PrefillChunk(seq=seq, start=seq.num_computed,
+                                       length=length,
+                                       is_last=(length == remaining)))
+            budget -= length
+        return PrefillBatch(chunks=chunks) if chunks else None
 
     def schedule(self) -> Optional[StepPlan]:
         """Pick the next engine step, or None if there is nothing to run."""
@@ -257,26 +299,15 @@ class Scheduler:
             self.finish(seq)
             self.reaped.append(seq)
 
-        prefilling = next((s for s in self.active.values()
-                           if s.phase == Phase.PREFILL), None)
-        if prefilling is None:
-            admitted = self._try_admit()
-            if admitted is not None:
-                prefilling = admitted
-
         decodable = [s for s in self.active.values() if s.phase == Phase.RUNNING]
 
-        run_prefill = prefilling is not None and (
-            self._prefer_prefill or not decodable)
-        if run_prefill:
-            self._prefer_prefill = False
-            return self._prefill_plan(prefilling)
+        if self._prefer_prefill or not decodable:
+            batch = self._prefill_plan()
+            if batch is not None:
+                self._prefer_prefill = False
+                return batch
         self._prefer_prefill = True
         if not decodable:
-            if prefilling is not None:
-                # only prefill work exists
-                self._prefer_prefill = False
-                return self._prefill_plan(prefilling)
             return None
         # decode: grow pages first (may preempt newest sequences)
         ready: List[Sequence] = []
@@ -292,12 +323,13 @@ class Scheduler:
 
     def on_step_done(self, plan: StepPlan) -> None:
         """Advance accounting after the engine ran the planned step."""
-        if isinstance(plan, PrefillChunk):
-            seq = plan.seq
-            seq.num_computed += plan.length
-            if plan.is_last:
-                seq.phase = Phase.RUNNING
-            self._commit_full_pages(seq)
+        if isinstance(plan, PrefillBatch):
+            for chunk in plan.chunks:
+                seq = chunk.seq
+                seq.num_computed += chunk.length
+                if chunk.is_last:
+                    seq.phase = Phase.RUNNING
+                self._commit_full_pages(seq)
         else:
             for seq in plan.seqs:
                 seq.num_computed += 1
@@ -325,4 +357,4 @@ class Scheduler:
 
 
 __all__ = ["Scheduler", "SchedulerConfig", "Sequence", "Phase",
-           "PrefillChunk", "DecodeBatch"]
+           "PrefillChunk", "PrefillBatch", "DecodeBatch"]

@@ -61,76 +61,193 @@ class BlockPayload:
                    data=arr.reshape(d["shape"]))
 
 
+def _pad_ids(page_ids: List[int]) -> List[int]:
+    """Pad a page-id list to the next power of two with page 0 (the reserved
+    garbage page), so the jitted gather/scatter compiles a handful of shapes
+    instead of one per transfer size."""
+    n = 1
+    while n < len(page_ids):
+        n *= 2
+    return list(page_ids) + [0] * (n - len(page_ids))
+
+
+@jax.jit
+def _gather_stacked(pages, ids):
+    return pages[:, :, :, ids]
+
+
+@jax.jit
+def _gather_list(pages, ids):
+    return jnp.stack([p[:, :, ids] for p in pages])
+
+
+def _gather_device(engine: JaxEngine, page_ids: List[int]):
+    """Device cache -> device array [L, 2, Hkv, n, ps, Dh] (n padded to a
+    power of two; extra slots hold garbage-page content)."""
+    ids = jnp.asarray(_pad_ids(page_ids), jnp.int32)
+    if isinstance(engine.pages, list):
+        return _gather_list(engine.pages, ids)
+    return _gather_stacked(engine.pages, ids)
+
+
 def _gather_pages(engine: JaxEngine, page_ids: List[int]) -> np.ndarray:
     """Device cache -> host [L, 2, Hkv, n, ps, Dh] for the given pages."""
-    ids = jnp.asarray(page_ids, jnp.int32)
-    if isinstance(engine.pages, list):
-        per_layer = [p[:, :, ids] for p in engine.pages]   # [2,Hkv,n,ps,Dh]
-        return np.asarray(jax.device_get(jnp.stack(per_layer)))
-    return np.asarray(jax.device_get(engine.pages[:, :, :, ids]))
+    out = jax.device_get(_gather_device(engine, page_ids))
+    return np.asarray(out)[:, :, :, :len(page_ids)]
 
 
 def _scatter_pages(engine: JaxEngine, page_ids: List[int],
-                   data: np.ndarray) -> None:
-    """Host [L, 2, Hkv, n, ps, Dh] -> device cache at the given pages."""
-    ids = jnp.asarray(page_ids, jnp.int32)
+                   data) -> None:
+    """[L, 2, Hkv, n, ps, Dh] (host or device) -> device cache at the given
+    pages.
+
+    The update runs as a donated jitted scatter: XLA aliases the input and
+    output cache buffers, so the write is in place — no full-cache copy per
+    injection (the pre-round-2 ``.at[].set`` outside jit materialized a
+    second copy of the whole KV cache every call).
+    """
+    ids = jnp.asarray(_pad_ids(page_ids), jnp.int32)
+    n_pad = ids.shape[0]
+    if not hasattr(engine, "_jit_scatter"):
+        engine._jit_scatter = jax.jit(
+            lambda pages, ids, vals: pages.at[:, :, :, ids].set(vals),
+            donate_argnums=(0,))
+        engine._jit_scatter_list = jax.jit(
+            lambda pages, ids, vals: [
+                p.at[:, :, ids].set(vals[l]) for l, p in enumerate(pages)],
+            donate_argnums=(0,))
     if isinstance(engine.pages, list):
-        vals = jnp.asarray(data, dtype=engine.pages[0].dtype)
-        engine.pages = [p.at[:, :, ids].set(vals[l])
-                        for l, p in enumerate(engine.pages)]
+        dtype = engine.pages[0].dtype
+        vals = _pad_vals(data, n_pad, dtype)
+        engine.pages = engine._jit_scatter_list(engine.pages, ids, vals)
     else:
-        vals = jnp.asarray(data, dtype=engine.pages.dtype)
-        engine.pages = engine.pages.at[:, :, :, ids].set(vals)
+        dtype = engine.pages.dtype
+        vals = _pad_vals(data, n_pad, dtype)
+        engine.pages = engine._jit_scatter(engine.pages, ids, vals)
+
+
+def _pad_vals(data, n_pad: int, dtype):
+    """Pad the page axis (3) of [L,2,Hkv,n,ps,Dh] to n_pad; padded slots
+    write to the garbage page, which is scratch by design."""
+    vals = jnp.asarray(data, dtype=dtype)
+    n = vals.shape[3]
+    if n < n_pad:
+        pad = [(0, 0)] * vals.ndim
+        pad[3] = (0, n_pad - n)
+        vals = jnp.pad(vals, pad)
+    return vals
 
 
 def export_blocks(engine: JaxEngine,
                   block_hashes: List[int]) -> List[BlockPayload]:
-    """Extract resident blocks by hash. Missing hashes are skipped (the
-    destination recomputes anything it doesn't receive)."""
-    alloc = engine.allocator
-    claimed: List[Tuple[int, int]] = []  # (hash, page_id)
-    try:
-        for h in block_hashes:
-            page = alloc._by_hash.get(h)
-            if page is None:
-                break  # chain broken: later blocks are useless without this one
-            alloc.incref(page)
-            claimed.append((h, page))
-        if not claimed:
-            return []
-        data = _gather_pages(engine, [p for _h, p in claimed])
-        out = []
-        for i, (h, page) in enumerate(claimed):
-            info = alloc._info[page]
-            out.append(BlockPayload(
-                block_hash=h, local_hash=info.local_hash,
-                parent_hash=info.parent_hash,
-                data=data[:, :, :, i]))
-        return out
-    finally:
-        alloc.release([p for _h, p in claimed])
+    """Extract resident blocks by hash as host payloads (the DCN/RPC path).
+    Missing hashes break the chain (the destination recomputes the rest)."""
+    metas, data = _export_device(engine, block_hashes)
+    if not metas:
+        return []
+    host = np.asarray(jax.device_get(data))[:, :, :, :len(metas)]
+    return [BlockPayload(block_hash=h, local_hash=local, parent_hash=parent,
+                         data=host[:, :, :, i])
+            for i, (h, local, parent) in enumerate(metas)]
 
 
-def inject_blocks(engine: JaxEngine, blocks: List[BlockPayload]) -> int:
-    """Write received blocks into the cache and register their hashes; they
-    land in the prefix-cache LRU, so the next admission of the matching
+def _inject_data(engine: JaxEngine,
+                 metas: List[Tuple[int, int, Optional[int]]],
+                 data) -> int:
+    """Core injection: ``metas[i] = (block_hash, local_hash, parent_hash)``
+    describes page slice ``data[:, :, :, i]`` ([L, 2, Hkv, n, ps, Dh], host
+    or device). Fresh blocks are scattered into the cache and registered;
+    they land in the prefix-cache LRU, so the next admission of the matching
     prompt revives them. Returns blocks actually injected."""
     alloc = engine.allocator
-    fresh = [b for b in blocks if b.block_hash not in alloc._by_hash]
-    if not fresh:
-        return 0
+    fresh = [i for i, m in enumerate(metas) if m[0] not in alloc._by_hash]
     if len(fresh) > alloc.num_free:
         # not worth evicting live cache for a partial chain; inject what fits
         fresh = fresh[:alloc.num_free]
     if not fresh:
         return 0
     pages = alloc.allocate(len(fresh))
-    data = np.stack([b.data for b in fresh], axis=3)  # [L,2,Hkv,n,ps,Dh]
+    if len(fresh) != len(metas):
+        data = jnp.asarray(data)[:, :, :, jnp.asarray(fresh, jnp.int32)]
     _scatter_pages(engine, pages, data)
-    for page, blk in zip(pages, fresh):
-        alloc.commit(page, blk.block_hash, blk.local_hash, blk.parent_hash)
+    for page, i in zip(pages, fresh):
+        h, local, parent = metas[i]
+        alloc.commit(page, h, local, parent)
     alloc.release(pages)  # refcount 0 -> LRU, matchable by admission
     return len(fresh)
+
+
+def inject_blocks(engine: JaxEngine, blocks: List[BlockPayload]) -> int:
+    """Inject host-side block payloads (the DCN/RPC path)."""
+    if not blocks:
+        return 0
+    metas = [(b.block_hash, b.local_hash, b.parent_hash) for b in blocks]
+    data = np.stack([b.data for b in blocks], axis=3)  # [L,2,Hkv,n,ps,Dh]
+    return _inject_data(engine, metas, data)
+
+
+def _export_device(engine: JaxEngine, block_hashes: List[int]):
+    """Extract resident blocks by hash as (metas, device array) — no host
+    round trip. Missing hashes break the chain (later blocks are useless
+    without their parents)."""
+    alloc = engine.allocator
+    claimed: List[Tuple[int, int]] = []
+    try:
+        for h in block_hashes:
+            page = alloc._by_hash.get(h)
+            if page is None:
+                break
+            alloc.incref(page)
+            claimed.append((h, page))
+        if not claimed:
+            return [], None
+        data = _gather_device(engine, [p for _h, p in claimed])
+        metas = []
+        for h, page in claimed:
+            info = alloc._info[page]
+            metas.append((h, info.local_hash, info.parent_hash))
+        return metas, data
+    finally:
+        alloc.release([p for _h, p in claimed])
+
+
+def _put_like(vals, pages) -> "jax.Array":
+    """Move a stacked [L, 2, Hkv, n, ps, Dh] array onto the sharding of the
+    destination cache (device-to-device on a real mesh — ICI, not host)."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    ref = pages[0] if isinstance(pages, list) else pages
+    sharding = ref.sharding
+    if isinstance(pages, list) and isinstance(sharding, NamedSharding):
+        # per-layer refs are rank 5; the stacked transport array is rank 6
+        sharding = NamedSharding(sharding.mesh,
+                                 PartitionSpec(None, *sharding.spec))
+    return jax.device_put(vals, sharding)
+
+
+async def transfer_blocks_ici(src: JaxEngine, dst: JaxEngine,
+                              block_hashes: List[int]) -> int:
+    """Same-process prefill-to-decode block handoff: device-to-device via
+    ``jax.device_put`` onto the destination cache's sharding (rides ICI on a
+    TPU mesh), then a donated jitted scatter — the KV bytes never touch a
+    ``np.ndarray``.
+
+    This is the NIXL-replacement fast path (reference:
+    ``lib/llm/src/block_manager/block/transfer/nixl.rs``,
+    ``nixl_connect/__init__.py``); the RPC/DCN path (``BlockPayload`` over
+    the runtime data plane) remains the cross-process fallback. Both legs
+    run inside the owning engine's exclusive window, so neither races a
+    pages-donating step.
+    """
+    metas, data = await src.run_exclusive(_export_device, src, block_hashes)
+    if not metas:
+        return 0
+
+    def _inject(dst_engine, metas_, data_):
+        moved = _put_like(data_[:, :, :, :len(metas_)], dst_engine.pages)
+        return _inject_data(dst_engine, metas_, moved)
+
+    return await dst.run_exclusive(_inject, dst, metas, data)
 
 
 def serve_kv_export(engine: JaxEngine):
@@ -151,4 +268,4 @@ def serve_kv_export(engine: JaxEngine):
 
 
 __all__ = ["BlockPayload", "export_blocks", "inject_blocks",
-           "serve_kv_export"]
+           "transfer_blocks_ici", "serve_kv_export"]

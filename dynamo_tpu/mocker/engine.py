@@ -25,7 +25,7 @@ from typing import Tuple
 import numpy as np
 
 from dynamo_tpu.engine.loop import ScheduledEngineBase
-from dynamo_tpu.engine.scheduler import PrefillChunk, StepPlan
+from dynamo_tpu.engine.scheduler import PrefillBatch, StepPlan
 
 
 @dataclass
@@ -36,6 +36,7 @@ class MockEngineArgs:
     page_size: int = 16            # reference: block_size
     max_num_seqs: int = 64
     max_prefill_chunk: int = 512
+    max_prefill_seqs: int = 8
     max_context: int = 4096
     speedup_ratio: float = 1.0     # >1 = faster than real time
     vocab_size: int = 32000
@@ -55,7 +56,8 @@ class MockerEngine(ScheduledEngineBase):
         super().__init__(num_pages=a.num_pages, page_size=a.page_size,
                          max_num_seqs=a.max_num_seqs,
                          max_prefill_chunk=a.max_prefill_chunk,
-                         max_context=a.max_context)
+                         max_context=a.max_context,
+                         max_prefill_seqs=a.max_prefill_seqs)
         self._rng = np.random.default_rng(0)
 
     def _simulate(self, seconds: float) -> None:
@@ -72,15 +74,21 @@ class MockerEngine(ScheduledEngineBase):
 
     def _execute_plan(self, plan: StepPlan) -> Tuple[np.ndarray, np.ndarray]:
         a = self.args
-        if isinstance(plan, PrefillChunk):
-            n, cached = plan.length, plan.start
-            self._simulate(a.prefill_base_s + n * a.prefill_per_token_s
-                           + n * cached * a.prefill_attn_quadratic_s)
-            seq = plan.seq
-            so = seq.request.sampling_options
-            tok = self._token_for(seq.request.request_id, len(seq),
-                                  so.temperature or 0.0)
-            return np.array([tok]), np.array([-1.0], np.float32)
+        if isinstance(plan, PrefillBatch):
+            # one shared step base + per-chunk token/attention costs: chunks
+            # batched into one step amortize the launch overhead, which is
+            # exactly the benefit batched prefill exists to model
+            cost = a.prefill_base_s
+            toks = np.empty(len(plan.chunks), np.int64)
+            for i, c in enumerate(plan.chunks):
+                cost += (c.length * a.prefill_per_token_s
+                         + c.length * c.start * a.prefill_attn_quadratic_s)
+                seq = c.seq
+                so = seq.request.sampling_options
+                toks[i] = self._token_for(seq.request.request_id, len(seq),
+                                          so.temperature or 0.0)
+            self._simulate(cost)
+            return toks, np.full(len(plan.chunks), -1.0, np.float32)
         b = len(plan.seqs)
         self._simulate(a.decode_base_s + b * a.decode_per_seq_s)
         toks = np.empty(b, np.int64)

@@ -16,9 +16,11 @@ import pytest
 from dynamo_tpu.engine.jax_engine import JaxEngine, JaxEngineConfig
 from dynamo_tpu.engine.transfer import (
     BlockPayload,
+    _export_device,
     export_blocks,
     inject_blocks,
     serve_kv_export,
+    transfer_blocks_ici,
 )
 from dynamo_tpu.llm.register import engine_handler, register_llm, serve_engine
 from dynamo_tpu.models.config import ModelConfig
@@ -96,6 +98,80 @@ class TestBlockTransfer:
             assert (wired[0].data == payloads[0].data).all()
         finally:
             await a.stop()
+
+
+class TestIciTransfer:
+    """Device-to-device (ICI-path) block transfer between two engines in one
+    process — the NIXL-replacement fast path. No np.ndarray round trip."""
+
+    async def test_ici_transfer_between_devices(self):
+        import jax
+
+        devs = jax.devices()
+        assert len(devs) >= 2, "conftest forces an 8-device CPU mesh"
+        a = JaxEngine.random_init(ModelConfig.tiny(), engine_cfg(
+            shard_params_fn=lambda p: jax.device_put(p, devs[0]),
+            shard_pages_fn=lambda p: jax.device_put(p, devs[0])))
+        b = JaxEngine.random_init(ModelConfig.tiny(), engine_cfg(
+            shard_params_fn=lambda p: jax.device_put(p, devs[1]),
+            shard_pages_fn=lambda p: jax.device_put(p, devs[1])))
+        try:
+            prompt = list(range(1, 14))  # 13 tokens -> 3 full blocks
+            solo_frames = await collect(a.generate(make_req(prompt, "solo")))
+            want = [t for f in solo_frames for t in f.token_ids]
+
+            req = make_req(prompt, "p")
+            req.prefill_only = True
+            frames = await collect(a.generate(req))
+            hashes = [blk[0]
+                      for blk in frames[-1].kv_transfer_params["blocks"]]
+
+            # the export side stays on device A
+            metas, data = await a.run_exclusive(_export_device, a, hashes)
+            assert len(metas) == 3
+            assert isinstance(data, jax.Array)
+            assert list(data.devices()) == [devs[0]]
+
+            moved = await transfer_blocks_ici(a, b, hashes)
+            assert moved == 3
+            # the destination cache still lives on device B
+            ref = b.pages[0] if isinstance(b.pages, list) else b.pages
+            assert list(ref.devices()) == [devs[1]]
+
+            out = await collect(b.generate(make_req(prompt, "d")))
+            assert out[-1].cached_tokens == 12  # prefix revived, not recomputed
+            got = [t for f in out for t in f.token_ids]
+            assert got == want  # same params + transferred KV => same greedy
+        finally:
+            await a.stop()
+            await b.stop()
+
+    async def test_ici_transfer_onto_sharded_cache(self):
+        """Destination with a TP-sharded cache: the transport array lands on
+        the mesh sharding (the NamedSharding branch of _put_like)."""
+        import jax
+        from dynamo_tpu.parallel import tp_sharding
+
+        cfg = ModelConfig.tiny()
+        a = JaxEngine.random_init(cfg, engine_cfg())
+        shard = tp_sharding(cfg, 2)
+        b = JaxEngine.random_init(cfg, engine_cfg(
+            shard_params_fn=shard.shard_params,
+            shard_pages_fn=shard.shard_pages))
+        try:
+            prompt = list(range(1, 14))
+            req = make_req(prompt, "p")
+            req.prefill_only = True
+            frames = await collect(a.generate(req))
+            hashes = [blk[0]
+                      for blk in frames[-1].kv_transfer_params["blocks"]]
+            assert await transfer_blocks_ici(a, b, hashes) == 3
+            out = await collect(b.generate(make_req(prompt, "d")))
+            assert out[-1].cached_tokens == 12
+            assert out[-1].finish_reason == FinishReason.LENGTH
+        finally:
+            await a.stop()
+            await b.stop()
 
 
 class TestDisaggE2E:

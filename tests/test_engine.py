@@ -17,7 +17,7 @@ from dynamo_tpu.engine.pages import OutOfPages, PageAllocator
 from dynamo_tpu.engine.scheduler import (
     DecodeBatch,
     Phase,
-    PrefillChunk,
+    PrefillBatch,
     Scheduler,
     SchedulerConfig,
 )
@@ -122,22 +122,39 @@ def make_req(tokens, rid="r1", max_tokens=8, **kw):
         eos_token_ids=[0])
 
 
+def advance(sched, plan):
+    """on_step_done + the token append the engine would do for last chunks."""
+    sched.on_step_done(plan)
+    if isinstance(plan, PrefillBatch):
+        for c in plan.chunks:
+            if c.is_last:
+                c.seq.tokens.append(9)
+                c.seq.generated.append(9)
+    else:
+        for s in plan.seqs:
+            s.tokens.append(9)
+            s.generated.append(9)
+
+
 class TestScheduler:
     def make(self, num_pages=17, page_size=4, **cfg):
         alloc = PageAllocator(num_pages, page_size)
-        return Scheduler(alloc, SchedulerConfig(
-            max_num_seqs=4, max_prefill_chunk=8, **cfg)), alloc
+        base = dict(max_num_seqs=4, max_prefill_chunk=8)
+        base.update(cfg)
+        return Scheduler(alloc, SchedulerConfig(**base)), alloc
 
     def test_chunked_prefill_then_decode(self):
         sched, _ = self.make()
-        sched.add_request(make_req(range(1, 13), "a"))  # 12 tokens, chunk=8
+        sched.add_request(make_req(range(1, 13), "a"))  # 12 tokens, budget=8
         p1 = sched.schedule()
-        assert isinstance(p1, PrefillChunk) and p1.length == 8 and not p1.is_last
+        assert isinstance(p1, PrefillBatch) and len(p1.chunks) == 1
+        assert p1.chunks[0].length == 8 and not p1.chunks[0].is_last
         sched.on_step_done(p1)
         p2 = sched.schedule()
-        assert isinstance(p2, PrefillChunk) and p2.length == 4 and p2.is_last
+        assert isinstance(p2, PrefillBatch)
+        assert p2.chunks[0].length == 4 and p2.chunks[0].is_last
         sched.on_step_done(p2)
-        seq = p2.seq
+        seq = p2.chunks[0].seq
         assert seq.phase == Phase.RUNNING
         seq.tokens.append(99)  # engine appends sampled token
         seq.generated.append(99)
@@ -147,18 +164,50 @@ class TestScheduler:
     def test_prefill_decode_alternation(self):
         sched, _ = self.make()
         sched.add_request(make_req(range(1, 5), "a"))
-        p = sched.schedule()
-        sched.on_step_done(p)
-        p.seq.tokens.append(9); p.seq.generated.append(9)
+        advance(sched, sched.schedule())
         sched.add_request(make_req(range(1, 5), "b"))
         kinds = []
         for _ in range(2):
             plan = sched.schedule()
             kinds.append(type(plan))
-            sched.on_step_done(plan)
-            if isinstance(plan, PrefillChunk) and plan.is_last:
-                plan.seq.tokens.append(9); plan.seq.generated.append(9)
-        assert set(kinds) == {PrefillChunk, DecodeBatch}
+            advance(sched, plan)
+        assert set(kinds) == {PrefillBatch, DecodeBatch}
+
+    def test_concurrent_prompts_share_prefill_steps(self):
+        """Four waiting prompts must not serialize into four prefill steps:
+        the token budget packs them two per step."""
+        sched, _ = self.make()
+        for i in range(4):
+            sched.add_request(make_req(range(10 * i + 1, 10 * i + 5), f"s{i}"))
+        p1 = sched.schedule()
+        assert isinstance(p1, PrefillBatch)
+        assert [c.length for c in p1.chunks] == [4, 4]  # budget 8 = 2 prompts
+        assert all(c.is_last for c in p1.chunks)
+        advance(sched, p1)
+        # alternation gives decode a turn, then the remaining two prefill
+        d = sched.schedule()
+        assert isinstance(d, DecodeBatch) and len(d.seqs) == 2
+        advance(sched, d)
+        p2 = sched.schedule()
+        assert isinstance(p2, PrefillBatch) and len(p2.chunks) == 2
+        assert {c.seq.request.request_id for c in p2.chunks} == {"s2", "s3"}
+
+    def test_decode_cadence_bounded_during_long_prefill(self):
+        """A long prompt arriving must not starve running decodes: prefill
+        chunks and decode steps alternate one-for-one."""
+        sched, _ = self.make()
+        sched.add_request(make_req(range(1, 5), "short"))
+        advance(sched, sched.schedule())  # short is RUNNING
+        sched.add_request(make_req(range(100, 124), "long"))  # 24 tok = 3 chunks
+        kinds = []
+        for _ in range(6):
+            plan = sched.schedule()
+            kinds.append(PrefillBatch if isinstance(plan, PrefillBatch)
+                         else DecodeBatch)
+            advance(sched, plan)
+        # strict one-for-one alternation (either phase), 3 of each
+        assert kinds.count(PrefillBatch) == 3
+        assert all(a != b for a, b in zip(kinds, kinds[1:]))
 
     def test_prefix_reuse_on_second_request(self):
         sched, alloc = self.make()
@@ -168,45 +217,28 @@ class TestScheduler:
         sched.on_step_done(plan)
         plan = sched.schedule()
         sched.on_step_done(plan)
-        sched.finish(plan.seq)  # releases pages -> LRU with 3 committed blocks
+        sched.finish(plan.chunks[0].seq)  # pages -> LRU, 3 committed blocks
         sched.add_request(make_req(prompt, "b"))
         plan = sched.schedule()
-        assert isinstance(plan, PrefillChunk)
+        assert isinstance(plan, PrefillBatch)
+        chunk = plan.chunks[0]
         # 12 tokens = 3 blocks cached, but at least 1 token must recompute:
         # usable cached = 8 tokens (2 full pages)
-        assert plan.seq.cached_tokens == 8
-        assert plan.start == 8 and plan.length == 4
+        assert chunk.seq.cached_tokens == 8
+        assert chunk.start == 8 and chunk.length == 4
 
     def test_preemption_on_page_pressure(self):
-        sched, alloc = self.make(num_pages=6, page_size=4)  # 5 usable pages
-        # two seqs, distinct 7-token prompts (no prefix sharing): 2 pages each
-        sched.add_request(make_req(range(1, 8), "a", max_tokens=16))
-        sched.add_request(make_req(range(11, 18), "b", max_tokens=16))
-        # drive until both are running at 8 tokens (page boundary)
-        for _ in range(8):
-            if (len(sched.active) == 2 and
-                    all(s.phase == Phase.RUNNING and len(s) == 8
-                        for s in sched.active.values())):
-                break
-            plan = sched.schedule()
-            assert plan is not None
-            sched.on_step_done(plan)
-            if isinstance(plan, PrefillChunk) and plan.is_last:
-                plan.seq.tokens.append(9)
-                plan.seq.generated.append(9)
-        # decode once at len 8 (page 1 still has room), reaching len 9
+        sched, alloc = self.make(num_pages=4, page_size=4)  # 3 usable pages
+        # two 4-token prompts (1 page each), then both need a 2nd page
+        sched.add_request(make_req(range(1, 5), "a", max_tokens=16))
+        sched.add_request(make_req(range(11, 15), "b", max_tokens=16))
         plan = sched.schedule()
-        assert isinstance(plan, DecodeBatch) and len(plan.seqs) == 2
-        sched.on_step_done(plan)
-        for s in plan.seqs:
-            s.tokens.append(9)
-            s.generated.append(9)
-        # next decode: each needs a 3rd page but only 1 is free -> the
-        # newest sequence is preempted back to waiting
+        assert isinstance(plan, PrefillBatch) and len(plan.chunks) == 2
+        advance(sched, plan)  # both RUNNING at len 5 -> need page 2
+        # decode: one free page left; "a" (older) gets it, "b" is preempted
         plan = sched.schedule()
         assert isinstance(plan, DecodeBatch)
-        assert len(plan.seqs) == 1
-        assert plan.seqs[0].request.request_id == "a"
+        assert [s.request.request_id for s in plan.seqs] == ["a"]
         assert sched.num_preemptions == 1
         assert len(sched.waiting) == 1
 
