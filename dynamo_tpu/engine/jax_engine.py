@@ -73,6 +73,13 @@ class JaxEngineConfig:
     # mesh/sharding hooks (filled by dynamo_tpu.parallel when multi-chip)
     shard_params_fn: Optional[Callable] = None
     shard_pages_fn: Optional[Callable] = None
+    # sequence-parallel long-prompt prefill: when ``mesh`` has an ``sp``
+    # axis > 1, prompts longer than ``ring_threshold`` (default: the chunk
+    # budget) prefill in ONE ring-attention step over the sp ring instead of
+    # serial chunks (``parallel/ring_prefill.py``)
+    mesh: Optional[object] = None
+    sp_axis: str = "sp"
+    ring_threshold: Optional[int] = None
 
 
 def _bucket(n: int, lo: int, hi: int) -> int:
@@ -90,12 +97,21 @@ class JaxEngine(ScheduledEngineBase):
                  forward_fn: Optional[Callable] = None):
         self.model_cfg = model_cfg
         self.cfg = config or JaxEngineConfig()
+        self._sp = 1
+        if self.cfg.mesh is not None:
+            self._sp = dict(self.cfg.mesh.shape).get(self.cfg.sp_axis, 1)
+        ring_threshold = None
+        if self._sp > 1:
+            ring_threshold = (self.cfg.ring_threshold
+                              if self.cfg.ring_threshold is not None
+                              else self.cfg.max_prefill_chunk)
         super().__init__(
             num_pages=self.cfg.num_pages, page_size=self.cfg.page_size,
             max_num_seqs=self.cfg.max_num_seqs,
             max_prefill_chunk=self.cfg.max_prefill_chunk,
             max_context=self.cfg.max_context,
-            max_prefill_seqs=self.cfg.max_prefill_seqs)
+            max_prefill_seqs=self.cfg.max_prefill_seqs,
+            ring_threshold=ring_threshold)
         self.params = params
         from dynamo_tpu.models import get_family
         family = get_family(model_cfg)
@@ -121,6 +137,9 @@ class JaxEngine(ScheduledEngineBase):
         self._rng = jax.random.PRNGKey(self.cfg.seed)
         self._step_counter = 0
         self._jit_step = jax.jit(self._step_impl, donate_argnums=(1,))
+        self._jit_ring_step = jax.jit(self._ring_step_impl,
+                                      donate_argnums=(1,))
+        self.ring_steps = 0  # diagnostics: sequence-parallel prefills run
 
     # -- compiled step -----------------------------------------------------
 
@@ -138,8 +157,27 @@ class JaxEngine(ScheduledEngineBase):
             logits, pages = self._forward_unrolled(
                 params, self.model_cfg, tokens, positions, pages,
                 page_table, total_lens, new_lens, attn_impl=attn)
+        return self._sample_tail(logits, pages, rng, step, temperature,
+                                 top_k, top_p)
+
+    def _ring_step_impl(self, params, pages, tokens, positions, page_table,
+                        total_lens, new_lens, rng, step, temperature, top_k,
+                        top_p):
+        """Sequence-parallel whole-prompt prefill (ring attention over sp)."""
+        from dynamo_tpu.parallel.ring_prefill import ring_prefill
+        logits, pages = ring_prefill(
+            params, self.model_cfg, tokens, positions, pages, page_table,
+            total_lens, new_lens, mesh=self.cfg.mesh,
+            sp_axis=self.cfg.sp_axis)
+        return self._sample_tail(logits, pages, rng, step, temperature,
+                                 top_k, top_p)
+
+    def _sample_tail(self, logits, pages, rng, step, temperature, top_k,
+                     top_p):
+        """Shared sampling epilogue of every step family (chunked + ring)."""
         key = jax.random.fold_in(rng, step)
-        sampled, logprobs = sample_tokens(logits, key, temperature, top_k, top_p)
+        sampled, logprobs = sample_tokens(logits, key, temperature, top_k,
+                                          top_p)
         return pages, sampled, logprobs
 
     # -- plan -> device arrays --------------------------------------------
@@ -149,11 +187,20 @@ class JaxEngine(ScheduledEngineBase):
         P = self.table_width
         if isinstance(plan, PrefillBatch):
             chunks = plan.chunks
-            B = _bucket(len(chunks), self.cfg.min_prefill_seqs_bucket,
-                        self.cfg.max_num_seqs)
-            S = _bucket(max(c.length for c in chunks),
-                        self.cfg.min_prefill_bucket,
-                        self.cfg.max_prefill_chunk)
+            if plan.ring:
+                # whole-prompt sequence-parallel step: B=1, S may exceed the
+                # chunk budget; pad S to a power of two (bounded compile
+                # count) that divides evenly over the sp ring
+                B = 1
+                S = _bucket(chunks[0].length, self.cfg.min_prefill_bucket,
+                            self.cfg.max_context)
+                S = -(-S // self._sp) * self._sp
+            else:
+                B = _bucket(len(chunks), self.cfg.min_prefill_seqs_bucket,
+                            self.cfg.max_num_seqs)
+                S = _bucket(max(c.length for c in chunks),
+                            self.cfg.min_prefill_bucket,
+                            self.cfg.max_prefill_chunk)
             toks = np.zeros((B, S), np.int32)
             pos = np.zeros((B, S), np.int32)
             table = np.zeros((B, P), np.int32)
@@ -201,7 +248,13 @@ class JaxEngine(ScheduledEngineBase):
                 top_k[i] = so.top_k or 0
                 if so.top_p is not None:
                     top_p[i] = so.top_p
-        self.pages, sampled, logprobs = self._jit_step(
+        step_fn = self._jit_step
+        if isinstance(plan, PrefillBatch) and plan.ring:
+            step_fn = self._jit_ring_step
+            self.ring_steps += 1
+            logger.info("ring prefill: %d prompt tokens in one step over "
+                        "sp=%d", plan.chunks[0].length, self._sp)
+        self.pages, sampled, logprobs = step_fn(
             self.params, self.pages, jnp.asarray(toks), jnp.asarray(pos),
             jnp.asarray(table), jnp.asarray(total), jnp.asarray(new),
             self._rng, np.int32(self._step_counter), jnp.asarray(temp),

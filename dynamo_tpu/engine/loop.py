@@ -54,14 +54,16 @@ class ScheduledEngineBase(EngineBase):
 
     def __init__(self, num_pages: int, page_size: int, max_num_seqs: int,
                  max_prefill_chunk: int, max_context: int,
-                 max_prefill_seqs: int = 8):
+                 max_prefill_seqs: int = 8,
+                 ring_threshold: Optional[int] = None):
         if max_context % page_size:
             raise ValueError("max_context must be a multiple of page_size")
         self.max_context = max_context
         self.allocator = PageAllocator(num_pages, page_size)
         self.scheduler = Scheduler(self.allocator, SchedulerConfig(
             max_num_seqs=max_num_seqs, max_prefill_chunk=max_prefill_chunk,
-            max_prefill_seqs=max_prefill_seqs))
+            max_prefill_seqs=max_prefill_seqs,
+            ring_threshold=ring_threshold))
         self._queues: Dict[str, asyncio.Queue] = {}
         self._work = asyncio.Event()
         self._loop_task: Optional[asyncio.Task] = None

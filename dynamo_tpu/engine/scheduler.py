@@ -90,9 +90,15 @@ class PrefillBatch:
     device, one row per chunk). Concurrent arrivals share a step instead of
     serializing, so decode cadence stays bounded under bursts — the role of
     the reference mocker's token-budget chunked scheduler
-    (``lib/llm/src/mocker/scheduler.rs:249-520``)."""
+    (``lib/llm/src/mocker/scheduler.rs:249-520``).
+
+    ``ring=True`` marks a sequence-parallel long-prompt step: one chunk
+    covering the WHOLE prompt, executed via ring attention over the ``sp``
+    mesh axis (``parallel/ring_prefill.py``) instead of chunked paged
+    prefill. Only emitted when the engine enabled it (sp mesh present)."""
 
     chunks: List[PrefillChunk]
+    ring: bool = False
 
     @property
     def seqs(self) -> List[Sequence]:
@@ -114,6 +120,10 @@ class SchedulerConfig:
     max_prefill_seqs: int = 8        # max sequences sharing one prefill step
     watermark: float = 0.01          # keep this fraction of pages free at admit
     max_queue: int = 4096
+    # prompts longer than this (and with no resident prefix) prefill in ONE
+    # sequence-parallel ring step instead of chunks; None disables (set by
+    # the engine only when an sp mesh exists)
+    ring_threshold: Optional[int] = None
 
 
 class Scheduler:
@@ -263,23 +273,45 @@ class Scheduler:
         """Admit waiting sequences (bounded by slots, pages, and batch
         width), then pack up to ``max_prefill_seqs`` chunks into one step
         under the ``max_prefill_chunk`` token budget, oldest first."""
-        n_prefill = sum(1 for s in self.active.values()
-                        if s.phase == Phase.PREFILL)
+        rt = self.cfg.ring_threshold
+
+        def ring_eligible(s: Sequence) -> bool:
+            return (rt is not None and s.num_computed == 0
+                    and len(s) - s.num_computed > rt)
+
         # cap admission at the batch width so admitted pages don't sit idle
-        # across many steps waiting for a row
+        # across many steps waiting for a row; ring candidates run alone and
+        # are held out of packing, so they don't consume a row
+        n_prefill = sum(1 for s in self.active.values()
+                        if s.phase == Phase.PREFILL and not ring_eligible(s))
         while (n_prefill < self.cfg.max_prefill_seqs
                and len(self.active) < self.cfg.max_num_seqs):
-            if self._try_admit() is None:
+            seq = self._try_admit()
+            if seq is None:
                 break
-            n_prefill += 1
+            if not ring_eligible(seq):
+                n_prefill += 1
         prefilling = sorted(
             (s for s in self.active.values() if s.phase == Phase.PREFILL),
             key=lambda s: s.arrival)
         if not prefilling:
             return None
+        # Long novel prompts take the sequence-parallel ring path: the whole
+        # prompt in ONE step, alone (its compute is already split sp ways).
+        # A prefix-hit sequence (num_computed > 0) must attend to resident
+        # pages, which the ring path doesn't read — it stays chunked.
+        # Oldest-first still governs: a ring step runs only when its sequence
+        # is the oldest prefilling one; until then ring candidates are held
+        # OUT of chunk packing (a single chunk would spoil eligibility), so
+        # neither path can starve the other.
+        if ring_eligible(prefilling[0]):
+            seq = prefilling[0]
+            return PrefillBatch(ring=True, chunks=[PrefillChunk(
+                seq=seq, start=0, length=len(seq), is_last=True)])
         budget = self.cfg.max_prefill_chunk
         chunks: List[PrefillChunk] = []
-        for seq in prefilling[:self.cfg.max_prefill_seqs]:
+        packable = [s for s in prefilling if not ring_eligible(s)]
+        for seq in packable[:self.cfg.max_prefill_seqs]:
             if budget <= 0:
                 break
             # len(seq), not num_prompt: a revived preempted sequence must

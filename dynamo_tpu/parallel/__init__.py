@@ -9,6 +9,8 @@ SGLang/TRT-LLM flags over NCCL (SURVEY §2.7); here they are native jax:
   paged KV cache: annotate once, let XLA insert the ICI collectives.
 - ``ring_attention.py`` — sequence/context parallelism (net-new vs the
   reference, which has none — SURVEY §5).
+- ``ring_prefill.py`` — the serving integration: whole-prompt prefill with
+  the sequence axis sharded over ``sp``, writing the paged KV cache.
 """
 
 from dynamo_tpu.parallel.mesh import MeshSpec, make_mesh

@@ -105,23 +105,35 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
 
 def ring_self_attention(mesh: Mesh, q: jnp.ndarray, k: jnp.ndarray,
                         v: jnp.ndarray, positions: jnp.ndarray,
+                        kv_valid: Optional[jnp.ndarray] = None,
                         sm_scale: Optional[float] = None,
-                        axis_name: str = "sp") -> jnp.ndarray:
+                        axis_name: str = "sp",
+                        head_axis: Optional[str] = None) -> jnp.ndarray:
     """Full-array wrapper: shards the sequence axis over ``axis_name`` and
     runs ring attention. q/k/v [B, S, H, D], positions [B, S]; S must divide
-    by the axis size."""
+    by the axis size.
+
+    ``kv_valid`` [B, S] masks padded tail positions; ``head_axis`` names a
+    mesh axis to shard the head dim over as well (tensor parallelism —
+    attention is head-local so only the K/V ring needs collectives). A
+    ``head_axis`` absent from the mesh or of size 1 is ignored.
+    """
     from jax import shard_map
 
-    seq_spec = P(None, axis_name, None, None)
+    if kv_valid is None:
+        kv_valid = jnp.ones(positions.shape, bool)
+    if head_axis is not None and mesh.shape.get(head_axis, 1) <= 1:
+        head_axis = None
+    seq_spec = P(None, axis_name, head_axis, None)
     pos_spec = P(None, axis_name)
 
     fn = functools.partial(ring_attention, sm_scale=sm_scale,
                            axis_name=axis_name)
     sharded = shard_map(
         fn, mesh=mesh,
-        in_specs=(seq_spec, seq_spec, seq_spec, pos_spec, pos_spec),
+        in_specs=(seq_spec, seq_spec, seq_spec, pos_spec, pos_spec, pos_spec),
         out_specs=seq_spec, check_vma=False)
-    return sharded(q, k, v, positions, positions)
+    return sharded(q, k, v, positions, positions, kv_valid)
 
 
 __all__ = ["ring_attention", "ring_self_attention"]

@@ -1,0 +1,106 @@
+"""Sequence-parallel long-prompt prefill that fills the paged KV cache.
+
+This wires ring attention (``parallel/ring_attention.py``) into the serving
+engine's prefill contract: same signature family as ``models/llama.forward``
+— (params, cfg, tokens, positions, pages, page_table, total_lens, new_lens)
+→ (last-token logits, updated pages) — but the sequence axis is sharded over
+the ``sp`` mesh axis and attention runs as a ring (K/V shards rotate via
+``lax.ppermute`` over ICI) instead of gathering from the cache.
+
+Why a separate forward instead of chunked prefill: a chunked prefill of
+length S costs O(S²/chunk) cache re-gathers and serializes on one chip's
+flops; the ring path does the whole prompt in ONE step with compute and
+activation memory split ``sp`` ways. The K/V written back to the paged cache
+is identical to what chunked prefill would have written, so decode proceeds
+normally afterwards (and router block hashes/commits are unaffected).
+
+Scope: this path computes attention only among the NEW tokens, so the engine
+uses it when ``seq.num_computed == 0`` (no prefix-cache hit, the common case
+for a genuinely long novel prompt); otherwise it falls back to chunked
+prefill which attends to resident pages. The reference has no sequence
+parallelism anywhere (SURVEY §5) — net-new capability.
+
+Works with both cache layouts (stacked ``[L, 2, Hkv, N, ps, Dh]`` for the
+scan forward; per-layer list for the unrolled/Pallas forward) and composes
+with tensor parallelism: the head axis stays sharded over ``tp`` inside the
+ring (attention is head-local), so a ``(sp, tp)`` mesh uses both.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple, Union
+
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.models.llama import (
+    _finish_layer,
+    _logits,
+    _project_qkv,
+)
+from dynamo_tpu.ops.attention import write_kv, write_kv_layer
+from dynamo_tpu.parallel.ring_attention import ring_self_attention
+
+Pages = Union[jnp.ndarray, List[jnp.ndarray]]
+
+
+def ring_prefill(params, cfg: ModelConfig, tokens: jnp.ndarray,
+                 positions: jnp.ndarray, pages: Pages,
+                 page_table: jnp.ndarray, total_lens: jnp.ndarray,
+                 new_lens: jnp.ndarray, *, mesh: Mesh,
+                 sp_axis: str = "sp", tp_axis: str = "tp",
+                 ) -> Tuple[jnp.ndarray, Pages]:
+    """Full-prompt prefill with the sequence axis sharded over ``sp``.
+
+    tokens/positions: [B, S] with S a multiple of the ``sp`` axis size;
+    pads masked via ``new_lens`` exactly like ``llama.forward``. Positions
+    must start at 0 (no resident prefix — see module docstring). Returns
+    (logits [B, vocab] at each row's last real token, updated pages).
+    """
+    sm_scale = cfg.head_dim ** -0.5
+    S = tokens.shape[1]
+    sp = mesh.shape[sp_axis]
+    if S % sp:
+        raise ValueError(f"padded prompt length {S} not divisible by "
+                         f"sp={sp}")
+    seq_sharded = NamedSharding(mesh, P(None, sp_axis, None))
+    kv_valid = jnp.arange(S)[None, :] < new_lens[:, None]   # [B, S]
+
+    h = params["embed"][tokens]                             # [B, S, H]
+    h = lax.with_sharding_constraint(h, seq_sharded)
+
+    def layer(h, pages, lp, write):
+        q, k, v = _project_qkv(cfg, lp, h, positions)
+        pages = write(pages, k, v)
+        attn = ring_self_attention(mesh, q, k, v, positions,
+                                   kv_valid=kv_valid, sm_scale=sm_scale,
+                                   axis_name=sp_axis, head_axis=tp_axis)
+        h = _finish_layer(cfg, lp, h, attn)
+        return lax.with_sharding_constraint(h, seq_sharded), pages
+
+    if isinstance(pages, list):
+        out_pages: List[jnp.ndarray] = []
+        for l in range(cfg.num_layers):
+            lp = {k: v[l] for k, v in params["layers"].items()}
+            h, kv = layer(h, pages[l], lp,
+                          lambda pg, k, v: write_kv_layer(
+                              pg, k, v, page_table, positions, new_lens))
+            out_pages.append(kv)
+        return _logits(cfg, params, h, new_lens), out_pages
+
+    def body(carry, xs):
+        h, pages = carry
+        lp, lidx = xs
+        h, pages = layer(h, pages, lp,
+                         lambda pg, k, v: write_kv(
+                             pg, lidx, k, v, page_table, positions, new_lens))
+        return (h, pages), None
+
+    (h, pages), _ = lax.scan(
+        body, (h, pages), (params["layers"], jnp.arange(cfg.num_layers)))
+    return _logits(cfg, params, h, new_lens), pages
+
+
+__all__ = ["ring_prefill"]

@@ -56,6 +56,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-context", type=int, default=8192)
     p.add_argument("--tensor-parallel-size", type=int, default=1,
                    help="shard the model over this many local devices")
+    p.add_argument("--sequence-parallel-size", type=int, default=1,
+                   help="ring-attention sequence parallelism: prompts longer "
+                        "than the prefill chunk budget prefill in one "
+                        "sequence-sharded step over this many devices")
     p.add_argument("--no-kv-events", action="store_true")
     p.add_argument("--disagg", choices=["none", "prefill", "decode"],
                    default="none",
@@ -79,11 +83,16 @@ def build_engine(args: argparse.Namespace) -> JaxEngine:
         max_num_seqs=args.max_num_seqs,
         max_prefill_chunk=args.max_prefill_chunk,
         max_context=min(args.max_context, cfg.max_position_embeddings))
-    if args.tensor_parallel_size > 1:
-        from dynamo_tpu.parallel import tp_sharding
-        shard = tp_sharding(cfg, args.tensor_parallel_size)
+    tp, sp = args.tensor_parallel_size, args.sequence_parallel_size
+    if tp > 1 or sp > 1:
+        from dynamo_tpu.parallel.mesh import MeshSpec, make_mesh
+        from dynamo_tpu.parallel.sharding import ModelSharding
+        mesh = make_mesh(MeshSpec(tp=tp, sp=sp),
+                         devices=jax.devices()[:tp * sp])
+        shard = ModelSharding(cfg, mesh)
         engine_cfg.shard_params_fn = shard.shard_params
         engine_cfg.shard_pages_fn = shard.shard_pages
+        engine_cfg.mesh = mesh
     if args.random_weights:
         from dynamo_tpu.models import get_family
         params = get_family(cfg).init_params(cfg, jax.random.PRNGKey(0))

@@ -1,0 +1,176 @@
+"""Ring attention integrated into the serving engine (long-prompt prefill).
+
+VERDICT r1 item 6: the sp-ring primitive existed but nothing in the serving
+path used it. These tests pin the integration on the 8-device CPU mesh:
+
+- ``ring_prefill`` produces the same last-token logits AND the same paged-KV
+  contents as the single-device ``llama.forward`` scan path.
+- A ``JaxEngine`` with an sp mesh routes a long novel prompt through ONE
+  sequence-parallel prefill step (``ring_steps`` increments, the chunked
+  path would have needed several steps) and then decodes tokens identical
+  to a plain single-device engine — proving the ring-written KV cache is
+  byte-compatible with what chunked prefill writes.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine.jax_engine import JaxEngine, JaxEngineConfig
+from dynamo_tpu.models import llama
+from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.parallel.mesh import MeshSpec, make_mesh
+from dynamo_tpu.parallel.ring_prefill import ring_prefill
+from dynamo_tpu.protocols.common import (
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+
+
+def make_req(tokens, rid, max_tokens=6):
+    r = PreprocessedRequest(
+        token_ids=list(tokens), request_id=rid,
+        stop_conditions=StopConditions(max_tokens=max_tokens),
+        sampling_options=SamplingOptions(temperature=0.0),
+        eos_token_ids=[])
+    return r
+
+
+async def collect(engine, req):
+    frames = []
+    async for out in engine.generate(req):
+        frames.append(out)
+    return frames
+
+
+class TestRingPrefillNumerics:
+    @pytest.mark.parametrize("spec", [MeshSpec(sp=4), MeshSpec(sp=2, tp=2)])
+    def test_matches_scan_forward_and_cache(self, spec):
+        cfg = ModelConfig.tiny()
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        mesh = make_mesh(spec, devices=jax.devices()[:4])
+        if spec.tp > 1:
+            from dynamo_tpu.parallel.sharding import ModelSharding
+            params = ModelSharding(cfg, mesh).shard_params(params)
+
+        B, S, page_size, num_pages = 2, 32, 4, 32
+        table_w = S // page_size
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (B, S), 0,
+                                    cfg.vocab_size, jnp.int32)
+        positions = jnp.tile(jnp.arange(S, dtype=jnp.int32)[None], (B, 1))
+        table = jnp.arange(1, 1 + B * table_w,
+                           dtype=jnp.int32).reshape(B, table_w)
+        # row 1 has 5 fewer real tokens: exercises the pad/kv_valid masking
+        new_lens = jnp.asarray([S, S - 5], jnp.int32)
+        total_lens = new_lens
+
+        ref_logits, ref_pages = jax.jit(
+            lambda p, pg: llama.forward(p, cfg, tokens, positions, pg, table,
+                                        total_lens, new_lens)
+        )(params, llama.make_pages(cfg, num_pages, page_size))
+
+        ring_logits, ring_pages = jax.jit(
+            lambda p, pg: ring_prefill(p, cfg, tokens, positions, pg, table,
+                                       total_lens, new_lens, mesh=mesh)
+        )(params, llama.make_pages(cfg, num_pages, page_size))
+
+        np.testing.assert_allclose(np.asarray(ring_logits),
+                                   np.asarray(ref_logits),
+                                   rtol=2e-4, atol=2e-4)
+        # the paged cache must be identical outside the garbage page 0
+        np.testing.assert_allclose(np.asarray(ring_pages[:, :, :, 1:]),
+                                   np.asarray(ref_pages[:, :, :, 1:]),
+                                   rtol=2e-4, atol=2e-4)
+
+
+class TestRingScheduling:
+    def test_ring_respects_arrival_order(self):
+        """A newer long prompt must not jump an older prefilling sequence;
+        while waiting its turn it stays out of chunk packing (one chunk
+        would spoil ring eligibility)."""
+        from dynamo_tpu.engine.pages import PageAllocator
+        from dynamo_tpu.engine.scheduler import (
+            PrefillBatch, Scheduler, SchedulerConfig)
+
+        alloc = PageAllocator(num_pages=64, page_size=4)
+        sched = Scheduler(alloc, SchedulerConfig(
+            max_num_seqs=4, max_prefill_chunk=8, max_prefill_seqs=4,
+            ring_threshold=16))
+        sched.add_request(make_req(list(range(1, 13)), "old"))    # 12 toks
+        sched.add_request(make_req(list(range(100, 130)), "new"))  # 30 toks
+
+        plan1 = sched.schedule()  # old first, chunked; new held out
+        assert isinstance(plan1, PrefillBatch) and not plan1.ring
+        assert [c.seq.request.request_id for c in plan1.chunks] == ["old"]
+        sched.on_step_done(plan1)
+
+        plan2 = sched.schedule()  # old's last chunk
+        assert not plan2.ring
+        assert [c.seq.request.request_id for c in plan2.chunks] == ["old"]
+        assert plan2.chunks[0].is_last
+        for c in plan2.chunks:  # the engine would append the first token
+            c.seq.tokens.append(9)
+            c.seq.generated.append(9)
+        sched.on_step_done(plan2)
+
+        plan3 = sched.schedule()  # prefill/decode alternation: old decodes
+        from dynamo_tpu.engine.scheduler import DecodeBatch
+        assert isinstance(plan3, DecodeBatch)
+        for s in plan3.seqs:
+            s.tokens.append(9)
+        sched.on_step_done(plan3)
+
+        plan4 = sched.schedule()  # now "new" is oldest prefilling: ring
+        assert isinstance(plan4, PrefillBatch) and plan4.ring
+        assert plan4.chunks[0].seq.request.request_id == "new"
+        assert plan4.chunks[0].length == 30
+
+
+class TestRingServing:
+    async def test_long_prompt_rides_ring_then_decodes(self):
+        cfg = ModelConfig.tiny()
+        base = dict(num_pages=64, page_size=4, max_num_seqs=4,
+                    max_prefill_chunk=16, max_context=128,
+                    min_prefill_bucket=4, attn_impl="scan")
+        mesh = make_mesh(MeshSpec(sp=4), devices=jax.devices()[:4])
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        eng_ring = JaxEngine(cfg, params,
+                             JaxEngineConfig(mesh=mesh, **base))
+        eng_plain = JaxEngine(cfg, params, JaxEngineConfig(**base))
+        prompt = list(np.random.default_rng(7).integers(
+            1, cfg.vocab_size, size=50))
+        try:
+            f_ring = await collect(eng_ring, make_req(prompt, "ring-1"))
+            assert eng_ring.ring_steps == 1  # whole prompt in ONE step
+            f_plain = await collect(eng_plain, make_req(prompt, "plain-1"))
+            assert eng_plain.ring_steps == 0
+            t_ring = [t for f in f_ring for t in f.token_ids]
+            t_plain = [t for f in f_plain for t in f.token_ids]
+            assert len(t_ring) == 6
+            assert t_ring == t_plain  # greedy: ring KV == chunked KV
+        finally:
+            await eng_ring.stop()
+            await eng_plain.stop()
+
+    async def test_short_and_cached_prompts_stay_chunked(self):
+        cfg = ModelConfig.tiny()
+        mesh = make_mesh(MeshSpec(sp=4), devices=jax.devices()[:4])
+        eng = JaxEngine.random_init(cfg, JaxEngineConfig(
+            num_pages=64, page_size=4, max_num_seqs=4, max_prefill_chunk=16,
+            max_context=128, min_prefill_bucket=4, attn_impl="scan",
+            mesh=mesh))
+        long_prompt = list(range(100, 150))
+        try:
+            await collect(eng, make_req(list(range(1, 9)), "short"))
+            assert eng.ring_steps == 0  # under threshold: chunked
+            await collect(eng, make_req(long_prompt, "long-a"))
+            assert eng.ring_steps == 1
+            # same prompt again: prefix-cache hit -> num_computed > 0 ->
+            # must take the chunked path (ring doesn't read resident pages)
+            frames = await collect(eng, make_req(long_prompt, "long-b"))
+            assert eng.ring_steps == 1
+            assert frames[-1].cached_tokens == 48  # 50 tokens, 12 full pages
+        finally:
+            await eng.stop()
