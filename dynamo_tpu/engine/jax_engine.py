@@ -140,6 +140,10 @@ class JaxEngine(ScheduledEngineBase):
         self._jit_ring_step = jax.jit(self._ring_step_impl,
                                       donate_argnums=(1,))
         self.ring_steps = 0  # diagnostics: sequence-parallel prefills run
+        # multi-host: called with (kind, arrays, step) right before each
+        # dispatch so rank 0 can broadcast the step to follower ranks
+        # (parallel/multihost.py); None on single-host workers
+        self.step_tap: Optional[Callable] = None
 
     # -- compiled step -----------------------------------------------------
 
@@ -248,18 +252,34 @@ class JaxEngine(ScheduledEngineBase):
                 top_k[i] = so.top_k or 0
                 if so.top_p is not None:
                     top_p[i] = so.top_p
-        step_fn = self._jit_step
+        kind = "step"
         if isinstance(plan, PrefillBatch) and plan.ring:
-            step_fn = self._jit_ring_step
+            kind = "ring"
             self.ring_steps += 1
             logger.info("ring prefill: %d prompt tokens in one step over "
                         "sp=%d", plan.chunks[0].length, self._sp)
-        self.pages, sampled, logprobs = step_fn(
-            self.params, self.pages, jnp.asarray(toks), jnp.asarray(pos),
-            jnp.asarray(table), jnp.asarray(total), jnp.asarray(new),
-            self._rng, np.int32(self._step_counter), jnp.asarray(temp),
-            jnp.asarray(top_k), jnp.asarray(top_p))
+        arrays = dict(toks=toks, pos=pos, table=table, total=total, new=new,
+                      temp=temp, top_k=top_k, top_p=top_p)
+        if self.step_tap is not None:
+            self.step_tap(kind, arrays, self._step_counter)
+        out = self.execute_arrays(kind, arrays, self._step_counter)
         self._step_counter += 1
+        return out
+
+    def execute_arrays(self, kind: str, a: dict,
+                       step: int) -> Tuple[np.ndarray, np.ndarray]:
+        """Run one jitted step from raw padded host arrays.
+
+        The multi-host follower entry point: every rank calls this with
+        identical arrays so the multi-controller jit executes in lockstep
+        (rank 0 arrives here via ``_execute_plan``)."""
+        step_fn = self._jit_ring_step if kind == "ring" else self._jit_step
+        self.pages, sampled, logprobs = step_fn(
+            self.params, self.pages, jnp.asarray(a["toks"]),
+            jnp.asarray(a["pos"]), jnp.asarray(a["table"]),
+            jnp.asarray(a["total"]), jnp.asarray(a["new"]),
+            self._rng, np.int32(step), jnp.asarray(a["temp"]),
+            jnp.asarray(a["top_k"]), jnp.asarray(a["top_p"]))
         return np.asarray(sampled), np.asarray(logprobs)
 
     # -- embeddings --------------------------------------------------------
@@ -291,6 +311,10 @@ class JaxEngine(ScheduledEngineBase):
 
     async def embed(self, token_lists) -> np.ndarray:
         import asyncio
+        if self.step_tap is not None:
+            raise NotImplementedError(
+                "embeddings bypass the broadcast step stream and are not "
+                "yet supported on multi-host workers")
         return await asyncio.to_thread(self._embed_batch, token_lists)
 
     @classmethod

@@ -1,0 +1,145 @@
+"""Multi-host serving: ONE logical worker endpoint over a multi-process mesh.
+
+SURVEY §7 hard-part #3 and VERDICT r1 item 5. The reference gates multi-node
+engine launches behind ``--num-nodes/--node-rank/--leader-addr`` CLI flags
+(``launch/dynamo-run/src/main.rs:28``) plus an etcd leader/worker barrier
+(``lib/runtime/src/utils/leader_worker_barrier.rs:16-80``), then delegates
+the actual cross-node execution to vLLM/SGLang's NCCL world. Here the
+cross-node execution model is jax multi-controller SPMD, and the design
+follows from its one contract: **every process must enter the same compiled
+computation with the same global arrays**.
+
+  - ``initialize_distributed`` wires the processes into one jax world
+    (``jax.distributed.initialize``): N hosts × local chips = one global
+    device set; a ``Mesh`` over those devices makes every ``jit`` a
+    multi-host program whose collectives ride ICI/DCN.
+  - Rank 0 is the ONLY rank with a scheduler, allocator, RPC endpoint, and
+    model registration — the "host 0 serves" pattern. Its engine loop taps
+    every step: immediately before dispatching a jitted step it broadcasts
+    the exact padded host arrays (a few KB: token ids, page tables,
+    sampling knobs — never device state) over the coordinator event bus.
+  - Ranks > 0 run ``follow_steps``: subscribe, deserialize, call the SAME
+    jitted step with the SAME arrays. No scheduler, no divergence — the
+    follower is a pure step executor, which is exactly the degree of
+    freedom multi-controller SPMD leaves it.
+  - Bring-up is rendezvoused with the existing leader/worker barrier
+    (``runtime/barrier.py``): followers check in only AFTER subscribing, so
+    no step message can be missed; the leader serves only after the barrier
+    completes.
+
+Scope (honest): KV-block export/import, tiered offload, and the embeddings
+path mutate ``engine.pages`` outside the step stream and are not yet
+broadcast — multi-host workers reject those (single-host workers are
+unaffected). Batch-dim (dp) sharding across hosts would also need sampled
+tokens gathered to rank 0; the multi-host mesh therefore shards tp/sp only,
+where step outputs are replicated and every rank can read them locally.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Dict, Optional
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+def barrier_id(namespace: str, component: str) -> str:
+    """Bring-up barrier id, namespaced like the step subject — two multihost
+    groups on one coordinator must never satisfy each other's barriers."""
+    return f"mh-bringup/{namespace}/{component}"
+
+
+def step_subject(namespace: str, component: str) -> str:
+    return f"{namespace}.{component}.mh_steps"
+
+
+def initialize_distributed(coordinator_address: str, num_nodes: int,
+                           node_rank: int,
+                           local_device_count: Optional[int] = None) -> None:
+    """Join this process into the jax multi-controller world.
+
+    Must run before ANY other jax API touches the backend. On TPU pods the
+    device count is discovered; ``local_device_count`` is for CPU tests
+    (virtual host devices)."""
+    import jax
+
+    if local_device_count is not None:
+        # virtual-CPU world (tests/dryruns): N host devices per process,
+        # cross-process collectives over gloo. Real TPU pods autodetect.
+        jax.config.update("jax_num_cpu_devices", local_device_count)
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_nodes, process_id=node_rank)
+    logger.info("jax.distributed: rank %d/%d, %d local / %d global devices",
+                node_rank, num_nodes, jax.local_device_count(),
+                jax.device_count())
+
+
+# ---------------------------------------------------------------- wire form
+
+def _pack_arrays(kind: str, arrays: Dict[str, np.ndarray], step: int) -> dict:
+    msg = {"kind": kind, "step": int(step)}
+    for name, arr in arrays.items():
+        a = np.ascontiguousarray(arr)
+        msg[name] = {"d": str(a.dtype), "s": list(a.shape), "b": a.tobytes()}
+    return msg
+
+
+def _unpack_arrays(msg: dict) -> Dict[str, np.ndarray]:
+    out = {}
+    for name, v in msg.items():
+        if isinstance(v, dict) and "b" in v:
+            out[name] = np.frombuffer(v["b"], dtype=np.dtype(v["d"])).reshape(
+                v["s"])
+    return out
+
+
+# ---------------------------------------------------------------- rank 0
+
+class StepFanout:
+    """Rank-0 side: engine step tap → ordered broadcast to followers.
+
+    The tap runs in the engine's step thread; publishes hop to the event
+    loop and are awaited before the step dispatches, so the wire order is
+    exactly the execution order."""
+
+    def __init__(self, drt, subject: str):
+        self._drt = drt
+        self._subject = subject
+        self._loop = asyncio.get_running_loop()
+
+    def tap(self, kind: str, arrays: Dict[str, np.ndarray],
+            step: int) -> None:
+        fut = asyncio.run_coroutine_threadsafe(
+            self._drt.publish_event(self._subject,
+                                    _pack_arrays(kind, arrays, step)),
+            self._loop)
+        fut.result(timeout=30.0)
+
+    def install(self, engine) -> None:
+        engine.step_tap = self.tap
+
+
+# ---------------------------------------------------------------- rank > 0
+
+async def follow_steps(drt, subject: str, engine, *,
+                       ready_event: Optional[asyncio.Event] = None) -> None:
+    """Follower loop: execute every broadcast step on the local shards.
+
+    Runs until the subscription closes (leader gone / runtime shutdown).
+    ``engine`` is a full JaxEngine (same config as rank 0) whose scheduler
+    is simply never used."""
+    sub = await drt.subscribe_events(subject)
+    if ready_event is not None:
+        ready_event.set()
+    async for _subject, msg in sub:
+        arrays = _unpack_arrays(msg)
+        await asyncio.to_thread(engine.execute_arrays, msg["kind"], arrays,
+                                msg["step"])
+
+
+__all__ = ["initialize_distributed", "StepFanout", "follow_steps",
+           "step_subject", "barrier_id"]

@@ -90,7 +90,11 @@ class ModelSharding:
             "final_norm": P(),
         }
         if not self.cfg.tie_word_embeddings:
-            specs["lm_head"] = P(None, "tp")
+            # logits shard cleanly for real vocabs (128256, 32000, ...);
+            # replicate as a fallback for odd-sized vocabs (toy models)
+            tp = self.mesh.shape.get("tp", 1)
+            specs["lm_head"] = (P(None, "tp")
+                                if self.cfg.vocab_size % tp == 0 else P())
         return specs
 
     def pages_spec(self) -> P:

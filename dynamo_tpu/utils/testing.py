@@ -40,8 +40,12 @@ def make_test_tokenizer() -> Tokenizer:
 
 def make_test_model_dir(path: str, name: str = "test-model",
                         context_length: int = 2048,
-                        vocab_size: Optional[int] = None) -> str:
-    """Write an HF-style model dir usable by ModelDeploymentCard.from_local_path."""
+                        vocab_size: Optional[int] = None,
+                        **config_overrides) -> str:
+    """Write an HF-style model dir usable by ModelDeploymentCard.from_local_path.
+
+    Extra keyword args override config.json fields (e.g.
+    ``num_key_value_heads=4`` for a tp=4-shardable toy model)."""
     os.makedirs(path, exist_ok=True)
     tok = make_test_tokenizer()
     eos_id = tok.token_to_id("<eos>")
@@ -60,6 +64,7 @@ def make_test_model_dir(path: str, name: str = "test-model",
             "num_hidden_layers": 2,
             "rms_norm_eps": 1e-5,
             "rope_theta": 10000.0,
+            **config_overrides,
         }, f)
     with open(os.path.join(path, "tokenizer_config.json"), "w") as f:
         json.dump({"chat_template": TEST_CHAT_TEMPLATE,

@@ -61,6 +61,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "than the prefill chunk budget prefill in one "
                         "sequence-sharded step over this many devices")
     p.add_argument("--no-kv-events", action="store_true")
+    p.add_argument("--num-nodes", type=int, default=1,
+                   help="multi-host: total processes in the jax world")
+    p.add_argument("--node-rank", type=int, default=0,
+                   help="multi-host: this process's rank (0 = leader, "
+                        "serves the endpoint; >0 = step follower)")
+    p.add_argument("--jax-coordinator", default=None,
+                   help="multi-host: jax.distributed coordinator address "
+                        "(host:port of rank 0)")
+    p.add_argument("--local-devices", type=int, default=None,
+                   help="multi-host: local device count override "
+                        "(virtual-CPU tests; autodetected on TPU)")
     p.add_argument("--disagg", choices=["none", "prefill", "decode"],
                    default="none",
                    help="disaggregated role: 'prefill' serves prefill+KV "
@@ -87,6 +98,7 @@ def build_engine(args: argparse.Namespace) -> JaxEngine:
     if tp > 1 or sp > 1:
         from dynamo_tpu.parallel.mesh import MeshSpec, make_mesh
         from dynamo_tpu.parallel.sharding import ModelSharding
+        # multi-host: the mesh spans every process's devices (global set)
         mesh = make_mesh(MeshSpec(tp=tp, sp=sp),
                          devices=jax.devices()[:tp * sp])
         shard = ModelSharding(cfg, mesh)
@@ -105,13 +117,45 @@ def build_engine(args: argparse.Namespace) -> JaxEngine:
 
 
 async def amain(args: argparse.Namespace) -> None:
+    multihost = args.num_nodes > 1
+    if multihost:
+        if args.disagg != "none":
+            raise SystemExit("--disagg is not supported with --num-nodes>1 "
+                             "(KV export/import bypasses the step stream)")
+        if args.jax_coordinator is None:
+            raise SystemExit("--jax-coordinator required with --num-nodes>1")
+        # must precede any jax backend use (build_engine, jax.devices)
+        from dynamo_tpu.parallel.multihost import initialize_distributed
+        initialize_distributed(args.jax_coordinator, args.num_nodes,
+                               args.node_rank,
+                               local_device_count=args.local_devices)
+
     drt = await DistributedRuntime.create(coordinator=args.coordinator)
+
+    if multihost and args.node_rank > 0:
+        await _follower_main(args, drt)
+        return
+
     card = ModelDeploymentCard.from_local_path(args.model_path,
                                                name=args.model_name)
     card.kv_cache_block_size = args.page_size
     endpoint = (drt.namespace(args.namespace).component(args.component)
                 .endpoint(args.endpoint))
     engine = build_engine(args)
+
+    if multihost:
+        # followers subscribed before checking in, so serving can't outrun
+        # them; install the step broadcast tap only once all are present
+        from dynamo_tpu.parallel.multihost import (
+            StepFanout, barrier_id, step_subject)
+        from dynamo_tpu.runtime.barrier import leader_barrier
+        subject = step_subject(args.namespace, args.component)
+        await leader_barrier(drt, barrier_id(args.namespace, args.component),
+                             {"model": args.model_name or args.model_path},
+                             num_workers=args.num_nodes - 1, timeout=120.0)
+        StepFanout(drt, subject).install(engine)
+        logger.info("multihost leader: %d followers in lockstep",
+                    args.num_nodes - 1)
 
     event_pump: asyncio.Task | None = None
     if not args.no_kv_events:
@@ -161,6 +205,41 @@ async def amain(args: argparse.Namespace) -> None:
         if event_pump is not None:
             event_pump.cancel()
         await engine.stop()
+        await drt.close()
+
+
+async def _follower_main(args: argparse.Namespace, drt) -> None:
+    """Rank>0: a pure step executor — no endpoint, no registration."""
+    from dynamo_tpu.parallel.multihost import (
+        barrier_id, follow_steps, step_subject)
+    from dynamo_tpu.runtime.barrier import worker_barrier
+
+    engine = build_engine(args)
+    subject = step_subject(args.namespace, args.component)
+    ready = asyncio.Event()
+    follow = asyncio.ensure_future(
+        follow_steps(drt, subject, engine, ready_event=ready))
+    # subscribed (no step can be missed) — or the subscribe itself failed,
+    # which must surface instead of wedging the barrier wait
+    ready_wait = asyncio.ensure_future(ready.wait())
+    done, _ = await asyncio.wait([ready_wait, follow],
+                                 return_when=asyncio.FIRST_COMPLETED)
+    if follow in done:
+        ready_wait.cancel()
+        follow.result()  # raises the subscribe/loop error
+        raise RuntimeError("follower step loop exited before ready")
+    await worker_barrier(drt, barrier_id(args.namespace, args.component),
+                         f"rank{args.node_rank}", timeout=120.0)
+    print(f"multihost follower rank {args.node_rank} in lockstep "
+          f"({len(jax.devices())} global devices)", flush=True)
+    try:
+        done, _pending = await asyncio.wait(
+            [follow, asyncio.ensure_future(drt.runtime.wait_shutdown())],
+            return_when=asyncio.FIRST_COMPLETED)
+        for t in done:
+            t.result()
+    finally:
+        follow.cancel()
         await drt.close()
 
 

@@ -1,0 +1,79 @@
+"""Multi-host serving e2e: two real worker processes, one logical endpoint.
+
+VERDICT r1 item 5 (second half): the host-0-serves pattern with
+``jax.distributed`` — rank 0 runs scheduler+RPC and broadcasts each step's
+host arrays; rank 1 is a pure step executor. The two processes federate
+4+4 virtual CPU devices into one 8-device world (gloo collectives), the
+model is tp=8-sharded across BOTH processes, and a chat completion flows
+through frontend → rank-0 worker → lockstep multi-controller jit.
+
+Reference analog: ``--num-nodes/--node-rank/--leader-addr`` multi-node
+launches (``launch/dynamo-run/src/main.rs:28``) over the etcd
+leader/worker barrier (``lib/runtime/src/utils/leader_worker_barrier.rs``).
+"""
+
+import asyncio
+
+import aiohttp
+
+from dynamo_tpu.utils.testing import make_test_model_dir
+from tests.procutils import ManagedProcess, free_port
+from tests.test_serve_e2e import frontend, wait_model
+
+
+def mh_worker(coord_port: int, model_dir: str, rank: int, jax_port: int):
+    ready = ("jax worker serving" if rank == 0
+             else "multihost follower rank 1 in lockstep")
+    return ManagedProcess(
+        ["dynamo_tpu.worker.main", "--coordinator", f"127.0.0.1:{coord_port}",
+         "--model-path", model_dir, "--model-name", "mh-model",
+         "--random-weights", "--tensor-parallel-size", "8",
+         "--num-nodes", "2", "--node-rank", str(rank),
+         "--jax-coordinator", f"127.0.0.1:{jax_port}",
+         "--local-devices", "4", "--no-kv-events",
+         "--page-size", "4", "--num-pages", "64", "--max-num-seqs", "2",
+         "--max-prefill-chunk", "16", "--max-context", "128"],
+        name=f"mh-worker-{rank}", ready_line=ready, timeout=150.0,
+        # each process must bring exactly 4 virtual devices of its own:
+        # drop the conftest-inherited 8-device flag (jax_num_cpu_devices
+        # is set by --local-devices inside the worker instead)
+        env_overrides={"XLA_FLAGS": ""})
+
+
+def test_two_process_tp8_serving(tmp_path):
+    model_dir = make_test_model_dir(
+        str(tmp_path / "mh-model"),
+        num_attention_heads=8, num_key_value_heads=8)
+
+    async def _main():
+        coord_port, http_port, jax_port = free_port(), free_port(), free_port()
+        base = f"http://127.0.0.1:{http_port}"
+        body = {"model": "mh-model", "max_tokens": 4, "temperature": 0.0,
+                "messages": [{"role": "user", "content": "multihost hi"}]}
+        fe = frontend(coord_port, http_port)
+        w0 = mh_worker(coord_port, str(tmp_path / "mh-model"), 0, jax_port)
+        w1 = mh_worker(coord_port, str(tmp_path / "mh-model"), 1, jax_port)
+        try:
+            await fe.start()
+            # jax.distributed.initialize blocks until both ranks connect:
+            # the two workers must come up together
+            await asyncio.gather(w0.start(), w1.start())
+            await wait_model(base, "mh-model", timeout=60.0)
+            async with aiohttp.ClientSession() as s:
+                r1 = await (await s.post(
+                    f"{base}/v1/chat/completions", json=body,
+                    timeout=aiohttp.ClientTimeout(total=120))).json()
+                assert r1["choices"][0]["finish_reason"] == "length"
+                assert r1["usage"]["completion_tokens"] == 4
+                text1 = r1["choices"][0]["message"]["content"]
+                r2 = await (await s.post(
+                    f"{base}/v1/chat/completions", json=body,
+                    timeout=aiohttp.ClientTimeout(total=120))).json()
+                # lockstep determinism through the two-process mesh
+                assert r2["choices"][0]["message"]["content"] == text1
+            assert w0.proc.poll() is None and w1.proc.poll() is None
+        finally:
+            for p in (w1, w0, fe):
+                await p.stop()
+
+    asyncio.run(asyncio.wait_for(_main(), timeout=300))
