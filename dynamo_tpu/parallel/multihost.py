@@ -137,8 +137,19 @@ async def follow_steps(drt, subject: str, engine, *,
         ready_event.set()
     async for _subject, msg in sub:
         arrays = _unpack_arrays(msg)
-        await asyncio.to_thread(engine.execute_arrays, msg["kind"], arrays,
-                                msg["step"])
+        try:
+            await asyncio.to_thread(engine.execute_arrays, msg["kind"],
+                                    arrays, msg["step"])
+        except Exception:
+            # mirror the leader's per-step recovery (loop.py catches step
+            # exceptions, fails the victims, keeps serving): when a step
+            # raises on ALL ranks — the common case, it's one SPMD program —
+            # every rank logs and stays in lockstep for the next step.
+            # A rank-ASYMMETRIC failure (one rank can't even launch the
+            # program) wedges the group's collectives and is a
+            # restart-the-group condition, as in any SPMD world.
+            logger.exception("follower step %s failed; continuing in "
+                             "lockstep", msg.get("step"))
 
 
 __all__ = ["initialize_distributed", "StepFanout", "follow_steps",

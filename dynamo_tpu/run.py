@@ -87,7 +87,9 @@ def build_engine_and_card(out: str, args) -> Tuple[EngineBase, ModelDeploymentCa
     if out == "jax":
         if not args.model_path:
             raise SystemExit("out=jax requires --model-path")
+        from dynamo_tpu.models.hub import resolve_model_path
         from dynamo_tpu.worker.main import build_engine
+        args.model_path = resolve_model_path(args.model_path)
         card = ModelDeploymentCard.from_local_path(args.model_path,
                                                    name=args.model_name)
         ns = argparse.Namespace(

@@ -117,6 +117,10 @@ def build_engine(args: argparse.Namespace) -> JaxEngine:
 
 
 async def amain(args: argparse.Namespace) -> None:
+    # accept HF repo ids as well as local dirs/.gguf (reference: hub.rs)
+    from dynamo_tpu.models.hub import resolve_model_path
+    args.model_path = resolve_model_path(args.model_path)
+
     multihost = args.num_nodes > 1
     if multihost:
         if args.disagg != "none":
@@ -232,14 +236,15 @@ async def _follower_main(args: argparse.Namespace, drt) -> None:
                          f"rank{args.node_rank}", timeout=120.0)
     print(f"multihost follower rank {args.node_rank} in lockstep "
           f"({len(jax.devices())} global devices)", flush=True)
+    shutdown = asyncio.ensure_future(drt.runtime.wait_shutdown())
     try:
         done, _pending = await asyncio.wait(
-            [follow, asyncio.ensure_future(drt.runtime.wait_shutdown())],
-            return_when=asyncio.FIRST_COMPLETED)
+            [follow, shutdown], return_when=asyncio.FIRST_COMPLETED)
         for t in done:
             t.result()
     finally:
-        follow.cancel()
+        for t in (follow, shutdown):
+            t.cancel()
         await drt.close()
 
 

@@ -1,0 +1,59 @@
+"""HF hub model intake (``models/hub.py`` — reference ``lib/llm/src/hub.rs``).
+
+No network in CI: the download path is exercised against a hand-built HF
+cache (the ``models--org--name/snapshots/<rev>`` layout huggingface_hub
+reads) under ``HF_HUB_OFFLINE``, which is exactly the warm-cache/offline
+production path on a TPU pod with a shared model cache.
+"""
+
+import json
+import os
+
+import pytest
+
+from dynamo_tpu.models.hub import is_local, resolve_model_path
+
+
+def build_fake_cache(cache_dir, repo_id: str, rev: str = "deadbeef") -> str:
+    """Construct the HF cache layout for one cached snapshot."""
+    folder = os.path.join(cache_dir, "models--" + repo_id.replace("/", "--"))
+    snap = os.path.join(folder, "snapshots", rev)
+    os.makedirs(snap, exist_ok=True)
+    os.makedirs(os.path.join(folder, "refs"), exist_ok=True)
+    with open(os.path.join(folder, "refs", "main"), "w") as f:
+        f.write(rev)
+    with open(os.path.join(snap, "config.json"), "w") as f:
+        json.dump({"model_type": "llama"}, f)
+    return snap
+
+
+class TestResolve:
+    def test_local_dir_passes_through(self, tmp_path):
+        d = str(tmp_path / "model")
+        os.makedirs(d)
+        assert resolve_model_path(d) == d
+
+    def test_local_gguf_passes_through(self, tmp_path):
+        f = tmp_path / "model.gguf"
+        f.write_bytes(b"GGUF")
+        assert resolve_model_path(str(f)) == str(f)
+        assert is_local(str(f))
+
+    def test_cached_repo_resolves_offline(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("HF_HUB_OFFLINE", "1")
+        cache = str(tmp_path / "hub")
+        snap = build_fake_cache(cache, "test-org/tiny-model")
+        resolved = resolve_model_path("test-org/tiny-model",
+                                      cache_dir=cache)
+        assert os.path.samefile(resolved, snap)
+        assert os.path.exists(os.path.join(resolved, "config.json"))
+
+    def test_uncached_repo_offline_raises(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("HF_HUB_OFFLINE", "1")
+        with pytest.raises(Exception):
+            resolve_model_path("test-org/not-cached",
+                               cache_dir=str(tmp_path / "hub"))
+
+    def test_nonexistent_path_is_not_treated_as_repo(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            resolve_model_path(str(tmp_path / "a" / "b" / "missing"))

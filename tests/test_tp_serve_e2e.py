@@ -10,7 +10,6 @@ size`` handed to vLLM (``components/backends/vllm``).
 """
 
 import aiohttp
-import pytest
 
 from dynamo_tpu.utils.testing import make_test_model_dir
 from tests.procutils import ManagedProcess, free_port
