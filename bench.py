@@ -2,8 +2,10 @@
 
 Measures aggregated continuous-batching decode throughput (the
 "Llama-3-8B aggregated, single chip" config family from BASELINE.json) on a
-Llama-3.2-1B-geometry model with random weights: N concurrent requests,
-fixed-length prompts, fixed decode budget, one padded decode shape.
+Llama-3.2-3B-geometry model with random weights: N concurrent requests,
+fixed-length prompts, fixed decode budget, one padded decode shape. The
+headline value is STEADY-STATE decode tok/s (the phase after every sequence
+has its first token); prefill tok/s and p50 TTFT ride along in the JSON.
 
 Prints exactly ONE JSON line on stdout:
   {"metric": ..., "value": ..., "unit": "tokens/sec", "vs_baseline": ...}
@@ -81,19 +83,21 @@ async def run_bench(args) -> dict:
         seqs, prompt, gen = 4, 32, 16
         page_size, max_ctx = 4, 64
     else:
-        cfg = ModelConfig(
-            vocab_size=128256, hidden_size=2048, intermediate_size=8192,
-            num_layers=16, num_heads=32, num_kv_heads=8, head_dim=64,
-            rope_theta=500000.0, max_position_embeddings=8192,
-            tie_word_embeddings=True, dtype="bfloat16")
+        cfg = ModelConfig.llama32_3b()
         seqs, prompt, gen = args.seqs, args.prompt, args.gen
         page_size, max_ctx = 16, args.prompt + args.gen + 64
 
     pages_needed = seqs * ((prompt + gen) // page_size + 2)
+    # pin ONE compiled shape per step family ([8, prompt] prefill,
+    # [seqs, 1] decode) so warmup pays every compile and the timed phase
+    # is pure execution
+    prefill_seqs = min(8, seqs)
     ecfg = JaxEngineConfig(
         num_pages=pages_needed + 16, page_size=page_size,
         max_num_seqs=seqs, max_prefill_chunk=min(512, prompt),
+        max_prefill_seqs=prefill_seqs,
         max_context=max_ctx, min_prefill_bucket=min(512, prompt),
+        min_prefill_seqs_bucket=prefill_seqs,
         min_decode_bucket=seqs)
     engine = JaxEngine.random_init(cfg, ecfg)
 
@@ -108,29 +112,38 @@ async def run_bench(args) -> dict:
             sampling_options=SamplingOptions(temperature=0.0))
 
     ttfts = []
+    arrivals: list = []  # (t, n_tokens) across all sequences
 
     async def drive(rid: str, n_prompt: int, n_gen: int):
         t0 = time.perf_counter()
         first = None
         count = 0
         async for out in engine.generate(make_req(rid, n_prompt, n_gen)):
+            now = time.perf_counter()
             if out.token_ids and first is None:
-                first = time.perf_counter() - t0
+                first = now - t0
+            if out.token_ids:
+                arrivals.append((now, len(out.token_ids)))
             count += len(out.token_ids)
         if first is not None:
             ttfts.append(first)
-        return count
+        return first, count
 
     try:
-        # warmup: compile the prefill and (padded) decode shapes
+        # warmup: compile the REAL prefill and decode shapes — a full-width
+        # concurrent batch, or the timed phase eats a multi-minute XLA
+        # compile of the shapes it actually runs (round-2 lesson: warmup at
+        # [1, S] left [8, S] to compile inside the measurement)
         print("bench: warmup/compile...", file=sys.stderr, flush=True)
-        await drive("warm", prompt, 4)
+        await asyncio.gather(
+            *[drive(f"warm{i}", prompt, 2) for i in range(prefill_seqs)])
         ttfts.clear()
 
         print(f"bench: {seqs} seqs x ({prompt} prompt + {gen} gen)",
               file=sys.stderr, flush=True)
+        arrivals.clear()
         t0 = time.perf_counter()
-        counts = await asyncio.gather(
+        results = await asyncio.gather(
             *[drive(f"r{i}", prompt, gen) for i in range(seqs)])
         wall = time.perf_counter() - t0
         # serialized with the step loop per the engine.pages contract
@@ -138,8 +151,22 @@ async def run_bench(args) -> dict:
     finally:
         await engine.stop()
 
-    total_generated = sum(counts)
-    tok_per_s = total_generated / wall
+    total_generated = sum(c for _f, c in results)
+    # the metric is DECODE throughput: measure the steady-state phase, from
+    # the moment every sequence has its first token (prefill done — its own
+    # cost is reported as TTFT/prefill tok/s on stderr) to the last token.
+    # A request that never produced a token (error) reports first=None —
+    # exclude it rather than crash the whole bench run.
+    firsts = [f for f, _c in results if f is not None]
+    if not firsts:
+        raise RuntimeError("no request produced a first token")
+    t_steady = max(firsts) + t0
+    steady = [(t, n) for t, n in arrivals if t > t_steady]
+    steady_tokens = sum(n for _t, n in steady)
+    steady_wall = (max(t for t, _n in steady) - t_steady) if steady else 0.0
+    tok_per_s = (steady_tokens / steady_wall if steady_wall > 0
+                 else total_generated / wall)
+    prefill_tok_s = seqs * prompt / (t_steady - t0)
 
     # HBM roofline for bandwidth-bound decode on this model/batch:
     # each decode step streams all params + the batch's live KV context.
@@ -152,17 +179,21 @@ async def run_bench(args) -> dict:
     roofline_tok_s = roofline_steps * seqs
 
     print(f"bench: {total_generated} tokens in {wall:.2f}s; "
+          f"steady decode {tok_per_s:.0f} tok/s; "
+          f"prefill {prefill_tok_s:.0f} tok/s; "
           f"p50 TTFT {statistics.median(ttfts) * 1e3:.0f}ms; "
           f"roofline {roofline_tok_s:.0f} tok/s "
           f"(params {param_bytes / 1e9:.2f} GB)", file=sys.stderr, flush=True)
 
     return {
-        "metric": f"decode_throughput_llama1b_bs{seqs}"
+        "metric": f"decode_throughput_llama3b_bs{seqs}"
                   if on_tpu and not args.small else "decode_throughput_tiny",
         "value": round(tok_per_s, 1),
         "unit": "tokens/sec",
         "vs_baseline": round(tok_per_s / roofline_tok_s, 4),
         "kv_inject_gbps": kv_gbps,
+        "prefill_tok_s": round(prefill_tok_s, 1),
+        "ttft_p50_s": round(statistics.median(ttfts), 3),
     }
 
 
@@ -264,7 +295,7 @@ def main() -> None:
     while time.monotonic() + cpu_reserve < deadline and attempt < 3:
         attempt += 1
         remaining = deadline - time.monotonic() - cpu_reserve
-        result = _run_attempt(child_argv, tpu_env, min(remaining, 240.0))
+        result = _run_attempt(child_argv, tpu_env, min(remaining, 380.0))
         if result is not None:
             result["attempts"] = attempt
             print(json.dumps(result), flush=True)

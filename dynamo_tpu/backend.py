@@ -85,6 +85,33 @@ class Backend:
         self.card = card
         self.tokenizer = tokenizer if tokenizer is not None else card.load_tokenizer()
 
+    def _logprob_entry(self, piece: str, logprob: Optional[float],
+                       top: Optional[dict], num_top: int) -> dict:
+        """One OpenAI ``logprobs.content[]`` element (chat format; the
+        completions route reshapes these into the legacy arrays).
+
+        ``piece`` is the token's TRUE text delta from the incremental
+        decoder — concatenating ``bytes`` across entries reconstructs the
+        stream exactly (a token mid-multibyte contributes "" now and the
+        full character lands on the completing token), unlike decoding the
+        id in isolation, which yields U+FFFD for byte-fallback tokens.
+        Alternatives are decoded in isolation (no stream position exists
+        for a token that wasn't chosen).
+
+        Reference surface: ``lib/llm/src/protocols/openai`` logprobs types;
+        the engines there populate them via vLLM — here the native engine's
+        top-K step outputs feed them directly."""
+        entry = {"token": piece, "logprob": logprob,
+                 "bytes": list(piece.encode("utf-8"))}
+        if top:
+            ranked = sorted(top.items(), key=lambda kv: -kv[1])[:num_top]
+            entry["top_logprobs"] = [
+                {"token": (t := self.tokenizer.decode([tid],
+                                                      skip_special_tokens=False)),
+                 "logprob": lp, "bytes": list(t.encode("utf-8"))}
+                for tid, lp in ranked]
+        return entry
+
     async def transform(self, request: PreprocessedRequest,
                         engine_stream: AsyncIterator[LLMEngineOutput]
                         ) -> AsyncIterator[BackendOutput]:
@@ -95,6 +122,8 @@ class Backend:
         ignore_eos = request.stop_conditions.ignore_eos
         stop_ids = set(request.stop_conditions.stop_token_ids or [])
         completion = 0
+        # None = logprobs off; 0 = sampled token only; N = +N alternatives
+        want_logprobs = request.sampling_options.logprobs
 
         try:
             async for out in engine_stream:
@@ -103,8 +132,11 @@ class Backend:
                                         finish_reason=FinishReason.ERROR)
                     return
                 emit_ids: List[int] = []
+                pieces: List[str] = []
+                lp_content: Optional[List[dict]] = (
+                    [] if want_logprobs is not None else None)
                 finish: Optional[FinishReason] = out.finish_reason
-                for tok in out.token_ids:
+                for j, tok in enumerate(out.token_ids):
                     completion += 1
                     if not ignore_eos and tok in eos_ids:
                         finish = FinishReason.EOS
@@ -113,9 +145,33 @@ class Backend:
                         finish = FinishReason.STOP
                         break
                     emit_ids.append(tok)
-                text = jail.push(decoder.extend(emit_ids)) if emit_ids else ""
+                    piece = decoder.step(tok)
+                    pieces.append(piece)
+                    if lp_content is not None:
+                        lp = (out.log_probs[j]
+                              if out.log_probs and j < len(out.log_probs)
+                              else None)
+                        top = (out.top_logprobs[j]
+                               if out.top_logprobs
+                               and j < len(out.top_logprobs) else None)
+                        lp_content.append(self._logprob_entry(
+                            piece, lp, top, want_logprobs))
+                text = jail.push("".join(pieces)) if pieces else ""
                 if jail.matched is not None:
                     finish = FinishReason.STOP
+                    if lp_content:
+                        # drop entries for tokens the jail trimmed (the stop
+                        # string itself). Approximate across frames: text
+                        # may include chars the jail held from earlier
+                        # frames whose entries already went out, which only
+                        # errs toward keeping a boundary token.
+                        kept, acc = [], 0
+                        for e in lp_content:
+                            if acc >= len(text):
+                                break
+                            kept.append(e)
+                            acc += len(e["token"])
+                        lp_content = kept
                 if finish is not None:
                     if jail.matched is None:
                         text += jail.flush()
@@ -123,6 +179,7 @@ class Backend:
                         token_ids=emit_ids, text=text or None,
                         finish_reason=finish,
                         cum_log_probs=out.cum_log_probs, log_probs=out.log_probs,
+                        logprobs_content=lp_content or None,
                         prompt_tokens=out.prompt_tokens or len(request.token_ids),
                         completion_tokens=out.completion_tokens or completion,
                         cached_tokens=out.cached_tokens)
@@ -130,7 +187,8 @@ class Backend:
                 if emit_ids or text:
                     yield BackendOutput(
                         token_ids=emit_ids, text=text or None,
-                        cum_log_probs=out.cum_log_probs, log_probs=out.log_probs)
+                        cum_log_probs=out.cum_log_probs, log_probs=out.log_probs,
+                        logprobs_content=lp_content or None)
             # engine ended without a finish reason: surface what we have
             tail = jail.flush()
             yield BackendOutput(

@@ -61,6 +61,10 @@ class JaxEngineConfig:
     # same knob for the prefill batch dimension: raising it pins B to fewer
     # compiled (B, S) combinations at the cost of padded rows
     min_prefill_seqs_bucket: int = 1
+    # alternatives returned per sampled token (OpenAI top_logprobs; the
+    # on-device top-k over [B, V] logits is noise next to the forward pass).
+    # 0 disables the extra [B, K] outputs entirely.
+    num_top_logprobs: int = 8
     seed: int = 0
     # attention implementation:
     #   "scan"     — lax.scan over layers, stacked cache, XLA gather attention
@@ -119,7 +123,17 @@ class JaxEngine(ScheduledEngineBase):
         self._forward_unrolled = family.forward_unrolled
         impl = self.cfg.attn_impl
         if impl == "auto":
-            impl = "pallas" if jax.devices()[0].platform == "tpu" else "scan"
+            # the tunneled single-chip backend registers as "axon"
+            on_tpu = jax.devices()[0].platform in ("tpu", "axon")
+            impl = "pallas" if on_tpu else "scan"
+        if impl == "pallas":
+            from dynamo_tpu.ops.pallas.decode import supports
+            if not supports(model_cfg.head_dim, self.cfg.page_size):
+                logger.info(
+                    "pallas decode kernel needs head_dim%%128==0 and "
+                    "page_size%%8==0 (got %d/%d); using the XLA scan path",
+                    model_cfg.head_dim, self.cfg.page_size)
+                impl = "scan"
         self.attn_impl = impl
         if impl == "scan":
             self.pages = llama.make_pages(model_cfg, self.cfg.num_pages,
@@ -178,15 +192,30 @@ class JaxEngine(ScheduledEngineBase):
 
     def _sample_tail(self, logits, pages, rng, step, temperature, top_k,
                      top_p):
-        """Shared sampling epilogue of every step family (chunked + ring)."""
+        """Shared sampling epilogue of every step family (chunked + ring).
+
+        Everything the host needs is PACKED into one int32 buffer
+        ``[B, 2 + 2K]`` (token id, logprob bits, K alternative ids, K
+        alternative logprob bits): the host does exactly ONE device fetch
+        per step — on a tunneled/remote backend every extra fetch is a full
+        round trip (~80 ms measured vs ~2 ms chained dispatch)."""
         key = jax.random.fold_in(rng, step)
         sampled, logprobs = sample_tokens(logits, key, temperature, top_k,
                                           top_p)
-        return pages, sampled, logprobs
+        cols = [sampled[:, None],
+                jax.lax.bitcast_convert_type(logprobs, jnp.int32)[:, None]]
+        K = self.cfg.num_top_logprobs
+        if K > 0:
+            lf = logits.astype(jnp.float32)
+            vals, ids = jax.lax.top_k(lf, min(K, lf.shape[-1]))
+            top_lps = vals - jax.nn.logsumexp(lf, axis=-1, keepdims=True)
+            cols.append(ids.astype(jnp.int32))
+            cols.append(jax.lax.bitcast_convert_type(top_lps, jnp.int32))
+        return pages, jnp.concatenate(cols, axis=1)
 
     # -- plan -> device arrays --------------------------------------------
 
-    def _execute_plan(self, plan: StepPlan) -> Tuple[np.ndarray, np.ndarray]:
+    def _execute_plan(self, plan: StepPlan):
         """Build padded arrays, run the jitted step, fetch sampled tokens."""
         P = self.table_width
         if isinstance(plan, PrefillBatch):
@@ -266,21 +295,30 @@ class JaxEngine(ScheduledEngineBase):
         self._step_counter += 1
         return out
 
-    def execute_arrays(self, kind: str, a: dict,
-                       step: int) -> Tuple[np.ndarray, np.ndarray]:
+    def execute_arrays(self, kind: str, a: dict, step: int):
         """Run one jitted step from raw padded host arrays.
 
         The multi-host follower entry point: every rank calls this with
         identical arrays so the multi-controller jit executes in lockstep
-        (rank 0 arrives here via ``_execute_plan``)."""
+        (rank 0 arrives here via ``_execute_plan``). Returns
+        (sampled, logprobs, extras) where extras carries the top-K
+        alternatives when ``num_top_logprobs`` > 0."""
         step_fn = self._jit_ring_step if kind == "ring" else self._jit_step
-        self.pages, sampled, logprobs = step_fn(
+        self.pages, packed = step_fn(
             self.params, self.pages, jnp.asarray(a["toks"]),
             jnp.asarray(a["pos"]), jnp.asarray(a["table"]),
             jnp.asarray(a["total"]), jnp.asarray(a["new"]),
             self._rng, np.int32(step), jnp.asarray(a["temp"]),
             jnp.asarray(a["top_k"]), jnp.asarray(a["top_p"]))
-        return np.asarray(sampled), np.asarray(logprobs)
+        host = np.asarray(packed)                  # the ONE fetch per step
+        sampled = host[:, 0]
+        logprobs = host[:, 1].copy().view(np.float32)
+        extras = None
+        if host.shape[1] > 2:
+            K = (host.shape[1] - 2) // 2
+            extras = {"top_ids": host[:, 2:2 + K],
+                      "top_lps": host[:, 2 + K:].copy().view(np.float32)}
+        return sampled, logprobs, extras
 
     # -- embeddings --------------------------------------------------------
 

@@ -76,11 +76,14 @@ class ScheduledEngineBase(EngineBase):
 
     # -- subclass hook -----------------------------------------------------
 
-    def _execute_plan(self, plan: StepPlan) -> Tuple[np.ndarray, np.ndarray]:
-        """Run one step; returns (sampled_tokens, logprobs) aligned with the
-        plan (prefill: one entry per plan.chunks; decode: one entry per
-        plan.seqs). Runs in a worker thread — must not touch scheduler
-        state."""
+    def _execute_plan(self, plan: StepPlan
+                      ) -> Tuple[np.ndarray, np.ndarray, Optional[dict]]:
+        """Run one step; returns (sampled_tokens, logprobs, extras) aligned
+        with the plan (prefill: one entry per plan.chunks; decode: one entry
+        per plan.seqs). ``extras`` optionally carries per-row top-K
+        alternatives (``top_ids``/``top_lps`` [B, K]) for the OpenAI
+        logprobs surface, or None. Runs in a worker thread — must not touch
+        scheduler state."""
         raise NotImplementedError
 
     # -- frame emission ----------------------------------------------------
@@ -93,11 +96,13 @@ class ScheduledEngineBase(EngineBase):
     def _finish(self, seq: Sequence, reason: FinishReason,
                 token: Optional[int] = None,
                 logprob: Optional[float] = None,
-                kv_transfer_params: Optional[dict] = None) -> None:
+                kv_transfer_params: Optional[dict] = None,
+                top: Optional[Dict[int, float]] = None) -> None:
         self.scheduler.finish(seq)
         self._emit(seq, LLMEngineOutput(
             token_ids=[token] if token is not None else [],
             log_probs=[logprob] if logprob is not None else None,
+            top_logprobs=[top] if top is not None else None,
             finish_reason=reason,
             prompt_tokens=seq.num_prompt,
             completion_tokens=len(seq.generated),
@@ -105,7 +110,8 @@ class ScheduledEngineBase(EngineBase):
             kv_transfer_params=kv_transfer_params,
         ))
 
-    def _accept_token(self, seq: Sequence, token: int, logprob: float) -> None:
+    def _accept_token(self, seq: Sequence, token: int, logprob: float,
+                      top: Optional[Dict[int, float]] = None) -> None:
         """Append a sampled token and resolve stop conditions."""
         req = seq.request
         sc = req.stop_conditions
@@ -114,21 +120,31 @@ class ScheduledEngineBase(EngineBase):
         n = len(seq.generated)
         min_ok = sc.min_tokens is None or n >= sc.min_tokens
         if (not sc.ignore_eos and min_ok and token in req.eos_token_ids):
-            self._finish(seq, FinishReason.EOS, token, logprob)
+            self._finish(seq, FinishReason.EOS, token, logprob, top=top)
             return
         if min_ok and sc.stop_token_ids and token in sc.stop_token_ids:
-            self._finish(seq, FinishReason.STOP, token, logprob)
+            self._finish(seq, FinishReason.STOP, token, logprob, top=top)
             return
         max_new = sc.max_tokens if sc.max_tokens is not None else (
             self.max_context - seq.num_prompt)
         if n >= max_new or len(seq) >= self.max_context:
-            self._finish(seq, FinishReason.LENGTH, token, logprob)
+            self._finish(seq, FinishReason.LENGTH, token, logprob, top=top)
             return
-        self._emit(seq, LLMEngineOutput(token_ids=[token],
-                                        log_probs=[logprob]))
+        self._emit(seq, LLMEngineOutput(
+            token_ids=[token], log_probs=[logprob],
+            top_logprobs=[top] if top is not None else None))
 
     def _process(self, plan: StepPlan, sampled: np.ndarray,
-                 logprobs: np.ndarray) -> None:
+                 logprobs: np.ndarray,
+                 extras: Optional[dict] = None) -> None:
+        def top_for(i: int, seq: Sequence) -> Optional[Dict[int, float]]:
+            # host dict building + per-token wire bytes only for requests
+            # that asked (the device-side top-k is compiled in regardless)
+            if extras is None or seq.request.sampling_options.logprobs is None:
+                return None
+            return {int(t): float(l) for t, l in
+                    zip(extras["top_ids"][i], extras["top_lps"][i])}
+
         self.scheduler.on_step_done(plan)
         if isinstance(plan, PrefillBatch):
             for i, chunk in enumerate(plan.chunks):
@@ -156,7 +172,8 @@ class ScheduledEngineBase(EngineBase):
                                      kv_transfer_params=params)
                     else:
                         self._accept_token(seq, int(sampled[i]),
-                                           float(logprobs[i]))
+                                           float(logprobs[i]),
+                                           top_for(i, seq))
         else:
             for i, seq in enumerate(plan.seqs):
                 if seq.phase is not Phase.RUNNING:
@@ -164,7 +181,8 @@ class ScheduledEngineBase(EngineBase):
                 if seq.cancelled:
                     self._finish(seq, FinishReason.CANCELLED)
                     continue
-                self._accept_token(seq, int(sampled[i]), float(logprobs[i]))
+                self._accept_token(seq, int(sampled[i]), float(logprobs[i]),
+                                   top_for(i, seq))
         # always drain (unbounded growth otherwise); publish if anyone listens
         events = self.allocator.drain_events()
         if events and self.kv_event_cb is not None:
@@ -255,8 +273,7 @@ class ScheduledEngineBase(EngineBase):
                 await self._work.wait()
                 continue
             try:
-                sampled, logprobs = await asyncio.to_thread(
-                    self._execute_plan, plan)
+                result = await asyncio.to_thread(self._execute_plan, plan)
             except Exception as e:  # noqa: BLE001 — engine must not die silently
                 logger.exception("engine step failed")
                 victims = plan.seqs
@@ -265,7 +282,8 @@ class ScheduledEngineBase(EngineBase):
                     self._emit(seq, LLMEngineOutput(
                         finish_reason=FinishReason.ERROR, error=str(e)))
                 continue
-            self._process(plan, sampled, logprobs)
+            sampled, logprobs, extras = result
+            self._process(plan, sampled, logprobs, extras)
 
     async def start(self) -> None:
         if self._loop_task is None:

@@ -73,16 +73,16 @@ def _pad_ids(page_ids: List[int]) -> List[int]:
 
 @jax.jit
 def _gather_stacked(pages, ids):
-    return pages[:, :, :, ids]
+    return pages[:, ids]
 
 
 @jax.jit
 def _gather_list(pages, ids):
-    return jnp.stack([p[:, :, ids] for p in pages])
+    return jnp.stack([p[ids] for p in pages])
 
 
 def _gather_device(engine: JaxEngine, page_ids: List[int]):
-    """Device cache -> device array [L, 2, Hkv, n, ps, Dh] (n padded to a
+    """Device cache -> device array [L, n, 2, Hkv, ps, Dh] (n padded to a
     power of two; extra slots hold garbage-page content)."""
     ids = jnp.asarray(_pad_ids(page_ids), jnp.int32)
     if isinstance(engine.pages, list):
@@ -91,14 +91,14 @@ def _gather_device(engine: JaxEngine, page_ids: List[int]):
 
 
 def _gather_pages(engine: JaxEngine, page_ids: List[int]) -> np.ndarray:
-    """Device cache -> host [L, 2, Hkv, n, ps, Dh] for the given pages."""
+    """Device cache -> host [L, n, 2, Hkv, ps, Dh] for the given pages."""
     out = jax.device_get(_gather_device(engine, page_ids))
-    return np.asarray(out)[:, :, :, :len(page_ids)]
+    return np.asarray(out)[:, :len(page_ids)]
 
 
 def _scatter_pages(engine: JaxEngine, page_ids: List[int],
                    data) -> None:
-    """[L, 2, Hkv, n, ps, Dh] (host or device) -> device cache at the given
+    """[L, n, 2, Hkv, ps, Dh] (host or device) -> device cache at the given
     pages.
 
     The update runs as a donated jitted scatter: XLA aliases the input and
@@ -110,30 +110,28 @@ def _scatter_pages(engine: JaxEngine, page_ids: List[int],
     n_pad = ids.shape[0]
     if not hasattr(engine, "_jit_scatter"):
         engine._jit_scatter = jax.jit(
-            lambda pages, ids, vals: pages.at[:, :, :, ids].set(vals),
+            lambda pages, ids, vals: pages.at[:, ids].set(vals),
             donate_argnums=(0,))
         engine._jit_scatter_list = jax.jit(
             lambda pages, ids, vals: [
-                p.at[:, :, ids].set(vals[l]) for l, p in enumerate(pages)],
+                p.at[ids].set(vals[l]) for l, p in enumerate(pages)],
             donate_argnums=(0,))
     if isinstance(engine.pages, list):
-        dtype = engine.pages[0].dtype
-        vals = _pad_vals(data, n_pad, dtype)
+        vals = _pad_vals(data, n_pad, engine.pages[0].dtype)
         engine.pages = engine._jit_scatter_list(engine.pages, ids, vals)
     else:
-        dtype = engine.pages.dtype
-        vals = _pad_vals(data, n_pad, dtype)
+        vals = _pad_vals(data, n_pad, engine.pages.dtype)
         engine.pages = engine._jit_scatter(engine.pages, ids, vals)
 
 
 def _pad_vals(data, n_pad: int, dtype):
-    """Pad the page axis (3) of [L,2,Hkv,n,ps,Dh] to n_pad; padded slots
+    """Pad the page axis (1) of [L,n,2,Hkv,ps,Dh] to n_pad; padded slots
     write to the garbage page, which is scratch by design."""
     vals = jnp.asarray(data, dtype=dtype)
-    n = vals.shape[3]
+    n = vals.shape[1]
     if n < n_pad:
         pad = [(0, 0)] * vals.ndim
-        pad[3] = (0, n_pad - n)
+        pad[1] = (0, n_pad - n)
         vals = jnp.pad(vals, pad)
     return vals
 
@@ -145,9 +143,9 @@ def export_blocks(engine: JaxEngine,
     metas, data = _export_device(engine, block_hashes)
     if not metas:
         return []
-    host = np.asarray(jax.device_get(data))[:, :, :, :len(metas)]
+    host = np.asarray(jax.device_get(data))[:, :len(metas)]
     return [BlockPayload(block_hash=h, local_hash=local, parent_hash=parent,
-                         data=host[:, :, :, i])
+                         data=host[:, i])
             for i, (h, local, parent) in enumerate(metas)]
 
 
@@ -155,7 +153,7 @@ def _inject_data(engine: JaxEngine,
                  metas: List[Tuple[int, int, Optional[int]]],
                  data) -> int:
     """Core injection: ``metas[i] = (block_hash, local_hash, parent_hash)``
-    describes page slice ``data[:, :, :, i]`` ([L, 2, Hkv, n, ps, Dh], host
+    describes page slice ``data[:, i]`` ([L, n, 2, Hkv, ps, Dh], host
     or device). Fresh blocks are scattered into the cache and registered;
     they land in the prefix-cache LRU, so the next admission of the matching
     prompt revives them. Returns blocks actually injected."""
@@ -168,7 +166,7 @@ def _inject_data(engine: JaxEngine,
         return 0
     pages = alloc.allocate(len(fresh))
     if len(fresh) != len(metas):
-        data = jnp.asarray(data)[:, :, :, jnp.asarray(fresh, jnp.int32)]
+        data = jnp.asarray(data)[:, jnp.asarray(fresh, jnp.int32)]
     _scatter_pages(engine, pages, data)
     for page, i in zip(pages, fresh):
         h, local, parent = metas[i]
@@ -182,7 +180,7 @@ def inject_blocks(engine: JaxEngine, blocks: List[BlockPayload]) -> int:
     if not blocks:
         return 0
     metas = [(b.block_hash, b.local_hash, b.parent_hash) for b in blocks]
-    data = np.stack([b.data for b in blocks], axis=3)  # [L,2,Hkv,n,ps,Dh]
+    data = np.stack([b.data for b in blocks], axis=1)  # [L,n,2,Hkv,ps,Dh]
     return _inject_data(engine, metas, data)
 
 
@@ -212,7 +210,7 @@ def _export_device(engine: JaxEngine, block_hashes: List[int]):
 
 
 def _put_like(vals, pages) -> "jax.Array":
-    """Move a stacked [L, 2, Hkv, n, ps, Dh] array onto the sharding of the
+    """Move a stacked [L, n, 2, Hkv, ps, Dh] array onto the sharding of the
     destination cache (device-to-device on a real mesh — ICI, not host)."""
     from jax.sharding import NamedSharding, PartitionSpec
 
@@ -244,7 +242,7 @@ async def transfer_blocks_ici(src: JaxEngine, dst: JaxEngine,
         return 0
 
     def _inject(dst_engine, metas_, data_):
-        moved = _put_like(data_[:, :, :, :len(metas_)], dst_engine.pages)
+        moved = _put_like(data_[:, :len(metas_)], dst_engine.pages)
         return _inject_data(dst_engine, metas_, moved)
 
     return await dst.run_exclusive(_inject, dst, metas, data)

@@ -25,6 +25,7 @@ from dynamo_tpu.protocols.openai import (
     ChatCompletionRequest,
     ChatCompletionResponse,
     ChatMessage,
+    ChoiceLogprobs,
     CompletionChoice,
     CompletionRequest,
     CompletionResponse,
@@ -36,6 +37,24 @@ from dynamo_tpu.protocols.openai import (
 )
 
 logger = logging.getLogger(__name__)
+
+
+def _legacy_logprobs(entries: List[dict], offset_start: int = 0):
+    """Chat-style logprob entries -> the legacy completions logprobs object
+    (tokens / token_logprobs / top_logprobs / text_offset). Returns the
+    object and the next character offset (streaming keeps it cumulative)."""
+    out = {"tokens": [], "token_logprobs": [], "top_logprobs": [],
+           "text_offset": []}
+    off = offset_start
+    for e in entries:
+        out["tokens"].append(e["token"])
+        out["token_logprobs"].append(e["logprob"])
+        out["top_logprobs"].append(
+            {t["token"]: t["logprob"]
+             for t in e.get("top_logprobs", [])} or None)
+        out["text_offset"].append(off)
+        off += len(e["token"])
+    return out, off
 
 
 def _error(status: int, message: str, etype: str = "invalid_request_error") -> web.Response:
@@ -231,6 +250,7 @@ class HttpService:
         """Aggregate the chunk stream into one response (parity:
         ``protocols/openai/chat_completions/aggregator.rs``)."""
         text_parts: List[str] = []
+        lp_entries: List[dict] = []
         finish_reason: Optional[str] = None
         usage = Usage()
         preprocessed, delta = pipeline.prepare_chat(req, request_id)
@@ -241,6 +261,8 @@ class HttpService:
                 for choice in chunk.choices:
                     if choice.delta.content:
                         text_parts.append(choice.delta.content)
+                    if choice.logprobs and choice.logprobs.content:
+                        lp_entries.extend(choice.logprobs.content)
                     if choice.finish_reason:
                         finish_reason = choice.finish_reason
                 if chunk.usage is not None:
@@ -253,7 +275,9 @@ class HttpService:
             id=request_id, created=now_unix(), model=req.model,
             choices=[ChatChoice(
                 message=ChatMessage(role="assistant", content="".join(text_parts)),
-                finish_reason=finish_reason or "stop")],
+                finish_reason=finish_reason or "stop",
+                logprobs=(ChoiceLogprobs(content=lp_entries)
+                          if lp_entries else None))],
             usage=usage)
         timer.done("200", usage.prompt_tokens)
         return web.json_response(body.model_dump(exclude_none=True))
@@ -273,6 +297,7 @@ class HttpService:
                 return await self._stream_completion(request, req, pipeline,
                                                      request_id, timer)
             text_parts: List[str] = []
+            lp_entries: List[dict] = []
             finish = None
             usage = Usage()
             gen = pipeline.generate_completion(req, request_id)
@@ -283,6 +308,8 @@ class HttpService:
                     if out.text:
                         text_parts.append(out.text)
                         timer.on_token(len(out.token_ids) or 1)
+                    if out.logprobs_content:
+                        lp_entries.extend(out.logprobs_content)
                     if out.finish_reason is not None:
                         finish = out.finish_reason.to_openai()
                         usage = Usage(
@@ -293,8 +320,11 @@ class HttpService:
                 await gen.aclose()
             body = CompletionResponse(
                 id=request_id, created=now_unix(), model=req.model,
-                choices=[CompletionChoice(text="".join(text_parts),
-                                          finish_reason=finish or "stop")],
+                choices=[CompletionChoice(
+                    text="".join(text_parts),
+                    finish_reason=finish or "stop",
+                    logprobs=(_legacy_logprobs(lp_entries)[0]
+                              if lp_entries else None))],
                 usage=usage)
             timer.done("200", usage.prompt_tokens)
             return web.json_response(body.model_dump(exclude_none=True))
@@ -326,18 +356,27 @@ class HttpService:
         status = "200"
         created = now_unix()
         gen = pipeline.generate_completion(req, request_id)
+        lp_offset = 0
         try:
             async for out in gen:
                 if out.error:
                     raise RuntimeError(out.error)
-                if out.text or out.finish_reason is not None:
+                # logprobs_content gates emission too: a frame may carry
+                # token logprobs whose text is still held by the decoder
+                if out.text or out.logprobs_content or (
+                        out.finish_reason is not None):
                     timer.on_token(len(out.token_ids) or (1 if out.text else 0))
+                    lp_obj = None
+                    if out.logprobs_content:
+                        lp_obj, lp_offset = _legacy_logprobs(
+                            out.logprobs_content, lp_offset)
                     chunk = CompletionResponse(
                         id=request_id, created=created, model=req.model,
                         choices=[CompletionChoice(
                             text=out.text or "",
                             finish_reason=(out.finish_reason.to_openai()
-                                           if out.finish_reason else None))])
+                                           if out.finish_reason else None),
+                            logprobs=lp_obj)])
                     await resp.write(sse.encode_data(
                         chunk.model_dump(exclude_none=True)))
             await resp.write(sse.encode_done())

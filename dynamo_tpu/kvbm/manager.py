@@ -72,7 +72,7 @@ class TieredEngine(EngineBase):
         for i, (h, _page, info) in enumerate(evicted):
             blk = BlockPayload(block_hash=h, local_hash=info.local_hash,
                                parent_hash=info.parent_hash,
-                               data=data[:, :, :, i].copy())
+                               data=data[:, i].copy())
             self.offloaded += 1
             for demoted in self.host.put(blk):
                 if self.disk is not None:

@@ -88,7 +88,7 @@ class MockerEngine(ScheduledEngineBase):
                 toks[i] = self._token_for(seq.request.request_id, len(seq),
                                           so.temperature or 0.0)
             self._simulate(cost)
-            return toks, np.full(len(plan.chunks), -1.0, np.float32)
+            return toks, np.full(len(plan.chunks), -1.0, np.float32), None
         b = len(plan.seqs)
         self._simulate(a.decode_base_s + b * a.decode_per_seq_s)
         toks = np.empty(b, np.int64)
@@ -96,7 +96,7 @@ class MockerEngine(ScheduledEngineBase):
             so = seq.request.sampling_options
             toks[i] = self._token_for(seq.request.request_id, len(seq),
                                       so.temperature or 0.0)
-        return toks, np.full(b, -1.0, np.float32)
+        return toks, np.full(b, -1.0, np.float32), None
 
 
 __all__ = ["MockerEngine", "MockEngineArgs"]

@@ -82,6 +82,19 @@ class ModelConfig:
             return cls.from_hf(json.load(f), dtype=dtype)
 
     @classmethod
+    def llama32_3b(cls, **kw) -> "ModelConfig":
+        """Llama-3.2-3B geometry — the single-chip flagship/bench config
+        (bf16 params + KV fit a v5e chip; head_dim=128 rides the Pallas
+        decode kernel). Shared by bench.py and __graft_entry__.py."""
+        defaults = dict(
+            vocab_size=128256, hidden_size=3072, intermediate_size=8192,
+            num_layers=28, num_heads=24, num_kv_heads=8, head_dim=128,
+            rope_theta=500000.0, max_position_embeddings=8192,
+            tie_word_embeddings=True, dtype="bfloat16")
+        defaults.update(kw)
+        return cls(**defaults)
+
+    @classmethod
     def tiny(cls, **kw) -> "ModelConfig":
         """A toy config for tests (runs in ms on CPU)."""
         defaults = dict(vocab_size=256, hidden_size=64, intermediate_size=128,

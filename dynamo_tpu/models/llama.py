@@ -8,11 +8,12 @@ interchangeable forwards over the same weights:
   compiled layer body, fast compiles, XLA while-loop buffer aliasing keeps the
   stacked paged KV cache (scan carry) updated in place. This is the portable
   path (CPU tests, prefill-heavy work).
-- ``forward_unrolled`` — python loop over layers with a *list* of per-layer KV
-  buffers. Needed for the Pallas decode kernel: a Pallas call can't fuse a
-  dynamic layer-slice of a stacked cache (it would copy the whole layer per
-  step), but with per-layer buffers the kernel reads HBM directly. Longer
-  compile, fastest decode; the serving engine uses it on TPU.
+- ``forward_unrolled`` — python loop over layers with a *list* of per-layer
+  KV buffers. Exists for the Pallas decode kernel, which wants a concrete
+  per-layer HBM ref (a traced layer-slice of a stacked cache forces XLA to
+  defensively copy the whole cache around the opaque custom call —
+  measured 10x worse than the list, aliasing declarations included).
+  Longer compile, fastest decode; the serving engine uses it on TPU.
 
 Both share the exact same math (``_layer_step``); equivalence is tested.
 
@@ -57,21 +58,25 @@ def _head_rms_norm(x: jnp.ndarray, w: jnp.ndarray, eps: float) -> jnp.ndarray:
 
 def make_pages(cfg: ModelConfig, num_pages: int, page_size: int,
                dtype=None) -> jnp.ndarray:
-    """Stacked paged KV cache: [L, 2, Hkv, N, page_size, Dh] (scan path).
+    """Stacked paged KV cache: [L, N, 2, Hkv, page_size, Dh] (scan path).
+
+    Page-major: one page is a contiguous slab carrying K AND V for all kv
+    heads, so page-granular DMAs (Pallas decode kernel, disagg block
+    transfer) are single descriptors (see ``ops/attention.py``).
 
     Page 0 is reserved as the garbage page for padded writes — allocators must
     hand out pages starting at index 1.
     """
     dtype = dtype or jnp.dtype(cfg.dtype)
-    return jnp.zeros((cfg.num_layers, 2, cfg.num_kv_heads, num_pages,
+    return jnp.zeros((cfg.num_layers, num_pages, 2, cfg.num_kv_heads,
                       page_size, cfg.head_dim), dtype=dtype)
 
 
 def make_pages_list(cfg: ModelConfig, num_pages: int, page_size: int,
                     dtype=None) -> List[jnp.ndarray]:
-    """Per-layer KV buffers [2, Hkv, N, page_size, Dh] (unrolled path)."""
+    """Per-layer KV buffers [N, 2, Hkv, page_size, Dh] (unrolled path)."""
     dtype = dtype or jnp.dtype(cfg.dtype)
-    return [jnp.zeros((2, cfg.num_kv_heads, num_pages, page_size,
+    return [jnp.zeros((num_pages, 2, cfg.num_kv_heads, page_size,
                        cfg.head_dim), dtype=dtype)
             for _ in range(cfg.num_layers)]
 

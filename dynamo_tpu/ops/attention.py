@@ -4,11 +4,15 @@ Capability parity with the reference's engine-internal paged attention (the
 reference delegates this to vLLM/SGLang CUDA kernels; here it is native).
 Design is TPU-first:
 
-- Cache layout is kernel-native: per layer ``[2, Hkv, N, page_size, Dh]``
-  (k/v, kv-head-major) — exactly what the Pallas paged decode kernel
-  (``ops/pallas/decode.py``) consumes with zero reshuffling, and stacked to
-  ``pages[L, 2, Hkv, N, page_size, Dh]`` for the ``lax.scan`` forward where
-  XLA's while-loop buffer aliasing keeps every per-layer scatter in place.
+- Cache layout is PAGE-MAJOR: per layer ``[N, 2, Hkv, page_size, Dh]``
+  (page, k/v, kv-head), stacked to ``[L, N, 2, Hkv, page_size, Dh]`` for
+  the ``lax.scan`` forward. One page is one contiguous slab holding BOTH
+  K and V for every kv head — so the Pallas decode kernel
+  (``ops/pallas/decode.py``) fetches a page's entire contribution with ONE
+  DMA descriptor, and device-to-device block transfers (disagg prefill →
+  decode) move whole pages with unit-stride copies. (A head-major layout
+  fragments every page into per-head 4 KB strips — measured ~10× worse on
+  both the DMA and the XLA-gather paths.)
 - Page 0 is a reserved garbage page: padded token positions write there, which
   makes every scatter shape-static and mask-free.
 - One code path serves prefill (S = chunk length) and decode (S = 1): new K/V
@@ -35,7 +39,7 @@ def write_kv_layer(kv_layer: jnp.ndarray, k_new: jnp.ndarray,
                    positions: jnp.ndarray, new_lens: jnp.ndarray) -> jnp.ndarray:
     """Scatter new K/V into one layer's paged cache.
 
-    kv_layer:   [2, Hkv, N, page_size, Dh]
+    kv_layer:   [N, 2, Hkv, page_size, Dh]
     k_new/v_new:[B, S, Hkv, Dh]
     page_table: [B, P] logical-page -> physical-page map (int32)
     positions:  [B, S] absolute token positions of the new tokens
@@ -50,17 +54,17 @@ def write_kv_layer(kv_layer: jnp.ndarray, k_new: jnp.ndarray,
     pad = jnp.arange(S)[None, :] >= new_lens[:, None]
     phys = jnp.where(pad, 0, phys)
     slot = jnp.where(pad, 0, slot)
-    # (phys, slot) are contiguous advanced indices, so their broadcast dims
-    # stay in place: the scatter slice is [2, Hkv, B, S, Dh]
-    new = jnp.stack([k_new, v_new]).transpose(0, 3, 1, 2, 4)
-    return kv_layer.at[:, :, phys, slot].set(new.astype(kv_layer.dtype),
+    # advanced indices (phys, slot) are separated by slices, so their
+    # broadcast dims move to the FRONT: the scatter value is [B, S, 2, Hkv, Dh]
+    new = jnp.stack([k_new, v_new], axis=2)
+    return kv_layer.at[phys, :, :, slot].set(new.astype(kv_layer.dtype),
                                              mode="drop")
 
 
 def write_kv(pages: jnp.ndarray, layer_idx, k_new: jnp.ndarray,
              v_new: jnp.ndarray, page_table: jnp.ndarray,
              positions: jnp.ndarray, new_lens: jnp.ndarray) -> jnp.ndarray:
-    """Scatter new K/V into the stacked cache ``[L, 2, Hkv, N, ps, Dh]``."""
+    """Scatter new K/V into the stacked cache ``[L, N, 2, Hkv, ps, Dh]``."""
     page_size = pages.shape[4]
     B, S = positions.shape
     logical = positions // page_size
@@ -69,11 +73,8 @@ def write_kv(pages: jnp.ndarray, layer_idx, k_new: jnp.ndarray,
     pad = jnp.arange(S)[None, :] >= new_lens[:, None]
     phys = jnp.where(pad, 0, phys)
     slot = jnp.where(pad, 0, slot)
-    # layer_idx and (phys, slot) are separated by slices, so the advanced
-    # dims [B, S] move to the FRONT of the scatter slice: value layout is
-    # [B, S, 2, Hkv, Dh]
-    new = jnp.stack([k_new, v_new]).transpose(1, 2, 0, 3, 4)
-    return pages.at[layer_idx, :, :, phys, slot].set(
+    new = jnp.stack([k_new, v_new], axis=2)                # [B, S, 2, Hkv, Dh]
+    return pages.at[layer_idx, phys, :, :, slot].set(
         new.astype(pages.dtype), mode="drop")
 
 
@@ -95,22 +96,25 @@ def _attend(qg: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     return out.reshape(B, S, Hkv * G, Dh)
 
 
+def _gathered_to_bhtd(g: jnp.ndarray) -> jnp.ndarray:
+    """[B, P, Hkv, ps, Dh] gathered pages -> [B, Hkv, T, Dh]."""
+    B, P, Hkv, ps, Dh = g.shape
+    return g.transpose(0, 2, 1, 3, 4).reshape(B, Hkv, P * ps, Dh)
+
+
 def paged_attention_layer(q: jnp.ndarray, kv_layer: jnp.ndarray,
                           page_table: jnp.ndarray, positions: jnp.ndarray,
                           total_lens: jnp.ndarray, sm_scale: float
                           ) -> jnp.ndarray:
     """XLA-path attention against one layer's cache.
 
-    q: [B, S, Hq, Dh]; kv_layer: [2, Hkv, N, ps, Dh] -> [B, S, Hq, Dh]
+    q: [B, S, Hq, Dh]; kv_layer: [N, 2, Hkv, ps, Dh] -> [B, S, Hq, Dh]
     """
     B, S, Hq, Dh = q.shape
-    Hkv, _N, page_size, _ = kv_layer.shape[1:]
-    P = page_table.shape[1]
-    T = P * page_size
-    k = kv_layer[0][:, page_table]  # [Hkv, B, P, ps, Dh]
-    v = kv_layer[1][:, page_table]
-    k = k.transpose(1, 0, 2, 3, 4).reshape(B, Hkv, T, Dh)
-    v = v.transpose(1, 0, 2, 3, 4).reshape(B, Hkv, T, Dh)
+    Hkv = kv_layer.shape[2]
+    gathered = kv_layer[page_table]        # [B, P, 2, Hkv, ps, Dh]
+    k = _gathered_to_bhtd(gathered[:, :, 0])
+    v = _gathered_to_bhtd(gathered[:, :, 1])
     qg = q.reshape(B, S, Hkv, Hq // Hkv, Dh)
     return _attend(qg, k, v, positions, total_lens,
                    sm_scale).astype(q.dtype)
@@ -122,27 +126,21 @@ def paged_attention(q: jnp.ndarray, pages: jnp.ndarray, layer_idx,
     """Attend queries to the stacked paged context (scan path).
 
     q:          [B, S, Hq, Dh]
-    pages:      [L, 2, Hkv, N, page_size, Dh]
+    pages:      [L, N, 2, Hkv, page_size, Dh]
     page_table: [B, P]
     positions:  [B, S] absolute positions of the queries
     total_lens: [B] total context length (cached + new)
     returns     [B, S, Hq, Dh]
     """
     B, S, Hq, Dh = q.shape
-    Hkv = pages.shape[2]
-    page_size = pages.shape[4]
-    P = page_table.shape[1]
-    T = P * page_size
+    Hkv = pages.shape[3]
 
     # Single fused gather: the traced layer_idx participates as an advanced
     # index so XLA reads only the gathered pages (slicing pages[layer_idx]
     # first would dynamic-slice-copy the whole layer's cache).
-    # Advanced-index result: [B, P, ps, Dh] per k/v with Hkv slicing -> use
-    # explicit gather over (layer, kv, head, page).
-    k = pages[layer_idx, 0, :, page_table]  # [B, P, Hkv, ps, Dh]
-    v = pages[layer_idx, 1, :, page_table]
-    k = k.transpose(0, 2, 1, 3, 4).reshape(B, Hkv, T, Dh)
-    v = v.transpose(0, 2, 1, 3, 4).reshape(B, Hkv, T, Dh)
+    gathered = pages[layer_idx, page_table]  # [B, P, 2, Hkv, ps, Dh]
+    k = _gathered_to_bhtd(gathered[:, :, 0])
+    v = _gathered_to_bhtd(gathered[:, :, 1])
     qg = q.reshape(B, S, Hkv, Hq // Hkv, Dh)
     return _attend(qg, k, v, positions, total_lens,
                    sm_scale).astype(q.dtype)

@@ -1,7 +1,7 @@
 """Pallas TPU kernels for the serving hot loops.
 
 - ``decode.paged_decode_attention`` — decode-step attention that reads KV
-  pages directly from HBM (fuses away the XLA path's [B, T, Hkv, Dh] gather).
+  pages directly from HBM (fuses away the XLA path's [B, T, Hkv, Dh] gather; page-major slabs, one DMA per page).
 
 The XLA implementations in ``dynamo_tpu.ops.attention`` remain the portable
 reference (CPU tests) and the prefill path.

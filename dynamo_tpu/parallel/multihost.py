@@ -135,11 +135,13 @@ async def follow_steps(drt, subject: str, engine, *,
     sub = await drt.subscribe_events(subject)
     if ready_event is not None:
         ready_event.set()
+    consecutive_failures = 0
     async for _subject, msg in sub:
         arrays = _unpack_arrays(msg)
         try:
             await asyncio.to_thread(engine.execute_arrays, msg["kind"],
                                     arrays, msg["step"])
+            consecutive_failures = 0
         except Exception:
             # mirror the leader's per-step recovery (loop.py catches step
             # exceptions, fails the victims, keeps serving): when a step
@@ -148,6 +150,12 @@ async def follow_steps(drt, subject: str, engine, *,
             # A rank-ASYMMETRIC failure (one rank can't even launch the
             # program) wedges the group's collectives and is a
             # restart-the-group condition, as in any SPMD world.
+            consecutive_failures += 1
+            if consecutive_failures >= 3:
+                # persistently failing rank (dead pages buffer, OOM): exit
+                # so the orchestrator restarts the group, instead of
+                # silently diverging or wedging the leader's collectives
+                raise
             logger.exception("follower step %s failed; continuing in "
                              "lockstep", msg.get("step"))
 

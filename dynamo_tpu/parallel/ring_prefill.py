@@ -20,8 +20,9 @@ for a genuinely long novel prompt); otherwise it falls back to chunked
 prefill which attends to resident pages. The reference has no sequence
 parallelism anywhere (SURVEY §5) — net-new capability.
 
-Works with both cache layouts (stacked ``[L, 2, Hkv, N, ps, Dh]`` for the
-scan forward; per-layer list for the unrolled/Pallas forward) and composes
+Writes either cache layout (stacked ``[L, N, 2, Hkv, ps, Dh]`` for the scan
+forward; per-layer page-major list for the unrolled/Pallas forward) and
+composes
 with tensor parallelism: the head axis stays sharded over ``tp`` inside the
 ring (attention is head-local), so a ``(sp, tp)`` mesh uses both.
 """

@@ -15,10 +15,10 @@ Layout (params carry a leading ``L`` layer axis from the ``lax.scan`` stack):
 - ``embed``    ``[V, H]``       — replicated (all-gather-free lookup)
 - ``lm_head``  ``[H, V]``       — shard ``V`` over ``tp`` (logits sharded,
   top-k/sampling runs fine on sharded logits)
-- KV pages ``[L, 2, Hkv, N, page, Dh]`` (stacked) or per-layer
-  ``[2, Hkv, N, page, Dh]`` — shard ``Hkv`` over ``tp``; each chip holds its
-  own heads' cache, so paged writes/gathers (and the Pallas decode kernel's
-  page DMAs) are chip-local.
+- KV pages ``[L, N, 2, Hkv, page, Dh]`` (stacked) or per-layer
+  ``[N, 2, Hkv, page, Dh]`` — shard ``Hkv`` over ``tp``; each chip holds its
+  own heads' slice of every page, so paged writes/gathers (and the Pallas
+  decode kernel's page DMAs) are chip-local.
 
 ``num_kv_heads`` must be divisible by ``tp`` (e.g. Llama-3-8B: 8 KV heads →
 tp ∈ {1,2,4,8}); for tp > Hkv one would replicate KV heads — rejected for
@@ -98,12 +98,12 @@ class ModelSharding:
         return specs
 
     def pages_spec(self) -> P:
-        """Stacked cache [L, 2, Hkv, N, page, Dh]: Hkv over tp."""
-        return P(None, None, "tp", None, None, None)
+        """Stacked cache [L, N, 2, Hkv, page, Dh]: Hkv over tp."""
+        return P(None, None, None, "tp", None, None)
 
     def pages_layer_spec(self) -> P:
-        """Per-layer cache [2, Hkv, N, page, Dh]: Hkv over tp."""
-        return P(None, "tp", None, None, None)
+        """Per-layer cache [N, 2, Hkv, page, Dh]: Hkv over tp."""
+        return P(None, None, "tp", None, None)
 
     # -- application -------------------------------------------------------
 

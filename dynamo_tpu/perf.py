@@ -77,6 +77,90 @@ class RecordedStream:
         reference's close-logprob detection (``perf/logprobs.rs``)."""
         return sum(1 for lp in self.logprobs() if lp < threshold)
 
+    def top_logprobs(self) -> List[Dict[int, float]]:
+        """Per-token top-K alternatives ({token_id: logprob}), flattened."""
+        out: List[Dict[int, float]] = []
+        for r in self.responses:
+            top = getattr(r.item, "top_logprobs", None)
+            if top is None and isinstance(r.item, dict):
+                top = r.item.get("top_logprobs")
+            out.extend(top or [])
+        return out
+
+    def logprob_analysis(self) -> "LogprobAnalysis":
+        return LogprobAnalysis.from_tokens(self.logprobs(),
+                                           self.top_logprobs())
+
+
+@dataclass
+class LogprobAnalysis:
+    """Distribution analytics over sampled logprobs + top-K alternatives.
+
+    Parity: reference ``lib/llm/src/perf/logprobs.rs`` (sequence logprob
+    distributions, close-call counting on top-1/top-2 margins, rank
+    tracking). ``margins[i]`` is the logprob gap between the best and
+    second-best candidate at step i — the decisive confidence signal the
+    reference uses to find tokens a nearly-tied distribution could flip;
+    ``ranks[i]`` is the sampled token's position in the top-K (0 = argmax,
+    K = fell outside)."""
+
+    chosen: List[float] = field(default_factory=list)
+    margins: List[float] = field(default_factory=list)
+    ranks: List[int] = field(default_factory=list)
+
+    @classmethod
+    def from_tokens(cls, chosen: List[float],
+                    tops: List[Dict[int, float]]) -> "LogprobAnalysis":
+        margins: List[float] = []
+        ranks: List[int] = []
+        for i, top in enumerate(tops):
+            vals = sorted(top.values(), reverse=True)
+            if len(vals) >= 2:
+                margins.append(vals[0] - vals[1])
+            if i < len(chosen):
+                # rank by count of alternatives strictly better than chosen
+                ranks.append(sum(1 for v in vals if v > chosen[i] + 1e-9))
+        return cls(chosen=list(chosen), margins=margins, ranks=ranks)
+
+    # -- scalars -------------------------------------------------------------
+
+    def mean_logprob(self) -> float:
+        return sum(self.chosen) / len(self.chosen) if self.chosen else 0.0
+
+    def perplexity(self) -> float:
+        """exp(-mean logprob) of the sampled sequence."""
+        import math
+        return math.exp(-self.mean_logprob()) if self.chosen else 1.0
+
+    def close_calls(self, margin_threshold: float = 0.1) -> int:
+        """Steps where the top-2 candidates were within ``margin_threshold``
+        nats — a tiny numerics or sampling change could flip the output."""
+        return sum(1 for m in self.margins if m <= margin_threshold)
+
+    def non_greedy_tokens(self) -> int:
+        """Sampled tokens that were NOT the argmax (rank > 0)."""
+        return sum(1 for r in self.ranks if r > 0)
+
+    def rank_histogram(self) -> Dict[int, int]:
+        hist: Dict[int, int] = {}
+        for r in self.ranks:
+            hist[r] = hist.get(r, 0) + 1
+        return hist
+
+    def summary(self) -> Dict[str, float]:
+        out = {
+            "tokens": float(len(self.chosen)),
+            "mean_logprob": self.mean_logprob(),
+            "perplexity": self.perplexity(),
+            "close_calls": float(self.close_calls()),
+            "non_greedy_tokens": float(self.non_greedy_tokens()),
+        }
+        if self.margins:
+            s = sorted(self.margins)
+            out["margin_p50"] = s[len(s) // 2]
+            out["margin_min"] = s[0]
+        return out
+
 
 async def record_stream(stream: AsyncIterator[Any],
                         into: Optional[RecordedStream] = None
@@ -90,4 +174,5 @@ async def record_stream(stream: AsyncIterator[Any],
         yield item
 
 
-__all__ = ["RecordedStream", "TimestampedResponse", "record_stream"]
+__all__ = ["RecordedStream", "TimestampedResponse", "record_stream",
+           "LogprobAnalysis"]

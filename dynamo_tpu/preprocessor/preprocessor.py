@@ -24,6 +24,7 @@ from dynamo_tpu.protocols.openai import (
     ChatChunkChoice,
     ChatCompletionChunk,
     ChatCompletionRequest,
+    ChoiceLogprobs,
     CompletionRequest,
     DeltaMessage,
     Usage,
@@ -97,6 +98,17 @@ class OpenAIPreprocessor:
             min_tokens=req.min_tokens,
             ignore_eos=ignore_eos,
         )
+        # OpenAI logprobs: chat gates a count behind a bool (logprobs=true +
+        # top_logprobs=N); legacy completions passes the count directly.
+        # sampling.logprobs None = off, 0 = sampled token only, N = +N tops.
+        if isinstance(req, ChatCompletionRequest):
+            logprobs = ((req.top_logprobs or 0) if req.logprobs else None)
+        else:
+            logprobs = req.logprobs
+        if logprobs is not None:
+            # OpenAI caps top_logprobs at 20; the engine serves at most its
+            # compiled num_top_logprobs (default 8) — more is silently fewer
+            logprobs = min(logprobs, 20)
         sampling = SamplingOptions(
             temperature=req.temperature,
             top_p=req.top_p,
@@ -106,6 +118,7 @@ class OpenAIPreprocessor:
             repetition_penalty=req.repetition_penalty,
             seed=req.seed,
             n=req.n,
+            logprobs=logprobs,
         )
         return PreprocessedRequest(
             token_ids=token_ids,
@@ -143,11 +156,17 @@ class DeltaGenerator:
             self.completion_tokens = out.completion_tokens
         role = "assistant" if self._first else None
         self._first = False
-        if out.text or role is not None:
+        # emit on logprob entries too: a frame whose tokens decoded to no
+        # text yet (partial UTF-8 held by the decode stream) still carries
+        # per-token logprobs that must not be dropped
+        if out.text or role is not None or out.logprobs_content:
+            logprobs = (ChoiceLogprobs(content=out.logprobs_content)
+                        if out.logprobs_content else None)
             chunks.append(ChatCompletionChunk(
                 id=self.id, created=self.created, model=self.model,
                 choices=[ChatChunkChoice(
-                    delta=DeltaMessage(role=role, content=out.text or ""))]))
+                    delta=DeltaMessage(role=role, content=out.text or ""),
+                    logprobs=logprobs)]))
         if out.finish_reason is not None:
             chunks.append(ChatCompletionChunk(
                 id=self.id, created=self.created, model=self.model,

@@ -218,6 +218,10 @@ class BackendOutput:
     error: Optional[str] = None
     cum_log_probs: Optional[float] = None
     log_probs: Optional[List[float]] = None
+    # OpenAI chat ``logprobs.content[]``-shaped dicts, one per emitted token
+    # (token text, logprob, bytes, top_logprobs) — rendered by the backend,
+    # which owns the tokenizer; None when the request didn't ask
+    logprobs_content: Optional[List[Dict[str, Any]]] = None
     prompt_tokens: Optional[int] = None
     completion_tokens: Optional[int] = None
     cached_tokens: Optional[int] = None
@@ -227,7 +231,8 @@ class BackendOutput:
         if self.finish_reason is not None:
             d["finish_reason"] = self.finish_reason.value
         for k in ("text", "error", "cum_log_probs", "log_probs",
-                  "prompt_tokens", "completion_tokens", "cached_tokens"):
+                  "logprobs_content", "prompt_tokens", "completion_tokens",
+                  "cached_tokens"):
             v = getattr(self, k)
             if v is not None:
                 d[k] = v
@@ -243,6 +248,7 @@ class BackendOutput:
             error=d.get("error"),
             cum_log_probs=d.get("cum_log_probs"),
             log_probs=d.get("log_probs"),
+            logprobs_content=d.get("logprobs_content"),
             prompt_tokens=d.get("prompt_tokens"),
             completion_tokens=d.get("completion_tokens"),
             cached_tokens=d.get("cached_tokens"),

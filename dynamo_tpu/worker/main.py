@@ -60,6 +60,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="ring-attention sequence parallelism: prompts longer "
                         "than the prefill chunk budget prefill in one "
                         "sequence-sharded step over this many devices")
+    p.add_argument("--num-top-logprobs", type=int, default=8,
+                   help="alternatives computed per sampled token (serves "
+                        "OpenAI top_logprobs up to this; 0 disables)")
     p.add_argument("--no-kv-events", action="store_true")
     p.add_argument("--num-nodes", type=int, default=1,
                    help="multi-host: total processes in the jax world")
@@ -93,7 +96,8 @@ def build_engine(args: argparse.Namespace) -> JaxEngine:
         num_pages=args.num_pages, page_size=args.page_size,
         max_num_seqs=args.max_num_seqs,
         max_prefill_chunk=args.max_prefill_chunk,
-        max_context=min(args.max_context, cfg.max_position_embeddings))
+        max_context=min(args.max_context, cfg.max_position_embeddings),
+        num_top_logprobs=args.num_top_logprobs)
     tp, sp = args.tensor_parallel_size, args.sequence_parallel_size
     if tp > 1 or sp > 1:
         from dynamo_tpu.parallel.mesh import MeshSpec, make_mesh

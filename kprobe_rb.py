@@ -1,0 +1,15 @@
+import jax, jax.numpy as jnp, numpy as np, time
+f = jax.jit(lambda x: x + 1)
+x = jnp.zeros((32,), jnp.int32)
+x = f(x); np.asarray(x)
+# chained dispatch WITHOUT readback
+t0 = time.perf_counter()
+for _ in range(50): x = f(x)
+jax.block_until_ready(x)
+print(f"50 chained steps, no readback: {(time.perf_counter()-t0)/50*1e3:.2f} ms/step")
+# with per-step host readback
+t0 = time.perf_counter()
+for _ in range(50):
+    x = f(x)
+    _ = np.asarray(x)
+print(f"50 steps with per-step readback: {(time.perf_counter()-t0)/50*1e3:.2f} ms/step")

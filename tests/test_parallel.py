@@ -89,10 +89,11 @@ class TestTpSharding:
         placed = shard.shard_pages(pages)
         # Hkv axis split across tp: each shard holds Hkv/2 heads
         shard_shape = placed.sharding.shard_shape(placed.shape)
-        assert shard_shape[2] == cfg.num_kv_heads // 2
+        assert shard_shape[3] == cfg.num_kv_heads // 2
         layer_list = shard.shard_pages(llama.make_pages_list(cfg, 8, 4))
         ls = layer_list[0].sharding.shard_shape(layer_list[0].shape)
-        assert ls[1] == cfg.num_kv_heads // 2
+        assert ls[2] == cfg.num_kv_heads // 2
+
 
     def test_param_placement(self):
         cfg = ModelConfig.tiny()
