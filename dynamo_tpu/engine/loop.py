@@ -69,6 +69,12 @@ class ScheduledEngineBase(EngineBase):
         self._loop_task: Optional[asyncio.Task] = None
         self._stopping = False
         self.kv_event_cb: Optional[Callable[[List[KvCacheEvent]], None]] = None
+        # supervision: called when the engine loop DIES (exception — not a
+        # clean stop()). A worker wires this to runtime shutdown so its
+        # lease/registration vanish and routers stop sending traffic to a
+        # zombie (reference: CriticalTaskExecutionHandle,
+        # lib/runtime/src/utils/task.rs)
+        self.on_loop_exit: Optional[Callable[[], None]] = None
         # work serialized with the step loop (KV transfers, offload/onboard):
         # drained between steps so nothing else ever touches pages/allocator
         # while a (pages-donating) jitted step is in flight
@@ -240,6 +246,13 @@ class ScheduledEngineBase(EngineBase):
     async def _loop(self) -> None:
         try:
             await self._loop_body()
+        except BaseException:
+            if not self._stopping and self.on_loop_exit is not None:
+                try:
+                    self.on_loop_exit()
+                except Exception:
+                    logger.exception("on_loop_exit hook failed")
+            raise
         finally:
             # whether stopped or crashed, nobody will drain the queue again —
             # fail pending exclusive work so callers don't hang forever

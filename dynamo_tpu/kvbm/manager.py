@@ -1,13 +1,21 @@
 """Offload/onboard orchestration across the KV tiers.
 
 Parity in role: reference ``OffloadManager`` (``block_manager/offload.rs`` —
-G1->G2->G3 offload, onboarding with batched transfers). Here transfers are
-jax gathers (device->host) and the content-addressed inject path
-(``engine/transfer.py``) — no CUDA streams/NIXL agents to manage.
+G1->G2->G3 offload with bounded queues off the hot path, onboarding with
+batched transfers). Here transfers are jax gathers (device->host) and the
+content-addressed inject path (``engine/transfer.py``) — no CUDA
+streams/NIXL agents to manage.
 
 ``TieredEngine`` wraps any ``JaxEngine``:
-- installs the allocator eviction hook: HBM-evicted blocks snapshot into G2
-  (host RAM), G2 overflow demotes to G3 (disk);
+- installs the allocator eviction hook: HBM-evicted blocks are snapshotted
+  ON DEVICE (an async jitted gather — no host sync, runs between steps) and
+  handed to a background spill thread through a BOUNDED queue; the thread
+  does the device->host copy and the G2/G3 tier writes (disk IO never runs
+  on the eviction path, so an eviction storm cannot stall a decode step —
+  reference analog: ``offload.rs:80-99``'s bounded offload queues).
+  When the queue is full the oldest pending spill is dropped and counted:
+  the tiers are best-effort caches, blocking the engine is worse than
+  losing a re-computable block.
 - on ``generate``, prompt blocks missing from HBM but held by G2/G3 are
   injected back into the device cache, then normal admission prefix-matches
   them. Onboarding pulls G3 hits back through G2 (promotion on use).
@@ -16,14 +24,18 @@ jax gathers (device->host) and the content-addressed inject path
 from __future__ import annotations
 
 import logging
+import queue
+import threading
 from dataclasses import dataclass
-from typing import AsyncIterator, List, Optional
+from typing import AsyncIterator, Dict, List, Optional
+
+import numpy as np
 
 from dynamo_tpu.engine.jax_engine import JaxEngine
 from dynamo_tpu.engine.base import EngineBase
 from dynamo_tpu.engine.transfer import (
     BlockPayload,
-    _gather_pages,
+    _gather_device,
     inject_blocks,
 )
 from dynamo_tpu.protocols.common import LLMEngineOutput, PreprocessedRequest
@@ -40,6 +52,8 @@ class TieredKvConfig:
     disk_path: str = "/tmp/dynamo_tpu_kvbm"
     # cap on blocks onboarded per request (bound admission latency)
     max_onboard_blocks: int = 256
+    # bounded background spill queue (eviction batches in flight)
+    max_pending_spills: int = 8
 
 
 class TieredEngine(EngineBase):
@@ -54,29 +68,89 @@ class TieredEngine(EngineBase):
                      if self.cfg.disk_budget_bytes > 0 else None)
         self.offloaded = 0
         self.onboarded = 0
+        self.dropped_spills = 0
+        self._tier_lock = threading.Lock()
+        self._pending_lock = threading.Lock()
+        self._pending_hashes: set = set()
+        self._spills: "queue.Queue" = queue.Queue(
+            maxsize=self.cfg.max_pending_spills)
+        self._spill_thread: Optional[threading.Thread] = None
         engine.allocator.on_evict = self._on_evict
 
     # -- offload (G1 -> G2 -> G3) -----------------------------------------
 
     def _on_evict(self, evicted: List[tuple]) -> None:
-        """Allocator eviction hook: snapshot blocks to the host tier.
+        """Allocator eviction hook — must return fast.
 
-        Runs synchronously before the pages are reused; the gather reads the
-        current immutable device array snapshot.
+        Runs between engine steps (evictions happen in the scheduler, which
+        is serialized with the step loop), so the device gather reads a
+        consistent cache. Only the gather DISPATCH happens here; the
+        device->host copy and tier writes run on the spill thread.
         """
         try:
-            data = _gather_pages(self.engine, [p for _h, p, _i in evicted])
+            data_dev = _gather_device(self.engine,
+                                      [p for _h, p, _i in evicted])
         except Exception:
             logger.exception("kvbm offload gather failed; blocks dropped")
             return
-        for i, (h, _page, info) in enumerate(evicted):
-            blk = BlockPayload(block_hash=h, local_hash=info.local_hash,
-                               parent_hash=info.parent_hash,
-                               data=data[:, i].copy())
-            self.offloaded += 1
-            for demoted in self.host.put(blk):
-                if self.disk is not None:
-                    self.disk.put(demoted)
+        metas = [(h, info.local_hash, info.parent_hash)
+                 for h, _page, info in evicted]
+        with self._pending_lock:
+            self._pending_hashes.update(h for h, _l, _p in metas)
+        item = (metas, data_dev)
+        try:
+            self._spills.put_nowait(item)
+        except queue.Full:
+            try:  # drop the OLDEST pending batch, keep the freshest
+                old_metas, _old = self._spills.get_nowait()
+                with self._pending_lock:
+                    self._pending_hashes.difference_update(
+                        h for h, _l, _p in old_metas)
+                self._spills.task_done()
+                self.dropped_spills += 1
+            except queue.Empty:
+                pass
+            try:
+                self._spills.put_nowait(item)
+            except queue.Full:
+                self.dropped_spills += 1
+                return
+        if self._spill_thread is None or not self._spill_thread.is_alive():
+            self._spill_thread = threading.Thread(
+                target=self._spill_loop, daemon=True, name="kvbm-spill")
+            self._spill_thread.start()
+
+    def _spill_loop(self) -> None:
+        # daemon thread, lives for the engine's lifetime: retiring on idle
+        # races the producer's is_alive() check and can strand a batch
+        while True:
+            metas, data_dev = self._spills.get()
+            try:
+                host = np.asarray(data_dev)  # the device->host copy
+                with self._tier_lock:
+                    for i, (h, local, parent) in enumerate(metas):
+                        blk = BlockPayload(block_hash=h, local_hash=local,
+                                           parent_hash=parent,
+                                           data=host[:, i].copy())
+                        self.offloaded += 1
+                        for demoted in self.host.put(blk):
+                            if self.disk is not None:
+                                self.disk.put(demoted)
+            except Exception:
+                logger.exception("kvbm spill batch failed; blocks dropped")
+            finally:
+                with self._pending_lock:
+                    self._pending_hashes.difference_update(
+                        h for h, _l, _p in metas)
+                self._spills.task_done()
+
+    def flush_spills(self, timeout: float = 10.0) -> None:
+        """Block until every pending spill landed in a tier."""
+        import time
+        deadline = time.monotonic() + timeout
+        while (self._spills.unfinished_tasks
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
 
     # -- onboard (G2/G3 -> G1) --------------------------------------------
 
@@ -93,15 +167,25 @@ class TieredEngine(EngineBase):
         """Inject tier-resident prompt blocks missing from HBM."""
         page_size = self.engine.allocator.page_size
         hashes = compute_block_hash_for_seq(token_ids, page_size)
+        # onboarding must observe completed offloads — but only wait when a
+        # NEEDED block is actually still in the spill queue; flushing every
+        # pending batch here would re-serialize slow tier writes onto the
+        # step loop at every admission
+        with self._pending_lock:
+            overlap = bool(self._pending_hashes.intersection(
+                h for h in hashes[:self.cfg.max_onboard_blocks]))
+        if overlap:
+            self.flush_spills()
         resident = self.engine.allocator._by_hash
         needed: List[BlockPayload] = []
-        for h in hashes[:self.cfg.max_onboard_blocks]:
-            if h in resident:
-                continue
-            blk = self._lookup(h)
-            if blk is None:
-                break  # chain broken: further blocks can't be used
-            needed.append(blk)
+        with self._tier_lock:
+            for h in hashes[:self.cfg.max_onboard_blocks]:
+                if h in resident:
+                    continue
+                blk = self._lookup(h)
+                if blk is None:
+                    break  # chain broken: further blocks can't be used
+                needed.append(blk)
         if not needed:
             return 0
         n = inject_blocks(self.engine, needed)
@@ -128,6 +212,23 @@ class TieredEngine(EngineBase):
 
     def stats(self):
         return self.engine.stats()
+
+    def kvbm_stats(self) -> Dict[str, float]:
+        """Tier/pool gauges for the stats plane (worker ``__stats__`` →
+        frontend Prometheus; reference: block-manager pool metrics)."""
+        with self._tier_lock:
+            out = {
+                "kvbm_offloaded_blocks": self.offloaded,
+                "kvbm_onboarded_blocks": self.onboarded,
+                "kvbm_dropped_spills": self.dropped_spills,
+                "kvbm_host_blocks": len(self.host),
+                "kvbm_host_bytes": self.host.used,
+                "kvbm_pending_spills": self._spills.qsize(),
+            }
+            if self.disk is not None:
+                out["kvbm_disk_blocks"] = len(self.disk)
+                out["kvbm_disk_bytes"] = self.disk.used
+        return out
 
 
 __all__ = ["TieredEngine", "TieredKvConfig"]

@@ -1,0 +1,120 @@
+"""Synthetic request-trace generator with controllable prefix sharing.
+
+Parity: reference ``benchmarks/data_generator`` (synthesizes mooncake-style
+traces whose prefix-overlap statistics drive KV-router and prefix-cache
+benchmarks). A trace is JSONL, one request per line:
+
+    {"timestamp": ms, "input_length": n, "output_length": m,
+     "hash_ids": [...block hash ids...]}
+
+``hash_ids`` are BLOCK-level ids: requests in the same "group" share their
+first ``shared_blocks`` ids (the common system prompt / few-shot header),
+then diverge into unique tail blocks — exactly the structure the KV router's
+prefix matcher exploits. Groups are drawn Zipf-style so a few prompts are
+hot, arrivals are Poisson.
+
+CLI:
+    python -m dynamo_tpu.trace_gen --requests 1000 --rps 8 \\
+        --groups 20 --shared-blocks 16 --out trace.jsonl
+
+The mocker/router e2e and the profiler consume these to reproduce the
+reference's router benchmarks without real user logs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from dataclasses import dataclass
+from typing import Iterator, List, Optional
+
+import numpy as np
+
+
+@dataclass
+class TraceConfig:
+    num_requests: int = 1000
+    requests_per_s: float = 8.0       # Poisson arrival rate
+    num_groups: int = 20              # distinct shared prefixes
+    zipf_a: float = 1.2               # group popularity skew (>1)
+    shared_blocks: int = 16           # blocks of shared prefix per group
+    unique_blocks_mean: float = 8.0   # geometric tail after the prefix
+    output_len_mean: float = 128.0    # geometric decode lengths
+    block_size: int = 16              # tokens per block (for input_length)
+    seed: int = 0
+
+
+def generate(cfg: TraceConfig) -> Iterator[dict]:
+    rng = np.random.default_rng(cfg.seed)
+    # globally unique id spaces: group prefixes then per-request tails
+    next_unique = cfg.num_groups * cfg.shared_blocks
+    t_ms = 0.0
+    for _ in range(cfg.num_requests):
+        t_ms += rng.exponential(1000.0 / cfg.requests_per_s)
+        g = min(int(rng.zipf(cfg.zipf_a)) - 1, cfg.num_groups - 1)
+        prefix = list(range(g * cfg.shared_blocks,
+                            g * cfg.shared_blocks + cfg.shared_blocks))
+        n_tail = 1 + int(rng.geometric(1.0 / cfg.unique_blocks_mean))
+        tail = list(range(next_unique, next_unique + n_tail))
+        next_unique += n_tail
+        hash_ids = prefix + tail
+        yield {
+            "timestamp": round(t_ms, 3),
+            "input_length": len(hash_ids) * cfg.block_size,
+            "output_length": 1 + int(rng.geometric(
+                1.0 / cfg.output_len_mean)),
+            "hash_ids": hash_ids,
+        }
+
+
+def prefix_share_ratio(trace: List[dict]) -> float:
+    """Fraction of all blocks that a warm prefix cache would have already
+    seen (the trace's theoretical maximum cache-hit rate)."""
+    seen = set()
+    total = hits = 0
+    for req in trace:
+        for h in req["hash_ids"]:
+            total += 1
+            if h in seen:
+                hits += 1
+            seen.add(h)
+    return hits / total if total else 0.0
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    p = argparse.ArgumentParser(
+        description="prefix-sharing request trace generator")
+    p.add_argument("--requests", type=int, default=1000)
+    p.add_argument("--rps", type=float, default=8.0)
+    p.add_argument("--groups", type=int, default=20)
+    p.add_argument("--zipf", type=float, default=1.2)
+    p.add_argument("--shared-blocks", type=int, default=16)
+    p.add_argument("--unique-blocks-mean", type=float, default=8.0)
+    p.add_argument("--output-len-mean", type=float, default=128.0)
+    p.add_argument("--block-size", type=int, default=16)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--out", default="-")
+    args = p.parse_args(argv)
+    cfg = TraceConfig(
+        num_requests=args.requests, requests_per_s=args.rps,
+        num_groups=args.groups, zipf_a=args.zipf,
+        shared_blocks=args.shared_blocks,
+        unique_blocks_mean=args.unique_blocks_mean,
+        output_len_mean=args.output_len_mean,
+        block_size=args.block_size, seed=args.seed)
+    trace = list(generate(cfg))
+    out = sys.stdout if args.out == "-" else open(args.out, "w")
+    for req in trace:
+        out.write(json.dumps(req) + "\n")
+    if out is not sys.stdout:
+        out.close()
+    print(f"trace: {len(trace)} requests, prefix-share ratio "
+          f"{prefix_share_ratio(trace):.2f}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()
+
+
+__all__ = ["TraceConfig", "generate", "prefix_share_ratio"]

@@ -60,6 +60,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="ring-attention sequence parallelism: prompts longer "
                         "than the prefill chunk budget prefill in one "
                         "sequence-sharded step over this many devices")
+    p.add_argument("--host-cache-bytes", type=int, default=0,
+                   help="KVBM G2 host-RAM KV tier budget (0 disables)")
+    p.add_argument("--disk-cache-bytes", type=int, default=0,
+                   help="KVBM G3 disk KV tier budget (0 disables)")
+    p.add_argument("--disk-cache-path", default="/tmp/dynamo_tpu_kvbm")
     p.add_argument("--num-top-logprobs", type=int, default=8,
                    help="alternatives computed per sampled token (serves "
                         "OpenAI top_logprobs up to this; 0 disables)")
@@ -151,6 +156,34 @@ async def amain(args: argparse.Namespace) -> None:
                 .endpoint(args.endpoint))
     engine = build_engine(args)
 
+    # a dead engine loop takes the worker's registration down with it, so
+    # routers stop sending to a zombie (reference: task.rs critical tasks)
+    engine.on_loop_exit = drt.runtime.shutdown
+
+    tiered = None
+    if args.host_cache_bytes > 0 or args.disk_cache_bytes > 0:
+        if multihost:
+            raise SystemExit(
+                "KVBM tiers are not supported with --num-nodes>1: tier "
+                "gathers/scatters would run rank-0-only jits on the "
+                "globally sharded cache and wedge the group")
+        if args.disagg == "decode":
+            raise SystemExit(
+                "KVBM tiers with --disagg decode are not supported yet: "
+                "the disagg decode path pulls prefixes from prefill "
+                "workers and bypasses tier onboarding")
+        from dynamo_tpu.kvbm.manager import TieredEngine, TieredKvConfig
+        tiered = TieredEngine(engine, TieredKvConfig(
+            host_budget_bytes=max(args.host_cache_bytes, 1),
+            disk_budget_bytes=args.disk_cache_bytes,
+            disk_path=args.disk_cache_path))
+
+    def worker_stats() -> dict:
+        d = engine.stats().to_dict()
+        if tiered is not None:
+            d["kvbm"] = tiered.kvbm_stats()
+        return d
+
     if multihost:
         # followers subscribed before checking in, so serving can't outrun
         # them; install the step broadcast tap only once all are present
@@ -180,10 +213,10 @@ async def amain(args: argparse.Namespace) -> None:
         from dynamo_tpu.llm.register import engine_handler
         await engine.start()
         await endpoint.serve(engine_handler(handler),
-                             stats_provider=lambda: engine.stats().to_dict())
+                             stats_provider=worker_stats)
     else:
-        await serve_engine(endpoint, engine,
-                           stats_provider=lambda: engine.stats().to_dict())
+        await serve_engine(endpoint, tiered if tiered is not None else engine,
+                           stats_provider=worker_stats)
     if args.disagg == "prefill":
         # serve the KV block fetch endpoint for decode workers; register as
         # model_type=prefill so frontends don't route chat traffic here

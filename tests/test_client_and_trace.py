@@ -1,0 +1,114 @@
+"""Typed HTTP client (lib/llm/src/http/client.rs analog) + trace generator.
+
+The client is exercised against the real in-process HttpService with an echo
+engine — typed responses, streaming, and error surfacing; the trace
+generator is pinned on determinism and its prefix-sharing contract.
+"""
+
+import json
+import subprocess
+import sys
+
+import pytest
+
+from dynamo_tpu.engine.base import EchoEngine
+from dynamo_tpu.http.client import HttpClientError, OpenAIClient
+from dynamo_tpu.http.service import HttpService
+from dynamo_tpu.llm.model_manager import ModelManager
+from dynamo_tpu.llm.pipeline import LocalEnginePipeline
+from dynamo_tpu.trace_gen import TraceConfig, generate, prefix_share_ratio
+from dynamo_tpu.utils.testing import make_test_card
+
+
+async def echo_service():
+    card = make_test_card(name="echo-model")
+    manager = ModelManager()
+    manager.add(card.name, LocalEnginePipeline(card, EchoEngine()))
+    return await HttpService(manager, host="127.0.0.1", port=0).start()
+
+
+class TestOpenAIClient:
+    async def test_models_and_chat_typed(self):
+        service = await echo_service()
+        try:
+            async with OpenAIClient(
+                    f"http://127.0.0.1:{service.port}") as c:
+                models = await c.models()
+                assert [m.id for m in models.data] == ["echo-model"]
+                resp = await c.chat(
+                    [{"role": "user", "content": "hello"}],
+                    model="echo-model", max_tokens=8)
+                assert resp.choices[0].message.role == "assistant"
+                assert resp.choices[0].finish_reason in ("stop", "length")
+                assert resp.usage.completion_tokens > 0
+        finally:
+            await service.stop()
+
+    async def test_chat_stream_chunks(self):
+        service = await echo_service()
+        try:
+            async with OpenAIClient(
+                    f"http://127.0.0.1:{service.port}") as c:
+                text = ""
+                n = 0
+                async for chunk in c.chat_stream(
+                        [{"role": "user", "content": "hi"}],
+                        model="echo-model", max_tokens=6):
+                    n += 1
+                    for ch in chunk.choices:
+                        text += ch.delta.content or ""
+                assert n >= 2
+                assert text
+        finally:
+            await service.stop()
+
+    async def test_completion_and_unknown_model(self):
+        service = await echo_service()
+        try:
+            async with OpenAIClient(
+                    f"http://127.0.0.1:{service.port}") as c:
+                resp = await c.completion("once upon", model="echo-model",
+                                          max_tokens=4)
+                assert resp.choices[0].text
+                with pytest.raises(HttpClientError) as ei:
+                    await c.chat([{"role": "user", "content": "x"}],
+                                 model="nope")
+                assert ei.value.status == 404
+        finally:
+            await service.stop()
+
+
+class TestTraceGen:
+    def test_deterministic_and_prefix_shared(self):
+        cfg = TraceConfig(num_requests=300, num_groups=10,
+                          shared_blocks=8, seed=42)
+        a = list(generate(cfg))
+        b = list(generate(cfg))
+        assert a == b  # seeded determinism
+        # arrivals monotonic; lengths consistent with hash counts
+        ts = [r["timestamp"] for r in a]
+        assert ts == sorted(ts)
+        assert all(r["input_length"] ==
+                   len(r["hash_ids"]) * cfg.block_size for r in a)
+        # with 10 hot groups of 8 shared blocks, a large fraction of all
+        # blocks must be re-seen — the property the KV router exploits
+        ratio = prefix_share_ratio(a)
+        assert ratio > 0.3
+        # no sharing when every request is its own group
+        lone = list(generate(TraceConfig(num_requests=100, num_groups=100,
+                                         zipf_a=5.0, shared_blocks=1,
+                                         seed=1)))
+        assert prefix_share_ratio(lone) < ratio
+
+    def test_cli_writes_jsonl(self, tmp_path):
+        out = tmp_path / "trace.jsonl"
+        r = subprocess.run(
+            [sys.executable, "-m", "dynamo_tpu.trace_gen",
+             "--requests", "50", "--out", str(out)],
+            capture_output=True, text=True, timeout=60, cwd="/root/repo")
+        assert r.returncode == 0, r.stderr
+        lines = [json.loads(ln) for ln in out.read_text().splitlines()]
+        assert len(lines) == 50
+        assert {"timestamp", "input_length", "output_length",
+                "hash_ids"} <= set(lines[0])
+        assert "prefix-share ratio" in r.stderr

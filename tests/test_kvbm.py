@@ -142,3 +142,92 @@ class TestTieredEngine:
             assert len(tiered.disk) >= 1
         finally:
             await tiered.stop()
+
+
+class SlowDisk(DiskTier):
+    """Disk tier whose writes take 150ms — models a saturated disk."""
+
+    def put(self, block):
+        import time
+        time.sleep(0.15)
+        return super().put(block)
+
+
+class TestAsyncOffload:
+    async def test_slow_disk_does_not_block_eviction(self, tmp_path):
+        """Eviction (on the engine's step path) must return immediately even
+        when the spill target is slow: the tier writes happen on the spill
+        thread (VERDICT r1 item 10 — offload off the hot path)."""
+        import time
+        eng = JaxEngine.random_init(ModelConfig.tiny(), JaxEngineConfig(
+            num_pages=10, page_size=4, max_num_seqs=2,
+            max_prefill_chunk=8, max_context=32, min_prefill_bucket=4))
+        tiered = TieredEngine(eng, TieredKvConfig(
+            host_budget_bytes=1,  # everything demotes to disk immediately
+            disk_budget_bytes=1 << 20))
+        tiered.disk = SlowDisk(str(tmp_path), 1 << 20)
+        try:
+            await collect(tiered, make_req(list(range(1, 14)), "a"))
+            # force eviction of a's 3 committed blocks
+            t0 = time.monotonic()
+            await collect(tiered, make_req(list(range(101, 114)), "b",
+                                           max_tokens=20))
+            fg = time.monotonic() - t0
+            tiered.flush_spills()
+            # 3+ blocks x 150ms of disk writes happened, but off-path: the
+            # foreground generate must not have absorbed them serially
+            assert tiered.offloaded >= 3
+            assert len(tiered.disk) >= 3
+            assert fg < 3 * 0.15 + 1.0  # generous CI slack, still far under
+        finally:
+            await tiered.stop()
+
+    async def test_kvbm_stats_gauges(self, tmp_path):
+        tiered, _eng = tiny_tiered(num_pages=10, disk_path=str(tmp_path),
+                                   disk_bytes=1 << 20)
+        try:
+            await collect(tiered, make_req(list(range(1, 14)), "a"))
+            await collect(tiered, make_req(list(range(101, 114)), "b",
+                                           max_tokens=20))
+            tiered.flush_spills()
+            s = tiered.kvbm_stats()
+            assert s["kvbm_offloaded_blocks"] >= 3
+            assert s["kvbm_host_blocks"] >= 1
+            assert s["kvbm_host_bytes"] > 0
+            assert s["kvbm_pending_spills"] == 0
+            assert "kvbm_disk_blocks" in s
+        finally:
+            await tiered.stop()
+
+
+class TestLoopSupervision:
+    async def test_dead_loop_fires_exit_hook(self):
+        """A crashed engine loop (not a clean stop) must invoke
+        on_loop_exit so the worker can drop its registration (reference:
+        CriticalTaskExecutionHandle, lib/runtime/src/utils/task.rs)."""
+        eng = JaxEngine.random_init(ModelConfig.tiny(), JaxEngineConfig(
+            num_pages=16, page_size=4, max_num_seqs=2,
+            max_prefill_chunk=8, max_context=32, min_prefill_bucket=4))
+        fired = asyncio.Event()
+        eng.on_loop_exit = fired.set
+
+        def boom():
+            raise RuntimeError("scheduler corrupted")
+
+        try:
+            await eng.start()
+            eng.scheduler.schedule = boom  # loop body dies outside a step
+            eng._work.set()
+            await asyncio.wait_for(fired.wait(), timeout=5)
+        finally:
+            await eng.stop()
+
+    async def test_clean_stop_does_not_fire_hook(self):
+        eng = JaxEngine.random_init(ModelConfig.tiny(), JaxEngineConfig(
+            num_pages=16, page_size=4, max_num_seqs=2,
+            max_prefill_chunk=8, max_context=32, min_prefill_bucket=4))
+        fired = []
+        eng.on_loop_exit = lambda: fired.append(1)
+        await eng.start()
+        await eng.stop()
+        assert not fired
