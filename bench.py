@@ -76,6 +76,13 @@ async def run_bench(args) -> dict:
     from dynamo_tpu.models.config import ModelConfig
     from dynamo_tpu.protocols.common import (
         PreprocessedRequest, SamplingOptions, StopConditions)
+    from dynamo_tpu.utils.platform import enable_compilation_cache
+
+    # persistent compile cache: a repeat run of the same config loads its
+    # step programs from disk instead of recompiling (minutes -> seconds on
+    # the tunneled chip); shared via JAX_COMPILATION_CACHE_DIR with any
+    # retry attempts the orchestrator launches
+    enable_compilation_cache()
 
     on_tpu = jax.devices()[0].platform in TPU_PLATFORMS
     if args.small or not on_tpu:
@@ -133,11 +140,16 @@ async def run_bench(args) -> dict:
         # warmup: compile the REAL prefill and decode shapes — a full-width
         # concurrent batch, or the timed phase eats a multi-minute XLA
         # compile of the shapes it actually runs (round-2 lesson: warmup at
-        # [1, S] left [8, S] to compile inside the measurement)
+        # [1, S] left [8, S] to compile inside the measurement). Decode
+        # needs >2 steps so the chained (pipelined) program also compiles.
         print("bench: warmup/compile...", file=sys.stderr, flush=True)
+        t_setup = time.perf_counter()  # engine built; this times compiles only
         await asyncio.gather(
-            *[drive(f"warm{i}", prompt, 2) for i in range(prefill_seqs)])
+            *[drive(f"warm{i}", prompt, 8) for i in range(seqs)])
         ttfts.clear()
+        warmup_s = time.perf_counter() - t_setup
+        print(f"bench: warmup done in {warmup_s:.1f}s", file=sys.stderr,
+              flush=True)
 
         print(f"bench: {seqs} seqs x ({prompt} prompt + {gen} gen)",
               file=sys.stderr, flush=True)
@@ -191,9 +203,13 @@ async def run_bench(args) -> dict:
         "value": round(tok_per_s, 1),
         "unit": "tokens/sec",
         "vs_baseline": round(tok_per_s / roofline_tok_s, 4),
+        # the primary configuration really ran (the driver must treat any
+        # fallback JSON as a failed round, VERDICT r2 item 4)
+        "valid": bool(on_tpu and not args.small),
         "kv_inject_gbps": kv_gbps,
         "prefill_tok_s": round(prefill_tok_s, 1),
         "ttft_p50_s": round(statistics.median(ttfts), 3),
+        "warmup_s": round(warmup_s, 1),
     }
 
 
@@ -317,6 +333,10 @@ def main() -> None:
         errors.append("cpu fallback failed too")
     if not errors:
         errors.append("tpu attempts skipped (budget)")
+    # the primary config did NOT run: mark the JSON invalid so the driver
+    # records a failed round instead of mistaking the toy number for the
+    # real one (VERDICT r2: a fallback at rc=0 read as success)
+    result["valid"] = False
     result["error"] = "; ".join(errors)
     print(json.dumps(result), flush=True)
 

@@ -74,6 +74,12 @@ class JaxEngineConfig:
     #   "pallas"   — unrolled + Pallas paged decode kernel (TPU)
     #   "auto"     — pallas on TPU, scan elsewhere
     attn_impl: str = "auto"
+    # pipelined decode: step N+1 consumes step N's sampled tokens directly
+    # on device; the host fetches step N's results while N+1 runs, hiding
+    # the device->host readback (which on a tunneled chip is ~80 ms — the
+    # dominant per-step cost at small batch). Disable for strict
+    # step-at-a-time debugging.
+    pipeline_decode: bool = True
     # mesh/sharding hooks (filled by dynamo_tpu.parallel when multi-chip)
     shard_params_fn: Optional[Callable] = None
     shard_pages_fn: Optional[Callable] = None
@@ -153,7 +159,14 @@ class JaxEngine(ScheduledEngineBase):
         self._jit_step = jax.jit(self._step_impl, donate_argnums=(1,))
         self._jit_ring_step = jax.jit(self._ring_step_impl,
                                       donate_argnums=(1,))
+        # chained decode: tokens come from the previous step's on-device
+        # packed output (column 0) instead of the host. prev_packed is NOT
+        # donated — the host still fetches it after this dispatch.
+        self._jit_chained = jax.jit(self._chained_step_impl,
+                                    donate_argnums=(1,))
+        self._last_packed = None  # most recent packed output (device)
         self.ring_steps = 0  # diagnostics: sequence-parallel prefills run
+        self.chained_steps = 0  # diagnostics: pipelined decode steps run
         # multi-host: called with (kind, arrays, step) right before each
         # dispatch so rank 0 can broadcast the step to follower ranks
         # (parallel/multihost.py); None on single-host workers
@@ -177,6 +190,17 @@ class JaxEngine(ScheduledEngineBase):
                 page_table, total_lens, new_lens, attn_impl=attn)
         return self._sample_tail(logits, pages, rng, step, temperature,
                                  top_k, top_p)
+
+    def _chained_step_impl(self, params, pages, prev_packed, positions,
+                           page_table, total_lens, new_lens, rng, step,
+                           temperature, top_k, top_p):
+        """Decode step whose input token is the previous step's on-device
+        sampled token (packed column 0), row-aligned with the previous
+        plan."""
+        tokens = prev_packed[:, :1]                        # [B, 1] int32
+        return self._step_impl(params, pages, tokens, positions, page_table,
+                               total_lens, new_lens, rng, step, temperature,
+                               top_k, top_p)
 
     def _ring_step_impl(self, params, pages, tokens, positions, page_table,
                         total_lens, new_lens, rng, step, temperature, top_k,
@@ -257,43 +281,105 @@ class JaxEngine(ScheduledEngineBase):
                 if so.top_p is not None:
                     top_p[i] = so.top_p
         else:
-            seqs = plan.seqs
-            B = _bucket(len(seqs), self.cfg.min_decode_bucket,
-                        self.cfg.max_num_seqs)
-            toks = np.zeros((B, 1), np.int32)
-            pos = np.zeros((B, 1), np.int32)
-            table = np.zeros((B, P), np.int32)
-            total = np.ones(B, np.int32)
-            new = np.zeros(B, np.int32)
-            temp = np.zeros(B, np.float32)
-            top_k = np.zeros(B, np.int32)
-            top_p = np.ones(B, np.float32)
-            for i, seq in enumerate(seqs):
-                last = len(seq) - 1
-                toks[i, 0] = seq.tokens.tokens()[-1]
-                pos[i, 0] = last
-                table[i, :len(seq.page_ids)] = seq.page_ids
-                total[i] = len(seq)
-                new[i] = 1
-                so = seq.request.sampling_options
-                if so.temperature is not None:
-                    temp[i] = so.temperature
-                top_k[i] = so.top_k or 0
-                if so.top_p is not None:
-                    top_p[i] = so.top_p
+            arrays = self._decode_arrays(plan.seqs, chained=False)
+            plan._step_id = self._step_counter
+            if self.step_tap is not None:
+                self.step_tap("step", arrays, self._step_counter)
+            out = self.execute_arrays("step", arrays, self._step_counter)
+            self._step_counter += 1
+            return out
         kind = "step"
-        if isinstance(plan, PrefillBatch) and plan.ring:
+        if plan.ring:
             kind = "ring"
             self.ring_steps += 1
             logger.info("ring prefill: %d prompt tokens in one step over "
                         "sp=%d", plan.chunks[0].length, self._sp)
         arrays = dict(toks=toks, pos=pos, table=table, total=total, new=new,
                       temp=temp, top_k=top_k, top_p=top_p)
+        plan._step_id = self._step_counter
         if self.step_tap is not None:
             self.step_tap(kind, arrays, self._step_counter)
         out = self.execute_arrays(kind, arrays, self._step_counter)
         self._step_counter += 1
         return out
+
+    def _decode_arrays(self, seqs, chained: bool) -> dict:
+        """Padded host arrays for one decode step.
+
+        Normal decode feeds the last appended token at position ``len-1``.
+        A chained step (step N's token still on device, not yet appended
+        host-side) feeds position ``len`` — the device substitutes the
+        token from the previous packed output."""
+        P = self.table_width
+        B = _bucket(len(seqs), self.cfg.min_decode_bucket,
+                    self.cfg.max_num_seqs)
+        toks = np.zeros((B, 1), np.int32)
+        pos = np.zeros((B, 1), np.int32)
+        table = np.zeros((B, P), np.int32)
+        total = np.ones(B, np.int32)
+        new = np.zeros(B, np.int32)
+        temp = np.zeros(B, np.float32)
+        top_k = np.zeros(B, np.int32)
+        top_p = np.ones(B, np.float32)
+        for i, seq in enumerate(seqs):
+            if chained:
+                pos[i, 0] = len(seq)
+                total[i] = len(seq) + 1
+            else:
+                toks[i, 0] = seq.tokens.tokens()[-1]
+                pos[i, 0] = len(seq) - 1
+                total[i] = len(seq)
+            table[i, :len(seq.page_ids)] = seq.page_ids
+            new[i] = 1
+            so = seq.request.sampling_options
+            if so.temperature is not None:
+                temp[i] = so.temperature
+            top_k[i] = so.top_k or 0
+            if so.top_p is not None:
+                top_p[i] = so.top_p
+        return dict(toks=toks, pos=pos, table=table, total=total, new=new,
+                    temp=temp, top_k=top_k, top_p=top_p)
+
+    # -- pipelined decode (loop.py hooks) ----------------------------------
+
+    @property
+    def supports_pipelining(self) -> bool:
+        return self.cfg.pipeline_decode
+
+    def dispatch_decode(self, plan):
+        """Dispatch one decode step WITHOUT fetching its results; returns
+        the on-device packed output handle (jax dispatch is async)."""
+        arrays = self._decode_arrays(plan.seqs, chained=False)
+        plan._step_id = self._step_counter
+        if self.step_tap is not None:
+            self.step_tap("step", arrays, self._step_counter)
+        packed = self._invoke_step("step", arrays, self._step_counter)
+        self._step_counter += 1
+        return packed
+
+    def dispatch_chained(self, plan, prev_packed):
+        """Dispatch decode step N+1 consuming step N's on-device tokens."""
+        arrays = self._decode_arrays(plan.seqs, chained=True)
+        plan._step_id = self._step_counter
+        if self.step_tap is not None:
+            self.step_tap("chained", arrays, self._step_counter)
+        packed = self._invoke_step("chained", arrays, self._step_counter,
+                                   prev_packed=prev_packed)
+        self._step_counter += 1
+        self.chained_steps += 1
+        return packed
+
+    def fetch_packed(self, packed):
+        """Blocking device->host fetch + unpack of one step's results."""
+        host = np.asarray(packed)
+        sampled = host[:, 0]
+        logprobs = host[:, 1].copy().view(np.float32)
+        extras = None
+        if host.shape[1] > 2:
+            K = (host.shape[1] - 2) // 2
+            extras = {"top_ids": host[:, 2:2 + K],
+                      "top_lps": host[:, 2 + K:].copy().view(np.float32)}
+        return sampled, logprobs, extras
 
     def execute_arrays(self, kind: str, a: dict, step: int):
         """Run one jitted step from raw padded host arrays.
@@ -303,22 +389,34 @@ class JaxEngine(ScheduledEngineBase):
         (rank 0 arrives here via ``_execute_plan``). Returns
         (sampled, logprobs, extras) where extras carries the top-K
         alternatives when ``num_top_logprobs`` > 0."""
-        step_fn = self._jit_ring_step if kind == "ring" else self._jit_step
-        self.pages, packed = step_fn(
-            self.params, self.pages, jnp.asarray(a["toks"]),
-            jnp.asarray(a["pos"]), jnp.asarray(a["table"]),
-            jnp.asarray(a["total"]), jnp.asarray(a["new"]),
-            self._rng, np.int32(step), jnp.asarray(a["temp"]),
-            jnp.asarray(a["top_k"]), jnp.asarray(a["top_p"]))
-        host = np.asarray(packed)                  # the ONE fetch per step
-        sampled = host[:, 0]
-        logprobs = host[:, 1].copy().view(np.float32)
-        extras = None
-        if host.shape[1] > 2:
-            K = (host.shape[1] - 2) // 2
-            extras = {"top_ids": host[:, 2:2 + K],
-                      "top_lps": host[:, 2 + K:].copy().view(np.float32)}
-        return sampled, logprobs, extras
+        return self.fetch_packed(self._invoke_step(kind, a, step))
+
+    def _invoke_step(self, kind: str, a: dict, step: int, prev_packed=None):
+        """Dispatch ONE jitted step of any family; returns the on-device
+        packed output (jax dispatch is async — no host sync here). The
+        single place the 12-argument step signature is spelled out.
+
+        kind "chained" substitutes the previous step's on-device sampled
+        tokens for ``a["toks"]``; ``prev_packed`` defaults to this rank's
+        last packed output (the follower case — leaders pass it)."""
+        if kind == "chained":
+            prev = prev_packed if prev_packed is not None else self._last_packed
+            self.pages, packed = self._jit_chained(
+                self.params, self.pages, prev,
+                jnp.asarray(a["pos"]), jnp.asarray(a["table"]),
+                jnp.asarray(a["total"]), jnp.asarray(a["new"]),
+                self._rng, np.int32(step), jnp.asarray(a["temp"]),
+                jnp.asarray(a["top_k"]), jnp.asarray(a["top_p"]))
+        else:
+            step_fn = self._jit_ring_step if kind == "ring" else self._jit_step
+            self.pages, packed = step_fn(
+                self.params, self.pages, jnp.asarray(a["toks"]),
+                jnp.asarray(a["pos"]), jnp.asarray(a["table"]),
+                jnp.asarray(a["total"]), jnp.asarray(a["new"]),
+                self._rng, np.int32(step), jnp.asarray(a["temp"]),
+                jnp.asarray(a["top_k"]), jnp.asarray(a["top_p"]))
+        self._last_packed = packed
+        return packed
 
     # -- embeddings --------------------------------------------------------
 

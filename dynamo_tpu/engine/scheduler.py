@@ -124,6 +124,11 @@ class SchedulerConfig:
     # sequence-parallel ring step instead of chunks; None disables (set by
     # the engine only when an sp mesh exists)
     ring_threshold: Optional[int] = None
+    # cap on concurrently-admitted ring-eligible sequences: ring steps run
+    # one at a time, so each extra admission pins its full prompt's pages
+    # idle across many steps — a burst of long prompts could otherwise
+    # starve decode growth and trigger preemption storms (ADVICE r2)
+    max_ring_seqs: int = 2
 
 
 class Scheduler:
@@ -137,6 +142,9 @@ class Scheduler:
         self.active: Dict[str, Sequence] = {}  # request_id -> seq (prefill+running)
         self._prefer_prefill = True
         self.num_preemptions = 0
+        # set by the engine loop: the context ceiling used for the
+        # deterministic end-of-stream check in plan_chained
+        self.max_context_hint: Optional[int] = None
         # cancelled sequences reaped outside an engine step; the engine drains
         # this to emit their CANCELLED frames (otherwise the caller's stream
         # would never terminate)
@@ -281,15 +289,29 @@ class Scheduler:
 
         # cap admission at the batch width so admitted pages don't sit idle
         # across many steps waiting for a row; ring candidates run alone and
-        # are held out of packing, so they don't consume a row
+        # are held out of packing, so they don't consume a row — but their
+        # admissions are capped separately (max_ring_seqs): each one pins
+        # its whole prompt's pages until its single ring step runs
         n_prefill = sum(1 for s in self.active.values()
                         if s.phase == Phase.PREFILL and not ring_eligible(s))
+        n_ring = sum(1 for s in self.active.values()
+                     if s.phase == Phase.PREFILL and ring_eligible(s))
         while (n_prefill < self.cfg.max_prefill_seqs
                and len(self.active) < self.cfg.max_num_seqs):
+            while self.waiting and self.waiting[0].cancelled:
+                self.reaped.append(self.waiting.popleft())
+            if (rt is not None and self.waiting
+                    and len(self.waiting[0]) > rt
+                    and n_ring >= self.cfg.max_ring_seqs):
+                # head would (likely) take the ring path; hold it — FIFO
+                # order forbids skipping ahead to shorter prompts
+                break
             seq = self._try_admit()
             if seq is None:
                 break
-            if not ring_eligible(seq):
+            if ring_eligible(seq):
+                n_ring += 1
+            else:
                 n_prefill += 1
         prefilling = sorted(
             (s for s in self.active.values() if s.phase == Phase.PREFILL),
@@ -352,6 +374,57 @@ class Scheduler:
         if not ready:
             return None
         return DecodeBatch(seqs=ready)
+
+    def plan_chained(self, prev: DecodeBatch) -> Optional[DecodeBatch]:
+        """Plan decode step N+1 while step N's results are still on device.
+
+        Called BEFORE ``on_step_done(prev)`` ran — sequence state still
+        excludes step N's token. Returns a DecodeBatch over exactly
+        ``prev.seqs`` (same order, so the device can index step N's sampled
+        tokens row-for-row), or None when chaining is unsafe:
+
+        - anything is waiting/prefilling (the normal schedule would prefer a
+          prefill step, and new rows would break row alignment),
+        - any prev sequence finished/was cancelled per host knowledge,
+        - any sequence deterministically finishes at step N (max_tokens /
+          max_context) — its N+1 row would be wasted work and the drain
+          boundary is cheap,
+        - page growth for the +1 lookahead fails (no preemption on this
+          path; the caller falls back to the drain-then-schedule flow).
+
+        Safety of the speculative row for a sequence that turns out to
+        finish at step N (EOS/stop): the device writes step N's token KV at
+        position ``len`` into a page that can never be committed (its last
+        position is not computed), so after release it returns to the free
+        list — a later owner overwrites before any masked read. The row's
+        sampled output is discarded at process time (phase != RUNNING).
+        """
+        if self.waiting:
+            return None
+        for seq in prev.seqs:
+            if seq.phase is not Phase.RUNNING or seq.cancelled:
+                return None
+            sc = seq.request.stop_conditions
+            max_new = sc.max_tokens if sc.max_tokens is not None else (
+                self.max_context_hint - seq.num_prompt
+                if self.max_context_hint else None)
+            # after step N the sequence has len+1 tokens / generated+1
+            if max_new is not None and len(seq.generated) + 1 >= max_new:
+                return None
+            if (self.max_context_hint is not None
+                    and len(seq) + 1 >= self.max_context_hint):
+                return None
+        if any(s.phase is Phase.PREFILL for s in self.active.values()):
+            return None
+        # +1 lookahead growth: step N+1 writes KV at position len(seq)
+        for seq in prev.seqs:
+            need = self._pages_needed(len(seq) + 1) - len(seq.page_ids)
+            if need > 0:
+                try:
+                    seq.page_ids.extend(self.alloc.allocate(need))
+                except OutOfPages:
+                    return None
+        return DecodeBatch(seqs=list(prev.seqs))
 
     def on_step_done(self, plan: StepPlan) -> None:
         """Advance accounting after the engine ran the planned step."""

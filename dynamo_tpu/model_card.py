@@ -33,6 +33,12 @@ class ModelDeploymentCard:
     tokenizer_json: Optional[str] = None  # inline tokenizers-library JSON
     tokenizer_path: Optional[str] = None  # path to tokenizer.json
     hf_config: Dict[str, Any] = field(default_factory=dict)  # raw config.json
+    # top-logprob alternatives the serving engine computes per token
+    # (JaxEngineConfig.num_top_logprobs); the preprocessor clamps request
+    # top_logprobs to this so accepted requests are actually served in
+    # full. Default matches JaxEngineConfig's default — workers that raise
+    # the engine K must set this too (worker/main.py does).
+    num_top_logprobs: int = 8
     extra: Dict[str, Any] = field(default_factory=dict)
 
     def load_tokenizer(self):

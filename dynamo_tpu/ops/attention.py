@@ -96,6 +96,80 @@ def _attend(qg: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     return out.reshape(B, S, Hkv * G, Dh)
 
 
+# pages per streamed chunk on the blockwise path; 8 pages x 16-token pages
+# = 128 kv positions per chunk — one chunk's matmul fills the MXU's lanes
+PAGES_PER_CHUNK = 8
+
+
+def _attend_blockwise(qg: jnp.ndarray, gather_chunk, num_table_pages: int,
+                      page_size: int, chunk_pages: int,
+                      positions: jnp.ndarray, total_lens: jnp.ndarray,
+                      sm_scale: float) -> jnp.ndarray:
+    """Flash-style chunked attention over the paged context.
+
+    The full-gather path above materializes ``[B,Hkv,S,G,T]`` scores — at
+    serving shapes (B=8, S=512, T=704, 3B model) that is ~250 MB of f32 per
+    layer, which is what made round 2's real-config prefill bench blow its
+    budget. Here the kv context is consumed in chunks of ``chunk_pages``
+    pages with the same online-softmax (running max + rescaled accumulators)
+    the ring/Pallas paths use, so peak intermediate size is
+    ``[B,Hkv,S,G,chunk_span]`` regardless of context length, and the
+    ``fori_loop`` bound is dynamic — chunks beyond the longest live context
+    are never touched, even though the page table is padded to
+    ``max_context``.
+
+    qg: [B, S, Hkv, G, Dh] queries (grouped);
+    gather_chunk(c) -> (k, v) each [B, Hkv, span, Dh] for pages
+    ``[c*chunk_pages, (c+1)*chunk_pages)`` of the (padded) page table.
+    Matmuls run in the cache dtype with f32 accumulation (MXU-friendly;
+    same numerics as the Pallas decode kernel).
+    """
+    B, S, Hkv, G, Dh = qg.shape
+    span = chunk_pages * page_size
+    n_static = -(-num_table_pages // chunk_pages)
+    max_t = jnp.max(total_lens)
+    n_chunks = jnp.minimum((max_t + span - 1) // span, n_static)
+
+    def body(c, carry):
+        num, den, mx = carry
+        k, v = gather_chunk(c)
+        s = jnp.einsum("bsngd,bntd->bnsgt", qg, k,
+                       preferred_element_type=jnp.float32) * sm_scale
+        t_pos = c * span + jnp.arange(span)
+        causal = t_pos[None, None, :] <= positions[:, :, None]   # [B,S,span]
+        valid = t_pos[None, None, :] < total_lens[:, None, None]
+        mask = (causal & valid)[:, None, :, None, :]
+        s = jnp.where(mask, s, NEG_INF)
+        mx_new = jnp.maximum(mx, jnp.max(s, axis=-1))            # [B,Hkv,S,G]
+        p = jnp.exp(s - mx_new[..., None])
+        # rows with no visible kv yet (mx_new still -inf): exp(-inf - -inf)
+        # is exp(0)=1 in floats — zero those rows explicitly
+        p = jnp.where((mx_new > NEG_INF / 2)[..., None], p, 0.0)
+        scale = jnp.where(mx > NEG_INF / 2, jnp.exp(mx - mx_new), 0.0)
+        pv = jnp.einsum("bnsgt,bntd->bnsgd", p.astype(v.dtype), v,
+                        preferred_element_type=jnp.float32)
+        num = num * scale[..., None] + pv
+        den = den * scale + jnp.sum(p, axis=-1)
+        return num, den, mx_new
+
+    num0 = jnp.zeros((B, Hkv, S, G, Dh), jnp.float32)
+    den0 = jnp.zeros((B, Hkv, S, G), jnp.float32)
+    mx0 = jnp.full((B, Hkv, S, G), NEG_INF, jnp.float32)
+    num, den, _ = jax.lax.fori_loop(0, n_chunks, body, (num0, den0, mx0))
+    out = num / jnp.maximum(den, 1e-20)[..., None]               # [B,Hkv,S,G,Dh]
+    return out.transpose(0, 2, 1, 3, 4).reshape(B, S, Hkv * G, Dh)
+
+
+def _pad_table(page_table: jnp.ndarray, chunk_pages: int) -> jnp.ndarray:
+    """Pad the page-table width to a multiple of ``chunk_pages`` with page 0
+    (the reserved garbage page) so chunk slices are always full-width."""
+    P = page_table.shape[1]
+    rem = P % chunk_pages
+    if rem:
+        page_table = jnp.pad(page_table, ((0, 0), (0, chunk_pages - rem)))
+    return page_table
+
+
 def _gathered_to_bhtd(g: jnp.ndarray) -> jnp.ndarray:
     """[B, P, Hkv, ps, Dh] gathered pages -> [B, Hkv, T, Dh]."""
     B, P, Hkv, ps, Dh = g.shape
@@ -109,13 +183,30 @@ def paged_attention_layer(q: jnp.ndarray, kv_layer: jnp.ndarray,
     """XLA-path attention against one layer's cache.
 
     q: [B, S, Hq, Dh]; kv_layer: [N, 2, Hkv, ps, Dh] -> [B, S, Hq, Dh]
+
+    Prefill steps (S > 1) with a context wider than one chunk take the
+    blockwise online-softmax path; small shapes keep the direct gather.
     """
     B, S, Hq, Dh = q.shape
     Hkv = kv_layer.shape[2]
+    ps = kv_layer.shape[3]
+    P = page_table.shape[1]
+    qg = q.reshape(B, S, Hkv, Hq // Hkv, Dh)
+    if S > 1 and P > PAGES_PER_CHUNK:
+        table = _pad_table(page_table, PAGES_PER_CHUNK)
+
+        def gather_chunk(c):
+            tbl = jax.lax.dynamic_slice(
+                table, (0, c * PAGES_PER_CHUNK), (B, PAGES_PER_CHUNK))
+            g = kv_layer[tbl]              # [B, C, 2, Hkv, ps, Dh]
+            return _gathered_to_bhtd(g[:, :, 0]), _gathered_to_bhtd(g[:, :, 1])
+
+        return _attend_blockwise(qg, gather_chunk, P, ps, PAGES_PER_CHUNK,
+                                 positions, total_lens,
+                                 sm_scale).astype(q.dtype)
     gathered = kv_layer[page_table]        # [B, P, 2, Hkv, ps, Dh]
     k = _gathered_to_bhtd(gathered[:, :, 0])
     v = _gathered_to_bhtd(gathered[:, :, 1])
-    qg = q.reshape(B, S, Hkv, Hq // Hkv, Dh)
     return _attend(qg, k, v, positions, total_lens,
                    sm_scale).astype(q.dtype)
 
@@ -134,6 +225,22 @@ def paged_attention(q: jnp.ndarray, pages: jnp.ndarray, layer_idx,
     """
     B, S, Hq, Dh = q.shape
     Hkv = pages.shape[3]
+    ps = pages.shape[4]
+    P = page_table.shape[1]
+    qg = q.reshape(B, S, Hkv, Hq // Hkv, Dh)
+    if S > 1 and P > PAGES_PER_CHUNK:
+        table = _pad_table(page_table, PAGES_PER_CHUNK)
+
+        def gather_chunk(c):
+            tbl = jax.lax.dynamic_slice(
+                table, (0, c * PAGES_PER_CHUNK), (B, PAGES_PER_CHUNK))
+            # traced layer_idx rides the advanced index (see below)
+            g = pages[layer_idx, tbl]      # [B, C, 2, Hkv, ps, Dh]
+            return _gathered_to_bhtd(g[:, :, 0]), _gathered_to_bhtd(g[:, :, 1])
+
+        return _attend_blockwise(qg, gather_chunk, P, ps, PAGES_PER_CHUNK,
+                                 positions, total_lens,
+                                 sm_scale).astype(q.dtype)
 
     # Single fused gather: the traced layer_idx participates as an advanced
     # index so XLA reads only the gathered pages (slicing pages[layer_idx]
@@ -141,7 +248,6 @@ def paged_attention(q: jnp.ndarray, pages: jnp.ndarray, layer_idx,
     gathered = pages[layer_idx, page_table]  # [B, P, 2, Hkv, ps, Dh]
     k = _gathered_to_bhtd(gathered[:, :, 0])
     v = _gathered_to_bhtd(gathered[:, :, 1])
-    qg = q.reshape(B, S, Hkv, Hq // Hkv, Dh)
     return _attend(qg, k, v, positions, total_lens,
                    sm_scale).astype(q.dtype)
 

@@ -110,17 +110,32 @@ class StepFanout:
         self._drt = drt
         self._subject = subject
         self._loop = asyncio.get_running_loop()
+        # resolved (step_id, ok) outcomes not yet relayed to followers;
+        # appended from the event loop (engine _process/_fail_plan), drained
+        # from the step thread inside tap() — list append/swap is
+        # GIL-atomic, and ordering within one producer is preserved
+        self._outcomes: list = []
+
+    def note_outcome(self, step_id, ok: bool) -> None:
+        if step_id is not None:
+            self._outcomes.append((int(step_id), bool(ok)))
 
     def tap(self, kind: str, arrays: Dict[str, np.ndarray],
             step: int) -> None:
+        msg = _pack_arrays(kind, arrays, step)
+        if self._outcomes:
+            drained, self._outcomes = self._outcomes, []
+            # piggyback resolved outcomes on the next step message so
+            # followers can cross-check their own per-step results
+            # (divergence detection, ADVICE r2)
+            msg["outcomes"] = drained
         fut = asyncio.run_coroutine_threadsafe(
-            self._drt.publish_event(self._subject,
-                                    _pack_arrays(kind, arrays, step)),
-            self._loop)
+            self._drt.publish_event(self._subject, msg), self._loop)
         fut.result(timeout=30.0)
 
     def install(self, engine) -> None:
         engine.step_tap = self.tap
+        engine.step_outcome_cb = self.note_outcome
 
 
 # ---------------------------------------------------------------- rank > 0
@@ -136,20 +151,30 @@ async def follow_steps(drt, subject: str, engine, *,
     if ready_event is not None:
         ready_event.set()
     consecutive_failures = 0
+    my_failed_steps: Dict[int, bool] = {}
     async for _subject, msg in sub:
+        # cross-check the leader's resolved outcomes against our own: a
+        # step WE failed that the LEADER completed means this rank's
+        # KV/pages state silently diverged — restart the group now rather
+        # than serve corrupt state (ADVICE r2). The reverse (leader failed,
+        # we succeeded) is benign: only the leader holds scheduler
+        # bookkeeping, device state advanced identically on all ranks.
+        for step_id, leader_ok in msg.get("outcomes", []):
+            if my_failed_steps.pop(step_id, False) and leader_ok:
+                raise RuntimeError(
+                    f"multihost divergence: leader completed step {step_id} "
+                    "but this rank failed it — restarting the group")
         arrays = _unpack_arrays(msg)
         try:
             await asyncio.to_thread(engine.execute_arrays, msg["kind"],
                                     arrays, msg["step"])
             consecutive_failures = 0
         except Exception:
-            # mirror the leader's per-step recovery (loop.py catches step
-            # exceptions, fails the victims, keeps serving): when a step
-            # raises on ALL ranks — the common case, it's one SPMD program —
-            # every rank logs and stays in lockstep for the next step.
-            # A rank-ASYMMETRIC failure (one rank can't even launch the
-            # program) wedges the group's collectives and is a
-            # restart-the-group condition, as in any SPMD world.
+            # when a step raises on ALL ranks — the common case, it's one
+            # SPMD program — every rank logs and stays in lockstep for the
+            # next step; the outcome cross-check above catches the
+            # asymmetric case one message later.
+            my_failed_steps[int(msg["step"])] = True
             consecutive_failures += 1
             if consecutive_failures >= 3:
                 # persistently failing rank (dead pages buffer, OOM): exit

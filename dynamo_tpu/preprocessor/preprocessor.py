@@ -106,9 +106,12 @@ class OpenAIPreprocessor:
         else:
             logprobs = req.logprobs
         if logprobs is not None:
-            # OpenAI caps top_logprobs at 20; the engine serves at most its
-            # compiled num_top_logprobs (default 8) — more is silently fewer
-            logprobs = min(logprobs, 20)
+            # OpenAI caps top_logprobs at 20; the serving engine computes
+            # exactly card.num_top_logprobs alternatives per token, so the
+            # accepted range is the min of the two — never silently fewer
+            # than the request asked for
+            engine_k = getattr(self.card, "num_top_logprobs", 20)
+            logprobs = min(logprobs, 20, engine_k)
         sampling = SamplingOptions(
             temperature=req.temperature,
             top_p=req.top_p,

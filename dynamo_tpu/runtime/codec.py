@@ -5,6 +5,15 @@ length><msgpack payload>``.  Parity in spirit with the reference's two-part
 codec (``lib/runtime/src/pipeline/network/codec/two_part.rs``): a frame is a
 msgpack map whose "header" fields (op, ids) and "payload" (bin) travel
 together; msgpack bin avoids a second length-prefix layer.
+
+TWO-PART frames carry bulk binary (KV block transfers) without msgpack
+re-copies: the u32 length has its high bit set, the msgpack part holds the
+metadata, and a ``<u32 raw length><raw bytes>`` trailer follows. The raw
+bytes are written straight from the source buffer (a numpy view — no
+``tobytes``/msgpack/concat copies on the send side) and surface on the
+receive side under the ``"_raw"`` key of the decoded map. This is the
+replacement for the reference codec's header+payload split that NIXL-bound
+block data rode (``block/transfer/nixl.rs``).
 """
 
 from __future__ import annotations
@@ -18,6 +27,22 @@ import msgpack
 MAX_FRAME = 512 * 1024 * 1024  # 512 MiB hard cap (KV block transfers ride this)
 
 _LEN = struct.Struct(">I")
+_RAW_BIT = 0x8000_0000
+
+
+class Raw:
+    """A stream item whose bulk bytes should ride a two-part frame.
+
+    Handlers yield ``Raw(meta_dict, buffer)``; the RPC layer sends the
+    metadata as the msgpack part and the buffer as the raw trailer. The
+    receiving side sees ``meta_dict`` with ``"_raw"`` holding the bytes.
+    """
+
+    __slots__ = ("obj", "raw")
+
+    def __init__(self, obj: Any, raw: Any):
+        self.obj = obj
+        self.raw = raw
 
 
 def pack(obj: Any) -> bytes:
@@ -29,30 +54,52 @@ def unpack(data: bytes) -> Any:
 
 
 async def read_frame(reader: asyncio.StreamReader) -> Optional[Any]:
-    """Read one frame; returns None on clean EOF."""
+    """Read one frame; returns None on clean EOF. A two-part frame's raw
+    trailer is attached to the decoded map as ``obj["_raw"]``."""
     try:
         hdr = await reader.readexactly(4)
     except (asyncio.IncompleteReadError, ConnectionResetError):
         return None
     (length,) = _LEN.unpack(hdr)
+    raw_follows = bool(length & _RAW_BIT)
+    length &= ~_RAW_BIT
     if length > MAX_FRAME:
         raise ValueError(f"frame length {length} exceeds cap {MAX_FRAME}")
     try:
         body = await reader.readexactly(length)
+        obj = unpack(body)
+        if raw_follows:
+            (raw_len,) = _LEN.unpack(await reader.readexactly(4))
+            if raw_len > MAX_FRAME:
+                raise ValueError(f"raw length {raw_len} exceeds cap")
+            obj["_raw"] = await reader.readexactly(raw_len)
     except (asyncio.IncompleteReadError, ConnectionResetError):
         return None
-    return unpack(body)
+    return obj
 
 
-def write_frame(writer: asyncio.StreamWriter, obj: Any) -> None:
-    """Queue one frame on the writer (call ``await writer.drain()`` for backpressure)."""
+def write_frame(writer: asyncio.StreamWriter, obj: Any,
+                raw: Optional[Any] = None) -> None:
+    """Queue one frame on the writer (call ``await writer.drain()`` for
+    backpressure). ``raw`` (bytes/memoryview/numpy buffer) rides as a
+    two-part trailer with zero intermediate copies on this side."""
     body = pack(obj)
-    writer.write(_LEN.pack(len(body)) + body)
+    if raw is None:
+        writer.write(_LEN.pack(len(body)))
+        writer.write(body)
+        return
+    view = memoryview(raw).cast("B")
+    writer.write(_LEN.pack(len(body) | _RAW_BIT))
+    writer.write(body)
+    writer.write(_LEN.pack(view.nbytes))
+    writer.write(view)
 
 
-async def send_frame(writer: asyncio.StreamWriter, obj: Any) -> None:
-    write_frame(writer, obj)
+async def send_frame(writer: asyncio.StreamWriter, obj: Any,
+                     raw: Optional[Any] = None) -> None:
+    write_frame(writer, obj, raw)
     await writer.drain()
 
 
-__all__ = ["pack", "unpack", "read_frame", "write_frame", "send_frame", "MAX_FRAME"]
+__all__ = ["pack", "unpack", "read_frame", "write_frame", "send_frame",
+           "MAX_FRAME", "Raw"]

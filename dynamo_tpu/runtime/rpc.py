@@ -27,7 +27,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, AsyncIterator, Awaitable, Callable, Dict, Optional
 
-from dynamo_tpu.runtime.codec import read_frame, send_frame
+from dynamo_tpu.runtime.codec import Raw, read_frame, send_frame
 from dynamo_tpu.utils.aio import reap_task
 
 logger = logging.getLogger(__name__)
@@ -137,9 +137,9 @@ class RpcServer:
         stream_tasks: Dict[int, asyncio.Task] = {}
         self._conn_writers.add(writer)
 
-        async def send(obj: Any) -> None:
+        async def send(obj: Any, raw: Any = None) -> None:
             async with wlock:
-                await send_frame(writer, obj)
+                await send_frame(writer, obj, raw)
 
         try:
             while True:
@@ -213,7 +213,13 @@ class RpcServer:
                 if ctx.cancelled:
                     await agen.aclose()
                     break
-                await send({"op": "data", "sid": sid, "payload": item})
+                if isinstance(item, Raw):
+                    # bulk binary (KV blocks): metadata in the msgpack part,
+                    # bytes as a zero-copy two-part trailer
+                    await send({"op": "data", "sid": sid,
+                                "payload": item.obj}, raw=item.raw)
+                else:
+                    await send({"op": "data", "sid": sid, "payload": item})
             await send({"op": "final", "sid": sid})
         except asyncio.CancelledError:
             # caller cancelled (or server stopping): nothing more to send; the
@@ -322,7 +328,12 @@ class RpcConnection:
                 if stream is None:
                     continue
                 if op == "data":
-                    stream.queue.put_nowait(("data", frame.get("payload")))
+                    payload = frame.get("payload")
+                    if "_raw" in frame and isinstance(payload, dict):
+                        # two-part frame: surface the raw trailer inside the
+                        # payload the handler yielded it with
+                        payload["_raw"] = frame["_raw"]
+                    stream.queue.put_nowait(("data", payload))
                 elif op == "final":
                     stream.queue.put_nowait(("final", None))
                 elif op == "err":

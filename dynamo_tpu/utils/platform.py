@@ -38,4 +38,29 @@ def force_cpu_platform(n_devices: int | None = None) -> int:
     return len(jax.devices())
 
 
-__all__ = ["force_cpu_platform"]
+def enable_compilation_cache(cache_dir: str | None = None) -> str:
+    """Turn on jax's persistent compilation cache so repeated processes
+    (bench children, restarted workers) skip recompiles of identical step
+    programs. On a tunneled single chip a cold serving-config compile is
+    minutes; a warm cache load is seconds (VERDICT r2 item 3).
+
+    Returns the cache directory used. Safe to call before or after backend
+    init; also exports ``JAX_COMPILATION_CACHE_DIR`` so child processes
+    inherit the same cache."""
+    cache_dir = (cache_dir
+                 or os.environ.get("JAX_COMPILATION_CACHE_DIR")
+                 or os.path.expanduser("~/.cache/dynamo_tpu/jax_cache"))
+    os.makedirs(cache_dir, exist_ok=True)
+    os.environ["JAX_COMPILATION_CACHE_DIR"] = cache_dir
+
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    # default thresholds skip small/fast programs; we want every serving
+    # step program cached, including the tiny test shapes
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+    return cache_dir
+
+
+__all__ = ["force_cpu_platform", "enable_compilation_cache"]

@@ -23,7 +23,11 @@ import logging
 from typing import Any, AsyncIterator, Optional
 
 from dynamo_tpu.engine.jax_engine import JaxEngine
-from dynamo_tpu.engine.transfer import BlockPayload, inject_blocks
+from dynamo_tpu.engine.transfer import (
+    BlockPayload,
+    inject_blocks,
+    inject_frame,
+)
 from dynamo_tpu.protocols.common import LLMEngineOutput, PreprocessedRequest
 from dynamo_tpu.runtime.push_router import PushRouter, RouterMode
 from dynamo_tpu.runtime.runtime import DistributedRuntime
@@ -134,15 +138,25 @@ class DisaggDecodeHandler:
             hashes = [b[0] for b in params.get("blocks", [])]
             if hashes:
                 kv_stream = await self._kv_client.direct(
-                    {"block_hashes": hashes}, iid)
-                blocks = []
+                    {"block_hashes": hashes, "wire": 2}, iid)
+                # batched two-part frames: inject frame k while frame k+1
+                # is still in flight (pipelined, zero msgpack re-copies)
+                injected = total = 0
+                legacy: list = []
                 async for frame in kv_stream:
-                    blocks.append(BlockPayload.from_wire(frame))
-                if blocks:
-                    n = await self.engine.run_exclusive(
-                        inject_blocks, self.engine, blocks)
+                    if "_raw" in frame:
+                        total += len(frame["blocks"])
+                        injected += await self.engine.run_exclusive(
+                            inject_frame, self.engine, frame)
+                    else:  # pre-batched single-block schema
+                        legacy.append(BlockPayload.from_wire(frame))
+                if legacy:
+                    total += len(legacy)
+                    injected += await self.engine.run_exclusive(
+                        inject_blocks, self.engine, legacy)
+                if total:
                     logger.debug("injected %d/%d transferred blocks",
-                                 n, len(blocks))
+                                 injected, total)
             return final
         except Exception as e:  # noqa: BLE001 — disagg must never fail a
             # request: any remote-leg error (connection, malformed frame,

@@ -152,6 +152,7 @@ async def amain(args: argparse.Namespace) -> None:
     card = ModelDeploymentCard.from_local_path(args.model_path,
                                                name=args.model_name)
     card.kv_cache_block_size = args.page_size
+    card.num_top_logprobs = args.num_top_logprobs
     endpoint = (drt.namespace(args.namespace).component(args.component)
                 .endpoint(args.endpoint))
     engine = build_engine(args)

@@ -413,3 +413,64 @@ class TestJaxEngine:
             assert m.kv_stats.kv_total_blocks == 63
         finally:
             await eng.stop()
+
+
+class TestPipelinedDecode:
+    """Chained decode (step N+1 consumes step N's on-device token) must be
+    token-for-token identical to step-at-a-time execution under greedy
+    sampling, across staggered stream ends and prefix-cache revives."""
+
+    async def _run(self, pipeline: bool):
+        eng = tiny_engine(pipeline_decode=pipeline)
+        try:
+            reqs = []
+            for i, n in enumerate((3, 7, 12)):
+                r = make_req([i + 1, i + 2, i + 3, i + 4, i + 5],
+                             f"p{i}", max_tokens=n)
+                r.eos_token_ids = []
+                reqs.append(r)
+            results = await asyncio.gather(*[collect(eng, r) for r in reqs])
+            toks = [[t for f in frames for t in f.token_ids]
+                    for frames in results]
+            return toks, eng.chained_steps
+        finally:
+            await eng.stop()
+
+    async def test_equivalence_and_chaining_happened(self):
+        toks_on, chained = await self._run(True)
+        toks_off, chained_off = await self._run(False)
+        assert toks_on == toks_off
+        assert [len(t) for t in toks_on] == [3, 7, 12]
+        assert chained > 0          # the pipelined run actually chained
+        assert chained_off == 0
+
+    async def test_chained_page_growth_across_boundary(self):
+        # page_size=4: decode crosses page boundaries repeatedly while
+        # chained, exercising the +1 lookahead growth in plan_chained
+        eng = tiny_engine(pipeline_decode=True, num_pages=32)
+        try:
+            r = make_req([1, 2, 3], "g", max_tokens=21)
+            r.eos_token_ids = []
+            frames = await collect(eng, r)
+            toks = [t for f in frames for t in f.token_ids]
+            assert len(toks) == 21
+            assert frames[-1].finish_reason == FinishReason.LENGTH
+            assert eng.chained_steps > 10
+        finally:
+            await eng.stop()
+
+    async def test_exclusive_work_flushes_pending(self):
+        # run_exclusive while a chained stream is mid-flight: the loop must
+        # flush the pending step before running the exclusive fn
+        eng = tiny_engine(pipeline_decode=True)
+        try:
+            r = make_req([9, 8, 7], "x", max_tokens=16)
+            r.eos_token_ids = []
+            task = asyncio.ensure_future(collect(eng, r))
+            await asyncio.sleep(0.2)
+            seen = await eng.run_exclusive(lambda e: e.allocator.num_free, eng)
+            assert isinstance(seen, int)
+            frames = await task
+            assert len([t for f in frames for t in f.token_ids]) == 16
+        finally:
+            await eng.stop()

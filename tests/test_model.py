@@ -233,3 +233,69 @@ class TestPallasDecode:
                         reason="needs a real TPU")
     def test_kernel_native_matches_xla_path(self):
         self._run(interpret=False)
+
+
+class TestBlockwisePrefillAttention:
+    """The chunked online-softmax prefill path must match the direct
+    full-gather path bit-for-bit up to f32 reduction order."""
+
+    def _mk(self, B, S, P, Hq, Hkv, ps, Dh, dtype, seed=0):
+        k1, k2 = jax.random.split(jax.random.PRNGKey(seed))
+        kv = jax.random.normal(k1, (1 + B * P, 2, Hkv, ps, Dh)).astype(dtype)
+        q = jax.random.normal(k2, (B, S, Hq, Dh)).astype(dtype)
+        table = jnp.arange(1, 1 + B * P, dtype=jnp.int32).reshape(B, P)
+        return q, kv, table
+
+    def test_matches_direct_path(self):
+        from dynamo_tpu.ops import attention as A
+        # P=24 > PAGES_PER_CHUNK so the blockwise path triggers; the direct
+        # reference is computed by calling the internals explicitly
+        B, S, P, Hq, Hkv, ps, Dh = 3, 16, 24, 4, 2, 8, 32
+        q, kv, table = self._mk(B, S, P, Hq, Hkv, ps, Dh, jnp.float32)
+        # mixed contexts: a fresh prompt, a prefix-hit continuation, a
+        # mid-table context; plus padded rows of tokens beyond new_lens
+        start = jnp.array([0, 64, 5], jnp.int32)
+        new = jnp.array([16, 16, 9], jnp.int32)
+        positions = start[:, None] + jnp.arange(S)[None, :]
+        total = start + new
+        out = A.paged_attention_layer(q, kv, table, positions, total, 0.17)
+        # direct reference
+        g = kv[table]
+        k = A._gathered_to_bhtd(g[:, :, 0])
+        v = A._gathered_to_bhtd(g[:, :, 1])
+        qg = q.reshape(B, S, Hkv, Hq // Hkv, Dh)
+        ref = A._attend(qg, k, v, positions, total, 0.17)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_stacked_path_matches(self):
+        from dynamo_tpu.ops import attention as A
+        B, S, P, Hq, Hkv, ps, Dh = 2, 8, 16, 4, 2, 4, 16
+        L = 3
+        k1, k2 = jax.random.split(jax.random.PRNGKey(3))
+        pages = jax.random.normal(
+            k1, (L, 1 + B * P, 2, Hkv, ps, Dh)).astype(jnp.float32)
+        q = jax.random.normal(k2, (B, S, Hq, Dh)).astype(jnp.float32)
+        table = jnp.arange(1, 1 + B * P, dtype=jnp.int32).reshape(B, P)
+        positions = jnp.tile(jnp.arange(S)[None], (B, 1)) + 20
+        total = jnp.array([28, 23], jnp.int32)
+        out = A.paged_attention(q, pages, 1, table, positions, total, 0.2)
+        ref = A.paged_attention_layer(q, pages[1], table, positions, total,
+                                      0.2)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_table_not_multiple_of_chunk(self):
+        from dynamo_tpu.ops import attention as A
+        B, S, P, Hq, Hkv, ps, Dh = 2, 4, 11, 2, 1, 4, 16
+        q, kv, table = self._mk(B, S, P, Hq, Hkv, ps, Dh, jnp.float32, seed=7)
+        positions = jnp.tile(jnp.arange(S)[None], (B, 1))
+        total = jnp.array([4, 3], jnp.int32)
+        out = A.paged_attention_layer(q, kv, table, positions, total, 0.3)
+        g = kv[table]
+        k = A._gathered_to_bhtd(g[:, :, 0])
+        v = A._gathered_to_bhtd(g[:, :, 1])
+        qg = q.reshape(B, S, Hkv, Hq // Hkv, Dh)
+        ref = A._attend(qg, k, v, positions, total, 0.3)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)

@@ -128,6 +128,47 @@ class TestRingScheduling:
         assert plan4.chunks[0].length == 30
 
 
+    def test_ring_admission_cap(self):
+        """ADVICE r2 (medium): a burst of long prompts must not all be
+        admitted at once — each ring-eligible admission pins its whole
+        prompt's pages while ring steps run one at a time. Admissions stop
+        at max_ring_seqs; the rest stay WAITING (pages unpinned)."""
+        from dynamo_tpu.engine.pages import PageAllocator
+        from dynamo_tpu.engine.scheduler import (
+            Phase, PrefillBatch, Scheduler, SchedulerConfig)
+
+        alloc = PageAllocator(num_pages=256, page_size=4)
+        sched = Scheduler(alloc, SchedulerConfig(
+            max_num_seqs=8, max_prefill_chunk=8, max_prefill_seqs=4,
+            ring_threshold=16, max_ring_seqs=2))
+        for i in range(5):  # five distinct 30-token prompts (a shared
+            # prefix would make later ones prefix-hit, hence chunk-eligible)
+            sched.add_request(
+                make_req(list(range(100 * i + 1, 100 * i + 31)), f"L{i}"))
+        plan = sched.schedule()
+        assert isinstance(plan, PrefillBatch) and plan.ring
+        # only max_ring_seqs admitted; the other three hold no pages
+        assert len(sched.active) == 2
+        assert len(sched.waiting) == 3
+        assert all(not s.page_ids for s in sched.waiting)
+        # a short prompt behind the long ones must also wait (FIFO)
+        sched.add_request(make_req([1, 2, 3], "short"))
+        sched.on_step_done(plan)
+        plan.chunks[0].seq.tokens.append(9)
+        plan.chunks[0].seq.generated.append(9)
+        plan2 = sched.schedule()  # alternation: L0 decodes first
+        from dynamo_tpu.engine.scheduler import DecodeBatch
+        assert isinstance(plan2, DecodeBatch)
+        for s in plan2.seqs:
+            s.tokens.append(9)
+        sched.on_step_done(plan2)
+        plan3 = sched.schedule()
+        assert isinstance(plan3, PrefillBatch) and plan3.ring
+        # L1 went ring; L2 was admitted into the freed ring slot, but the
+        # short prompt is still queued behind L3/L4
+        assert len(sched.waiting) == 3
+
+
 class TestRingServing:
     async def test_long_prompt_rides_ring_then_decodes(self):
         cfg = ModelConfig.tiny()

@@ -1,0 +1,78 @@
+"""Prefill attention microbench: blockwise vs direct path on the real chip.
+
+Measures one full prefill step ([B, S] chunk batch) of the Llama-3.2-3B
+geometry at the flagship bench shape, for both attention paths, plus the
+compile time of each. Run on the TPU (no JAX_PLATFORMS override).
+
+Usage: python tools/prefill_microbench.py [--direct] [--seqs 8 --prompt 512]
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--direct", action="store_true",
+                   help="force the old full-gather path")
+    p.add_argument("--seqs", type=int, default=8)
+    p.add_argument("--prompt", type=int, default=512)
+    p.add_argument("--ctx", type=int, default=704)
+    p.add_argument("--reps", type=int, default=5)
+    args = p.parse_args()
+
+    from dynamo_tpu.ops import attention as A
+    if args.direct:
+        # disable the blockwise dispatch by raising the chunk threshold
+        A.PAGES_PER_CHUNK = 10**9
+
+    from dynamo_tpu.models.config import ModelConfig
+    from dynamo_tpu.models import llama
+
+    cfg = ModelConfig.llama32_3b()
+    B, S = args.seqs, args.prompt
+    ps = 16
+    P = args.ctx // ps
+    num_pages = B * P + 8
+
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    pages = llama.make_pages_list(cfg, num_pages, ps)
+
+    toks = jnp.asarray(np.random.default_rng(0).integers(
+        1, cfg.vocab_size, size=(B, S)), jnp.int32)
+    pos = jnp.tile(jnp.arange(S, dtype=jnp.int32)[None], (B, 1))
+    table = jnp.asarray(
+        np.arange(1, 1 + B * P, dtype=np.int32).reshape(B, P))
+    total = jnp.full((B,), S, jnp.int32)
+    new = jnp.full((B,), S, jnp.int32)
+
+    fwd = jax.jit(
+        lambda prm, pg: llama.forward_unrolled(
+            prm, cfg, toks, pos, pg, table, total, new),
+        donate_argnums=(1,))
+
+    t0 = time.perf_counter()
+    logits, pages = fwd(params, pages)
+    jax.block_until_ready(logits)
+    compile_s = time.perf_counter() - t0
+    print(f"compile+first: {compile_s:.1f}s")
+
+    t0 = time.perf_counter()
+    for _ in range(args.reps):
+        logits, pages = fwd(params, pages)
+    jax.block_until_ready(logits)
+    dt = (time.perf_counter() - t0) / args.reps
+    toks_per_step = B * S
+    print(f"path={'direct' if args.direct else 'blockwise'} "
+          f"[{B},{S}] step {dt * 1e3:.1f} ms -> "
+          f"{toks_per_step / dt:.0f} prefill tok/s")
+
+
+if __name__ == "__main__":
+    main()
