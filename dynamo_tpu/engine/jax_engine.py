@@ -281,13 +281,7 @@ class JaxEngine(ScheduledEngineBase):
                 if so.top_p is not None:
                     top_p[i] = so.top_p
         else:
-            arrays = self._decode_arrays(plan.seqs, chained=False)
-            plan._step_id = self._step_counter
-            if self.step_tap is not None:
-                self.step_tap("step", arrays, self._step_counter)
-            out = self.execute_arrays("step", arrays, self._step_counter)
-            self._step_counter += 1
-            return out
+            return self.fetch_packed(self.dispatch_decode(plan))
         kind = "step"
         if plan.ring:
             kind = "ring"

@@ -302,9 +302,13 @@ class Scheduler:
                 self.reaped.append(self.waiting.popleft())
             if (rt is not None and self.waiting
                     and len(self.waiting[0]) > rt
-                    and n_ring >= self.cfg.max_ring_seqs):
-                # head would (likely) take the ring path; hold it — FIFO
-                # order forbids skipping ahead to shorter prompts
+                    and n_ring >= self.cfg.max_ring_seqs
+                    and not self.alloc.peek_prefix(
+                        self.waiting[0].tokens.block_hashes())):
+                # head would take the ring path (long AND no resident
+                # prefix — a prefix-hit long prompt goes chunked and needs
+                # no ring slot); hold it — FIFO order forbids skipping
+                # ahead to shorter prompts
                 break
             seq = self._try_admit()
             if seq is None:

@@ -19,6 +19,7 @@ indistinguishable from having computed the prefix locally.
 
 from __future__ import annotations
 
+import asyncio
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -295,6 +296,23 @@ def inject_frame(engine: JaxEngine, meta: Dict[str, Any]) -> int:
     return _inject_data(engine, metas, np.moveaxis(arr, 0, 1))
 
 
+def serve_kv_export_bulk(engine: JaxEngine, loop):
+    """Bulk-plane handler (``runtime/bulk.py``): synchronous, runs in the
+    bulk connection's thread, coordinates with the engine loop via
+    ``run_coroutine_threadsafe`` so the gather still happens inside an
+    exclusive window. Yields (meta, buffer) pairs in the same schema as
+    ``export_frames``."""
+
+    def handler(payload):
+        hashes = list((payload or {}).get("block_hashes", []))
+        fut = asyncio.run_coroutine_threadsafe(
+            engine.run_exclusive(export_frames, engine, hashes), loop)
+        for f in fut.result(timeout=120.0):
+            yield f.obj, f.raw
+
+    return handler
+
+
 def serve_kv_export(engine: JaxEngine):
     """RPC handler factory: serves block fetches for disagg decode workers.
 
@@ -325,4 +343,4 @@ def serve_kv_export(engine: JaxEngine):
 
 __all__ = ["BlockPayload", "export_blocks", "inject_blocks",
            "export_frames", "inject_frame", "transfer_blocks_ici",
-           "serve_kv_export", "BLOCKS_PER_FRAME"]
+           "serve_kv_export", "serve_kv_export_bulk", "BLOCKS_PER_FRAME"]

@@ -75,6 +75,9 @@ class TieredEngine(EngineBase):
         self._spills: "queue.Queue" = queue.Queue(
             maxsize=self.cfg.max_pending_spills)
         self._spill_thread: Optional[threading.Thread] = None
+        self._peer_client = None          # G4 (enable_peer_fetch)
+        self._self_instance_id = -1
+        self.peer_onboarded = 0
         engine.allocator.on_evict = self._on_evict
 
     # -- offload (G1 -> G2 -> G3) -----------------------------------------
@@ -192,6 +195,55 @@ class TieredEngine(EngineBase):
         self.onboarded += n
         return n
 
+    # -- G4: cross-worker peer tier ---------------------------------------
+
+    def enable_peer_fetch(self, kv_client, self_instance_id: int) -> None:
+        """Turn on the G4 remote tier: on a local tier miss, fetch the
+        missing chain from a peer worker's ``kv_export`` endpoint (content
+        addressing makes any peer's copy byte-identical). Reference:
+        ``CacheLevel::G4`` + distributed leader/worker,
+        ``block_manager.rs:67-81``, ``block_manager/distributed/``."""
+        self._peer_client = kv_client
+        self._self_instance_id = self_instance_id
+        self.peer_onboarded = 0
+
+    async def _onboard_from_peers(self, token_ids: List[int]) -> int:
+        """Fetch the first-missing chain suffix from any live peer."""
+        from dynamo_tpu.engine.transfer import inject_frame
+
+        page_size = self.engine.allocator.page_size
+        hashes = compute_block_hash_for_seq(token_ids, page_size)
+        hashes = hashes[:self.cfg.max_onboard_blocks]
+        resident = self.engine.allocator._by_hash
+        with self._tier_lock:
+            missing_from = next(
+                (i for i, h in enumerate(hashes)
+                 if h not in resident and self.host.get(h) is None
+                 and (self.disk is None or self.disk.get(h) is None)),
+                None)
+        if missing_from is None:
+            return 0
+        want = hashes[missing_from:]
+        injected = 0
+        for iid in self._peer_client.instance_ids():
+            if iid == self._self_instance_id:
+                continue
+            try:
+                stream = await self._peer_client.direct(
+                    {"block_hashes": want, "wire": 2}, iid)
+                async for frame in stream:
+                    if "_raw" not in frame:
+                        continue
+                    injected += await self.engine.run_exclusive(
+                        inject_frame, self.engine, frame)
+            except Exception as e:  # noqa: BLE001 — peers are best-effort
+                logger.debug("G4 peer %x fetch failed: %s", iid, e)
+                continue
+            if injected:
+                break  # content-addressed: any one peer's copy suffices
+        self.peer_onboarded += injected
+        return injected
+
     # -- EngineBase --------------------------------------------------------
 
     async def generate(self, request: PreprocessedRequest,
@@ -201,6 +253,11 @@ class TieredEngine(EngineBase):
             # engine.pages, which is donated through every step
             await self.engine.run_exclusive(
                 self._onboard_for, request.token_ids)
+            if self._peer_client is not None:
+                try:
+                    await self._onboard_from_peers(request.token_ids)
+                except Exception:  # noqa: BLE001 — G4 must never fail a req
+                    logger.exception("G4 peer onboard failed")
         async for out in self.engine.generate(request, ctx):
             yield out
 
@@ -224,6 +281,7 @@ class TieredEngine(EngineBase):
                 "kvbm_host_blocks": len(self.host),
                 "kvbm_host_bytes": self.host.used,
                 "kvbm_pending_spills": self._spills.qsize(),
+                "kvbm_peer_onboarded_blocks": self.peer_onboarded,
             }
             if self.disk is not None:
                 out["kvbm_disk_blocks"] = len(self.disk)
@@ -231,4 +289,45 @@ class TieredEngine(EngineBase):
         return out
 
 
-__all__ = ["TieredEngine", "TieredKvConfig"]
+def serve_tiered_kv_export(tiered: TieredEngine):
+    """RPC handler: like ``transfer.serve_kv_export`` but also serves
+    blocks held only in this worker's G2/G3 tiers — the provider side of
+    the G4 remote tier (peers fetch what fell out of our HBM)."""
+    from dynamo_tpu.engine.transfer import (
+        BLOCKS_PER_FRAME, export_blocks)
+    from dynamo_tpu.runtime.codec import Raw
+
+    def _collect(hashes: List[int]) -> List[BlockPayload]:
+        # HBM-resident prefix first (device gather), then continue the
+        # chain from the tiers; stop at the first total miss
+        blocks = export_blocks(tiered.engine, hashes)
+        with tiered._tier_lock:
+            for h in hashes[len(blocks):]:
+                blk = tiered._lookup(h)
+                if blk is None:
+                    break
+                blocks.append(blk)
+        return blocks
+
+    async def handler(payload, ctx):
+        hashes = list((payload or {}).get("block_hashes", []))
+        blocks = await tiered.engine.run_exclusive(_collect, hashes)
+        if int((payload or {}).get("wire", 1)) >= 2:
+            for i in range(0, len(blocks), BLOCKS_PER_FRAME):
+                chunk = blocks[i:i + BLOCKS_PER_FRAME]
+                data = np.ascontiguousarray(
+                    np.stack([b.data for b in chunk], axis=0))
+                yield Raw({
+                    "blocks": [[b.block_hash, b.local_hash, b.parent_hash]
+                               for b in chunk],
+                    "dtype": str(data.dtype),
+                    "block_shape": list(data.shape[1:]),
+                }, data)
+        else:
+            for b in blocks:
+                yield b.to_wire()
+
+    return handler
+
+
+__all__ = ["TieredEngine", "TieredKvConfig", "serve_tiered_kv_export"]

@@ -71,7 +71,10 @@ class LocalConnector:
                 proc.kill()
             logger.info("stopped %s worker pid=%d", role, proc.pid)
 
-    async def scale(self, prefill: int, decode: int) -> None:
+    async def scale(self, prefill: int, decode: int,
+                    prefill_config=None, decode_config=None) -> None:
+        # process connector: parallelism config changes need a relaunch
+        # with different flags; counts-only here
         self._reap()
         for role, want in (("prefill", prefill), ("decode", decode)):
             have = len(self._fleets[role])
@@ -92,10 +95,16 @@ class KvConnector:
         self.drt = drt
         self.namespace = namespace
 
-    async def scale(self, prefill: int, decode: int) -> None:
+    async def scale(self, prefill: int, decode: int,
+                    prefill_config=None, decode_config=None) -> None:
+        desired = {"prefill": prefill, "decode": decode}
+        if prefill_config:
+            desired["prefill_config"] = prefill_config
+        if decode_config:
+            desired["decode_config"] = decode_config
         await self.drt.coord.put(
             planner_desired_key(self.namespace),
-            json.dumps({"prefill": prefill, "decode": decode}).encode())
+            json.dumps(desired).encode())
 
 
 __all__ = ["LocalConnector", "KvConnector", "planner_desired_key"]

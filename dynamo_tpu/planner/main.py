@@ -44,7 +44,9 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 async def amain(args: argparse.Namespace) -> None:
-    interp = PerfInterpolator.from_file(args.profile)
+    from dynamo_tpu.planner.perf_interpolation import MultiPerfInterpolator
+    # handles both flat and parallelism-sweep profile schemas
+    interp = MultiPerfInterpolator.from_file(args.profile)
     source = PrometheusSource(args.metrics_url)
     if args.connector == "local":
         if not args.prefill_cmd or not args.decode_cmd:
@@ -52,9 +54,12 @@ async def amain(args: argparse.Namespace) -> None:
         connector = LocalConnector(shlex.split(args.prefill_cmd),
                                    shlex.split(args.decode_cmd))
     else:
+        from dynamo_tpu.planner.metrics_source import QueueAwareSource
         from dynamo_tpu.runtime.runtime import DistributedRuntime
         drt = await DistributedRuntime.create(coordinator=args.coordinator)
         connector = KvConnector(drt, args.namespace)
+        # prefill-queue backlog rides the same coordinator connection
+        source = QueueAwareSource(source, drt, args.namespace)
     planner = Planner(
         PlannerConfig(interval_s=args.interval, predictor=args.predictor,
                       min_prefill=args.min_prefill,

@@ -92,4 +92,28 @@ class PrometheusSource:
         )
 
 
-__all__ = ["PrometheusSource"]
+class QueueAwareSource:
+    """Wraps a MetricsSource, adding the coordinator prefill-queue depth
+    (the planner's direct backlog signal — reference: JetStream consumer
+    lag on the prefill queue)."""
+
+    def __init__(self, inner, drt, namespace: str):
+        self.inner = inner
+        self.drt = drt
+        self.namespace = namespace
+
+    async def sample(self) -> Optional[TrafficSample]:
+        s = await self.inner.sample()
+        if s is None:
+            return None
+        try:
+            from dynamo_tpu.worker.disagg import prefill_queue_name
+            depth, _pullers = await self.drt.coord.queue_depth(
+                prefill_queue_name(self.namespace))
+            s.prefill_queue_depth = depth
+        except Exception as e:  # noqa: BLE001 — depth is best-effort
+            logger.debug("queue depth probe failed: %s", e)
+        return s
+
+
+__all__ = ["PrometheusSource", "QueueAwareSource"]

@@ -43,7 +43,13 @@ class PerfInterpolator:
     @classmethod
     def from_file(cls, path: str) -> "PerfInterpolator":
         with open(path) as f:
-            return cls(json.load(f))
+            profile = json.load(f)
+        if "configs" in profile:
+            # multi-config (parallelism sweep) profile: callers that only
+            # want one surface get the first config; the planner loads the
+            # full set via MultiPerfInterpolator
+            profile = profile["configs"][0]
+        return cls(profile)
 
     # -- prefill -----------------------------------------------------------
 
@@ -70,4 +76,37 @@ class PerfInterpolator:
         return float(best)
 
 
-__all__ = ["PerfInterpolator"]
+class MultiPerfInterpolator:
+    """Per-parallelism-config interpolators (``profile_parallelism_sweep``
+    output). The planner evaluates every option and picks the config whose
+    CHIP cost (replicas x chips-per-replica) is lowest for the predicted
+    load — the reference ``profile_sla`` TP-sweep consumption pattern.
+    """
+
+    def __init__(self, profile: Dict[str, Any]):
+        configs = profile.get("configs")
+        if not configs:
+            # flat single-config profile: one option, 1 chip
+            configs = [{"tp": 1, "sp": 1, "chips": 1,
+                        "prefill": profile["prefill"],
+                        "decode": profile["decode"]}]
+        self.options: List[Dict[str, Any]] = []
+        for c in configs:
+            self.options.append({
+                "tp": int(c.get("tp", 1)), "sp": int(c.get("sp", 1)),
+                "chips": int(c.get("chips",
+                                   c.get("tp", 1) * c.get("sp", 1))),
+                "interp": PerfInterpolator(c),
+            })
+
+    @classmethod
+    def from_file(cls, path: str) -> "MultiPerfInterpolator":
+        with open(path) as f:
+            return cls(json.load(f))
+
+    @property
+    def is_multi(self) -> bool:
+        return len(self.options) > 1
+
+
+__all__ = ["PerfInterpolator", "MultiPerfInterpolator"]

@@ -210,6 +210,78 @@ def _build_jax(args) -> object:
     return JaxEngine(cfg, load_hf_params(cfg, args.model_path), ecfg)
 
 
+async def profile_parallelism_sweep(args) -> Dict:
+    """Sweep (tp, sp) engine configs, one full profile each — the reference
+    ``profile_sla.py`` behavior of sweeping TP sizes for prefill/decode so
+    the planner can pick a CONFIG, not just a count (VERDICT r2 item 8).
+
+    Runs each config on a slice of the available devices (virtual CPU mesh
+    in tests/dry-runs, real chips on hardware). Output schema:
+
+      {"configs": [{"tp": T, "sp": S, "chips": T*S,
+                    "prefill": [...], "decode": [...]}, ...],
+       "meta": {...}}
+    """
+    import jax
+
+    from dynamo_tpu.engine.jax_engine import JaxEngine, JaxEngineConfig
+    from dynamo_tpu.models.config import ModelConfig
+    from dynamo_tpu.parallel.mesh import MeshSpec, make_mesh
+    from dynamo_tpu.parallel.sharding import ModelSharding
+
+    if args.model_path:
+        from dynamo_tpu.models.hub import resolve_model_path
+        args.model_path = resolve_model_path(args.model_path)
+        cfg = ModelConfig.from_pretrained(args.model_path, dtype=args.dtype)
+    else:
+        cfg = ModelConfig.tiny(dtype="float32")
+    configs = []
+    for tp, sp in args.sweep:
+        n = tp * sp
+        if n > len(jax.devices()):
+            print(f"profile: skipping tp={tp} sp={sp} "
+                  f"(needs {n} devices, have {len(jax.devices())})")
+            continue
+        ecfg = JaxEngineConfig(
+            num_pages=args.num_pages, page_size=args.page_size,
+            max_num_seqs=max(args.concurrency),
+            max_prefill_chunk=args.max_prefill_chunk,
+            max_context=min(max(2 * max(args.isl), 4096),
+                            cfg.max_position_embeddings))
+        if n > 1:
+            mesh = make_mesh(MeshSpec(tp=tp, sp=sp),
+                             devices=jax.devices()[:n])
+            shard = ModelSharding(cfg, mesh)
+            ecfg.shard_params_fn = shard.shard_params
+            ecfg.shard_pages_fn = shard.shard_pages
+            ecfg.mesh = mesh
+        engine = JaxEngine.random_init(cfg, ecfg)
+        try:
+            prof = await profile_engine(
+                engine, isls=args.isl, concurrencies=args.concurrency,
+                osl=args.osl, vocab=cfg.vocab_size,
+                meta={"tp": tp, "sp": sp})
+        finally:
+            await engine.stop()
+        configs.append({"tp": tp, "sp": sp, "chips": n,
+                        "prefill": prof["prefill"],
+                        "decode": prof["decode"]})
+        print(f"profile: tp={tp} sp={sp} done "
+              f"({len(prof['prefill'])}+{len(prof['decode'])} rows)")
+    return {"configs": configs,
+            "meta": {"engine": "jax", "model": args.model_path,
+                     "osl": args.osl}}
+
+
+def _parse_sweep(s: str) -> List:
+    """'1,1;2,1;4,1' -> [(1,1), (2,1), (4,1)] as (tp, sp)."""
+    out = []
+    for part in s.split(";"):
+        tp, sp = part.split(",")
+        out.append((int(tp), int(sp)))
+    return out
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         description="pre-deployment engine profiler (profile_sla analog)")
@@ -229,10 +301,20 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--model-path", default=None, help="jax engine only")
     p.add_argument("--random-weights", action="store_true")
     p.add_argument("--dtype", default="bfloat16")
+    p.add_argument("--sweep", type=_parse_sweep, default=None,
+                   metavar="TP,SP;TP,SP;...",
+                   help="sweep parallelism configs (jax engine; random "
+                        "weights): one profile per (tp, sp), planner picks "
+                        "the config")
     return p
 
 
 async def amain(args) -> Dict:
+    if getattr(args, "sweep", None):
+        profile = await profile_parallelism_sweep(args)
+        with open(args.output, "w") as f:
+            json.dump(profile, f, indent=1)
+        return profile
     if args.engine == "jax":
         if args.model_path is None:
             raise SystemExit("--model-path required for --engine jax")
@@ -261,6 +343,10 @@ def main() -> None:
                              "constants for this profile")
     args = parser.parse_args()
     profile = asyncio.run(amain(args))
+    if "configs" in profile:
+        print(f"profile written to {args.output}: "
+              f"{len(profile['configs'])} parallelism configs")
+        return
     print(f"profile written to {args.output}: "
           f"{len(profile['prefill'])} prefill rows, "
           f"{len(profile['decode'])} decode rows")
@@ -274,4 +360,4 @@ if __name__ == "__main__":
 
 
 __all__ = ["profile_engine", "profile_prefill", "profile_decode",
-           "calibrate_mock_args"]
+           "profile_parallelism_sweep", "calibrate_mock_args"]

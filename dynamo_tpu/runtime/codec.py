@@ -45,6 +45,19 @@ class Raw:
         self.raw = raw
 
 
+def byte_view(raw: Any) -> memoryview:
+    """Flat byte view of any buffer, including numpy arrays of extension
+    dtypes (bfloat16 etc.) that reject the buffer protocol — those are
+    reinterpreted as uint8 first (zero-copy for contiguous arrays)."""
+    try:
+        return memoryview(raw).cast("B")
+    except (TypeError, ValueError):
+        import numpy as np
+
+        arr = np.ascontiguousarray(np.asarray(raw))
+        return memoryview(arr.view(np.uint8)).cast("B")
+
+
 def pack(obj: Any) -> bytes:
     return msgpack.packb(obj, use_bin_type=True)
 
@@ -88,7 +101,7 @@ def write_frame(writer: asyncio.StreamWriter, obj: Any,
         writer.write(_LEN.pack(len(body)))
         writer.write(body)
         return
-    view = memoryview(raw).cast("B")
+    view = byte_view(raw)
     writer.write(_LEN.pack(len(body) | _RAW_BIT))
     writer.write(body)
     writer.write(_LEN.pack(view.nbytes))
@@ -102,4 +115,4 @@ async def send_frame(writer: asyncio.StreamWriter, obj: Any,
 
 
 __all__ = ["pack", "unpack", "read_frame", "write_frame", "send_frame",
-           "MAX_FRAME", "Raw"]
+           "MAX_FRAME", "Raw", "byte_view"]

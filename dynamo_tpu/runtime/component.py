@@ -37,6 +37,9 @@ class Instance:
     endpoint: str
     instance_id: int  # == lease id, like the reference (component.rs:379-386)
     address: str  # host:port of the worker's RpcServer
+    # endpoints of the worker's bulk data plane (runtime/bulk.py) when it
+    # serves one — the NIXL-role transport KV blocks ride instead of RPC
+    bulk_address: str = ""
 
     @property
     def etcd_key(self) -> str:
@@ -48,13 +51,16 @@ class Instance:
         return f"{self.namespace}.{self.component}.{self.endpoint}"
 
     def to_json(self) -> bytes:
-        return json.dumps({
+        d = {
             "namespace": self.namespace,
             "component": self.component,
             "endpoint": self.endpoint,
             "instance_id": self.instance_id,
             "address": self.address,
-        }).encode()
+        }
+        if self.bulk_address:
+            d["bulk_address"] = self.bulk_address
+        return json.dumps(d).encode()
 
     @classmethod
     def from_json(cls, data: bytes) -> "Instance":
@@ -62,7 +68,7 @@ class Instance:
         return cls(
             namespace=d["namespace"], component=d["component"],
             endpoint=d["endpoint"], instance_id=d["instance_id"],
-            address=d["address"])
+            address=d["address"], bulk_address=d.get("bulk_address", ""))
 
 
 class Namespace:
@@ -147,7 +153,8 @@ class Endpoint:
 
     async def serve(self, handler: Handler,
                     stats_provider: Optional[Callable[[], Any]] = None,
-                    graceful_shutdown: bool = True) -> "ServedEndpoint":
+                    graceful_shutdown: bool = True,
+                    bulk_address: str = "") -> "ServedEndpoint":
         """Register the handler on the local RpcServer and announce the
         instance in the coordinator under the primary lease.
 
@@ -162,7 +169,7 @@ class Endpoint:
         inst = Instance(
             namespace=self.namespace, component=self.component,
             endpoint=self.name, instance_id=lease.lease_id,
-            address=server.address)
+            address=server.address, bulk_address=bulk_address)
         await drt.coord.put(inst.etcd_key, inst.to_json(), lease_id=lease.lease_id)
         logger.info("serving endpoint %s as instance %x at %s",
                     self.path, inst.instance_id, inst.address)

@@ -31,6 +31,7 @@ import fnmatch
 import itertools
 import logging
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, AsyncIterator, Callable, Dict, List, Optional, Tuple
 
@@ -118,6 +119,11 @@ class Coordinator:
         self._watches: Dict[int, _Watch] = {}
         self._subs: List[_Subscription] = []
         self._queue_rr: Dict[Tuple[str, str], int] = {}  # (pattern, group) -> rr counter
+        # work queues (JetStream-queue role; the reference's prefill queue
+        # rides a NATS JetStream consumer group, rust/llm/nats.rs:109):
+        # FIFO per name, pulls park until an item arrives
+        self._queues: Dict[str, "deque[bytes]"] = {}
+        self._queue_pulls: Dict[str, "deque[Tuple[_Conn, Any]]"] = {}
         self._ids = itertools.count(1)
         self._server: Optional[asyncio.base_events.Server] = None
         self._lease_task: Optional[asyncio.Task] = None
@@ -183,6 +189,10 @@ class Coordinator:
             for w in list(conn.watches.values()):
                 self._watches.pop(w.watch_id, None)
             self._subs = [s for s in self._subs if s.conn is not conn]
+            for pulls in self._queue_pulls.values():
+                # drop this connection's parked queue pulls
+                for item in [p for p in pulls if p[0] is conn]:
+                    pulls.remove(item)
             # leases owned by a dropped connection keep ticking until TTL expiry
             # (matches etcd semantics: reconnect within TTL keeps instances alive)
             try:
@@ -268,6 +278,25 @@ class Coordinator:
             if sub:
                 self._subs = [s for s in self._subs if s.sub_id != sub.sub_id]
             await conn.send({"rid": rid, "ok": True})
+        elif op == "queue_push":
+            depth = await self._op_queue_push(f["queue"], f["payload"])
+            await conn.send({"rid": rid, "ok": True, "depth": depth})
+        elif op == "queue_pull":
+            await self._op_queue_pull(conn, rid, f["queue"])
+        elif op == "queue_cancel":
+            pulls = self._queue_pulls.get(f["queue"], deque())
+            target = [(c, r) for (c, r) in pulls
+                      if c is conn and r == f["pull_rid"]]
+            for item in target:
+                pulls.remove(item)
+            await conn.send({"rid": rid, "ok": True,
+                             "cancelled": bool(target)})
+        elif op == "queue_depth":
+            q = self._queues.get(f["queue"])
+            await conn.send({"rid": rid, "ok": True,
+                             "depth": len(q) if q else 0,
+                             "pullers": len(self._queue_pulls.get(
+                                 f["queue"], ()))})
         elif op == "ping":
             await conn.send({"rid": rid, "ok": True, "time": time.time()})
         else:
@@ -328,6 +357,41 @@ class Coordinator:
                 logger.info("lease %d expired; revoking %d keys",
                             lid, len(self._leases[lid].keys))
                 await self._revoke_lease(lid)
+
+    # -- work queues -------------------------------------------------------
+
+    async def _op_queue_push(self, queue: str, payload: bytes) -> int:
+        """FIFO push; delivers straight to a parked puller when one waits.
+
+        Delivery is at-most-once (no acks): the prefill flow tolerates a
+        lost job because the decode side times out and falls back to local
+        prefill. Returns the post-push depth (0 = handed to a puller).
+
+        Each delivery carries ``age_s`` — time spent queued by THE
+        COORDINATOR'S clock — so consumers can expire stale jobs without
+        comparing wall clocks across hosts (clock skew immune)."""
+        pulls = self._queue_pulls.get(queue)
+        while pulls:
+            conn, rid = pulls.popleft()
+            if conn.alive:
+                await conn.send({"rid": rid, "ok": True, "payload": payload,
+                                 "age_s": 0.0, "depth": 0})
+                return 0
+        q = self._queues.setdefault(queue, deque())
+        q.append((payload, time.monotonic()))
+        return len(q)
+
+    async def _op_queue_pull(self, conn: _Conn, rid: Any, queue: str) -> None:
+        """Answer with the oldest item now, or park until a push arrives.
+        A parked pull on a dying connection is skipped at delivery time."""
+        q = self._queues.get(queue)
+        if q:
+            payload, t_in = q.popleft()
+            await conn.send({"rid": rid, "ok": True, "payload": payload,
+                             "age_s": time.monotonic() - t_in,
+                             "depth": len(q)})
+            return
+        self._queue_pulls.setdefault(queue, deque()).append((conn, rid))
 
     # -- pub/sub -----------------------------------------------------------
 
@@ -638,6 +702,78 @@ class CoordClient:
         self._subs.pop(sub_id, None)
         await self._call("unsubscribe", sub_id=sub_id)
         self._orphan_msgs.pop(sub_id, None)
+
+    # -- work queues -------------------------------------------------------
+
+    async def queue_push(self, queue: str, payload: bytes) -> int:
+        """Push one job; returns post-push depth (0 = a puller took it)."""
+        return (await self._call("queue_push", queue=queue,
+                                 payload=payload))["depth"]
+
+    async def queue_pull(self, queue: str,
+                         timeout: Optional[float] = None
+                         ) -> Optional[Tuple[bytes, float]]:
+        """Pull the oldest job, parking server-side until one arrives;
+        returns (payload, age_s) — ``age_s`` is time spent queued by the
+        coordinator's clock — or None on timeout.
+
+        Timeout protocol: the parked pull is explicitly cancelled
+        (``queue_cancel``). If the cancel races a delivery already in
+        flight, the client waits for it and pushes the job BACK, so a
+        timed-out puller can never swallow a job. External CANCELLATION of
+        this coroutine fires the same best-effort server-side cancel so a
+        parked pull on a still-live connection cannot swallow a later push
+        into an orphaned future."""
+        if self._writer is None:
+            raise ConnectionError("not connected")
+        rid = next(self._rids)
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending[rid] = fut
+        async with self._wlock:
+            await send_frame(self._writer,
+                             {"op": "queue_pull", "rid": rid, "queue": queue})
+        closed_wait = asyncio.ensure_future(self.closed.wait())
+        try:
+            done, _ = await asyncio.wait(
+                {fut, closed_wait}, timeout=timeout,
+                return_when=asyncio.FIRST_COMPLETED)
+            if fut in done:
+                resp = fut.result()
+                return resp["payload"], float(resp.get("age_s", 0.0))
+            if closed_wait in done:
+                self._pending.pop(rid, None)
+                raise ConnectionError("coordinator connection lost")
+            # timeout: cancel the parked pull server-side
+            resp = await self._call("queue_cancel", queue=queue,
+                                    pull_rid=rid)
+            if not resp.get("cancelled", False):
+                # delivery already in flight — take it and give it back
+                payload = (await fut)["payload"]
+                await self.queue_push(queue, payload)
+            self._pending.pop(rid, None)
+            return None
+        except asyncio.CancelledError:
+            self._pending.pop(rid, None)
+            if not self.closed.is_set():
+                # fire-and-forget: unpark server-side (conn teardown covers
+                # the closing case)
+                asyncio.get_running_loop().create_task(
+                    self._queue_cancel_quiet(queue, rid))
+            raise
+        finally:
+            closed_wait.cancel()
+
+    async def _queue_cancel_quiet(self, queue: str, pull_rid: int) -> None:
+        try:
+            await self._call("queue_cancel", queue=queue, pull_rid=pull_rid)
+        except Exception:  # noqa: BLE001 — best-effort cleanup
+            pass
+
+    async def queue_depth(self, queue: str) -> Tuple[int, int]:
+        """(queued jobs, parked pullers) — the planner's prefill-pressure
+        signal (reference: JetStream consumer info on the prefill queue)."""
+        resp = await self._call("queue_depth", queue=queue)
+        return resp["depth"], resp.get("pullers", 0)
 
     async def ping(self) -> float:
         return (await self._call("ping"))["time"]

@@ -42,6 +42,99 @@ def disagg_conf_key(namespace: str) -> str:
     return f"disagg/{namespace}/conf"
 
 
+def prefill_queue_name(namespace: str) -> str:
+    """Coordinator work-queue carrying prefill jobs (the JetStream prefill
+    queue role — reference ``rust/llm/nats.rs:109`` ``NatsQueue``, flow in
+    ``docs/architecture/dynamo_flow.md`` S7-S10)."""
+    return f"prefill/{namespace}"
+
+
+def prefill_reply_subject(namespace: str, rid: str) -> str:
+    return f"{namespace}.prefill_reply.{rid}"
+
+
+class PrefillQueueWorker:
+    """Prefill-side queue consumer: pulls jobs, prefills, publishes the
+    result (first token + kv_transfer_params + where to fetch the blocks).
+
+    Queue semantics give disagg what round-robin cannot: jobs wait for the
+    FIRST FREE prefill worker (not a blindly-chosen one), depth is a real
+    backlog signal for the planner, and adding a worker immediately drains
+    the queue."""
+
+    def __init__(self, engine: JaxEngine, drt: DistributedRuntime,
+                 namespace: str, instance_id: int, bulk_address: str = "",
+                 concurrency: int = 2):
+        self.engine = engine
+        self.drt = drt
+        self.namespace = namespace
+        self.instance_id = instance_id
+        self.bulk_address = bulk_address
+        self.concurrency = concurrency
+        self._tasks: list = []
+        self.jobs_done = 0
+
+    async def start(self) -> "PrefillQueueWorker":
+        for i in range(self.concurrency):
+            self._tasks.append(asyncio.create_task(
+                self._pull_loop(), name=f"prefill-queue-{i}"))
+        return self
+
+    async def stop(self) -> None:
+        for t in self._tasks:
+            await reap_task(t)
+
+    async def _pull_loop(self) -> None:
+        from dynamo_tpu.runtime import codec
+        queue = prefill_queue_name(self.namespace)
+        while True:
+            try:
+                pulled = await self.drt.coord.queue_pull(queue)
+            except ConnectionError:
+                return  # coordinator gone; runtime shutdown handles the rest
+            if pulled is None:
+                continue
+            raw, age_s = pulled
+            job = None
+            try:
+                job = codec.unpack(raw)
+                await self._run_job(job, age_s)
+                self.jobs_done += 1
+            except Exception:  # noqa: BLE001 — one bad job must not kill
+                logger.exception("prefill queue job failed")
+                if job is None and isinstance(raw, (bytes, bytearray)):
+                    logger.warning("undecodable prefill job dropped")
+
+    async def _run_job(self, job: dict, age_s: float = 0.0) -> None:
+        from dynamo_tpu.runtime import codec
+        # staleness by TIME QUEUED (measured on the coordinator's single
+        # clock — immune to cross-host wall-clock skew): past the decode
+        # side's reply timeout, nobody is waiting for this job
+        if age_s > job.get("ttl", float("inf")):
+            logger.info("dropping stale prefill job %s (queued %.1fs)",
+                        job.get("req", {}).get("request_id"), age_s)
+            return
+        try:
+            req = PreprocessedRequest.from_dict(job["req"])
+            req.prefill_only = True
+            final: Optional[LLMEngineOutput] = None
+            async for out in self.engine.generate(req):
+                if out.finish_reason is not None:
+                    final = out
+            reply = {
+                "out": final.to_dict() if final is not None else None,
+                "instance_id": self.instance_id,
+                "bulk_address": self.bulk_address,
+            }
+        except Exception:  # noqa: BLE001 — reply even on failure, so the
+            # decode side falls back immediately instead of waiting out
+            # its queue timeout
+            reply = {"out": None, "instance_id": self.instance_id}
+            raise
+        finally:
+            await self.drt.coord.publish(job["reply"], codec.pack(reply))
+
+
 class DisaggConfig:
     """Hot-reloadable disagg policy."""
 
@@ -61,12 +154,17 @@ class DisaggDecodeHandler:
 
     def __init__(self, engine: JaxEngine, drt: DistributedRuntime,
                  namespace: str, prefill_component: str,
-                 conf: Optional[DisaggConfig] = None):
+                 conf: Optional[DisaggConfig] = None,
+                 use_queue: bool = True, queue_timeout: float = 30.0):
         self.engine = engine
         self.drt = drt
         self.namespace = namespace
         self.prefill_component = prefill_component
         self.conf = conf or DisaggConfig()
+        # prefill-queue leg (reference PrefillQueue): jobs go to the first
+        # FREE worker; disable to force the direct round-robin leg only
+        self.use_queue = use_queue
+        self.queue_timeout = queue_timeout
         self._gen_client = None
         self._kv_client = None
         self._router: Optional[PushRouter] = None
@@ -118,12 +216,75 @@ class DisaggDecodeHandler:
         n = len(request.token_ids)
         return n > self.conf.max_local_prefill_length
 
+    async def _queue_prefill(self, preq: PreprocessedRequest
+                             ) -> Optional[LLMEngineOutput]:
+        """Prefill via the coordinator work queue: push the job, await the
+        reply event, pull the KV blocks from whichever prefill worker took
+        it. Returns None on timeout/failure (caller falls back to the
+        direct round-robin leg, then to local prefill)."""
+        from dynamo_tpu.runtime import codec
+        # no queue consumers -> don't park the request behind a timeout;
+        # the direct round-robin leg handles pre-queue prefill workers
+        depth, pullers = await self.drt.coord.queue_depth(
+            prefill_queue_name(self.namespace))
+        if pullers == 0 and depth == 0:
+            return None
+        rid = preq.request_id or f"pf-{id(preq):x}"
+        subject = prefill_reply_subject(self.namespace, rid)
+        # a DISTINCT request id for the queued copy: if this leg times out
+        # and the direct leg re-sends rid to the same worker, a late queue
+        # pull must not collide in the engine's request_id-keyed state
+        preq = PreprocessedRequest.from_dict(preq.to_dict())
+        preq.request_id = f"{rid}-q"
+        preq.prefill_only = True
+        sub = await self.drt.subscribe_events(subject)
+        try:
+            await self.drt.coord.queue_push(
+                prefill_queue_name(self.namespace),
+                codec.pack({"req": preq.to_dict(), "reply": subject,
+                            "ttl": self.queue_timeout}))
+            try:
+                _subj, reply = await asyncio.wait_for(
+                    sub.__anext__(), timeout=self.queue_timeout)
+            except asyncio.TimeoutError:
+                logger.warning("prefill queue reply timed out after %.1fs",
+                               self.queue_timeout)
+                return None
+            if not reply.get("out"):
+                return None
+            final = LLMEngineOutput.from_dict(reply["out"])
+            if final.error:
+                return None
+            params = final.kv_transfer_params or {}
+            hashes = [b[0] for b in params.get("blocks", [])]
+            if hashes:
+                await self._pull_blocks(
+                    hashes, reply["instance_id"],
+                    bulk_address=reply.get("bulk_address", ""))
+            return final
+        finally:
+            try:
+                await sub.cancel()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+
     async def _remote_prefill(self, request: PreprocessedRequest
                               ) -> Optional[LLMEngineOutput]:
         """Run the prefill leg; returns the final prefill frame (first token +
-        kv_transfer_params) or None on any failure (-> local fallback)."""
+        kv_transfer_params) or None on any failure (-> local fallback).
+        Tries the prefill queue first (workers pull when free — reference
+        PrefillQueue role), then the direct round-robin leg."""
         preq = PreprocessedRequest.from_dict(request.to_dict())
         preq.prefill_only = True
+        if self.use_queue:
+            try:
+                final = await self._queue_prefill(preq)
+            except Exception as e:  # noqa: BLE001 — queue leg must not fail
+                logger.warning("prefill queue leg failed (%s); trying "
+                               "direct", e)
+                final = None
+            if final is not None:
+                return final
         try:
             iid = self._router.select_instance()
             final: Optional[LLMEngineOutput] = None
@@ -137,26 +298,7 @@ class DisaggDecodeHandler:
             params = final.kv_transfer_params or {}
             hashes = [b[0] for b in params.get("blocks", [])]
             if hashes:
-                kv_stream = await self._kv_client.direct(
-                    {"block_hashes": hashes, "wire": 2}, iid)
-                # batched two-part frames: inject frame k while frame k+1
-                # is still in flight (pipelined, zero msgpack re-copies)
-                injected = total = 0
-                legacy: list = []
-                async for frame in kv_stream:
-                    if "_raw" in frame:
-                        total += len(frame["blocks"])
-                        injected += await self.engine.run_exclusive(
-                            inject_frame, self.engine, frame)
-                    else:  # pre-batched single-block schema
-                        legacy.append(BlockPayload.from_wire(frame))
-                if legacy:
-                    total += len(legacy)
-                    injected += await self.engine.run_exclusive(
-                        inject_blocks, self.engine, legacy)
-                if total:
-                    logger.debug("injected %d/%d transferred blocks",
-                                 injected, total)
+                await self._pull_blocks(hashes, iid)
             return final
         except Exception as e:  # noqa: BLE001 — disagg must never fail a
             # request: any remote-leg error (connection, malformed frame,
@@ -164,6 +306,85 @@ class DisaggDecodeHandler:
             logger.warning("remote prefill failed (%s); falling back local", e,
                            exc_info=not isinstance(e, ConnectionError))
             return None
+
+    async def _pull_blocks(self, hashes: list, iid: int,
+                           bulk_address: str = "") -> None:
+        """Fetch + inject the prefix blocks from prefill worker ``iid``.
+
+        Prefers the worker's bulk data plane (raw sockets, unix-first —
+        the NIXL-role transport); falls back to batched two-part frames on
+        the RPC plane when the instance advertises no bulk address."""
+        inst = self._kv_client.get_instance(iid)
+        if not bulk_address and inst is not None:
+            bulk_address = inst.bulk_address
+        injected = total = 0
+        bulk_done = False
+        if bulk_address:
+            from dynamo_tpu.runtime.bulk import bulk_fetch
+            # stream-and-inject: frames hop from the fetch thread into an
+            # asyncio queue; frame k injects while k+1 is still on the
+            # wire — same pipelining the RPC branch gets from its async
+            # iterator, without buffering the whole prefix in RAM
+            loop = asyncio.get_running_loop()
+            frame_q: asyncio.Queue = asyncio.Queue()
+
+            def on_frame(meta, raw):
+                loop.call_soon_threadsafe(frame_q.put_nowait, (meta, raw))
+
+            fetch = asyncio.create_task(asyncio.to_thread(
+                bulk_fetch, bulk_address, KV_EXPORT_ENDPOINT,
+                {"block_hashes": hashes}, f"{iid:x}", 60.0, on_frame))
+            try:
+                while True:
+                    get = asyncio.ensure_future(frame_q.get())
+                    done, _ = await asyncio.wait(
+                        {get, fetch}, return_when=asyncio.FIRST_COMPLETED)
+                    if get in done:
+                        meta, raw = get.result()
+                        meta = dict(meta)
+                        meta["_raw"] = raw
+                        total += len(meta["blocks"])
+                        injected += await self.engine.run_exclusive(
+                            inject_frame, self.engine, meta)
+                        continue
+                    get.cancel()
+                    await fetch  # raises on transport/handler error
+                    while not frame_q.empty():  # drain the tail
+                        meta, raw = frame_q.get_nowait()
+                        meta = dict(meta)
+                        meta["_raw"] = raw
+                        total += len(meta["blocks"])
+                        injected += await self.engine.run_exclusive(
+                            inject_frame, self.engine, meta)
+                    bulk_done = True
+                    break
+            except Exception as e:  # noqa: BLE001 — bulk plane unreachable
+                # (e.g. worker bound to 127.0.0.1 across hosts): the RPC
+                # export path below still works — never waste the completed
+                # remote prefill over a transport problem
+                logger.warning("bulk KV fetch from %s failed (%s); falling "
+                               "back to the RPC export path",
+                               bulk_address, e)
+        if not bulk_done:
+            kv_stream = await self._kv_client.direct(
+                {"block_hashes": hashes, "wire": 2}, iid)
+            # batched two-part frames: inject frame k while frame k+1
+            # is still in flight (pipelined, zero msgpack re-copies)
+            legacy: list = []
+            async for frame in kv_stream:
+                if "_raw" in frame:
+                    total += len(frame["blocks"])
+                    injected += await self.engine.run_exclusive(
+                        inject_frame, self.engine, frame)
+                else:  # pre-batched single-block schema
+                    legacy.append(BlockPayload.from_wire(frame))
+            if legacy:
+                total += len(legacy)
+                injected += await self.engine.run_exclusive(
+                    inject_blocks, self.engine, legacy)
+        if total:
+            logger.debug("injected %d/%d transferred blocks",
+                         injected, total)
 
     async def generate(self, request: PreprocessedRequest,
                        ctx=None) -> AsyncIterator[LLMEngineOutput]:

@@ -87,6 +87,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "component and decodes")
     p.add_argument("--prefill-component", default="prefill",
                    help="component name of the prefill workers (decode role)")
+    p.add_argument("--bulk-host", default="127.0.0.1",
+                   help="bind host for the bulk KV data plane (prefill "
+                        "role); use this host's DCN address for cross-host "
+                        "disagg")
     return p
 
 
@@ -173,11 +177,26 @@ async def amain(args: argparse.Namespace) -> None:
                 "KVBM tiers with --disagg decode are not supported yet: "
                 "the disagg decode path pulls prefixes from prefill "
                 "workers and bypasses tier onboarding")
-        from dynamo_tpu.kvbm.manager import TieredEngine, TieredKvConfig
+        from dynamo_tpu.kvbm.manager import (
+            TieredEngine, TieredKvConfig, serve_tiered_kv_export)
+        from dynamo_tpu.worker.disagg import KV_EXPORT_ENDPOINT
         tiered = TieredEngine(engine, TieredKvConfig(
             host_budget_bytes=max(args.host_cache_bytes, 1),
             disk_budget_bytes=args.disk_cache_bytes,
             disk_path=args.disk_cache_path))
+        # G4 remote tier: serve this worker's HBM+tier blocks to peers on
+        # the same component, and fetch from peers on a local tier miss
+        # (reference: CacheLevel::G4, block_manager/distributed/).
+        # The disagg-prefill branch below registers the SAME endpoint name
+        # with the tier-aware handler itself — registering here too would
+        # be overwritten (last register wins).
+        g4_ep = (drt.namespace(args.namespace).component(args.component)
+                 .endpoint(KV_EXPORT_ENDPOINT))
+        if args.disagg != "prefill":
+            await g4_ep.serve(serve_tiered_kv_export(tiered))
+        g4_lease = await drt.primary_lease()
+        tiered.enable_peer_fetch(await g4_ep.client(),
+                                 self_instance_id=g4_lease.lease_id)
 
     def worker_stats() -> dict:
         d = engine.stats().to_dict()
@@ -218,15 +237,42 @@ async def amain(args: argparse.Namespace) -> None:
     else:
         await serve_engine(endpoint, tiered if tiered is not None else engine,
                            stats_provider=worker_stats)
+    bulk_server = None
+    queue_worker = None
     if args.disagg == "prefill":
         # serve the KV block fetch endpoint for decode workers; register as
-        # model_type=prefill so frontends don't route chat traffic here
-        from dynamo_tpu.engine.transfer import serve_kv_export
+        # model_type=prefill so frontends don't route chat traffic here.
+        # Bulk KV bytes ride the dedicated raw-socket plane (runtime/bulk.py
+        # — the NIXL-role transport); the RPC endpoint stays as the
+        # control/fallback path.
+        from dynamo_tpu.engine.transfer import (
+            serve_kv_export, serve_kv_export_bulk)
+        from dynamo_tpu.runtime.bulk import BulkServer
         from dynamo_tpu.worker.disagg import KV_EXPORT_ENDPOINT
         kv_ep = (drt.namespace(args.namespace).component(args.component)
                  .endpoint(KV_EXPORT_ENDPOINT))
-        await kv_ep.serve(serve_kv_export(engine))
+        lease = await drt.primary_lease()
+        bulk_server = BulkServer(
+            host=args.bulk_host,
+            unix_path=f"/tmp/dynamo_tpu_bulk_{lease.lease_id:x}.sock",
+            ident=f"{lease.lease_id:x}").start()
+        bulk_server.register(KV_EXPORT_ENDPOINT, serve_kv_export_bulk(
+            engine, asyncio.get_running_loop()))
+        if tiered is not None:
+            # tier-aware export: peers and decode workers can fetch blocks
+            # that fell out of this worker's HBM into G2/G3
+            from dynamo_tpu.kvbm.manager import serve_tiered_kv_export
+            kv_handler = serve_tiered_kv_export(tiered)
+        else:
+            kv_handler = serve_kv_export(engine)
+        await kv_ep.serve(kv_handler, bulk_address=bulk_server.address)
         await register_llm(drt, endpoint, card, model_type="prefill")
+        # pull-based prefill queue consumer (reference PrefillQueue role):
+        # decode workers enqueue; the first free prefill worker takes a job
+        from dynamo_tpu.worker.disagg import PrefillQueueWorker
+        queue_worker = await PrefillQueueWorker(
+            engine, drt, args.namespace, instance_id=lease.lease_id,
+            bulk_address=bulk_server.address).start()
     else:
         await register_llm(drt, endpoint, card)
     from dynamo_tpu.runtime.system_server import SystemServer
@@ -240,6 +286,10 @@ async def amain(args: argparse.Namespace) -> None:
     try:
         await drt.runtime.wait_shutdown()
     finally:
+        if queue_worker is not None:
+            await queue_worker.stop()
+        if bulk_server is not None:
+            bulk_server.stop()
         if system is not None:
             await system.stop()
         if handler is not None:

@@ -111,3 +111,64 @@ async def test_concurrent_clients():
                 assert len(items) == 20
 
         await asyncio.gather(*[worker(i) for i in range(8)])
+
+
+# -- work queues (JetStream prefill-queue role) ------------------------------
+
+async def test_queue_push_pull_fifo():
+    async with Coordinator() as coord:
+        async with CoordClient(coord.address) as c:
+            assert await c.queue_push("q", b"a") == 1
+            assert await c.queue_push("q", b"b") == 2
+            assert await c.queue_depth("q") == (2, 0)
+            assert (await c.queue_pull("q"))[0] == b"a"
+            p, age = await c.queue_pull("q")
+            assert p == b"b" and age >= 0.0
+            assert await c.queue_depth("q") == (0, 0)
+
+
+async def test_queue_parked_pull_wakes_on_push():
+    async with Coordinator() as coord:
+        async with CoordClient(coord.address) as c1, \
+                   CoordClient(coord.address) as c2:
+            pull = asyncio.ensure_future(c1.queue_pull("jobs"))
+            await asyncio.sleep(0.1)
+            assert await c1.queue_depth("jobs") == (0, 1)
+            assert await c2.queue_push("jobs", b"x") == 0  # handed directly
+            assert (await pull)[0] == b"x"
+
+
+async def test_queue_competing_pullers_each_get_one():
+    async with Coordinator() as coord:
+        async with CoordClient(coord.address) as c1, \
+                   CoordClient(coord.address) as c2, \
+                   CoordClient(coord.address) as c3:
+            p1 = asyncio.ensure_future(c1.queue_pull("jobs"))
+            p2 = asyncio.ensure_future(c2.queue_pull("jobs"))
+            await asyncio.sleep(0.1)
+            await c3.queue_push("jobs", b"j1")
+            await c3.queue_push("jobs", b"j2")
+            got = sorted([(await p1)[0], (await p2)[0]])
+            assert got == [b"j1", b"j2"]
+
+
+async def test_queue_pull_timeout_does_not_swallow_jobs():
+    async with Coordinator() as coord:
+        async with CoordClient(coord.address) as c:
+            assert await c.queue_pull("empty", timeout=0.2) is None
+            # parked pull was cancelled: a later push must stay queued
+            assert await c.queue_push("empty", b"later") == 1
+            assert (await c.queue_pull("empty", timeout=0.5))[0] == b"later"
+
+
+async def test_queue_dead_puller_skipped():
+    async with Coordinator() as coord:
+        async with CoordClient(coord.address) as alive:
+            dead = await CoordClient(coord.address).connect()
+            _p = asyncio.ensure_future(dead.queue_pull("jobs"))
+            await asyncio.sleep(0.1)
+            await dead.close()
+            await asyncio.sleep(0.1)
+            # push must not vanish into the dead puller
+            await alive.queue_push("jobs", b"x")
+            assert (await alive.queue_pull("jobs", timeout=1.0))[0] == b"x"

@@ -110,3 +110,33 @@ class TestProfileCli:
         it = PerfInterpolator(profile)  # planner loads it directly
         assert it.ttft(64) > 0
         assert profile["meta"]["engine"] == "mocker"
+
+
+class TestParallelismSweep:
+    def test_sweep_on_virtual_mesh(self, tmp_path):
+        """profile --sweep on the 8-device CPU mesh: one profile per (tp,
+        sp) config, consumable by MultiPerfInterpolator (VERDICT r2 #8)."""
+        import argparse
+        import asyncio
+        import json
+
+        from dynamo_tpu.planner.perf_interpolation import (
+            MultiPerfInterpolator)
+        from dynamo_tpu.planner.profile import profile_parallelism_sweep
+
+        args = argparse.Namespace(
+            model_path=None, dtype="float32",
+            sweep=[(1, 1), (2, 1), (1, 2)],
+            isl=[8, 16], concurrency=[1, 2], osl=4,
+            num_pages=64, page_size=4, max_prefill_chunk=16)
+        profile = asyncio.run(profile_parallelism_sweep(args))
+        assert len(profile["configs"]) == 3
+        for c in profile["configs"]:
+            assert len(c["prefill"]) == 2
+            assert len(c["decode"]) == 2
+            assert all(r["ttft_s"] > 0 for r in c["prefill"])
+        path = tmp_path / "sweep.json"
+        path.write_text(json.dumps(profile))
+        multi = MultiPerfInterpolator.from_file(str(path))
+        assert multi.is_multi
+        assert [o["chips"] for o in multi.options] == [1, 2, 2]
