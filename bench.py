@@ -311,21 +311,19 @@ def _measure_kv_inject(engine) -> float:
     (gathered device array -> jitted in-place scatter, no host bounce)."""
     import jax
 
-    from dynamo_tpu.engine.transfer import _gather_device, _scatter_pages
-
     n_blk = 1
     while n_blk * 2 <= min(64, engine.allocator.num_pages - 2):
         n_blk *= 2
     ids = list(range(1, n_blk + 1))
-    data = _gather_device(engine, ids)
+    data = engine.dispatch_gather_pages(ids)
     jax.block_until_ready(data)
-    _scatter_pages(engine, ids, data[:, :, :, :n_blk])  # compile warmup
+    engine.scatter_pages_device(ids, data)  # compile warmup
     ref = engine.pages[0] if isinstance(engine.pages, list) else engine.pages
     jax.block_until_ready(ref)
     reps = 5
     t0 = time.perf_counter()
     for _ in range(reps):
-        _scatter_pages(engine, ids, data[:, :, :, :n_blk])
+        engine.scatter_pages_device(ids, data)
     ref = engine.pages[0] if isinstance(engine.pages, list) else engine.pages
     jax.block_until_ready(ref)
     dt = (time.perf_counter() - t0) / reps

@@ -383,7 +383,10 @@ class JaxEngine(ScheduledEngineBase):
         (rank 0 arrives here via ``_execute_plan``). Returns
         (sampled, logprobs, extras) where extras carries the top-K
         alternatives when ``num_top_logprobs`` > 0."""
-        return self.fetch_packed(self._invoke_step(kind, a, step))
+        out = self._invoke_step(kind, a, step)
+        if out is None:
+            return None  # follower-side page IO (gather/scatter): no packed
+        return self.fetch_packed(out)
 
     def _invoke_step(self, kind: str, a: dict, step: int, prev_packed=None):
         """Dispatch ONE jitted step of any family; returns the on-device
@@ -393,6 +396,20 @@ class JaxEngine(ScheduledEngineBase):
         kind "chained" substitutes the previous step's on-device sampled
         tokens for ``a["toks"]``; ``prev_packed`` defaults to this rank's
         last packed output (the follower case — leaders pass it)."""
+        if kind == "embed":
+            self._embed_batch_raw(a["toks"], a["mask"])
+            return None
+        if kind == "gather":
+            # follower side of a broadcast page gather: join the SPMD op,
+            # discard the (replicated) result
+            self._ensure_page_io_jits()
+            self._jit_gather_pages(self.pages, jnp.asarray(a["ids"]))
+            return None
+        if kind == "scatter":
+            self._ensure_page_io_jits()
+            self.pages = self._jit_scatter_pages(
+                self.pages, jnp.asarray(a["ids"]), jnp.asarray(a["vals"]))
+            return None
         if kind == "chained":
             prev = prev_packed if prev_packed is not None else self._last_packed
             self.pages, packed = self._jit_chained(
@@ -412,20 +429,109 @@ class JaxEngine(ScheduledEngineBase):
         self._last_packed = packed
         return packed
 
+    # -- page IO (KV transfer / KVBM tier moves) ---------------------------
+    # On a multi-host mesh ``pages`` is a GLOBAL sharded array: every rank
+    # must enter the same jitted gather/scatter. These methods broadcast
+    # the op over the step stream (same ordered channel as compute steps)
+    # before dispatching, and gathers produce fully-REPLICATED outputs so
+    # the leader can read the whole result from its local shards. This is
+    # what lifts the r2 multihost rejections on disagg + KVBM (VERDICT r2
+    # item 6; reference: block_manager/distributed/{leader,worker}.rs).
+
+    def _ensure_page_io_jits(self):
+        if hasattr(self, "_jit_gather_pages"):
+            return
+        rep = None
+        if self.cfg.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+            rep = NamedSharding(self.cfg.mesh, PartitionSpec())
+        if isinstance(self.pages, list):
+            gather = lambda pages, ids: jnp.stack([p[ids] for p in pages])  # noqa: E731
+            scatter = lambda pages, ids, vals: [  # noqa: E731
+                p.at[ids].set(vals[l].astype(p.dtype))
+                for l, p in enumerate(pages)]
+        else:
+            gather = lambda pages, ids: pages[:, ids]  # noqa: E731
+            scatter = lambda pages, ids, vals: pages.at[:, ids].set(  # noqa: E731
+                vals.astype(pages.dtype))
+        self._jit_gather_pages = jax.jit(
+            gather, out_shardings=rep) if rep is not None else jax.jit(gather)
+        self._jit_scatter_pages = jax.jit(scatter, donate_argnums=(0,))
+
+    @staticmethod
+    def _pad_page_ids(page_ids) -> np.ndarray:
+        """Pad to the next power of two with page 0 (the garbage page) so
+        the jits compile a handful of shapes, not one per transfer size."""
+        n = 1
+        while n < len(page_ids):
+            n *= 2
+        return np.asarray(list(page_ids) + [0] * (n - len(page_ids)),
+                          np.int32)
+
+    def dispatch_gather_pages(self, page_ids):
+        """Gather cache pages -> device array [L, n_pad, 2, Hkv, ps, Dh]
+        (replicated on a mesh). Non-blocking; broadcast to followers."""
+        self._ensure_page_io_jits()
+        ids = self._pad_page_ids(page_ids)
+        if self.step_tap is not None:
+            # consume a step id of our own: sharing one id between a page
+            # IO op and the next compute step would mispair the followers'
+            # failure bookkeeping with the leader's outcome cross-check
+            self.step_tap("gather", {"ids": ids}, self._step_counter)
+            self._step_counter += 1
+        return self._jit_gather_pages(self.pages, jnp.asarray(ids))
+
+    def gather_pages_host(self, page_ids) -> np.ndarray:
+        """Gather + host fetch, trimmed to the real page count."""
+        out = self.dispatch_gather_pages(page_ids)
+        return np.asarray(jax.device_get(out))[:, :len(page_ids)]
+
+    def scatter_pages_device(self, page_ids, vals_dev) -> None:
+        """Scatter DEVICE-resident values (the same-process ICI path) —
+        no broadcast, no host bounce. vals_dev page axis may be narrower
+        than the padded ids; it is padded on device."""
+        self._ensure_page_io_jits()
+        ids = self._pad_page_ids(page_ids)
+        vals = jnp.asarray(vals_dev)
+        if vals.shape[1] < ids.shape[0]:
+            pad = [(0, 0)] * vals.ndim
+            pad[1] = (0, int(ids.shape[0]) - int(vals.shape[1]))
+            vals = jnp.pad(vals, pad)
+        self.pages = self._jit_scatter_pages(self.pages, jnp.asarray(ids),
+                                             vals)
+
+    def scatter_pages_host(self, page_ids, vals) -> None:
+        """Scatter host values [L, n, 2, Hkv, ps, Dh] into cache pages, in
+        place (donated). Broadcast with the values so every rank applies
+        the identical global write."""
+        self._ensure_page_io_jits()
+        ids = self._pad_page_ids(page_ids)
+        vals = np.asarray(vals)
+        if vals.shape[1] < ids.shape[0]:
+            pad = [(0, 0)] * vals.ndim
+            pad[1] = (0, ids.shape[0] - vals.shape[1])
+            vals = np.pad(vals, pad)
+        if self.step_tap is not None:
+            self.step_tap("scatter", {"ids": ids, "vals": vals},
+                          self._step_counter)
+            self._step_counter += 1
+        self.pages = self._jit_scatter_pages(self.pages, jnp.asarray(ids),
+                                             jnp.asarray(vals))
+
     # -- embeddings --------------------------------------------------------
 
     def _embed_batch(self, token_lists) -> np.ndarray:
         """Mean-pooled hidden-state embeddings (runs outside the scheduler;
-        embeddings are one-shot, no KV cache involvement)."""
+        embeddings are one-shot, no KV cache involvement). On a multi-host
+        mesh the batch is broadcast so every rank joins the encode jit
+        (replicated output — the leader reads it locally)."""
         from dynamo_tpu.models import get_family
         family = get_family(self.model_cfg)
         encode = getattr(family, "encode", None)
         if encode is None:
             raise NotImplementedError(
                 f"{self.model_cfg.model_type} has no embedding path")
-        if not hasattr(self, "_jit_encode"):
-            self._jit_encode = jax.jit(
-                lambda p, t, m: encode(p, self.model_cfg, t, m))
+        self._ensure_encode_jit(encode)
         B = len(token_lists)
         S = _bucket(max(len(t) for t in token_lists),
                     self.cfg.min_prefill_bucket, self.cfg.max_prefill_chunk)
@@ -435,16 +541,42 @@ class JaxEngine(ScheduledEngineBase):
             n = min(len(ids), S)
             toks[i, :n] = ids[:n]
             mask[i, :n] = True
-        out = self._jit_encode(self.params, jnp.asarray(toks),
-                               jnp.asarray(mask))
-        return np.asarray(out)
+        if self.step_tap is not None:
+            self.step_tap("embed", {"toks": toks, "mask": mask},
+                          self._step_counter)
+            self._step_counter += 1
+        return np.asarray(self._embed_batch_raw(toks, mask))
+
+    def _ensure_encode_jit(self, encode=None):
+        if hasattr(self, "_jit_encode"):
+            return
+        if encode is None:
+            from dynamo_tpu.models import get_family
+            encode = get_family(self.model_cfg).encode
+        rep = None
+        if self.cfg.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+            rep = NamedSharding(self.cfg.mesh, PartitionSpec())
+        self._jit_encode = jax.jit(
+            lambda p, t, m: encode(p, self.model_cfg, t, m),
+            **({"out_shardings": rep} if rep is not None else {}))
+
+    def _embed_batch_raw(self, toks, mask):
+        """Run the encode jit from raw padded arrays (leader AND follower
+        entry — identical arrays on every rank keep the SPMD program in
+        lockstep)."""
+        self._ensure_encode_jit()
+        return self._jit_encode(self.params, jnp.asarray(toks),
+                                jnp.asarray(mask))
 
     async def embed(self, token_lists) -> np.ndarray:
         import asyncio
         if self.step_tap is not None:
-            raise NotImplementedError(
-                "embeddings bypass the broadcast step stream and are not "
-                "yet supported on multi-host workers")
+            # multi-host: serialize with the step loop so the broadcast
+            # order equals the leader's actual dispatch order — a tap from
+            # a free-running thread could interleave with step taps and
+            # de-lockstep the ranks' collective order
+            return await self.run_exclusive(self._embed_batch, token_lists)
         return await asyncio.to_thread(self._embed_batch, token_lists)
 
     @classmethod

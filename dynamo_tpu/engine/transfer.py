@@ -63,79 +63,10 @@ class BlockPayload:
                    data=arr.reshape(d["shape"]))
 
 
-def _pad_ids(page_ids: List[int]) -> List[int]:
-    """Pad a page-id list to the next power of two with page 0 (the reserved
-    garbage page), so the jitted gather/scatter compiles a handful of shapes
-    instead of one per transfer size."""
-    n = 1
-    while n < len(page_ids):
-        n *= 2
-    return list(page_ids) + [0] * (n - len(page_ids))
-
-
-@jax.jit
-def _gather_stacked(pages, ids):
-    return pages[:, ids]
-
-
-@jax.jit
-def _gather_list(pages, ids):
-    return jnp.stack([p[ids] for p in pages])
-
-
-def _gather_device(engine: JaxEngine, page_ids: List[int]):
-    """Device cache -> device array [L, n, 2, Hkv, ps, Dh] (n padded to a
-    power of two; extra slots hold garbage-page content)."""
-    ids = jnp.asarray(_pad_ids(page_ids), jnp.int32)
-    if isinstance(engine.pages, list):
-        return _gather_list(engine.pages, ids)
-    return _gather_stacked(engine.pages, ids)
-
-
-def _gather_pages(engine: JaxEngine, page_ids: List[int]) -> np.ndarray:
-    """Device cache -> host [L, n, 2, Hkv, ps, Dh] for the given pages."""
-    out = jax.device_get(_gather_device(engine, page_ids))
-    return np.asarray(out)[:, :len(page_ids)]
-
-
-def _scatter_pages(engine: JaxEngine, page_ids: List[int],
-                   data) -> None:
-    """[L, n, 2, Hkv, ps, Dh] (host or device) -> device cache at the given
-    pages.
-
-    The update runs as a donated jitted scatter: XLA aliases the input and
-    output cache buffers, so the write is in place — no full-cache copy per
-    injection (the pre-round-2 ``.at[].set`` outside jit materialized a
-    second copy of the whole KV cache every call).
-    """
-    ids = jnp.asarray(_pad_ids(page_ids), jnp.int32)
-    n_pad = ids.shape[0]
-    if not hasattr(engine, "_jit_scatter"):
-        engine._jit_scatter = jax.jit(
-            lambda pages, ids, vals: pages.at[:, ids].set(vals),
-            donate_argnums=(0,))
-        engine._jit_scatter_list = jax.jit(
-            lambda pages, ids, vals: [
-                p.at[ids].set(vals[l]) for l, p in enumerate(pages)],
-            donate_argnums=(0,))
-    if isinstance(engine.pages, list):
-        vals = _pad_vals(data, n_pad, engine.pages[0].dtype)
-        engine.pages = engine._jit_scatter_list(engine.pages, ids, vals)
-    else:
-        vals = _pad_vals(data, n_pad, engine.pages.dtype)
-        engine.pages = engine._jit_scatter(engine.pages, ids, vals)
-
-
-def _pad_vals(data, n_pad: int, dtype):
-    """Pad the page axis (1) of [L,n,2,Hkv,ps,Dh] to n_pad; padded slots
-    write to the garbage page, which is scratch by design."""
-    vals = jnp.asarray(data, dtype=dtype)
-    n = vals.shape[1]
-    if n < n_pad:
-        pad = [(0, 0)] * vals.ndim
-        pad[1] = (0, n_pad - n)
-        vals = jnp.pad(vals, pad)
-    return vals
+# Gather/scatter jits live on the ENGINE (``dispatch_gather_pages`` /
+# ``scatter_pages_host`` / ``scatter_pages_device``, jax_engine.py) — one
+# implementation serves the single-host, ICI, and multi-host-broadcast
+# paths alike.
 
 
 def export_blocks(engine: JaxEngine,
@@ -167,9 +98,20 @@ def _inject_data(engine: JaxEngine,
     if not fresh:
         return 0
     pages = alloc.allocate(len(fresh))
-    if len(fresh) != len(metas):
-        data = jnp.asarray(data)[:, jnp.asarray(fresh, jnp.int32)]
-    _scatter_pages(engine, pages, data)
+    is_device = isinstance(data, jax.Array)
+    if engine.step_tap is not None or not is_device:
+        # host values (the wire path), and ALWAYS on multi-host: the
+        # scatter is broadcast with its values so every rank applies the
+        # identical write to the sharded cache
+        host = np.asarray(data)
+        if len(fresh) != len(metas):
+            host = host[:, np.asarray(fresh, np.int64)]
+        engine.scatter_pages_host(pages, host)
+    else:
+        # device values (same-process ICI path): no host bounce
+        if len(fresh) != len(metas):
+            data = data[:, jnp.asarray(fresh, jnp.int32)]
+        engine.scatter_pages_device(pages, data)
     for page, i in zip(pages, fresh):
         h, local, parent = metas[i]
         alloc.commit(page, h, local, parent)
@@ -189,7 +131,9 @@ def inject_blocks(engine: JaxEngine, blocks: List[BlockPayload]) -> int:
 def _export_device(engine: JaxEngine, block_hashes: List[int]):
     """Extract resident blocks by hash as (metas, device array) — no host
     round trip. Missing hashes break the chain (later blocks are useless
-    without their parents)."""
+    without their parents). The gather goes through
+    ``engine.dispatch_gather_pages`` so a multi-host engine broadcasts it
+    to followers (every rank must join ops on the sharded cache)."""
     alloc = engine.allocator
     claimed: List[Tuple[int, int]] = []
     try:
@@ -201,7 +145,7 @@ def _export_device(engine: JaxEngine, block_hashes: List[int]):
             claimed.append((h, page))
         if not claimed:
             return [], None
-        data = _gather_device(engine, [p for _h, p in claimed])
+        data = engine.dispatch_gather_pages([p for _h, p in claimed])
         metas = []
         for h, page in claimed:
             info = alloc._info[page]
@@ -272,7 +216,11 @@ def export_frames(engine: JaxEngine, block_hashes: List[int]) -> List[Raw]:
     if not metas:
         return []
     n = len(metas)
-    host = np.asarray(jax.device_get(jnp.moveaxis(data, 1, 0)[:n]))
+    # transpose HOST-side: a device-side moveaxis would be another jitted
+    # op every mesh rank must join; one host memcpy is cheap next to the
+    # wire time and keeps the multi-host path to exactly one broadcast op
+    host = np.ascontiguousarray(
+        np.moveaxis(np.asarray(jax.device_get(data))[:, :n], 1, 0))
     frames: List[Raw] = []
     for i in range(0, n, BLOCKS_PER_FRAME):
         chunk = host[i:i + BLOCKS_PER_FRAME]

@@ -35,7 +35,6 @@ from dynamo_tpu.engine.jax_engine import JaxEngine
 from dynamo_tpu.engine.base import EngineBase
 from dynamo_tpu.engine.transfer import (
     BlockPayload,
-    _gather_device,
     inject_blocks,
 )
 from dynamo_tpu.protocols.common import LLMEngineOutput, PreprocessedRequest
@@ -91,8 +90,11 @@ class TieredEngine(EngineBase):
         device->host copy and tier writes run on the spill thread.
         """
         try:
-            data_dev = _gather_device(self.engine,
-                                      [p for _h, p, _i in evicted])
+            # dispatch_gather_pages broadcasts on a multi-host mesh (every
+            # rank joins the gather on the sharded cache) and returns a
+            # replicated handle the spill thread can read locally
+            data_dev = self.engine.dispatch_gather_pages(
+                [p for _h, p, _i in evicted])
         except Exception:
             logger.exception("kvbm offload gather failed; blocks dropped")
             return
@@ -289,45 +291,85 @@ class TieredEngine(EngineBase):
         return out
 
 
+def collect_tiered_blocks(tiered: TieredEngine,
+                          hashes: List[int]) -> List[BlockPayload]:
+    """HBM-resident prefix first (device gather), then continue the chain
+    from the G2/G3 tiers; stop at the first total miss. Runs under
+    ``run_exclusive``."""
+    from dynamo_tpu.engine.transfer import export_blocks
+
+    blocks = export_blocks(tiered.engine, hashes)
+    with tiered._tier_lock:
+        for h in hashes[len(blocks):]:
+            blk = tiered._lookup(h)
+            if blk is None:
+                break
+            blocks.append(blk)
+    return blocks
+
+
+def tiered_export_frames(tiered: TieredEngine, hashes: List[int]):
+    """Batched Raw wire frames spanning HBM + tiers (the tier-aware
+    counterpart of ``transfer.export_frames``; shared by the RPC and bulk
+    planes so neither silently misses tier-resident blocks). Runs under
+    ``run_exclusive``."""
+    from dynamo_tpu.engine.transfer import BLOCKS_PER_FRAME
+    from dynamo_tpu.runtime.codec import Raw
+
+    blocks = collect_tiered_blocks(tiered, hashes)
+    frames = []
+    for i in range(0, len(blocks), BLOCKS_PER_FRAME):
+        chunk = blocks[i:i + BLOCKS_PER_FRAME]
+        data = np.ascontiguousarray(
+            np.stack([b.data for b in chunk], axis=0))
+        frames.append(Raw({
+            "blocks": [[b.block_hash, b.local_hash, b.parent_hash]
+                       for b in chunk],
+            "dtype": str(data.dtype),
+            "block_shape": list(data.shape[1:]),
+        }, data))
+    return frames
+
+
 def serve_tiered_kv_export(tiered: TieredEngine):
     """RPC handler: like ``transfer.serve_kv_export`` but also serves
     blocks held only in this worker's G2/G3 tiers — the provider side of
     the G4 remote tier (peers fetch what fell out of our HBM)."""
-    from dynamo_tpu.engine.transfer import (
-        BLOCKS_PER_FRAME, export_blocks)
-    from dynamo_tpu.runtime.codec import Raw
-
-    def _collect(hashes: List[int]) -> List[BlockPayload]:
-        # HBM-resident prefix first (device gather), then continue the
-        # chain from the tiers; stop at the first total miss
-        blocks = export_blocks(tiered.engine, hashes)
-        with tiered._tier_lock:
-            for h in hashes[len(blocks):]:
-                blk = tiered._lookup(h)
-                if blk is None:
-                    break
-                blocks.append(blk)
-        return blocks
 
     async def handler(payload, ctx):
         hashes = list((payload or {}).get("block_hashes", []))
-        blocks = await tiered.engine.run_exclusive(_collect, hashes)
         if int((payload or {}).get("wire", 1)) >= 2:
-            for i in range(0, len(blocks), BLOCKS_PER_FRAME):
-                chunk = blocks[i:i + BLOCKS_PER_FRAME]
-                data = np.ascontiguousarray(
-                    np.stack([b.data for b in chunk], axis=0))
-                yield Raw({
-                    "blocks": [[b.block_hash, b.local_hash, b.parent_hash]
-                               for b in chunk],
-                    "dtype": str(data.dtype),
-                    "block_shape": list(data.shape[1:]),
-                }, data)
+            frames = await tiered.engine.run_exclusive(
+                tiered_export_frames, tiered, hashes)
+            for f in frames:
+                yield f
         else:
+            blocks = await tiered.engine.run_exclusive(
+                collect_tiered_blocks, tiered, hashes)
             for b in blocks:
                 yield b.to_wire()
 
     return handler
 
 
-__all__ = ["TieredEngine", "TieredKvConfig", "serve_tiered_kv_export"]
+def serve_tiered_kv_export_bulk(tiered: TieredEngine, loop):
+    """Bulk-plane handler spanning HBM + tiers (tier-aware counterpart of
+    ``transfer.serve_kv_export_bulk``) — without this, the PREFERRED
+    transport would silently truncate chains at the first tier-resident
+    block."""
+    import asyncio as _aio
+
+    def handler(payload):
+        hashes = list((payload or {}).get("block_hashes", []))
+        fut = _aio.run_coroutine_threadsafe(
+            tiered.engine.run_exclusive(tiered_export_frames, tiered,
+                                        hashes), loop)
+        for f in fut.result(timeout=120.0):
+            yield f.obj, f.raw
+
+    return handler
+
+
+__all__ = ["TieredEngine", "TieredKvConfig", "serve_tiered_kv_export",
+           "serve_tiered_kv_export_bulk", "tiered_export_frames",
+           "collect_tiered_blocks"]

@@ -27,12 +27,15 @@ computation with the same global arrays**.
     no step message can be missed; the leader serves only after the barrier
     completes.
 
-Scope (honest): KV-block export/import, tiered offload, and the embeddings
-path mutate ``engine.pages`` outside the step stream and are not yet
-broadcast — multi-host workers reject those (single-host workers are
-unaffected). Batch-dim (dp) sharding across hosts would also need sampled
-tokens gathered to rank 0; the multi-host mesh therefore shards tp/sp only,
-where step outputs are replicated and every rank can read them locally.
+KV-block export/import, tiered offload (KVBM), and embeddings also ride
+the broadcast stream: the engine's ``dispatch_gather_pages`` /
+``scatter_pages_host`` / ``_embed_batch`` tap "gather"/"scatter"/"embed"
+messages before dispatch, so every rank joins those jits on the globally
+sharded cache (gathers produce replicated outputs the leader reads
+locally) — disagg P/D and KVBM therefore compose with multi-host workers.
+Scope (honest): batch-dim (dp) sharding across hosts would need sampled
+tokens gathered to rank 0; the multi-host mesh shards tp/sp only, where
+step outputs are replicated and every rank can read them locally.
 """
 
 from __future__ import annotations

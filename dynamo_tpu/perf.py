@@ -93,34 +93,89 @@ class RecordedStream:
 
 
 @dataclass
+class CloseCall:
+    """One near-tied sampling decision (the reference reports these per
+    position so regressions that flip outputs can be localized)."""
+
+    position: int
+    margin: float              # top-1 minus top-2 logprob (nats)
+    chosen_logprob: float
+    candidates: List[float]    # top-K logprobs, best first
+
+
+@dataclass
 class LogprobAnalysis:
     """Distribution analytics over sampled logprobs + top-K alternatives.
 
     Parity: reference ``lib/llm/src/perf/logprobs.rs`` (sequence logprob
-    distributions, close-call counting on top-1/top-2 margins, rank
-    tracking). ``margins[i]`` is the logprob gap between the best and
-    second-best candidate at step i — the decisive confidence signal the
-    reference uses to find tokens a nearly-tied distribution could flip;
-    ``ranks[i]`` is the sampled token's position in the top-K (0 = argmax,
-    K = fell outside)."""
+    distributions, close-call detection on top-1/top-2 margins, rank
+    tracking, per-position entropy). ``margins[i]`` is the logprob gap
+    between the best and second-best candidate at step i — the decisive
+    confidence signal the reference uses to find tokens a nearly-tied
+    distribution could flip; ``ranks[i]`` is the sampled token's position
+    in the top-K (0 = argmax, K = fell outside); ``entropies[i]`` is the
+    distribution entropy over the observed top-K plus the residual tail
+    mass as one bucket (a lower bound on full-vocab entropy — exact over
+    the head, collapsing the tail)."""
 
     chosen: List[float] = field(default_factory=list)
     margins: List[float] = field(default_factory=list)
     ranks: List[int] = field(default_factory=list)
+    entropies: List[float] = field(default_factory=list)
+    tops: List[List[float]] = field(default_factory=list)
 
     @classmethod
     def from_tokens(cls, chosen: List[float],
                     tops: List[Dict[int, float]]) -> "LogprobAnalysis":
+        import math
         margins: List[float] = []
         ranks: List[int] = []
+        entropies: List[float] = []
+        top_vals: List[List[float]] = []
         for i, top in enumerate(tops):
             vals = sorted(top.values(), reverse=True)
+            top_vals.append(vals)
             if len(vals) >= 2:
                 margins.append(vals[0] - vals[1])
             if i < len(chosen):
                 # rank by count of alternatives strictly better than chosen
                 ranks.append(sum(1 for v in vals if v > chosen[i] + 1e-9))
-        return cls(chosen=list(chosen), margins=margins, ranks=ranks)
+            if vals:
+                # entropy over top-K probabilities + one residual bucket
+                # for the unobserved tail (treats the tail as a single
+                # outcome, so this lower-bounds full-vocab entropy over
+                # the tail while being exact over the head)
+                probs = [math.exp(v) for v in vals]
+                tail = max(0.0, 1.0 - sum(probs))
+                if tail > 1e-12:
+                    probs.append(tail)
+                entropies.append(-sum(p * math.log(p)
+                                      for p in probs if p > 0.0))
+        return cls(chosen=list(chosen), margins=margins, ranks=ranks,
+                   entropies=entropies, tops=top_vals)
+
+    @classmethod
+    def from_openai_chunks(cls, chunks: List[Any]) -> "LogprobAnalysis":
+        """Build the analysis from recorded OpenAI chat chunks (dicts or
+        chunk objects with ``choices[].logprobs.content`` entries) — the
+        reference analyzes recorded response streams the same way
+        (``perf/logprobs.rs`` over SSE captures), so analytics work on
+        what actually crossed the wire, not only engine-internal frames."""
+        chosen: List[float] = []
+        tops: List[Dict[int, float]] = []
+        for ch in chunks:
+            d = ch if isinstance(ch, dict) else getattr(
+                ch, "to_dict", lambda: {})()
+            for choice in d.get("choices", []):
+                content = ((choice.get("logprobs") or {}).get("content")
+                           or [])
+                for entry in content:
+                    chosen.append(float(entry.get("logprob", 0.0)))
+                    alt = {i: float(t.get("logprob", 0.0))
+                           for i, t in enumerate(
+                               entry.get("top_logprobs") or [])}
+                    tops.append(alt)
+        return cls.from_tokens(chosen, tops)
 
     # -- scalars -------------------------------------------------------------
 
@@ -137,6 +192,39 @@ class LogprobAnalysis:
         nats — a tiny numerics or sampling change could flip the output."""
         return sum(1 for m in self.margins if m <= margin_threshold)
 
+    def close_call_details(self, margin_threshold: float = 0.1
+                           ) -> List[CloseCall]:
+        """The near-tied positions themselves, with their candidate sets
+        (reference behavior: localize WHICH tokens could flip, not just
+        how many)."""
+        out: List[CloseCall] = []
+        for i, vals in enumerate(self.tops):
+            if len(vals) >= 2 and vals[0] - vals[1] <= margin_threshold:
+                out.append(CloseCall(
+                    position=i, margin=vals[0] - vals[1],
+                    chosen_logprob=(self.chosen[i]
+                                    if i < len(self.chosen) else 0.0),
+                    candidates=list(vals)))
+        return out
+
+    def low_confidence_spans(self, margin_threshold: float = 0.1,
+                             min_len: int = 2) -> List[tuple]:
+        """(start, end) position ranges of >= ``min_len`` CONSECUTIVE
+        close calls — sustained uncertainty (hallucination-prone spans)
+        rather than isolated coin flips."""
+        flags = [len(v) >= 2 and v[0] - v[1] <= margin_threshold
+                 for v in self.tops]
+        spans: List[tuple] = []
+        start = None
+        for i, f in enumerate(flags + [False]):
+            if f and start is None:
+                start = i
+            elif not f and start is not None:
+                if i - start >= min_len:
+                    spans.append((start, i))
+                start = None
+        return spans
+
     def non_greedy_tokens(self) -> int:
         """Sampled tokens that were NOT the argmax (rank > 0)."""
         return sum(1 for r in self.ranks if r > 0)
@@ -147,6 +235,10 @@ class LogprobAnalysis:
             hist[r] = hist.get(r, 0) + 1
         return hist
 
+    def mean_entropy(self) -> float:
+        return (sum(self.entropies) / len(self.entropies)
+                if self.entropies else 0.0)
+
     def summary(self) -> Dict[str, float]:
         out = {
             "tokens": float(len(self.chosen)),
@@ -154,11 +246,15 @@ class LogprobAnalysis:
             "perplexity": self.perplexity(),
             "close_calls": float(self.close_calls()),
             "non_greedy_tokens": float(self.non_greedy_tokens()),
+            "mean_entropy": self.mean_entropy(),
         }
         if self.margins:
             s = sorted(self.margins)
             out["margin_p50"] = s[len(s) // 2]
             out["margin_min"] = s[0]
+        if self.entropies:
+            e = sorted(self.entropies)
+            out["entropy_p90"] = e[min(len(e) - 1, int(len(e) * 0.9))]
         return out
 
 
@@ -175,4 +271,4 @@ async def record_stream(stream: AsyncIterator[Any],
 
 
 __all__ = ["RecordedStream", "TimestampedResponse", "record_stream",
-           "LogprobAnalysis"]
+           "LogprobAnalysis", "CloseCall"]

@@ -85,7 +85,16 @@ class MultiPerfInterpolator:
 
     def __init__(self, profile: Dict[str, Any]):
         configs = profile.get("configs")
+        if configs == []:
+            raise ValueError(
+                "profile has an empty 'configs' list — the parallelism "
+                "sweep skipped every config (not enough devices?); "
+                "re-profile with feasible (tp, sp) sizes")
         if not configs:
+            if "prefill" not in profile or "decode" not in profile:
+                raise ValueError(
+                    "profile has neither 'configs' nor flat "
+                    "'prefill'/'decode' surfaces")
             # flat single-config profile: one option, 1 chip
             configs = [{"tp": 1, "sp": 1, "chips": 1,
                         "prefill": profile["prefill"],

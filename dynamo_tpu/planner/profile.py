@@ -268,6 +268,11 @@ async def profile_parallelism_sweep(args) -> Dict:
                         "decode": prof["decode"]})
         print(f"profile: tp={tp} sp={sp} done "
               f"({len(prof['prefill'])}+{len(prof['decode'])} rows)")
+    if not configs:
+        raise SystemExit(
+            "parallelism sweep produced NO configs: every (tp, sp) needs "
+            f"more than the {len(jax.devices())} available devices — "
+            "writing an empty profile would crash the planner at startup")
     return {"configs": configs,
             "meta": {"engine": "jax", "model": args.model_path,
                      "osl": args.osl}}

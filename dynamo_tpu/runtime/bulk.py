@@ -244,7 +244,9 @@ def _connect(address: str, timeout: float) -> socket.socket:
 def bulk_fetch(address: str, endpoint: str, payload: Any,
                ident: str = "", timeout: float = 60.0,
                on_frame: Optional[Callable[[Dict[str, Any], Any], None]]
-               = None) -> List[Tuple[Dict[str, Any], bytes]]:
+               = None,
+               stop: Optional[threading.Event] = None
+               ) -> List[Tuple[Dict[str, Any], bytes]]:
     """Synchronous bulk fetch (run via ``asyncio.to_thread`` from async
     code). ``ident`` is the server identity the caller expects (the
     instance id) — a mismatched server refuses instead of silently serving
@@ -279,6 +281,10 @@ def bulk_fetch(address: str, endpoint: str, payload: Any,
                 raise RuntimeError(f"bulk fetch failed: {meta['error']}")
             if meta.get("final"):
                 return out
+            if stop is not None and stop.is_set():
+                # consumer aborted (e.g. injection failed): stop reading
+                # instead of streaming the rest into the void
+                raise ConnectionError("bulk fetch aborted by consumer")
             if on_frame is not None:
                 on_frame(meta, raw)
             else:

@@ -703,6 +703,55 @@ class CoordClient:
         await self._call("unsubscribe", sub_id=sub_id)
         self._orphan_msgs.pop(sub_id, None)
 
+    # -- object store ------------------------------------------------------
+    # (reference: NATS object store carrying model-card artifacts,
+    # ``transports/nats.rs:123-176``.) Implemented client-side on the KV
+    # plane: ``obj/{bucket}/{name}/meta`` + ``/c{i}`` chunk keys. Chunking
+    # keeps any single KV value (and coordinator frame) small even for
+    # multi-MB artifacts like inlined tokenizers.
+
+    OBJ_CHUNK = 1 << 20  # 1 MiB per chunk
+
+    @staticmethod
+    def _obj_prefix(bucket: str, name: str) -> str:
+        return f"obj/{bucket}/{name}/"
+
+    async def obj_put(self, bucket: str, name: str, data: bytes,
+                      lease_id: int = 0) -> int:
+        """Store an object as chunked KV entries; returns chunk count.
+        Attach a lease to make the object vanish with its owner."""
+        prefix = self._obj_prefix(bucket, name)
+        n = max(1, -(-len(data) // self.OBJ_CHUNK))
+        for i in range(n):
+            chunk = data[i * self.OBJ_CHUNK:(i + 1) * self.OBJ_CHUNK]
+            await self.put(f"{prefix}c{i:06d}", chunk, lease_id=lease_id)
+        import json as _json
+        await self.put(f"{prefix}meta",
+                       _json.dumps({"size": len(data),
+                                    "chunks": n}).encode(),
+                       lease_id=lease_id)
+        return n
+
+    async def obj_get(self, bucket: str, name: str) -> Optional[bytes]:
+        """Fetch a stored object, or None when absent/incomplete."""
+        prefix = self._obj_prefix(bucket, name)
+        meta_raw = await self.get(f"{prefix}meta")
+        if meta_raw is None:
+            return None
+        import json as _json
+        meta = _json.loads(meta_raw)
+        parts = []
+        for i in range(int(meta["chunks"])):
+            c = await self.get(f"{prefix}c{i:06d}")
+            if c is None:
+                return None  # torn write/expiry mid-read
+            parts.append(c)
+        data = b"".join(parts)
+        return data if len(data) == int(meta["size"]) else None
+
+    async def obj_delete(self, bucket: str, name: str) -> int:
+        return await self.delete_prefix(self._obj_prefix(bucket, name))
+
     # -- work queues -------------------------------------------------------
 
     async def queue_push(self, queue: str, payload: bytes) -> int:

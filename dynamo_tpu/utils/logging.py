@@ -1,6 +1,20 @@
-"""Logging configuration (parity: reference ``lib/runtime/src/logging.rs`` +
-``configure_dynamo_logging``): env-filter via ``DYN_LOG``, optional JSONL via
-``DYN_LOGGING_JSONL``."""
+"""Logging configuration.
+
+Parity: reference ``lib/runtime/src/logging.rs:53-122`` (``logging::init``)
+and the Python mirror ``configure_dynamo_logging``
+(``bindings/python/src/dynamo/runtime/logging.py``):
+
+- **env filter** via ``DYN_LOG``: tracing-EnvFilter-style directives —
+  a default level plus per-target overrides, e.g.
+  ``DYN_LOG=info,dynamo_tpu.engine=debug,dynamo_tpu.kv_router=warning``.
+- **JSONL sink** via ``DYN_LOGGING_JSONL``: a file path appends one JSON
+  object per record there (stderr keeps the human format); the values
+  ``1``/``true`` switch the stderr handler itself to JSONL.
+- **TOML config** via ``DYN_LOGGING_CONFIG_PATH``: a ``[logging]`` table
+  overriding the same knobs (env still wins, matching figment layering).
+- **local timezone** opt-in (``DYN_LOGGING_LOCAL_TZ``): timestamps in
+  local time instead of UTC.
+"""
 
 from __future__ import annotations
 
@@ -9,13 +23,18 @@ import logging
 import os
 import sys
 import time
-from typing import Optional
+from typing import Dict, Optional, Tuple
 
 
 class JsonlFormatter(logging.Formatter):
+    def __init__(self, local_tz: bool = False):
+        super().__init__()
+        self._tz = time.localtime if local_tz else time.gmtime
+
     def format(self, record: logging.LogRecord) -> str:
         out = {
-            "time": time.strftime("%Y-%m-%dT%H:%M:%S", time.gmtime(record.created)),
+            "time": time.strftime("%Y-%m-%dT%H:%M:%S",
+                                  self._tz(record.created)),
             "level": record.levelname,
             "target": record.name,
             "message": record.getMessage(),
@@ -25,19 +44,80 @@ class JsonlFormatter(logging.Formatter):
         return json.dumps(out)
 
 
+def parse_env_filter(spec: str) -> Tuple[int, Dict[str, int]]:
+    """``"info,pkg.mod=debug"`` -> (default level, per-target levels).
+
+    Unknown level names fall back to INFO (never crash process startup
+    over a typo in an env var — matches the reference's lenient parse)."""
+    default = logging.INFO
+    targets: Dict[str, int] = {}
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" in part:
+            name, _, lvl = part.partition("=")
+            targets[name.strip()] = getattr(logging, lvl.strip().upper(),
+                                            logging.INFO)
+        else:
+            default = getattr(logging, part.upper(), logging.INFO)
+    return default, targets
+
+
+def _load_toml_config(path: str) -> dict:
+    import tomllib
+
+    with open(path, "rb") as f:
+        data = tomllib.load(f)
+    return data.get("logging", data)
+
+
 def configure_logging(level: Optional[str] = None) -> None:
-    level = level or os.environ.get("DYN_LOG", "info")
-    numeric = getattr(logging, level.upper(), logging.INFO)
-    handler = logging.StreamHandler(sys.stderr)
-    if os.environ.get("DYN_LOGGING_JSONL"):
-        handler.setFormatter(JsonlFormatter())
+    """Install handlers per the layered config: defaults <- TOML <- env.
+
+    Reference semantics (``logging.rs``): one stderr sink (human or JSONL)
+    plus an optional JSONL file sink; per-target level directives."""
+    conf: dict = {}
+    toml_path = os.environ.get("DYN_LOGGING_CONFIG_PATH")
+    if toml_path:
+        try:
+            conf = _load_toml_config(toml_path)
+        except Exception:  # noqa: BLE001 — bad config must not kill startup
+            logging.getLogger(__name__).warning(
+                "could not read DYN_LOGGING_CONFIG_PATH=%s", toml_path)
+
+    spec = (level or os.environ.get("DYN_LOG")
+            or conf.get("level") or "info")
+    default_level, target_levels = parse_env_filter(str(spec))
+    # TOML [logging.targets] table merges under env directives
+    for name, lvl in (conf.get("targets") or {}).items():
+        target_levels.setdefault(
+            name, getattr(logging, str(lvl).upper(), logging.INFO))
+
+    jsonl = os.environ.get("DYN_LOGGING_JSONL", conf.get("jsonl", ""))
+    local_tz = bool(os.environ.get("DYN_LOGGING_LOCAL_TZ",
+                                   conf.get("local_tz", "")))
+
+    stderr_handler = logging.StreamHandler(sys.stderr)
+    if str(jsonl).lower() in ("1", "true"):
+        stderr_handler.setFormatter(JsonlFormatter(local_tz))
     else:
-        handler.setFormatter(logging.Formatter(
+        stderr_handler.setFormatter(logging.Formatter(
             "%(asctime)s %(levelname)s %(name)s: %(message)s"))
+    handlers = [stderr_handler]
+    if jsonl and str(jsonl).lower() not in ("1", "true"):
+        # a path: append JSONL records there alongside stderr
+        file_handler = logging.FileHandler(str(jsonl))
+        file_handler.setFormatter(JsonlFormatter(local_tz))
+        handlers.append(file_handler)
+
     root = logging.getLogger()
     root.handlers.clear()
-    root.addHandler(handler)
-    root.setLevel(numeric)
+    for h in handlers:
+        root.addHandler(h)
+    root.setLevel(default_level)
+    for name, lvl in target_levels.items():
+        logging.getLogger(name).setLevel(lvl)
 
 
-__all__ = ["configure_logging", "JsonlFormatter"]
+__all__ = ["configure_logging", "JsonlFormatter", "parse_env_filter"]

@@ -325,15 +325,18 @@ class DisaggDecodeHandler:
             # asyncio queue; frame k injects while k+1 is still on the
             # wire — same pipelining the RPC branch gets from its async
             # iterator, without buffering the whole prefix in RAM
+            import threading
             loop = asyncio.get_running_loop()
             frame_q: asyncio.Queue = asyncio.Queue()
+            abort = threading.Event()
 
             def on_frame(meta, raw):
                 loop.call_soon_threadsafe(frame_q.put_nowait, (meta, raw))
 
             fetch = asyncio.create_task(asyncio.to_thread(
                 bulk_fetch, bulk_address, KV_EXPORT_ENDPOINT,
-                {"block_hashes": hashes}, f"{iid:x}", 60.0, on_frame))
+                {"block_hashes": hashes}, f"{iid:x}", 60.0, on_frame,
+                abort))
             try:
                 while True:
                     get = asyncio.ensure_future(frame_q.get())
@@ -361,7 +364,16 @@ class DisaggDecodeHandler:
             except Exception as e:  # noqa: BLE001 — bulk plane unreachable
                 # (e.g. worker bound to 127.0.0.1 across hosts): the RPC
                 # export path below still works — never waste the completed
-                # remote prefill over a transport problem
+                # remote prefill over a transport problem. Tell the fetch
+                # thread to stop and reap its task so it neither streams
+                # frames into the void nor logs an unretrieved exception.
+                abort.set()
+                if not fetch.done():
+                    fetch.cancel()
+                try:
+                    await fetch
+                except (Exception, asyncio.CancelledError):  # noqa: BLE001
+                    pass
                 logger.warning("bulk KV fetch from %s failed (%s); falling "
                                "back to the RPC export path",
                                bulk_address, e)

@@ -136,9 +136,6 @@ async def amain(args: argparse.Namespace) -> None:
 
     multihost = args.num_nodes > 1
     if multihost:
-        if args.disagg != "none":
-            raise SystemExit("--disagg is not supported with --num-nodes>1 "
-                             "(KV export/import bypasses the step stream)")
         if args.jax_coordinator is None:
             raise SystemExit("--jax-coordinator required with --num-nodes>1")
         # must precede any jax backend use (build_engine, jax.devices)
@@ -167,11 +164,9 @@ async def amain(args: argparse.Namespace) -> None:
 
     tiered = None
     if args.host_cache_bytes > 0 or args.disk_cache_bytes > 0:
-        if multihost:
-            raise SystemExit(
-                "KVBM tiers are not supported with --num-nodes>1: tier "
-                "gathers/scatters would run rank-0-only jits on the "
-                "globally sharded cache and wedge the group")
+        # multihost OK: tier gathers/scatters ride the broadcast step
+        # stream (engine.dispatch_gather_pages / scatter_pages_host), so
+        # every rank joins the jits on the sharded cache
         if args.disagg == "decode":
             raise SystemExit(
                 "KVBM tiers with --disagg decode are not supported yet: "
@@ -256,22 +251,28 @@ async def amain(args: argparse.Namespace) -> None:
             host=args.bulk_host,
             unix_path=f"/tmp/dynamo_tpu_bulk_{lease.lease_id:x}.sock",
             ident=f"{lease.lease_id:x}").start()
-        bulk_server.register(KV_EXPORT_ENDPOINT, serve_kv_export_bulk(
-            engine, asyncio.get_running_loop()))
         if tiered is not None:
-            # tier-aware export: peers and decode workers can fetch blocks
-            # that fell out of this worker's HBM into G2/G3
-            from dynamo_tpu.kvbm.manager import serve_tiered_kv_export
+            # tier-aware export on BOTH planes: peers and decode workers
+            # can fetch blocks that fell out of this worker's HBM into
+            # G2/G3 whichever transport they pick
+            from dynamo_tpu.kvbm.manager import (
+                serve_tiered_kv_export, serve_tiered_kv_export_bulk)
             kv_handler = serve_tiered_kv_export(tiered)
+            bulk_handler = serve_tiered_kv_export_bulk(
+                tiered, asyncio.get_running_loop())
         else:
             kv_handler = serve_kv_export(engine)
+            bulk_handler = serve_kv_export_bulk(
+                engine, asyncio.get_running_loop())
+        bulk_server.register(KV_EXPORT_ENDPOINT, bulk_handler)
         await kv_ep.serve(kv_handler, bulk_address=bulk_server.address)
         await register_llm(drt, endpoint, card, model_type="prefill")
         # pull-based prefill queue consumer (reference PrefillQueue role):
         # decode workers enqueue; the first free prefill worker takes a job
         from dynamo_tpu.worker.disagg import PrefillQueueWorker
         queue_worker = await PrefillQueueWorker(
-            engine, drt, args.namespace, instance_id=lease.lease_id,
+            tiered if tiered is not None else engine, drt, args.namespace,
+            instance_id=lease.lease_id,
             bulk_address=bulk_server.address).start()
     else:
         await register_llm(drt, endpoint, card)

@@ -119,3 +119,77 @@ class TestEmbeddings:
         finally:
             await service.stop()
             await eng.stop()
+
+
+# -- logging config (parity: logging.rs:53-122) ------------------------------
+
+class TestLoggingConfig:
+    def _reset(self):
+        import logging as L
+        root = L.getLogger()
+        root.handlers.clear()
+        for name in list(L.Logger.manager.loggerDict):
+            if name.startswith("fake_target"):
+                L.getLogger(name).setLevel(L.NOTSET)
+
+    def test_env_filter_per_target_levels(self, monkeypatch):
+        import logging as L
+
+        from dynamo_tpu.utils.logging import (
+            configure_logging, parse_env_filter)
+        default, targets = parse_env_filter(
+            "warning,fake_target.engine=debug,fake_target.router=error")
+        assert default == L.WARNING
+        assert targets == {"fake_target.engine": L.DEBUG,
+                           "fake_target.router": L.ERROR}
+        monkeypatch.setenv(
+            "DYN_LOG", "warning,fake_target.engine=debug")
+        self._reset()
+        configure_logging()
+        assert L.getLogger().level == L.WARNING
+        assert L.getLogger("fake_target.engine").level == L.DEBUG
+        # typo'd level never crashes startup
+        assert parse_env_filter("nonsense")[0] == L.INFO
+        self._reset()
+
+    def test_jsonl_file_sink(self, tmp_path, monkeypatch):
+        import logging as L
+
+        from dynamo_tpu.utils.logging import configure_logging
+        sink = tmp_path / "log.jsonl"
+        monkeypatch.setenv("DYN_LOGGING_JSONL", str(sink))
+        monkeypatch.setenv("DYN_LOG", "info")
+        self._reset()
+        configure_logging()
+        L.getLogger("fake_target.sink").info("hello %s", "world")
+        for h in L.getLogger().handlers:
+            h.flush()
+        import json as J
+        lines = [J.loads(x) for x in
+                 sink.read_text().strip().splitlines()]
+        assert lines and lines[-1]["message"] == "hello world"
+        assert lines[-1]["target"] == "fake_target.sink"
+        assert lines[-1]["level"] == "INFO"
+        self._reset()
+
+    def test_toml_config_layered_under_env(self, tmp_path, monkeypatch):
+        import logging as L
+
+        from dynamo_tpu.utils.logging import configure_logging
+        cfg = tmp_path / "logging.toml"
+        cfg.write_text(
+            '[logging]\nlevel = "error"\n'
+            '[logging.targets]\n"fake_target.toml" = "debug"\n')
+        monkeypatch.setenv("DYN_LOGGING_CONFIG_PATH", str(cfg))
+        monkeypatch.delenv("DYN_LOG", raising=False)
+        monkeypatch.delenv("DYN_LOGGING_JSONL", raising=False)
+        self._reset()
+        configure_logging()
+        assert L.getLogger().level == L.ERROR
+        assert L.getLogger("fake_target.toml").level == L.DEBUG
+        # env wins over TOML (figment layering)
+        monkeypatch.setenv("DYN_LOG", "warning")
+        self._reset()
+        configure_logging()
+        assert L.getLogger().level == L.WARNING
+        self._reset()

@@ -172,3 +172,28 @@ async def test_queue_dead_puller_skipped():
             # push must not vanish into the dead puller
             await alive.queue_push("jobs", b"x")
             assert (await alive.queue_pull("jobs", timeout=1.0))[0] == b"x"
+
+
+# -- object store (chunked, on the KV plane) ---------------------------------
+
+async def test_object_store_roundtrip_and_chunking():
+    async with Coordinator() as coord:
+        async with CoordClient(coord.address) as c:
+            big = bytes(range(256)) * 10_000  # 2.56 MB -> 3 chunks
+            n = await c.obj_put("cards", "llama", big)
+            assert n == 3
+            got = await c.obj_get("cards", "llama")
+            assert got == big
+            assert await c.obj_get("cards", "missing") is None
+            assert await c.obj_delete("cards", "llama") == 4  # 3 + meta
+            assert await c.obj_get("cards", "llama") is None
+
+
+async def test_object_store_lease_expiry():
+    async with Coordinator() as coord:
+        async with CoordClient(coord.address) as c:
+            lease = await c.grant_lease(ttl=0.6, keepalive=False)
+            await c.obj_put("b", "o", b"x" * 100, lease_id=lease.lease_id)
+            assert await c.obj_get("b", "o") == b"x" * 100
+            await asyncio.sleep(1.5)
+            assert await c.obj_get("b", "o") is None

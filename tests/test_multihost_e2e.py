@@ -77,3 +77,81 @@ def test_two_process_tp8_serving(tmp_path):
                 await p.stop()
 
     asyncio.run(asyncio.wait_for(_main(), timeout=300))
+
+
+def mh_disagg_decode_worker(coord_port: int, model_dir: str, rank: int,
+                            jax_port: int):
+    """Multi-host DECODE worker group: --disagg decode over 2 ranks."""
+    ready = ("jax worker serving" if rank == 0
+             else "multihost follower rank 1 in lockstep")
+    return ManagedProcess(
+        ["dynamo_tpu.worker.main", "--coordinator", f"127.0.0.1:{coord_port}",
+         "--model-path", model_dir, "--model-name", "mh-model",
+         "--random-weights", "--tensor-parallel-size", "8",
+         "--num-nodes", "2", "--node-rank", str(rank),
+         "--jax-coordinator", f"127.0.0.1:{jax_port}",
+         "--local-devices", "4", "--no-kv-events",
+         "--disagg", "decode", "--component", "tpu",
+         "--prefill-component", "prefill",
+         "--page-size", "4", "--num-pages", "64", "--max-num-seqs", "2",
+         "--max-prefill-chunk", "16", "--max-context", "128"],
+        name=f"mh-dec-{rank}", ready_line=ready, timeout=150.0,
+        env_overrides={"XLA_FLAGS": "", "DYN_LOG": "debug"})
+
+
+def prefill_worker(coord_port: int, model_dir: str):
+    return ManagedProcess(
+        ["dynamo_tpu.worker.main", "--coordinator", f"127.0.0.1:{coord_port}",
+         "--model-path", model_dir, "--model-name", "mh-model",
+         "--random-weights", "--no-kv-events",
+         "--disagg", "prefill", "--component", "prefill",
+         "--page-size", "4", "--num-pages", "64", "--max-num-seqs", "2",
+         "--max-prefill-chunk", "16", "--max-context", "128"],
+        name="prefill", ready_line="jax worker serving", timeout=120.0)
+
+
+def test_disagg_over_multihost(tmp_path):
+    """VERDICT r2 item 6: a MULTI-HOST decode worker receives transferred
+    KV blocks — the inject rides the broadcast step stream as a "scatter"
+    op every rank joins. Prefill runs on a separate single-chip worker."""
+    model_dir = make_test_model_dir(
+        str(tmp_path / "mh-model"),
+        num_attention_heads=8, num_key_value_heads=8)
+
+    async def _main():
+        coord_port, http_port, jax_port = free_port(), free_port(), free_port()
+        base = f"http://127.0.0.1:{http_port}"
+        long_prompt = ("tell me about mountains and rivers and forests "
+                       "and deserts and oceans and glaciers far away")
+        body = {"model": "mh-model", "max_tokens": 4, "temperature": 0.0,
+                "messages": [{"role": "user", "content": long_prompt}]}
+        fe = frontend(coord_port, http_port)
+        pre = prefill_worker(coord_port, str(tmp_path / "mh-model"))
+        w0 = mh_disagg_decode_worker(coord_port, str(tmp_path / "mh-model"),
+                                     0, jax_port)
+        w1 = mh_disagg_decode_worker(coord_port, str(tmp_path / "mh-model"),
+                                     1, jax_port)
+        try:
+            await fe.start()
+            await pre.start()
+            await asyncio.gather(w0.start(), w1.start())
+            await wait_model(base, "mh-model", timeout=60.0)
+            async with aiohttp.ClientSession() as s:
+                r = await (await s.post(
+                    f"{base}/v1/chat/completions", json=body,
+                    timeout=aiohttp.ClientTimeout(total=150))).json()
+                assert r["choices"][0]["finish_reason"] == "length"
+                assert r["usage"]["completion_tokens"] == 4
+            # the decode leader really injected transferred blocks (the
+            # broadcast scatter ran) — visible in its debug log
+            assert await w0.drain_until("injected", timeout=5.0), \
+                "no KV injection on decode leader"
+            log0 = "".join(w0.lines)
+            assert "falling back local" not in log0
+            assert w0.proc.poll() is None and w1.proc.poll() is None
+            assert pre.proc.poll() is None
+        finally:
+            for p in (w1, w0, pre, fe):
+                await p.stop()
+
+    asyncio.run(asyncio.wait_for(_main(), timeout=300))
