@@ -22,7 +22,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-AXES = ("dp", "tp", "sp", "ep")
+AXES = ("dp", "pp", "tp", "sp", "ep")
 
 
 @dataclass(frozen=True)
@@ -30,16 +30,18 @@ class MeshSpec:
     """Logical mesh shape; unspecified axes default to 1."""
 
     dp: int = 1
+    pp: int = 1
     tp: int = 1
     sp: int = 1
     ep: int = 1
 
     @property
     def size(self) -> int:
-        return self.dp * self.tp * self.sp * self.ep
+        return self.dp * self.pp * self.tp * self.sp * self.ep
 
     def shape(self) -> Dict[str, int]:
-        return {"dp": self.dp, "tp": self.tp, "sp": self.sp, "ep": self.ep}
+        return {"dp": self.dp, "pp": self.pp, "tp": self.tp, "sp": self.sp,
+                "ep": self.ep}
 
     @classmethod
     def from_dict(cls, d: Dict[str, int]) -> "MeshSpec":
@@ -53,7 +55,7 @@ def make_mesh(spec: Optional[MeshSpec] = None,
               devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
     """Build a ``jax.sharding.Mesh`` with the canonical axis order.
 
-    Axis order is (dp, tp, sp, ep) — innermost axes get the
+    Axis order is (dp, pp, tp, sp, ep) — innermost axes get the
     fastest-varying device dimension, which on a TPU slice means ``tp``/``sp``
     neighbors sit on adjacent ICI links (jax device order is torus-major).
     """
@@ -63,7 +65,8 @@ def make_mesh(spec: Optional[MeshSpec] = None,
         raise ValueError(
             f"mesh spec {spec.shape()} needs {spec.size} devices, "
             f"have {len(devices)}")
-    arr = np.array(devices).reshape(spec.dp, spec.tp, spec.sp, spec.ep)
+    arr = np.array(devices).reshape(spec.dp, spec.pp, spec.tp, spec.sp,
+                                    spec.ep)
     return Mesh(arr, AXES)
 
 

@@ -1,0 +1,208 @@
+"""Pipeline parallelism: layer stages over the ``pp`` mesh axis.
+
+The reference never implements PP itself — it only forwards engine flags
+(SURVEY §2.7; ``launch/dynamo-run/src/main.rs:28``); the engines' PP is
+NCCL send/recv between layer shards. Here PP is built the XLA way
+(SURVEY §7 stage 8, "GSPMD stage partitioning"): ONE ``shard_map`` program
+in which
+
+- the layer-stacked parameter pytree and the stacked paged KV cache shard
+  their LAYER axis over ``pp`` — stage ``s`` holds layers
+  ``[s*L/pp, (s+1)*L/pp)`` and exactly those layers' KV pages, so paged
+  reads/writes stay stage-local with no cross-stage traffic;
+- the batch is split into microbatches that flow through the stages on a
+  ``lax.ppermute`` ring (the classic pipeline schedule: at tick ``t``
+  stage ``s`` works microbatch ``t - s``); with ``M`` microbatches the
+  pipeline runs ``M + pp - 1`` ticks and each stage idles only during
+  fill/drain ticks;
+- inactive ticks compute on garbage but their page writes are masked to
+  the reserved garbage page (``new_lens = 0``) and their outputs dropped,
+  keeping every tick shape-identical — the XLA-friendly alternative to
+  data-dependent control flow;
+- last-stage logits are collected per microbatch and ``psum``-broadcast
+  at the end, so every rank returns the full ``[B, vocab]`` (multi-host
+  leaders read results locally, like every other step family).
+
+Scope (honest): the in/out specs here stage the LAYER axis only; on a
+mesh that also has tp > 1 the weights replicate over tp within each stage
+(correct, not head-split). Extending the specs to ``P(pp, ..., tp)`` per
+leaf is the composition path once a deployment needs both at once.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.models.llama import (
+    _finish_layer,
+    _project_qkv,
+    _rms_norm,
+)
+from dynamo_tpu.ops.attention import paged_attention, write_kv
+
+
+def _param_specs(params: Dict[str, Any], pp_axis: str) -> Dict[str, Any]:
+    """Layer-stacked leaves shard axis 0 over pp; the rest replicate."""
+    layer_spec = {k: P(pp_axis) for k in params["layers"]}
+    specs: Dict[str, Any] = {k: P() for k in params if k != "layers"}
+    specs["layers"] = layer_spec
+    return specs
+
+
+def pipeline_forward(params: Dict[str, Any], cfg: ModelConfig,
+                     tokens: jnp.ndarray, positions: jnp.ndarray,
+                     pages: jnp.ndarray, page_table: jnp.ndarray,
+                     total_lens: jnp.ndarray, new_lens: jnp.ndarray,
+                     mesh: Mesh, pp_axis: str = "pp",
+                     n_microbatches: int | None = None
+                     ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Drop-in for ``llama.forward`` running the layers as a pp pipeline.
+
+    Requires ``cfg.num_layers %% pp == 0``. ``n_microbatches`` must divide
+    the batch; the default picks the LARGEST divisor of B that is <= pp —
+    M == pp keeps every stage busy in steady state, smaller batches run
+    with pipeline bubbles rather than failing. ``pages`` is the stacked
+    cache ``[L, N, 2, Hkv, ps, Dh]``.
+    """
+    n_stages = mesh.shape[pp_axis]
+    if n_stages == 1:
+        from dynamo_tpu.models.llama import forward
+        return forward(params, cfg, tokens, positions, pages, page_table,
+                       total_lens, new_lens)
+    if cfg.num_layers % n_stages:
+        raise ValueError(f"num_layers={cfg.num_layers} not divisible by "
+                         f"pp={n_stages}")
+    B = tokens.shape[0]
+    # default: the largest microbatch count <= pp that divides B (a small
+    # serving batch pipelines with bubbles rather than failing)
+    M = n_microbatches or max(m for m in range(1, n_stages + 1)
+                              if B % m == 0)
+    if B % M:
+        raise ValueError(f"batch {B} not divisible by n_microbatches={M}")
+    Bm = B // M
+    sm_scale = cfg.head_dim ** -0.5
+    layers_per_stage = cfg.num_layers // n_stages
+
+    def shard_fn(params, tokens, positions, page_table, total_lens,
+                 new_lens, pages_local):
+        stage = lax.axis_index(pp_axis)
+        last = n_stages - 1
+        # microbatch stacks [M, Bm, ...]
+        tok_mb = tokens.reshape(M, Bm, -1)
+        pos_mb = positions.reshape(M, Bm, -1)
+        tbl_mb = page_table.reshape(M, Bm, -1)
+        tot_mb = total_lens.reshape(M, Bm)
+        new_mb = new_lens.reshape(M, Bm)
+        S = tok_mb.shape[2]
+        H = cfg.hidden_size
+
+        # local layer ids are GLOBAL indices into the pp-sharded page
+        # stack's local slab (axis 0 of pages_local is layers_per_stage)
+        local_layer_ids = jnp.arange(layers_per_stage)
+
+        def run_stage(h, pages_local, pos, tbl, tot, new):
+            def body(carry, xs):
+                h, pages_local = carry
+                lp, lidx = xs
+                q, k, v = _project_qkv(cfg, lp, h, pos)
+                pages_local = write_kv(pages_local, lidx, k, v, tbl, pos,
+                                       new)
+                attn = paged_attention(q, pages_local, lidx, tbl, pos, tot,
+                                       sm_scale)
+                h = _finish_layer(cfg, lp, h, attn)
+                return (h, pages_local), None
+
+            (h, pages_local), _ = lax.scan(
+                body, (h, pages_local), (params["layers"], local_layer_ids))
+            return h, pages_local
+
+        def tick(t, carry):
+            pages_local, h_in, out = carry
+            m = t - stage                      # this stage's microbatch
+            active = jnp.logical_and(m >= 0, m < M)
+            mc = jnp.clip(m, 0, M - 1)
+            tok = lax.dynamic_index_in_dim(tok_mb, mc, keepdims=False)
+            pos = lax.dynamic_index_in_dim(pos_mb, mc, keepdims=False)
+            tbl = lax.dynamic_index_in_dim(tbl_mb, mc, keepdims=False)
+            tot = lax.dynamic_index_in_dim(tot_mb, mc, keepdims=False)
+            new = lax.dynamic_index_in_dim(new_mb, mc, keepdims=False)
+            # inactive ticks: mask page writes to the garbage page and let
+            # the compute produce don't-care values
+            new = jnp.where(active, new, 0)
+            h0 = params["embed"][tok]          # [Bm, S, H]
+            h = jnp.where(stage == 0, h0, h_in)
+            h, pages_local = run_stage(h, pages_local, pos, tbl, tot, new)
+            # last stage: record this microbatch's LAST-TOKEN hidden state
+            # (the vocab projection — the dominant small-batch matmul —
+            # runs ONCE after the loop, not per tick per stage)
+            last_idx = jnp.maximum(new, 1) - 1                 # [Bm]
+            h_last = jnp.take_along_axis(
+                h, last_idx[:, None, None].astype(jnp.int32), axis=1)[:, 0]
+            keep = jnp.logical_and(active, stage == last)
+            prev = lax.dynamic_index_in_dim(out, mc, keepdims=False)
+            out = lax.dynamic_update_index_in_dim(
+                out, jnp.where(keep, h_last, prev), mc, 0)
+            # hand the activation to the next stage (stage 0 re-embeds, so
+            # the value it receives is ignored)
+            h_next = lax.ppermute(
+                h, pp_axis, [(i, i + 1) for i in range(n_stages - 1)])
+            return pages_local, h_next, out
+
+        out0 = jnp.zeros((M, Bm, H), params["embed"].dtype)
+        h0 = jnp.zeros((Bm, S, H), params["embed"].dtype)
+        pages_local, _h, out = lax.fori_loop(
+            0, M + n_stages - 1, tick, (pages_local, h0, out0))
+        # only the last stage holds real hidden states; broadcast them,
+        # then project to the vocab once
+        out = lax.psum(
+            jnp.where(stage == last, out, jnp.zeros_like(out)), pp_axis)
+        hn = _rms_norm(out.reshape(B, H), params["final_norm"],
+                       cfg.rms_norm_eps)
+        lm_head = params.get("lm_head")
+        if lm_head is None:
+            lm_head = params["embed"].T
+        logits = hn.astype(jnp.float32) @ lm_head.astype(jnp.float32)
+        return logits, pages_local
+
+    specs_in = (
+        _param_specs(params, pp_axis),
+        P(), P(), P(), P(), P(),       # tokens/positions/table/total/new
+        P(pp_axis),                    # pages: layer axis staged
+    )
+    specs_out = (P(), P(pp_axis))
+    fn = jax.shard_map(shard_fn, mesh=mesh, in_specs=specs_in,
+                       out_specs=specs_out, check_vma=False)
+    logits, pages = fn(params, tokens, positions, page_table, total_lens,
+                       new_lens, pages)
+    return logits, pages
+
+
+def pp_sharding_fns(mesh: Mesh, pp_axis: str = "pp"):
+    """(shard_params_fn, shard_pages_fn) placing the layer-stacked leaves
+    and the stacked page cache on the pp axis — what a worker plugs into
+    ``JaxEngineConfig`` to serve with ``pipeline_forward``."""
+    from jax.sharding import NamedSharding
+
+    def shard_params(params):
+        out = dict(params)
+        out["layers"] = {
+            k: jax.device_put(v, NamedSharding(mesh, P(pp_axis)))
+            for k, v in params["layers"].items()}
+        for k, v in params.items():
+            if k != "layers":
+                out[k] = jax.device_put(v, NamedSharding(mesh, P()))
+        return out
+
+    def shard_pages(pages):
+        return jax.device_put(pages, NamedSharding(mesh, P(pp_axis)))
+
+    return shard_params, shard_pages
+
+
+__all__ = ["pipeline_forward", "pp_sharding_fns"]

@@ -56,6 +56,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-context", type=int, default=8192)
     p.add_argument("--tensor-parallel-size", type=int, default=1,
                    help="shard the model over this many local devices")
+    p.add_argument("--pipeline-parallel-size", type=int, default=1,
+                   help="stage the layers over this many devices "
+                        "(microbatch pipeline; scan attention path)")
     p.add_argument("--sequence-parallel-size", type=int, default=1,
                    help="ring-attention sequence parallelism: prompts longer "
                         "than the prefill chunk budget prefill in one "
@@ -107,6 +110,32 @@ def build_engine(args: argparse.Namespace) -> JaxEngine:
         max_prefill_chunk=args.max_prefill_chunk,
         max_context=min(args.max_context, cfg.max_position_embeddings),
         num_top_logprobs=args.num_top_logprobs)
+    forward_fn = None
+    pp = args.pipeline_parallel_size
+    if pp > 1:
+        import functools
+
+        from dynamo_tpu.parallel.mesh import MeshSpec, make_mesh
+        from dynamo_tpu.parallel.pipeline import (
+            pipeline_forward, pp_sharding_fns)
+        if args.tensor_parallel_size > 1 or args.sequence_parallel_size > 1:
+            raise SystemExit("--pipeline-parallel-size does not combine "
+                             "with tp/sp yet (layer-axis staging only)")
+        if args.num_nodes > 1:
+            raise SystemExit("--pipeline-parallel-size with --num-nodes>1 "
+                             "is not wired yet (the engine's multihost "
+                             "input broadcast is gated on cfg.mesh, which "
+                             "the pp path does not set)")
+        if cfg.num_layers % pp:
+            raise SystemExit(
+                f"model has {cfg.num_layers} layers — not divisible by "
+                f"--pipeline-parallel-size {pp}")
+        mesh = make_mesh(MeshSpec(pp=pp), devices=jax.devices()[:pp])
+        shard_params, shard_pages = pp_sharding_fns(mesh)
+        engine_cfg.attn_impl = "scan"  # pipeline runs the stacked-cache path
+        engine_cfg.shard_params_fn = shard_params
+        engine_cfg.shard_pages_fn = shard_pages
+        forward_fn = functools.partial(pipeline_forward, mesh=mesh)
     tp, sp = args.tensor_parallel_size, args.sequence_parallel_size
     if tp > 1 or sp > 1:
         from dynamo_tpu.parallel.mesh import MeshSpec, make_mesh
@@ -126,7 +155,7 @@ def build_engine(args: argparse.Namespace) -> JaxEngine:
         params = load_gguf_params(cfg, args.model_path)
     else:
         params = load_hf_params(cfg, args.model_path)
-    return JaxEngine(cfg, params, engine_cfg)
+    return JaxEngine(cfg, params, engine_cfg, forward_fn=forward_fn)
 
 
 async def amain(args: argparse.Namespace) -> None:

@@ -37,7 +37,8 @@ async def run_tokens(engine, tokens, rid):
 class TestMesh:
     def test_make_mesh_axes(self):
         mesh = make_mesh(MeshSpec(dp=2, tp=4))
-        assert mesh.shape == {"dp": 2, "tp": 4, "sp": 1, "ep": 1}
+        assert mesh.shape == {"dp": 2, "pp": 1, "tp": 4, "sp": 1,
+                              "ep": 1}
 
     def test_mesh_size_mismatch(self):
         with pytest.raises(ValueError):

