@@ -399,9 +399,32 @@ def main() -> None:
     tpu_env.pop("JAX_PLATFORMS", None)  # let the TPU plugin register
     errors: list[str] = []
     attempt = 0
+    probes = 0
     while time.monotonic() + cpu_reserve < deadline and attempt < 3:
-        attempt += 1
+        # cheap probe first: the tunneled backend's failure mode is a HANG
+        # at init — burning a full attempt's timeout discovering that
+        # wastes the budget a later flaky-tunnel window could have used
+        probes += 1
+        try:
+            probe_rc = subprocess.run(
+                [sys.executable, "-c", "import jax; jax.devices()"],
+                env=tpu_env, timeout=75,
+                stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL).returncode
+        except subprocess.TimeoutExpired:
+            probe_rc = -1
+        if probe_rc != 0:
+            print(f"bench: tpu probe {probes} failed/hung", file=sys.stderr,
+                  flush=True)
+            errors.append(f"tpu probe {probes} failed")
+            if time.monotonic() + cpu_reserve < deadline:
+                time.sleep(10.0)
+            continue
         remaining = deadline - time.monotonic() - cpu_reserve
+        if remaining < 30.0:
+            errors.append("tpu probe ok but budget exhausted")
+            break
+        attempt += 1
         result = _run_attempt(child_argv, tpu_env, min(remaining, 380.0))
         if result is not None:
             result["attempts"] = attempt

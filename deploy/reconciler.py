@@ -2,10 +2,14 @@
 
 Role parity: the reference's Go operator (``deploy/cloud/operator``) reacting
 to planner scale decisions via CRD patches. Here the division of labor is:
-the planner's ``KvConnector`` publishes desired prefill/decode counts to the
-coordinator KV (``planner/{ns}/desired``); this reconciler watches that key
-and patches the two worker Deployments via ``kubectl scale``. It has no
-in-cluster dependencies beyond kubectl credentials.
+the planner's ``KvConnector`` publishes desired prefill/decode counts — and,
+for parallelism-sweep profiles, the chosen (tp, sp) config per pool — to the
+coordinator KV (``planner/{ns}/desired``); this reconciler watches that key,
+patches replica counts via ``kubectl scale``, and when the chosen config
+changes, patches the worker container's ``--tensor-parallel-size`` /
+``--sequence-parallel-size`` args via a strategic-merge patch (pods roll with
+the Deployment's update strategy). It has no in-cluster dependencies beyond
+kubectl credentials.
 
 Run: ``python deploy/reconciler.py --coordinator dynamo-coordinator:6650``
 """
@@ -40,9 +44,35 @@ async def kubectl_scale(deployment: str, replicas: int,
     return True
 
 
+async def kubectl_patch_args(deployment: str, container: str,
+                             config: dict, kube_namespace: str) -> bool:
+    """Append/replace the parallelism flags on the worker container by
+    JSON-patching an env var the container command reads
+    (``DYN_PARALLEL_ARGS``) — arg-list surgery via strategic merge is
+    brittle across manifests, an env indirection is not."""
+    env_val = (f"--tensor-parallel-size {int(config.get('tp', 1))} "
+               f"--sequence-parallel-size {int(config.get('sp', 1))}")
+    container_patch = {
+        "name": container,
+        "env": [{"name": "DYN_PARALLEL_ARGS", "value": env_val}],
+    }
+    patch = json.dumps(
+        {"spec": {"template": {"spec": {"containers": [container_patch]}}}})
+    proc = await asyncio.create_subprocess_exec(
+        "kubectl", "-n", kube_namespace, "patch", f"deployment/{deployment}",
+        "--type", "strategic", "-p", patch,
+        stdout=asyncio.subprocess.PIPE, stderr=asyncio.subprocess.PIPE)
+    _out, err = await proc.communicate()
+    if proc.returncode != 0:
+        logger.error("kubectl patch %s failed: %s", deployment, err.decode())
+        return False
+    logger.info("patched %s parallel args: %s", deployment, env_val)
+    return True
+
+
 async def reconcile(drt: DistributedRuntime, namespace: str,
                     kube_namespace: str, prefill_deploy: str,
-                    decode_deploy: str) -> None:
+                    decode_deploy: str, container: str = "worker") -> None:
     key = planner_desired_key(namespace)
     watch = await drt.coord.watch_prefix(key)
     applied = None
@@ -52,11 +82,17 @@ async def reconcile(drt: DistributedRuntime, namespace: str,
         desired = json.loads(raw)
         if desired == applied:
             return
-        ok1 = await kubectl_scale(prefill_deploy, int(desired["prefill"]),
-                                  kube_namespace)
-        ok2 = await kubectl_scale(decode_deploy, int(desired["decode"]),
-                                  kube_namespace)
-        if ok1 and ok2:
+        ok = [await kubectl_scale(prefill_deploy, int(desired["prefill"]),
+                                  kube_namespace),
+              await kubectl_scale(decode_deploy, int(desired["decode"]),
+                                  kube_namespace)]
+        for deploy, cfg_key in ((prefill_deploy, "prefill_config"),
+                                (decode_deploy, "decode_config")):
+            cfg = desired.get(cfg_key)
+            if cfg and cfg != (applied or {}).get(cfg_key):
+                ok.append(await kubectl_patch_args(
+                    deploy, container, cfg, kube_namespace))
+        if all(ok):
             applied = desired
 
     for _key, value in watch.snapshot:

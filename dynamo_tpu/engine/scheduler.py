@@ -145,6 +145,10 @@ class Scheduler:
         # set by the engine loop: the context ceiling used for the
         # deterministic end-of-stream check in plan_chained
         self.max_context_hint: Optional[int] = None
+        # engine-dp rank advertised in load metrics (reference
+        # WorkerStats.data_parallel_rank, kv_router/protocols.rs:52);
+        # set by the worker when serving one rank of a dp group
+        self.dp_rank: Optional[int] = None
         # cancelled sequences reaped outside an engine step; the engine drains
         # this to emit their CANCELLED frames (otherwise the caller's stream
         # would never terminate)
@@ -455,6 +459,7 @@ class Scheduler:
                 request_active_slots=len(self.active),
                 request_total_slots=self.cfg.max_num_seqs,
                 num_requests_waiting=len(self.waiting),
+                data_parallel_rank=self.dp_rank,
             ),
             kv_stats=KvStats(
                 kv_active_blocks=total - self.alloc.num_free,

@@ -1,0 +1,132 @@
+"""Composable pipeline operators over engine-frame streams.
+
+Parity: the reference's generic pipeline graph — ``ServiceFrontend`` /
+``Operator`` (forward+backward edges) / ``ServiceBackend`` linked with
+``.link()`` (``lib/runtime/src/pipeline/nodes.rs``, ``context.rs``) — whose
+only in-tree production instance is the Migration operator sitting between
+the preprocessor and the router (``migration.rs``). Here the same
+composability is expressed the Python way:
+
+- a **Source** is ``async fn(request) -> AsyncIterator[LLMEngineOutput]``
+  (the sink at the end of a chain: a router hop, a local engine, a mock);
+- an **Operator** wraps a downstream Source: it may rewrite the request,
+  retry it, or transform/observe frames flowing back up;
+- ``link(operators, sink)`` folds them into a single Source.
+
+``ServicePipeline`` subclasses build their engine hop from these, so a
+custom deployment can insert its own operators (rate limiting, frame
+auditing, shadow traffic, ...) without forking the pipeline classes —
+``ComposedPipeline`` takes any operator chain directly.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import AsyncIterator, Callable, List, Sequence
+
+from dynamo_tpu.protocols.common import (
+    FinishReason,
+    LLMEngineOutput,
+    PreprocessedRequest,
+)
+from dynamo_tpu.runtime.rpc import StreamEndedError
+
+logger = logging.getLogger(__name__)
+
+Source = Callable[[PreprocessedRequest], AsyncIterator[LLMEngineOutput]]
+
+
+class Operator:
+    """One pipeline stage: sees the request on the way down and every
+    frame on the way back up."""
+
+    def call(self, request: PreprocessedRequest,
+             next_source: Source) -> AsyncIterator[LLMEngineOutput]:
+        raise NotImplementedError
+
+
+def link(operators: Sequence[Operator], sink: Source) -> Source:
+    """Fold operators (outermost first) around the sink into one Source
+    (the reference's ``.link()`` chain building,
+    ``pipeline/nodes.rs``)."""
+    source = sink
+    for op in reversed(list(operators)):
+        def bound(req, _op=op, _next=source):
+            return _op.call(req, _next)
+        source = bound
+    return source
+
+
+class MigrationOperator(Operator):
+    """Retry-on-stream-drop with token continuation.
+
+    On a mid-stream drop the request is rebuilt with the tokens generated
+    so far appended and re-issued to the downstream source — the request
+    migrates to another worker (reference ``migration.rs:38-131``; the
+    drop signal is the missing ``final`` sentinel, surfaced as
+    ``StreamEndedError``)."""
+
+    def __init__(self, migration_limit: int = 3):
+        self.migration_limit = migration_limit
+
+    async def call(self, request: PreprocessedRequest,
+                   next_source: Source) -> AsyncIterator[LLMEngineOutput]:
+        generated: List[int] = []  # tokens already yielded downstream
+        attempt = 0
+        req = request
+        while True:
+            try:
+                async for out in next_source(req):
+                    generated.extend(out.token_ids)
+                    yield out
+                    if out.finish_reason is not None:
+                        return
+                return  # clean final without an explicit finish frame
+            except (StreamEndedError, ConnectionError) as e:
+                attempt += 1
+                if attempt > self.migration_limit:
+                    logger.error("request %s exhausted %d migrations: %s",
+                                 request.request_id, self.migration_limit, e)
+                    yield LLMEngineOutput(
+                        error="stream ended before generation completed "
+                              f"(after {attempt - 1} migrations)",
+                        finish_reason=FinishReason.ERROR)
+                    return
+                req = self._rebuild(request, generated)
+                logger.warning(
+                    "migrating request %s (attempt %d/%d, %d tokens done)",
+                    request.request_id, attempt, self.migration_limit,
+                    len(generated))
+
+    @staticmethod
+    def _rebuild(original: PreprocessedRequest,
+                 generated: List[int]) -> PreprocessedRequest:
+        req = PreprocessedRequest.from_dict(original.to_dict())
+        req.token_ids = list(original.token_ids) + list(generated)
+        sc = req.stop_conditions
+        if sc.max_tokens is not None:
+            sc.max_tokens = max(1, sc.max_tokens - len(generated))
+        return req
+
+
+def router_sink(router) -> Source:
+    """Terminal source: one streamed hop through a PushRouter."""
+
+    async def source(request: PreprocessedRequest):
+        async for payload in router.generate_stream(request.to_dict()):
+            yield LLMEngineOutput.from_dict(payload)
+
+    return source
+
+
+def engine_sink(engine) -> Source:
+    """Terminal source: a local in-process engine."""
+
+    def source(request: PreprocessedRequest):
+        return engine.generate(request)
+
+    return source
+
+
+__all__ = ["Operator", "Source", "link", "MigrationOperator",
+           "router_sink", "engine_sink"]

@@ -1,10 +1,13 @@
-"""Service pipelines: Frontend -> Preprocessor -> [Migration -> Router] -> Backend.
+"""Service pipelines: Frontend -> Preprocessor -> [operators...] -> Backend.
 
 Parity: reference ``entrypoint/input/common.rs:126-155`` (``build_pipeline``)
 and ``discovery/watcher.rs:163-310`` (client pipeline built per discovered
-model), plus the ``Migration`` retry operator (``lib/llm/src/migration.rs``):
-on a mid-stream drop the request is rebuilt with the tokens generated so far
-appended and re-issued to another worker, up to ``migration_limit`` times.
+model). The engine hop is COMPOSED from generic operators
+(``llm/operators.py`` — the ``pipeline/nodes.rs`` role): RemotePipeline is
+``link([Migration], router_sink)``, LocalEnginePipeline is
+``link([], engine_sink)``, and ``ComposedPipeline`` accepts any operator
+chain so deployments can insert their own stages without forking these
+classes.
 """
 
 from __future__ import annotations
@@ -101,12 +104,13 @@ class LocalEnginePipeline(ServicePipeline):
 
     def __init__(self, card: ModelDeploymentCard, engine: EngineBase):
         super().__init__(card)
+        from dynamo_tpu.llm.operators import engine_sink, link
         self.engine = engine
+        self._source = link([], engine_sink(engine))
 
-    async def engine_stream(self, request: PreprocessedRequest
-                            ) -> AsyncIterator[LLMEngineOutput]:
-        async for out in self.engine.generate(request):
-            yield out
+    def engine_stream(self, request: PreprocessedRequest
+                      ) -> AsyncIterator[LLMEngineOutput]:
+        return self._source(request)
 
     async def generate_embeddings(self, req) -> "tuple[list, int]":
         embed = getattr(self.engine, "embed", None)
@@ -126,16 +130,36 @@ class LocalEnginePipeline(ServicePipeline):
                 sum(len(t) for t in token_lists))
 
 
+class ComposedPipeline(ServicePipeline):
+    """Pipeline whose engine hop is an arbitrary operator chain over a
+    sink (``llm/operators.py``) — the extension point for custom stages
+    (rate limiting, frame auditing, shadow traffic, ...)."""
+
+    def __init__(self, card: ModelDeploymentCard, operators, sink):
+        super().__init__(card)
+        from dynamo_tpu.llm.operators import link
+        self._source = link(operators, sink)
+
+    def engine_stream(self, request: PreprocessedRequest
+                      ) -> AsyncIterator[LLMEngineOutput]:
+        return self._source(request)
+
+
 class RemotePipeline(ServicePipeline):
     """Pipeline routing to remote workers through a PushRouter, with the
-    migration (retry-on-stream-drop) operator built in."""
+    migration (retry-on-stream-drop) operator built in:
+    ``link([MigrationOperator], router_sink(router))``."""
 
     def __init__(self, card: ModelDeploymentCard, router: PushRouter,
                  migration_limit: Optional[int] = None):
         super().__init__(card)
+        from dynamo_tpu.llm.operators import (
+            MigrationOperator, link, router_sink)
         self.router = router
         self.migration_limit = (migration_limit if migration_limit is not None
                                 else card.migration_limit)
+        self._source = link([MigrationOperator(self.migration_limit)],
+                            router_sink(router))
 
     def resolve_annotations(self, preprocessed: PreprocessedRequest) -> bool:
         from dynamo_tpu.preprocessor.preprocessor import (
@@ -154,46 +178,10 @@ class RemotePipeline(ServicePipeline):
         }
         return True
 
-    async def engine_stream(self, request: PreprocessedRequest
-                            ) -> AsyncIterator[LLMEngineOutput]:
-        generated: list = []  # tokens already yielded downstream
-        attempt = 0
-        req = request
-        while True:
-            try:
-                async for payload in self.router.generate_stream(req.to_dict()):
-                    out = LLMEngineOutput.from_dict(payload)
-                    generated.extend(out.token_ids)
-                    yield out
-                    if out.finish_reason is not None:
-                        return
-                return  # clean final without an explicit finish frame
-            except (StreamEndedError, ConnectionError) as e:
-                attempt += 1
-                if attempt > self.migration_limit:
-                    logger.error("request %s exhausted %d migrations: %s",
-                                 request.request_id, self.migration_limit, e)
-                    yield LLMEngineOutput(
-                        error="stream ended before generation completed "
-                              f"(after {attempt - 1} migrations)",
-                        finish_reason=FinishReason.ERROR)
-                    return
-                # Migration: rebuild the request with tokens generated so far
-                # appended so the next worker continues where the dead one
-                # stopped (reference migration.rs:38-131).
-                req = self._rebuild(request, generated)
-                logger.warning("migrating request %s (attempt %d/%d, %d tokens done)",
-                               request.request_id, attempt, self.migration_limit,
-                               len(generated))
-
-    @staticmethod
-    def _rebuild(original: PreprocessedRequest, generated: list) -> PreprocessedRequest:
-        req = PreprocessedRequest.from_dict(original.to_dict())
-        req.token_ids = list(original.token_ids) + list(generated)
-        sc = req.stop_conditions
-        if sc.max_tokens is not None:
-            sc.max_tokens = max(1, sc.max_tokens - len(generated))
-        return req
+    def engine_stream(self, request: PreprocessedRequest
+                      ) -> AsyncIterator[LLMEngineOutput]:
+        return self._source(request)
 
 
-__all__ = ["ServicePipeline", "LocalEnginePipeline", "RemotePipeline"]
+__all__ = ["ServicePipeline", "LocalEnginePipeline", "RemotePipeline",
+           "ComposedPipeline"]

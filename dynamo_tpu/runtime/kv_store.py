@@ -1,0 +1,158 @@
+"""Pluggable key-value store abstraction (buckets with optional TTL).
+
+Parity: reference ``lib/runtime/src/storage/key_value_store.rs`` — a
+``KeyValueStore`` trait with etcd / NATS-KV / in-memory backends, used for
+model-card storage and TTL buckets. Here the two backends that exist in
+this runtime's world:
+
+- ``MemoryKeyValueStore`` — in-process (static mode, tests);
+- ``CoordKeyValueStore`` — namespaced onto the coordinator KV plane
+  (``kvstore/{bucket}/{key}``), TTL carried in-band per entry (msgpack
+  envelope) with lazy expiry, so it needs no coordinator-side support
+  beyond plain put/get/delete.
+
+Both present the same ``KeyValueBucket`` surface, so components written
+against it (model-card storage, planner state, user extensions) are
+backend-agnostic — the reference's reason for the trait.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Tuple
+
+from dynamo_tpu.runtime import codec
+
+
+class KeyValueBucket:
+    """One named bucket. Values are opaque bytes. ``ttl`` (seconds, set at
+    bucket creation) applies per entry from its last put."""
+
+    async def put(self, key: str, value: bytes) -> None:
+        raise NotImplementedError
+
+    async def get(self, key: str) -> Optional[bytes]:
+        raise NotImplementedError
+
+    async def delete(self, key: str) -> bool:
+        raise NotImplementedError
+
+    async def entries(self) -> List[Tuple[str, bytes]]:
+        raise NotImplementedError
+
+
+class KeyValueStore:
+    async def bucket(self, name: str,
+                     ttl: Optional[float] = None) -> KeyValueBucket:
+        raise NotImplementedError
+
+
+# ---------------------------------------------------------------- memory
+
+
+class _MemoryBucket(KeyValueBucket):
+    def __init__(self, ttl: Optional[float],
+                 data: Dict[str, Tuple[bytes, float]]):
+        self.ttl = ttl
+        self._data = data  # key -> (val, exp); shared per bucket name
+
+    def _live(self, key: str) -> Optional[bytes]:
+        item = self._data.get(key)
+        if item is None:
+            return None
+        val, exp = item
+        if exp and exp <= time.monotonic():
+            del self._data[key]
+            return None
+        return val
+
+    async def put(self, key: str, value: bytes) -> None:
+        exp = (time.monotonic() + self.ttl) if self.ttl else 0.0
+        self._data[key] = (bytes(value), exp)
+
+    async def get(self, key: str) -> Optional[bytes]:
+        return self._live(key)
+
+    async def delete(self, key: str) -> bool:
+        return self._data.pop(key, None) is not None
+
+    async def entries(self) -> List[Tuple[str, bytes]]:
+        out = []
+        for k in list(self._data):
+            v = self._live(k)
+            if v is not None:
+                out.append((k, v))
+        return out
+
+
+class MemoryKeyValueStore(KeyValueStore):
+    def __init__(self) -> None:
+        self._datas: Dict[str, Dict[str, Tuple[bytes, float]]] = {}
+
+    async def bucket(self, name: str,
+                     ttl: Optional[float] = None) -> KeyValueBucket:
+        # handle semantics match the coordinator backend: the DATA is
+        # shared per name, the TTL is per handle (each call's ttl applies
+        # to the entries written through it)
+        data = self._datas.setdefault(name, {})
+        return _MemoryBucket(ttl, data)
+
+
+# ------------------------------------------------------------- coordinator
+
+
+class _CoordBucket(KeyValueBucket):
+    def __init__(self, coord, name: str, ttl: Optional[float]):
+        self._coord = coord
+        self._prefix = f"kvstore/{name}/"
+        self.ttl = ttl
+
+    def _wrap(self, value: bytes) -> bytes:
+        exp = (time.time() + self.ttl) if self.ttl else 0.0
+        return codec.pack({"e": exp, "v": bytes(value)})
+
+    def _unwrap(self, raw: bytes) -> Optional[bytes]:
+        d = codec.unpack(raw)
+        if d["e"] and d["e"] <= time.time():
+            return None
+        return d["v"]
+
+    async def put(self, key: str, value: bytes) -> None:
+        await self._coord.put(self._prefix + key, self._wrap(value))
+
+    async def get(self, key: str) -> Optional[bytes]:
+        raw = await self._coord.get(self._prefix + key)
+        if raw is None:
+            return None
+        val = self._unwrap(raw)
+        if val is None:  # expired: collect lazily
+            await self._coord.delete(self._prefix + key)
+        return val
+
+    async def delete(self, key: str) -> bool:
+        return (await self._coord.delete(self._prefix + key)) > 0
+
+    async def entries(self) -> List[Tuple[str, bytes]]:
+        out = []
+        for k, raw in await self._coord.get_prefix(self._prefix):
+            val = self._unwrap(raw)
+            if val is None:
+                # lazy collection here too, or a bucket used only via
+                # entries() would leak expired keys forever
+                await self._coord.delete(k)
+                continue
+            out.append((k[len(self._prefix):], val))
+        return out
+
+
+class CoordKeyValueStore(KeyValueStore):
+    def __init__(self, coord) -> None:
+        self._coord = coord
+
+    async def bucket(self, name: str,
+                     ttl: Optional[float] = None) -> KeyValueBucket:
+        return _CoordBucket(self._coord, name, ttl)
+
+
+__all__ = ["KeyValueStore", "KeyValueBucket", "MemoryKeyValueStore",
+           "CoordKeyValueStore"]

@@ -163,6 +163,13 @@ class DistributedRuntime:
 
     # -- typed event bus ---------------------------------------------------
 
+    def kv_store(self):
+        """The pluggable key-value store surface (reference
+        ``storage/key_value_store.rs`` trait): buckets with optional TTL,
+        backed by the coordinator KV plane."""
+        from dynamo_tpu.runtime.kv_store import CoordKeyValueStore
+        return CoordKeyValueStore(self.coord)
+
     async def publish_event(self, subject: str, obj: Any) -> int:
         """Publish a msgpack-encoded event object."""
         return await self.coord.publish(subject, codec.pack(obj))

@@ -90,6 +90,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "component and decodes")
     p.add_argument("--prefill-component", default="prefill",
                    help="component name of the prefill workers (decode role)")
+    p.add_argument("--data-parallel-rank", type=int, default=None,
+                   help="engine-dp rank advertised in load metrics (the "
+                        "router's per-rank dp accounting)")
     p.add_argument("--bulk-host", default="127.0.0.1",
                    help="bind host for the bulk KV data plane (prefill "
                         "role); use this host's DCN address for cross-host "
@@ -190,6 +193,7 @@ async def amain(args: argparse.Namespace) -> None:
     # a dead engine loop takes the worker's registration down with it, so
     # routers stop sending to a zombie (reference: task.rs critical tasks)
     engine.on_loop_exit = drt.runtime.shutdown
+    engine.scheduler.dp_rank = args.data_parallel_rank
 
     tiered = None
     if args.host_cache_bytes > 0 or args.disk_cache_bytes > 0:
@@ -367,7 +371,17 @@ async def _follower_main(args: argparse.Namespace, drt) -> None:
 
 
 def main() -> None:
-    args = build_parser().parse_args()
+    import os
+    import sys
+
+    argv = list(sys.argv[1:])
+    # planner-chosen parallelism config (the k8s reconciler patches this
+    # env on the Deployment instead of doing arg-list surgery, see
+    # deploy/reconciler.py); appended last so it overrides static flags
+    extra = os.environ.get("DYN_PARALLEL_ARGS", "").split()
+    if extra:
+        argv += extra
+    args = build_parser().parse_args(argv)
     configure_logging()
     try:
         asyncio.run(amain(args))

@@ -156,3 +156,73 @@ class TestRunCli:
         assert len(lines) == 5
         assert lines[0]["index"] == 0
         assert "5/5 prompts" in proc.stderr
+
+
+class TestComposableOperators:
+    """llm/operators.py: the pipeline graph role (pipeline/nodes.rs) —
+    operators link around a sink; custom stages compose without forking
+    the pipeline classes."""
+
+    async def test_custom_operator_composes_and_migration_retries(self):
+        from dynamo_tpu.llm.operators import (
+            MigrationOperator, Operator, link)
+        from dynamo_tpu.protocols.common import (
+            FinishReason, LLMEngineOutput, PreprocessedRequest,
+            SamplingOptions, StopConditions)
+        from dynamo_tpu.runtime.rpc import StreamEndedError
+
+        calls = {"n": 0}
+
+        async def flaky_sink(req):
+            # first attempt dies after 2 tokens; retry (with those tokens
+            # appended) completes
+            calls["n"] += 1
+            if calls["n"] == 1:
+                yield LLMEngineOutput(token_ids=[10])
+                yield LLMEngineOutput(token_ids=[11])
+                raise StreamEndedError("worker died")
+            assert req.token_ids[-2:] == [10, 11]  # continuation carried
+            yield LLMEngineOutput(token_ids=[12],
+                                  finish_reason=FinishReason.LENGTH)
+
+        seen = []
+
+        class Audit(Operator):
+            async def call(self, request, next_source):
+                async for out in next_source(request):
+                    seen.extend(out.token_ids)
+                    yield out
+
+        source = link([Audit(), MigrationOperator(2)], flaky_sink)
+        req = PreprocessedRequest(
+            token_ids=[1, 2, 3], request_id="r",
+            stop_conditions=StopConditions(max_tokens=8),
+            sampling_options=SamplingOptions())
+        got = []
+        async for out in source(req):
+            got.extend(out.token_ids)
+            if out.finish_reason is not None:
+                break
+        assert got == [10, 11, 12]
+        assert seen == [10, 11, 12]  # the custom stage observed every frame
+        assert calls["n"] == 2
+
+    async def test_migration_exhaustion_yields_error_frame(self):
+        from dynamo_tpu.llm.operators import MigrationOperator, link
+        from dynamo_tpu.protocols.common import (
+            FinishReason, LLMEngineOutput, PreprocessedRequest,
+            SamplingOptions, StopConditions)
+        from dynamo_tpu.runtime.rpc import StreamEndedError
+
+        async def dead_sink(req):
+            raise StreamEndedError("always down")
+            yield  # pragma: no cover
+
+        source = link([MigrationOperator(1)], dead_sink)
+        req = PreprocessedRequest(
+            token_ids=[1], request_id="r",
+            stop_conditions=StopConditions(max_tokens=4),
+            sampling_options=SamplingOptions())
+        frames = [f async for f in source(req)]
+        assert frames[-1].finish_reason == FinishReason.ERROR
+        assert "migrations" in frames[-1].error

@@ -216,3 +216,43 @@ async def test_router_generate_stream_fails_over():
     finally:
         await worker2.close()
         await drt.close()
+
+
+class TestKeyValueStore:
+    """Pluggable KV buckets (storage/key_value_store.rs parity): both
+    backends present the same surface incl. per-entry TTL."""
+
+    async def _exercise(self, store):
+        b = await store.bucket("cards")
+        await b.put("llama", b"card-bytes")
+        assert await b.get("llama") == b"card-bytes"
+        assert await b.get("missing") is None
+        await b.put("qwen", b"other")
+        got = dict(await b.entries())
+        assert got == {"llama": b"card-bytes", "qwen": b"other"}
+        assert await b.delete("llama") is True
+        assert await b.delete("llama") is False
+        # TTL bucket: entries vanish after expiry
+        t = await store.bucket("leases", ttl=0.2)
+        await t.put("k", b"v")
+        assert await t.get("k") == b"v"
+        await asyncio.sleep(0.35)
+        assert await t.get("k") is None
+        assert await t.entries() == []
+
+    async def test_memory_backend(self):
+        from dynamo_tpu.runtime.kv_store import MemoryKeyValueStore
+        await self._exercise(MemoryKeyValueStore())
+
+    async def test_coordinator_backend(self):
+        from dynamo_tpu.runtime.coordinator import Coordinator
+        from dynamo_tpu.runtime.kv_store import CoordKeyValueStore
+        from dynamo_tpu.runtime.runtime import DistributedRuntime
+        async with Coordinator() as coord:
+            drt = await DistributedRuntime.create(coordinator=coord.address)
+            try:
+                store = drt.kv_store()
+                assert isinstance(store, CoordKeyValueStore)
+                await self._exercise(store)
+            finally:
+                await drt.close()
