@@ -405,10 +405,13 @@ def main() -> None:
         # at init — burning a full attempt's timeout discovering that
         # wastes the budget a later flaky-tunnel window could have used
         probes += 1
+        probe_budget = min(75.0, deadline - time.monotonic() - cpu_reserve)
+        if probe_budget <= 5.0:
+            break
         try:
             probe_rc = subprocess.run(
                 [sys.executable, "-c", "import jax; jax.devices()"],
-                env=tpu_env, timeout=75,
+                env=tpu_env, timeout=probe_budget,
                 stdout=subprocess.DEVNULL,
                 stderr=subprocess.DEVNULL).returncode
         except subprocess.TimeoutExpired:

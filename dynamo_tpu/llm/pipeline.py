@@ -12,7 +12,6 @@ classes.
 
 from __future__ import annotations
 
-import logging
 from typing import Any, AsyncIterator, Dict, Optional
 
 from dynamo_tpu.backend import Backend
@@ -22,7 +21,6 @@ from dynamo_tpu.preprocessor import OpenAIPreprocessor
 from dynamo_tpu.preprocessor.preprocessor import DeltaGenerator
 from dynamo_tpu.protocols.common import (
     BackendOutput,
-    FinishReason,
     LLMEngineOutput,
     PreprocessedRequest,
 )
@@ -32,9 +30,6 @@ from dynamo_tpu.protocols.openai import (
     CompletionRequest,
 )
 from dynamo_tpu.runtime.push_router import PushRouter
-from dynamo_tpu.runtime.rpc import StreamEndedError
-
-logger = logging.getLogger(__name__)
 
 
 class ServicePipeline:

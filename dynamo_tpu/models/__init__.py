@@ -17,6 +17,14 @@ def get_family(cfg: ModelConfig):
     if cfg.num_experts:
         from dynamo_tpu.models import moe
         return moe
+    if cfg.model_type == "gemma2":
+        # only gemma-2 is implemented; gemma-1/gemma-3 differ (norm
+        # layout, qk-norm, dual rope thetas) and must not silently load
+        from dynamo_tpu.models import gemma
+        return gemma
+    if cfg.model_type.startswith("gemma"):
+        raise NotImplementedError(
+            f"model_type {cfg.model_type!r}: only gemma2 is implemented")
     from dynamo_tpu.models import llama
     return llama
 

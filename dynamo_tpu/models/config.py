@@ -5,8 +5,10 @@ HF config for context length / arch metadata; here the config additionally
 drives the native jax model (the reference never builds the model itself).
 
 Covers the Llama family tree: llama/llama-3, mistral, qwen2/qwen3 (qwen3 adds
-per-head q/k RMS norm), and the MoE variants (mixtral/qwen3_moe/deepseek-style
-``num_experts``/``top_k`` routing) handled by ``models/moe.py``.
+per-head q/k RMS norm), the MoE variants (mixtral/qwen3_moe/deepseek-style
+``num_experts``/``top_k`` routing) handled by ``models/moe.py``, and the
+gemma-2 family (GeGLU, sandwich norms, logit softcaps, alternating
+sliding-window layers) handled by ``models/gemma.py``.
 """
 
 from __future__ import annotations
@@ -39,6 +41,11 @@ class ModelConfig:
     num_experts_per_tok: int = 2
     moe_intermediate_size: int = 0
     norm_topk_prob: bool = True
+    # gemma-2 family (models/gemma.py)
+    sliding_window: int = 0            # 0 = all layers global attention
+    attn_logit_softcap: float = 0.0    # 0 = disabled
+    final_logit_softcap: float = 0.0
+    query_pre_attn_scalar: float = 0.0  # 0 = use head_dim
 
     @property
     def q_size(self) -> int:
@@ -64,7 +71,10 @@ class ModelConfig:
             rope_theta=float(hf.get("rope_theta", 10000.0)),
             rms_norm_eps=float(hf.get("rms_norm_eps", 1e-5)),
             max_position_embeddings=hf.get("max_position_embeddings", 8192),
-            tie_word_embeddings=bool(hf.get("tie_word_embeddings", False)),
+            # transformers omits fields equal to its per-arch defaults:
+            # gemma ties embeddings by default and serializes nothing
+            tie_word_embeddings=bool(hf.get("tie_word_embeddings",
+                                            mt.startswith("gemma"))),
             qk_norm=mt in ("qwen3", "qwen3_moe"),
             attention_bias=bool(hf.get("attention_bias", mt == "qwen2")),
             model_type=mt,
@@ -74,6 +84,13 @@ class ModelConfig:
             moe_intermediate_size=hf.get("moe_intermediate_size",
                                          hf.get("intermediate_size", 0)),
             norm_topk_prob=bool(hf.get("norm_topk_prob", True)),
+            sliding_window=int(hf.get("sliding_window") or 0)
+            if mt.startswith("gemma") else 0,
+            attn_logit_softcap=float(hf.get("attn_logit_softcapping") or 0.0),
+            final_logit_softcap=float(
+                hf.get("final_logit_softcapping") or 0.0),
+            query_pre_attn_scalar=float(
+                hf.get("query_pre_attn_scalar") or 0.0),
         )
 
     @classmethod

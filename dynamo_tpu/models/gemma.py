@@ -1,0 +1,208 @@
+"""Gemma-2 family decoder — pure-functional jax over the paged KV cache.
+
+Same serving contract as ``models/llama.py`` (``init_params`` /
+``forward`` scan / ``forward_unrolled``), covering the gemma-2
+architecture differences (verified against transformers'
+``Gemma2ForCausalLM`` in tests):
+
+- GeGLU MLP: ``gelu_tanh(x@gate) * (x@up) @ down``;
+- sandwich norms: pre+post norms around BOTH attention and the MLP
+  (4 RMSNorms per layer), with gemma's ``x * (1 + w)`` RMSNorm;
+- embedding scaled by ``sqrt(hidden_size)``;
+- attention-logit and final-logit soft-capping;
+- alternating sliding-window layers (even layers sliding, odd global —
+  HF gemma-2 convention), expressed as a per-layer window arg to the
+  paged attention mask so the SAME paged cache serves both kinds;
+- query scale from ``query_pre_attn_scalar`` instead of ``head_dim``.
+
+The Pallas decode kernel does not implement softcap/window yet, so this
+family always runs the XLA attention paths (``forward_unrolled`` ignores
+the ``attn_impl`` override); blockwise prefill applies as usual.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.models.llama import make_pages, make_pages_list
+from dynamo_tpu.ops.attention import (
+    paged_attention,
+    paged_attention_layer,
+    write_kv,
+    write_kv_layer,
+)
+from dynamo_tpu.ops.rope import apply_rope
+
+Params = Dict[str, Any]
+
+
+def _rms_norm(x: jnp.ndarray, w: jnp.ndarray, eps: float) -> jnp.ndarray:
+    """gemma RMSNorm: f32 compute, ``x * (1 + w)`` (weights zero-init)."""
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    normed = xf * jax.lax.rsqrt(var + eps)
+    return (normed * (1.0 + w.astype(jnp.float32))).astype(x.dtype)
+
+
+def layer_windows(cfg: ModelConfig) -> jnp.ndarray:
+    """Per-layer attention window: even layers sliding, odd global (0)."""
+    if not cfg.sliding_window:
+        return jnp.zeros((cfg.num_layers,), jnp.int32)
+    return jnp.asarray([cfg.sliding_window if (i % 2 == 0) else 0
+                        for i in range(cfg.num_layers)], jnp.int32)
+
+
+def _sm_scale(cfg: ModelConfig) -> float:
+    base = cfg.query_pre_attn_scalar or cfg.head_dim
+    return base ** -0.5
+
+
+def init_params(cfg: ModelConfig, rng: jax.Array,
+                scale: float = 0.02) -> Params:
+    dtype = jnp.dtype(cfg.dtype)
+    keys = iter(jax.random.split(rng, 16))
+
+    def zeros(shape):
+        return jnp.zeros(shape, dtype=dtype)  # gemma norms are zero-init
+
+    def randn(key, shape):
+        return (jax.random.normal(key, shape, jnp.float32) * scale
+                ).astype(dtype)
+
+    L, H, I = cfg.num_layers, cfg.hidden_size, cfg.intermediate_size
+    layers: Dict[str, jnp.ndarray] = {
+        "attn_norm": zeros((L, H)),
+        "post_attn_norm": zeros((L, H)),
+        "pre_ffw_norm": zeros((L, H)),
+        "post_ffw_norm": zeros((L, H)),
+        "wq": randn(next(keys), (L, H, cfg.q_size)),
+        "wk": randn(next(keys), (L, H, cfg.kv_size)),
+        "wv": randn(next(keys), (L, H, cfg.kv_size)),
+        "wo": randn(next(keys), (L, cfg.q_size, H)),
+        "w_gate": randn(next(keys), (L, H, I)),
+        "w_up": randn(next(keys), (L, H, I)),
+        "w_down": randn(next(keys), (L, I, H)),
+    }
+    params: Params = {
+        "embed": randn(next(keys), (cfg.vocab_size, H)),
+        "layers": layers,
+        "final_norm": zeros((H,)),
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = randn(next(keys), (H, cfg.vocab_size))
+    return params
+
+
+def _project_qkv(cfg: ModelConfig, lp: Dict[str, jnp.ndarray],
+                 h: jnp.ndarray, positions: jnp.ndarray):
+    B, S, _ = h.shape
+    x = _rms_norm(h, lp["attn_norm"], cfg.rms_norm_eps)
+    q = (x @ lp["wq"]).reshape(B, S, cfg.num_heads, cfg.head_dim)
+    k = (x @ lp["wk"]).reshape(B, S, cfg.num_kv_heads, cfg.head_dim)
+    v = (x @ lp["wv"]).reshape(B, S, cfg.num_kv_heads, cfg.head_dim)
+    q = apply_rope(q, positions, cfg.rope_theta)
+    k = apply_rope(k, positions, cfg.rope_theta)
+    return q, k, v
+
+
+def _finish_layer(cfg: ModelConfig, lp: Dict[str, jnp.ndarray],
+                  h: jnp.ndarray, attn: jnp.ndarray) -> jnp.ndarray:
+    B, S, _ = h.shape
+    eps = cfg.rms_norm_eps
+    attn_out = attn.reshape(B, S, cfg.q_size) @ lp["wo"]
+    h = h + _rms_norm(attn_out, lp["post_attn_norm"], eps)
+    x = _rms_norm(h, lp["pre_ffw_norm"], eps)
+    mlp = (jax.nn.gelu(x @ lp["w_gate"], approximate=True)
+           * (x @ lp["w_up"])) @ lp["w_down"]
+    return h + _rms_norm(mlp, lp["post_ffw_norm"], eps)
+
+
+def _logits(cfg: ModelConfig, params: Params, h: jnp.ndarray,
+            new_lens: jnp.ndarray) -> jnp.ndarray:
+    h = _rms_norm(h, params["final_norm"], cfg.rms_norm_eps)
+    last = jnp.maximum(new_lens - 1, 0)
+    h_last = jnp.take_along_axis(
+        h, last[:, None, None].astype(jnp.int32), axis=1)[:, 0]
+    lm_head = params.get("lm_head")
+    if lm_head is None:
+        lm_head = params["embed"].T
+    logits = h_last.astype(jnp.float32) @ lm_head.astype(jnp.float32)
+    cap = cfg.final_logit_softcap
+    if cap:
+        logits = jnp.tanh(logits / cap) * cap
+    return logits
+
+
+def _embed(cfg: ModelConfig, params: Params,
+           tokens: jnp.ndarray) -> jnp.ndarray:
+    h = params["embed"][tokens]
+    # gemma scales embeddings by sqrt(H), cast through the model dtype the
+    # way HF does (the normalizer is rounded to bf16 there)
+    normalizer = jnp.asarray(math.sqrt(cfg.hidden_size), h.dtype)
+    return h * normalizer
+
+
+def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
+            positions: jnp.ndarray, pages: jnp.ndarray,
+            page_table: jnp.ndarray, total_lens: jnp.ndarray,
+            new_lens: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Scan-over-layers forward against the stacked paged cache."""
+    sm_scale = _sm_scale(cfg)
+    softcap = (jnp.asarray(cfg.attn_logit_softcap, jnp.float32)
+               if cfg.attn_logit_softcap else None)
+    windows = layer_windows(cfg)
+    h = _embed(cfg, params, tokens)
+
+    def body(carry, xs):
+        h, pages = carry
+        lp, lidx, win = xs
+        q, k, v = _project_qkv(cfg, lp, h, positions)
+        pages = write_kv(pages, lidx, k, v, page_table, positions, new_lens)
+        attn = paged_attention(q, pages, lidx, page_table, positions,
+                               total_lens, sm_scale, window=win,
+                               softcap=softcap)
+        h = _finish_layer(cfg, lp, h, attn)
+        return (h, pages), None
+
+    (h, pages), _ = jax.lax.scan(
+        body, (h, pages),
+        (params["layers"], jnp.arange(cfg.num_layers), windows))
+    return _logits(cfg, params, h, new_lens), pages
+
+
+def forward_unrolled(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
+                     positions: jnp.ndarray, pages_list: List[jnp.ndarray],
+                     page_table: jnp.ndarray, total_lens: jnp.ndarray,
+                     new_lens: jnp.ndarray,
+                     attn_impl: Optional[Callable] = None
+                     ) -> Tuple[jnp.ndarray, List[jnp.ndarray]]:
+    """Unrolled forward. ``attn_impl`` is IGNORED: the Pallas decode kernel
+    implements neither soft-capping nor sliding windows, so gemma always
+    takes the XLA attention paths."""
+    del attn_impl
+    sm_scale = _sm_scale(cfg)
+    softcap = (jnp.asarray(cfg.attn_logit_softcap, jnp.float32)
+               if cfg.attn_logit_softcap else None)
+    windows = layer_windows(cfg)
+    h = _embed(cfg, params, tokens)
+    out_pages: List[jnp.ndarray] = []
+    for l in range(cfg.num_layers):
+        lp = {k: v[l] for k, v in params["layers"].items()}
+        q, k, v = _project_qkv(cfg, lp, h, positions)
+        kv = write_kv_layer(pages_list[l], k, v, page_table, positions,
+                            new_lens)
+        attn = paged_attention_layer(q, kv, page_table, positions,
+                                     total_lens, sm_scale,
+                                     window=windows[l], softcap=softcap)
+        h = _finish_layer(cfg, lp, h, attn)
+        out_pages.append(kv)
+    return _logits(cfg, params, h, new_lens), out_pages
+
+
+__all__ = ["init_params", "forward", "forward_unrolled", "make_pages",
+           "make_pages_list", "layer_windows"]

@@ -87,6 +87,18 @@ def _name_map(cfg: ModelConfig) -> Dict[str, Any]:
             "model.layers.{i}.mlp.up_proj.weight": (("layers", "w_up"), True),
             "model.layers.{i}.mlp.down_proj.weight": (("layers", "w_down"), True),
         })
+    if cfg.model_type.startswith("gemma"):
+        # gemma-2 sandwich norms: post_attention_layernorm is the POST
+        # norm on the attention residual (not the llama mlp_norm), plus
+        # dedicated pre/post feed-forward norms
+        m.update({
+            "model.layers.{i}.post_attention_layernorm.weight":
+                (("layers", "post_attn_norm"), False),
+            "model.layers.{i}.pre_feedforward_layernorm.weight":
+                (("layers", "pre_ffw_norm"), False),
+            "model.layers.{i}.post_feedforward_layernorm.weight":
+                (("layers", "post_ffw_norm"), False),
+        })
     if not cfg.tie_word_embeddings:
         m["lm_head.weight"] = (("lm_head",), True)
     if cfg.attention_bias:

@@ -78,17 +78,35 @@ def write_kv(pages: jnp.ndarray, layer_idx, k_new: jnp.ndarray,
         new.astype(pages.dtype), mode="drop")
 
 
+def _softcap(scores: jnp.ndarray, cap) -> jnp.ndarray:
+    """gemma-style logit soft-capping: cap * tanh(scores / cap). ``cap``
+    may be a traced scalar; 0 disables (selected via where so the op stays
+    shape-static under jit)."""
+    if cap is None:
+        return scores
+    capped = jnp.tanh(scores / jnp.maximum(cap, 1e-6)) * cap
+    return jnp.where(cap > 0, capped, scores)
+
+
 def _attend(qg: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
             positions: jnp.ndarray, total_lens: jnp.ndarray,
-            sm_scale: float) -> jnp.ndarray:
-    """qg [B,S,Hkv,G,Dh]; k/v [B,Hkv,T,Dh] -> [B,S,Hkv*G,Dh]."""
+            sm_scale: float, window=None, softcap=None) -> jnp.ndarray:
+    """qg [B,S,Hkv,G,Dh]; k/v [B,Hkv,T,Dh] -> [B,S,Hkv*G,Dh].
+
+    ``window`` (traced int32, 0 = unlimited) restricts each query to the
+    last ``window`` kv positions — gemma-2 alternating sliding-window
+    layers; ``softcap`` applies attention-logit soft-capping."""
     B, S, Hkv, G, Dh = qg.shape
     T = k.shape[2]
     scores = jnp.einsum("bsngd,bntd->bnsgt", qg.astype(jnp.float32),
                         k.astype(jnp.float32)) * sm_scale  # [B,Hkv,S,G,T]
+    scores = _softcap(scores, softcap)
     t_pos = jnp.arange(T)[None, None, :]                   # [1, 1, T]
     causal = t_pos <= positions[:, :, None]                # [B, S, T]
     valid = t_pos < total_lens[:, None, None]              # [B, 1, T]
+    if window is not None:
+        in_win = (window <= 0) | (t_pos > positions[:, :, None] - window)
+        causal = causal & in_win
     mask = (causal & valid)[:, None, :, None, :]           # [B, 1, S, 1, T]
     scores = jnp.where(mask, scores, NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1)
@@ -104,7 +122,8 @@ PAGES_PER_CHUNK = 8
 def _attend_blockwise(qg: jnp.ndarray, gather_chunk, num_table_pages: int,
                       page_size: int, chunk_pages: int,
                       positions: jnp.ndarray, total_lens: jnp.ndarray,
-                      sm_scale: float) -> jnp.ndarray:
+                      sm_scale: float, window=None,
+                      softcap=None) -> jnp.ndarray:
     """Flash-style chunked attention over the paged context.
 
     The full-gather path above materializes ``[B,Hkv,S,G,T]`` scores — at
@@ -135,8 +154,14 @@ def _attend_blockwise(qg: jnp.ndarray, gather_chunk, num_table_pages: int,
         k, v = gather_chunk(c)
         s = jnp.einsum("bsngd,bntd->bnsgt", qg, k,
                        preferred_element_type=jnp.float32) * sm_scale
+        s = _softcap(s, softcap)
         t_pos = c * span + jnp.arange(span)
         causal = t_pos[None, None, :] <= positions[:, :, None]   # [B,S,span]
+        if window is not None:
+            in_win = ((window <= 0)
+                      | (t_pos[None, None, :] > positions[:, :, None]
+                         - window))
+            causal = causal & in_win
         valid = t_pos[None, None, :] < total_lens[:, None, None]
         mask = (causal & valid)[:, None, :, None, :]
         s = jnp.where(mask, s, NEG_INF)
@@ -178,8 +203,8 @@ def _gathered_to_bhtd(g: jnp.ndarray) -> jnp.ndarray:
 
 def paged_attention_layer(q: jnp.ndarray, kv_layer: jnp.ndarray,
                           page_table: jnp.ndarray, positions: jnp.ndarray,
-                          total_lens: jnp.ndarray, sm_scale: float
-                          ) -> jnp.ndarray:
+                          total_lens: jnp.ndarray, sm_scale: float,
+                          window=None, softcap=None) -> jnp.ndarray:
     """XLA-path attention against one layer's cache.
 
     q: [B, S, Hq, Dh]; kv_layer: [N, 2, Hkv, ps, Dh] -> [B, S, Hq, Dh]
@@ -202,18 +227,20 @@ def paged_attention_layer(q: jnp.ndarray, kv_layer: jnp.ndarray,
             return _gathered_to_bhtd(g[:, :, 0]), _gathered_to_bhtd(g[:, :, 1])
 
         return _attend_blockwise(qg, gather_chunk, P, ps, PAGES_PER_CHUNK,
-                                 positions, total_lens,
-                                 sm_scale).astype(q.dtype)
+                                 positions, total_lens, sm_scale,
+                                 window=window,
+                                 softcap=softcap).astype(q.dtype)
     gathered = kv_layer[page_table]        # [B, P, 2, Hkv, ps, Dh]
     k = _gathered_to_bhtd(gathered[:, :, 0])
     v = _gathered_to_bhtd(gathered[:, :, 1])
-    return _attend(qg, k, v, positions, total_lens,
-                   sm_scale).astype(q.dtype)
+    return _attend(qg, k, v, positions, total_lens, sm_scale,
+                   window=window, softcap=softcap).astype(q.dtype)
 
 
 def paged_attention(q: jnp.ndarray, pages: jnp.ndarray, layer_idx,
                     page_table: jnp.ndarray, positions: jnp.ndarray,
-                    total_lens: jnp.ndarray, sm_scale: float) -> jnp.ndarray:
+                    total_lens: jnp.ndarray, sm_scale: float,
+                    window=None, softcap=None) -> jnp.ndarray:
     """Attend queries to the stacked paged context (scan path).
 
     q:          [B, S, Hq, Dh]
@@ -239,8 +266,9 @@ def paged_attention(q: jnp.ndarray, pages: jnp.ndarray, layer_idx,
             return _gathered_to_bhtd(g[:, :, 0]), _gathered_to_bhtd(g[:, :, 1])
 
         return _attend_blockwise(qg, gather_chunk, P, ps, PAGES_PER_CHUNK,
-                                 positions, total_lens,
-                                 sm_scale).astype(q.dtype)
+                                 positions, total_lens, sm_scale,
+                                 window=window,
+                                 softcap=softcap).astype(q.dtype)
 
     # Single fused gather: the traced layer_idx participates as an advanced
     # index so XLA reads only the gathered pages (slicing pages[layer_idx]
@@ -248,8 +276,8 @@ def paged_attention(q: jnp.ndarray, pages: jnp.ndarray, layer_idx,
     gathered = pages[layer_idx, page_table]  # [B, P, 2, Hkv, ps, Dh]
     k = _gathered_to_bhtd(gathered[:, :, 0])
     v = _gathered_to_bhtd(gathered[:, :, 1])
-    return _attend(qg, k, v, positions, total_lens,
-                   sm_scale).astype(q.dtype)
+    return _attend(qg, k, v, positions, total_lens, sm_scale,
+                   window=window, softcap=softcap).astype(q.dtype)
 
 
 __all__ = ["write_kv", "write_kv_layer", "paged_attention",

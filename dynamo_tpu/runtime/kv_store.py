@@ -124,24 +124,30 @@ class _CoordBucket(KeyValueBucket):
         raw = await self._coord.get(self._prefix + key)
         if raw is None:
             return None
-        val = self._unwrap(raw)
-        if val is None:  # expired: collect lazily
-            await self._coord.delete(self._prefix + key)
-        return val
+        # expired entries are SKIPPED here, not deleted: an unguarded
+        # read-then-delete races a concurrent re-put and could drop the
+        # fresh value; collection happens in entries() behind a full-TTL
+        # grace window instead
+        return self._unwrap(raw)
 
     async def delete(self, key: str) -> bool:
         return (await self._coord.delete(self._prefix + key)) > 0
 
     async def entries(self) -> List[Tuple[str, bytes]]:
         out = []
+        grace = self.ttl or 0.0
         for k, raw in await self._coord.get_prefix(self._prefix):
-            val = self._unwrap(raw)
-            if val is None:
-                # lazy collection here too, or a bucket used only via
-                # entries() would leak expired keys forever
-                await self._coord.delete(k)
+            d = codec.unpack(raw)
+            if d["e"] and d["e"] <= time.time():
+                # lazy collection (a bucket used only via entries() must
+                # not leak forever), but only past a full extra TTL of
+                # grace — a racing re-put within that window would have
+                # rewritten the envelope, so the delete-vs-put race is
+                # confined to entries dead for >= 2x their TTL
+                if d["e"] + grace <= time.time():
+                    await self._coord.delete(k)
                 continue
-            out.append((k[len(self._prefix):], val))
+            out.append((k[len(self._prefix):], d["v"]))
         return out
 
 
