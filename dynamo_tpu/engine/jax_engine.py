@@ -293,9 +293,22 @@ class JaxEngine(ScheduledEngineBase):
         plan._step_id = self._step_counter
         if self.step_tap is not None:
             self.step_tap(kind, arrays, self._step_counter)
-        out = self.execute_arrays(kind, arrays, self._step_counter)
+        packed = self._invoke_step(kind, arrays, self._step_counter)
         self._step_counter += 1
-        return out
+        if (self.step_tap is None
+                and not any(c.is_last for c in plan.chunks)):
+            # No row samples a token this step (intermediate chunks of long
+            # prompts): skip the device->host readback — on a tunneled chip
+            # that is ~80 ms saved per chunk of TTFT; _process never reads
+            # non-last-chunk sampled values. Tradeoffs, both accepted:
+            # a device error in this step surfaces at the NEXT fetch and is
+            # attributed to that plan (the victims overlap — they are this
+            # prompt's own later chunks); and on MULTI-HOST we never skip,
+            # because the leader's step_outcome broadcast must reflect a
+            # real sync or a symmetric failure would read as divergence.
+            B = arrays["toks"].shape[0]
+            return np.zeros(B, np.int64), np.zeros(B, np.float32), None
+        return self.fetch_packed(packed)
 
     def _decode_arrays(self, seqs, chained: bool) -> dict:
         """Padded host arrays for one decode step.

@@ -79,13 +79,12 @@ def write_kv(pages: jnp.ndarray, layer_idx, k_new: jnp.ndarray,
 
 
 def _softcap(scores: jnp.ndarray, cap) -> jnp.ndarray:
-    """gemma-style logit soft-capping: cap * tanh(scores / cap). ``cap``
-    may be a traced scalar; 0 disables (selected via where so the op stays
-    shape-static under jit)."""
+    """gemma-style logit soft-capping: cap * tanh(scores / cap). Callers
+    pass ``cap=None`` when disabled (never a zero scalar), so the enabled
+    path is a bare tanh — no masking over the score tensor."""
     if cap is None:
         return scores
-    capped = jnp.tanh(scores / jnp.maximum(cap, 1e-6)) * cap
-    return jnp.where(cap > 0, capped, scores)
+    return jnp.tanh(scores / cap) * cap
 
 
 def _attend(qg: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,

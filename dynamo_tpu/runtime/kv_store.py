@@ -109,7 +109,11 @@ class _CoordBucket(KeyValueBucket):
 
     def _wrap(self, value: bytes) -> bytes:
         exp = (time.time() + self.ttl) if self.ttl else 0.0
-        return codec.pack({"e": exp, "v": bytes(value)})
+        # the WRITER's ttl rides in the envelope: readers use it as the
+        # collection grace window, so a no-TTL read handle can't collect
+        # a just-expired entry out from under a racing re-put
+        return codec.pack({"e": exp, "v": bytes(value),
+                           "t": float(self.ttl or 0.0)})
 
     def _unwrap(self, raw: bytes) -> Optional[bytes]:
         d = codec.unpack(raw)
@@ -135,9 +139,9 @@ class _CoordBucket(KeyValueBucket):
 
     async def entries(self) -> List[Tuple[str, bytes]]:
         out = []
-        grace = self.ttl or 0.0
         for k, raw in await self._coord.get_prefix(self._prefix):
             d = codec.unpack(raw)
+            grace = float(d.get("t", 0.0))  # the writer's ttl
             if d["e"] and d["e"] <= time.time():
                 # lazy collection (a bucket used only via entries() must
                 # not leak forever), but only past a full extra TTL of

@@ -474,3 +474,54 @@ class TestPipelinedDecode:
             assert len([t for f in frames for t in f.token_ids]) == 16
         finally:
             await eng.stop()
+
+
+class TestPrefillFetchSkipping:
+    async def test_intermediate_chunks_skip_readback(self):
+        """Only prefill steps containing a LAST chunk fetch results; the
+        intermediate chunks of a long prompt dispatch without the
+        device->host round trip (their sampled values are never read)."""
+        eng = tiny_engine(max_prefill_chunk=4, min_prefill_bucket=4,
+                          num_pages=32, max_context=64)
+        fetches = {"n": 0}
+        orig = eng.fetch_packed
+
+        def counting(packed):
+            fetches["n"] += 1
+            return orig(packed)
+
+        eng.fetch_packed = counting
+        try:
+            # 14-token prompt / 4-token chunks -> 4 prefill steps, only the
+            # final one needs a fetch; 3 decode steps follow
+            r = make_req(list(range(1, 15)), "long", max_tokens=3)
+            r.eos_token_ids = []
+            frames = await collect(eng, r)
+            toks = [t for f in frames for t in f.token_ids]
+            assert len(toks) == 3
+            # fetches: 1 (last prefill chunk, samples token 1) + 2 decode
+            # steps (tokens 2 and 3) = 3; the three intermediate prefill
+            # chunks fetched nothing
+            assert fetches["n"] == 3, fetches
+        finally:
+            await eng.stop()
+
+    async def test_long_prompt_tokens_unchanged(self):
+        """Greedy output across chunked prefill must be identical to a
+        one-chunk prefill of the same prompt (fetch skipping must not
+        perturb anything)."""
+        prompt = list(range(1, 15))
+
+        async def run(chunk):
+            eng = tiny_engine(max_prefill_chunk=chunk,
+                              min_prefill_bucket=4, num_pages=32,
+                              max_context=64)
+            try:
+                r = make_req(prompt, "p", max_tokens=4)
+                r.eos_token_ids = []
+                frames = await collect(eng, r)
+                return [t for f in frames for t in f.token_ids]
+            finally:
+                await eng.stop()
+
+        assert await run(4) == await run(16)
