@@ -288,7 +288,12 @@ class Scheduler:
         rt = self.cfg.ring_threshold
 
         def ring_eligible(s: Sequence) -> bool:
-            return (rt is not None and s.num_computed == 0
+            # a resident prefix composes with the ring (cached pages are
+            # merged via blockwise partials) as long as it is page-aligned
+            # (prefix-cache hits always are — admission truncates to full
+            # pages); the REMAINING tokens must justify a ring step
+            return (rt is not None
+                    and s.num_computed % self.page_size == 0
                     and len(s) - s.num_computed > rt)
 
         # cap admission at the batch width so admitted pages don't sit idle
@@ -304,16 +309,15 @@ class Scheduler:
                and len(self.active) < self.cfg.max_num_seqs):
             while self.waiting and self.waiting[0].cancelled:
                 self.reaped.append(self.waiting.popleft())
-            if (rt is not None and self.waiting
-                    and len(self.waiting[0]) > rt
-                    and n_ring >= self.cfg.max_ring_seqs
-                    and not self.alloc.peek_prefix(
-                        self.waiting[0].tokens.block_hashes())):
-                # head would take the ring path (long AND no resident
-                # prefix — a prefix-hit long prompt goes chunked and needs
-                # no ring slot); hold it — FIFO order forbids skipping
-                # ahead to shorter prompts
-                break
+            if rt is not None and self.waiting and n_ring >= self.cfg.max_ring_seqs:
+                head = self.waiting[0]
+                cached = (self.alloc.peek_prefix(head.tokens.block_hashes())
+                          * self.page_size)
+                if len(head) - cached > rt:
+                    # head would take the ring path (its REMAINING tokens
+                    # after any prefix hit exceed the threshold); hold it —
+                    # FIFO order forbids skipping ahead to shorter prompts
+                    break
             seq = self._try_admit()
             if seq is None:
                 break
@@ -326,10 +330,10 @@ class Scheduler:
             key=lambda s: s.arrival)
         if not prefilling:
             return None
-        # Long novel prompts take the sequence-parallel ring path: the whole
-        # prompt in ONE step, alone (its compute is already split sp ways).
-        # A prefix-hit sequence (num_computed > 0) must attend to resident
-        # pages, which the ring path doesn't read — it stays chunked.
+        # Long prompts take the sequence-parallel ring path: the remaining
+        # tokens in ONE step, alone (compute already split sp ways). A
+        # page-aligned resident prefix rides along — the ring merges cached
+        # pages via blockwise online-softmax partials (ring_prefill.py).
         # Oldest-first still governs: a ring step runs only when its sequence
         # is the oldest prefilling one; until then ring candidates are held
         # OUT of chunk packing (a single chunk would spoil eligibility), so
@@ -337,7 +341,8 @@ class Scheduler:
         if ring_eligible(prefilling[0]):
             seq = prefilling[0]
             return PrefillBatch(ring=True, chunks=[PrefillChunk(
-                seq=seq, start=0, length=len(seq), is_last=True)])
+                seq=seq, start=seq.num_computed,
+                length=len(seq) - seq.num_computed, is_last=True)])
         budget = self.cfg.max_prefill_chunk
         chunks: List[PrefillChunk] = []
         packable = [s for s in prefilling if not ring_eligible(s)]

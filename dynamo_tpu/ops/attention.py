@@ -121,8 +121,8 @@ PAGES_PER_CHUNK = 8
 def _attend_blockwise(qg: jnp.ndarray, gather_chunk, num_table_pages: int,
                       page_size: int, chunk_pages: int,
                       positions: jnp.ndarray, total_lens: jnp.ndarray,
-                      sm_scale: float, window=None,
-                      softcap=None) -> jnp.ndarray:
+                      sm_scale: float, window=None, softcap=None,
+                      return_partials: bool = False) -> jnp.ndarray:
     """Flash-style chunked attention over the paged context.
 
     The full-gather path above materializes ``[B,Hkv,S,G,T]`` scores — at
@@ -179,9 +179,38 @@ def _attend_blockwise(qg: jnp.ndarray, gather_chunk, num_table_pages: int,
     num0 = jnp.zeros((B, Hkv, S, G, Dh), jnp.float32)
     den0 = jnp.zeros((B, Hkv, S, G), jnp.float32)
     mx0 = jnp.full((B, Hkv, S, G), NEG_INF, jnp.float32)
-    num, den, _ = jax.lax.fori_loop(0, n_chunks, body, (num0, den0, mx0))
+    num, den, mx = jax.lax.fori_loop(0, n_chunks, body, (num0, den0, mx0))
+    if return_partials:
+        # [B,Hq,S,...] layout (grouped heads folded), matching the ring
+        # path's partials so the two contexts merge elementwise
+        Hq = Hkv * G
+        num_p = num.transpose(0, 1, 3, 2, 4).reshape(B, Hq, S, Dh)
+        den_p = den.transpose(0, 1, 3, 2).reshape(B, Hq, S)
+        mx_p = mx.transpose(0, 1, 3, 2).reshape(B, Hq, S)
+        return num_p, den_p, mx_p
     out = num / jnp.maximum(den, 1e-20)[..., None]               # [B,Hkv,S,G,Dh]
     return out.transpose(0, 2, 1, 3, 4).reshape(B, S, Hkv * G, Dh)
+
+
+def merge_softmax_partials(a, b):
+    """Combine two un-normalized online-softmax states over DISJOINT kv
+    contexts (e.g. ring self-attention over new tokens + blockwise
+    attention over cached pages). Each is (num [..., D], den [...],
+    mx [...]); dead states (mx == -inf: that context had no visible kv)
+    contribute zero. Returns the same triple."""
+    num_a, den_a, mx_a = a
+    num_b, den_b, mx_b = b
+    mx = jnp.maximum(mx_a, mx_b)
+    sa = jnp.where(mx_a > NEG_INF / 2, jnp.exp(mx_a - mx), 0.0)
+    sb = jnp.where(mx_b > NEG_INF / 2, jnp.exp(mx_b - mx), 0.0)
+    num = num_a * sa[..., None] + num_b * sb[..., None]
+    den = den_a * sa + den_b * sb
+    return num, den, mx
+
+
+def normalize_softmax_partials(num, den):
+    """(num, den) -> attention output; all-dead rows produce zeros."""
+    return num / jnp.maximum(den, 1e-20)[..., None]
 
 
 def _pad_table(page_table: jnp.ndarray, chunk_pages: int) -> jnp.ndarray:
@@ -280,4 +309,5 @@ def paged_attention(q: jnp.ndarray, pages: jnp.ndarray, layer_idx,
 
 
 __all__ = ["write_kv", "write_kv_layer", "paged_attention",
-           "paged_attention_layer", "NEG_INF"]
+           "paged_attention_layer", "merge_softmax_partials",
+           "normalize_softmax_partials", "NEG_INF"]

@@ -56,7 +56,8 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                    q_pos: jnp.ndarray, kv_pos: jnp.ndarray,
                    kv_valid: Optional[jnp.ndarray] = None,
                    sm_scale: Optional[float] = None,
-                   axis_name: str = "sp") -> jnp.ndarray:
+                   axis_name: str = "sp",
+                   return_partials: bool = False) -> jnp.ndarray:
     """Causal self-attention with the kv sequence sharded over a ring.
 
     Call INSIDE shard_map. Shapes are per-shard:
@@ -98,7 +99,12 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     mx0 = jnp.full((B, Hq, Sq), NEG_INF, jnp.float32)
     carry, _ = lax.scan(body, (k, v, kv_pos, kv_valid, num0, den0, mx0),
                         None, length=n)
-    num, den = carry[4], carry[5]
+    num, den, mx = carry[4], carry[5], carry[6]
+    if return_partials:
+        # un-normalized online-softmax state, for merging with partials
+        # from another context (e.g. cached pages in a prefix-hit ring
+        # prefill): num [B,Hq,Sq,D], den/mx [B,Hq,Sq]
+        return num, den, mx
     out = num / jnp.maximum(den, 1e-20)[..., None]         # [B,Hq,Sq,D]
     return out.transpose(0, 2, 1, 3).astype(q.dtype)
 
@@ -108,7 +114,8 @@ def ring_self_attention(mesh: Mesh, q: jnp.ndarray, k: jnp.ndarray,
                         kv_valid: Optional[jnp.ndarray] = None,
                         sm_scale: Optional[float] = None,
                         axis_name: str = "sp",
-                        head_axis: Optional[str] = None) -> jnp.ndarray:
+                        head_axis: Optional[str] = None,
+                        return_partials: bool = False) -> jnp.ndarray:
     """Full-array wrapper: shards the sequence axis over ``axis_name`` and
     runs ring attention. q/k/v [B, S, H, D], positions [B, S]; S must divide
     by the axis size.
@@ -128,11 +135,18 @@ def ring_self_attention(mesh: Mesh, q: jnp.ndarray, k: jnp.ndarray,
     pos_spec = P(None, axis_name)
 
     fn = functools.partial(ring_attention, sm_scale=sm_scale,
-                           axis_name=axis_name)
+                           axis_name=axis_name,
+                           return_partials=return_partials)
+    if return_partials:
+        nd_spec = P(None, head_axis, axis_name, None)   # num [B,Hq,Sq,D]
+        sc_spec = P(None, head_axis, axis_name)         # den/mx [B,Hq,Sq]
+        out_specs = (nd_spec, sc_spec, sc_spec)
+    else:
+        out_specs = seq_spec
     sharded = shard_map(
         fn, mesh=mesh,
         in_specs=(seq_spec, seq_spec, seq_spec, pos_spec, pos_spec, pos_spec),
-        out_specs=seq_spec, check_vma=False)
+        out_specs=out_specs, check_vma=False)
     return sharded(q, k, v, positions, positions, kv_valid)
 
 

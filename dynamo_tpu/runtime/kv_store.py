@@ -141,7 +141,9 @@ class _CoordBucket(KeyValueBucket):
         out = []
         for k, raw in await self._coord.get_prefix(self._prefix):
             d = codec.unpack(raw)
-            grace = float(d.get("t", 0.0))  # the writer's ttl
+            # writer's ttl; legacy envelopes (no "t") fall back to this
+            # handle's ttl so they keep their pre-upgrade protection
+            grace = float(d.get("t", self.ttl or 0.0))
             if d["e"] and d["e"] <= time.time():
                 # lazy collection (a bucket used only via entries() must
                 # not leak forever), but only past a full extra TTL of
