@@ -17,12 +17,21 @@ bandwidth-saturating engine, so this is comparable chip-to-chip — the
 reference's H100 stacks sit around 0.5-0.7 of their equivalent roofline.
 Diagnostics (TTFT, step counts) go to stderr.
 
-Robustness (round-1 lesson: the tunneled TPU backend can hang for minutes
-on init or fail UNAVAILABLE): the default entry is an ORCHESTRATOR that
-never imports jax itself. It runs the measurement in child subprocesses
-(``--_child``) under hard wall-clock timeouts, retries TPU init with
-backoff, and if the TPU never comes up, emits a CPU fallback number with an
-``"error"`` field — one JSON line on stdout no matter what.
+Robustness (three rounds of lessons: the tunneled TPU backend can hang for
+minutes on init, and round 2's one good window died in a cold compile):
+
+- The default entry is an ORCHESTRATOR that never imports jax. It probes the
+  TPU CONTINUOUSLY from t=0 across the whole budget (not a few front-loaded
+  attempt slots) and launches the measurement the moment a probe succeeds.
+- A separate cache-PRIMING child compiles the step programs one at a time
+  into jax's persistent compilation cache before the measurement child runs,
+  so a killed attempt still leaves later attempts warm program-by-program.
+- TIERED configs: full (3B, bs32×512+128) → reduced (3B, bs16×256+64) —
+  both ``valid: true`` on-chip numbers — then a CPU tiny fallback marked
+  ``valid: false``.
+- The engine's TPU path is now scan-over-layers with the layer-indexed
+  Pallas decode kernel (one compiled layer body), which cuts the cold
+  compile that killed round 2 by ~the layer count.
 """
 
 from __future__ import annotations
@@ -49,6 +58,14 @@ HBM_GBPS = {
 # the tunneled backend registers as platform "axon" but is a real TPU
 TPU_PLATFORMS = ("tpu", "axon")
 
+# measurement tiers: name -> (seqs, prompt, gen). Both TPU tiers run the
+# flagship Llama-3.2-3B geometry and produce valid on-chip numbers; the
+# reduced tier exists so a short tunnel window still yields valid data.
+TIERS = {
+    "full": (32, 512, 128),
+    "reduced": (16, 256, 64),
+}
+
 
 def detect_bandwidth() -> float:
     import jax
@@ -68,31 +85,25 @@ def tree_bytes(tree) -> int:
                for x in jax.tree_util.tree_leaves(tree))
 
 
-async def run_bench(args) -> dict:
+def _build_engine(args):
+    """The engine both the priming child and the measurement child build —
+    identical config so the persistent compile cache keys match."""
     import jax
-    import numpy as np
 
     from dynamo_tpu.engine.jax_engine import JaxEngine, JaxEngineConfig
     from dynamo_tpu.models.config import ModelConfig
-    from dynamo_tpu.protocols.common import (
-        PreprocessedRequest, SamplingOptions, StopConditions)
     from dynamo_tpu.utils.platform import enable_compilation_cache
 
-    # persistent compile cache: a repeat run of the same config loads its
-    # step programs from disk instead of recompiling (minutes -> seconds on
-    # the tunneled chip); shared via JAX_COMPILATION_CACHE_DIR with any
-    # retry attempts the orchestrator launches
     enable_compilation_cache()
-
     on_tpu = jax.devices()[0].platform in TPU_PLATFORMS
-    if args.small or not on_tpu:
+    if args.tier == "tiny" or not on_tpu:
         cfg = ModelConfig.tiny(dtype="float32")
         seqs, prompt, gen = 4, 32, 16
         page_size, max_ctx = 4, 64
     else:
         cfg = ModelConfig.llama32_3b()
-        seqs, prompt, gen = args.seqs, args.prompt, args.gen
-        page_size, max_ctx = 16, args.prompt + args.gen + 64
+        seqs, prompt, gen = TIERS[args.tier]
+        page_size, max_ctx = 16, prompt + gen + 64
 
     pages_needed = seqs * ((prompt + gen) // page_size + 2)
     # pin ONE compiled shape per step family ([8, prompt] prefill,
@@ -105,8 +116,54 @@ async def run_bench(args) -> dict:
         max_prefill_seqs=prefill_seqs,
         max_context=max_ctx, min_prefill_bucket=min(512, prompt),
         min_prefill_seqs_bucket=prefill_seqs,
-        min_decode_bucket=seqs)
+        min_decode_bucket=seqs,
+        attn_impl=args.attn_impl)
     engine = JaxEngine.random_init(cfg, ecfg)
+    return engine, cfg, (seqs, prompt, gen, prefill_seqs), on_tpu
+
+
+def _prime_programs(engine, seqs: int, prompt: int,
+                    prefill_seqs: int) -> None:
+    """Compile the three step programs one at a time (no requests), each
+    landing in the persistent cache as soon as it finishes — a later
+    measurement child starts warm even if this child is killed mid-way.
+    Prints per-program compile seconds (the on-chip diagnostic three rounds
+    of failed benches never produced)."""
+    import jax
+    import numpy as np
+
+    P = engine.table_width
+
+    def arrays(B, S):
+        return dict(
+            toks=np.zeros((B, S), np.int32),
+            pos=np.tile(np.arange(S, dtype=np.int32)[None], (B, 1)),
+            table=np.zeros((B, P), np.int32),
+            total=np.full((B,), S, np.int32),
+            new=np.zeros((B,), np.int32),  # nothing written: garbage page
+            temp=np.zeros((B,), np.float32),
+            top_k=np.zeros((B,), np.int32),
+            top_p=np.ones((B,), np.float32))
+
+    plans = [("prefill", "step", arrays(prefill_seqs, prompt)),
+             ("decode", "step", arrays(seqs, 1)),
+             ("chained", "chained", arrays(seqs, 1))]
+    for name, kind, a in plans:
+        t0 = time.perf_counter()
+        packed = engine._invoke_step(kind, a, 0)
+        jax.block_until_ready(packed)
+        print(f"bench: primed {name} [{a['toks'].shape[0]}, "
+              f"{a['toks'].shape[1]}] in {time.perf_counter() - t0:.1f}s",
+              file=sys.stderr, flush=True)
+
+
+async def run_bench(args) -> dict:
+    import numpy as np
+
+    from dynamo_tpu.protocols.common import (
+        PreprocessedRequest, SamplingOptions, StopConditions)
+
+    engine, cfg, (seqs, prompt, gen, _pfs), on_tpu = _build_engine(args)
 
     rng = np.random.default_rng(0)
 
@@ -137,11 +194,11 @@ async def run_bench(args) -> dict:
         return first, count
 
     try:
-        # warmup: compile the REAL prefill and decode shapes — a full-width
-        # concurrent batch, or the timed phase eats a multi-minute XLA
-        # compile of the shapes it actually runs (round-2 lesson: warmup at
-        # [1, S] left [8, S] to compile inside the measurement). Decode
-        # needs >2 steps so the chained (pipelined) program also compiles.
+        # warmup: compile (or load from the persistent cache the priming
+        # child filled) the REAL prefill and decode shapes — a full-width
+        # concurrent batch, or the timed phase eats the compile of the
+        # shapes it actually runs. Decode needs >2 steps so the chained
+        # (pipelined) program also compiles.
         print("bench: warmup/compile...", file=sys.stderr, flush=True)
         t_setup = time.perf_counter()  # engine built; this times compiles only
         await asyncio.gather(
@@ -199,15 +256,18 @@ async def run_bench(args) -> dict:
           f"roofline {roofline_tok_s:.0f} tok/s "
           f"(params {param_bytes / 1e9:.2f} GB)", file=sys.stderr, flush=True)
 
+    tpu_run = on_tpu and args.tier != "tiny"
     return {
         "metric": f"decode_throughput_llama3b_bs{seqs}"
-                  if on_tpu and not args.small else "decode_throughput_tiny",
+                  if tpu_run else "decode_throughput_tiny",
         "value": round(tok_per_s, 1),
         "unit": "tokens/sec",
         "vs_baseline": round(tok_per_s / roofline_tok_s, 4),
-        # the primary configuration really ran (the driver must treat any
-        # fallback JSON as a failed round, VERDICT r2 item 4)
-        "valid": bool(on_tpu and not args.small),
+        # the primary configuration really ran on the chip (the driver must
+        # treat any CPU fallback JSON as a failed round, VERDICT r2 item 4)
+        "valid": bool(tpu_run),
+        "tier": args.tier,
+        "attn_impl": engine.attn_impl,
         "kv_inject_gbps": kv_gbps,
         "kv_wire_gbps": kv_wire_gbps,
         "kv_bulk_gbps": kv_bulk_gbps,
@@ -217,16 +277,29 @@ async def run_bench(args) -> dict:
     }
 
 
+# target bytes per transport measurement: small samples measure framing
+# overhead, not bandwidth (VERDICT r3: 1 MB samples made a 6 GB/s plane
+# read as 0.2) — stream >=128 MB through the real block geometry
+TRANSPORT_TARGET_BYTES = 128 * 1024 * 1024
+TRANSPORT_REPS = 5
+
+
 def _bench_frames(engine):
     """Synthetic wire frames shaped like this engine's KV blocks (shared by
-    the wire/bulk transport measurements so their GB/s are comparable)."""
+    the wire/bulk transport measurements so their GB/s are comparable).
+    Frame count/width sized so one full fetch moves >=TRANSPORT_TARGET_BYTES
+    (the serving geometry: a 3B-model block is ~1.8 MB, so a 64-block prefix
+    fetch is ~117 MB — measuring less benchmarks the framing, not the
+    plane)."""
     import numpy as np
 
     ref = engine.pages[0] if isinstance(engine.pages, list) else engine.pages
     L = (len(engine.pages) if isinstance(engine.pages, list)
          else engine.pages.shape[0])
     blk_shape = (L,) + tuple(ref.shape[-4:])  # [L, 2, Hkv, ps, Dh]
-    per_frame, n_frames = 16, 8
+    blk_bytes = int(np.prod(blk_shape)) * 2   # uint16 payload
+    n_frames = 8
+    per_frame = max(4, -(-TRANSPORT_TARGET_BYTES // (n_frames * blk_bytes)))
     chunk = np.ones((per_frame,) + blk_shape, np.uint16)
     meta = {"blocks": [[i, i, None] for i in range(per_frame)],
             "dtype": "uint16", "block_shape": list(blk_shape)}
@@ -234,15 +307,21 @@ def _bench_frames(engine):
 
 
 async def _time_transport(label: str, fetch_once, total_bytes: int) -> float:
-    """Warm once, time once; returns GB/s. ``fetch_once()`` -> bytes got."""
-    for _ in range(2):
+    """Warm once, then median of TRANSPORT_REPS timed fetches; returns GB/s.
+    ``fetch_once()`` -> bytes got."""
+    got = await fetch_once()  # warm (connection setup, first-touch pages)
+    assert got == total_bytes, (got, total_bytes)
+    times = []
+    for _ in range(TRANSPORT_REPS):
         t0 = time.perf_counter()
         got = await fetch_once()
-        dt = time.perf_counter() - t0
-    assert got == total_bytes, (got, total_bytes)
+        times.append(time.perf_counter() - t0)
+        assert got == total_bytes, (got, total_bytes)
+    dt = statistics.median(times)
     gbps = total_bytes / dt / 1e9
     print(f"bench: kv {label} {total_bytes / 1e6:.0f} MB in {dt * 1e3:.0f}ms"
-          f" -> {gbps:.2f} GB/s", file=sys.stderr, flush=True)
+          f" (median of {TRANSPORT_REPS}) -> {gbps:.2f} GB/s",
+          file=sys.stderr, flush=True)
     return round(gbps, 2)
 
 
@@ -250,7 +329,7 @@ async def _measure_kv_bulk(engine) -> float:
     """Bulk data plane bandwidth (GB/s): synthetic block frames through
     runtime/bulk.py's raw-socket plane (unix-first — the transport disagg
     actually uses between colocated workers)."""
-    from dynamo_tpu.runtime.bulk import BulkServer, bulk_fetch
+    from dynamo_tpu.runtime.bulk import BulkServer, bulk_fetch, release_buffer
 
     meta, chunk, n_frames = _bench_frames(engine)
 
@@ -262,9 +341,19 @@ async def _measure_kv_bulk(engine) -> float:
         unix_path=f"/tmp/dynamo_bench_bulk_{os.getpid()}.sock").start()
     server.register("kv", handler)
 
+    def fetch_sync() -> int:
+        got = 0
+
+        def on_frame(_m, raw):
+            nonlocal got
+            got += len(raw)
+            release_buffer(raw)  # steady state: consumer returns buffers
+
+        bulk_fetch(server.address, "kv", {}, on_frame=on_frame)
+        return got
+
     async def fetch_once() -> int:
-        frames = await asyncio.to_thread(bulk_fetch, server.address, "kv", {})
-        return sum(len(r) for _m, r in frames)
+        return await asyncio.to_thread(fetch_sync)
 
     try:
         return await _time_transport("bulk", fetch_once,
@@ -308,7 +397,8 @@ async def _measure_kv_wire(engine) -> float:
 
 def _measure_kv_inject(engine) -> float:
     """KV-block injection bandwidth (GB/s) via the ICI-path donated scatter
-    (gathered device array -> jitted in-place scatter, no host bounce)."""
+    (gathered device array -> jitted in-place scatter, no host bounce).
+    64 serving-geometry blocks (~117 MB on the 3B config), median of 5."""
     import jax
 
     n_blk = 1
@@ -320,33 +410,43 @@ def _measure_kv_inject(engine) -> float:
     engine.scatter_pages_device(ids, data)  # compile warmup
     ref = engine.pages[0] if isinstance(engine.pages, list) else engine.pages
     jax.block_until_ready(ref)
-    reps = 5
-    t0 = time.perf_counter()
-    for _ in range(reps):
+    times = []
+    for _ in range(TRANSPORT_REPS):
+        t0 = time.perf_counter()
         engine.scatter_pages_device(ids, data)
-    ref = engine.pages[0] if isinstance(engine.pages, list) else engine.pages
-    jax.block_until_ready(ref)
-    dt = (time.perf_counter() - t0) / reps
+        ref = (engine.pages[0] if isinstance(engine.pages, list)
+               else engine.pages)
+        jax.block_until_ready(ref)
+        times.append(time.perf_counter() - t0)
+    dt = statistics.median(times)
     nbytes = data.size * data.dtype.itemsize
     gbps = nbytes / dt / 1e9
     print(f"bench: kv inject {n_blk} blocks ({nbytes / 1e6:.1f} MB) "
-          f"in {dt * 1e3:.1f}ms -> {gbps:.1f} GB/s",
-          file=sys.stderr, flush=True)
+          f"in {dt * 1e3:.1f}ms (median of {TRANSPORT_REPS}) "
+          f"-> {gbps:.1f} GB/s", file=sys.stderr, flush=True)
     return round(gbps, 2)
 
 
 def _parse_args(argv=None):
     p = argparse.ArgumentParser()
-    p.add_argument("--seqs", type=int, default=32)
-    p.add_argument("--prompt", type=int, default=512)
-    p.add_argument("--gen", type=int, default=128)
+    p.add_argument("--tier", choices=["full", "reduced", "tiny"],
+                   default="full")
     p.add_argument("--small", action="store_true",
-                   help="tiny config (CI / CPU smoke)")
+                   help="alias for --tier tiny (CI / CPU smoke)")
+    p.add_argument("--attn-impl", default="auto",
+                   help="engine attn_impl (auto/pallas/pallas_unrolled/"
+                        "scan/unrolled) for on-chip A/B runs")
     p.add_argument("--_child", action="store_true",
                    help="internal: run the measurement in this process")
+    p.add_argument("--_prime", action="store_true",
+                   help="internal: compile the step programs into the "
+                        "persistent cache, run nothing")
     p.add_argument("--budget", type=float, default=520.0,
                    help="orchestrator total wall-clock budget (s)")
-    return p.parse_args(argv)
+    args = p.parse_args(argv)
+    if args.small:
+        args.tier = "tiny"
+    return args
 
 
 def _child_main(args) -> None:
@@ -354,13 +454,18 @@ def _child_main(args) -> None:
         from dynamo_tpu.utils.platform import force_cpu_platform
 
         force_cpu_platform()
+    if args._prime:
+        engine, _cfg, (seqs, prompt, _gen, pfs), _on_tpu = _build_engine(args)
+        _prime_programs(engine, seqs, prompt, pfs)
+        print(json.dumps({"primed": True}), flush=True)
+        return
     result = asyncio.run(run_bench(args))
     print(json.dumps(result), flush=True)
 
 
 def _run_attempt(argv: list[str], env: dict, timeout: float) -> dict | None:
-    """Run one child measurement; return its parsed JSON result or None."""
-    cmd = [sys.executable, os.path.abspath(__file__), "--_child"] + argv
+    """Run one child; return its parsed JSON result line or None."""
+    cmd = [sys.executable, os.path.abspath(__file__)] + argv
     print(f"bench: attempt {argv} timeout={timeout:.0f}s",
           file=sys.stderr, flush=True)
     try:
@@ -382,32 +487,36 @@ def _run_attempt(argv: list[str], env: dict, timeout: float) -> dict | None:
     return None
 
 
+PROBE_WINDOW = 75.0   # max seconds a single probe may take (init hang guard)
+PROBE_GAP = 10.0      # pause between failed probes
+
+
 def main() -> None:
     args = _parse_args()
-    if args._child:
+    if args._child or args._prime:
         _child_main(args)
         return
 
-    # Orchestrator: never imports jax. TPU attempts with backoff under a
-    # global budget, reserving time for a CPU fallback measurement.
+    # Orchestrator: never imports jax. Probe the TPU continuously across
+    # the whole budget; the moment one probe succeeds, prime the compile
+    # cache and run the measurement, degrading full -> reduced tier as the
+    # budget shrinks. CPU fallback only when the chip never answered.
     deadline = time.monotonic() + args.budget
-    cpu_reserve = 150.0
-    child_argv = ["--seqs", str(args.seqs), "--prompt", str(args.prompt),
-                  "--gen", str(args.gen)] + (["--small"] if args.small else [])
+    cpu_reserve = 120.0
 
     tpu_env = dict(os.environ)
     tpu_env.pop("JAX_PLATFORMS", None)  # let the TPU plugin register
     errors: list[str] = []
-    attempt = 0
     probes = 0
-    while time.monotonic() + cpu_reserve < deadline and attempt < 3:
-        # cheap probe first: the tunneled backend's failure mode is a HANG
-        # at init — burning a full attempt's timeout discovering that
-        # wastes the budget a later flaky-tunnel window could have used
-        probes += 1
-        probe_budget = min(75.0, deadline - time.monotonic() - cpu_reserve)
+    primed = False
+    measure_attempts = 0
+    while time.monotonic() + cpu_reserve < deadline:
+        probe_budget = min(PROBE_WINDOW,
+                           deadline - time.monotonic() - cpu_reserve)
         if probe_budget <= 5.0:
             break
+        probes += 1
+        t_probe = time.monotonic()
         try:
             probe_rc = subprocess.run(
                 [sys.executable, "-c", "import jax; jax.devices()"],
@@ -417,32 +526,64 @@ def main() -> None:
         except subprocess.TimeoutExpired:
             probe_rc = -1
         if probe_rc != 0:
-            print(f"bench: tpu probe {probes} failed/hung", file=sys.stderr,
+            print(f"bench: tpu probe {probes} failed/hung "
+                  f"({time.monotonic() - t_probe:.0f}s)", file=sys.stderr,
                   flush=True)
-            errors.append(f"tpu probe {probes} failed")
+            if probes <= 5:
+                errors.append(f"tpu probe {probes} failed")
             if time.monotonic() + cpu_reserve < deadline:
-                time.sleep(10.0)
+                time.sleep(PROBE_GAP)
             continue
+        print(f"bench: tpu probe {probes} OK "
+              f"({time.monotonic() - t_probe:.0f}s)", file=sys.stderr,
+              flush=True)
+
         remaining = deadline - time.monotonic() - cpu_reserve
-        if remaining < 30.0:
-            errors.append("tpu probe ok but budget exhausted")
+        if remaining < 45.0:
+            errors.append("tpu up but budget exhausted")
             break
-        attempt += 1
-        result = _run_attempt(child_argv, tpu_env, min(remaining, 380.0))
+        if args.tier == "tiny":
+            # the user asked for the smoke config: honor it (still runs on
+            # the TPU when one answered the probe)
+            tier = "tiny"
+        elif (args.tier == "full" and remaining >= 240.0
+                and measure_attempts == 0):
+            tier = "full"
+        else:  # degrade only: never escalate past what was asked for
+            tier = "reduced" if args.tier == "full" else args.tier
+        common = ["--tier", tier, "--attn-impl", args.attn_impl]
+        # prime the compile cache in its own child: even if it dies partway,
+        # every program it finished is persisted for the measurement child
+        if not primed and remaining >= 150.0:
+            prime_budget = remaining - 90.0
+            r = _run_attempt(["--_prime"] + common, tpu_env,
+                             min(prime_budget, 300.0))
+            primed = r is not None and r.get("primed", False)
+            if not primed:
+                errors.append("prime child failed/timed out")
+            remaining = deadline - time.monotonic() - cpu_reserve
+            if remaining < 45.0:
+                errors.append("primed but budget exhausted")
+                break
+        measure_attempts += 1
+        result = _run_attempt(["--_child"] + common, tpu_env,
+                              min(remaining, 380.0))
         if result is not None:
-            result["attempts"] = attempt
+            result["attempts"] = measure_attempts
+            result["probes"] = probes
             print(json.dumps(result), flush=True)
             return
-        errors.append(f"tpu attempt {attempt} failed/timed out")
-        if attempt < 3 and time.monotonic() + cpu_reserve < deadline:
-            time.sleep(min(10.0 * attempt, 30.0))
+        errors.append(f"tpu measure attempt {measure_attempts} "
+                      f"(tier {tier}) failed/timed out")
+        if time.monotonic() + cpu_reserve < deadline:
+            time.sleep(PROBE_GAP)
 
     # CPU fallback: a real (tiny) measurement so the driver always gets a
     # number, with the failure recorded.
     cpu_env = dict(os.environ)
     cpu_env["JAX_PLATFORMS"] = "cpu"
     cpu_env["BENCH_FORCE_CPU"] = "1"
-    result = _run_attempt(["--small"], cpu_env,
+    result = _run_attempt(["--_child", "--tier", "tiny"], cpu_env,
                           max(deadline - time.monotonic(), 60.0))
     if result is None:
         result = {"metric": "decode_throughput", "value": 0.0,
@@ -454,6 +595,7 @@ def main() -> None:
     # records a failed round instead of mistaking the toy number for the
     # real one (VERDICT r2: a fallback at rc=0 read as success)
     result["valid"] = False
+    result["probes"] = probes
     result["error"] = "; ".join(errors)
     print(json.dumps(result), flush=True)
 

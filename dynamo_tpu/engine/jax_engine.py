@@ -67,11 +67,18 @@ class JaxEngineConfig:
     num_top_logprobs: int = 8
     seed: int = 0
     # attention implementation:
-    #   "scan"     — lax.scan over layers, stacked cache, XLA gather attention
+    #   "scan"     — lax.scan over layers, stacked cache, XLA attention
     #                (portable; CPU tests)
+    #   "pallas"   — scan + stacked cache, with the layer-indexed Pallas
+    #                decode kernel inside the scan body for S == 1 steps
+    #                (TPU default: one compiled layer body — ~L× cheaper
+    #                cold compile than the unrolled families — with the
+    #                kernel's page-streaming DMAs)
     #   "unrolled" — python loop over layers, per-layer cache buffers, XLA
-    #                gather attention (pallas minus the kernel; CPU-testable)
-    #   "pallas"   — unrolled + Pallas paged decode kernel (TPU)
+    #                gather attention (CPU-testable)
+    #   "pallas_unrolled" — unrolled + per-layer Pallas decode kernel
+    #                (round-3 TPU path; kept for on-chip A/B against the
+    #                scan+pallas path)
     #   "auto"     — pallas on TPU, scan elsewhere
     attn_impl: str = "auto"
     # pipelined decode: step N+1 consumes step N's sampled tokens directly
@@ -108,8 +115,17 @@ class JaxEngine(ScheduledEngineBase):
         self.model_cfg = model_cfg
         self.cfg = config or JaxEngineConfig()
         self._sp = 1
+        self._dp = 1
         if self.cfg.mesh is not None:
             self._sp = dict(self.cfg.mesh.shape).get(self.cfg.sp_axis, 1)
+            self._dp = dict(self.cfg.mesh.shape).get("dp", 1)
+        if self._dp > 1:
+            # batch-dim sharding needs every padded batch divisible by dp:
+            # raise the bucket floors so even a 1-sequence step pads to dp
+            self.cfg.min_decode_bucket = max(self.cfg.min_decode_bucket,
+                                             self._dp)
+            self.cfg.min_prefill_seqs_bucket = max(
+                self.cfg.min_prefill_seqs_bucket, self._dp)
         ring_threshold = None
         if self._sp > 1:
             ring_threshold = (self.cfg.ring_threshold
@@ -132,19 +148,19 @@ class JaxEngine(ScheduledEngineBase):
             # the tunneled single-chip backend registers as "axon"
             on_tpu = jax.devices()[0].platform in ("tpu", "axon")
             impl = "pallas" if on_tpu else "scan"
-        if impl == "pallas":
+        if impl in ("pallas", "pallas_unrolled"):
             from dynamo_tpu.ops.pallas.decode import supports
             if not supports(model_cfg.head_dim, self.cfg.page_size):
                 logger.info(
                     "pallas decode kernel needs head_dim%%128==0 and "
-                    "page_size%%8==0 (got %d/%d); using the XLA scan path",
+                    "page_size%%8==0 (got %d/%d); using the XLA path",
                     model_cfg.head_dim, self.cfg.page_size)
-                impl = "scan"
+                impl = "scan" if impl == "pallas" else "unrolled"
         self.attn_impl = impl
-        if impl == "scan":
+        if impl in ("scan", "pallas"):
             self.pages = llama.make_pages(model_cfg, self.cfg.num_pages,
                                           self.cfg.page_size)
-        elif impl in ("unrolled", "pallas"):
+        elif impl in ("unrolled", "pallas_unrolled"):
             self.pages = llama.make_pages_list(model_cfg, self.cfg.num_pages,
                                                self.cfg.page_size)
         else:
@@ -174,15 +190,52 @@ class JaxEngine(ScheduledEngineBase):
 
     # -- compiled step -----------------------------------------------------
 
+    def _shard_batch(self, tokens, positions, page_table, total_lens,
+                     new_lens, temperature, top_k, top_p):
+        """Constrain the batch dim over the mesh's ``dp`` axis (cross-host
+        data parallelism): GSPMD partitions the whole forward along batch,
+        and ``_sample_tail`` re-replicates the packed output (a tiny
+        [B, 2+2K] all-gather) so rank 0 reads every row locally — the
+        missing piece that kept multi-host at tp/sp-only (VERDICT r3 §5)."""
+        if self._dp <= 1 or tokens.shape[0] % self._dp:
+            # indivisible batch (e.g. the B=1 ring prefill): replicated
+            return (tokens, positions, page_table, total_lens, new_lens,
+                    temperature, top_k, top_p)
+        from jax.sharding import NamedSharding, PartitionSpec
+        row = NamedSharding(self.cfg.mesh, PartitionSpec("dp"))
+        mat = NamedSharding(self.cfg.mesh, PartitionSpec("dp", None))
+        c = jax.lax.with_sharding_constraint
+        return (c(tokens, mat), c(positions, mat), c(page_table, mat),
+                c(total_lens, row), c(new_lens, row), c(temperature, row),
+                c(top_k, row), c(top_p, row))
+
     def _step_impl(self, params, pages, tokens, positions, page_table,
                    total_lens, new_lens, rng, step, temperature, top_k, top_p):
-        if self.attn_impl == "scan":
-            logits, pages = self._forward(params, self.model_cfg, tokens,
-                                          positions, pages, page_table,
-                                          total_lens, new_lens)
+        (tokens, positions, page_table, total_lens, new_lens, temperature,
+         top_k, top_p) = self._shard_batch(
+            tokens, positions, page_table, total_lens, new_lens, temperature,
+            top_k, top_p)
+        if self.attn_impl in ("scan", "pallas"):
+            if self.attn_impl == "pallas":
+                if tokens.shape[1] == 1:
+                    from dynamo_tpu.ops.pallas.decode import (
+                        paged_decode_attention_stacked as attn)
+                else:
+                    from dynamo_tpu.ops.pallas.prefill import (
+                        paged_prefill_attention_stacked as attn)
+                logits, pages = self._forward(
+                    params, self.model_cfg, tokens, positions, pages,
+                    page_table, total_lens, new_lens, attn_impl=attn)
+            else:
+                # no attn_impl kwarg: custom forward_fns (pipeline_forward)
+                # only implement the base signature
+                logits, pages = self._forward(params, self.model_cfg, tokens,
+                                              positions, pages, page_table,
+                                              total_lens, new_lens)
         else:
             attn = None
-            if self.attn_impl == "pallas" and tokens.shape[1] == 1:
+            if (self.attn_impl == "pallas_unrolled"
+                    and tokens.shape[1] == 1):
                 from dynamo_tpu.ops.pallas import paged_decode_attention
                 attn = paged_decode_attention
             logits, pages = self._forward_unrolled(
@@ -235,7 +288,14 @@ class JaxEngine(ScheduledEngineBase):
             top_lps = vals - jax.nn.logsumexp(lf, axis=-1, keepdims=True)
             cols.append(ids.astype(jnp.int32))
             cols.append(jax.lax.bitcast_convert_type(top_lps, jnp.int32))
-        return pages, jnp.concatenate(cols, axis=1)
+        packed = jnp.concatenate(cols, axis=1)
+        if self._dp > 1:
+            # gather the dp-sharded rows back to every rank (rank 0 reads
+            # the whole batch locally; [B, 2+2K] int32 — a few KB)
+            from jax.sharding import NamedSharding, PartitionSpec
+            packed = jax.lax.with_sharding_constraint(
+                packed, NamedSharding(self.cfg.mesh, PartitionSpec()))
+        return pages, packed
 
     # -- plan -> device arrays --------------------------------------------
 

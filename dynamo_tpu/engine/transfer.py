@@ -234,14 +234,20 @@ def export_frames(engine: JaxEngine, block_hashes: List[int]) -> List[Raw]:
 
 
 def inject_frame(engine: JaxEngine, meta: Dict[str, Any]) -> int:
-    """Inject one batched wire frame (``export_frames`` schema). The raw
-    buffer is viewed, never copied, until the device upload. Runs under
-    ``run_exclusive``. Returns blocks injected."""
+    """Inject one batched wire frame (``export_frames`` schema). Runs under
+    ``run_exclusive``. Returns blocks injected.
+
+    The block-major -> layer-major transpose is materialized as an OWNING
+    copy: callers release the wire buffer back to the bulk freelist as soon
+    as this returns, so nothing here may keep aliasing it (``jnp.asarray``
+    can zero-copy a contiguous numpy array on the CPU backend, and the
+    device upload itself is async). The copy is the same one ``jnp.asarray``
+    would make for the non-contiguous view anyway."""
     raw = meta["_raw"]
     shape = [len(meta["blocks"])] + list(meta["block_shape"])
     arr = np.frombuffer(raw, dtype=np.dtype(meta["dtype"])).reshape(shape)
     metas = [(b[0], b[1], b[2]) for b in meta["blocks"]]
-    return _inject_data(engine, metas, np.moveaxis(arr, 0, 1))
+    return _inject_data(engine, metas, np.moveaxis(arr, 0, 1).copy())
 
 
 def serve_kv_export_bulk(engine: JaxEngine, loop):

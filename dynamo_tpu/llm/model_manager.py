@@ -101,9 +101,10 @@ class ModelWatcher:
 
     async def _handle_put(self, key: str, value: bytes) -> None:
         entry = ModelEntry.from_json(value)
-        if entry.model_type == "prefill":
-            # disagg prefill workers are internal: decode workers discover
-            # them by component; frontends must not route chat traffic there
+        if entry.model_type in ("prefill", "decode"):
+            # disagg-internal workers: "prefill" (decode-first flow) and
+            # "decode" (prefill-first flow) are discovered by component by
+            # their peer role; frontends must not route chat traffic there
             return
         instances = self._model_instances.setdefault(entry.name, set())
         instances.add(key)

@@ -42,11 +42,19 @@ class ModelDeploymentCard:
     extra: Dict[str, Any] = field(default_factory=dict)
 
     def load_tokenizer(self):
-        """Resolve the card's tokenizer (inline JSON preferred, else path)."""
+        """Resolve the card's tokenizer (inline JSON preferred, else path).
+
+        A ``.model`` path selects the native SentencePiece backend
+        (reference: ``lib/llm/src/tokenizers/sp.rs`` behind the same file
+        dispatch, ``tokenizers.rs:586``); anything else is HF
+        ``tokenizers`` JSON."""
         from dynamo_tpu.preprocessor.tokenizer import HfTokenizer  # lazy: avoids cycle
         if self.tokenizer_json:
             return HfTokenizer.from_json(self.tokenizer_json)
         if self.tokenizer_path:
+            if self.tokenizer_path.endswith(".model"):
+                from dynamo_tpu.preprocessor.sp_tokenizer import SpTokenizer
+                return SpTokenizer.from_file(self.tokenizer_path)
             return HfTokenizer.from_file(self.tokenizer_path)
         raise ValueError(f"model card {self.name!r} carries no tokenizer")
 
@@ -134,11 +142,16 @@ class ModelDeploymentCard:
             if isinstance(bos, int):
                 card.bos_token_id = bos
         tok_path = os.path.join(path, "tokenizer.json")
+        sp_path = os.path.join(path, "tokenizer.model")
         if os.path.exists(tok_path):
             card.tokenizer_path = tok_path
             if inline_tokenizer:
                 with open(tok_path) as f:
                     card.tokenizer_json = f.read()
+        elif os.path.exists(sp_path):
+            # SentencePiece-only checkpoint (original llama/mistral/gemma
+            # releases): the native SP backend serves it
+            card.tokenizer_path = sp_path
         tc_path = os.path.join(path, "tokenizer_config.json")
         if os.path.exists(tc_path):
             with open(tc_path) as f:

@@ -150,8 +150,13 @@ def _embed(cfg: ModelConfig, params: Params,
 def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
             positions: jnp.ndarray, pages: jnp.ndarray,
             page_table: jnp.ndarray, total_lens: jnp.ndarray,
-            new_lens: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Scan-over-layers forward against the stacked paged cache."""
+            new_lens: jnp.ndarray,
+            attn_impl: Optional[Callable] = None
+            ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Scan-over-layers forward. ``attn_impl`` is IGNORED: the Pallas decode
+    kernel implements neither soft-capping nor sliding windows, so gemma
+    always takes the XLA attention paths."""
+    del attn_impl
     sm_scale = _sm_scale(cfg)
     softcap = (jnp.asarray(cfg.attn_logit_softcap, jnp.float32)
                if cfg.attn_logit_softcap else None)

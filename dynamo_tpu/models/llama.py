@@ -176,7 +176,9 @@ def _logits(cfg: ModelConfig, params: Params, h: jnp.ndarray,
 def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
             positions: jnp.ndarray, pages: jnp.ndarray,
             page_table: jnp.ndarray, total_lens: jnp.ndarray,
-            new_lens: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+            new_lens: jnp.ndarray,
+            attn_impl: Optional[Callable] = None
+            ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Scan-over-layers forward against the stacked paged cache.
 
     tokens:     [B, S] new token ids (padded; pads masked via new_lens)
@@ -185,10 +187,17 @@ def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     page_table: [B, P] physical page ids per sequence
     total_lens: [B] context length including the new tokens
     new_lens:   [B] real new tokens per sequence (<= S)
+    attn_impl:  optional stacked-cache attention override with
+                ``paged_attention``'s signature — the engine passes the
+                Pallas decode kernel (``paged_decode_attention_stacked``)
+                for S == 1 steps on TPU; the traced scan index selects the
+                layer inside the kernel's DMA, so decode keeps the
+                single-compiled-layer-body scan.
 
     Returns (logits [B, vocab] at each sequence's last real new token, pages).
     """
     sm_scale = cfg.head_dim ** -0.5
+    attn_impl = attn_impl or paged_attention
     h = params["embed"][tokens]  # [B, S, H]
 
     def body(carry, xs):
@@ -196,8 +205,8 @@ def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
         lp, lidx = xs
         q, k, v = _project_qkv(cfg, lp, h, positions)
         pages = write_kv(pages, lidx, k, v, page_table, positions, new_lens)
-        attn = paged_attention(q, pages, lidx, page_table, positions,
-                               total_lens, sm_scale)
+        attn = attn_impl(q, pages, lidx, page_table, positions,
+                         total_lens, sm_scale)
         h = _finish_layer(cfg, lp, h, attn)
         return (h, pages), None
 

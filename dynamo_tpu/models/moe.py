@@ -96,9 +96,12 @@ def init_params(cfg: ModelConfig, rng: jax.Array,
 def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
             positions: jnp.ndarray, pages: jnp.ndarray,
             page_table: jnp.ndarray, total_lens: jnp.ndarray,
-            new_lens: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+            new_lens: jnp.ndarray,
+            attn_impl: Optional[Callable] = None
+            ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Scan-over-layers MoE forward (same contract as llama.forward)."""
     sm_scale = cfg.head_dim ** -0.5
+    attn_impl = attn_impl or paged_attention
     h = params["embed"][tokens]
 
     def body(carry, xs):
@@ -106,8 +109,8 @@ def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
         lp, lidx = xs
         q, k, v = _project_qkv(cfg, lp, h, positions)
         pages = write_kv(pages, lidx, k, v, page_table, positions, new_lens)
-        attn = paged_attention(q, pages, lidx, page_table, positions,
-                               total_lens, sm_scale)
+        attn = attn_impl(q, pages, lidx, page_table, positions,
+                         total_lens, sm_scale)
         h = _moe_layer_tail(cfg, lp, h, attn)
         return (h, pages), None
 

@@ -58,9 +58,26 @@ def supports(head_dim: int, page_size: int) -> bool:
     return head_dim % 128 == 0 and page_size % 8 == 0
 
 
-def _decode_kernel(q_ref, kv_hbm, table_ref, lens_ref, out_ref,
+def _resolve_interpret(interpret) -> bool:
+    """``None`` -> interpreter mode off-TPU (so CPU tests exercise the
+    engine's exact TPU code path), native Mosaic on TPU."""
+    if interpret is not None:
+        return interpret
+    return jax.default_backend() not in ("tpu", "axon")
+
+
+def _decode_kernel(q_ref, kv_hbm, layer_ref, table_ref, lens_ref, out_ref,
                    buf, sem, *, page_size: int, n_kv: int, chunk: int):
     """One program per sequence: stream page chunks, online-softmax attend.
+
+    kv_hbm is the STACKED cache ``[L, N, 2, Hkv, ps, Dh]`` and ``layer_ref``
+    an SMEM scalar selecting the layer — the dynamic layer index rides the
+    DMA descriptor, so the same compiled kernel serves every layer. That is
+    what lets the engine run decode under ``lax.scan`` over layers (one
+    compiled layer body, ~L× cheaper cold compile) instead of a python
+    unroll: the kernel receives the WHOLE cache array (no layer slicing at
+    the XLA level — slicing a stacked cache outside an opaque custom call
+    is what forced the defensive whole-cache copies, measured ~10x).
 
     buf: [2, 2, Hkv, chunk*page_size, Dh] double-buffered slabs — pages DMA
     straight into their position range, so the chunk is ALREADY in the
@@ -69,6 +86,7 @@ def _decode_kernel(q_ref, kv_hbm, table_ref, lens_ref, out_ref,
     sem: [2, chunk] DMA semaphores (slot, page-in-chunk).
     """
     b = pl.program_id(0)
+    layer = layer_ref[0]
     ctx = lens_ref[b]
     num_pages = jax.lax.div(ctx + page_size - 1, page_size)
     num_chunks = jax.lax.div(num_pages + chunk - 1, chunk)
@@ -89,7 +107,7 @@ def _decode_kernel(q_ref, kv_hbm, table_ref, lens_ref, out_ref,
         # would still poison the PV matmul.
         jj = jnp.minimum(j, P - 1)
         return pltpu.make_async_copy(
-            kv_hbm.at[table_ref[b, jj]],
+            kv_hbm.at[layer, table_ref[b, jj]],
             buf.at[slot, :, :, pl.ds(i * page_size, page_size)],
             sem.at[slot, i])
 
@@ -150,10 +168,10 @@ def _decode_kernel(q_ref, kv_hbm, table_ref, lens_ref, out_ref,
 
 
 @functools.partial(jax.jit, static_argnames=("sm_scale", "interpret"))
-def _paged_decode(q, kv_pages, page_table, total_lens,
+def _paged_decode(q, kv_pages, layer_idx, page_table, total_lens,
                   sm_scale: float, interpret: bool = False):
     B, Hq, Dh = q.shape
-    _N, _two, Hkv, page_size, _ = kv_pages.shape
+    _L, _N, _two, Hkv, page_size, _ = kv_pages.shape
     P = page_table.shape[1]
     chunk = min(PAGES_PER_CHUNK, P)
 
@@ -164,7 +182,8 @@ def _paged_decode(q, kv_pages, page_table, total_lens,
         grid=(B,),
         in_specs=[
             pl.BlockSpec((1, Hq, Dh), lambda b: (b, 0, 0)),
-            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec(memory_space=pltpu.SMEM),
         ],
@@ -175,13 +194,14 @@ def _paged_decode(q, kv_pages, page_table, total_lens,
         ],
         out_shape=jax.ShapeDtypeStruct((B, Hq, Dh), q.dtype),
         interpret=interpret,
-    )((q * sm_scale).astype(q.dtype), kv_pages, page_table, total_lens)
+    )((q * sm_scale).astype(q.dtype), kv_pages, layer_idx, page_table,
+      total_lens)
 
 
 def paged_decode_attention(q: jnp.ndarray, kv_layer: jnp.ndarray,
                            page_table: jnp.ndarray, positions: jnp.ndarray,
                            total_lens: jnp.ndarray, sm_scale: float,
-                           interpret: bool = False) -> jnp.ndarray:
+                           interpret: bool | None = None) -> jnp.ndarray:
     """Drop-in for ``ops.attention.paged_attention_layer`` when S == 1.
 
     q:          [B, 1, Hq, Dh]
@@ -192,11 +212,41 @@ def paged_decode_attention(q: jnp.ndarray, kv_layer: jnp.ndarray,
     B, S, Hq, Dh = q.shape
     if S != 1:
         raise ValueError(f"decode kernel requires S=1, got S={S}")
-    out = _paged_decode(q[:, 0], kv_layer,
+    out = _paged_decode(q[:, 0], kv_layer[None],
+                        jnp.zeros((1,), jnp.int32),
                         page_table.astype(jnp.int32),
                         total_lens.astype(jnp.int32), sm_scale,
-                        interpret=interpret)
+                        interpret=_resolve_interpret(interpret))
     return out[:, None]                                    # [B, 1, Hq, Dh]
 
 
-__all__ = ["paged_decode_attention", "supports"]
+def paged_decode_attention_stacked(q: jnp.ndarray, pages: jnp.ndarray,
+                                   layer_idx, page_table: jnp.ndarray,
+                                   positions: jnp.ndarray,
+                                   total_lens: jnp.ndarray, sm_scale: float,
+                                   interpret: bool | None = None
+                                   ) -> jnp.ndarray:
+    """Drop-in for ``ops.attention.paged_attention`` when S == 1: the whole
+    stacked cache enters the kernel and the (possibly TRACED) ``layer_idx``
+    selects the layer inside the DMA — usable as the attention op inside a
+    ``lax.scan`` over layers, giving one compiled decode layer body.
+
+    q:          [B, 1, Hq, Dh]
+    pages:      [L, N, 2, Hkv, page_size, Dh] (page-major slabs)
+    layer_idx:  scalar int (python int or traced scan index)
+    page_table: [B, P]
+    total_lens: [B] context length including the query token
+    """
+    B, S, Hq, Dh = q.shape
+    if S != 1:
+        raise ValueError(f"decode kernel requires S=1, got S={S}")
+    layer = jnp.asarray(layer_idx, jnp.int32).reshape(1)
+    out = _paged_decode(q[:, 0], pages, layer,
+                        page_table.astype(jnp.int32),
+                        total_lens.astype(jnp.int32), sm_scale,
+                        interpret=_resolve_interpret(interpret))
+    return out[:, None]                                    # [B, 1, Hq, Dh]
+
+
+__all__ = ["paged_decode_attention", "paged_decode_attention_stacked",
+           "supports"]

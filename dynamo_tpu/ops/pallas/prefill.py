@@ -1,0 +1,215 @@
+"""Chunked-prefill paged attention on TPU — flash-style Pallas kernel.
+
+Covers the serving engine's prefill steps (S = chunk of new tokens per
+sequence) against the paged KV cache, the shape class where the XLA
+blockwise path (``ops/attention._attend_blockwise``) still materializes a
+``[B, Hkv, S, G, span]`` score block per chunk in XLA-managed buffers. Here
+the whole layer runs as one kernel per (sequence, query-block):
+
+- Same page-streaming machinery as the decode kernel
+  (``ops/pallas/decode.py``): pages stay in HBM (``memory_space=ANY``) in
+  the page-major slab layout ``[L, N, 2, Hkv, ps, Dh]``, an SMEM layer
+  index rides the DMA descriptors (so the kernel works under ``lax.scan``
+  over layers), and chunks of ``PAGES_PER_CHUNK`` pages double-buffer into
+  VMEM — the next chunk's burst issued while the current chunk computes.
+- Flash-style online softmax in f32 with a CAUSAL mask on absolute
+  positions: query row ``s`` of the block attends to kv positions
+  ``t <= q_start + j*SB + s`` and ``t < ctx``. Prefix-cache hits fall out:
+  queries attend to whatever the page table already holds.
+- The query block is ``[SB, Hq, Dh]`` with SB = 256 (or S when shorter):
+  large enough to fill the MXU via the grouped ``[Hkv, G*SB, span]``
+  matmuls, small enough that scores + accumulator + kv slabs fit VMEM at
+  Llama-3-class geometry (~11 MB at Hkv=8, G=3, Dh=128).
+- Each (b, j) program streams only the chunks its queries can SEE
+  (``ceil(min(ctx, block_end+1) / span)``) — early query blocks of a long
+  context skip the tail, and queries past ``ctx`` cost nothing.
+
+Alignment: ``head_dim % 128 == 0`` and ``page_size % 8 == 0`` (same
+``supports`` predicate as decode). CPU tests run in interpreter mode.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from dynamo_tpu.ops.pallas.decode import _resolve_interpret, supports  # noqa: F401
+
+NEG_INF = -1e30
+
+PAGES_PER_CHUNK = 8
+
+# query rows per grid program: SB * Hq * Dh bf16 + f32 scores/acc must fit
+# VMEM next to the double-buffered kv slabs
+QUERY_BLOCK = 256
+
+
+def _prefill_kernel(q_ref, kv_hbm, layer_ref, table_ref, qstart_ref,
+                    lens_ref, out_ref, buf, sem, *, page_size: int,
+                    n_kv: int, chunk: int, q_block: int):
+    """One program per (sequence, query-block): stream visible page chunks,
+    causal online-softmax attend.
+
+    q_ref/out_ref: [1, SB, Hq, Dh] block of the padded chunk batch.
+    buf: [2, 2, Hkv, chunk*page_size, Dh] double-buffered kv slabs.
+    sem: [2, chunk] DMA semaphores.
+    """
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+    layer = layer_ref[0]
+    ctx = lens_ref[b]
+    q_start = qstart_ref[b]
+
+    SB = q_block
+    Hq, Dh = q_ref.shape[2], q_ref.shape[3]
+    G = Hq // n_kv
+    span = chunk * page_size
+
+    # kv this block can see: causal bound (its last query's position + 1)
+    # clamped to the live context
+    block_last = q_start + (j + 1) * SB - 1
+    visible = jnp.minimum(ctx, block_last + 1)
+    num_chunks = jnp.maximum(jax.lax.div(visible + span - 1, span), 1)
+
+    P = table_ref.shape[1]
+
+    def page_dma(slot, i, c):
+        jj = jnp.minimum(c * chunk + i, P - 1)
+        return pltpu.make_async_copy(
+            kv_hbm.at[layer, table_ref[b, jj]],
+            buf.at[slot, :, :, pl.ds(i * page_size, page_size)],
+            sem.at[slot, i])
+
+    def start_chunk(slot, c):
+        def start_one(i, _):
+            page_dma(slot, i, c).start()
+            return 0
+
+        jax.lax.fori_loop(0, chunk, start_one, 0, unroll=True)
+
+    def wait_chunk(slot, c):
+        def wait_one(i, _):
+            page_dma(slot, i, c).wait()
+            return 0
+
+        jax.lax.fori_loop(0, chunk, wait_one, 0, unroll=True)
+
+    start_chunk(0, 0)
+
+    # queries in [Hkv, G*SB, Dh] so scores/PV are single-contraction
+    # batched matmuls (Mosaic takes one contracting dim)
+    q = q_ref[0].reshape(SB, n_kv, G, Dh).transpose(1, 2, 0, 3) \
+        .reshape(n_kv, G * SB, Dh)
+    qpos = q_start + j * SB + jax.lax.broadcasted_iota(
+        jnp.int32, (1, G, SB, 1), 2)                       # [1, G, SB, 1]
+
+    def body(c, carry):
+        m, l, acc = carry
+        slot = jax.lax.rem(c, 2)
+
+        @pl.when(c + 1 < num_chunks)
+        def _():
+            start_chunk(jax.lax.rem(c + 1, 2), c + 1)
+
+        wait_chunk(slot, c)
+        k = buf[slot, 0]                                   # [Hkv, span, Dh]
+        v = buf[slot, 1]
+
+        s = jax.lax.dot_general(
+            q, k, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)            # [Hkv, G*SB, span]
+        s4 = s.reshape(n_kv, G, SB, span)
+        t_pos = c * span + jax.lax.broadcasted_iota(
+            jnp.int32, (1, 1, 1, span), 3)
+        mask = (t_pos <= qpos) & (t_pos < ctx)             # [1, G, SB, span]
+        s4 = jnp.where(mask, s4, NEG_INF)
+        s = s4.reshape(n_kv, G * SB, span)
+
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))        # [Hkv, G*SB]
+        p = jnp.exp(s - m_new[..., None])
+        # a block whose first chunks are all-masked keeps m at -inf:
+        # exp(-inf - -inf) = 1 would leak weight — zero those rows
+        p = jnp.where((m_new > NEG_INF / 2)[..., None], p, 0.0)
+        scale = jnp.where(m > NEG_INF / 2, jnp.exp(m - m_new), 0.0)
+        l = l * scale + jnp.sum(p, axis=-1)
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)            # [Hkv, G*SB, Dh]
+        acc = acc * scale[..., None] + pv
+        return m_new, l, acc
+
+    m0 = jnp.full((n_kv, G * SB), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((n_kv, G * SB), jnp.float32)
+    acc0 = jnp.zeros((n_kv, G * SB, Dh), jnp.float32)
+    _m, l, acc = jax.lax.fori_loop(0, num_chunks, body, (m0, l0, acc0))
+    out = acc / jnp.maximum(l, 1e-20)[..., None]           # [Hkv, G*SB, Dh]
+    out = out.reshape(n_kv, G, SB, Dh).transpose(2, 0, 1, 3) \
+        .reshape(SB, Hq, Dh)
+    out_ref[0] = out.astype(out_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("sm_scale", "interpret"))
+def _paged_prefill(q, kv_pages, layer_idx, page_table, q_start, total_lens,
+                   sm_scale: float, interpret: bool = False):
+    B, S, Hq, Dh = q.shape
+    _L, _N, _two, Hkv, page_size, _ = kv_pages.shape
+    P = page_table.shape[1]
+    chunk = min(PAGES_PER_CHUNK, P)
+    SB = min(QUERY_BLOCK, S)
+    assert S % SB == 0, (S, SB)
+
+    kernel = functools.partial(_prefill_kernel, page_size=page_size,
+                               n_kv=Hkv, chunk=chunk, q_block=SB)
+    return pl.pallas_call(
+        kernel,
+        grid=(B, S // SB),
+        in_specs=[
+            pl.BlockSpec((1, SB, Hq, Dh), lambda b, j: (b, j, 0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec((1, SB, Hq, Dh), lambda b, j: (b, j, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((2, 2, Hkv, chunk * page_size, Dh), kv_pages.dtype),
+            pltpu.SemaphoreType.DMA((2, chunk)),
+        ],
+        out_shape=jax.ShapeDtypeStruct((B, S, Hq, Dh), q.dtype),
+        interpret=interpret,
+    )((q * sm_scale).astype(q.dtype), kv_pages, layer_idx, page_table,
+      q_start, total_lens)
+
+
+def paged_prefill_attention_stacked(q: jnp.ndarray, pages: jnp.ndarray,
+                                    layer_idx, page_table: jnp.ndarray,
+                                    positions: jnp.ndarray,
+                                    total_lens: jnp.ndarray, sm_scale: float,
+                                    interpret: bool | None = None
+                                    ) -> jnp.ndarray:
+    """Drop-in for ``ops.attention.paged_attention`` on prefill steps
+    (S > 1, positions contiguous per row — the engine's chunk batches).
+
+    q:          [B, S, Hq, Dh] (S = padded chunk length)
+    pages:      [L, N, 2, Hkv, page_size, Dh]
+    layer_idx:  scalar int (python int or traced scan index)
+    page_table: [B, P]
+    positions:  [B, S] absolute positions (row-contiguous; only column 0
+                enters the kernel — pad rows/slots mask out downstream)
+    total_lens: [B] context length including the new tokens
+    """
+    layer = jnp.asarray(layer_idx, jnp.int32).reshape(1)
+    out = _paged_prefill(q, pages, layer,
+                         page_table.astype(jnp.int32),
+                         positions[:, 0].astype(jnp.int32),
+                         total_lens.astype(jnp.int32), sm_scale,
+                         interpret=_resolve_interpret(interpret))
+    return out
+
+
+__all__ = ["paged_prefill_attention_stacked", "supports"]

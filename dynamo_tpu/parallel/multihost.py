@@ -33,9 +33,11 @@ the broadcast stream: the engine's ``dispatch_gather_pages`` /
 messages before dispatch, so every rank joins those jits on the globally
 sharded cache (gathers produce replicated outputs the leader reads
 locally) — disagg P/D and KVBM therefore compose with multi-host workers.
-Scope (honest): batch-dim (dp) sharding across hosts would need sampled
-tokens gathered to rank 0; the multi-host mesh shards tp/sp only, where
-step outputs are replicated and every rank can read them locally.
+Batch-dim (dp) sharding across hosts works too: when the mesh carries a
+``dp`` axis the engine constrains its batch inputs to ``P("dp")`` and
+re-replicates the packed step output (a tiny [B, 2+2K] all-gather) inside
+the step program (``jax_engine._shard_batch`` / ``_sample_tail``), so rank
+0 reads every sampled row locally.
 """
 
 from __future__ import annotations

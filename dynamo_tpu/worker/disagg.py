@@ -28,7 +28,11 @@ from dynamo_tpu.engine.transfer import (
     inject_blocks,
     inject_frame,
 )
-from dynamo_tpu.protocols.common import LLMEngineOutput, PreprocessedRequest
+from dynamo_tpu.protocols.common import (
+    FinishReason,
+    LLMEngineOutput,
+    PreprocessedRequest,
+)
 from dynamo_tpu.runtime.push_router import PushRouter, RouterMode
 from dynamo_tpu.runtime.runtime import DistributedRuntime
 from dynamo_tpu.utils.aio import reap_task
@@ -155,7 +159,8 @@ class DisaggDecodeHandler:
     def __init__(self, engine: JaxEngine, drt: DistributedRuntime,
                  namespace: str, prefill_component: str,
                  conf: Optional[DisaggConfig] = None,
-                 use_queue: bool = True, queue_timeout: float = 30.0):
+                 use_queue: bool = True, queue_timeout: float = 30.0,
+                 strategy: str = "decode_first"):
         self.engine = engine
         self.drt = drt
         self.namespace = namespace
@@ -165,6 +170,10 @@ class DisaggDecodeHandler:
         # FREE worker; disable to force the direct round-robin leg only
         self.use_queue = use_queue
         self.queue_timeout = queue_timeout
+        # "prefill_first": this decode worker only ACCEPTS forwarded
+        # requests (kv_transfer_params inbound) and never initiates the
+        # remote-prefill leg itself
+        self.strategy = strategy
         self._gen_client = None
         self._kv_client = None
         self._router: Optional[PushRouter] = None
@@ -211,6 +220,8 @@ class DisaggDecodeHandler:
     # -- the disagg leg ----------------------------------------------------
 
     def _use_remote_prefill(self, request: PreprocessedRequest) -> bool:
+        if self.strategy == "prefill_first":
+            return False
         if not self._gen_client.instance_ids():
             return False
         n = len(request.token_ids)
@@ -320,18 +331,37 @@ class DisaggDecodeHandler:
         injected = total = 0
         bulk_done = False
         if bulk_address:
-            from dynamo_tpu.runtime.bulk import bulk_fetch
+            from dynamo_tpu.runtime.bulk import bulk_fetch, release_buffer
             # stream-and-inject: frames hop from the fetch thread into an
             # asyncio queue; frame k injects while k+1 is still on the
             # wire — same pipelining the RPC branch gets from its async
-            # iterator, without buffering the whole prefix in RAM
+            # iterator. A small in-flight window gives BACKPRESSURE (a slow
+            # injector must not buffer the whole prefix in RAM) and lets
+            # each injected frame's receive buffer go back to the bulk
+            # freelist, so steady-state fetches land in warm pages.
             import threading
             loop = asyncio.get_running_loop()
             frame_q: asyncio.Queue = asyncio.Queue()
             abort = threading.Event()
+            window = threading.Semaphore(4)  # frames in flight
 
             def on_frame(meta, raw):
+                while not window.acquire(timeout=0.5):
+                    if abort.is_set():
+                        raise ConnectionError("bulk fetch aborted")
                 loop.call_soon_threadsafe(frame_q.put_nowait, (meta, raw))
+
+            async def inject_one(meta, raw):
+                nonlocal injected, total
+                meta = dict(meta)
+                meta["_raw"] = raw
+                total += len(meta["blocks"])
+                try:
+                    injected += await self.engine.run_exclusive(
+                        inject_frame, self.engine, meta)
+                finally:
+                    release_buffer(raw)
+                    window.release()
 
             fetch = asyncio.create_task(asyncio.to_thread(
                 bulk_fetch, bulk_address, KV_EXPORT_ENDPOINT,
@@ -344,29 +374,23 @@ class DisaggDecodeHandler:
                         {get, fetch}, return_when=asyncio.FIRST_COMPLETED)
                     if get in done:
                         meta, raw = get.result()
-                        meta = dict(meta)
-                        meta["_raw"] = raw
-                        total += len(meta["blocks"])
-                        injected += await self.engine.run_exclusive(
-                            inject_frame, self.engine, meta)
+                        await inject_one(meta, raw)
                         continue
                     get.cancel()
                     await fetch  # raises on transport/handler error
                     while not frame_q.empty():  # drain the tail
                         meta, raw = frame_q.get_nowait()
-                        meta = dict(meta)
-                        meta["_raw"] = raw
-                        total += len(meta["blocks"])
-                        injected += await self.engine.run_exclusive(
-                            inject_frame, self.engine, meta)
+                        await inject_one(meta, raw)
                     bulk_done = True
                     break
             except Exception as e:  # noqa: BLE001 — bulk plane unreachable
                 # (e.g. worker bound to 127.0.0.1 across hosts): the RPC
                 # export path below still works — never waste the completed
-                # remote prefill over a transport problem. Tell the fetch
-                # thread to stop and reap its task so it neither streams
-                # frames into the void nor logs an unretrieved exception.
+                # remote prefill over a transport problem. abort BEFORE
+                # awaiting: a to_thread task only completes when its thread
+                # exits, and the thread exits via the abort check. Then reap
+                # the task so it neither streams frames into the void nor
+                # logs an unretrieved exception.
                 abort.set()
                 if not fetch.done():
                     fetch.cancel()
@@ -377,6 +401,13 @@ class DisaggDecodeHandler:
                 logger.warning("bulk KV fetch from %s failed (%s); falling "
                                "back to the RPC export path",
                                bulk_address, e)
+            finally:
+                # ALWAYS tell the fetch thread to stop — including on task
+                # CancellationError (client disconnect), which `except
+                # Exception` does not catch: a cancelled to_thread keeps
+                # its worker thread alive, and without abort the on_frame
+                # backpressure loop would spin on window.acquire forever
+                abort.set()
         if not bulk_done:
             kv_stream = await self._kv_client.direct(
                 {"block_hashes": hashes, "wire": 2}, iid)
@@ -398,10 +429,41 @@ class DisaggDecodeHandler:
             logger.debug("injected %d/%d transferred blocks",
                          injected, total)
 
+    async def _inbound_prefill(self, request: PreprocessedRequest
+                               ) -> Optional[LLMEngineOutput]:
+        """PREFILL-FIRST inbound leg: the request arrives WITH
+        ``kv_transfer_params`` already attached (a prefill worker computed
+        the prefix and forwarded the request here — reference:
+        ``DisaggregationStrategy.PREFILL_FIRST``,
+        ``trtllm/utils/request_handlers/handler_base.py:34-60``). Pull the
+        advertised blocks and synthesize the first-token frame; any failure
+        returns None and the prompt prefills locally (the blocks are an
+        optimization, the token ids are the truth)."""
+        params = request.kv_transfer_params or {}
+        blocks = params.get("blocks") or []
+        if not blocks or "first_token" not in params:
+            return None
+        request.kv_transfer_params = None  # consumed; never forward again
+        try:
+            hashes = [b[0] for b in blocks]
+            await self._pull_blocks(hashes, int(params.get("instance_id", 0)),
+                                    bulk_address=params.get("bulk_address",
+                                                            ""))
+        except Exception as e:  # noqa: BLE001 — prefix pull is best-effort
+            logger.warning("inbound prefill block pull failed (%s); "
+                           "decoding with local prefill", e)
+        return LLMEngineOutput(
+            token_ids=[int(params["first_token"])],
+            log_probs=([float(params["logprob"])]
+                       if params.get("logprob") is not None else None),
+            finish_reason=FinishReason.LENGTH)
+
     async def generate(self, request: PreprocessedRequest,
                        ctx=None) -> AsyncIterator[LLMEngineOutput]:
         first: Optional[LLMEngineOutput] = None
-        if self._use_remote_prefill(request):
+        if request.kv_transfer_params:
+            first = await self._inbound_prefill(request)
+        elif self._use_remote_prefill(request):
             first = await self._remote_prefill(request)
         if first is not None and first.token_ids:
             tok = first.token_ids[0]
@@ -434,5 +496,106 @@ class DisaggDecodeHandler:
             yield out
 
 
-__all__ = ["DisaggDecodeHandler", "DisaggConfig", "disagg_conf_key",
-           "KV_EXPORT_ENDPOINT"]
+class PrefillFirstHandler:
+    """PREFILL-FIRST entry: this (prefill) worker receives the request,
+    prefills locally, attaches ``kv_transfer_params`` (block hashes + where
+    to fetch them + the first token), and forwards the request to a decode
+    worker, relaying its stream. The mirror of ``DisaggDecodeHandler``'s
+    decode-first flow, selectable per deployment (reference:
+    ``handler_base.py:34-60`` ``DisaggregationStrategy``)."""
+
+    def __init__(self, engine: JaxEngine, drt: DistributedRuntime,
+                 namespace: str, decode_component: str,
+                 instance_id: int = 0, bulk_address: str = ""):
+        self.engine = engine
+        self.drt = drt
+        self.namespace = namespace
+        self.decode_component = decode_component
+        self.instance_id = instance_id
+        self.bulk_address = bulk_address
+        self._decode_client = None
+        self._router: Optional[PushRouter] = None
+
+    async def start(self) -> "PrefillFirstHandler":
+        comp = self.drt.namespace(self.namespace).component(
+            self.decode_component)
+        self._decode_client = await comp.endpoint("generate").client()
+        self._router = PushRouter(self._decode_client, RouterMode.ROUND_ROBIN)
+        return self
+
+    async def stop(self) -> None:
+        if self._decode_client is not None:
+            await self._decode_client.close()
+
+    async def generate(self, request: PreprocessedRequest,
+                       ctx=None) -> AsyncIterator[LLMEngineOutput]:
+        if not self._decode_client.instance_ids():
+            # no decode workers live: serve the whole request here rather
+            # than fail (disagg is an optimization, never a point of
+            # failure)
+            async for out in self.engine.generate(request, ctx):
+                yield out
+            return
+        preq = PreprocessedRequest.from_dict(request.to_dict())
+        preq.request_id = f"{request.request_id}-pf"
+        preq.prefill_only = True
+        final: Optional[LLMEngineOutput] = None
+        async for out in self.engine.generate(preq):
+            if out.finish_reason is not None:
+                final = out
+        if final is None or final.error or not final.token_ids:
+            logger.warning("local prefill leg failed; serving fully local")
+            async for out in self.engine.generate(request, ctx):
+                yield out
+            return
+        fwd = PreprocessedRequest.from_dict(request.to_dict())
+        params = dict(final.kv_transfer_params or {})
+        params["first_token"] = final.token_ids[0]
+        if final.log_probs:
+            params["logprob"] = final.log_probs[0]
+        params["instance_id"] = self.instance_id
+        params["bulk_address"] = self.bulk_address
+        fwd.kv_transfer_params = params
+        relayed = False
+        try:
+            iid = self._router.select_instance()
+            stream = await self._decode_client.direct(fwd.to_dict(), iid)
+            async for payload in stream:
+                out = LLMEngineOutput.from_dict(payload)
+                relayed = relayed or bool(out.token_ids)
+                yield out
+            return
+        except Exception as e:  # noqa: BLE001 — decode hop failed: the
+            # prefix is still cached here, finish the request locally —
+            # but ONLY if nothing was relayed yet. After a partial relay a
+            # local restart would repeat tokens the client already has;
+            # surface the break instead (the frontend's migration layer
+            # handles mid-stream worker loss).
+            if relayed:
+                logger.warning("decode stream broke mid-relay (%s)", e)
+                yield LLMEngineOutput(finish_reason=FinishReason.ERROR,
+                                      error=f"decode worker lost: {e}")
+                return
+            logger.warning("decode forward failed (%s); continuing local", e)
+            cont = PreprocessedRequest.from_dict(request.to_dict())
+            tok = final.token_ids[0]
+            yield LLMEngineOutput(token_ids=[tok], log_probs=final.log_probs)
+            sc = cont.stop_conditions
+            if sc.max_tokens is not None and sc.max_tokens <= 1:
+                yield LLMEngineOutput(finish_reason=FinishReason.LENGTH,
+                                      prompt_tokens=len(request.token_ids),
+                                      completion_tokens=1)
+                return
+            cont.token_ids = list(cont.token_ids) + [tok]
+            if cont.stop_conditions.max_tokens is not None:
+                cont.stop_conditions.max_tokens -= 1
+            async for out in self.engine.generate(cont, ctx):
+                if (out.finish_reason is not None
+                        and out.completion_tokens is not None):
+                    out.prompt_tokens = (out.prompt_tokens or 1) - 1
+                    out.completion_tokens = out.completion_tokens + 1
+                yield out
+
+
+__all__ = ["DisaggDecodeHandler", "PrefillFirstHandler", "DisaggConfig",
+           "disagg_conf_key", "KV_EXPORT_ENDPOINT"]

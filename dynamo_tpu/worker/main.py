@@ -56,6 +56,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-context", type=int, default=8192)
     p.add_argument("--tensor-parallel-size", type=int, default=1,
                    help="shard the model over this many local devices")
+    p.add_argument("--data-parallel-size", type=int, default=1,
+                   help="shard the BATCH over this many mesh devices "
+                        "(one engine, dp x tp mesh — composes with "
+                        "multi-host; distinct from running dp separate "
+                        "engines behind the router)")
     p.add_argument("--pipeline-parallel-size", type=int, default=1,
                    help="stage the layers over this many devices "
                         "(microbatch pipeline; scan attention path)")
@@ -90,6 +95,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "component and decodes")
     p.add_argument("--prefill-component", default="prefill",
                    help="component name of the prefill workers (decode role)")
+    p.add_argument("--disagg-strategy", choices=["decode_first",
+                                                 "prefill_first"],
+                   default="decode_first",
+                   help="decode_first: decode workers receive requests and "
+                        "delegate prefill (default). prefill_first: prefill "
+                        "workers receive requests, prefill locally, and "
+                        "forward to decode workers with the KV handoff "
+                        "attached (reference: trtllm handler_base.py:34-60)")
+    p.add_argument("--decode-component", default="tpu",
+                   help="component name of the decode workers "
+                        "(prefill role, prefill_first strategy)")
     p.add_argument("--data-parallel-rank", type=int, default=None,
                    help="engine-dp rank advertised in load metrics (the "
                         "router's per-rank dp accounting)")
@@ -140,12 +156,13 @@ def build_engine(args: argparse.Namespace) -> JaxEngine:
         engine_cfg.shard_pages_fn = shard_pages
         forward_fn = functools.partial(pipeline_forward, mesh=mesh)
     tp, sp = args.tensor_parallel_size, args.sequence_parallel_size
-    if tp > 1 or sp > 1:
+    dp = args.data_parallel_size
+    if tp > 1 or sp > 1 or dp > 1:
         from dynamo_tpu.parallel.mesh import MeshSpec, make_mesh
         from dynamo_tpu.parallel.sharding import ModelSharding
         # multi-host: the mesh spans every process's devices (global set)
-        mesh = make_mesh(MeshSpec(tp=tp, sp=sp),
-                         devices=jax.devices()[:tp * sp])
+        mesh = make_mesh(MeshSpec(dp=dp, tp=tp, sp=sp),
+                         devices=jax.devices()[:dp * tp * sp])
         shard = ModelSharding(cfg, mesh)
         engine_cfg.shard_params_fn = shard.shard_params
         engine_cfg.shard_pages_fn = shard.shard_pages
@@ -254,11 +271,26 @@ async def amain(args: argparse.Namespace) -> None:
             lease.lease_id)
 
     handler = None
+    prefill_first = args.disagg_strategy == "prefill_first"
     if args.disagg == "decode":
         from dynamo_tpu.worker.disagg import DisaggDecodeHandler
         handler = await DisaggDecodeHandler(
-            engine, drt, args.namespace, args.prefill_component).start()
+            engine, drt, args.namespace, args.prefill_component,
+            # prefill-first decode workers never INITIATE remote prefill —
+            # they receive requests with the KV handoff already attached
+            use_queue=not prefill_first,
+            strategy=args.disagg_strategy).start()
         from dynamo_tpu.llm.register import engine_handler
+        await engine.start()
+        await endpoint.serve(engine_handler(handler),
+                             stats_provider=worker_stats)
+    elif args.disagg == "prefill" and prefill_first:
+        from dynamo_tpu.llm.register import engine_handler
+        from dynamo_tpu.worker.disagg import PrefillFirstHandler
+        pf_lease = await drt.primary_lease()
+        handler = await PrefillFirstHandler(
+            engine, drt, args.namespace, args.decode_component,
+            instance_id=pf_lease.lease_id).start()
         await engine.start()
         await endpoint.serve(engine_handler(handler),
                              stats_provider=worker_stats)
@@ -299,14 +331,24 @@ async def amain(args: argparse.Namespace) -> None:
                 engine, asyncio.get_running_loop())
         bulk_server.register(KV_EXPORT_ENDPOINT, bulk_handler)
         await kv_ep.serve(kv_handler, bulk_address=bulk_server.address)
-        await register_llm(drt, endpoint, card, model_type="prefill")
-        # pull-based prefill queue consumer (reference PrefillQueue role):
-        # decode workers enqueue; the first free prefill worker takes a job
-        from dynamo_tpu.worker.disagg import PrefillQueueWorker
-        queue_worker = await PrefillQueueWorker(
-            tiered if tiered is not None else engine, drt, args.namespace,
-            instance_id=lease.lease_id,
-            bulk_address=bulk_server.address).start()
+        if prefill_first:
+            # prefill-first: THIS worker is the chat entrypoint; decode
+            # workers are internal. The handler forwards with our bulk
+            # address so decode pulls ride the fast plane.
+            handler.bulk_address = bulk_server.address
+            await register_llm(drt, endpoint, card)
+        else:
+            await register_llm(drt, endpoint, card, model_type="prefill")
+            # pull-based prefill queue consumer (reference PrefillQueue
+            # role): decode workers enqueue; the first free prefill worker
+            # takes a job
+            from dynamo_tpu.worker.disagg import PrefillQueueWorker
+            queue_worker = await PrefillQueueWorker(
+                tiered if tiered is not None else engine, drt, args.namespace,
+                instance_id=lease.lease_id,
+                bulk_address=bulk_server.address).start()
+    elif args.disagg == "decode" and prefill_first:
+        await register_llm(drt, endpoint, card, model_type="decode")
     else:
         await register_llm(drt, endpoint, card)
     from dynamo_tpu.runtime.system_server import SystemServer

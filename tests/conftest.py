@@ -36,15 +36,25 @@ import pytest
 ASYNC_TEST_TIMEOUT = float(os.environ.get("DYN_TEST_TIMEOUT", "60"))
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "async_timeout(seconds): per-test override of the async timeout")
+
+
 @pytest.hookimpl(tryfirst=True)
 def pytest_pyfunc_call(pyfuncitem):
     fn = pyfuncitem.obj
     if inspect.iscoroutinefunction(fn):
         kwargs = {name: pyfuncitem.funcargs[name]
                   for name in pyfuncitem._fixtureinfo.argnames}
+        timeout = ASYNC_TEST_TIMEOUT
+        marker = pyfuncitem.get_closest_marker("async_timeout")
+        if marker is not None and marker.args:
+            timeout = max(timeout, float(marker.args[0]))
 
         async def _run():
-            await asyncio.wait_for(fn(**kwargs), timeout=ASYNC_TEST_TIMEOUT)
+            await asyncio.wait_for(fn(**kwargs), timeout=timeout)
 
         asyncio.run(_run())
         return True

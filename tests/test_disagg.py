@@ -271,6 +271,96 @@ class TestDisaggE2E:
             await coord.stop()
 
 
+class TestPrefillFirst:
+    """PREFILL-FIRST strategy (reference: trtllm handler_base.py:34-60):
+    the prefill worker is the entrypoint — it prefills locally, attaches
+    kv_transfer_params (blocks + first token + source), forwards to a
+    decode worker, and relays the stream."""
+
+    async def test_prefill_first_matches_aggregated(self):
+        from dynamo_tpu.runtime.coordinator import Coordinator
+        from dynamo_tpu.worker.disagg import PrefillFirstHandler
+        prompt = list(range(1, 14))
+
+        solo = JaxEngine.random_init(ModelConfig.tiny(), engine_cfg())
+        try:
+            want = [t for f in await collect(
+                solo.generate(make_req(prompt, "solo"))) for t in f.token_ids]
+        finally:
+            await solo.stop()
+
+        coord = await Coordinator(port=0).start()
+        drts, handlers = [], []
+        try:
+            # decode worker: accepts forwarded requests only (never
+            # initiates remote prefill)
+            dec_drt = await DistributedRuntime.create(
+                coordinator=coord.address)
+            drts.append(dec_drt)
+            dec_engine = JaxEngine.random_init(ModelConfig.tiny(),
+                                               engine_cfg())
+            dec_handler = await DisaggDecodeHandler(
+                dec_engine, dec_drt, "ns", "prefill", use_queue=False,
+                strategy="prefill_first").start()
+            handlers.append(dec_handler)
+            dec_comp = dec_drt.namespace("ns").component("tpu")
+            await dec_comp.endpoint("generate").serve(
+                engine_handler(dec_handler))
+
+            # prefill worker: the entrypoint; serves kv_export for the
+            # decode side's block pull
+            pre_drt = await DistributedRuntime.create(
+                coordinator=coord.address)
+            drts.append(pre_drt)
+            pre_engine = JaxEngine.random_init(ModelConfig.tiny(),
+                                               engine_cfg())
+            pre_comp = pre_drt.namespace("ns").component("prefill")
+            await pre_comp.endpoint(KV_EXPORT_ENDPOINT).serve(
+                serve_kv_export(pre_engine))
+            pre_lease = await pre_drt.primary_lease()
+            pf_handler = await PrefillFirstHandler(
+                pre_engine, pre_drt, "ns", "tpu",
+                instance_id=pre_lease.lease_id).start()
+            handlers.append(pf_handler)
+            await pf_handler._decode_client.wait_for_instances(1, timeout=10)
+            await dec_handler._kv_client.wait_for_instances(1, timeout=10)
+
+            frames = await collect(pf_handler.generate(make_req(prompt,
+                                                                "r1")))
+            got = [t for f in frames for t in f.token_ids]
+            assert got == want
+            assert frames[-1].completion_tokens == 6
+            # the decode engine really decoded from the injected prefix
+            assert dec_engine.allocator.hits >= 3
+            # and the prefill engine computed it
+            assert pre_engine.allocator.misses >= 3
+        finally:
+            for h in handlers:
+                await h.stop()
+            for d in drts:
+                await d.close()
+            await coord.stop()
+
+    async def test_prefill_first_no_decode_workers_serves_local(self):
+        from dynamo_tpu.runtime.coordinator import Coordinator
+        from dynamo_tpu.worker.disagg import PrefillFirstHandler
+        coord = await Coordinator(port=0).start()
+        try:
+            drt = await DistributedRuntime.create(coordinator=coord.address)
+            engine = JaxEngine.random_init(ModelConfig.tiny(), engine_cfg())
+            handler = await PrefillFirstHandler(engine, drt, "ns",
+                                                "tpu").start()
+            frames = await collect(handler.generate(make_req(range(1, 10),
+                                                             "x")))
+            assert frames[-1].finish_reason == FinishReason.LENGTH
+            assert sum(len(f.token_ids) for f in frames) == 6
+            await handler.stop()
+            await engine.stop()
+            await drt.close()
+        finally:
+            await coord.stop()
+
+
 class TestBatchedFrameTransfer:
     """The zero-copy two-part wire path (export_frames/inject_frame) must be
     byte-identical to the per-block path, through a REAL RpcServer loopback

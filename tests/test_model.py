@@ -235,6 +235,166 @@ class TestPallasDecode:
         self._run(interpret=False)
 
 
+class TestPallasDecodeStacked:
+    """The layer-indexed stacked-cache kernel variant: same math as the
+    per-layer kernel, but the whole [L, N, ...] cache enters the kernel and
+    an SMEM scalar picks the layer — including with a TRACED index inside a
+    ``lax.scan`` (the engine's scan+pallas decode path)."""
+
+    def _mk(self, seed=0):
+        L, N, Hkv, ps, Dh = 3, 16, 2, 8, 128
+        pages = jnp.asarray(
+            jax.random.normal(jax.random.PRNGKey(seed), (L, N, 2, Hkv, ps, Dh)),
+            dtype=jnp.bfloat16)
+        B, P = 4, 6
+        table = (jnp.arange(1, 1 + B * P, dtype=jnp.int32).reshape(B, P)
+                 % 15 + 1)
+        q = jnp.asarray(
+            jax.random.normal(jax.random.PRNGKey(seed + 1), (B, 1, 4, Dh)),
+            dtype=jnp.bfloat16)
+        total = jnp.array([9, 17, 1, 48], jnp.int32)
+        return pages, q, table, total
+
+    def test_static_layer_matches_xla(self):
+        from dynamo_tpu.ops.attention import paged_attention_layer
+        from dynamo_tpu.ops.pallas import paged_decode_attention_stacked
+        pages, q, table, total = self._mk()
+        positions = (total - 1)[:, None]
+        for layer in range(pages.shape[0]):
+            ref = paged_attention_layer(q, pages[layer], table, positions,
+                                        total, 0.088)
+            out = paged_decode_attention_stacked(
+                q, pages, layer, table, positions, total, 0.088,
+                interpret=True)
+            np.testing.assert_allclose(np.asarray(ref, np.float32),
+                                       np.asarray(out, np.float32),
+                                       rtol=2e-2, atol=2e-2)
+
+    def test_traced_layer_inside_scan(self):
+        from dynamo_tpu.ops.attention import paged_attention_layer
+        from dynamo_tpu.ops.pallas import paged_decode_attention_stacked
+        pages, q, table, total = self._mk(seed=4)
+        positions = (total - 1)[:, None]
+        L = pages.shape[0]
+
+        def body(carry, lidx):
+            out = paged_decode_attention_stacked(
+                q, pages, lidx, table, positions, total, 0.088,
+                interpret=True)
+            return carry, out
+
+        _, outs = jax.lax.scan(body, 0, jnp.arange(L))
+        for layer in range(L):
+            ref = paged_attention_layer(q, pages[layer], table, positions,
+                                        total, 0.088)
+            np.testing.assert_allclose(np.asarray(ref, np.float32),
+                                       np.asarray(outs[layer], np.float32),
+                                       rtol=2e-2, atol=2e-2)
+
+    async def test_engine_pallas_scan_matches_scan_tokens(self):
+        """attn_impl='pallas' (scan forward + stacked kernel, interpret on
+        CPU) must generate the same greedy tokens as the plain scan path —
+        this is the engine's real TPU decode program."""
+        from dynamo_tpu.engine.jax_engine import JaxEngine, JaxEngineConfig
+        from dynamo_tpu.protocols.common import (
+            PreprocessedRequest, SamplingOptions, StopConditions)
+
+        cfg = ModelConfig.tiny(num_heads=2, num_kv_heads=1, head_dim=128)
+
+        def req(rid):
+            return PreprocessedRequest(
+                token_ids=list(range(1, 11)), request_id=rid,
+                stop_conditions=StopConditions(max_tokens=6),
+                sampling_options=SamplingOptions(temperature=0.0))
+
+        outs = {}
+        for impl in ("scan", "pallas"):
+            eng = JaxEngine.random_init(cfg, JaxEngineConfig(
+                num_pages=32, page_size=8, max_num_seqs=2,
+                max_prefill_chunk=16, max_context=64, min_prefill_bucket=4,
+                attn_impl=impl))
+            assert eng.attn_impl == impl
+            try:
+                toks = []
+                async for f in eng.generate(req(impl)):
+                    toks.extend(f.token_ids)
+                outs[impl] = toks
+            finally:
+                await eng.stop()
+        assert outs["scan"] == outs["pallas"]
+        assert len(outs["scan"]) == 6
+
+
+class TestPallasPrefill:
+    """Chunked-prefill flash kernel vs the XLA paged-attention path.
+
+    Comparison is restricted to REAL query slots: the kernel masks pad
+    slots by the row's contiguous positions (q_start + s) while the XLA
+    path uses the (zeroed) positions array — pad-slot outputs differ by
+    design and never reach logits (pads' K/V go to the garbage page, so no
+    real query attends to them)."""
+
+    def _mk(self, seed=0):
+        L, N, Hkv, ps, Dh = 2, 33, 2, 8, 128
+        Hq, B, S, P = 4, 3, 16, 8
+        k1, k2 = jax.random.split(jax.random.PRNGKey(seed))
+        pages = jax.random.normal(k1, (L, N, 2, Hkv, ps, Dh)) \
+            .astype(jnp.bfloat16)
+        q = jax.random.normal(k2, (B, S, Hq, Dh)).astype(jnp.bfloat16)
+        table = jnp.arange(1, 1 + B * P, dtype=jnp.int32).reshape(B, P)
+        return pages, q, table
+
+    def test_matches_xla_path(self):
+        from dynamo_tpu.ops.attention import paged_attention
+        from dynamo_tpu.ops.pallas.prefill import (
+            paged_prefill_attention_stacked)
+        pages, q, table = self._mk()
+        B, S = q.shape[:2]
+        # mixed rows: fresh prompt, prefix-cache continuation, short row
+        # with pad slots
+        start = jnp.array([0, 24, 3], jnp.int32)
+        new = jnp.array([S, S, 9], jnp.int32)
+        positions = start[:, None] + jnp.arange(S)[None, :]
+        positions = jnp.where(jnp.arange(S)[None, :] < new[:, None],
+                              positions, 0)
+        total = start + new
+        for layer in range(pages.shape[0]):
+            ref = paged_attention(q, pages, layer, table, positions, total,
+                                  0.088)
+            out = paged_prefill_attention_stacked(
+                q, pages, layer, table, positions, total, 0.088,
+                interpret=True)
+            for b in range(B):
+                nb = int(new[b])
+                np.testing.assert_allclose(
+                    np.asarray(ref[b, :nb], np.float32),
+                    np.asarray(out[b, :nb], np.float32),
+                    rtol=3e-2, atol=3e-2)
+
+    def test_inside_scan_traced_layer(self):
+        from dynamo_tpu.ops.attention import paged_attention
+        from dynamo_tpu.ops.pallas.prefill import (
+            paged_prefill_attention_stacked)
+        pages, q, table = self._mk(seed=5)
+        B, S = q.shape[:2]
+        positions = jnp.tile(jnp.arange(S, dtype=jnp.int32)[None], (B, 1))
+        total = jnp.full((B,), S, jnp.int32)
+
+        def body(carry, lidx):
+            out = paged_prefill_attention_stacked(
+                q, pages, lidx, table, positions, total, 0.1,
+                interpret=True)
+            return carry, out
+
+        _, outs = jax.lax.scan(body, 0, jnp.arange(pages.shape[0]))
+        for layer in range(pages.shape[0]):
+            ref = paged_attention(q, pages, layer, table, positions, total,
+                                  0.1)
+            np.testing.assert_allclose(np.asarray(ref, np.float32),
+                                       np.asarray(outs[layer], np.float32),
+                                       rtol=3e-2, atol=3e-2)
+
+
 class TestBlockwisePrefillAttention:
     """The chunked online-softmax prefill path must match the direct
     full-gather path bit-for-bit up to f32 reduction order."""

@@ -105,3 +105,53 @@ class TestTpSharding:
         assert wq.sharding.shard_shape(wq.shape)[2] == cfg.q_size // 2
         emb = placed["embed"]
         assert emb.sharding.shard_shape(emb.shape) == emb.shape  # replicated
+
+
+class TestDpSharding:
+    """Batch-dim data parallelism on one engine: the mesh carries a dp axis,
+    the step's batch inputs are dp-sharded and the packed output is
+    re-replicated (the all-gather that unlocks cross-host dp,
+    VERDICT r3 §5)."""
+
+    @pytest.mark.async_timeout(150)
+    async def test_dp_tp_matches_unsharded_generation(self):
+        # two engine compiles (unsharded + dp x tp GSPMD) in one test:
+        # runs ~30s warm but has flaked at the default 60s under load
+        cfg = ModelConfig.tiny()  # Hkv=2 -> tp=2
+        prompts = [list(range(1, 10)), list(range(20, 32)),
+                   list(range(40, 47)), list(range(60, 70))]
+
+        async def run_all(engine):
+            import asyncio
+            return await asyncio.gather(*[
+                run_tokens(engine, p, f"r{i}")
+                for i, p in enumerate(prompts)])
+
+        base = JaxEngine.random_init(cfg, JaxEngineConfig(
+            num_pages=64, page_size=4, max_num_seqs=4,
+            max_prefill_chunk=16, max_context=64, min_prefill_bucket=4))
+        try:
+            want = await run_all(base)
+        finally:
+            await base.stop()
+
+        mesh = make_mesh(MeshSpec(dp=2, tp=2),
+                         devices=jax.devices()[:4])
+        shard = ModelSharding(cfg, mesh)
+        ecfg = JaxEngineConfig(
+            num_pages=64, page_size=4, max_num_seqs=4,
+            max_prefill_chunk=16, max_context=64, min_prefill_bucket=4,
+            shard_params_fn=shard.shard_params,
+            shard_pages_fn=shard.shard_pages, mesh=mesh)
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        sharded = JaxEngine(cfg, params, ecfg)
+        assert sharded._dp == 2
+        # bucket floors raised so every padded batch divides by dp
+        assert sharded.cfg.min_decode_bucket >= 2
+        try:
+            got = await run_all(sharded)
+        finally:
+            await sharded.stop()
+
+        assert got == want
+        assert all(len(g) == 6 for g in got)
