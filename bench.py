@@ -508,7 +508,7 @@ def main() -> None:
     tpu_env.pop("JAX_PLATFORMS", None)  # let the TPU plugin register
     errors: list[str] = []
     probes = 0
-    primed = False
+    primed: set[str] = set()  # per tier: full-tier programs don't warm reduced
     measure_attempts = 0
     while time.monotonic() + cpu_reserve < deadline:
         probe_budget = min(PROBE_WINDOW,
@@ -554,13 +554,14 @@ def main() -> None:
         common = ["--tier", tier, "--attn-impl", args.attn_impl]
         # prime the compile cache in its own child: even if it dies partway,
         # every program it finished is persisted for the measurement child
-        if not primed and remaining >= 150.0:
+        if tier not in primed and remaining >= 150.0:
             prime_budget = remaining - 90.0
             r = _run_attempt(["--_prime"] + common, tpu_env,
                              min(prime_budget, 300.0))
-            primed = r is not None and r.get("primed", False)
-            if not primed:
-                errors.append("prime child failed/timed out")
+            if r is not None and r.get("primed", False):
+                primed.add(tier)
+            else:
+                errors.append(f"prime child ({tier}) failed/timed out")
             remaining = deadline - time.monotonic() - cpu_reserve
             if remaining < 45.0:
                 errors.append("primed but budget exhausted")

@@ -121,7 +121,15 @@ class JaxEngine(ScheduledEngineBase):
             self._dp = dict(self.cfg.mesh.shape).get("dp", 1)
         if self._dp > 1:
             # batch-dim sharding needs every padded batch divisible by dp:
-            # raise the bucket floors so even a 1-sequence step pads to dp
+            # raise the bucket floors so even a 1-sequence step pads to dp,
+            # and reject a cap that cannot divide — buckets double from the
+            # floor then CLAMP at max_num_seqs, so an indivisible cap would
+            # silently run the heaviest (full-load) batches replicated
+            if self.cfg.max_num_seqs % self._dp:
+                raise ValueError(
+                    f"max_num_seqs={self.cfg.max_num_seqs} not divisible "
+                    f"by dp={self._dp}: the saturated decode batch could "
+                    "not shard over the dp axis")
             self.cfg.min_decode_bucket = max(self.cfg.min_decode_bucket,
                                              self._dp)
             self.cfg.min_prefill_seqs_bucket = max(
@@ -148,6 +156,11 @@ class JaxEngine(ScheduledEngineBase):
             # the tunneled single-chip backend registers as "axon"
             on_tpu = jax.devices()[0].platform in ("tpu", "axon")
             impl = "pallas" if on_tpu else "scan"
+        if forward_fn is not None and impl == "pallas":
+            # custom forwards (pipeline_forward) implement only the base
+            # signature — never pass them the attn_impl kwarg
+            logger.info("custom forward_fn: using the XLA scan path")
+            impl = "scan"
         if impl in ("pallas", "pallas_unrolled"):
             from dynamo_tpu.ops.pallas.decode import supports
             if not supports(model_cfg.head_dim, self.cfg.page_size):

@@ -41,6 +41,15 @@ class ModelConfig:
     num_experts_per_tok: int = 2
     moe_intermediate_size: int = 0
     norm_topk_prob: bool = True
+    # expert compute: "dense" runs every expert on every token (static
+    # shapes, fine at decode batch sizes); "dispatch" gathers each expert's
+    # routed tokens into a fixed-capacity buffer first, cutting expert
+    # FLOPs from E to ~k x capacity_factor per token (the wide-EP path)
+    moe_backend: str = "dense"
+    # dispatch capacity per expert = ceil(T * k / E * this); tokens routed
+    # past capacity are dropped (their combine weight is zero) — the
+    # standard GShard/Switch overflow semantics
+    moe_capacity_factor: float = 2.0
     # gemma-2 family (models/gemma.py)
     sliding_window: int = 0            # 0 = all layers global attention
     attn_logit_softcap: float = 0.0    # 0 = disabled

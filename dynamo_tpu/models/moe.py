@@ -7,13 +7,14 @@ swapping the dense MLP for routed experts:
 
 - router: softmax over expert logits, top-k selection, optional
   renormalization (``norm_topk_prob``).
-- experts computed densely (every expert over every token) with the routing
-  weights applied as a mask — simple, fully static shapes, and under GSPMD
-  the expert axis shards over ``ep`` so each chip computes only its local
-  experts, with XLA inserting the combine all-reduce. This is the right
-  trade at serving batch sizes (decode steps see tens of tokens); a
-  capacity-based dispatch kernel is the later optimization, not a different
-  architecture.
+- two expert-compute backends, selected by ``cfg.moe_backend``:
+  "dense" computes every expert over every token with routing weights as a
+  mask — simple, fully static shapes, the right trade at decode batch
+  sizes (tens of tokens); "dispatch" (``moe_mlp_dispatch``) gathers each
+  expert's routed tokens into a fixed-capacity buffer first, cutting
+  expert FLOPs from E to ~k x capacity_factor per token — the wide-EP
+  path for large expert counts. Under GSPMD both shard the expert axis
+  over ``ep`` so each chip computes only its local experts.
 
 Weight layout (stacked for scan): ``w_router [L, H, E]``,
 ``w_gate/w_up [L, E, H, I]``, ``w_down [L, E, I, H]``.
@@ -46,12 +47,7 @@ from dynamo_tpu.ops.attention import (
 def moe_mlp(cfg: ModelConfig, lp: Dict[str, jnp.ndarray],
             x: jnp.ndarray) -> jnp.ndarray:
     """Routed expert MLP. x: [B, S, H] (already normed) -> [B, S, H]."""
-    k = cfg.num_experts_per_tok
-    logits = x @ lp["w_router"]                     # [B, S, E]
-    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
-    top_w, top_i = jax.lax.top_k(probs, k)          # [B, S, k]
-    if cfg.norm_topk_prob:
-        top_w = top_w / jnp.sum(top_w, axis=-1, keepdims=True)
+    top_w, top_i = _router_topk(cfg, lp, x)         # [B, S, k]
     # dense per-expert weights [B, S, E] (zero for unrouted experts)
     weights = jnp.sum(
         jax.nn.one_hot(top_i, cfg.num_experts, dtype=jnp.float32)
@@ -63,11 +59,74 @@ def moe_mlp(cfg: ModelConfig, lp: Dict[str, jnp.ndarray],
     return jnp.einsum("bse,bseh->bsh", weights.astype(out.dtype), out)
 
 
+def _router_topk(cfg: ModelConfig, lp: Dict[str, jnp.ndarray],
+                 x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Shared router: softmax over expert logits, top-k, optional renorm.
+    x: [..., H] -> (weights [..., k] f32, indices [..., k] int32)."""
+    logits = x @ lp["w_router"]
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    top_w, top_i = jax.lax.top_k(probs, cfg.num_experts_per_tok)
+    if cfg.norm_topk_prob:
+        top_w = top_w / jnp.sum(top_w, axis=-1, keepdims=True)
+    return top_w, top_i
+
+
+def moe_mlp_dispatch(cfg: ModelConfig, lp: Dict[str, jnp.ndarray],
+                     x: jnp.ndarray) -> jnp.ndarray:
+    """Capacity-factor token dispatch (GShard/Switch style): each expert
+    computes only a fixed-capacity buffer of its ROUTED tokens instead of
+    every token — expert FLOPs drop from ``E`` to ``~k * capacity_factor``
+    per token, which is what makes wide-EP (DeepSeek-R1/Mixtral-class
+    expert counts) credible. Reference role: SGLang DeepEP wide-EP
+    (``components/backends/sglang/docs/dsr1-wideep-h100.md``); here the
+    dispatch/combine are einsums against one-hot capacity assignments, so
+    under GSPMD the expert axis shards over ``ep`` and XLA lowers the
+    gathers to all-to-alls on ICI.
+
+    Tokens routed past an expert's capacity are dropped for that expert
+    (combine weight zero) — standard overflow semantics; raise
+    ``cfg.moe_capacity_factor`` to make drops impossible at a given batch.
+    x: [B, S, H] (already normed) -> [B, S, H].
+    """
+    B, S, H = x.shape
+    T = B * S
+    E, k = cfg.num_experts, cfg.num_experts_per_tok
+    import math
+    C = max(1, min(T, math.ceil(T * k * cfg.moe_capacity_factor / E)))
+    xt = x.reshape(T, H)
+    top_w, top_i = _router_topk(cfg, lp, xt)              # [T, k]
+
+    # position-in-expert by running counts (slot-major priority: slot 0
+    # assignments claim capacity before slot 1, ties by token order)
+    counts = jnp.zeros((E,), jnp.int32)
+    combine = jnp.zeros((T, E, C), jnp.float32)
+    for j in range(k):
+        m = jax.nn.one_hot(top_i[:, j], E, dtype=jnp.int32)   # [T, E]
+        pos = jnp.cumsum(m, axis=0) - 1 + counts[None, :]     # [T, E]
+        counts = counts + jnp.sum(m, axis=0)
+        keep = (pos < C) & (m > 0)                            # [T, E]
+        oh = jax.nn.one_hot(jnp.clip(pos, 0, C - 1), C,
+                            dtype=jnp.float32)                # [T, E, C]
+        combine = combine + jnp.where(
+            keep[..., None], oh * top_w[:, j, None, None], 0.0)
+
+    dispatch = (combine > 0).astype(x.dtype)                  # [T, E, C]
+    xe = jnp.einsum("tec,th->ech", dispatch, xt)              # [E, C, H]
+    gate = jnp.einsum("ech,ehi->eci", xe, lp["w_gate"])
+    up = jnp.einsum("ech,ehi->eci", xe, lp["w_up"])
+    ye = jnp.einsum("eci,eih->ech", jax.nn.silu(gate) * up,
+                    lp["w_down"])                             # [E, C, H]
+    out = jnp.einsum("tec,ech->th", combine.astype(ye.dtype), ye)
+    return out.reshape(B, S, H).astype(x.dtype)
+
+
 def _moe_layer_tail(cfg: ModelConfig, lp: Dict[str, jnp.ndarray],
                     h: jnp.ndarray, attn: jnp.ndarray) -> jnp.ndarray:
     h = _finish_attn(cfg, lp, h, attn)
     x = _rms_norm(h, lp["mlp_norm"], cfg.rms_norm_eps)
-    return h + moe_mlp(cfg, lp, x)
+    mlp = (moe_mlp_dispatch if cfg.moe_backend == "dispatch"
+           else moe_mlp)
+    return h + mlp(cfg, lp, x)
 
 
 def init_params(cfg: ModelConfig, rng: jax.Array,
@@ -141,4 +200,5 @@ def forward_unrolled(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     return _logits(cfg, params, h, new_lens), out_pages
 
 
-__all__ = ["forward", "forward_unrolled", "init_params", "moe_mlp"]
+__all__ = ["forward", "forward_unrolled", "init_params", "moe_mlp",
+           "moe_mlp_dispatch"]

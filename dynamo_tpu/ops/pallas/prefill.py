@@ -160,13 +160,16 @@ def _paged_prefill(q, kv_pages, layer_idx, page_table, q_start, total_lens,
     P = page_table.shape[1]
     chunk = min(PAGES_PER_CHUNK, P)
     SB = min(QUERY_BLOCK, S)
-    assert S % SB == 0, (S, SB)
+    # S need not divide SB: pallas pads the ragged last block (its garbage
+    # query rows attend to finite clamped pages and their outputs land in
+    # the discarded pad region of out_ref)
+    n_q_blocks = -(-S // SB)
 
     kernel = functools.partial(_prefill_kernel, page_size=page_size,
                                n_kv=Hkv, chunk=chunk, q_block=SB)
     return pl.pallas_call(
         kernel,
-        grid=(B, S // SB),
+        grid=(B, n_q_blocks),
         in_specs=[
             pl.BlockSpec((1, SB, Hq, Dh), lambda b, j: (b, j, 0, 0)),
             pl.BlockSpec(memory_space=pl.ANY),

@@ -23,10 +23,15 @@ in which
   at the end, so every rank returns the full ``[B, vocab]`` (multi-host
   leaders read results locally, like every other step family).
 
-Scope (honest): the in/out specs here stage the LAYER axis only; on a
-mesh that also has tp > 1 the weights replicate over tp within each stage
-(correct, not head-split). Extending the specs to ``P(pp, ..., tp)`` per
-leaf is the composition path once a deployment needs both at once.
+PP composes with TP (``pp x tp`` mesh): the ``shard_map`` stays fully
+manual (partial-manual shard_map is not supported by this jax), so the
+stage body does tensor parallelism explicitly — weights placed with
+``P("pp", ..., "tp")`` (``pp_sharding_fns`` with a model config), each
+device computing its head/ffn shard and the standard two per-layer
+``lax.psum`` all-reduces over ``tp`` (after the attention out-projection
+and the mlp down-projection) completing the activations. KV pages shard
+``Hkv`` over tp inside each stage, so paged reads/writes stay chip-local
+exactly as in the plain tp path.
 """
 
 from __future__ import annotations
@@ -47,9 +52,31 @@ from dynamo_tpu.models.llama import (
 from dynamo_tpu.ops.attention import paged_attention, write_kv
 
 
-def _param_specs(params: Dict[str, Any], pp_axis: str) -> Dict[str, Any]:
-    """Layer-stacked leaves shard axis 0 over pp; the rest replicate."""
-    layer_spec = {k: P(pp_axis) for k in params["layers"]}
+# tp tail (dims after the leading L axis) per layer-stacked leaf — the
+# same placement ``parallel/sharding.py`` uses for the plain tp path:
+# qkv/ffn-up shard their OUTPUT dim, out/down projections their INPUT dim
+# (so the partial products line up for the per-layer psum).
+_TP_TAILS: Dict[str, Tuple] = {
+    "attn_norm": (), "mlp_norm": (), "q_norm": (), "k_norm": (),
+    "wq": (None, "tp"), "wk": (None, "tp"), "wv": (None, "tp"),
+    "wo": ("tp", None),
+    "w_gate": (None, "tp"), "w_up": (None, "tp"), "w_down": ("tp", None),
+    "bq": ("tp",), "bk": ("tp",), "bv": ("tp",),
+}
+
+
+def _layer_spec(name: str, pp_axis: str, tp: int) -> P:
+    if tp == 1:
+        return P(pp_axis)
+    return P(pp_axis, *_TP_TAILS.get(name, ()))
+
+
+def _param_specs(params: Dict[str, Any], pp_axis: str,
+                 tp: int) -> Dict[str, Any]:
+    """Layer-stacked leaves shard axis 0 over pp (+ tp tails); the rest
+    replicate (incl. lm_head: the vocab projection runs once on the full
+    hidden state after the pipeline, replicated per device)."""
+    layer_spec = {k: _layer_spec(k, pp_axis, tp) for k in params["layers"]}
     specs: Dict[str, Any] = {k: P() for k in params if k != "layers"}
     specs["layers"] = layer_spec
     return specs
@@ -59,7 +86,7 @@ def pipeline_forward(params: Dict[str, Any], cfg: ModelConfig,
                      tokens: jnp.ndarray, positions: jnp.ndarray,
                      pages: jnp.ndarray, page_table: jnp.ndarray,
                      total_lens: jnp.ndarray, new_lens: jnp.ndarray,
-                     mesh: Mesh, pp_axis: str = "pp",
+                     mesh: Mesh, pp_axis: str = "pp", tp_axis: str = "tp",
                      n_microbatches: int | None = None
                      ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Drop-in for ``llama.forward`` running the layers as a pp pipeline.
@@ -68,9 +95,11 @@ def pipeline_forward(params: Dict[str, Any], cfg: ModelConfig,
     the batch; the default picks the LARGEST divisor of B that is <= pp —
     M == pp keeps every stage busy in steady state, smaller batches run
     with pipeline bubbles rather than failing. ``pages`` is the stacked
-    cache ``[L, N, 2, Hkv, ps, Dh]``.
+    cache ``[L, N, 2, Hkv, ps, Dh]``. A ``tp`` mesh axis > 1 additionally
+    head/ffn-shards each stage (weights placed by ``pp_sharding_fns``).
     """
     n_stages = mesh.shape[pp_axis]
+    tp = dict(mesh.shape).get(tp_axis, 1)
     if n_stages == 1:
         from dynamo_tpu.models.llama import forward
         return forward(params, cfg, tokens, positions, pages, page_table,
@@ -78,6 +107,10 @@ def pipeline_forward(params: Dict[str, Any], cfg: ModelConfig,
     if cfg.num_layers % n_stages:
         raise ValueError(f"num_layers={cfg.num_layers} not divisible by "
                          f"pp={n_stages}")
+    if tp > 1 and (cfg.num_kv_heads % tp or cfg.intermediate_size % tp):
+        raise ValueError(f"num_kv_heads={cfg.num_kv_heads}/"
+                         f"intermediate_size={cfg.intermediate_size} not "
+                         f"divisible by tp={tp}")
     B = tokens.shape[0]
     # default: the largest microbatch count <= pp that divides B (a small
     # serving batch pipelines with bubbles rather than failing)
@@ -88,6 +121,14 @@ def pipeline_forward(params: Dict[str, Any], cfg: ModelConfig,
     Bm = B // M
     sm_scale = cfg.head_dim ** -0.5
     layers_per_stage = cfg.num_layers // n_stages
+    # per-device view of the head/ffn dims under manual tp: _project_qkv
+    # reshapes by head COUNTS, which are local inside the shard_map body
+    cfg_local = cfg
+    if tp > 1:
+        import dataclasses
+        cfg_local = dataclasses.replace(
+            cfg, num_heads=cfg.num_heads // tp,
+            num_kv_heads=cfg.num_kv_heads // tp)
 
     def shard_fn(params, tokens, positions, page_table, total_lens,
                  new_lens, pages_local):
@@ -110,12 +151,27 @@ def pipeline_forward(params: Dict[str, Any], cfg: ModelConfig,
             def body(carry, xs):
                 h, pages_local = carry
                 lp, lidx = xs
-                q, k, v = _project_qkv(cfg, lp, h, pos)
+                q, k, v = _project_qkv(cfg_local, lp, h, pos)
                 pages_local = write_kv(pages_local, lidx, k, v, tbl, pos,
                                        new)
                 attn = paged_attention(q, pages_local, lidx, tbl, pos, tot,
                                        sm_scale)
-                h = _finish_layer(cfg, lp, h, attn)
+                if tp == 1:
+                    h = _finish_layer(cfg, lp, h, attn)
+                else:
+                    # manual tensor parallelism: each device holds its head
+                    # slice of wo / ffn slice of w_down, so the projections
+                    # produce PARTIAL sums — the standard two all-reduces
+                    # per layer complete them (parallel/sharding.py places
+                    # the plain-tp path identically; GSPMD inserts the same
+                    # psums there automatically)
+                    Bm_, S_ = h.shape[0], h.shape[1]
+                    attn_out = attn.reshape(Bm_, S_, -1) @ lp["wo"]
+                    h = h + lax.psum(attn_out, tp_axis)
+                    x = _rms_norm(h, lp["mlp_norm"], cfg.rms_norm_eps)
+                    mlp = (jax.nn.silu(x @ lp["w_gate"])
+                           * (x @ lp["w_up"])) @ lp["w_down"]
+                    h = h + lax.psum(mlp, tp_axis)
                 return (h, pages_local), None
 
             (h, pages_local), _ = lax.scan(
@@ -170,12 +226,14 @@ def pipeline_forward(params: Dict[str, Any], cfg: ModelConfig,
         logits = hn.astype(jnp.float32) @ lm_head.astype(jnp.float32)
         return logits, pages_local
 
+    pages_spec = (P(pp_axis) if tp == 1
+                  else P(pp_axis, None, None, tp_axis))
     specs_in = (
-        _param_specs(params, pp_axis),
+        _param_specs(params, pp_axis, tp),
         P(), P(), P(), P(), P(),       # tokens/positions/table/total/new
-        P(pp_axis),                    # pages: layer axis staged
+        pages_spec,                    # pages: layers staged, Hkv over tp
     )
-    specs_out = (P(), P(pp_axis))
+    specs_out = (P(), pages_spec)
     fn = jax.shard_map(shard_fn, mesh=mesh, in_specs=specs_in,
                        out_specs=specs_out, check_vma=False)
     logits, pages = fn(params, tokens, positions, page_table, total_lens,
@@ -183,16 +241,35 @@ def pipeline_forward(params: Dict[str, Any], cfg: ModelConfig,
     return logits, pages
 
 
-def pp_sharding_fns(mesh: Mesh, pp_axis: str = "pp"):
+def pp_sharding_fns(mesh: Mesh, cfg: ModelConfig | None = None,
+                    pp_axis: str = "pp", tp_axis: str = "tp"):
     """(shard_params_fn, shard_pages_fn) placing the layer-stacked leaves
     and the stacked page cache on the pp axis — what a worker plugs into
-    ``JaxEngineConfig`` to serve with ``pipeline_forward``."""
+    ``JaxEngineConfig`` to serve with ``pipeline_forward``.
+
+    With a ``tp`` axis > 1 on the mesh, each layer leaf composes the stage
+    placement with the tensor-parallel tail (wq ``P("pp", None, "tp")``,
+    pages ``P("pp", None, None, "tp", ...)``); non-layer leaves replicate
+    (the vocab projection runs replicated after the pipeline). ``cfg`` is
+    required then, for the divisibility checks."""
     from jax.sharding import NamedSharding
+
+    tp = dict(mesh.shape).get(tp_axis, 1)
+    if tp > 1:
+        if cfg is None:
+            raise ValueError("pp x tp sharding needs the model config")
+        if cfg.num_kv_heads % tp or cfg.intermediate_size % tp:
+            raise ValueError(
+                f"num_kv_heads={cfg.num_kv_heads}/intermediate_size="
+                f"{cfg.intermediate_size} not divisible by tp={tp}")
+    pages_spec = (P(pp_axis) if tp == 1
+                  else P(pp_axis, None, None, tp_axis))
 
     def shard_params(params):
         out = dict(params)
         out["layers"] = {
-            k: jax.device_put(v, NamedSharding(mesh, P(pp_axis)))
+            k: jax.device_put(
+                v, NamedSharding(mesh, _layer_spec(k, pp_axis, tp)))
             for k, v in params["layers"].items()}
         for k, v in params.items():
             if k != "layers":
@@ -200,7 +277,7 @@ def pp_sharding_fns(mesh: Mesh, pp_axis: str = "pp"):
         return out
 
     def shard_pages(pages):
-        return jax.device_put(pages, NamedSharding(mesh, P(pp_axis)))
+        return jax.device_put(pages, NamedSharding(mesh, pages_spec))
 
     return shard_params, shard_pages
 

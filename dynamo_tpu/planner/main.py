@@ -25,7 +25,7 @@ def build_parser() -> argparse.ArgumentParser:
                    help="perf profile JSON (see planner/perf_interpolation.py)")
     p.add_argument("--interval", type=float, default=30.0)
     p.add_argument("--predictor", default="ewma",
-                   choices=["constant", "ewma", "trend"])
+                   choices=["constant", "ewma", "trend", "seasonal"])
     p.add_argument("--ttft-slo", type=float, default=0.5)
     p.add_argument("--itl-slo", type=float, default=0.05)
     p.add_argument("--min-prefill", type=int, default=1)

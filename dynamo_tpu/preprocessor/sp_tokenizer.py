@@ -23,7 +23,6 @@ families (llama/mistral/gemma): whitespace to ``▁`` with a dummy prefix.
 from __future__ import annotations
 
 import struct
-import threading
 from typing import Dict, List, Optional, Tuple
 
 _SPACE = "▁"  # ▁
@@ -75,7 +74,6 @@ class SpTokenizer:
                  model_type: int = _UNIGRAM):
         self._pieces = pieces
         self._model_type = model_type
-        self._lock = threading.Lock()
         # _id_of: full piece -> id map (token_to_id lookups, any type).
         # _match: pieces segmentation may produce from USER TEXT — control
         # and byte pieces excluded, or a prompt containing the literal
@@ -98,6 +96,9 @@ class SpTokenizer:
                 self._byte_id[int(piece[3:5], 16)] = i
         self._max_piece_len = max((len(p) for p, _s, _t in pieces),
                                   default=1)
+        # unknown-char fallback edge: scored below any real segmentation
+        self._unk_penalty = min((s for _p, s, _t in pieces),
+                                default=0.0) - 10.0
 
     # -- loading -----------------------------------------------------------
 
@@ -159,8 +160,7 @@ class SpTokenizer:
         best = [NEG] * (n + 1)
         back: List[Optional[Tuple[int, Optional[int]]]] = [None] * (n + 1)
         best[0] = 0.0
-        # score an unknown single char below any real segmentation
-        unk_penalty = min((s for _p, s, _t in self._pieces), default=0.0) - 10.0
+        unk_penalty = self._unk_penalty
         for i in range(n):
             if best[i] <= NEG / 2:
                 continue
@@ -212,12 +212,13 @@ class SpTokenizer:
 
     def encode(self, text: str, add_special_tokens: bool = False
                ) -> List[int]:
-        del add_special_tokens  # BOS/EOS handling lives in the chat template
+        # BOS/EOS handling lives in the chat template; encode/decode read
+        # only immutable state, so no lock (unlike the HF-object wrapper)
+        del add_special_tokens
         norm = self._normalize(text)
-        with self._lock:
-            if self._model_type == _BPE:
-                return self._encode_bpe(norm)
-            return self._encode_unigram(norm)
+        if self._model_type == _BPE:
+            return self._encode_bpe(norm)
+        return self._encode_unigram(norm)
 
     # -- decode ------------------------------------------------------------
 

@@ -465,35 +465,45 @@ class DisaggDecodeHandler:
             first = await self._inbound_prefill(request)
         elif self._use_remote_prefill(request):
             first = await self._remote_prefill(request)
-        if first is not None and first.token_ids:
-            tok = first.token_ids[0]
-            yield LLMEngineOutput(token_ids=[tok],
-                                  log_probs=first.log_probs)
-            sc = request.stop_conditions
-            if (not sc.ignore_eos and tok in request.eos_token_ids) or \
-               (sc.stop_token_ids and tok in sc.stop_token_ids):
-                yield LLMEngineOutput(
-                    finish_reason=first.finish_reason,
-                    prompt_tokens=len(request.token_ids),
-                    completion_tokens=1)
-                return
-            if sc.max_tokens is not None and sc.max_tokens <= 1:
-                yield LLMEngineOutput(
-                    finish_reason=first.finish_reason,
-                    prompt_tokens=len(request.token_ids),
-                    completion_tokens=1)
-                return
-            request = PreprocessedRequest.from_dict(request.to_dict())
-            request.token_ids = list(request.token_ids) + [tok]
-            if request.stop_conditions.max_tokens is not None:
-                request.stop_conditions.max_tokens -= 1
-        async for out in self.engine.generate(request, ctx):
-            if (first is not None and out.finish_reason is not None
-                    and out.completion_tokens is not None):
-                # the handed-off first token counts as completion, not prompt
-                out.prompt_tokens = (out.prompt_tokens or 1) - 1
-                out.completion_tokens = out.completion_tokens + 1
+        async for out in _continue_after_first(self.engine, request, first,
+                                               ctx):
             yield out
+
+
+async def _continue_after_first(engine: JaxEngine,
+                                request: PreprocessedRequest,
+                                first: Optional[LLMEngineOutput],
+                                ctx=None) -> AsyncIterator[LLMEngineOutput]:
+    """Stream a request on ``engine`` given an optional handed-off FIRST
+    token (a completed remote/local prefill leg): emit it, resolve its
+    stop conditions (EOS / stop tokens / max_tokens), then decode the rest
+    with the token appended to the prompt — the one shared continuation
+    for the decode-first, prefill-first-inbound, and prefill-first-local-
+    fallback paths, so their stop semantics can never drift apart."""
+    if first is not None and first.token_ids:
+        tok = first.token_ids[0]
+        yield LLMEngineOutput(token_ids=[tok], log_probs=first.log_probs)
+        sc = request.stop_conditions
+        done = ((not sc.ignore_eos and tok in request.eos_token_ids)
+                or (sc.stop_token_ids and tok in sc.stop_token_ids)
+                or (sc.max_tokens is not None and sc.max_tokens <= 1))
+        if done:
+            yield LLMEngineOutput(
+                finish_reason=first.finish_reason,
+                prompt_tokens=len(request.token_ids),
+                completion_tokens=1)
+            return
+        request = PreprocessedRequest.from_dict(request.to_dict())
+        request.token_ids = list(request.token_ids) + [tok]
+        if request.stop_conditions.max_tokens is not None:
+            request.stop_conditions.max_tokens -= 1
+    async for out in engine.generate(request, ctx):
+        if (first is not None and out.finish_reason is not None
+                and out.completion_tokens is not None):
+            # the handed-off first token counts as completion, not prompt
+            out.prompt_tokens = (out.prompt_tokens or 1) - 1
+            out.completion_tokens = out.completion_tokens + 1
+        yield out
 
 
 class PrefillFirstHandler:
@@ -577,23 +587,8 @@ class PrefillFirstHandler:
                                       error=f"decode worker lost: {e}")
                 return
             logger.warning("decode forward failed (%s); continuing local", e)
-            cont = PreprocessedRequest.from_dict(request.to_dict())
-            tok = final.token_ids[0]
-            yield LLMEngineOutput(token_ids=[tok], log_probs=final.log_probs)
-            sc = cont.stop_conditions
-            if sc.max_tokens is not None and sc.max_tokens <= 1:
-                yield LLMEngineOutput(finish_reason=FinishReason.LENGTH,
-                                      prompt_tokens=len(request.token_ids),
-                                      completion_tokens=1)
-                return
-            cont.token_ids = list(cont.token_ids) + [tok]
-            if cont.stop_conditions.max_tokens is not None:
-                cont.stop_conditions.max_tokens -= 1
-            async for out in self.engine.generate(cont, ctx):
-                if (out.finish_reason is not None
-                        and out.completion_tokens is not None):
-                    out.prompt_tokens = (out.prompt_tokens or 1) - 1
-                    out.completion_tokens = out.completion_tokens + 1
+            async for out in _continue_after_first(self.engine, request,
+                                                   final, ctx):
                 yield out
 
 

@@ -68,6 +68,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="ring-attention sequence parallelism: prompts longer "
                         "than the prefill chunk budget prefill in one "
                         "sequence-sharded step over this many devices")
+    p.add_argument("--moe-backend", choices=["dense", "dispatch"],
+                   default=None,
+                   help="MoE expert compute: dense (every expert, every "
+                        "token — decode-batch default) or dispatch "
+                        "(capacity-factor token gather — wide-EP)")
     p.add_argument("--host-cache-bytes", type=int, default=0,
                    help="KVBM G2 host-RAM KV tier budget (0 disables)")
     p.add_argument("--disk-cache-bytes", type=int, default=0,
@@ -123,6 +128,9 @@ def build_engine(args: argparse.Namespace) -> JaxEngine:
         cfg = GgufFile(args.model_path).to_model_config(dtype=args.dtype)
     else:
         cfg = ModelConfig.from_pretrained(args.model_path, dtype=args.dtype)
+    if args.moe_backend is not None and cfg.num_experts:
+        import dataclasses
+        cfg = dataclasses.replace(cfg, moe_backend=args.moe_backend)
     engine_cfg = JaxEngineConfig(
         num_pages=args.num_pages, page_size=args.page_size,
         max_num_seqs=args.max_num_seqs,
@@ -137,9 +145,9 @@ def build_engine(args: argparse.Namespace) -> JaxEngine:
         from dynamo_tpu.parallel.mesh import MeshSpec, make_mesh
         from dynamo_tpu.parallel.pipeline import (
             pipeline_forward, pp_sharding_fns)
-        if args.tensor_parallel_size > 1 or args.sequence_parallel_size > 1:
+        if args.sequence_parallel_size > 1:
             raise SystemExit("--pipeline-parallel-size does not combine "
-                             "with tp/sp yet (layer-axis staging only)")
+                             "with sp yet")
         if args.num_nodes > 1:
             raise SystemExit("--pipeline-parallel-size with --num-nodes>1 "
                              "is not wired yet (the engine's multihost "
@@ -149,15 +157,20 @@ def build_engine(args: argparse.Namespace) -> JaxEngine:
             raise SystemExit(
                 f"model has {cfg.num_layers} layers — not divisible by "
                 f"--pipeline-parallel-size {pp}")
-        mesh = make_mesh(MeshSpec(pp=pp), devices=jax.devices()[:pp])
-        shard_params, shard_pages = pp_sharding_fns(mesh)
+        pp_tp = args.tensor_parallel_size
+        mesh = make_mesh(MeshSpec(pp=pp, tp=pp_tp),
+                         devices=jax.devices()[:pp * pp_tp])
+        shard_params, shard_pages = pp_sharding_fns(mesh, cfg)
         engine_cfg.attn_impl = "scan"  # pipeline runs the stacked-cache path
         engine_cfg.shard_params_fn = shard_params
         engine_cfg.shard_pages_fn = shard_pages
         forward_fn = functools.partial(pipeline_forward, mesh=mesh)
     tp, sp = args.tensor_parallel_size, args.sequence_parallel_size
     dp = args.data_parallel_size
-    if tp > 1 or sp > 1 or dp > 1:
+    if pp > 1 and dp > 1:
+        raise SystemExit("--pipeline-parallel-size does not combine with "
+                         "--data-parallel-size yet")
+    if (tp > 1 or sp > 1 or dp > 1) and pp == 1:
         from dynamo_tpu.parallel.mesh import MeshSpec, make_mesh
         from dynamo_tpu.parallel.sharding import ModelSharding
         # multi-host: the mesh spans every process's devices (global set)

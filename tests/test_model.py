@@ -371,6 +371,33 @@ class TestPallasPrefill:
                     np.asarray(out[b, :nb], np.float32),
                     rtol=3e-2, atol=3e-2)
 
+    def test_ragged_query_block(self):
+        """S not divisible by the 256-row query block (e.g. a 320-token
+        chunk bucket): the ragged last block must still be correct."""
+        from dynamo_tpu.ops import pallas as _p
+        from dynamo_tpu.ops.attention import paged_attention
+        from dynamo_tpu.ops.pallas import prefill as pf
+        L, N, Hkv, ps, Dh = 2, 33, 2, 8, 128
+        Hq, B, S, P = 4, 2, 20, 8  # S=20 vs forced q_block=16
+        k1, k2 = jax.random.split(jax.random.PRNGKey(9))
+        pages = jax.random.normal(k1, (L, N, 2, Hkv, ps, Dh)) \
+            .astype(jnp.bfloat16)
+        q = jax.random.normal(k2, (B, S, Hq, Dh)).astype(jnp.bfloat16)
+        table = jnp.arange(1, 1 + B * P, dtype=jnp.int32).reshape(B, P)
+        positions = jnp.tile(jnp.arange(S, dtype=jnp.int32)[None], (B, 1))
+        total = jnp.full((B,), S, jnp.int32)
+        orig = pf.QUERY_BLOCK
+        pf.QUERY_BLOCK = 16
+        try:
+            out = pf.paged_prefill_attention_stacked(
+                q, pages, 0, table, positions, total, 0.1, interpret=True)
+        finally:
+            pf.QUERY_BLOCK = orig
+        ref = paged_attention(q, pages, 0, table, positions, total, 0.1)
+        np.testing.assert_allclose(np.asarray(ref, np.float32),
+                                   np.asarray(out, np.float32),
+                                   rtol=3e-2, atol=3e-2)
+
     def test_inside_scan_traced_layer(self):
         from dynamo_tpu.ops.attention import paged_attention
         from dynamo_tpu.ops.pallas.prefill import (

@@ -79,6 +79,71 @@ def test_two_process_tp8_serving(tmp_path):
     asyncio.run(asyncio.wait_for(_main(), timeout=300))
 
 
+def mh_dp_worker(coord_port: int, model_dir: str, rank: int, jax_port: int):
+    """dp=2 x tp=4 over the two-process 8-device world: the BATCH shards
+    across hosts; the engine re-replicates the packed output so rank 0
+    streams every row (VERDICT r3 §5 — cross-host dp)."""
+    ready = ("jax worker serving" if rank == 0
+             else "multihost follower rank 1 in lockstep")
+    return ManagedProcess(
+        ["dynamo_tpu.worker.main", "--coordinator", f"127.0.0.1:{coord_port}",
+         "--model-path", model_dir, "--model-name", "mh-model",
+         "--random-weights", "--data-parallel-size", "2",
+         "--tensor-parallel-size", "4",
+         "--num-nodes", "2", "--node-rank", str(rank),
+         "--jax-coordinator", f"127.0.0.1:{jax_port}",
+         "--local-devices", "4", "--no-kv-events",
+         "--page-size", "4", "--num-pages", "64", "--max-num-seqs", "4",
+         "--max-prefill-chunk", "16", "--max-context", "128"],
+        name=f"mh-dp-{rank}", ready_line=ready, timeout=150.0,
+        env_overrides={"XLA_FLAGS": ""})
+
+
+def test_two_process_dp2_tp4_serving(tmp_path):
+    model_dir = make_test_model_dir(
+        str(tmp_path / "mh-model"),
+        num_attention_heads=8, num_key_value_heads=8)
+
+    async def _main():
+        coord_port, http_port, jax_port = free_port(), free_port(), free_port()
+        base = f"http://127.0.0.1:{http_port}"
+
+        def body(text):
+            return {"model": "mh-model", "max_tokens": 4, "temperature": 0.0,
+                    "messages": [{"role": "user", "content": text}]}
+
+        fe = frontend(coord_port, http_port)
+        w0 = mh_dp_worker(coord_port, str(tmp_path / "mh-model"), 0, jax_port)
+        w1 = mh_dp_worker(coord_port, str(tmp_path / "mh-model"), 1, jax_port)
+        try:
+            await fe.start()
+            await asyncio.gather(w0.start(), w1.start())
+            await wait_model(base, "mh-model", timeout=60.0)
+            async with aiohttp.ClientSession() as s:
+                # CONCURRENT requests so the padded batch really spans the
+                # dp axis (bucket floor = dp = 2)
+                rs = await asyncio.gather(*[
+                    (await s.post(f"{base}/v1/chat/completions",
+                                  json=body(f"dp hello {i}"),
+                                  timeout=aiohttp.ClientTimeout(total=120))
+                     ).json() for i in range(3)])
+                for r in rs:
+                    assert r["choices"][0]["finish_reason"] == "length"
+                    assert r["usage"]["completion_tokens"] == 4
+                # greedy determinism across the dp-sharded mesh
+                r2 = await (await s.post(
+                    f"{base}/v1/chat/completions", json=body("dp hello 0"),
+                    timeout=aiohttp.ClientTimeout(total=120))).json()
+                assert (r2["choices"][0]["message"]["content"]
+                        == rs[0]["choices"][0]["message"]["content"])
+            assert w0.proc.poll() is None and w1.proc.poll() is None
+        finally:
+            for p in (w1, w0, fe):
+                await p.stop()
+
+    asyncio.run(asyncio.wait_for(_main(), timeout=300))
+
+
 def mh_disagg_decode_worker(coord_port: int, model_dir: str, rank: int,
                             jax_port: int):
     """Multi-host DECODE worker group: --disagg decode over 2 ranks."""

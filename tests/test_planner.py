@@ -66,6 +66,42 @@ class TestPredictors:
         with pytest.raises(ValueError):
             make_predictor("prophet")
 
+    def test_seasonal_learns_cycle(self):
+        """Holt-Winters must beat EWMA on a pure seasonal load: after a few
+        cycles its one-step forecast tracks the upcoming phase, where EWMA
+        lags toward the mean."""
+        import math
+
+        from dynamo_tpu.planner.load_predictor import SeasonalPredictor
+        season = 12
+        sp = make_predictor("seasonal", window=240, season=season)
+        assert isinstance(sp, SeasonalPredictor)
+        ew = EwmaPredictor()
+
+        def load(t):  # 100 +/- 80 sine cycle
+            return 100.0 + 80.0 * math.sin(2 * math.pi * t / season)
+
+        errs_sp, errs_ew = [], []
+        for t in range(8 * season):
+            y = load(t)
+            if t > 4 * season:  # after the profile converges
+                errs_sp.append(abs((sp.predict() or 0) - y))
+                errs_ew.append(abs((ew.predict() or 0) - y))
+            sp.observe(y)
+            ew.observe(y)
+        assert sum(errs_sp) < 0.35 * sum(errs_ew), (
+            sum(errs_sp), sum(errs_ew))
+
+    def test_seasonal_clamps_and_bootstraps(self):
+        from dynamo_tpu.planner.load_predictor import SeasonalPredictor
+        p = SeasonalPredictor(season=4)
+        assert p.predict() is None
+        p.observe(5.0)
+        assert p.predict() >= 0.0
+        for v in (0.0, 0.0, 0.0, 0.0):
+            p.observe(v)
+        assert p.predict() >= 0.0
+
 
 class TestInterpolator:
     def test_interp_and_extrapolation(self):
