@@ -91,6 +91,11 @@ def render_graph(cr: Dict[str, Any],
     name = cr["metadata"]["name"]
     spec = cr.get("spec", {}) or {}
     services: Dict[str, Any] = spec.get("services", {}) or {}
+    for svc_name, svc in services.items():
+        ctype = (svc or {}).get("componentType", "worker")
+        if ctype not in COMPONENTS:
+            raise ValueError(f"unknown componentType {ctype!r} "
+                             f"for service {svc_name!r}")
     coordinator = spec.get("coordinator") or ""
     if not coordinator:
         coord_svcs = [s for s, v in services.items()
@@ -99,13 +104,19 @@ def render_graph(cr: Dict[str, Any],
             svc = coord_svcs[0]
             port = services[svc].get("port") or COMPONENTS["coordinator"][1]
             coordinator = f"{name}-{svc}:{port}"
+        elif any((v or {}).get("componentType", "worker") != "coordinator"
+                 for v in services.values()):
+            # every non-coordinator component needs the address; deploying
+            # with '--coordinator ""' would crash-loop silently — fail the
+            # CR with a visible validation message instead
+            raise ValueError(
+                "graph has no spec.coordinator and no coordinator "
+                "service — components would start with an empty "
+                "coordinator address")
     manifests: List[Dict[str, Any]] = []
     for svc_name in sorted(services):
         svc = services[svc_name] or {}
         ctype = svc.get("componentType", "worker")
-        if ctype not in COMPONENTS:
-            raise ValueError(f"unknown componentType {ctype!r} "
-                             f"for service {svc_name!r}")
         full = f"{name}-{svc_name}"
         labels = {GRAPH_LABEL: name, SERVICE_LABEL: svc_name,
                   "app": full}
@@ -181,19 +192,23 @@ async def apply_manifests(manifests: List[Dict[str, Any]]) -> bool:
     return rc == 0
 
 
-async def prune_children(cr_name: str, keep: List[str],
+async def prune_children(cr_name: str, keep: Dict[str, List[str]],
                          kube_namespace: str) -> None:
     """Delete Deployments/Services labeled for this graph but absent from
-    the current spec (declarative removal of renamed/dropped services)."""
+    the current spec (declarative removal of renamed/dropped services).
+    ``keep`` maps kind -> kept names PER KIND: a Service that shares its
+    name with a kept Deployment (service dropped its port / changed
+    componentType) must still be pruned."""
     for kind in ("deployment", "service"):
         rc, out, _err = await _kubectl(
             "-n", kube_namespace, "get", kind, "-l",
             f"{GRAPH_LABEL}={cr_name}", "-o", "json")
         if rc != 0:
             continue
+        kept = keep.get(kind, [])
         for item in json.loads(out).get("items", []):
             name = item["metadata"]["name"]
-            if name not in keep:
+            if name not in kept:
                 logger.info("pruning %s/%s (no longer in graph %s)",
                             kind, name, cr_name)
                 await _kubectl("-n", kube_namespace, "delete", kind, name,
@@ -247,9 +262,10 @@ async def reconcile_once(kube_namespace: str) -> int:
             await update_status(cr, "Failed", kube_namespace)
             continue
         ok = await apply_manifests(manifests)
-        await prune_children(
-            name, [m["metadata"]["name"] for m in manifests],
-            kube_namespace)
+        keep: Dict[str, List[str]] = {"deployment": [], "service": []}
+        for m in manifests:
+            keep[m["kind"].lower()].append(m["metadata"]["name"])
+        await prune_children(name, keep, kube_namespace)
         state = (await graph_state(cr, kube_namespace)) if ok else "Failed"
         await update_status(cr, state, kube_namespace)
     return len(crs)

@@ -14,6 +14,10 @@ from dynamo_tpu.models.llama import forward, init_params, make_pages
 
 def get_family(cfg: ModelConfig):
     """Return the module implementing this config's model family."""
+    if cfg.kv_lora_rank:
+        # MLA (deepseek v2/v3): latent paged cache, absorbed attention
+        from dynamo_tpu.models import deepseek
+        return deepseek
     if cfg.num_experts:
         from dynamo_tpu.models import moe
         return moe

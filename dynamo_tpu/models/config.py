@@ -50,6 +50,29 @@ class ModelConfig:
     # past capacity are dropped (their combine weight is zero) — the
     # standard GShard/Switch overflow semantics
     moe_capacity_factor: float = 2.0
+    # DeepSeek V2/V3 MLA + MoE shape (models/deepseek.py). kv_lora_rank
+    # > 0 selects the MLA family: the KV cache stores the compressed
+    # latent (+ the shared rope key) instead of per-head K/V.
+    q_lora_rank: int = 0               # 0 = direct q projection
+    kv_lora_rank: int = 0
+    qk_rope_head_dim: int = 0
+    qk_nope_head_dim: int = 0
+    v_head_dim: int = 0
+    n_shared_experts: int = 0
+    first_k_dense_replace: int = 0     # leading dense (non-MoE) layers
+    routed_scaling_factor: float = 1.0
+    topk_method: str = "greedy"        # greedy | group_limited_greedy
+    n_group: int = 1
+    topk_group: int = 1
+    # YaRN rope scaling (real DeepSeek checkpoints ship
+    # rope_scaling={type: yarn, ...}); factor 0 = disabled
+    rope_scaling_factor: float = 0.0
+    rope_orig_max_position: int = 0
+    rope_beta_fast: float = 32.0
+    rope_beta_slow: float = 1.0
+    rope_mscale: float = 0.0
+    rope_mscale_all_dim: float = 0.0
+    rope_attention_factor: float = 0.0  # 0 = infer from factor/mscale
     # gemma-2 family (models/gemma.py)
     sliding_window: int = 0            # 0 = all layers global attention
     attn_logit_softcap: float = 0.0    # 0 = disabled
@@ -69,14 +92,57 @@ class ModelConfig:
         heads = hf["num_attention_heads"]
         mt = hf.get("model_type", "llama")
         num_experts = hf.get("num_local_experts", hf.get("num_experts", 0)) or 0
+        extra: Dict[str, Any] = {}
+        if mt.startswith("deepseek"):
+            num_experts = hf.get("n_routed_experts", 0) or 0
+            extra = dict(
+                q_lora_rank=int(hf.get("q_lora_rank") or 0),
+                kv_lora_rank=int(hf.get("kv_lora_rank") or 0),
+                qk_rope_head_dim=int(hf.get("qk_rope_head_dim") or 0),
+                qk_nope_head_dim=int(hf.get("qk_nope_head_dim") or 0),
+                v_head_dim=int(hf.get("v_head_dim") or 0),
+                n_shared_experts=int(hf.get("n_shared_experts") or 0),
+                first_k_dense_replace=int(
+                    hf.get("first_k_dense_replace") or 0),
+                routed_scaling_factor=float(
+                    hf.get("routed_scaling_factor") or 1.0),
+                topk_method=hf.get("topk_method", "greedy"),
+                n_group=int(hf.get("n_group") or 1),
+                topk_group=int(hf.get("topk_group") or 1),
+            )
+            rs = hf.get("rope_scaling") or {}
+            rtype = rs.get("rope_type", rs.get("type"))
+            if rtype == "yarn":
+                extra.update(
+                    rope_scaling_factor=float(rs.get("factor") or 1.0),
+                    rope_orig_max_position=int(
+                        rs.get("original_max_position_embeddings") or 0),
+                    rope_beta_fast=float(rs.get("beta_fast") or 32.0),
+                    rope_beta_slow=float(rs.get("beta_slow") or 1.0),
+                    rope_mscale=float(rs.get("mscale") or 0.0),
+                    rope_mscale_all_dim=float(
+                        rs.get("mscale_all_dim") or 0.0),
+                    rope_attention_factor=float(
+                        rs.get("attention_factor") or 0.0),
+                )
+            elif rtype is not None:
+                raise NotImplementedError(
+                    f"deepseek rope_scaling type {rtype!r} (only yarn is "
+                    "implemented)")
+        mla = bool(extra.get("kv_lora_rank"))
         return cls(
             vocab_size=hf["vocab_size"],
             hidden_size=hf["hidden_size"],
             intermediate_size=hf["intermediate_size"],
             num_layers=hf["num_hidden_layers"],
             num_heads=heads,
-            num_kv_heads=hf.get("num_key_value_heads", heads),
-            head_dim=hf.get("head_dim") or hf["hidden_size"] // heads,
+            # MLA: the paged cache stores ONE shared latent per token —
+            # [N, 2, 1, ps, kv_lora_rank], slot 0 = compressed kv latent,
+            # slot 1 = the (padded) shared rope key — so the generic cache
+            # machinery sizes from Hkv=1 x head_dim=kv_lora_rank
+            num_kv_heads=1 if mla else hf.get("num_key_value_heads", heads),
+            head_dim=(extra["kv_lora_rank"] if mla
+                      else hf.get("head_dim") or hf["hidden_size"] // heads),
             rope_theta=float(hf.get("rope_theta", 10000.0)),
             rms_norm_eps=float(hf.get("rms_norm_eps", 1e-5)),
             max_position_embeddings=hf.get("max_position_embeddings", 8192),
@@ -100,6 +166,7 @@ class ModelConfig:
                 hf.get("final_logit_softcapping") or 0.0),
             query_pre_attn_scalar=float(
                 hf.get("query_pre_attn_scalar") or 0.0),
+            **extra,
         )
 
     @classmethod

@@ -1,0 +1,537 @@
+"""DeepSeek V2/V3 family: MLA attention + shared/routed MoE, pure jax.
+
+The reference serves DeepSeek only through SGLang's CUDA stack (the wide-EP
+DSR1 recipe, ``components/backends/sglang/docs/dsr1-wideep-h100.md``); here
+the architecture is native. The TPU-first choice is Multi-head Latent
+Attention in its **absorbed** inference form:
+
+- The paged KV cache stores ONLY the compressed latent per token — slot 0
+  of the generic page layout holds the rms-normed ``c_kv``
+  (``kv_lora_rank`` wide), slot 1 the shared roped key (``qk_rope_head_dim``
+  wide, zero-padded to the latent width). At DeepSeek-V3 geometry that is
+  ~1 KB/token vs ~16 KB for equivalent MHA — the cache reduction that makes
+  long-context R1 serving fit HBM.
+- Attention runs IN LATENT SPACE: ``kv_b_proj`` is split into per-head
+  ``W_UK``/``W_UV``; queries absorb ``W_UK`` (``q_nope @ W_UK``) so scores
+  are ``q_lat · c_kv + q_pe · k_pe``, and the attention output re-expands
+  through ``W_UV`` — no per-head K/V ever materializes for the context.
+  This is algebraically identical to the HF eager path
+  (``transformers/models/deepseek_v2/modeling_deepseek_v2.py:339-430``,
+  checked by the parity test).
+- RoPE is the INTERLEAVED (complex-pair) convention HF uses for this
+  family (``apply_rotary_emb`` with ``view_as_complex``) — not llama's
+  rotate-half.
+- Layers are heterogeneous (``first_k_dense_replace`` dense layers, then
+  MoE): the scan forward runs TWO scans over two stacked pytrees
+  (``dense_layers`` / ``moe_layers``) sharing one paged cache, keeping the
+  single-compiled-layer-body property per layer kind.
+- The MoE gate matches HF exactly: f32 softmax scores, ``greedy`` or
+  ``group_limited_greedy`` top-k, weights scaled by
+  ``routed_scaling_factor`` (no renorm); routed experts compute densely
+  with the routing weights as a mask (ep-shardable, same trade as
+  ``models/moe.py``), plus the always-on shared experts.
+
+Weight layout matches HF checkpoints after transpose; ``load_params``
+assembles the two layer stacks from safetensors.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.models.llama import (
+    _logits,
+    _rms_norm,
+    make_pages,
+    make_pages_list,
+)
+from dynamo_tpu.ops.attention import NEG_INF, write_kv, write_kv_layer
+
+Params = Dict[str, Any]
+
+
+def yarn_freqs(cfg: ModelConfig) -> Tuple[np.ndarray, float]:
+    """(inv_freq [dr/2], attention_factor) — HF's
+    ``_compute_yarn_parameters`` (``modeling_rope_utils.py:246``) for the
+    rope head dim; identity when the config carries no yarn scaling."""
+    import math
+
+    dr = cfg.qk_rope_head_dim
+    base = cfg.rope_theta
+    pos_freqs = base ** (np.arange(0, dr, 2, dtype=np.float64) / dr)
+    if not cfg.rope_scaling_factor:
+        return (1.0 / pos_freqs).astype(np.float32), 1.0
+    factor = cfg.rope_scaling_factor
+    orig = cfg.rope_orig_max_position or cfg.max_position_embeddings
+
+    def get_mscale(scale, mscale=1.0):
+        if scale <= 1:
+            return 1.0
+        return 0.1 * mscale * math.log(scale) + 1.0
+
+    if cfg.rope_attention_factor:
+        attention_factor = cfg.rope_attention_factor
+    elif cfg.rope_mscale and cfg.rope_mscale_all_dim:
+        attention_factor = (get_mscale(factor, cfg.rope_mscale)
+                            / get_mscale(factor, cfg.rope_mscale_all_dim))
+    else:
+        attention_factor = get_mscale(factor)
+
+    def correction_dim(num_rot):
+        return (dr * math.log(orig / (num_rot * 2 * math.pi))
+                / (2 * math.log(base)))
+
+    low = max(math.floor(correction_dim(cfg.rope_beta_fast)), 0)
+    high = min(math.ceil(correction_dim(cfg.rope_beta_slow)), dr - 1)
+    if low == high:
+        high += 0.001
+    ramp = np.clip((np.arange(dr // 2, dtype=np.float64) - low)
+                   / (high - low), 0, 1)
+    extrapolation_factor = 1 - ramp
+    inv_freq = ((1.0 / (factor * pos_freqs))
+                * (1 - extrapolation_factor)
+                + (1.0 / pos_freqs) * extrapolation_factor)
+    return inv_freq.astype(np.float32), float(attention_factor)
+
+
+def rope_interleaved(x: jnp.ndarray, positions: jnp.ndarray,
+                     theta: float,
+                     inv_freq: Optional[np.ndarray] = None,
+                     scale: float = 1.0) -> jnp.ndarray:
+    """Complex-pair RoPE (HF deepseek ``apply_rotary_emb``): consecutive
+    element PAIRS (x[2i], x[2i+1]) rotate by the position angle, the
+    result scaled by the yarn ``attention_factor`` (HF multiplies the
+    freqs_cis magnitude). x: [B, S, ..., D]; positions: [B, S]."""
+    D = x.shape[-1]
+    if inv_freq is None:
+        inv_freq = 1.0 / (theta ** (jnp.arange(0, D, 2,
+                                               dtype=jnp.float32) / D))
+    else:
+        inv_freq = jnp.asarray(inv_freq, jnp.float32)
+    ang = positions.astype(jnp.float32)[..., None] * inv_freq  # [B, S, D/2]
+    while ang.ndim < x.ndim:
+        ang = ang[..., None, :]
+    cos, sin = jnp.cos(ang) * scale, jnp.sin(ang) * scale
+    xr = x[..., 0::2].astype(jnp.float32)
+    xi = x[..., 1::2].astype(jnp.float32)
+    out = jnp.stack([xr * cos - xi * sin, xr * sin + xi * cos], axis=-1)
+    return out.reshape(x.shape).astype(x.dtype)
+
+
+# ------------------------------------------------------------------- params
+
+def _attn_leaves(cfg: ModelConfig, key, scale: float,
+                 n: int) -> Dict[str, jnp.ndarray]:
+    dtype = jnp.dtype(cfg.dtype)
+    H = cfg.hidden_size
+    qk_head = cfg.qk_nope_head_dim + cfg.qk_rope_head_dim
+    keys = iter(jax.random.split(key, 8))
+
+    def randn(shape):
+        return (jax.random.normal(next(keys), (n,) + shape, jnp.float32)
+                * scale).astype(dtype)
+
+    leaves = {
+        "attn_norm": jnp.ones((n, H), dtype),
+        "wkv_a": randn((H, cfg.kv_lora_rank + cfg.qk_rope_head_dim)),
+        "kv_a_norm": jnp.ones((n, cfg.kv_lora_rank), dtype),
+        "wkv_b": randn((cfg.kv_lora_rank,
+                        cfg.num_heads * (cfg.qk_nope_head_dim
+                                         + cfg.v_head_dim))),
+        "wo": randn((cfg.num_heads * cfg.v_head_dim, H)),
+        "mlp_norm": jnp.ones((n, H), dtype),
+    }
+    if cfg.q_lora_rank:
+        leaves["wq_a"] = randn((H, cfg.q_lora_rank))
+        leaves["q_a_norm"] = jnp.ones((n, cfg.q_lora_rank), dtype)
+        leaves["wq_b"] = randn((cfg.q_lora_rank, cfg.num_heads * qk_head))
+    else:
+        leaves["wq"] = randn((H, cfg.num_heads * qk_head))
+    return leaves
+
+
+def init_params(cfg: ModelConfig, rng: jax.Array,
+                scale: float = 0.02) -> Params:
+    """Random init with the two-stack layer layout (tests/benchmarks)."""
+    dtype = jnp.dtype(cfg.dtype)
+    H, E = cfg.hidden_size, cfg.num_experts
+    Im = cfg.moe_intermediate_size or cfg.intermediate_size
+    K = cfg.first_k_dense_replace
+    M = cfg.num_layers - K
+    k_dense, k_moe, k_embed, k_head = jax.random.split(rng, 4)
+
+    def randn(key, shape):
+        return (jax.random.normal(key, shape, jnp.float32) * scale) \
+            .astype(dtype)
+
+    params: Params = {
+        "embed": randn(k_embed, (cfg.vocab_size, H)),
+        "final_norm": jnp.ones((H,), dtype),
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = randn(k_head, (H, cfg.vocab_size))
+    if K:
+        dl = _attn_leaves(cfg, k_dense, scale, K)
+        ks = iter(jax.random.split(jax.random.fold_in(k_dense, 1), 3))
+        dl["w_gate"] = randn(next(ks), (K, H, cfg.intermediate_size))
+        dl["w_up"] = randn(next(ks), (K, H, cfg.intermediate_size))
+        dl["w_down"] = randn(next(ks), (K, cfg.intermediate_size, H))
+        params["dense_layers"] = dl
+    if M:
+        ml = _attn_leaves(cfg, k_moe, scale, M)
+        ks = iter(jax.random.split(jax.random.fold_in(k_moe, 1), 8))
+        ml["w_router"] = randn(next(ks), (M, H, E))
+        ml["w_gate"] = randn(next(ks), (M, E, H, Im))
+        ml["w_up"] = randn(next(ks), (M, E, H, Im))
+        ml["w_down"] = randn(next(ks), (M, E, Im, H))
+        if cfg.n_shared_experts:
+            Is = Im * cfg.n_shared_experts
+            ml["ws_gate"] = randn(next(ks), (M, H, Is))
+            ml["ws_up"] = randn(next(ks), (M, H, Is))
+            ml["ws_down"] = randn(next(ks), (M, Is, H))
+        params["moe_layers"] = ml
+    return params
+
+
+# ---------------------------------------------------------------- attention
+
+def _mla_qkv(cfg: ModelConfig, lp: Dict[str, jnp.ndarray], h: jnp.ndarray,
+             positions: jnp.ndarray):
+    """Pre-attention MLA math: queries (latent-absorbed + rope) and the new
+    tokens' cache rows. Returns (q_lat [B,S,nh,dkv], q_pe [B,S,nh,dr],
+    c_kv [B,S,dkv], k_pe [B,S,dr], w_uv [nh,dkv,dv])."""
+    B, S, H = h.shape
+    nh = cfg.num_heads
+    dn, dr = cfg.qk_nope_head_dim, cfg.qk_rope_head_dim
+    dkv, dv = cfg.kv_lora_rank, cfg.v_head_dim
+    eps = cfg.rms_norm_eps
+    x = _rms_norm(h, lp["attn_norm"], eps)
+    if cfg.q_lora_rank:
+        q = _rms_norm(x @ lp["wq_a"], lp["q_a_norm"], eps) @ lp["wq_b"]
+    else:
+        q = x @ lp["wq"]
+    q = q.reshape(B, S, nh, dn + dr)
+    q_nope, q_pe = q[..., :dn], q[..., dn:]
+    inv_freq, att_scale = yarn_freqs(cfg)
+    q_pe = rope_interleaved(q_pe, positions, cfg.rope_theta,
+                            inv_freq=inv_freq, scale=att_scale)
+
+    ckv = x @ lp["wkv_a"]                                  # [B,S,dkv+dr]
+    c_kv = _rms_norm(ckv[..., :dkv], lp["kv_a_norm"], eps)
+    k_pe = rope_interleaved(ckv[..., dkv:], positions, cfg.rope_theta,
+                            inv_freq=inv_freq, scale=att_scale)
+
+    w_kb = lp["wkv_b"].reshape(dkv, nh, dn + dv)
+    w_uk = w_kb[..., :dn].transpose(1, 0, 2)               # [nh, dkv, dn]
+    w_uv = w_kb[..., dn:].transpose(1, 0, 2)               # [nh, dkv, dv]
+    # absorb W_UK into the queries: scores run in latent space
+    q_lat = jnp.einsum("bsnd,nkd->bsnk", q_nope.astype(jnp.float32),
+                       w_uk.astype(jnp.float32))
+    return q_lat, q_pe, c_kv, k_pe, w_uv
+
+
+def _cache_rows(cfg: ModelConfig, c_kv: jnp.ndarray, k_pe: jnp.ndarray):
+    """(k_new, v_new) for the generic paged write: slot 0 = latent,
+    slot 1 = rope key padded to the latent width. Both [B, S, 1, dkv]."""
+    pad = cfg.kv_lora_rank - cfg.qk_rope_head_dim
+    k_pe_padded = jnp.pad(k_pe, ((0, 0), (0, 0), (0, pad)))
+    return c_kv[:, :, None, :], k_pe_padded[:, :, None, :]
+
+
+def _mla_attend(cfg: ModelConfig, lp: Dict[str, jnp.ndarray],
+                h: jnp.ndarray, q_lat, q_pe, w_uv,
+                ckv_ctx: jnp.ndarray, kpe_ctx: jnp.ndarray,
+                positions: jnp.ndarray, total_lens: jnp.ndarray
+                ) -> jnp.ndarray:
+    """Latent-space attention + output projection residual.
+    ckv_ctx/kpe_ctx: [B, T, dkv] / [B, T, dr] gathered context."""
+    B, S, H = h.shape
+    sm_scale = (cfg.qk_nope_head_dim + cfg.qk_rope_head_dim) ** -0.5
+    T = ckv_ctx.shape[1]
+    scores = (jnp.einsum("bsnk,btk->bnst", q_lat,
+                         ckv_ctx.astype(jnp.float32))
+              + jnp.einsum("bsnd,btd->bnst", q_pe.astype(jnp.float32),
+                           kpe_ctx.astype(jnp.float32))) * sm_scale
+    t_pos = jnp.arange(T)[None, None, None, :]
+    mask = ((t_pos <= positions[:, None, :, None])
+            & (t_pos < total_lens[:, None, None, None]))
+    scores = jnp.where(mask, scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)                # [B,nh,S,T]
+    lat = jnp.einsum("bnst,btk->bsnk", probs,
+                     ckv_ctx.astype(jnp.float32))          # [B,S,nh,dkv]
+    out = jnp.einsum("bsnk,nkd->bsnd", lat, w_uv.astype(jnp.float32))
+    out = out.reshape(B, S, cfg.num_heads * cfg.v_head_dim).astype(h.dtype)
+    return h + out @ lp["wo"]
+
+
+def _gather_ctx(cfg: ModelConfig, gathered: jnp.ndarray):
+    """[B, P, 2, 1, ps, dkv] gathered pages -> latent/rope context."""
+    B, P, _two, _one, ps, dkv = gathered.shape
+    ckv = gathered[:, :, 0, 0].reshape(B, P * ps, dkv)
+    kpe = gathered[:, :, 1, 0].reshape(B, P * ps, dkv)[
+        ..., :cfg.qk_rope_head_dim]
+    return ckv, kpe
+
+
+# --------------------------------------------------------------------- MoE
+
+def _gate(cfg: ModelConfig, lp: Dict[str, jnp.ndarray], x: jnp.ndarray):
+    """HF-exact DeepSeek gate: f32 softmax scores, greedy or group-limited
+    top-k, scaled by routed_scaling_factor (no renorm)."""
+    scores = jax.nn.softmax(
+        (x.astype(jnp.float32) @ lp["w_router"].astype(jnp.float32)),
+        axis=-1)                                           # [B,S,E]
+    k = cfg.num_experts_per_tok
+    if cfg.topk_method == "group_limited_greedy":
+        B, S, E = scores.shape
+        g = cfg.n_group
+        group_scores = scores.reshape(B, S, g, E // g).max(axis=-1)
+        _gv, gi = jax.lax.top_k(group_scores, cfg.topk_group)
+        group_mask = jnp.sum(
+            jax.nn.one_hot(gi, g, dtype=scores.dtype), axis=2)  # [B,S,g]
+        score_mask = jnp.repeat(group_mask, E // g, axis=-1)
+        masked = jnp.where(score_mask > 0, scores, 0.0)
+        top_w, top_i = jax.lax.top_k(masked, k)
+    elif cfg.topk_method == "greedy":
+        top_w, top_i = jax.lax.top_k(scores, k)
+    else:
+        raise NotImplementedError(
+            f"topk_method {cfg.topk_method!r} (noaux_tc needs the "
+            "e_score_correction_bias weights — not wired yet)")
+    return top_w * cfg.routed_scaling_factor, top_i
+
+
+def _moe_mlp(cfg: ModelConfig, lp: Dict[str, jnp.ndarray],
+             x: jnp.ndarray) -> jnp.ndarray:
+    """Routed experts (dense-mask compute, ep-shardable) + shared experts."""
+    top_w, top_i = _gate(cfg, lp, x)
+    weights = jnp.sum(
+        jax.nn.one_hot(top_i, cfg.num_experts, dtype=jnp.float32)
+        * top_w[..., None], axis=2)                        # [B,S,E]
+    gate = jnp.einsum("bsh,ehi->bsei", x, lp["w_gate"])
+    up = jnp.einsum("bsh,ehi->bsei", x, lp["w_up"])
+    act = jax.nn.silu(gate) * up
+    routed = jnp.einsum("bse,bseh->bsh", weights.astype(x.dtype),
+                        jnp.einsum("bsei,eih->bseh", act, lp["w_down"]))
+    if cfg.n_shared_experts:
+        shared = (jax.nn.silu(x @ lp["ws_gate"])
+                  * (x @ lp["ws_up"])) @ lp["ws_down"]
+        routed = routed + shared
+    return routed
+
+
+def _dense_mlp(lp: Dict[str, jnp.ndarray], x: jnp.ndarray) -> jnp.ndarray:
+    return (jax.nn.silu(x @ lp["w_gate"]) * (x @ lp["w_up"])) @ lp["w_down"]
+
+
+# ----------------------------------------------------------------- forward
+
+def _layer_step(cfg: ModelConfig, lp, h, positions, total_lens, new_lens,
+                page_table, pages, lidx, *, moe: bool, layered: bool):
+    """One decoder layer against the paged latent cache. ``layered`` means
+    ``pages`` is the per-layer buffer (unrolled path) instead of the
+    stacked cache."""
+    q_lat, q_pe, c_kv, k_pe, w_uv = _mla_qkv(cfg, lp, h, positions)
+    k_new, v_new = _cache_rows(cfg, c_kv, k_pe)
+    if layered:
+        pages = write_kv_layer(pages, k_new, v_new, page_table, positions,
+                               new_lens)
+        gathered = pages[page_table]          # [B, P, 2, 1, ps, dkv]
+    else:
+        pages = write_kv(pages, lidx, k_new, v_new, page_table, positions,
+                         new_lens)
+        gathered = pages[lidx, page_table]
+    ckv_ctx, kpe_ctx = _gather_ctx(cfg, gathered)
+    h = _mla_attend(cfg, lp, h, q_lat, q_pe, w_uv, ckv_ctx, kpe_ctx,
+                    positions, total_lens)
+    x = _rms_norm(h, lp["mlp_norm"], cfg.rms_norm_eps)
+    h = h + (_moe_mlp(cfg, lp, x) if moe else _dense_mlp(lp, x))
+    return h, pages
+
+
+def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
+            positions: jnp.ndarray, pages: jnp.ndarray,
+            page_table: jnp.ndarray, total_lens: jnp.ndarray,
+            new_lens: jnp.ndarray,
+            attn_impl: Optional[Callable] = None
+            ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Scan forward (same contract as llama.forward). ``attn_impl`` is
+    IGNORED: MLA attention runs in latent space, which the GQA Pallas
+    kernels do not model — the XLA paths serve this family."""
+    del attn_impl
+    K = cfg.first_k_dense_replace
+    h = params["embed"][tokens]
+
+    def body(moe):
+        def step(carry, xs):
+            h, pages = carry
+            lp, lidx = xs
+            h, pages = _layer_step(cfg, lp, h, positions, total_lens,
+                                   new_lens, page_table, pages, lidx,
+                                   moe=moe, layered=False)
+            return (h, pages), None
+        return step
+
+    if K and "dense_layers" in params:
+        (h, pages), _ = jax.lax.scan(
+            body(False), (h, pages),
+            (params["dense_layers"], jnp.arange(K)))
+    if "moe_layers" in params:
+        (h, pages), _ = jax.lax.scan(
+            body(True), (h, pages),
+            (params["moe_layers"], K + jnp.arange(cfg.num_layers - K)))
+    return _logits(cfg, params, h, new_lens), pages
+
+
+def forward_unrolled(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
+                     positions: jnp.ndarray, pages_list: List[jnp.ndarray],
+                     page_table: jnp.ndarray, total_lens: jnp.ndarray,
+                     new_lens: jnp.ndarray,
+                     attn_impl: Optional[Callable] = None
+                     ) -> Tuple[jnp.ndarray, List[jnp.ndarray]]:
+    """Python-unrolled forward over per-layer latent buffers. ``attn_impl``
+    is IGNORED (see ``forward``)."""
+    del attn_impl
+    K = cfg.first_k_dense_replace
+    h = params["embed"][tokens]
+    out_pages: List[jnp.ndarray] = []
+    for l in range(cfg.num_layers):
+        moe = l >= K
+        stack = params["moe_layers"] if moe else params["dense_layers"]
+        li = l - K if moe else l
+        lp = {k: v[li] for k, v in stack.items()}
+        h, kv = _layer_step(cfg, lp, h, positions, total_lens, new_lens,
+                            page_table, pages_list[l], 0, moe=moe,
+                            layered=True)
+        out_pages.append(kv)
+    return _logits(cfg, params, h, new_lens), out_pages
+
+
+# ------------------------------------------------------------------ loader
+
+def load_params(cfg: ModelConfig, path: str,
+                shardings: Optional[Dict[str, Any]] = None) -> Params:
+    """Assemble the two-stack pytree from an HF deepseek checkpoint."""
+    from safetensors import safe_open
+
+    from dynamo_tpu.models.hf_loader import _checkpoint_files
+
+    K = cfg.first_k_dense_replace
+    attn = {
+        "input_layernorm.weight": ("attn_norm", False),
+        "self_attn.kv_a_proj_with_mqa.weight": ("wkv_a", True),
+        "self_attn.kv_a_layernorm.weight": ("kv_a_norm", False),
+        "self_attn.kv_b_proj.weight": ("wkv_b", True),
+        "self_attn.o_proj.weight": ("wo", True),
+        "post_attention_layernorm.weight": ("mlp_norm", False),
+    }
+    if cfg.q_lora_rank:
+        attn.update({
+            "self_attn.q_a_proj.weight": ("wq_a", True),
+            "self_attn.q_a_layernorm.weight": ("q_a_norm", False),
+            "self_attn.q_b_proj.weight": ("wq_b", True),
+        })
+    else:
+        attn["self_attn.q_proj.weight"] = ("wq", True)
+    dense_mlp = {
+        "mlp.gate_proj.weight": ("w_gate", True),
+        "mlp.up_proj.weight": ("w_up", True),
+        "mlp.down_proj.weight": ("w_down", True),
+    }
+    moe_mlp_names = {
+        "mlp.gate.weight": ("w_router", True),
+        "mlp.shared_experts.gate_proj.weight": ("ws_gate", True),
+        "mlp.shared_experts.up_proj.weight": ("ws_up", True),
+        "mlp.shared_experts.down_proj.weight": ("ws_down", True),
+    }
+    expert_names = {
+        "gate_proj.weight": "w_gate",
+        "up_proj.weight": "w_up",
+        "down_proj.weight": "w_down",
+    }
+    top = {
+        "model.embed_tokens.weight": (("embed",), False),
+        "model.norm.weight": (("final_norm",), False),
+    }
+    if not cfg.tie_word_embeddings:
+        top["lm_head.weight"] = (("lm_head",), True)
+
+    staged: Dict[tuple, Any] = {}
+    by_layer: Dict[Tuple[str, str], Dict[int, np.ndarray]] = {}
+    by_expert: Dict[str, Dict[Tuple[int, int], np.ndarray]] = {}
+    for f in _checkpoint_files(path):
+        with safe_open(f, framework="np") as sf:
+            for name in sf.keys():
+                if name in top:
+                    tree_path, tr = top[name]
+                    t = sf.get_tensor(name)
+                    staged[tree_path] = (np.ascontiguousarray(t.T)
+                                         if tr else t)
+                    continue
+                if not name.startswith("model.layers."):
+                    continue
+                rest = name[len("model.layers."):]
+                idx, _, tail = rest.partition(".")
+                layer = int(idx)
+                stack = "dense_layers" if layer < K else "moe_layers"
+                if tail in attn or (stack == "dense_layers"
+                                    and tail in dense_mlp) \
+                        or (stack == "moe_layers"
+                            and tail in moe_mlp_names):
+                    leaf, tr = (attn.get(tail) or dense_mlp.get(tail)
+                                or moe_mlp_names.get(tail))
+                    t = sf.get_tensor(name)
+                    if tr:
+                        t = np.ascontiguousarray(t.T)
+                    by_layer.setdefault((stack, leaf), {})[layer] = t
+                    continue
+                if tail.startswith("mlp.experts."):
+                    sub = tail[len("mlp.experts."):]
+                    j, _, wname = sub.partition(".")
+                    leaf = expert_names.get(wname)
+                    if leaf is not None:
+                        t = np.ascontiguousarray(sf.get_tensor(name).T)
+                        by_expert.setdefault(leaf, {})[
+                            (layer, int(j))] = t
+
+    for (stack, leaf), d in by_layer.items():
+        if stack == "dense_layers":
+            idxs = list(range(K))
+        else:
+            idxs = list(range(K, cfg.num_layers))
+        missing = set(idxs) - set(d)
+        if missing:
+            raise ValueError(f"missing layers {sorted(missing)} for "
+                             f"{stack}.{leaf}")
+        staged[(stack, leaf)] = np.stack([d[i] for i in idxs])
+    for leaf, d in by_expert.items():
+        staged[("moe_layers", leaf)] = np.stack([
+            np.stack([d[(i, j)] for j in range(cfg.num_experts)])
+            for i in range(K, cfg.num_layers)])
+
+    params: Params = {}
+    dtype = jnp.dtype(cfg.dtype)
+    for tree_path, arr in staged.items():
+        node = params
+        for k in tree_path[:-1]:
+            node = node.setdefault(k, {})
+        leaf = jnp.asarray(arr).astype(dtype)
+        if shardings is not None:
+            spec = shardings
+            for k in tree_path:
+                spec = spec.get(k) if isinstance(spec, dict) else None
+                if spec is None:
+                    break
+            if spec is not None:
+                leaf = jax.device_put(leaf, spec)
+        node[tree_path[-1]] = leaf
+    return params
+
+
+__all__ = ["init_params", "forward", "forward_unrolled", "load_params",
+           "rope_interleaved", "make_pages", "make_pages_list"]

@@ -144,6 +144,11 @@ def load_hf_params(cfg: ModelConfig, path: str,
     """Assemble the param pytree from an HF checkpoint directory."""
     if safe_open is None:  # pragma: no cover
         raise RuntimeError("safetensors not available")
+    if cfg.kv_lora_rank:
+        # deepseek MLA: heterogeneous layer stacks (dense + MoE) use a
+        # dedicated loader (models/deepseek.py)
+        from dynamo_tpu.models.deepseek import load_params
+        return load_params(cfg, path, shardings)
     patterns = _name_map(cfg)
     staged: Dict[tuple, Any] = {}
     per_layer: Dict[tuple, Dict[int, np.ndarray]] = {}

@@ -79,14 +79,16 @@ def moe_mlp_dispatch(cfg: ModelConfig, lp: Dict[str, jnp.ndarray],
     per token, which is what makes wide-EP (DeepSeek-R1/Mixtral-class
     expert counts) credible. Reference role: SGLang DeepEP wide-EP
     (``components/backends/sglang/docs/dsr1-wideep-h100.md``); here the
-    dispatch/combine are einsums against one-hot capacity assignments, so
-    under GSPMD the expert axis shards over ``ep`` and XLA lowers the
-    gathers to all-to-alls on ICI.
+    dispatch is a stable sort by expert + capacity-slot scatter/gather.
 
     Tokens routed past an expert's capacity are dropped for that expert
     (combine weight zero) — standard overflow semantics; raise
     ``cfg.moe_capacity_factor`` to make drops impossible at a given batch.
     x: [B, S, H] (already normed) -> [B, S, H].
+
+    Under GSPMD the expert-buffer gather/scatter and the [E, C, H]
+    expert einsums shard over ``ep`` (XLA lowers the cross-shard moves to
+    all-to-alls on ICI).
     """
     B, S, H = x.shape
     T = B * S
@@ -96,27 +98,38 @@ def moe_mlp_dispatch(cfg: ModelConfig, lp: Dict[str, jnp.ndarray],
     xt = x.reshape(T, H)
     top_w, top_i = _router_topk(cfg, lp, xt)              # [T, k]
 
-    # position-in-expert by running counts (slot-major priority: slot 0
-    # assignments claim capacity before slot 1, ties by token order)
-    counts = jnp.zeros((E,), jnp.int32)
-    combine = jnp.zeros((T, E, C), jnp.float32)
-    for j in range(k):
-        m = jax.nn.one_hot(top_i[:, j], E, dtype=jnp.int32)   # [T, E]
-        pos = jnp.cumsum(m, axis=0) - 1 + counts[None, :]     # [T, E]
-        counts = counts + jnp.sum(m, axis=0)
-        keep = (pos < C) & (m > 0)                            # [T, E]
-        oh = jax.nn.one_hot(jnp.clip(pos, 0, C - 1), C,
-                            dtype=jnp.float32)                # [T, E, C]
-        combine = combine + jnp.where(
-            keep[..., None], oh * top_w[:, j, None, None], 0.0)
+    # Sort-based dispatch — memory LINEAR in tokens (a one-hot [T, E, C]
+    # combine tensor is O(T^2 k cf / E): ~GBs at prefill chunk sizes).
+    # Assignments group by expert via a stable argsort; each one's rank
+    # inside its expert group is its capacity slot, ranks >= C drop
+    # (token-major priority within an expert: earlier tokens win).
+    A = T * k
+    flat_e = top_i.reshape(A)
+    flat_w = top_w.reshape(A).astype(jnp.float32)
+    flat_t = jnp.repeat(jnp.arange(T), k)
+    order = jnp.argsort(flat_e, stable=True)
+    sorted_e = flat_e[order]
+    sorted_t = flat_t[order]
+    sorted_w = flat_w[order]
+    counts = jnp.zeros((E,), jnp.int32).at[flat_e].add(1)
+    starts = jnp.cumsum(counts) - counts                  # [E]
+    rank = jnp.arange(A) - starts[sorted_e]
+    keep = rank < C
+    # overflow assignments route to a trash row past the expert buffers
+    dest = jnp.where(keep, sorted_e * C + rank, E * C)
 
-    dispatch = (combine > 0).astype(x.dtype)                  # [T, E, C]
-    xe = jnp.einsum("tec,th->ech", dispatch, xt)              # [E, C, H]
+    xe = jnp.zeros((E * C + 1, H), x.dtype).at[dest].set(xt[sorted_t])
+    xe = xe[:E * C].reshape(E, C, H)                      # [E, C, H]
     gate = jnp.einsum("ech,ehi->eci", xe, lp["w_gate"])
     up = jnp.einsum("ech,ehi->eci", xe, lp["w_up"])
     ye = jnp.einsum("eci,eih->ech", jax.nn.silu(gate) * up,
-                    lp["w_down"])                             # [E, C, H]
-    out = jnp.einsum("tec,ech->th", combine.astype(ye.dtype), ye)
+                    lp["w_down"])                         # [E, C, H]
+
+    ye_flat = jnp.concatenate(
+        [ye.reshape(E * C, H).astype(jnp.float32),
+         jnp.zeros((1, H), jnp.float32)])                 # trash row = 0
+    contrib = ye_flat[dest] * sorted_w[:, None]           # [A, H]
+    out = jnp.zeros((T, H), jnp.float32).at[sorted_t].add(contrib)
     return out.reshape(B, S, H).astype(x.dtype)
 
 

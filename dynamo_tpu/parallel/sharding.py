@@ -45,10 +45,17 @@ class ModelSharding:
         tp = mesh.shape.get("tp", 1)
         ep = mesh.shape.get("ep", 1)
         if tp > 1:
-            if cfg.num_kv_heads % tp:
+            if cfg.kv_lora_rank:
+                # MLA: tp splits the QUERY heads (the latent cache is
+                # shared/replicated), so num_heads is the constraint
+                if cfg.num_heads % tp:
+                    raise ValueError(
+                        f"num_heads={cfg.num_heads} not divisible by "
+                        f"tp={tp}")
+            elif cfg.num_kv_heads % tp:
                 raise ValueError(
                     f"num_kv_heads={cfg.num_kv_heads} not divisible by tp={tp}")
-            if cfg.intermediate_size % tp:
+            if not cfg.kv_lora_rank and cfg.intermediate_size % tp:
                 raise ValueError(
                     f"intermediate_size={cfg.intermediate_size} not divisible "
                     f"by tp={tp}")
@@ -59,6 +66,8 @@ class ModelSharding:
     # -- specs -------------------------------------------------------------
 
     def param_specs(self) -> Dict[str, Any]:
+        if self.cfg.kv_lora_rank:
+            return self._deepseek_specs()
         layers = {
             "attn_norm": P(),
             "wq": P(None, None, "tp"),
@@ -97,12 +106,61 @@ class ModelSharding:
                                 if self.cfg.vocab_size % tp == 0 else P())
         return specs
 
+    def _deepseek_specs(self) -> Dict[str, Any]:
+        """MLA (deepseek) pytree: HEAD-carrying projections shard their
+        head-packed dim over tp (wq/wq_b/wkv_b outputs, wo input) — under
+        GSPMD the whole latent attention then runs head-local per chip
+        with one psum after wo; the latent path (wkv_a/kv_a_norm) and the
+        shared-per-token cache replicate over tp. Routed experts shard
+        over ep, shared experts' ffn width over tp."""
+        attn = {
+            "attn_norm": P(),
+            "wkv_a": P(),
+            "kv_a_norm": P(),
+            "wkv_b": P(None, None, "tp"),
+            "wo": P(None, "tp", None),
+            "mlp_norm": P(),
+            "wq": P(None, None, "tp"),
+            "wq_a": P(),
+            "q_a_norm": P(),
+            "wq_b": P(None, None, "tp"),
+        }
+        dense = dict(attn)
+        dense.update(w_gate=P(None, None, "tp"), w_up=P(None, None, "tp"),
+                     w_down=P(None, "tp", None))
+        moe = dict(attn)
+        moe.update(
+            w_router=P(),
+            w_gate=P(None, "ep", None, "tp"),
+            w_up=P(None, "ep", None, "tp"),
+            w_down=P(None, "ep", "tp", None),
+            ws_gate=P(None, None, "tp"),
+            ws_up=P(None, None, "tp"),
+            ws_down=P(None, "tp", None),
+        )
+        specs: Dict[str, Any] = {
+            "embed": P(),
+            "final_norm": P(),
+            "dense_layers": dense,
+            "moe_layers": moe,
+        }
+        if not self.cfg.tie_word_embeddings:
+            tp = self.mesh.shape.get("tp", 1)
+            specs["lm_head"] = (P(None, "tp")
+                                if self.cfg.vocab_size % tp == 0 else P())
+        return specs
+
     def pages_spec(self) -> P:
-        """Stacked cache [L, N, 2, Hkv, page, Dh]: Hkv over tp."""
+        """Stacked cache [L, N, 2, Hkv, page, Dh]: Hkv over tp (MLA: the
+        latent is shared across heads — replicated)."""
+        if self.cfg.kv_lora_rank:
+            return P()
         return P(None, None, None, "tp", None, None)
 
     def pages_layer_spec(self) -> P:
         """Per-layer cache [N, 2, Hkv, page, Dh]: Hkv over tp."""
+        if self.cfg.kv_lora_rank:
+            return P()
         return P(None, None, "tp", None, None)
 
     # -- application -------------------------------------------------------
