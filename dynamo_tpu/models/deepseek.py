@@ -243,14 +243,27 @@ def _cache_rows(cfg: ModelConfig, c_kv: jnp.ndarray, k_pe: jnp.ndarray):
     return c_kv[:, :, None, :], k_pe_padded[:, :, None, :]
 
 
+# pages per streamed chunk on the blockwise path (matches ops/attention)
+PAGES_PER_CHUNK = 8
+
+
+def _expand_and_project(cfg: ModelConfig, lp, h, lat, w_uv) -> jnp.ndarray:
+    """lat [B,S,nh,dkv] latent attention output -> W_UV expand -> wo
+    residual."""
+    B, S, H = h.shape
+    out = jnp.einsum("bsnk,nkd->bsnd", lat, w_uv.astype(jnp.float32))
+    out = out.reshape(B, S, cfg.num_heads * cfg.v_head_dim).astype(h.dtype)
+    return h + out @ lp["wo"]
+
+
 def _mla_attend(cfg: ModelConfig, lp: Dict[str, jnp.ndarray],
                 h: jnp.ndarray, q_lat, q_pe, w_uv,
                 ckv_ctx: jnp.ndarray, kpe_ctx: jnp.ndarray,
                 positions: jnp.ndarray, total_lens: jnp.ndarray
                 ) -> jnp.ndarray:
-    """Latent-space attention + output projection residual.
+    """Latent-space attention + output projection residual (direct path:
+    decode steps / small tables — the full [B,nh,S,T] scores fit).
     ckv_ctx/kpe_ctx: [B, T, dkv] / [B, T, dr] gathered context."""
-    B, S, H = h.shape
     sm_scale = (cfg.qk_nope_head_dim + cfg.qk_rope_head_dim) ** -0.5
     T = ckv_ctx.shape[1]
     scores = (jnp.einsum("bsnk,btk->bnst", q_lat,
@@ -264,9 +277,56 @@ def _mla_attend(cfg: ModelConfig, lp: Dict[str, jnp.ndarray],
     probs = jax.nn.softmax(scores, axis=-1)                # [B,nh,S,T]
     lat = jnp.einsum("bnst,btk->bsnk", probs,
                      ckv_ctx.astype(jnp.float32))          # [B,S,nh,dkv]
-    out = jnp.einsum("bsnk,nkd->bsnd", lat, w_uv.astype(jnp.float32))
-    out = out.reshape(B, S, cfg.num_heads * cfg.v_head_dim).astype(h.dtype)
-    return h + out @ lp["wo"]
+    return _expand_and_project(cfg, lp, h, lat, w_uv)
+
+
+def _mla_attend_blockwise(cfg: ModelConfig, lp, h, q_lat, q_pe, w_uv,
+                          gather_chunk, num_table_pages: int, ps: int,
+                          positions: jnp.ndarray, total_lens: jnp.ndarray
+                          ) -> jnp.ndarray:
+    """Flash-style chunked latent attention for prefill (S > 1): the
+    context streams in page chunks with an online softmax, so the peak
+    intermediate is ``[B, nh, S, span]`` scores + a fixed
+    ``[B, nh, S, dkv]`` latent accumulator regardless of context length —
+    the full-gather path's ``[B, nh, S, T]`` scores are GBs per layer at
+    DeepSeek-V3 head counts (same failure mode
+    ``ops/attention._attend_blockwise`` exists for)."""
+    B, S, H = h.shape
+    nh, dkv = cfg.num_heads, cfg.kv_lora_rank
+    sm_scale = (cfg.qk_nope_head_dim + cfg.qk_rope_head_dim) ** -0.5
+    span = PAGES_PER_CHUNK * ps
+    n_static = -(-num_table_pages // PAGES_PER_CHUNK)
+    n_chunks = jnp.minimum(
+        (jnp.max(total_lens) + span - 1) // span, n_static)
+    q_pe32 = q_pe.astype(jnp.float32)
+
+    def body(c, carry):
+        num, den, mx = carry
+        ckv, kpe = gather_chunk(c)            # [B, span, dkv] / [B, span, dr]
+        s = (jnp.einsum("bsnk,btk->bnst", q_lat, ckv.astype(jnp.float32))
+             + jnp.einsum("bsnd,btd->bnst", q_pe32,
+                          kpe.astype(jnp.float32))) * sm_scale
+        t_pos = c * span + jnp.arange(span)
+        mask = ((t_pos[None, None, None, :] <= positions[:, None, :, None])
+                & (t_pos[None, None, None, :]
+                   < total_lens[:, None, None, None]))
+        s = jnp.where(mask, s, NEG_INF)
+        mx_new = jnp.maximum(mx, jnp.max(s, axis=-1))      # [B,nh,S]
+        p = jnp.exp(s - mx_new[..., None])
+        p = jnp.where((mx_new > NEG_INF / 2)[..., None], p, 0.0)
+        scale = jnp.where(mx > NEG_INF / 2, jnp.exp(mx - mx_new), 0.0)
+        pv = jnp.einsum("bnst,btk->bnsk", p, ckv.astype(jnp.float32))
+        num = num * scale[..., None] + pv
+        den = den * scale + jnp.sum(p, axis=-1)
+        return num, den, mx_new
+
+    num0 = jnp.zeros((B, nh, S, dkv), jnp.float32)
+    den0 = jnp.zeros((B, nh, S), jnp.float32)
+    mx0 = jnp.full((B, nh, S), NEG_INF, jnp.float32)
+    num, den, _mx = jax.lax.fori_loop(0, n_chunks, body, (num0, den0, mx0))
+    lat = (num / jnp.maximum(den, 1e-20)[..., None]) \
+        .transpose(0, 2, 1, 3)                             # [B,S,nh,dkv]
+    return _expand_and_project(cfg, lp, h, lat, w_uv)
 
 
 def _gather_ctx(cfg: ModelConfig, gathered: jnp.ndarray):
@@ -308,16 +368,29 @@ def _gate(cfg: ModelConfig, lp: Dict[str, jnp.ndarray], x: jnp.ndarray):
 
 def _moe_mlp(cfg: ModelConfig, lp: Dict[str, jnp.ndarray],
              x: jnp.ndarray) -> jnp.ndarray:
-    """Routed experts (dense-mask compute, ep-shardable) + shared experts."""
+    """Routed experts + shared experts. ``cfg.moe_backend`` picks the
+    routed compute: dense-mask (every expert, decode-batch default) or the
+    capacity-factor token dispatch (``models/moe.py expert_dispatch`` —
+    the wide-EP path that makes 256-expert DeepSeek-V3 credible)."""
     top_w, top_i = _gate(cfg, lp, x)
-    weights = jnp.sum(
-        jax.nn.one_hot(top_i, cfg.num_experts, dtype=jnp.float32)
-        * top_w[..., None], axis=2)                        # [B,S,E]
-    gate = jnp.einsum("bsh,ehi->bsei", x, lp["w_gate"])
-    up = jnp.einsum("bsh,ehi->bsei", x, lp["w_up"])
-    act = jax.nn.silu(gate) * up
-    routed = jnp.einsum("bse,bseh->bsh", weights.astype(x.dtype),
-                        jnp.einsum("bsei,eih->bseh", act, lp["w_down"]))
+    if cfg.moe_backend == "dispatch":
+        from dynamo_tpu.models.moe import expert_dispatch
+        B, S, H = x.shape
+        routed = expert_dispatch(
+            x.reshape(B * S, H), top_w.reshape(B * S, -1),
+            top_i.reshape(B * S, -1), lp["w_gate"], lp["w_up"],
+            lp["w_down"], cfg.num_experts,
+            cfg.moe_capacity_factor).reshape(B, S, H).astype(x.dtype)
+    else:
+        weights = jnp.sum(
+            jax.nn.one_hot(top_i, cfg.num_experts, dtype=jnp.float32)
+            * top_w[..., None], axis=2)                    # [B,S,E]
+        gate = jnp.einsum("bsh,ehi->bsei", x, lp["w_gate"])
+        up = jnp.einsum("bsh,ehi->bsei", x, lp["w_up"])
+        act = jax.nn.silu(gate) * up
+        routed = jnp.einsum("bse,bseh->bsh", weights.astype(x.dtype),
+                            jnp.einsum("bsei,eih->bseh", act,
+                                       lp["w_down"]))
     if cfg.n_shared_experts:
         shared = (jax.nn.silu(x @ lp["ws_gate"])
                   * (x @ lp["ws_up"])) @ lp["ws_down"]
@@ -336,19 +409,38 @@ def _layer_step(cfg: ModelConfig, lp, h, positions, total_lens, new_lens,
     """One decoder layer against the paged latent cache. ``layered`` means
     ``pages`` is the per-layer buffer (unrolled path) instead of the
     stacked cache."""
+    from dynamo_tpu.ops.attention import _pad_table
+
     q_lat, q_pe, c_kv, k_pe, w_uv = _mla_qkv(cfg, lp, h, positions)
     k_new, v_new = _cache_rows(cfg, c_kv, k_pe)
     if layered:
         pages = write_kv_layer(pages, k_new, v_new, page_table, positions,
                                new_lens)
-        gathered = pages[page_table]          # [B, P, 2, 1, ps, dkv]
     else:
         pages = write_kv(pages, lidx, k_new, v_new, page_table, positions,
                          new_lens)
-        gathered = pages[lidx, page_table]
-    ckv_ctx, kpe_ctx = _gather_ctx(cfg, gathered)
-    h = _mla_attend(cfg, lp, h, q_lat, q_pe, w_uv, ckv_ctx, kpe_ctx,
-                    positions, total_lens)
+    S = h.shape[1]
+    P = page_table.shape[1]
+    ps = pages.shape[-2]
+    if S > 1 and P > PAGES_PER_CHUNK:
+        table = _pad_table(page_table, PAGES_PER_CHUNK)
+
+        def gather_chunk(c):
+            tbl = jax.lax.dynamic_slice(
+                table, (0, c * PAGES_PER_CHUNK),
+                (table.shape[0], PAGES_PER_CHUNK))
+            g = pages[tbl] if layered else pages[lidx, tbl]
+            return _gather_ctx(cfg, g)
+
+        h = _mla_attend_blockwise(cfg, lp, h, q_lat, q_pe, w_uv,
+                                  gather_chunk, P, ps, positions,
+                                  total_lens)
+    else:
+        gathered = (pages[page_table] if layered
+                    else pages[lidx, page_table])
+        ckv_ctx, kpe_ctx = _gather_ctx(cfg, gathered)
+        h = _mla_attend(cfg, lp, h, q_lat, q_pe, w_uv, ckv_ctx, kpe_ctx,
+                        positions, total_lens)
     x = _rms_norm(h, lp["mlp_norm"], cfg.rms_norm_eps)
     h = h + (_moe_mlp(cfg, lp, x) if moe else _dense_mlp(lp, x))
     return h, pages
@@ -510,6 +602,13 @@ def load_params(cfg: ModelConfig, path: str,
                              f"{stack}.{leaf}")
         staged[(stack, leaf)] = np.stack([d[i] for i in idxs])
     for leaf, d in by_expert.items():
+        want = {(i, j) for i in range(K, cfg.num_layers)
+                for j in range(cfg.num_experts)}
+        missing = want - set(d)
+        if missing:
+            raise ValueError(
+                f"checkpoint missing {len(missing)} expert tensors for "
+                f"moe_layers.{leaf} (e.g. {sorted(missing)[:3]})")
         staged[("moe_layers", leaf)] = np.stack([
             np.stack([d[(i, j)] for j in range(cfg.num_experts)])
             for i in range(K, cfg.num_layers)])

@@ -153,13 +153,16 @@ def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
             new_lens: jnp.ndarray,
             attn_impl: Optional[Callable] = None
             ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Scan-over-layers forward. ``attn_impl`` is IGNORED: the Pallas decode
-    kernel implements neither soft-capping nor sliding windows, so gemma
-    always takes the XLA attention paths."""
-    del attn_impl
+    """Scan-over-layers forward. ``attn_impl`` is honored only when it
+    advertises ``supports_window_softcap`` (the stacked Pallas DECODE
+    kernel carries gemma's per-layer sliding window + logit soft-capping;
+    the prefill kernel does not) — otherwise the XLA paths serve, with
+    identical math."""
+    if not getattr(attn_impl, "supports_window_softcap", False):
+        attn_impl = None
+    attn_impl = attn_impl or paged_attention
     sm_scale = _sm_scale(cfg)
-    softcap = (jnp.asarray(cfg.attn_logit_softcap, jnp.float32)
-               if cfg.attn_logit_softcap else None)
+    softcap = cfg.attn_logit_softcap or None  # static: both paths accept
     windows = layer_windows(cfg)
     h = _embed(cfg, params, tokens)
 
@@ -168,9 +171,9 @@ def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
         lp, lidx, win = xs
         q, k, v = _project_qkv(cfg, lp, h, positions)
         pages = write_kv(pages, lidx, k, v, page_table, positions, new_lens)
-        attn = paged_attention(q, pages, lidx, page_table, positions,
-                               total_lens, sm_scale, window=win,
-                               softcap=softcap)
+        attn = attn_impl(q, pages, lidx, page_table, positions,
+                         total_lens, sm_scale, window=win,
+                         softcap=softcap)
         h = _finish_layer(cfg, lp, h, attn)
         return (h, pages), None
 

@@ -91,18 +91,32 @@ def moe_mlp_dispatch(cfg: ModelConfig, lp: Dict[str, jnp.ndarray],
     all-to-alls on ICI).
     """
     B, S, H = x.shape
-    T = B * S
-    E, k = cfg.num_experts, cfg.num_experts_per_tok
-    import math
-    C = max(1, min(T, math.ceil(T * k * cfg.moe_capacity_factor / E)))
-    xt = x.reshape(T, H)
+    xt = x.reshape(B * S, H)
     top_w, top_i = _router_topk(cfg, lp, xt)              # [T, k]
+    out = expert_dispatch(xt, top_w, top_i, lp["w_gate"], lp["w_up"],
+                          lp["w_down"], cfg.num_experts,
+                          cfg.moe_capacity_factor)
+    return out.reshape(B, S, H).astype(x.dtype)
 
-    # Sort-based dispatch — memory LINEAR in tokens (a one-hot [T, E, C]
-    # combine tensor is O(T^2 k cf / E): ~GBs at prefill chunk sizes).
-    # Assignments group by expert via a stable argsort; each one's rank
-    # inside its expert group is its capacity slot, ranks >= C drop
-    # (token-major priority within an expert: earlier tokens win).
+
+def expert_dispatch(xt: jnp.ndarray, top_w: jnp.ndarray,
+                    top_i: jnp.ndarray, w_gate, w_up, w_down,
+                    num_experts: int,
+                    capacity_factor: float) -> jnp.ndarray:
+    """Sort-based capacity dispatch core (routing-agnostic — the deepseek
+    family reuses it with its own gate). Memory LINEAR in tokens (a
+    one-hot [T, E, C] combine tensor is O(T^2 k cf / E): ~GBs at prefill
+    chunk sizes). Assignments group by expert via a stable argsort; each
+    one's rank inside its expert group is its capacity slot, ranks >= C
+    drop (token-major priority within an expert: earlier tokens win).
+
+    xt [T, H]; top_w/top_i [T, k]; expert weights [E, H, I]/[E, I, H].
+    Returns [T, H] float32 (caller casts)."""
+    import math
+    T, H = xt.shape
+    E = num_experts
+    k = top_i.shape[1]
+    C = max(1, min(T, math.ceil(T * k * capacity_factor / E)))
     A = T * k
     flat_e = top_i.reshape(A)
     flat_w = top_w.reshape(A).astype(jnp.float32)
@@ -118,19 +132,18 @@ def moe_mlp_dispatch(cfg: ModelConfig, lp: Dict[str, jnp.ndarray],
     # overflow assignments route to a trash row past the expert buffers
     dest = jnp.where(keep, sorted_e * C + rank, E * C)
 
-    xe = jnp.zeros((E * C + 1, H), x.dtype).at[dest].set(xt[sorted_t])
+    xe = jnp.zeros((E * C + 1, H), xt.dtype).at[dest].set(xt[sorted_t])
     xe = xe[:E * C].reshape(E, C, H)                      # [E, C, H]
-    gate = jnp.einsum("ech,ehi->eci", xe, lp["w_gate"])
-    up = jnp.einsum("ech,ehi->eci", xe, lp["w_up"])
+    gate = jnp.einsum("ech,ehi->eci", xe, w_gate)
+    up = jnp.einsum("ech,ehi->eci", xe, w_up)
     ye = jnp.einsum("eci,eih->ech", jax.nn.silu(gate) * up,
-                    lp["w_down"])                         # [E, C, H]
+                    w_down)                               # [E, C, H]
 
     ye_flat = jnp.concatenate(
         [ye.reshape(E * C, H).astype(jnp.float32),
          jnp.zeros((1, H), jnp.float32)])                 # trash row = 0
     contrib = ye_flat[dest] * sorted_w[:, None]           # [A, H]
-    out = jnp.zeros((T, H), jnp.float32).at[sorted_t].add(contrib)
-    return out.reshape(B, S, H).astype(x.dtype)
+    return jnp.zeros((T, H), jnp.float32).at[sorted_t].add(contrib)
 
 
 def _moe_layer_tail(cfg: ModelConfig, lp: Dict[str, jnp.ndarray],
@@ -214,4 +227,4 @@ def forward_unrolled(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
 
 
 __all__ = ["forward", "forward_unrolled", "init_params", "moe_mlp",
-           "moe_mlp_dispatch"]
+           "moe_mlp_dispatch", "expert_dispatch"]

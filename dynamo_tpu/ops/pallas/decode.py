@@ -66,8 +66,9 @@ def _resolve_interpret(interpret) -> bool:
     return jax.default_backend() not in ("tpu", "axon")
 
 
-def _decode_kernel(q_ref, kv_hbm, layer_ref, table_ref, lens_ref, out_ref,
-                   buf, sem, *, page_size: int, n_kv: int, chunk: int):
+def _decode_kernel(q_ref, kv_hbm, layer_ref, window_ref, table_ref,
+                   lens_ref, out_ref, buf, sem, *, page_size: int,
+                   n_kv: int, chunk: int, softcap: float):
     """One program per sequence: stream page chunks, online-softmax attend.
 
     kv_hbm is the STACKED cache ``[L, N, 2, Hkv, ps, Dh]`` and ``layer_ref``
@@ -84,12 +85,21 @@ def _decode_kernel(q_ref, kv_hbm, layer_ref, table_ref, lens_ref, out_ref,
     merged [Hkv, span, Dh] layout the matmuls want (no in-kernel transpose,
     and Mosaic's matmul only takes a single contracting dim).
     sem: [2, chunk] DMA semaphores (slot, page-in-chunk).
+
+    ``window_ref`` (SMEM scalar, 0 = unlimited) restricts the query to the
+    last ``window`` kv positions (gemma-2 alternating sliding-window
+    layers) — chunks wholly before the window are never even DMA'd.
+    ``softcap`` (static; 0 = disabled) applies gemma-style logit
+    soft-capping ``cap * tanh(s / cap)`` before the softmax.
     """
     b = pl.program_id(0)
     layer = layer_ref[0]
+    win = window_ref[0]
     ctx = lens_ref[b]
     num_pages = jax.lax.div(ctx + page_size - 1, page_size)
     num_chunks = jax.lax.div(num_pages + chunk - 1, chunk)
+    # first kv position the (single, at ctx-1) query can see
+    first_pos = jnp.where(win > 0, jnp.maximum(ctx - win, 0), 0)
 
     Hq, Dh = q_ref.shape[1], q_ref.shape[2]
     G = Hq // n_kv
@@ -125,9 +135,9 @@ def _decode_kernel(q_ref, kv_hbm, layer_ref, table_ref, lens_ref, out_ref,
 
         jax.lax.fori_loop(0, chunk, wait_one, 0, unroll=True)
 
-    start_chunk(0, 0)
-
     span = chunk * page_size
+    c0 = jax.lax.div(first_pos, span)  # skip chunks before the window
+    start_chunk(jax.lax.rem(c0, 2), c0)
 
     def body(c, carry):
         m, l, acc = carry
@@ -145,12 +155,18 @@ def _decode_kernel(q_ref, kv_hbm, layer_ref, table_ref, lens_ref, out_ref,
         s = jax.lax.dot_general(
             q, k, (((2,), (2,)), ((0,), (0,))),
             preferred_element_type=jnp.float32)
+        if softcap:
+            s = jnp.tanh(s / softcap) * softcap
         pos = c * span + jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
-        s = jnp.where(pos < ctx, s, NEG_INF)
+        s = jnp.where((pos < ctx) & (pos >= first_pos), s, NEG_INF)
 
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))        # [Hkv, G]
         p = jnp.exp(s - m_new[..., None])
-        scale = jnp.exp(m - m_new)
+        # a fully-masked first chunk would leave m at -inf and leak
+        # exp(0)=1 weights — zero those rows (cannot happen without a
+        # window, where chunk c0=0 always holds position 0)
+        p = jnp.where((m_new > NEG_INF / 2)[..., None], p, 0.0)
+        scale = jnp.where(m > NEG_INF / 2, jnp.exp(m - m_new), 0.0)
         l = l * scale + jnp.sum(p, axis=-1)
         # PV [Hkv, G, Dh]: batch Hkv, contract span
         pv = jax.lax.dot_general(
@@ -162,27 +178,30 @@ def _decode_kernel(q_ref, kv_hbm, layer_ref, table_ref, lens_ref, out_ref,
     m0 = jnp.full((n_kv, G), NEG_INF, jnp.float32)
     l0 = jnp.zeros((n_kv, G), jnp.float32)
     acc0 = jnp.zeros((n_kv, G, Dh), jnp.float32)
-    _m, l, acc = jax.lax.fori_loop(0, num_chunks, body, (m0, l0, acc0))
+    _m, l, acc = jax.lax.fori_loop(c0, num_chunks, body, (m0, l0, acc0))
     out = acc / jnp.maximum(l, 1e-20)[..., None]
     out_ref[0] = out.reshape(Hq, Dh).astype(out_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("sm_scale", "interpret"))
-def _paged_decode(q, kv_pages, layer_idx, page_table, total_lens,
-                  sm_scale: float, interpret: bool = False):
+@functools.partial(jax.jit,
+                   static_argnames=("sm_scale", "softcap", "interpret"))
+def _paged_decode(q, kv_pages, layer_idx, window, page_table, total_lens,
+                  sm_scale: float, softcap: float = 0.0,
+                  interpret: bool = False):
     B, Hq, Dh = q.shape
     _L, _N, _two, Hkv, page_size, _ = kv_pages.shape
     P = page_table.shape[1]
     chunk = min(PAGES_PER_CHUNK, P)
 
     kernel = functools.partial(_decode_kernel, page_size=page_size,
-                               n_kv=Hkv, chunk=chunk)
+                               n_kv=Hkv, chunk=chunk, softcap=softcap)
     return pl.pallas_call(
         kernel,
         grid=(B,),
         in_specs=[
             pl.BlockSpec((1, Hq, Dh), lambda b: (b, 0, 0)),
             pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec(memory_space=pltpu.SMEM),
@@ -194,8 +213,8 @@ def _paged_decode(q, kv_pages, layer_idx, page_table, total_lens,
         ],
         out_shape=jax.ShapeDtypeStruct((B, Hq, Dh), q.dtype),
         interpret=interpret,
-    )((q * sm_scale).astype(q.dtype), kv_pages, layer_idx, page_table,
-      total_lens)
+    )((q * sm_scale).astype(q.dtype), kv_pages, layer_idx, window,
+      page_table, total_lens)
 
 
 def paged_decode_attention(q: jnp.ndarray, kv_layer: jnp.ndarray,
@@ -214,6 +233,7 @@ def paged_decode_attention(q: jnp.ndarray, kv_layer: jnp.ndarray,
         raise ValueError(f"decode kernel requires S=1, got S={S}")
     out = _paged_decode(q[:, 0], kv_layer[None],
                         jnp.zeros((1,), jnp.int32),
+                        jnp.zeros((1,), jnp.int32),
                         page_table.astype(jnp.int32),
                         total_lens.astype(jnp.int32), sm_scale,
                         interpret=_resolve_interpret(interpret))
@@ -224,6 +244,7 @@ def paged_decode_attention_stacked(q: jnp.ndarray, pages: jnp.ndarray,
                                    layer_idx, page_table: jnp.ndarray,
                                    positions: jnp.ndarray,
                                    total_lens: jnp.ndarray, sm_scale: float,
+                                   window=None, softcap=None,
                                    interpret: bool | None = None
                                    ) -> jnp.ndarray:
     """Drop-in for ``ops.attention.paged_attention`` when S == 1: the whole
@@ -236,16 +257,27 @@ def paged_decode_attention_stacked(q: jnp.ndarray, pages: jnp.ndarray,
     layer_idx:  scalar int (python int or traced scan index)
     page_table: [B, P]
     total_lens: [B] context length including the query token
+    window:     optional scalar (python int or traced, 0 = unlimited) —
+                gemma-2 alternating sliding-window layers
+    softcap:    optional STATIC float (gemma logit soft-capping)
     """
     B, S, Hq, Dh = q.shape
     if S != 1:
         raise ValueError(f"decode kernel requires S=1, got S={S}")
     layer = jnp.asarray(layer_idx, jnp.int32).reshape(1)
-    out = _paged_decode(q[:, 0], pages, layer,
+    win = (jnp.zeros((1,), jnp.int32) if window is None
+           else jnp.asarray(window, jnp.int32).reshape(1))
+    out = _paged_decode(q[:, 0], pages, layer, win,
                         page_table.astype(jnp.int32),
                         total_lens.astype(jnp.int32), sm_scale,
+                        softcap=float(softcap or 0.0),
                         interpret=_resolve_interpret(interpret))
     return out[:, None]                                    # [B, 1, Hq, Dh]
+
+
+# marker the gemma forward checks before handing this impl its per-layer
+# window / softcap kwargs (the prefill kernel does not carry them)
+paged_decode_attention_stacked.supports_window_softcap = True
 
 
 __all__ = ["paged_decode_attention", "paged_decode_attention_stacked",

@@ -55,10 +55,18 @@ class ModelSharding:
             elif cfg.num_kv_heads % tp:
                 raise ValueError(
                     f"num_kv_heads={cfg.num_kv_heads} not divisible by tp={tp}")
-            if not cfg.kv_lora_rank and cfg.intermediate_size % tp:
+            if cfg.intermediate_size % tp:
                 raise ValueError(
                     f"intermediate_size={cfg.intermediate_size} not divisible "
                     f"by tp={tp}")
+            if cfg.kv_lora_rank and cfg.num_experts:
+                moe_i = cfg.moe_intermediate_size or cfg.intermediate_size
+                if (moe_i % tp
+                        or (moe_i * cfg.n_shared_experts) % tp):
+                    raise ValueError(
+                        f"moe_intermediate_size={moe_i} (x n_shared_"
+                        f"experts={cfg.n_shared_experts}) not divisible "
+                        f"by tp={tp}")
         if ep > 1 and cfg.num_experts % ep:
             raise ValueError(
                 f"num_experts={cfg.num_experts} not divisible by ep={ep}")

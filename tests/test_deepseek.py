@@ -107,6 +107,40 @@ class TestForward:
         np.testing.assert_allclose(np.asarray(ref_logits),
                                    np.asarray(logits), rtol=2e-2, atol=2e-3)
 
+    def test_blockwise_prefill_matches_direct(self):
+        """Wide page table (P > PAGES_PER_CHUNK) takes the chunked
+        online-softmax latent path; logits must match a run whose table
+        is narrow enough for the direct full-gather path."""
+        cfg = ds_cfg(max_position_embeddings=512)
+        params = deepseek.init_params(cfg, jax.random.PRNGKey(4))
+        prompt = list(np.random.RandomState(3).randint(1, 255, size=29))
+
+        # direct path: table width 4 (<= 8)
+        narrow = _alloc(1, 4)
+        l_direct, _ = _prefill(params, cfg, [prompt],
+                               make_pages(cfg, 6, 8, jnp.float32), narrow)
+        # blockwise path: same pages, table padded out to width 12
+        wide = jnp.concatenate(
+            [narrow, jnp.zeros((1, 8), jnp.int32)], axis=1)
+        l_block, _ = _prefill(params, cfg, [prompt],
+                              make_pages(cfg, 6, 8, jnp.float32), wide)
+        np.testing.assert_allclose(np.asarray(l_direct),
+                                   np.asarray(l_block),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_dispatch_backend_matches_dense(self):
+        cfg_d = ds_cfg()
+        cfg_s = ds_cfg(moe_backend="dispatch", moe_capacity_factor=4.0)
+        params = deepseek.init_params(cfg_d, jax.random.PRNGKey(5))
+        prompt = list(range(1, 12))
+        table = _alloc(1, 4)
+        l1, _ = _prefill(params, cfg_d, [prompt],
+                         make_pages(cfg_d, 6, 8, jnp.float32), table)
+        l2, _ = _prefill(params, cfg_s, [prompt],
+                         make_pages(cfg_s, 6, 8, jnp.float32), table)
+        np.testing.assert_allclose(np.asarray(l1), np.asarray(l2),
+                                   rtol=2e-4, atol=2e-4)
+
     def test_unrolled_matches_scan(self):
         from dynamo_tpu.models.llama import make_pages_list
         cfg = ds_cfg()

@@ -291,6 +291,25 @@ class TestPallasDecodeStacked:
                                        np.asarray(outs[layer], np.float32),
                                        rtol=2e-2, atol=2e-2)
 
+    def test_window_softcap_matches_xla(self):
+        """gemma-2 semantics in the kernel: sliding window (with the
+        before-window chunks skipped) + logit soft-capping must match the
+        XLA path."""
+        from dynamo_tpu.ops.attention import paged_attention
+        from dynamo_tpu.ops.pallas import paged_decode_attention_stacked
+        pages, q, table, total = self._mk(seed=7)
+        positions = (total - 1)[:, None]
+        for win, cap in ((16, None), (0, 30.0), (16, 30.0), (40, 8.0)):
+            ref = paged_attention(
+                q, pages, 1, table, positions, total, 0.088,
+                window=jnp.int32(win), softcap=cap)
+            out = paged_decode_attention_stacked(
+                q, pages, 1, table, positions, total, 0.088,
+                window=win, softcap=cap, interpret=True)
+            np.testing.assert_allclose(
+                np.asarray(ref, np.float32), np.asarray(out, np.float32),
+                rtol=2e-2, atol=2e-2, err_msg=f"win={win} cap={cap}")
+
     async def test_engine_pallas_scan_matches_scan_tokens(self):
         """attn_impl='pallas' (scan forward + stacked kernel, interpret on
         CPU) must generate the same greedy tokens as the plain scan path —
