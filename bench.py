@@ -505,7 +505,16 @@ def main() -> None:
     cpu_reserve = 120.0
 
     tpu_env = dict(os.environ)
-    tpu_env.pop("JAX_PLATFORMS", None)  # let the TPU plugin register
+    probe_code = "import jax; jax.devices()"
+    if os.environ.get("BENCH_TEST_CPU_CHAIN"):
+        # CI hook: drive the probe-success -> prime -> measure chain on
+        # CPU (the TPU site hook would otherwise hang every probe, and
+        # env vars alone cannot out-pin it — see utils/platform.py)
+        probe_code = ("from dynamo_tpu.utils.platform import "
+                      "force_cpu_platform; force_cpu_platform()")
+        tpu_env["BENCH_FORCE_CPU"] = "1"
+    else:
+        tpu_env.pop("JAX_PLATFORMS", None)  # let the TPU plugin register
     errors: list[str] = []
     probes = 0
     primed: set[str] = set()  # per tier: full-tier programs don't warm reduced
@@ -519,7 +528,7 @@ def main() -> None:
         t_probe = time.monotonic()
         try:
             probe_rc = subprocess.run(
-                [sys.executable, "-c", "import jax; jax.devices()"],
+                [sys.executable, "-c", probe_code],
                 env=tpu_env, timeout=probe_budget,
                 stdout=subprocess.DEVNULL,
                 stderr=subprocess.DEVNULL).returncode

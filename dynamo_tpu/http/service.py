@@ -217,16 +217,37 @@ class HttpService:
                 await resp.write(sse.SseEvent(
                     event=name,
                     data=json.dumps(value, separators=(",", ":"))).encode())
+            stream_text: List[str] = []
             async for chunk in gen:
                 if chunk.usage is not None and not chunk.choices:
                     if not include_usage:
                         continue  # client didn't opt into the usage chunk
+                if req.tools:
+                    for choice in chunk.choices:
+                        if choice.delta.content:
+                            stream_text.append(choice.delta.content)
                 # token accounting from the delta generator's counter (a chunk
                 # may carry text from several tokens; chunks != tokens)
                 timer.on_token(delta.completion_tokens - emitted_tokens)
                 emitted_tokens = delta.completion_tokens
                 await resp.write(sse.encode_data(
                     chunk.model_dump(exclude_none=True)))
+            if req.tools:
+                # the matcher needs the COMPLETE message, so tool calls on a
+                # stream arrive as one trailing chunk carrying the parsed
+                # delta.tool_calls + finish_reason "tool_calls" (the text
+                # deltas streamed untouched above) — same final semantics
+                # as the aggregated path, without buffering the stream
+                from dynamo_tpu.preprocessor.tools import parse_tool_calls
+                calls = parse_tool_calls("".join(stream_text),
+                                         req.tool_choice or "auto")
+                if calls:
+                    await resp.write(sse.encode_data({
+                        "id": request_id, "object": "chat.completion.chunk",
+                        "created": now_unix(), "model": req.model,
+                        "choices": [{"index": 0,
+                                     "delta": {"tool_calls": calls},
+                                     "finish_reason": "tool_calls"}]}))
             await resp.write(sse.encode_done())
         except (ConnectionResetError, asyncio.CancelledError):
             # client disconnected: stop generating (parity: disconnect.rs)
@@ -271,11 +292,25 @@ class HttpService:
                 emitted_tokens = delta.completion_tokens
         finally:
             await gen.aclose()
+        text = "".join(text_parts)
+        tool_calls: Optional[List[dict]] = None
+        if req.tools:
+            # tool-call extraction on the aggregated message (parity:
+            # ToolCallingMatcher in the reference aggregator,
+            # lib/llm/src/preprocessor/tools.rs)
+            from dynamo_tpu.preprocessor.tools import parse_tool_calls
+            calls = parse_tool_calls(text, req.tool_choice or "auto")
+            if calls:
+                tool_calls = calls
         body = ChatCompletionResponse(
             id=request_id, created=now_unix(), model=req.model,
             choices=[ChatChoice(
-                message=ChatMessage(role="assistant", content="".join(text_parts)),
-                finish_reason=finish_reason or "stop",
+                message=ChatMessage(
+                    role="assistant",
+                    content=None if tool_calls else text,
+                    tool_calls=tool_calls),
+                finish_reason=("tool_calls" if tool_calls
+                               else finish_reason or "stop"),
                 logprobs=(ChoiceLogprobs(content=lp_entries)
                           if lp_entries else None))],
             usage=usage)

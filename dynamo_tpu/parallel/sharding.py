@@ -59,13 +59,12 @@ class ModelSharding:
                 raise ValueError(
                     f"intermediate_size={cfg.intermediate_size} not divisible "
                     f"by tp={tp}")
-            if cfg.kv_lora_rank and cfg.num_experts:
+            if cfg.num_experts:
+                # both MoE spec families shard the expert FFN width over tp
                 moe_i = cfg.moe_intermediate_size or cfg.intermediate_size
-                if (moe_i % tp
-                        or (moe_i * cfg.n_shared_experts) % tp):
+                if moe_i % tp:
                     raise ValueError(
-                        f"moe_intermediate_size={moe_i} (x n_shared_"
-                        f"experts={cfg.n_shared_experts}) not divisible "
+                        f"moe_intermediate_size={moe_i} not divisible "
                         f"by tp={tp}")
         if ep > 1 and cfg.num_experts % ep:
             raise ValueError(

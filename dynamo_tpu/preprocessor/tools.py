@@ -1,0 +1,90 @@
+"""Tool-call extraction from generated text.
+
+Parity: reference ``lib/llm/src/preprocessor/tools.rs`` —
+``ToolCallingMatcher`` accepts a completed assistant message that IS a
+JSON tool invocation and converts it to OpenAI ``tool_calls`` entries.
+Accepted shapes (same as the reference's serde attempts, in order):
+
+- ``{"name": ..., "parameters": {...}}``
+- ``[{"name": ..., "parameters": {...}}, ...]``
+- ``{"name": ..., "arguments": {...}}``
+- ``[{"name": ..., "arguments": {...}}, ...]``
+
+Extension beyond the reference (the models this framework serves
+natively emit it): the qwen/hermes ``<tool_call> {...} </tool_call>``
+wrapper — each wrapped block parses with the same shapes. A message that
+parses as tool calls returns them and the HTTP layer reports
+``finish_reason: "tool_calls"`` with ``content: null``; anything else
+returns ``[]`` and the text passes through untouched.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import uuid
+from typing import Any, Dict, List, Optional
+
+_TOOL_CALL_RE = re.compile(r"<tool_call>\s*(.*?)\s*</tool_call>",
+                           re.DOTALL)
+
+
+def _one_call(obj: Any) -> Optional[Dict[str, Any]]:
+    """A dict of {name, parameters|arguments} -> OpenAI tool_call entry."""
+    if not isinstance(obj, dict) or not isinstance(obj.get("name"), str):
+        return None
+    args = obj.get("parameters", obj.get("arguments"))
+    if not isinstance(args, dict):
+        return None
+    return {
+        "id": f"call-{uuid.uuid4()}",
+        "type": "function",
+        "function": {"name": obj["name"],
+                     "arguments": json.dumps(args)},
+    }
+
+
+def _from_json_text(text: str) -> List[Dict[str, Any]]:
+    try:
+        obj = json.loads(text)
+    except (json.JSONDecodeError, ValueError):
+        return []
+    if isinstance(obj, dict):
+        call = _one_call(obj)
+        return [call] if call else []
+    if isinstance(obj, list):
+        calls = [_one_call(o) for o in obj]
+        if calls and all(c is not None for c in calls):
+            return calls  # type: ignore[return-value]
+    return []
+
+
+def parse_tool_calls(message: str,
+                     tool_choice: Any = "auto") -> List[Dict[str, Any]]:
+    """Extract OpenAI ``tool_calls`` from a completed message, or ``[]``.
+
+    ``tool_choice="none"`` disables parsing (reference:
+    ``ToolCallingMatcher::get_call``)."""
+    if tool_choice == "none":
+        return []
+    text = message.strip()
+    if not text:
+        return []
+    wrapped = _TOOL_CALL_RE.findall(text)
+    if wrapped:
+        # the whole message must be tool calls (modulo whitespace) — a
+        # prose answer that merely MENTIONS the tag stays text
+        remainder = _TOOL_CALL_RE.sub("", text).strip()
+        if remainder:
+            return []
+        calls: List[Dict[str, Any]] = []
+        for block in wrapped:
+            got = _from_json_text(block)
+            if not got:
+                return []
+            calls.extend(got)
+        return calls
+    return _from_json_text(text)
+
+
+__all__ = ["parse_tool_calls"]

@@ -1,0 +1,62 @@
+"""Benchmark orchestrator: the probe -> prime -> measure chain.
+
+Three rounds of BENCH_r*.json failures were orchestration failures, not
+measurement failures — so the orchestration itself is under test. The
+``BENCH_TEST_CPU_CHAIN`` hook makes probes and children run on forced-CPU
+jax (the TPU site hook would hang them in this environment), driving the
+EXACT code path a live chip window takes: probe succeeds, the priming
+child compiles the three step programs into the persistent cache, the
+measurement child runs warm and emits one JSON line.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+BENCH = os.path.join(os.path.dirname(__file__), "..", "bench.py")
+
+
+def test_probe_prime_measure_chain():
+    env = dict(os.environ)
+    env["BENCH_TEST_CPU_CHAIN"] = "1"
+    env.pop("JAX_PLATFORMS", None)
+    # the budget is a CEILING the orchestrator plans against, not a
+    # duration — it must leave >= 150s headroom after the cpu reserve for
+    # the priming child to be scheduled; the tiny run finishes in ~30s
+    r = subprocess.run(
+        [sys.executable, BENCH, "--budget", "420", "--tier", "tiny"],
+        env=env, capture_output=True, timeout=380)
+    assert r.returncode == 0, r.stderr.decode()[-2000:]
+    line = r.stdout.decode().strip().splitlines()[-1]
+    result = json.loads(line)
+    stderr = r.stderr.decode()
+    # the chain really ran: probe succeeded, all three programs primed,
+    # the measurement used an attempt slot (not the CPU fallback)
+    assert "tpu probe 1 OK" in stderr
+    for prog in ("prefill", "decode", "chained"):
+        assert f"primed {prog}" in stderr, stderr[-2000:]
+    assert result["attempts"] == 1
+    assert result["probes"] == 1
+    assert "error" not in result
+    assert result["value"] > 0
+    # forced-CPU children are honest about validity
+    assert result["valid"] is False
+    assert result["tier"] == "tiny"
+
+
+def test_cpu_fallback_when_probes_fail():
+    """No TPU and no CPU-chain hook: probes hang/fail and the orchestrator
+    must still emit one invalid JSON line via the CPU fallback."""
+    env = dict(os.environ)
+    env.pop("BENCH_TEST_CPU_CHAIN", None)
+    # make the real probe fail FAST (no tunnel wait): point the children at
+    # a python that cannot import jax... simplest honest knob: a tiny
+    # budget so probe windows collapse and the fallback path runs
+    r = subprocess.run(
+        [sys.executable, BENCH, "--budget", "1", "--tier", "tiny"],
+        env=env, capture_output=True, timeout=240)
+    assert r.returncode == 0, r.stderr.decode()[-2000:]
+    result = json.loads(r.stdout.decode().strip().splitlines()[-1])
+    assert result["valid"] is False
+    assert "error" in result

@@ -1,0 +1,163 @@
+"""Tool-call extraction: parser unit tests + HTTP aggregation wiring.
+
+Parity target: ``lib/llm/src/preprocessor/tools.rs`` ToolCallingMatcher
+(strict JSON {name, parameters|arguments} shapes, single or list), plus
+the qwen/hermes ``<tool_call>`` wrapper extension.
+"""
+
+import json
+from typing import AsyncIterator
+
+import aiohttp
+
+from dynamo_tpu.engine.base import EngineBase
+from dynamo_tpu.http.service import HttpService
+from dynamo_tpu.llm.model_manager import ModelManager
+from dynamo_tpu.llm.pipeline import LocalEnginePipeline
+from dynamo_tpu.preprocessor.tools import parse_tool_calls
+from dynamo_tpu.protocols.common import (
+    FinishReason,
+    LLMEngineOutput,
+    PreprocessedRequest,
+)
+from dynamo_tpu.utils.testing import make_test_card
+
+
+class TestParser:
+    def test_single_parameters_shape(self):
+        msg = '{"name": "get_weather", "parameters": {"city": "Paris"}}'
+        (call,) = parse_tool_calls(msg)
+        assert call["type"] == "function"
+        assert call["function"]["name"] == "get_weather"
+        assert json.loads(call["function"]["arguments"]) == {"city": "Paris"}
+        assert call["id"].startswith("call-")
+
+    def test_arguments_shape_and_list(self):
+        msg = ('[{"name": "a", "arguments": {"x": 1}},'
+               ' {"name": "b", "arguments": {}}]')
+        calls = parse_tool_calls(msg)
+        assert [c["function"]["name"] for c in calls] == ["a", "b"]
+
+    def test_tool_choice_none_disables(self):
+        msg = '{"name": "a", "parameters": {}}'
+        assert parse_tool_calls(msg, "none") == []
+
+    def test_prose_stays_text(self):
+        assert parse_tool_calls("The weather in Paris is sunny.") == []
+        # mentions the tag inside prose: not a pure tool-call message
+        assert parse_tool_calls(
+            'Use <tool_call>{"name": "a", "parameters": {}}</tool_call> '
+            "like this.") == []
+        # JSON but not a call shape
+        assert parse_tool_calls('{"city": "Paris"}') == []
+        assert parse_tool_calls('[{"name": "a", "parameters": {}}, 3]') == []
+
+    def test_wrapped_blocks(self):
+        msg = ('<tool_call>{"name": "a", "parameters": {"x": 1}}</tool_call>'
+               '\n<tool_call>{"name": "b", "arguments": {"y": 2}}'
+               "</tool_call>")
+        calls = parse_tool_calls(msg)
+        assert [c["function"]["name"] for c in calls] == ["a", "b"]
+
+
+class ScriptedEngine(EngineBase):
+    """Emits a fixed text (re-encoded with the serving tokenizer)."""
+
+    def __init__(self, tokenizer, text: str):
+        self._ids = tokenizer.encode(text)
+
+    async def generate(self, request: PreprocessedRequest,
+                       ctx=None) -> AsyncIterator[LLMEngineOutput]:
+        for t in self._ids:
+            yield LLMEngineOutput(token_ids=[t])
+        yield LLMEngineOutput(finish_reason=FinishReason.STOP,
+                              prompt_tokens=len(request.token_ids),
+                              completion_tokens=len(self._ids))
+
+
+async def _service_for(text: str):
+    card = make_test_card(name="tool-model")
+    manager = ModelManager()
+    manager.add(card.name, LocalEnginePipeline(
+        card, ScriptedEngine(card.load_tokenizer(), text)))
+    return await HttpService(manager, host="127.0.0.1", port=0).start()
+
+
+TOOLS = [{"type": "function",
+          "function": {"name": "get_weather",
+                       "parameters": {"type": "object"}}}]
+
+
+class TestHttpWiring:
+    async def test_tool_call_response(self):
+        service = await _service_for(
+            '{"name": "get_weather", "parameters": {"city": "Paris"}}')
+        try:
+            async with aiohttp.ClientSession() as s:
+                r = await (await s.post(
+                    f"http://127.0.0.1:{service.port}/v1/chat/completions",
+                    json={"model": "tool-model", "max_tokens": 64,
+                          "tools": TOOLS,
+                          "messages": [{"role": "user",
+                                        "content": "weather?"}]})).json()
+            choice = r["choices"][0]
+            assert choice["finish_reason"] == "tool_calls"
+            (call,) = choice["message"]["tool_calls"]
+            assert call["function"]["name"] == "get_weather"
+            assert json.loads(call["function"]["arguments"]) == {
+                "city": "Paris"}
+            assert not choice["message"].get("content")
+        finally:
+            await service.stop()
+
+    async def test_streaming_emits_trailing_tool_call_chunk(self):
+        """stream=true with tools: text deltas flow untouched, then ONE
+        trailing chunk carries the parsed delta.tool_calls with
+        finish_reason 'tool_calls' — same final semantics as aggregation
+        without buffering the stream."""
+        from dynamo_tpu.protocols.sse import SseDecoder
+
+        service = await _service_for(
+            '{"name": "get_weather", "parameters": {"city": "Oslo"}}')
+        try:
+            async with aiohttp.ClientSession() as s:
+                r = await s.post(
+                    f"http://127.0.0.1:{service.port}/v1/chat/completions",
+                    json={"model": "tool-model", "max_tokens": 64,
+                          "stream": True, "tools": TOOLS,
+                          "messages": [{"role": "user",
+                                        "content": "weather?"}]})
+                decoder = SseDecoder()
+                chunks = []
+                async for raw, _ in r.content.iter_chunks():
+                    for msg in decoder.feed(raw):
+                        if msg.data and msg.data != "[DONE]":
+                            chunks.append(json.loads(msg.data))
+            tool_chunks = [c for c in chunks
+                           if c["choices"]
+                           and c["choices"][0].get("delta", {})
+                           .get("tool_calls")]
+            assert len(tool_chunks) == 1
+            (call,) = tool_chunks[0]["choices"][0]["delta"]["tool_calls"]
+            assert call["function"]["name"] == "get_weather"
+            assert tool_chunks[0]["choices"][0]["finish_reason"] == \
+                "tool_calls"
+        finally:
+            await service.stop()
+
+    async def test_without_tools_text_passes_through(self):
+        text = '{"name": "get_weather", "parameters": {"city": "Paris"}}'
+        service = await _service_for(text)
+        try:
+            async with aiohttp.ClientSession() as s:
+                r = await (await s.post(
+                    f"http://127.0.0.1:{service.port}/v1/chat/completions",
+                    json={"model": "tool-model", "max_tokens": 64,
+                          "messages": [{"role": "user",
+                                        "content": "hi"}]})).json()
+            choice = r["choices"][0]
+            assert choice["finish_reason"] == "stop"
+            assert choice["message"]["content"] == text
+            assert "tool_calls" not in choice["message"]
+        finally:
+            await service.stop()
