@@ -74,6 +74,7 @@ class HttpService:
         self.metrics = metrics or FrontendMetrics()
         self.app = web.Application(client_max_size=64 * 1024 * 1024)
         self.app.router.add_post("/v1/chat/completions", self.handle_chat)
+        self.app.router.add_post("/v1/responses", self.handle_responses)
         self.app.router.add_post("/v1/completions", self.handle_completions)
         self.app.router.add_post("/v1/embeddings", self.handle_embeddings)
         self.app.router.add_get("/v1/models", self.handle_models)
@@ -217,12 +218,22 @@ class HttpService:
                 await resp.write(sse.SseEvent(
                     event=name,
                     data=json.dumps(value, separators=(",", ":"))).encode())
+            # tool-call extraction needs the COMPLETE message, which only
+            # exists when the finish chunk arrives — so with tools active
+            # the finish chunk (and anything after it, e.g. the usage
+            # chunk) is HELD, and flushed at stream end with its
+            # finish_reason rewritten to "tool_calls" + the parsed
+            # delta.tool_calls when the text parses as calls. The client
+            # sees exactly one finish_reason, agreeing with the aggregated
+            # path; text deltas stream untouched either way.
+            match_tools = bool(req.tools) and req.tool_choice != "none"
             stream_text: List[str] = []
+            held: List[dict] = []
             async for chunk in gen:
                 if chunk.usage is not None and not chunk.choices:
                     if not include_usage:
                         continue  # client didn't opt into the usage chunk
-                if req.tools:
+                if match_tools:
                     for choice in chunk.choices:
                         if choice.delta.content:
                             stream_text.append(choice.delta.content)
@@ -230,24 +241,24 @@ class HttpService:
                 # may carry text from several tokens; chunks != tokens)
                 timer.on_token(delta.completion_tokens - emitted_tokens)
                 emitted_tokens = delta.completion_tokens
-                await resp.write(sse.encode_data(
-                    chunk.model_dump(exclude_none=True)))
-            if req.tools:
-                # the matcher needs the COMPLETE message, so tool calls on a
-                # stream arrive as one trailing chunk carrying the parsed
-                # delta.tool_calls + finish_reason "tool_calls" (the text
-                # deltas streamed untouched above) — same final semantics
-                # as the aggregated path, without buffering the stream
+                payload = chunk.model_dump(exclude_none=True)
+                if match_tools and (held or any(
+                        c.finish_reason for c in chunk.choices)):
+                    held.append(payload)
+                    continue
+                await resp.write(sse.encode_data(payload))
+            if match_tools:
                 from dynamo_tpu.preprocessor.tools import parse_tool_calls
                 calls = parse_tool_calls("".join(stream_text),
                                          req.tool_choice or "auto")
-                if calls:
-                    await resp.write(sse.encode_data({
-                        "id": request_id, "object": "chat.completion.chunk",
-                        "created": now_unix(), "model": req.model,
-                        "choices": [{"index": 0,
-                                     "delta": {"tool_calls": calls},
-                                     "finish_reason": "tool_calls"}]}))
+                if calls and held:
+                    for choice in held[0].get("choices", []):
+                        if choice.get("finish_reason"):
+                            choice["finish_reason"] = "tool_calls"
+                            choice.setdefault("delta", {})["tool_calls"] = \
+                                calls
+                for payload in held:
+                    await resp.write(sse.encode_data(payload))
             await resp.write(sse.encode_done())
         except (ConnectionResetError, asyncio.CancelledError):
             # client disconnected: stop generating (parity: disconnect.rs)
@@ -265,11 +276,11 @@ class HttpService:
         await resp.write_eof()
         return resp
 
-    async def _aggregate_chat(self, req: ChatCompletionRequest, pipeline,
-                              request_id: str, timer: RequestTimer
-                              ) -> web.Response:
-        """Aggregate the chunk stream into one response (parity:
-        ``protocols/openai/chat_completions/aggregator.rs``)."""
+    async def _collect_chat(self, req: ChatCompletionRequest, pipeline,
+                            request_id: str, timer: RequestTimer):
+        """Drain the chunk stream; returns (text, finish_reason,
+        lp_entries, usage) — shared by the aggregated chat response and
+        the /v1/responses bridge."""
         text_parts: List[str] = []
         lp_entries: List[dict] = []
         finish_reason: Optional[str] = None
@@ -292,7 +303,15 @@ class HttpService:
                 emitted_tokens = delta.completion_tokens
         finally:
             await gen.aclose()
-        text = "".join(text_parts)
+        return "".join(text_parts), finish_reason, lp_entries, usage
+
+    async def _aggregate_chat(self, req: ChatCompletionRequest, pipeline,
+                              request_id: str, timer: RequestTimer
+                              ) -> web.Response:
+        """Aggregate the chunk stream into one response (parity:
+        ``protocols/openai/chat_completions/aggregator.rs``)."""
+        text, finish_reason, lp_entries, usage = await self._collect_chat(
+            req, pipeline, request_id, timer)
         tool_calls: Optional[List[dict]] = None
         if req.tools:
             # tool-call extraction on the aggregated message (parity:
@@ -316,6 +335,87 @@ class HttpService:
             usage=usage)
         timer.done("200", usage.prompt_tokens)
         return web.json_response(body.model_dump(exclude_none=True))
+
+    # fields the /v1/responses bridge does not implement: their presence
+    # gets a 501 instead of silently changed semantics (parity:
+    # validate_response_unsupported_fields, lib/llm/src/protocols/openai/
+    # validate.rs)
+    _RESPONSES_UNSUPPORTED = (
+        "previous_response_id", "tools", "tool_choice", "reasoning",
+        "store", "truncation", "include", "parallel_tool_calls", "text",
+        "background")
+
+    async def handle_responses(self, request: web.Request) -> web.Response:
+        """OpenAI Responses API, bridged through chat completions (parity:
+        ``handler_responses``, ``lib/llm/src/http/service/openai.rs:583`` —
+        text-only input, converted to a one-user-message chat request,
+        aggregated, and shaped back into a Response object)."""
+        try:
+            raw = await request.json()
+        except (json.JSONDecodeError, UnicodeDecodeError) as e:
+            return _error(400, f"invalid request: {e}")
+        if not isinstance(raw, dict):
+            return _error(400, "invalid request: expected an object")
+        bad = [k for k in self._RESPONSES_UNSUPPORTED
+               if raw.get(k) not in (None, [], {}, False)]
+        if bad:
+            return _error(501, f"unsupported field(s): {', '.join(bad)}",
+                          "not_implemented")
+        if raw.get("stream"):
+            return _error(501, "streaming responses are not implemented",
+                          "not_implemented")
+        if not isinstance(raw.get("input"), str):
+            return _error(501, "only text input is supported",
+                          "not_implemented")
+        model = raw.get("model") or ""
+        pipeline = self.manager.get(model)
+        if pipeline is None:
+            return _error(404, f"model {model!r} not found",
+                          "model_not_found")
+        try:
+            chat = ChatCompletionRequest(
+                model=model,
+                messages=[{"role": "user", "content": raw["input"]}],
+                temperature=raw.get("temperature"),
+                top_p=raw.get("top_p"),
+                max_tokens=raw.get("max_output_tokens"),
+            )
+        except ValidationError as e:
+            return _error(400, f"invalid request: {e}")
+        request_id = new_request_id("resp")
+        timer = RequestTimer(self.metrics, model, "responses")
+        try:
+            text, _finish, _lps, usage = await self._collect_chat(
+                chat, pipeline, request_id, timer)
+        except ValueError as e:  # same mapping as handle_chat
+            timer.done("400")
+            return _error(400, str(e))
+        except ConnectionError as e:
+            timer.done("503")
+            return _error(503, str(e), "service_unavailable")
+        except Exception as e:  # noqa: BLE001 — surface as API error
+            timer.done("500")
+            logger.exception("responses request %s failed", request_id)
+            return _error(500, str(e), "internal_error")
+        timer.done("200", usage.prompt_tokens)
+        return web.json_response({
+            "id": request_id,
+            "object": "response",
+            "created_at": now_unix(),
+            "model": model,
+            "status": "completed",
+            "output": [{
+                "type": "message",
+                "id": new_request_id("msg"),
+                "role": "assistant",
+                "status": "completed",
+                "content": [{"type": "output_text", "text": text,
+                             "annotations": []}],
+            }],
+            "usage": {"input_tokens": usage.prompt_tokens,
+                      "output_tokens": usage.completion_tokens,
+                      "total_tokens": usage.total_tokens},
+        })
 
     async def handle_completions(self, request: web.Request) -> web.StreamResponse:
         try:

@@ -140,8 +140,54 @@ class TestHttpWiring:
             assert len(tool_chunks) == 1
             (call,) = tool_chunks[0]["choices"][0]["delta"]["tool_calls"]
             assert call["function"]["name"] == "get_weather"
-            assert tool_chunks[0]["choices"][0]["finish_reason"] == \
-                "tool_calls"
+            # exactly ONE finish_reason on the whole stream, and it is
+            # tool_calls (the generator's "stop" chunk was rewritten, not
+            # followed by a second verdict)
+            finishes = [c["choices"][0].get("finish_reason")
+                        for c in chunks
+                        if c["choices"]
+                        and c["choices"][0].get("finish_reason")]
+            assert finishes == ["tool_calls"]
+        finally:
+            await service.stop()
+
+    async def test_responses_api_bridges_to_chat(self):
+        """/v1/responses (reference: handler_responses, openai.rs:583):
+        text input -> chat bridge -> Response object with output_text and
+        usage; unsupported fields and non-text input get 501."""
+        service = await _service_for("hello from the model")
+        base = f"http://127.0.0.1:{service.port}/v1/responses"
+        try:
+            async with aiohttp.ClientSession() as s:
+                r = await (await s.post(base, json={
+                    "model": "tool-model", "input": "hi",
+                    "max_output_tokens": 64})).json()
+                assert r["object"] == "response"
+                assert r["status"] == "completed"
+                (msg,) = r["output"]
+                assert msg["role"] == "assistant"
+                assert msg["content"][0]["type"] == "output_text"
+                assert msg["content"][0]["text"] == "hello from the model"
+                assert r["usage"]["output_tokens"] > 0
+
+                # unsupported field -> 501
+                resp = await s.post(base, json={
+                    "model": "tool-model", "input": "hi",
+                    "tools": [{"type": "function"}]})
+                assert resp.status == 501
+                # non-text input -> 501
+                resp = await s.post(base, json={
+                    "model": "tool-model",
+                    "input": [{"role": "user", "content": "x"}]})
+                assert resp.status == 501
+                # streaming -> 501
+                resp = await s.post(base, json={
+                    "model": "tool-model", "input": "hi", "stream": True})
+                assert resp.status == 501
+                # unknown model -> 404
+                resp = await s.post(base, json={"model": "nope",
+                                                "input": "hi"})
+                assert resp.status == 404
         finally:
             await service.stop()
 
