@@ -65,6 +65,12 @@ class JaxEngineConfig:
     # on-device top-k over [B, V] logits is noise next to the forward pass).
     # 0 disables the extra [B, K] outputs entirely.
     num_top_logprobs: int = 8
+    # sparse window of penalized token ids shipped per row per step
+    # (frequency/presence count generated tokens, repetition marks
+    # prompt+generated presence — ops/sampling.apply_penalties). Rows
+    # beyond W distinct penalizable ids keep the most frequent W.
+    # 0 disables the penalty inputs entirely.
+    penalty_window: int = 32
     seed: int = 0
     # attention implementation:
     #   "scan"     — lax.scan over layers, stacked cache, XLA attention
@@ -223,7 +229,8 @@ class JaxEngine(ScheduledEngineBase):
                 c(top_k, row), c(top_p, row))
 
     def _step_impl(self, params, pages, tokens, positions, page_table,
-                   total_lens, new_lens, rng, step, temperature, top_k, top_p):
+                   total_lens, new_lens, rng, step, temperature, top_k,
+                   top_p, pen=None):
         (tokens, positions, page_table, total_lens, new_lens, temperature,
          top_k, top_p) = self._shard_batch(
             tokens, positions, page_table, total_lens, new_lens, temperature,
@@ -255,22 +262,22 @@ class JaxEngine(ScheduledEngineBase):
                 params, self.model_cfg, tokens, positions, pages,
                 page_table, total_lens, new_lens, attn_impl=attn)
         return self._sample_tail(logits, pages, rng, step, temperature,
-                                 top_k, top_p)
+                                 top_k, top_p, pen, total_lens)
 
     def _chained_step_impl(self, params, pages, prev_packed, positions,
                            page_table, total_lens, new_lens, rng, step,
-                           temperature, top_k, top_p):
+                           temperature, top_k, top_p, pen=None):
         """Decode step whose input token is the previous step's on-device
         sampled token (packed column 0), row-aligned with the previous
         plan."""
         tokens = prev_packed[:, :1]                        # [B, 1] int32
         return self._step_impl(params, pages, tokens, positions, page_table,
                                total_lens, new_lens, rng, step, temperature,
-                               top_k, top_p)
+                               top_k, top_p, pen)
 
     def _ring_step_impl(self, params, pages, tokens, positions, page_table,
                         total_lens, new_lens, rng, step, temperature, top_k,
-                        top_p):
+                        top_p, pen=None):
         """Sequence-parallel whole-prompt prefill (ring attention over sp)."""
         from dynamo_tpu.parallel.ring_prefill import ring_prefill
         logits, pages = ring_prefill(
@@ -278,10 +285,10 @@ class JaxEngine(ScheduledEngineBase):
             total_lens, new_lens, mesh=self.cfg.mesh,
             sp_axis=self.cfg.sp_axis)
         return self._sample_tail(logits, pages, rng, step, temperature,
-                                 top_k, top_p)
+                                 top_k, top_p, pen, total_lens)
 
     def _sample_tail(self, logits, pages, rng, step, temperature, top_k,
-                     top_p):
+                     top_p, pen=None, total_lens=None):
         """Shared sampling epilogue of every step family (chunked + ring).
 
         Everything the host needs is PACKED into one int32 buffer
@@ -290,8 +297,21 @@ class JaxEngine(ScheduledEngineBase):
         per step — on a tunneled/remote backend every extra fetch is a full
         round trip (~80 ms measured vs ~2 ms chained dispatch)."""
         key = jax.random.fold_in(rng, step)
-        sampled, logprobs = sample_tokens(logits, key, temperature, top_k,
-                                          top_p)
+        seeds = None
+        if pen is not None:
+            # penalties rewrite the logits BEFORE sampling and the top-K
+            # alternatives, so reported logprobs reflect the distribution
+            # actually sampled from
+            from dynamo_tpu.ops.sampling import apply_penalties
+            logits = apply_penalties(logits, pen["ids"], pen["cnt"],
+                                     pen["ctx"], pen["fp"], pen["pp"],
+                                     pen["rp"])
+            seeds = pen["seeds"]
+        sampled, logprobs = sample_tokens(
+            logits, key, temperature, top_k, top_p, seeds=seeds,
+            # seeded rows key on (base rng, seed, token position): replays
+            # are deterministic under any batching/step interleaving
+            seed_rng=rng, seed_pos=total_lens)
         cols = [sampled[:, None],
                 jax.lax.bitcast_convert_type(logprobs, jnp.int32)[:, None]]
         K = self.cfg.num_top_logprobs
@@ -311,6 +331,87 @@ class JaxEngine(ScheduledEngineBase):
         return pages, packed
 
     # -- plan -> device arrays --------------------------------------------
+
+    def _sampling_extras(self, rows, B: int) -> dict:
+        """Per-row penalty windows + seeds (numpy, merged into the step's
+        host arrays). ``rows[i]`` is the Sequence for batch row i (fewer
+        than B: pad rows stay all-zero = no-op)."""
+        W = self.cfg.penalty_window
+        out = {"seeds": np.zeros(B, np.int32)}
+        if W <= 0:
+            return out
+        ids = np.zeros((B, W), np.int32)
+        cnt = np.zeros((B, W), np.float32)
+        ctx = np.zeros((B, W), np.float32)
+        fp = np.zeros(B, np.float32)
+        pp = np.zeros(B, np.float32)
+        rp = np.ones(B, np.float32)
+        any_active = False
+        for i, seq in enumerate(rows):
+            so = seq.request.sampling_options
+            if so.seed is not None:
+                # map any integer seed (0 included — valid per the OpenAI
+                # API) into [1, 2^31-1]; 0 stays the unseeded sentinel
+                out["seeds"][i] = (int(so.seed) % 0x7FFFFFFF) + 1
+                any_active = True
+            f = so.frequency_penalty or 0.0
+            p = so.presence_penalty or 0.0
+            r = so.repetition_penalty
+            rep_on = r is not None and r > 0 and r != 1.0
+            if not (f or p or rep_on):
+                continue
+            any_active = True
+            fp[i], pp[i] = f, p
+            if rep_on:
+                rp[i] = r
+            from collections import Counter
+            counts = Counter(seq.generated)
+            entries = counts.most_common(W)
+            if rep_on and len(entries) < W:
+                # repetition penalty also covers PROMPT tokens; fill the
+                # remaining window with them (most recent first)
+                have = {t for t, _c in entries}
+                prompt = seq.tokens.tokens()[:seq.num_prompt]
+                for t in reversed(prompt):
+                    if t not in have:
+                        entries.append((t, 0))
+                        have.add(t)
+                        if len(entries) >= W:
+                            break
+            for j, (t, c) in enumerate(entries[:W]):
+                ids[i, j] = t
+                cnt[i, j] = c
+                ctx[i, j] = 1.0
+        if not any_active:
+            # common case: nobody in the batch uses penalties or seeds —
+            # ship nothing and take the pen=None trace (no extra
+            # host->device arrays, single batch-wide gumbel draw)
+            return {}
+        out.update(pen_ids=ids, pen_cnt=cnt, pen_ctx=ctx, pen_fp=fp,
+                   pen_pp=pp, pen_rp=rp,
+                   pen_active=np.ones(1, np.int32))
+        return out
+
+    def _pen_arg(self, a: dict, B: int):
+        """The ``pen`` pytree for one jitted step, with all-zero defaults
+        for callers (cache priming, replayed broadcasts) whose arrays
+        predate the penalty keys."""
+        W = self.cfg.penalty_window
+        if W <= 0 or not np.any(a.get("pen_active", 0)):
+            return None
+        z_ids = a.get("pen_ids")
+        return {
+            "ids": jnp.asarray(z_ids if z_ids is not None
+                               else np.zeros((B, W), np.int32)),
+            "cnt": jnp.asarray(a.get("pen_cnt",
+                                     np.zeros((B, W), np.float32))),
+            "ctx": jnp.asarray(a.get("pen_ctx",
+                                     np.zeros((B, W), np.float32))),
+            "fp": jnp.asarray(a.get("pen_fp", np.zeros(B, np.float32))),
+            "pp": jnp.asarray(a.get("pen_pp", np.zeros(B, np.float32))),
+            "rp": jnp.asarray(a.get("pen_rp", np.ones(B, np.float32))),
+            "seeds": jnp.asarray(a.get("seeds", np.zeros(B, np.int32))),
+        }
 
     def _execute_plan(self, plan: StepPlan):
         """Build padded arrays, run the jitted step, fetch sampled tokens."""
@@ -362,7 +463,8 @@ class JaxEngine(ScheduledEngineBase):
             logger.info("ring prefill: %d prompt tokens in one step over "
                         "sp=%d", plan.chunks[0].length, self._sp)
         arrays = dict(toks=toks, pos=pos, table=table, total=total, new=new,
-                      temp=temp, top_k=top_k, top_p=top_p)
+                      temp=temp, top_k=top_k, top_p=top_p,
+                      **self._sampling_extras([c.seq for c in chunks], B))
         plan._step_id = self._step_counter
         if self.step_tap is not None:
             self.step_tap(kind, arrays, self._step_counter)
@@ -418,7 +520,8 @@ class JaxEngine(ScheduledEngineBase):
             if so.top_p is not None:
                 top_p[i] = so.top_p
         return dict(toks=toks, pos=pos, table=table, total=total, new=new,
-                    temp=temp, top_k=top_k, top_p=top_p)
+                    temp=temp, top_k=top_k, top_p=top_p,
+                    **self._sampling_extras(seqs, B))
 
     # -- pipelined decode (loop.py hooks) ----------------------------------
 
@@ -498,20 +601,22 @@ class JaxEngine(ScheduledEngineBase):
             return None
         if kind == "chained":
             prev = prev_packed if prev_packed is not None else self._last_packed
+            pen = self._pen_arg(a, a["pos"].shape[0])
             self.pages, packed = self._jit_chained(
                 self.params, self.pages, prev,
                 jnp.asarray(a["pos"]), jnp.asarray(a["table"]),
                 jnp.asarray(a["total"]), jnp.asarray(a["new"]),
                 self._rng, np.int32(step), jnp.asarray(a["temp"]),
-                jnp.asarray(a["top_k"]), jnp.asarray(a["top_p"]))
+                jnp.asarray(a["top_k"]), jnp.asarray(a["top_p"]), pen)
         else:
             step_fn = self._jit_ring_step if kind == "ring" else self._jit_step
+            pen = self._pen_arg(a, a["toks"].shape[0])
             self.pages, packed = step_fn(
                 self.params, self.pages, jnp.asarray(a["toks"]),
                 jnp.asarray(a["pos"]), jnp.asarray(a["table"]),
                 jnp.asarray(a["total"]), jnp.asarray(a["new"]),
                 self._rng, np.int32(step), jnp.asarray(a["temp"]),
-                jnp.asarray(a["top_k"]), jnp.asarray(a["top_p"]))
+                jnp.asarray(a["top_k"]), jnp.asarray(a["top_p"]), pen)
         self._last_packed = packed
         return packed
 

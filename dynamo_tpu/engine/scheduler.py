@@ -417,6 +417,18 @@ class Scheduler:
         for seq in prev.seqs:
             if seq.phase is not Phase.RUNNING or seq.cancelled:
                 return None
+            so = seq.request.sampling_options
+            if (so.frequency_penalty or so.presence_penalty
+                    or (so.repetition_penalty is not None
+                        and so.repetition_penalty > 0
+                        and so.repetition_penalty != 1.0)):
+                # penalty windows are built from host bookkeeping, which at
+                # chain-planning time still excludes step N's token — a
+                # chained step would penalize one token stale (an immediate
+                # repetition would escape). Penalized traffic takes the
+                # fetch-then-plan flow; seeds alone are fine (their keys
+                # fold the token position, not host state).
+                return None
             sc = seq.request.stop_conditions
             max_new = sc.max_tokens if sc.max_tokens is not None else (
                 self.max_context_hint - seq.num_prompt

@@ -372,10 +372,16 @@ class HttpService:
         if pipeline is None:
             return _error(404, f"model {model!r} not found",
                           "model_not_found")
+        messages = []
+        if isinstance(raw.get("instructions"), str) and raw["instructions"]:
+            # Responses API system prompt -> chat system message
+            messages.append({"role": "system",
+                             "content": raw["instructions"]})
+        messages.append({"role": "user", "content": raw["input"]})
         try:
             chat = ChatCompletionRequest(
                 model=model,
-                messages=[{"role": "user", "content": raw["input"]}],
+                messages=messages,
                 temperature=raw.get("temperature"),
                 top_p=raw.get("top_p"),
                 max_tokens=raw.get("max_output_tokens"),

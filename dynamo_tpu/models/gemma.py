@@ -131,7 +131,8 @@ def _logits(cfg: ModelConfig, params: Params, h: jnp.ndarray,
     lm_head = params.get("lm_head")
     if lm_head is None:
         lm_head = params["embed"].T
-    logits = h_last.astype(jnp.float32) @ lm_head.astype(jnp.float32)
+    # model-dtype operands + f32 accumulation (see llama._logits)
+    logits = jnp.dot(h_last, lm_head, preferred_element_type=jnp.float32)
     cap = cfg.final_logit_softcap
     if cap:
         logits = jnp.tanh(logits / cap) * cap

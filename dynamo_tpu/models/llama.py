@@ -170,7 +170,10 @@ def _logits(cfg: ModelConfig, params: Params, h: jnp.ndarray,
     lm_head = params.get("lm_head")
     if lm_head is None:
         lm_head = params["embed"].T
-    return h_last.astype(jnp.float32) @ lm_head.astype(jnp.float32)
+    # operands stay in the model dtype with f32 ACCUMULATION: casting
+    # lm_head to f32 would double its HBM stream (the largest single
+    # tensor of a decode step) and push the matmul off the bf16 MXU path
+    return jnp.dot(h_last, lm_head, preferred_element_type=jnp.float32)
 
 
 def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,

@@ -50,14 +50,59 @@ class SamplingParamsBatch:
                    top_p=np.ones(batch, np.float32))
 
 
+def apply_penalties(logits: jnp.ndarray, pen_ids: jnp.ndarray,
+                    pen_counts: jnp.ndarray, pen_in_ctx: jnp.ndarray,
+                    freq_pen: jnp.ndarray, pres_pen: jnp.ndarray,
+                    rep_pen: jnp.ndarray) -> jnp.ndarray:
+    """Frequency / presence / repetition penalties on device.
+
+    The host ships each row's penalized token ids as a SPARSE window
+    (ids unique per row, zero-padded with count 0 / in_ctx 0 so pad
+    entries contribute a zero delta — scatter-ADD makes duplicate pad
+    writes safe):
+
+    pen_ids:    [B, W] i32 token ids
+    pen_counts: [B, W] f32 occurrences among GENERATED tokens
+                (frequency/presence semantics, vLLM/OpenAI)
+    pen_in_ctx: [B, W] f32 1.0 if the token appears in prompt+generated
+                (repetition-penalty semantics, HF: divide positive /
+                multiply negative logits)
+    freq_pen/pres_pen: [B] f32 (0 = off); rep_pen: [B] f32 (1 = off)
+    """
+    if pen_ids.shape[1] == 0:
+        return logits
+    logits = logits.astype(jnp.float32)
+    sel = jnp.take_along_axis(logits, pen_ids, axis=1)     # [B, W]
+    rp = jnp.where(rep_pen[:, None] <= 0, 1.0, rep_pen[:, None])
+    adj = jnp.where(pen_in_ctx > 0,
+                    jnp.where(sel > 0, sel / rp, sel * rp), sel)
+    adj = adj - freq_pen[:, None] * pen_counts
+    adj = adj - pres_pen[:, None] * (pen_counts > 0)
+    delta = adj - sel                                      # 0 on pads
+    rows = jnp.arange(logits.shape[0])[:, None]
+    return logits.at[rows, pen_ids].add(delta)
+
+
 def sample_tokens(logits: jnp.ndarray, rng: jax.Array,
                   temperature: jnp.ndarray, top_k: jnp.ndarray,
-                  top_p: jnp.ndarray):
+                  top_p: jnp.ndarray, seeds: Optional[jnp.ndarray] = None,
+                  seed_rng: Optional[jax.Array] = None,
+                  seed_pos: Optional[jnp.ndarray] = None):
     """Sample next tokens.
 
     logits: [B, V] (any float dtype; promoted to f32)
-    returns (tokens [B] i32, logprobs [B] f32 — logprob of the chosen token
-    under the *unmodified* distribution, matching OpenAI logprobs semantics).
+    seeds:  optional [B] i32 per-request seeds (0 = unseeded). A seeded
+            row's randomness depends only on (base engine rng, seed, the
+            row's TOKEN POSITION ``seed_pos``) — not on its batch position,
+            the global step counter, or what it was batched with — so a
+            seeded request replays deterministically under any concurrency.
+    seed_rng: the engine's BASE key (pre step-fold); required with seeds.
+    seed_pos: [B] i32 position of the token being sampled per row.
+    returns (tokens [B] i32, logprobs [B] f32 — logprob of the chosen
+    token under the GIVEN logits before temperature/top-k/top-p (matching
+    OpenAI logprobs semantics; when the engine applies penalties upstream,
+    the reported logprobs reflect that penalized distribution — the one
+    actually sampled from).
     """
     logits = logits.astype(jnp.float32)
     B, V = logits.shape
@@ -78,7 +123,24 @@ def sample_tokens(logits: jnp.ndarray, rng: jax.Array,
     keep_p = (cum - probs) < top_p[:, None]
     scaled = jnp.where(keep_p, scaled, -jnp.inf)
 
-    gumbel = jax.random.gumbel(rng, (B, k), dtype=jnp.float32)
+    if seeds is None:
+        gumbel = jax.random.gumbel(rng, (B, k), dtype=jnp.float32)
+    else:
+        # per-row keys: unseeded rows fold their batch position (rows stay
+        # independent), seeded rows fold ONLY the seed (batch-invariant)
+        def draw(key):
+            return jax.random.gumbel(key, (k,), dtype=jnp.float32)
+
+        g_row = jax.vmap(lambda r: draw(
+            jax.random.fold_in(jax.random.fold_in(rng, 7), r)))(
+            jnp.arange(B))
+        base = rng if seed_rng is None else seed_rng
+        pos = (jnp.zeros(B, jnp.uint32) if seed_pos is None
+               else seed_pos.astype(jnp.uint32))
+        g_seed = jax.vmap(lambda s, p: draw(jax.random.fold_in(
+            jax.random.fold_in(base, s), p)))(
+            seeds.astype(jnp.uint32), pos)
+        gumbel = jnp.where((seeds != 0)[:, None], g_seed, g_row)
     choice = jnp.argmax(scaled + gumbel, axis=-1)          # [B]
     greedy = temperature <= 0.0
     choice = jnp.where(greedy, 0, choice)
@@ -89,4 +151,5 @@ def sample_tokens(logits: jnp.ndarray, rng: jax.Array,
     return tokens.astype(jnp.int32), chosen_logit - logz
 
 
-__all__ = ["SamplingParamsBatch", "sample_tokens", "TOPK_MAX"]
+__all__ = ["SamplingParamsBatch", "sample_tokens", "apply_penalties",
+           "TOPK_MAX"]
