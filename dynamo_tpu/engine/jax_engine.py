@@ -305,7 +305,7 @@ class JaxEngine(ScheduledEngineBase):
             from dynamo_tpu.ops.sampling import apply_penalties
             logits = apply_penalties(logits, pen["ids"], pen["cnt"],
                                      pen["ctx"], pen["fp"], pen["pp"],
-                                     pen["rp"])
+                                     pen["rp"], pen_bias=pen["bias"])
             seeds = pen["seeds"]
         sampled, logprobs = sample_tokens(
             logits, key, temperature, top_k, top_p, seeds=seeds,
@@ -333,16 +333,17 @@ class JaxEngine(ScheduledEngineBase):
     # -- plan -> device arrays --------------------------------------------
 
     def _sampling_extras(self, rows, B: int) -> dict:
-        """Per-row penalty windows + seeds (numpy, merged into the step's
-        host arrays). ``rows[i]`` is the Sequence for batch row i (fewer
-        than B: pad rows stay all-zero = no-op)."""
+        """Per-row penalty/bias windows + seeds (numpy, merged into the
+        step's host arrays). ``rows[i]`` is the Sequence for batch row i
+        (fewer than B: pad rows stay all-zero = no-op). With
+        ``penalty_window == 0`` seeds still ship (zero-width windows);
+        penalties/bias need W > 0."""
         W = self.cfg.penalty_window
         out = {"seeds": np.zeros(B, np.int32)}
-        if W <= 0:
-            return out
         ids = np.zeros((B, W), np.int32)
         cnt = np.zeros((B, W), np.float32)
         ctx = np.zeros((B, W), np.float32)
+        bias = np.zeros((B, W), np.float32)
         fp = np.zeros(B, np.float32)
         pp = np.zeros(B, np.float32)
         rp = np.ones(B, np.float32)
@@ -358,7 +359,8 @@ class JaxEngine(ScheduledEngineBase):
             p = so.presence_penalty or 0.0
             r = so.repetition_penalty
             rep_on = r is not None and r > 0 and r != 1.0
-            if not (f or p or rep_on):
+            lb = so.logit_bias or {}
+            if W <= 0 or not (f or p or rep_on or lb):
                 continue
             any_active = True
             fp[i], pp[i] = f, p
@@ -366,29 +368,40 @@ class JaxEngine(ScheduledEngineBase):
                 rp[i] = r
             from collections import Counter
             counts = Counter(seq.generated)
-            entries = counts.most_common(W)
+            prompt_set = (set(seq.tokens.tokens()[:seq.num_prompt])
+                          if rep_on else set())
+            # entry = (token, generated-count, in-context). logit_bias
+            # entries come FIRST (explicit client asks win the window),
+            # then penalized tokens by frequency, then — for repetition —
+            # prompt tokens (most recent first). A token in several roles
+            # gets ONE entry carrying its count, context flag, and bias.
+            entries = [(t, counts.get(t, 0),
+                        t in counts or t in prompt_set)
+                       for t in list(lb)[:W]]
+            have = {t for t, _c, _x in entries}
+            for t, c in counts.most_common(W):
+                if t not in have:
+                    entries.append((t, c, True))
+                    have.add(t)
             if rep_on and len(entries) < W:
-                # repetition penalty also covers PROMPT tokens; fill the
-                # remaining window with them (most recent first)
-                have = {t for t, _c in entries}
-                prompt = seq.tokens.tokens()[:seq.num_prompt]
-                for t in reversed(prompt):
+                for t in reversed(seq.tokens.tokens()[:seq.num_prompt]):
                     if t not in have:
-                        entries.append((t, 0))
+                        entries.append((t, 0, True))
                         have.add(t)
                         if len(entries) >= W:
                             break
-            for j, (t, c) in enumerate(entries[:W]):
+            for j, (t, c, x) in enumerate(entries[:W]):
                 ids[i, j] = t
                 cnt[i, j] = c
-                ctx[i, j] = 1.0
+                ctx[i, j] = 1.0 if x else 0.0
+                bias[i, j] = lb.get(t, 0.0)
         if not any_active:
-            # common case: nobody in the batch uses penalties or seeds —
-            # ship nothing and take the pen=None trace (no extra
+            # common case: nobody in the batch uses penalties, bias, or
+            # seeds — ship nothing and take the pen=None trace (no extra
             # host->device arrays, single batch-wide gumbel draw)
             return {}
-        out.update(pen_ids=ids, pen_cnt=cnt, pen_ctx=ctx, pen_fp=fp,
-                   pen_pp=pp, pen_rp=rp,
+        out.update(pen_ids=ids, pen_cnt=cnt, pen_ctx=ctx, pen_bias=bias,
+                   pen_fp=fp, pen_pp=pp, pen_rp=rp,
                    pen_active=np.ones(1, np.int32))
         return out
 
@@ -397,7 +410,7 @@ class JaxEngine(ScheduledEngineBase):
         for callers (cache priming, replayed broadcasts) whose arrays
         predate the penalty keys."""
         W = self.cfg.penalty_window
-        if W <= 0 or not np.any(a.get("pen_active", 0)):
+        if not np.any(a.get("pen_active", 0)):
             return None
         z_ids = a.get("pen_ids")
         return {
@@ -407,6 +420,8 @@ class JaxEngine(ScheduledEngineBase):
                                      np.zeros((B, W), np.float32))),
             "ctx": jnp.asarray(a.get("pen_ctx",
                                      np.zeros((B, W), np.float32))),
+            "bias": jnp.asarray(a.get("pen_bias",
+                                      np.zeros((B, W), np.float32))),
             "fp": jnp.asarray(a.get("pen_fp", np.zeros(B, np.float32))),
             "pp": jnp.asarray(a.get("pen_pp", np.zeros(B, np.float32))),
             "rp": jnp.asarray(a.get("pen_rp", np.ones(B, np.float32))),

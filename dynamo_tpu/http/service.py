@@ -38,6 +38,11 @@ from dynamo_tpu.protocols.openai import (
 
 logger = logging.getLogger(__name__)
 
+# upper bound on the OpenAI `n` parameter (choices per request): each
+# choice is an independent engine generation — unbounded n would be a
+# one-request DoS on scheduler admission
+MAX_CHOICES = 16
+
 
 def _legacy_logprobs(entries: List[dict], offset_start: int = 0):
     """Chat-style logprob entries -> the legacy completions logprobs object
@@ -159,6 +164,8 @@ class HttpService:
         pipeline = self.manager.get(req.model)
         if pipeline is None:
             return _error(404, f"model {req.model!r} not found", "model_not_found")
+        if req.n and not 1 <= req.n <= MAX_CHOICES:
+            return _error(400, f"n must be between 1 and {MAX_CHOICES}")
         request_id = new_request_id()
         timer = RequestTimer(self.metrics, req.model, "chat")
         try:
@@ -209,6 +216,10 @@ class HttpService:
             return resp
         status = "200"
         include_usage = bool(req.stream_options and req.stream_options.include_usage)
+        if max(1, req.n or 1) > 1:
+            return await self._stream_chat_multi(
+                resp, req, pipeline, request_id, timer,
+                (preprocessed, delta), include_usage)
         gen = pipeline.run_chat(preprocessed, delta)
         emitted_tokens = 0
         try:
@@ -276,8 +287,103 @@ class HttpService:
         await resp.write_eof()
         return resp
 
+    async def _stream_chat_multi(self, resp, req, pipeline,
+                                 request_id: str, timer: RequestTimer,
+                                 first_prepared, include_usage: bool):
+        """n > 1 streaming: the n choice generators run concurrently and
+        their chunks interleave on one SSE stream, each rewritten to its
+        choice index (standard OpenAI multi-choice streaming). Tool-call
+        extraction is n==1-only (the single-finish-chunk rewrite does not
+        compose with interleaved choices); tool-JSON streams as text here.
+        Per-choice usage chunks aggregate into ONE final usage chunk."""
+        n = req.n
+        pairs = [first_prepared] + [
+            self._prepare_choice(req, pipeline, request_id, i)
+            for i in range(1, n)]
+        # requested annotations ride ahead of the deltas, same as n == 1
+        for name, value in first_prepared[0].annotations_payload.items():
+            await resp.write(sse.SseEvent(
+                event=name,
+                data=json.dumps(value, separators=(",", ":"))).encode())
+        queue: asyncio.Queue = asyncio.Queue()
+
+        async def pump(i, pre, d):
+            gen = pipeline.run_chat(pre, d)
+            try:
+                async for chunk in gen:
+                    await queue.put((i, chunk))
+            except Exception as e:  # noqa: BLE001 — surface per stream
+                await queue.put((i, e))
+            finally:
+                await gen.aclose()
+                await queue.put((i, None))
+
+        tasks = [asyncio.create_task(pump(i, pre, d))
+                 for i, (pre, d) in enumerate(pairs)]
+        status = "200"
+        usage = Usage()
+        emitted = [0] * n
+        try:
+            live = n
+            while live:
+                i, chunk = await queue.get()
+                if chunk is None:
+                    live -= 1
+                    continue
+                if isinstance(chunk, Exception):
+                    raise chunk
+                if chunk.usage is not None and not chunk.choices:
+                    usage.prompt_tokens = chunk.usage.prompt_tokens
+                    usage.completion_tokens += chunk.usage.completion_tokens
+                    continue
+                # token accounting from stream i's delta counter (a chunk
+                # may carry several tokens; chunks != tokens)
+                d = pairs[i][1]
+                timer.on_token(d.completion_tokens - emitted[i])
+                emitted[i] = d.completion_tokens
+                payload = chunk.model_dump(exclude_none=True)
+                payload["id"] = request_id
+                for c in payload.get("choices", []):
+                    c["index"] = i
+                await resp.write(sse.encode_data(payload))
+            if include_usage:
+                usage.total_tokens = (usage.prompt_tokens
+                                      + usage.completion_tokens)
+                await resp.write(sse.encode_data({
+                    "id": request_id, "object": "chat.completion.chunk",
+                    "created": now_unix(), "model": req.model,
+                    "choices": [], "usage": usage.model_dump()}))
+            await resp.write(sse.encode_done())
+        except (ConnectionResetError, asyncio.CancelledError):
+            status = "499"
+            raise
+        except Exception as e:  # noqa: BLE001
+            logger.exception("multi-choice stream error for %s", request_id)
+            status = "500"
+            await resp.write(sse.encode_data(
+                {"error": {"message": str(e), "type": "internal_error"}}))
+            await resp.write(sse.encode_done())
+        finally:
+            for t in tasks:
+                t.cancel()
+            timer.done(status)
+        await resp.write_eof()
+        return resp
+
+    def _prepare_choice(self, req, pipeline, request_id: str, index: int):
+        """(preprocessed, delta) for choice ``index`` of an n-way request.
+        Distinct engine request ids keep the n generations independent;
+        a seeded request offsets the seed per choice so choices differ
+        while each remains reproducible."""
+        rid = request_id if index == 0 else f"{request_id}-c{index}"
+        preprocessed, delta = pipeline.prepare_chat(req, rid)
+        if index and preprocessed.sampling_options.seed is not None:
+            preprocessed.sampling_options.seed += index
+        return preprocessed, delta
+
     async def _collect_chat(self, req: ChatCompletionRequest, pipeline,
-                            request_id: str, timer: RequestTimer):
+                            request_id: str, timer: RequestTimer,
+                            prepared=None):
         """Drain the chunk stream; returns (text, finish_reason,
         lp_entries, usage) — shared by the aggregated chat response and
         the /v1/responses bridge."""
@@ -285,7 +391,8 @@ class HttpService:
         lp_entries: List[dict] = []
         finish_reason: Optional[str] = None
         usage = Usage()
-        preprocessed, delta = pipeline.prepare_chat(req, request_id)
+        preprocessed, delta = (prepared if prepared is not None
+                               else pipeline.prepare_chat(req, request_id))
         gen = pipeline.run_chat(preprocessed, delta)
         emitted_tokens = 0
         try:
@@ -309,21 +416,37 @@ class HttpService:
                               request_id: str, timer: RequestTimer
                               ) -> web.Response:
         """Aggregate the chunk stream into one response (parity:
-        ``protocols/openai/chat_completions/aggregator.rs``)."""
-        text, finish_reason, lp_entries, usage = await self._collect_chat(
-            req, pipeline, request_id, timer)
-        tool_calls: Optional[List[dict]] = None
-        if req.tools:
-            # tool-call extraction on the aggregated message (parity:
-            # ToolCallingMatcher in the reference aggregator,
-            # lib/llm/src/preprocessor/tools.rs)
-            from dynamo_tpu.preprocessor.tools import parse_tool_calls
-            calls = parse_tool_calls(text, req.tool_choice or "auto")
-            if calls:
-                tool_calls = calls
-        body = ChatCompletionResponse(
-            id=request_id, created=now_unix(), model=req.model,
-            choices=[ChatChoice(
+        ``protocols/openai/chat_completions/aggregator.rs``); ``n > 1``
+        runs the choices CONCURRENTLY (the engine batches them like any
+        other traffic, sharing the prompt via the prefix cache)."""
+        n = max(1, req.n or 1)
+        tasks = [asyncio.create_task(
+            self._collect_chat(req, pipeline, request_id, timer,
+                               prepared=self._prepare_choice(
+                                   req, pipeline, request_id, i)))
+            for i in range(n)]
+        try:
+            results = await asyncio.gather(*tasks)
+        except BaseException:
+            # one choice failed: stop the surviving generations instead of
+            # letting them decode to max_tokens for a response nobody gets
+            for t in tasks:
+                t.cancel()
+            raise
+        choices = []
+        usage = Usage()
+        for i, (text, finish_reason, lp_entries, u) in enumerate(results):
+            tool_calls: Optional[List[dict]] = None
+            if req.tools:
+                # tool-call extraction on the aggregated message (parity:
+                # ToolCallingMatcher in the reference aggregator,
+                # lib/llm/src/preprocessor/tools.rs)
+                from dynamo_tpu.preprocessor.tools import parse_tool_calls
+                calls = parse_tool_calls(text, req.tool_choice or "auto")
+                if calls:
+                    tool_calls = calls
+            choices.append(ChatChoice(
+                index=i,
                 message=ChatMessage(
                     role="assistant",
                     content=None if tool_calls else text,
@@ -331,8 +454,14 @@ class HttpService:
                 finish_reason=("tool_calls" if tool_calls
                                else finish_reason or "stop"),
                 logprobs=(ChoiceLogprobs(content=lp_entries)
-                          if lp_entries else None))],
-            usage=usage)
+                          if lp_entries else None)))
+            # prompt tokens count ONCE; completion tokens sum over choices
+            usage.prompt_tokens = u.prompt_tokens
+            usage.completion_tokens += u.completion_tokens
+        usage.total_tokens = usage.prompt_tokens + usage.completion_tokens
+        body = ChatCompletionResponse(
+            id=request_id, created=now_unix(), model=req.model,
+            choices=choices, usage=usage)
         timer.done("200", usage.prompt_tokens)
         return web.json_response(body.model_dump(exclude_none=True))
 

@@ -53,7 +53,8 @@ class SamplingParamsBatch:
 def apply_penalties(logits: jnp.ndarray, pen_ids: jnp.ndarray,
                     pen_counts: jnp.ndarray, pen_in_ctx: jnp.ndarray,
                     freq_pen: jnp.ndarray, pres_pen: jnp.ndarray,
-                    rep_pen: jnp.ndarray) -> jnp.ndarray:
+                    rep_pen: jnp.ndarray,
+                    pen_bias: Optional[jnp.ndarray] = None) -> jnp.ndarray:
     """Frequency / presence / repetition penalties on device.
 
     The host ships each row's penalized token ids as a SPARSE window
@@ -68,6 +69,8 @@ def apply_penalties(logits: jnp.ndarray, pen_ids: jnp.ndarray,
                 (repetition-penalty semantics, HF: divide positive /
                 multiply negative logits)
     freq_pen/pres_pen: [B] f32 (0 = off); rep_pen: [B] f32 (1 = off)
+    pen_bias:   optional [B, W] f32 OpenAI logit_bias, added
+                unconditionally per entry (0 on pads)
     """
     if pen_ids.shape[1] == 0:
         return logits
@@ -78,6 +81,8 @@ def apply_penalties(logits: jnp.ndarray, pen_ids: jnp.ndarray,
                     jnp.where(sel > 0, sel / rp, sel * rp), sel)
     adj = adj - freq_pen[:, None] * pen_counts
     adj = adj - pres_pen[:, None] * (pen_counts > 0)
+    if pen_bias is not None:
+        adj = adj + pen_bias
     delta = adj - sel                                      # 0 on pads
     rows = jnp.arange(logits.shape[0])[:, None]
     return logits.at[rows, pen_ids].add(delta)

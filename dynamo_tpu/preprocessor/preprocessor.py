@@ -80,6 +80,32 @@ class OpenAIPreprocessor:
         out.annotations = list((req.nvext.annotations if req.nvext else None) or [])
         return out
 
+    # widest logit_bias the serving engine's sparse penalty window
+    # carries per request (JaxEngineConfig.penalty_window default); more
+    # entries would be silently dropped on device, so reject instead
+    MAX_LOGIT_BIAS = 32
+
+    def _validate_logit_bias(self, lb):
+        if not lb:
+            return None
+        if len(lb) > self.MAX_LOGIT_BIAS:
+            raise ValueError(
+                f"logit_bias supports at most {self.MAX_LOGIT_BIAS} "
+                f"entries, got {len(lb)}")
+        vocab = self.tokenizer.vocab_size
+        out = {}
+        for k, v in lb.items():
+            try:
+                t = int(k)
+            except (TypeError, ValueError):
+                raise ValueError(f"logit_bias key {k!r} is not a token id")
+            if not 0 <= t < vocab:
+                raise ValueError(
+                    f"logit_bias token id {t} outside the vocab "
+                    f"(size {vocab})")
+            out[t] = float(v)
+        return out
+
     def _build(self, req: Union[ChatCompletionRequest, CompletionRequest],
                token_ids: List[int], request_id: Optional[str]) -> PreprocessedRequest:
         if len(token_ids) >= self.card.context_length:
@@ -119,6 +145,7 @@ class OpenAIPreprocessor:
             frequency_penalty=req.frequency_penalty,
             presence_penalty=req.presence_penalty,
             repetition_penalty=req.repetition_penalty,
+            logit_bias=self._validate_logit_bias(req.logit_bias),
             seed=req.seed,
             n=req.n,
             logprobs=logprobs,

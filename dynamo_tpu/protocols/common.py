@@ -84,6 +84,8 @@ class SamplingOptions:
     seed: Optional[int] = None
     n: int = 1
     logprobs: Optional[int] = None
+    # OpenAI logit_bias: token id -> additive bias (-100 bans, +100 forces)
+    logit_bias: Optional[Dict[int, float]] = None
 
     def to_dict(self) -> Dict[str, Any]:
         d = _asdict_shallow(self)
@@ -95,6 +97,10 @@ class SamplingOptions:
         kw = {k: d.get(k) for k in (
             "temperature", "top_p", "top_k", "frequency_penalty",
             "presence_penalty", "repetition_penalty", "seed", "logprobs")}
+        lb = d.get("logit_bias")
+        if lb:
+            # wire form may carry string token-id keys (OpenAI JSON)
+            kw["logit_bias"] = {int(k): float(v) for k, v in lb.items()}
         return cls(n=int(d.get("n", 1)), **kw)
 
 

@@ -103,6 +103,7 @@ class CompletionRequest(BaseModel):
     presence_penalty: Optional[float] = None
     frequency_penalty: Optional[float] = None
     repetition_penalty: Optional[float] = None
+    logit_bias: Optional[Dict[str, float]] = None
     seed: Optional[int] = None
     user: Optional[str] = None
     nvext: Optional[Extensions] = None

@@ -144,3 +144,36 @@ class TestEngineExtras:
             assert seeded == alone
         finally:
             await eng.stop()
+
+
+class TestLogitBias:
+    def test_bias_applies_on_device(self):
+        from dynamo_tpu.ops.sampling import apply_penalties
+        logits = np.zeros((1, 10), np.float32)
+        ids = np.array([[4, 0, 0]], np.int32)
+        z = np.zeros((1, 3), np.float32)
+        bias = np.array([[7.5, 0, 0]], np.float32)
+        out = np.asarray(apply_penalties(
+            jnp.asarray(logits), jnp.asarray(ids), jnp.asarray(z),
+            jnp.asarray(z), jnp.asarray(np.zeros(1, np.float32)),
+            jnp.asarray(np.zeros(1, np.float32)),
+            jnp.asarray(np.ones(1, np.float32)),
+            pen_bias=jnp.asarray(bias)))
+        assert out[0, 4] == 7.5
+        assert np.all(out[0, :4] == 0) and np.all(out[0, 5:] == 0)
+
+    async def test_bias_forces_token_end_to_end(self):
+        """+100 bias on one token id must make greedy sampling emit it
+        every step (the OpenAI 'force this token' idiom)."""
+        eng = _engine()
+        try:
+            toks = await _run(eng, _req(
+                "forced", temperature=0.0, logit_bias={7: 100.0}))
+            assert toks == [7] * 8
+            # and -100 bans: the banned token never appears even though
+            # it is what the +100 run proves the model CAN emit
+            banned = await _run(eng, _req(
+                "banned", temperature=0.0, logit_bias={7: -100.0}))
+            assert 7 not in banned
+        finally:
+            await eng.stop()

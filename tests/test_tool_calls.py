@@ -207,3 +207,87 @@ class TestHttpWiring:
             assert "tool_calls" not in choice["message"]
         finally:
             await service.stop()
+
+
+class TestMultiChoice:
+    async def test_aggregated_n3(self):
+        service = await _service_for("same text")
+        try:
+            async with aiohttp.ClientSession() as s:
+                r = await (await s.post(
+                    f"http://127.0.0.1:{service.port}/v1/chat/completions",
+                    json={"model": "tool-model", "max_tokens": 64, "n": 3,
+                          "messages": [{"role": "user",
+                                        "content": "hi"}]})).json()
+            assert [c["index"] for c in r["choices"]] == [0, 1, 2]
+            per = None
+            for c in r["choices"]:
+                assert c["message"]["content"] == "same text"
+                assert c["finish_reason"] == "stop"
+            # prompt counted once, completions summed over choices
+            u = r["usage"]
+            assert u["completion_tokens"] % 3 == 0
+            assert u["total_tokens"] == (u["prompt_tokens"]
+                                         + u["completion_tokens"])
+        finally:
+            await service.stop()
+
+    async def test_streaming_n2_interleaves_indices(self):
+        from dynamo_tpu.protocols.sse import SseDecoder
+
+        service = await _service_for("words flow here")
+        try:
+            async with aiohttp.ClientSession() as s:
+                r = await s.post(
+                    f"http://127.0.0.1:{service.port}/v1/chat/completions",
+                    json={"model": "tool-model", "max_tokens": 64, "n": 2,
+                          "stream": True,
+                          "stream_options": {"include_usage": True},
+                          "messages": [{"role": "user", "content": "go"}]})
+                decoder = SseDecoder()
+                chunks = []
+                async for raw, _ in r.content.iter_chunks():
+                    for msg in decoder.feed(raw):
+                        if msg.data and msg.data != "[DONE]":
+                            chunks.append(json.loads(msg.data))
+            indices = {c["choices"][0]["index"]
+                       for c in chunks if c.get("choices")}
+            assert indices == {0, 1}
+            texts = {0: "", 1: ""}
+            for c in chunks:
+                for ch in c.get("choices", []):
+                    texts[ch["index"]] += ch.get("delta", {}) \
+                        .get("content", "") or ""
+            assert texts[0] == texts[1] == "words flow here"
+            usage_chunks = [c for c in chunks
+                            if c.get("usage") and not c.get("choices")]
+            assert len(usage_chunks) == 1
+            u = usage_chunks[0]["usage"]
+            assert u["completion_tokens"] % 2 == 0
+        finally:
+            await service.stop()
+
+
+class TestValidation:
+    async def test_n_out_of_range_and_bias_validation(self):
+        service = await _service_for("x")
+        base = f"http://127.0.0.1:{service.port}/v1/chat/completions"
+        msgs = [{"role": "user", "content": "hi"}]
+        try:
+            async with aiohttp.ClientSession() as s:
+                r = await s.post(base, json={"model": "tool-model",
+                                             "n": 1000, "messages": msgs})
+                assert r.status == 400
+                # too many bias entries
+                r = await s.post(base, json={
+                    "model": "tool-model", "messages": msgs,
+                    "logit_bias": {str(i): -1 for i in range(40)}})
+                assert r.status == 400
+                assert "logit_bias" in (await r.json())["error"]["message"]
+                # out-of-vocab token id
+                r = await s.post(base, json={
+                    "model": "tool-model", "messages": msgs,
+                    "logit_bias": {"999999999": -100}})
+                assert r.status == 400
+        finally:
+            await service.stop()
