@@ -164,7 +164,7 @@ class HttpService:
         pipeline = self.manager.get(req.model)
         if pipeline is None:
             return _error(404, f"model {req.model!r} not found", "model_not_found")
-        if req.n and not 1 <= req.n <= MAX_CHOICES:
+        if not 1 <= req.n <= MAX_CHOICES:
             return _error(400, f"n must be between 1 and {MAX_CHOICES}")
         request_id = new_request_id()
         timer = RequestTimer(self.metrics, req.model, "chat")
@@ -370,15 +370,21 @@ class HttpService:
         await resp.write_eof()
         return resp
 
-    def _prepare_choice(self, req, pipeline, request_id: str, index: int):
-        """(preprocessed, delta) for choice ``index`` of an n-way request.
-        Distinct engine request ids keep the n generations independent;
-        a seeded request offsets the seed per choice so choices differ
-        while each remains reproducible."""
+    @staticmethod
+    def _choice_identity(request_id: str, seed, index: int):
+        """(rid, seed) for choice ``index`` of an n-way request — ONE
+        convention for chat and legacy completions: distinct engine
+        request ids keep the n generations independent, and a seeded
+        request offsets the seed per choice so choices differ while each
+        remains reproducible."""
         rid = request_id if index == 0 else f"{request_id}-c{index}"
+        return rid, (seed + index if seed is not None and index else seed)
+
+    def _prepare_choice(self, req, pipeline, request_id: str, index: int):
+        """(preprocessed, delta) for choice ``index`` of an n-way chat."""
+        rid, seed = self._choice_identity(request_id, req.seed, index)
         preprocessed, delta = pipeline.prepare_chat(req, rid)
-        if index and preprocessed.sampling_options.seed is not None:
-            preprocessed.sampling_options.seed += index
+        preprocessed.sampling_options.seed = seed
         return preprocessed, delta
 
     async def _collect_chat(self, req: ChatCompletionRequest, pipeline,
@@ -560,42 +566,70 @@ class HttpService:
         pipeline = self.manager.get(req.model)
         if pipeline is None:
             return _error(404, f"model {req.model!r} not found", "model_not_found")
+        if not 1 <= req.n <= MAX_CHOICES:
+            return _error(400, f"n must be between 1 and {MAX_CHOICES}")
+        n = req.n
+        if req.stream and n > 1:
+            return _error(501, "streaming with n > 1 is not implemented "
+                          "for legacy completions", "not_implemented")
         request_id = new_request_id("cmpl")
         timer = RequestTimer(self.metrics, req.model, "completions")
         try:
             if req.stream:
                 return await self._stream_completion(request, req, pipeline,
                                                      request_id, timer)
-            text_parts: List[str] = []
-            lp_entries: List[dict] = []
-            finish = None
-            usage = Usage()
-            gen = pipeline.generate_completion(req, request_id)
+
+            async def one_choice(i: int):
+                rid, seed = self._choice_identity(request_id, req.seed, i)
+                req_i = (req if i == 0
+                         else req.model_copy(update={"seed": seed}))
+                text_parts: List[str] = []
+                lp_entries: List[dict] = []
+                finish = None
+                u = Usage()
+                gen = pipeline.generate_completion(req_i, rid)
+                try:
+                    async for out in gen:
+                        if out.error:
+                            raise RuntimeError(out.error)
+                        if out.text:
+                            text_parts.append(out.text)
+                            timer.on_token(len(out.token_ids) or 1)
+                        if out.logprobs_content:
+                            lp_entries.extend(out.logprobs_content)
+                        if out.finish_reason is not None:
+                            finish = out.finish_reason.to_openai()
+                            u = Usage(
+                                prompt_tokens=out.prompt_tokens or 0,
+                                completion_tokens=out.completion_tokens or 0,
+                                total_tokens=(out.prompt_tokens or 0)
+                                + (out.completion_tokens or 0))
+                finally:
+                    await gen.aclose()
+                return "".join(text_parts), finish, lp_entries, u
+
+            tasks = [asyncio.create_task(one_choice(i)) for i in range(n)]
             try:
-                async for out in gen:
-                    if out.error:
-                        raise RuntimeError(out.error)
-                    if out.text:
-                        text_parts.append(out.text)
-                        timer.on_token(len(out.token_ids) or 1)
-                    if out.logprobs_content:
-                        lp_entries.extend(out.logprobs_content)
-                    if out.finish_reason is not None:
-                        finish = out.finish_reason.to_openai()
-                        usage = Usage(
-                            prompt_tokens=out.prompt_tokens or 0,
-                            completion_tokens=out.completion_tokens or 0,
-                            total_tokens=(out.prompt_tokens or 0) + (out.completion_tokens or 0))
-            finally:
-                await gen.aclose()
-            body = CompletionResponse(
-                id=request_id, created=now_unix(), model=req.model,
-                choices=[CompletionChoice(
-                    text="".join(text_parts),
+                results = await asyncio.gather(*tasks)
+            except BaseException:
+                for t in tasks:
+                    t.cancel()
+                raise
+            usage = Usage()
+            choices = []
+            for i, (text, finish, lp_entries, u) in enumerate(results):
+                choices.append(CompletionChoice(
+                    index=i, text=text,
                     finish_reason=finish or "stop",
                     logprobs=(_legacy_logprobs(lp_entries)[0]
-                              if lp_entries else None))],
-                usage=usage)
+                              if lp_entries else None)))
+                usage.prompt_tokens = u.prompt_tokens
+                usage.completion_tokens += u.completion_tokens
+            usage.total_tokens = (usage.prompt_tokens
+                                  + usage.completion_tokens)
+            body = CompletionResponse(
+                id=request_id, created=now_unix(), model=req.model,
+                choices=choices, usage=usage)
             timer.done("200", usage.prompt_tokens)
             return web.json_response(body.model_dump(exclude_none=True))
         except ValueError as e:

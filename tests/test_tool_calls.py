@@ -291,3 +291,27 @@ class TestValidation:
                 assert r.status == 400
         finally:
             await service.stop()
+
+
+class TestCompletionsMultiChoice:
+    async def test_aggregated_n2_and_stream_rejected(self):
+        service = await _service_for("legacy text")
+        base = f"http://127.0.0.1:{service.port}/v1/completions"
+        try:
+            async with aiohttp.ClientSession() as s:
+                r = await (await s.post(base, json={
+                    "model": "tool-model", "prompt": "p", "n": 2,
+                    "max_tokens": 64})).json()
+                assert [c["index"] for c in r["choices"]] == [0, 1]
+                assert all(c["text"] == "legacy text"
+                           for c in r["choices"])
+                assert r["usage"]["completion_tokens"] % 2 == 0
+                resp = await s.post(base, json={
+                    "model": "tool-model", "prompt": "p", "n": 2,
+                    "stream": True})
+                assert resp.status == 501
+                resp = await s.post(base, json={
+                    "model": "tool-model", "prompt": "p", "n": 999})
+                assert resp.status == 400
+        finally:
+            await service.stop()
