@@ -44,8 +44,11 @@ NEG_INF = -1e30
 PAGES_PER_CHUNK = 8
 
 # query rows per grid program: SB * Hq * Dh bf16 + f32 scores/acc must fit
-# VMEM next to the double-buffered kv slabs
-QUERY_BLOCK = 256
+# VMEM next to the double-buffered kv slabs. At Llama-3B geometry
+# (Hkv=8, G=3, Dh=128) 128 rows put the working set near ~8 MB — half the
+# ~16 MB VMEM budget, leaving headroom for Mosaic temporaries (256 rows
+# measured ~13.5 MB on paper: too close to debut on hardware untested)
+QUERY_BLOCK = 128
 
 
 def _prefill_kernel(q_ref, kv_hbm, layer_ref, table_ref, qstart_ref,

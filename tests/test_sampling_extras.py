@@ -177,3 +177,30 @@ class TestLogitBias:
             assert 7 not in banned
         finally:
             await eng.stop()
+
+
+class TestMultihostBroadcast:
+    def test_penalty_arrays_roundtrip_the_step_codec(self):
+        """Multihost leaders broadcast the step's host arrays; the new
+        penalty/seed keys must survive _pack_arrays/_unpack_arrays bit-
+        exactly or followers would run a DIFFERENT step program (pen=None
+        vs pen) and diverge."""
+        from dynamo_tpu.parallel.multihost import (
+            _pack_arrays, _unpack_arrays)
+        a = {
+            "toks": np.arange(8, dtype=np.int32).reshape(4, 2),
+            "pen_ids": np.arange(12, dtype=np.int32).reshape(4, 3),
+            "pen_cnt": np.ones((4, 3), np.float32),
+            "pen_ctx": np.zeros((4, 3), np.float32),
+            "pen_bias": np.full((4, 3), -2.5, np.float32),
+            "pen_fp": np.full(4, 0.5, np.float32),
+            "pen_pp": np.zeros(4, np.float32),
+            "pen_rp": np.ones(4, np.float32),
+            "pen_active": np.ones(1, np.int32),
+            "seeds": np.asarray([0, 7, 0, 9], np.int32),
+        }
+        back = _unpack_arrays(_pack_arrays("step", a, 3))
+        assert set(back) == set(a)
+        for k in a:
+            np.testing.assert_array_equal(back[k], a[k])
+            assert back[k].dtype == a[k].dtype
