@@ -311,7 +311,8 @@ class JaxEngine(ScheduledEngineBase):
             logits, key, temperature, top_k, top_p, seeds=seeds,
             # seeded rows key on (base rng, seed, token position): replays
             # are deterministic under any batching/step interleaving
-            seed_rng=rng, seed_pos=total_lens)
+            seed_rng=rng, seed_pos=total_lens,
+            min_p=pen["min_p"] if pen is not None else None)
         cols = [sampled[:, None],
                 jax.lax.bitcast_convert_type(logprobs, jnp.int32)[:, None]]
         K = self.cfg.num_top_logprobs
@@ -347,6 +348,7 @@ class JaxEngine(ScheduledEngineBase):
         fp = np.zeros(B, np.float32)
         pp = np.zeros(B, np.float32)
         rp = np.ones(B, np.float32)
+        min_p = np.zeros(B, np.float32)
         any_active = False
         for i, seq in enumerate(rows):
             so = seq.request.sampling_options
@@ -354,6 +356,9 @@ class JaxEngine(ScheduledEngineBase):
                 # map any integer seed (0 included — valid per the OpenAI
                 # API) into [1, 2^31-1]; 0 stays the unseeded sentinel
                 out["seeds"][i] = (int(so.seed) % 0x7FFFFFFF) + 1
+                any_active = True
+            if so.min_p:
+                min_p[i] = so.min_p
                 any_active = True
             f = so.frequency_penalty or 0.0
             p = so.presence_penalty or 0.0
@@ -401,7 +406,7 @@ class JaxEngine(ScheduledEngineBase):
             # host->device arrays, single batch-wide gumbel draw)
             return {}
         out.update(pen_ids=ids, pen_cnt=cnt, pen_ctx=ctx, pen_bias=bias,
-                   pen_fp=fp, pen_pp=pp, pen_rp=rp,
+                   pen_fp=fp, pen_pp=pp, pen_rp=rp, pen_min_p=min_p,
                    pen_active=np.ones(1, np.int32))
         return out
 
@@ -425,6 +430,8 @@ class JaxEngine(ScheduledEngineBase):
             "fp": jnp.asarray(a.get("pen_fp", np.zeros(B, np.float32))),
             "pp": jnp.asarray(a.get("pen_pp", np.zeros(B, np.float32))),
             "rp": jnp.asarray(a.get("pen_rp", np.ones(B, np.float32))),
+            "min_p": jnp.asarray(a.get("pen_min_p",
+                                       np.zeros(B, np.float32))),
             "seeds": jnp.asarray(a.get("seeds", np.zeros(B, np.int32))),
         }
 

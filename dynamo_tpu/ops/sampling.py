@@ -92,7 +92,8 @@ def sample_tokens(logits: jnp.ndarray, rng: jax.Array,
                   temperature: jnp.ndarray, top_k: jnp.ndarray,
                   top_p: jnp.ndarray, seeds: Optional[jnp.ndarray] = None,
                   seed_rng: Optional[jax.Array] = None,
-                  seed_pos: Optional[jnp.ndarray] = None):
+                  seed_pos: Optional[jnp.ndarray] = None,
+                  min_p: Optional[jnp.ndarray] = None):
     """Sample next tokens.
 
     logits: [B, V] (any float dtype; promoted to f32)
@@ -126,6 +127,11 @@ def sample_tokens(logits: jnp.ndarray, rng: jax.Array,
     # probability reaches top_p (always keep the first).
     cum = jnp.cumsum(probs, axis=-1)
     keep_p = (cum - probs) < top_p[:, None]
+    if min_p is not None:
+        # min_p (vLLM semantics): drop candidates whose post-temperature
+        # probability falls below min_p x the best candidate's (0 = off;
+        # candidate 0 always survives: probs[...,0] is the max)
+        keep_p &= probs >= min_p[:, None] * probs[:, :1]
     scaled = jnp.where(keep_p, scaled, -jnp.inf)
 
     if seeds is None:

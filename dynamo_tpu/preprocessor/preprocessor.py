@@ -146,6 +146,7 @@ class OpenAIPreprocessor:
             presence_penalty=req.presence_penalty,
             repetition_penalty=req.repetition_penalty,
             logit_bias=self._validate_logit_bias(req.logit_bias),
+            min_p=req.min_p,
             seed=req.seed,
             n=req.n,
             logprobs=logprobs,

@@ -86,6 +86,9 @@ class SamplingOptions:
     logprobs: Optional[int] = None
     # OpenAI logit_bias: token id -> additive bias (-100 bans, +100 forces)
     logit_bias: Optional[Dict[int, float]] = None
+    # vLLM-style min_p: drop candidates whose probability is below
+    # min_p * max-candidate-probability (0 = off)
+    min_p: Optional[float] = None
 
     def to_dict(self) -> Dict[str, Any]:
         d = _asdict_shallow(self)
@@ -96,7 +99,8 @@ class SamplingOptions:
     def from_dict(cls, d: Dict[str, Any]) -> "SamplingOptions":
         kw = {k: d.get(k) for k in (
             "temperature", "top_p", "top_k", "frequency_penalty",
-            "presence_penalty", "repetition_penalty", "seed", "logprobs")}
+            "presence_penalty", "repetition_penalty", "seed", "logprobs",
+            "min_p")}
         lb = d.get("logit_bias")
         if lb:
             # wire form may carry string token-id keys (OpenAI JSON)

@@ -67,6 +67,7 @@ class ChatCompletionRequest(BaseModel):
     frequency_penalty: Optional[float] = None
     repetition_penalty: Optional[float] = None  # extension
     logit_bias: Optional[Dict[str, float]] = None
+    min_p: Optional[float] = Field(default=None, ge=0.0, le=1.0)  # vLLM-style
     logprobs: Optional[bool] = None
     top_logprobs: Optional[int] = None
     seed: Optional[int] = None
@@ -104,6 +105,7 @@ class CompletionRequest(BaseModel):
     frequency_penalty: Optional[float] = None
     repetition_penalty: Optional[float] = None
     logit_bias: Optional[Dict[str, float]] = None
+    min_p: Optional[float] = Field(default=None, ge=0.0, le=1.0)  # vLLM-style
     seed: Optional[int] = None
     user: Optional[str] = None
     nvext: Optional[Extensions] = None

@@ -204,3 +204,41 @@ class TestMultihostBroadcast:
         for k in a:
             np.testing.assert_array_equal(back[k], a[k])
             assert back[k].dtype == a[k].dtype
+
+
+class TestMinP:
+    def test_min_p_masks_candidates(self):
+        import jax
+        from dynamo_tpu.ops.sampling import sample_tokens
+        # two clear leaders, a long tail: min_p=0.5 must only ever sample
+        # the leaders (tail prob << half the max)
+        logits = jnp.asarray(np.array([[5.0, 4.9] + [0.0] * 48]),
+                             jnp.float32)
+        seen = set()
+        for s in range(40):
+            t, _ = sample_tokens(
+                logits, jax.random.PRNGKey(s),
+                jnp.ones(1), jnp.zeros(1, jnp.int32), jnp.ones(1),
+                min_p=jnp.asarray([0.5], jnp.float32))
+            seen.add(int(t[0]))
+        assert seen <= {0, 1}
+        # min_p=0 disables: the tail is reachable at high temperature
+        seen0 = set()
+        for s in range(60):
+            t, _ = sample_tokens(
+                logits, jax.random.PRNGKey(s),
+                jnp.full((1,), 5.0), jnp.zeros(1, jnp.int32), jnp.ones(1),
+                min_p=jnp.asarray([0.0], jnp.float32))
+            seen0.add(int(t[0]))
+        assert len(seen0 - {0, 1}) > 0
+
+    async def test_min_p_end_to_end(self):
+        eng = _engine()
+        try:
+            toks = await _run(eng, _req("mp", temperature=1.0, min_p=1.0,
+                                        seed=3))
+            # min_p=1.0 keeps only the argmax: equivalent to greedy
+            greedy = await _run(eng, _req("g", temperature=0.0))
+            assert toks == greedy
+        finally:
+            await eng.stop()
