@@ -73,6 +73,9 @@ class ModelConfig:
     rope_mscale: float = 0.0
     rope_mscale_all_dim: float = 0.0
     rope_attention_factor: float = 0.0  # 0 = infer from factor/mscale
+    # deepseek rope convention: True = complex-pair interleaved (the HF
+    # default for this family), False = llama-style rotate-half halves
+    rope_interleave: bool = True
     # gemma-2 family (models/gemma.py)
     sliding_window: int = 0            # 0 = all layers global attention
     attn_logit_softcap: float = 0.0    # 0 = disabled
@@ -106,7 +109,12 @@ class ModelConfig:
                     hf.get("first_k_dense_replace") or 0),
                 routed_scaling_factor=float(
                     hf.get("routed_scaling_factor") or 1.0),
-                topk_method=hf.get("topk_method", "greedy"),
+                # V3 checkpoints route with the aux-loss-free sigmoid gate;
+                # HF's DeepseekV3Config does not serialize topk_method, so
+                # the model type implies it
+                topk_method=hf.get(
+                    "topk_method",
+                    "noaux_tc" if mt == "deepseek_v3" else "greedy"),
                 n_group=int(hf.get("n_group") or 1),
                 topk_group=int(hf.get("topk_group") or 1),
             )
@@ -129,6 +137,8 @@ class ModelConfig:
                 raise NotImplementedError(
                     f"deepseek rope_scaling type {rtype!r} (only yarn is "
                     "implemented)")
+            extra["rope_interleave"] = bool(
+                hf.get("rope_interleave", True))
         mla = bool(extra.get("kv_lora_rank"))
         return cls(
             vocab_size=hf["vocab_size"],

@@ -18,18 +18,21 @@ Attention in its **absorbed** inference form:
   This is algebraically identical to the HF eager path
   (``transformers/models/deepseek_v2/modeling_deepseek_v2.py:339-430``,
   checked by the parity test).
-- RoPE is the INTERLEAVED (complex-pair) convention HF uses for this
-  family (``apply_rotary_emb`` with ``view_as_complex``) — not llama's
-  rotate-half.
+- RoPE follows ``cfg.rope_interleave``: the complex-pair convention HF
+  defaults to for this family, or llama's rotate-half when a checkpoint
+  ships de-interleaved weights; V3 additionally folds the yarn mscale
+  into the softmax scale (``_mla_scale``).
 - Layers are heterogeneous (``first_k_dense_replace`` dense layers, then
   MoE): the scan forward runs TWO scans over two stacked pytrees
   (``dense_layers`` / ``moe_layers``) sharing one paged cache, keeping the
   single-compiled-layer-body property per layer kind.
-- The MoE gate matches HF exactly: f32 softmax scores, ``greedy`` or
-  ``group_limited_greedy`` top-k, weights scaled by
-  ``routed_scaling_factor`` (no renorm); routed experts compute densely
-  with the routing weights as a mask (ep-shardable, same trade as
-  ``models/moe.py``), plus the always-on shared experts.
+- The MoE gate matches HF exactly per generation: V2's f32 softmax scores
+  with ``greedy`` / ``group_limited_greedy`` top-k (no renorm), and V3's
+  aux-loss-free ``noaux_tc`` gate (sigmoid scores, e_score_correction_bias
+  group selection, renormalized weights) — both scaled by
+  ``routed_scaling_factor``; routed experts compute densely or via the
+  capacity dispatch (``cfg.moe_backend``), plus the always-on shared
+  experts.
 
 Weight layout matches HF checkpoints after transpose; ``load_params``
 assembles the two layer stacks from safetensors.
@@ -102,11 +105,16 @@ def yarn_freqs(cfg: ModelConfig) -> Tuple[np.ndarray, float]:
 def rope_interleaved(x: jnp.ndarray, positions: jnp.ndarray,
                      theta: float,
                      inv_freq: Optional[np.ndarray] = None,
-                     scale: float = 1.0) -> jnp.ndarray:
-    """Complex-pair RoPE (HF deepseek ``apply_rotary_emb``): consecutive
-    element PAIRS (x[2i], x[2i+1]) rotate by the position angle, the
-    result scaled by the yarn ``attention_factor`` (HF multiplies the
-    freqs_cis magnitude). x: [B, S, ..., D]; positions: [B, S]."""
+                     scale: float = 1.0,
+                     interleaved: bool = True) -> jnp.ndarray:
+    """RoPE in either deepseek convention, the result scaled by the yarn
+    ``attention_factor`` (HF multiplies the cos/sin magnitude):
+
+    - ``interleaved=True`` — complex-pair (HF ``apply_rotary_emb`` /
+      ``rope_interleave=True``): consecutive PAIRS (x[2i], x[2i+1]) rotate;
+    - ``interleaved=False`` — llama rotate-half over (x[:D/2], x[D/2:]).
+
+    x: [B, S, ..., D]; positions: [B, S]."""
     D = x.shape[-1]
     if inv_freq is None:
         inv_freq = 1.0 / (theta ** (jnp.arange(0, D, 2,
@@ -117,10 +125,17 @@ def rope_interleaved(x: jnp.ndarray, positions: jnp.ndarray,
     while ang.ndim < x.ndim:
         ang = ang[..., None, :]
     cos, sin = jnp.cos(ang) * scale, jnp.sin(ang) * scale
-    xr = x[..., 0::2].astype(jnp.float32)
-    xi = x[..., 1::2].astype(jnp.float32)
-    out = jnp.stack([xr * cos - xi * sin, xr * sin + xi * cos], axis=-1)
-    return out.reshape(x.shape).astype(x.dtype)
+    if interleaved:
+        xr = x[..., 0::2].astype(jnp.float32)
+        xi = x[..., 1::2].astype(jnp.float32)
+        out = jnp.stack([xr * cos - xi * sin, xr * sin + xi * cos],
+                        axis=-1)
+        return out.reshape(x.shape).astype(x.dtype)
+    x1 = x[..., :D // 2].astype(jnp.float32)
+    x2 = x[..., D // 2:].astype(jnp.float32)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin],
+                          axis=-1)
+    return out.astype(x.dtype)
 
 
 # ------------------------------------------------------------------- params
@@ -186,6 +201,8 @@ def init_params(cfg: ModelConfig, rng: jax.Array,
         ml = _attn_leaves(cfg, k_moe, scale, M)
         ks = iter(jax.random.split(jax.random.fold_in(k_moe, 1), 8))
         ml["w_router"] = randn(next(ks), (M, H, E))
+        if cfg.topk_method == "noaux_tc":
+            ml["router_bias"] = jnp.zeros((M, E), jnp.float32)
         ml["w_gate"] = randn(next(ks), (M, E, H, Im))
         ml["w_up"] = randn(next(ks), (M, E, H, Im))
         ml["w_down"] = randn(next(ks), (M, E, Im, H))
@@ -219,12 +236,14 @@ def _mla_qkv(cfg: ModelConfig, lp: Dict[str, jnp.ndarray], h: jnp.ndarray,
     q_nope, q_pe = q[..., :dn], q[..., dn:]
     inv_freq, att_scale = yarn_freqs(cfg)
     q_pe = rope_interleaved(q_pe, positions, cfg.rope_theta,
-                            inv_freq=inv_freq, scale=att_scale)
+                            inv_freq=inv_freq, scale=att_scale,
+                            interleaved=cfg.rope_interleave)
 
     ckv = x @ lp["wkv_a"]                                  # [B,S,dkv+dr]
     c_kv = _rms_norm(ckv[..., :dkv], lp["kv_a_norm"], eps)
     k_pe = rope_interleaved(ckv[..., dkv:], positions, cfg.rope_theta,
-                            inv_freq=inv_freq, scale=att_scale)
+                            inv_freq=inv_freq, scale=att_scale,
+                            interleaved=cfg.rope_interleave)
 
     w_kb = lp["wkv_b"].reshape(dkv, nh, dn + dv)
     w_uk = w_kb[..., :dn].transpose(1, 0, 2)               # [nh, dkv, dn]
@@ -247,6 +266,23 @@ def _cache_rows(cfg: ModelConfig, c_kv: jnp.ndarray, k_pe: jnp.ndarray):
 PAGES_PER_CHUNK = 8
 
 
+def _mla_scale(cfg: ModelConfig) -> float:
+    """Softmax scale. V3 folds the yarn mscale into the SCORE scale
+    (``modeling_deepseek_v3.py:371-377``: scaling *= mscale^2 when
+    rope_scaling carries mscale_all_dim); V2 expresses it through the
+    rope attention_factor instead (handled in ``yarn_freqs``)."""
+    import math
+
+    scale = (cfg.qk_nope_head_dim + cfg.qk_rope_head_dim) ** -0.5
+    if (cfg.model_type == "deepseek_v3" and cfg.rope_scaling_factor
+            and cfg.rope_mscale_all_dim):
+        m = (0.1 * cfg.rope_mscale_all_dim
+             * math.log(cfg.rope_scaling_factor) + 1.0
+             if cfg.rope_scaling_factor > 1 else 1.0)
+        scale *= m * m
+    return scale
+
+
 def _expand_and_project(cfg: ModelConfig, lp, h, lat, w_uv) -> jnp.ndarray:
     """lat [B,S,nh,dkv] latent attention output -> W_UV expand -> wo
     residual."""
@@ -264,7 +300,7 @@ def _mla_attend(cfg: ModelConfig, lp: Dict[str, jnp.ndarray],
     """Latent-space attention + output projection residual (direct path:
     decode steps / small tables — the full [B,nh,S,T] scores fit).
     ckv_ctx/kpe_ctx: [B, T, dkv] / [B, T, dr] gathered context."""
-    sm_scale = (cfg.qk_nope_head_dim + cfg.qk_rope_head_dim) ** -0.5
+    sm_scale = _mla_scale(cfg)
     T = ckv_ctx.shape[1]
     scores = (jnp.einsum("bsnk,btk->bnst", q_lat,
                          ckv_ctx.astype(jnp.float32))
@@ -293,7 +329,7 @@ def _mla_attend_blockwise(cfg: ModelConfig, lp, h, q_lat, q_pe, w_uv,
     ``ops/attention._attend_blockwise`` exists for)."""
     B, S, H = h.shape
     nh, dkv = cfg.num_heads, cfg.kv_lora_rank
-    sm_scale = (cfg.qk_nope_head_dim + cfg.qk_rope_head_dim) ** -0.5
+    sm_scale = _mla_scale(cfg)
     span = PAGES_PER_CHUNK * ps
     n_static = -(-num_table_pages // PAGES_PER_CHUNK)
     n_chunks = jnp.minimum(
@@ -341,8 +377,12 @@ def _gather_ctx(cfg: ModelConfig, gathered: jnp.ndarray):
 # --------------------------------------------------------------------- MoE
 
 def _gate(cfg: ModelConfig, lp: Dict[str, jnp.ndarray], x: jnp.ndarray):
-    """HF-exact DeepSeek gate: f32 softmax scores, greedy or group-limited
-    top-k, scaled by routed_scaling_factor (no renorm)."""
+    """HF-exact DeepSeek gate, per generation: V2 = f32 softmax scores
+    with greedy / group-limited top-k (no renorm); V3 (``noaux_tc``) =
+    the sigmoid + e_score_correction_bias gate (``_gate_noaux``). Both
+    scale by routed_scaling_factor."""
+    if cfg.topk_method == "noaux_tc":
+        return _gate_noaux(cfg, lp, x)
     scores = jax.nn.softmax(
         (x.astype(jnp.float32) @ lp["w_router"].astype(jnp.float32)),
         axis=-1)                                           # [B,S,E]
@@ -360,9 +400,31 @@ def _gate(cfg: ModelConfig, lp: Dict[str, jnp.ndarray], x: jnp.ndarray):
     elif cfg.topk_method == "greedy":
         top_w, top_i = jax.lax.top_k(scores, k)
     else:
-        raise NotImplementedError(
-            f"topk_method {cfg.topk_method!r} (noaux_tc needs the "
-            "e_score_correction_bias weights — not wired yet)")
+        raise NotImplementedError(f"topk_method {cfg.topk_method!r}")
+    return top_w * cfg.routed_scaling_factor, top_i
+
+
+def _gate_noaux(cfg: ModelConfig, lp: Dict[str, jnp.ndarray],
+                x: jnp.ndarray):
+    """V3 aux-loss-free gate (``DeepseekV3TopkRouter``): sigmoid scores,
+    bias-corrected group-limited selection (group score = sum of its top-2
+    corrected scores), weights taken from the UNCORRECTED scores,
+    normalized (+1e-20) when norm_topk_prob, scaled."""
+    scores = jax.nn.sigmoid(
+        x.astype(jnp.float32) @ lp["w_router"].astype(jnp.float32))
+    sfc = scores + lp["router_bias"].astype(jnp.float32)   # [B,S,E]
+    B, S, E = scores.shape
+    g, k = cfg.n_group, cfg.num_experts_per_tok
+    group_scores = jnp.sum(
+        jax.lax.top_k(sfc.reshape(B, S, g, E // g), 2)[0], axis=-1)
+    _gv, gi = jax.lax.top_k(group_scores, cfg.topk_group)
+    group_mask = jnp.sum(jax.nn.one_hot(gi, g, dtype=sfc.dtype), axis=2)
+    score_mask = jnp.repeat(group_mask, E // g, axis=-1)
+    masked = jnp.where(score_mask > 0, sfc, 0.0)
+    _w, top_i = jax.lax.top_k(masked, k)
+    top_w = jnp.take_along_axis(scores, top_i, axis=-1)
+    if cfg.norm_topk_prob:
+        top_w = top_w / (jnp.sum(top_w, axis=-1, keepdims=True) + 1e-20)
     return top_w * cfg.routed_scaling_factor, top_i
 
 
@@ -537,6 +599,7 @@ def load_params(cfg: ModelConfig, path: str,
     }
     moe_mlp_names = {
         "mlp.gate.weight": ("w_router", True),
+        "mlp.gate.e_score_correction_bias": ("router_bias", False),
         "mlp.shared_experts.gate_proj.weight": ("ws_gate", True),
         "mlp.shared_experts.up_proj.weight": ("ws_up", True),
         "mlp.shared_experts.down_proj.weight": ("ws_down", True),
@@ -619,7 +682,11 @@ def load_params(cfg: ModelConfig, path: str,
         node = params
         for k in tree_path[:-1]:
             node = node.setdefault(k, {})
-        leaf = jnp.asarray(arr).astype(dtype)
+        # the V3 gate's e_score_correction_bias stays f32: rounding it to
+        # bf16 flips expert selections near group/top-k boundaries
+        leaf_dtype = (jnp.float32 if tree_path[-1] == "router_bias"
+                      else dtype)
+        leaf = jnp.asarray(arr).astype(leaf_dtype)
         if shardings is not None:
             spec = shardings
             for k in tree_path:

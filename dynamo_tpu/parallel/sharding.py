@@ -138,6 +138,7 @@ class ModelSharding:
         moe = dict(attn)
         moe.update(
             w_router=P(),
+            router_bias=P(),
             w_gate=P(None, "ep", None, "tp"),
             w_up=P(None, "ep", None, "tp"),
             w_down=P(None, "ep", "tp", None),

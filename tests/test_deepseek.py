@@ -181,11 +181,36 @@ class TestGate:
                 for e in np.asarray(top_i)[b, s]:
                     assert e // 2 in allowed_groups
 
-    def test_noaux_tc_rejected(self):
-        cfg = ds_cfg(topk_method="noaux_tc")
-        lp = {"w_router": jnp.zeros((64, 4), jnp.float32)}
-        with pytest.raises(NotImplementedError):
-            deepseek._gate(cfg, lp, jnp.zeros((1, 1, 64), jnp.float32))
+    def test_noaux_tc_matches_numpy_reference(self):
+        """V3 gate: sigmoid scores, bias-corrected top-2-sum group
+        selection, weights from UNCORRECTED scores, renormalized."""
+        cfg = ds_cfg(num_experts=8, topk_method="noaux_tc", n_group=4,
+                     topk_group=2, num_experts_per_tok=2,
+                     norm_topk_prob=True, routed_scaling_factor=2.0)
+        rng = np.random.RandomState(8)
+        w = rng.randn(64, 8).astype(np.float32)
+        b = rng.uniform(-0.5, 0.5, 8).astype(np.float32)
+        lp = {"w_router": jnp.asarray(w), "router_bias": jnp.asarray(b)}
+        x = rng.randn(2, 3, 64).astype(np.float32)
+        top_w, top_i = deepseek._gate(cfg, lp, jnp.asarray(x))
+        scores = 1 / (1 + np.exp(-(x @ w)))
+        sfc = scores + b
+        for bi in range(2):
+            for s in range(3):
+                gs = np.sort(sfc[bi, s].reshape(4, 2), -1)[:, ::-1]
+                group_sum = gs[:, :2].sum(-1)
+                keep_groups = set(np.argsort(-group_sum)[:2])
+                masked = np.where(
+                    [e // 2 in keep_groups for e in range(8)],
+                    sfc[bi, s], 0.0)
+                want_i = set(np.argsort(-masked)[:2])
+                got_i = set(np.asarray(top_i)[bi, s])
+                assert got_i == want_i
+                wsum = scores[bi, s][list(got_i)].sum() + 1e-20
+                for j, e in enumerate(np.asarray(top_i)[bi, s]):
+                    np.testing.assert_allclose(
+                        np.asarray(top_w)[bi, s, j],
+                        scores[bi, s, e] / wsum * 2.0, rtol=1e-5)
 
 
 class TestHfParity:
@@ -329,3 +354,66 @@ class TestEngine:
             assert eng.pages.shape[2:] == (2, 1, 4, 32)
         finally:
             await eng.stop()
+
+
+class TestV3Parity:
+    def test_matches_transformers_deepseek_v3(self, tmp_path):
+        """V3: noaux_tc sigmoid gate with e_score_correction_bias, q_lora,
+        rope_interleave, yarn mscale in the softmax scale — logits parity
+        against transformers' DeepseekV3."""
+        torch = pytest.importorskip("torch")
+        from transformers import DeepseekV3Config, DeepseekV3ForCausalLM
+
+        hf_cfg = DeepseekV3Config(
+            vocab_size=128, hidden_size=64, intermediate_size=96,
+            moe_intermediate_size=32, num_hidden_layers=3,
+            num_attention_heads=4, num_key_value_heads=4,
+            n_routed_experts=8, n_shared_experts=1, num_experts_per_tok=2,
+            n_group=4, topk_group=2, norm_topk_prob=True,
+            first_k_dense_replace=1, routed_scaling_factor=2.5,
+            q_lora_rank=24, kv_lora_rank=32, qk_rope_head_dim=16,
+            qk_nope_head_dim=32, v_head_dim=32,
+            max_position_embeddings=256, rms_norm_eps=1e-6,
+            rope_theta=10000.0, tie_word_embeddings=False,
+            rope_scaling={"type": "yarn", "factor": 4.0,
+                          "original_max_position_embeddings": 64,
+                          "mscale": 1.0, "mscale_all_dim": 1.0,
+                          "beta_fast": 32, "beta_slow": 1},
+            attn_implementation="eager")
+        torch.manual_seed(3)
+        model = DeepseekV3ForCausalLM(hf_cfg).eval()
+        # give the correction bias real (nonzero) values so the test
+        # actually exercises the biased group selection
+        with torch.no_grad():
+            for layer in model.model.layers[1:]:
+                layer.mlp.gate.e_score_correction_bias.uniform_(-0.5, 0.5)
+        model.save_pretrained(tmp_path, safe_serialization=True)
+
+        cfg = ModelConfig.from_pretrained(str(tmp_path), dtype="float32")
+        assert cfg.topk_method == "noaux_tc"
+        assert cfg.q_lora_rank == 24
+        from dynamo_tpu.models.hf_loader import load_hf_params
+        params = load_hf_params(cfg, str(tmp_path))
+        assert "router_bias" in params["moe_layers"]
+
+        prompt = [3, 17, 42, 99, 5, 64, 23, 81]
+        with torch.no_grad():
+            ref = model(torch.tensor([prompt])).logits[0, -1].numpy()
+        pages = make_pages(cfg, 6, 8, dtype=jnp.float32)
+        logits, _ = _prefill(params, cfg, [prompt], pages, _alloc(1, 4))
+        np.testing.assert_allclose(np.asarray(logits[0]), ref,
+                                   rtol=3e-3, atol=3e-3)
+
+
+def test_sharding_covers_noaux_router_bias():
+    """V3 pytrees carry router_bias; shard_params must have a spec for it
+    (KeyError here would crash sharded serving at startup)."""
+    from dynamo_tpu.parallel import MeshSpec, ModelSharding, make_mesh
+    cfg = ds_cfg(num_experts=8, topk_method="noaux_tc", n_group=4,
+                 topk_group=2)
+    mesh = make_mesh(MeshSpec(tp=2, ep=2), devices=jax.devices()[:4])
+    params = deepseek.init_params(cfg, jax.random.PRNGKey(0))
+    assert params["moe_layers"]["router_bias"].dtype == jnp.float32
+    placed = ModelSharding(cfg, mesh).shard_params(params)
+    rb = placed["moe_layers"]["router_bias"]
+    assert rb.sharding.shard_shape(rb.shape) == rb.shape  # replicated
