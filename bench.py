@@ -17,21 +17,33 @@ bandwidth-saturating engine, so this is comparable chip-to-chip — the
 reference's H100 stacks sit around 0.5-0.7 of their equivalent roofline.
 Diagnostics (TTFT, step counts) go to stderr.
 
-Robustness (three rounds of lessons: the tunneled TPU backend can hang for
-minutes on init, and round 2's one good window died in a cold compile):
+Robustness (FOUR rounds of lessons: the tunneled TPU backend can hang for
+hours at init, and a successful init is precious):
 
-- The default entry is an ORCHESTRATOR that never imports jax. It probes the
-  TPU CONTINUOUSLY from t=0 across the whole budget (not a few front-loaded
-  attempt slots) and launches the measurement the moment a probe succeeds.
-- A separate cache-PRIMING child compiles the step programs one at a time
-  into jax's persistent compilation cache before the measurement child runs,
-  so a killed attempt still leaves later attempts warm program-by-program.
-- TIERED configs: full (3B, bs32×512+128) → reduced (3B, bs16×256+64) —
+- ONE child process does probe -> prime -> measure END TO END: the jax
+  import + ``jax.devices()`` that used to be a throwaway probe child IS the
+  probe, and the same process that won it proceeds straight into engine
+  build, per-program compile priming, and the timed run. Round 4 burned up
+  to three independent TPU inits per attempt (probe child, prime child,
+  measure child) — on a tunnel where init is the flaky step, that threw a
+  successful init away twice.
+- The child carries an INTERNAL WATCHDOG thread with per-stage budgets; a
+  stage that stalls gets a final ``hung`` checkpoint and a hard exit, so
+  the orchestrator's only job is restart-and-degrade.
+- The child emits incremental ``bench-ckpt: {...}`` JSON checkpoints on
+  stderr (init OK / engine built / each program primed / steps run). The
+  orchestrator forwards them, tracks the furthest stage any attempt
+  reached, and records it in the final JSON (``best_progress``) — so even
+  a failed round proves exactly how far the chip let us get.
+- TIERED configs: full (3B, bs32x512+128) -> reduced (3B, bs16x256+64) —
   both ``valid: true`` on-chip numbers — then a CPU tiny fallback marked
   ``valid: false``.
-- The engine's TPU path is now scan-over-layers with the layer-indexed
-  Pallas decode kernel (one compiled layer body), which cuts the cold
-  compile that killed round 2 by ~the layer count.
+- Compiled programs also land in jax's persistent compilation cache
+  (utils/platform.enable_compilation_cache), so any later run — including
+  the driver's end-of-round one — starts warm program-by-program.
+- If the measurement finishes with budget to spare, the SAME child runs the
+  ``--ab`` attn-impl A/B (scan+pallas vs pallas_unrolled, the round-4 open
+  question) without paying another init.
 """
 
 from __future__ import annotations
@@ -39,10 +51,12 @@ from __future__ import annotations
 import argparse
 import asyncio
 import json
+import math
 import os
 import statistics
 import subprocess
 import sys
+import threading
 import time
 
 HBM_GBPS = {
@@ -66,6 +80,67 @@ TIERS = {
     "reduced": (16, 256, 64),
 }
 
+# per-stage watchdog budgets (seconds). Generous vs the round-3 on-chip
+# measurements (20.4s worst compile) but tight enough that a hung tunnel
+# call dies inside the attempt instead of eating the whole budget.
+STAGE_BUDGETS = {
+    "jax_init": 80.0,
+    "engine_build": 150.0,
+    "prime": 240.0,       # per program
+    "warmup": 300.0,
+    "measure": 300.0,
+    "transport": 150.0,   # per transport measurement
+    # minimum remaining budget to start the A/B extra run: a second engine
+    # build + cold primes of the alternate impl (pallas_unrolled compiles
+    # per-layer programs) + a measurement. Rarely fits the driver's default
+    # 520s budget after a full main run (recorded as skipped); the tunnel
+    # watcher (tools/bench_on_up.sh) runs with a budget sized to reach it.
+    "ab": 300.0,
+}
+
+
+def _ckpt(stage: str, **kw) -> None:
+    """Incremental progress checkpoint: one JSON line on stderr. The
+    orchestrator parses these to know how far an attempt got; humans read
+    them in bench_stderr.log."""
+    print("bench-ckpt: " + json.dumps({"stage": stage, **kw}),
+          file=sys.stderr, flush=True)
+
+
+class Watchdog:
+    """Kills the child when the current stage exceeds its budget.
+
+    jax backend init (and a wedged tunnel mid-run) cannot be interrupted
+    from Python, so the only reliable stall guard INSIDE the process is a
+    daemon thread that hard-exits: the orchestrator sees the ``hung``
+    checkpoint + rc=3 and knows the exact stage that died."""
+
+    POLL_S = 2.0
+    EXIT_CODE = 3
+
+    def __init__(self):
+        self._deadline = math.inf
+        self._stage = "-"
+        self._t0 = time.monotonic()
+        t = threading.Thread(target=self._run, daemon=True)
+        t.start()
+
+    def arm(self, stage: str, budget: float) -> None:
+        self._stage = stage
+        self._t0 = time.monotonic()
+        self._deadline = self._t0 + budget
+
+    def disarm(self) -> None:
+        self._deadline = math.inf
+
+    def _run(self) -> None:
+        while True:
+            time.sleep(self.POLL_S)
+            if time.monotonic() > self._deadline:
+                _ckpt("hung", at=self._stage,
+                      s=round(time.monotonic() - self._t0, 1))
+                os._exit(self.EXIT_CODE)
+
 
 def detect_bandwidth() -> float:
     import jax
@@ -85,9 +160,9 @@ def tree_bytes(tree) -> int:
                for x in jax.tree_util.tree_leaves(tree))
 
 
-def _build_engine(args):
-    """The engine both the priming child and the measurement child build —
-    identical config so the persistent compile cache keys match."""
+def _build_engine(tier: str, attn_impl: str):
+    """Build the engine for a tier; config is deterministic per tier so the
+    persistent compile-cache keys match across runs."""
     import jax
 
     from dynamo_tpu.engine.jax_engine import JaxEngine, JaxEngineConfig
@@ -96,18 +171,18 @@ def _build_engine(args):
 
     enable_compilation_cache()
     on_tpu = jax.devices()[0].platform in TPU_PLATFORMS
-    if args.tier == "tiny" or not on_tpu:
+    if tier == "tiny" or not on_tpu:
         cfg = ModelConfig.tiny(dtype="float32")
         seqs, prompt, gen = 4, 32, 16
         page_size, max_ctx = 4, 64
     else:
         cfg = ModelConfig.llama32_3b()
-        seqs, prompt, gen = TIERS[args.tier]
+        seqs, prompt, gen = TIERS[tier]
         page_size, max_ctx = 16, prompt + gen + 64
 
     pages_needed = seqs * ((prompt + gen) // page_size + 2)
     # pin ONE compiled shape per step family ([8, prompt] prefill,
-    # [seqs, 1] decode) so warmup pays every compile and the timed phase
+    # [seqs, 1] decode) so priming pays every compile and the timed phase
     # is pure execution
     prefill_seqs = min(8, seqs)
     ecfg = JaxEngineConfig(
@@ -117,18 +192,19 @@ def _build_engine(args):
         max_context=max_ctx, min_prefill_bucket=min(512, prompt),
         min_prefill_seqs_bucket=prefill_seqs,
         min_decode_bucket=seqs,
-        attn_impl=args.attn_impl)
+        attn_impl=attn_impl)
     engine = JaxEngine.random_init(cfg, ecfg)
     return engine, cfg, (seqs, prompt, gen, prefill_seqs), on_tpu
 
 
-def _prime_programs(engine, seqs: int, prompt: int,
-                    prefill_seqs: int) -> None:
-    """Compile the three step programs one at a time (no requests), each
-    landing in the persistent cache as soon as it finishes — a later
-    measurement child starts warm even if this child is killed mid-way.
-    Prints per-program compile seconds (the on-chip diagnostic three rounds
-    of failed benches never produced)."""
+def _prime_programs(engine, seqs: int, prompt: int, prefill_seqs: int,
+                    wd: Watchdog, label: str = "main") -> None:
+    """Compile the three step programs one at a time (no requests). Each
+    lands in THIS process's jit cache (the measurement reuses the callable
+    directly) AND the persistent disk cache (a later driver run starts
+    warm even if this attempt dies right after). One checkpoint per
+    program — the on-chip compile-time diagnostic three rounds of failed
+    benches never produced."""
     import jax
     import numpy as np
 
@@ -149,22 +225,25 @@ def _prime_programs(engine, seqs: int, prompt: int,
              ("decode", "step", arrays(seqs, 1)),
              ("chained", "chained", arrays(seqs, 1))]
     for name, kind, a in plans:
+        wd.arm(f"prime:{name}", STAGE_BUDGETS["prime"])
         t0 = time.perf_counter()
         packed = engine._invoke_step(kind, a, 0)
         jax.block_until_ready(packed)
-        print(f"bench: primed {name} [{a['toks'].shape[0]}, "
-              f"{a['toks'].shape[1]}] in {time.perf_counter() - t0:.1f}s",
-              file=sys.stderr, flush=True)
+        _ckpt("primed", program=name, label=label,
+              shape=[int(a["toks"].shape[0]), int(a["toks"].shape[1])],
+              s=round(time.perf_counter() - t0, 1))
 
 
-async def run_bench(args) -> dict:
+async def _measure_engine(engine, cfg, geometry, wd: Watchdog,
+                          label: str) -> dict:
+    """Drive the engine through warmup + the timed run; returns the raw
+    measurement numbers (no transport measurements, no JSON framing)."""
     import numpy as np
 
     from dynamo_tpu.protocols.common import (
         PreprocessedRequest, SamplingOptions, StopConditions)
 
-    engine, cfg, (seqs, prompt, gen, _pfs), on_tpu = _build_engine(args)
-
+    seqs, prompt, gen, _pfs = geometry
     rng = np.random.default_rng(0)
 
     def make_req(rid: str, n_prompt: int, n_gen: int) -> PreprocessedRequest:
@@ -175,7 +254,7 @@ async def run_bench(args) -> dict:
             stop_conditions=StopConditions(max_tokens=n_gen, ignore_eos=True),
             sampling_options=SamplingOptions(temperature=0.0))
 
-    ttfts = []
+    ttfts: list = []
     arrivals: list = []  # (t, n_tokens) across all sequences
 
     async def drive(rid: str, n_prompt: int, n_gen: int):
@@ -193,41 +272,33 @@ async def run_bench(args) -> dict:
             ttfts.append(first)
         return first, count
 
-    try:
-        # warmup: compile (or load from the persistent cache the priming
-        # child filled) the REAL prefill and decode shapes — a full-width
-        # concurrent batch, or the timed phase eats the compile of the
-        # shapes it actually runs. Decode needs >2 steps so the chained
-        # (pipelined) program also compiles.
-        print("bench: warmup/compile...", file=sys.stderr, flush=True)
-        t_setup = time.perf_counter()  # engine built; this times compiles only
-        await asyncio.gather(
-            *[drive(f"warm{i}", prompt, 8) for i in range(seqs)])
-        ttfts.clear()
-        warmup_s = time.perf_counter() - t_setup
-        print(f"bench: warmup done in {warmup_s:.1f}s", file=sys.stderr,
-              flush=True)
+    # warmup: compile (or reuse from this process's jit cache, which the
+    # priming stage just filled) the REAL prefill and decode shapes — a
+    # full-width concurrent batch. Decode needs >2 steps so the chained
+    # (pipelined) program also runs.
+    wd.arm(f"warmup:{label}", STAGE_BUDGETS["warmup"])
+    t_setup = time.perf_counter()
+    await asyncio.gather(
+        *[drive(f"warm{i}", prompt, 8) for i in range(seqs)])
+    ttfts.clear()
+    warmup_s = time.perf_counter() - t_setup
+    _ckpt("warmup_done", label=label, s=round(warmup_s, 1))
 
-        print(f"bench: {seqs} seqs x ({prompt} prompt + {gen} gen)",
-              file=sys.stderr, flush=True)
-        arrivals.clear()
-        t0 = time.perf_counter()
-        results = await asyncio.gather(
-            *[drive(f"r{i}", prompt, gen) for i in range(seqs)])
-        wall = time.perf_counter() - t0
-        # serialized with the step loop per the engine.pages contract
-        kv_gbps = await engine.run_exclusive(_measure_kv_inject, engine)
-        kv_wire_gbps = await _measure_kv_wire(engine)
-        kv_bulk_gbps = await _measure_kv_bulk(engine)
-    finally:
-        await engine.stop()
+    wd.arm(f"measure:{label}", STAGE_BUDGETS["measure"])
+    print(f"bench: {seqs} seqs x ({prompt} prompt + {gen} gen)",
+          file=sys.stderr, flush=True)
+    arrivals.clear()
+    t0 = time.perf_counter()
+    results = await asyncio.gather(
+        *[drive(f"r{i}", prompt, gen) for i in range(seqs)])
+    wall = time.perf_counter() - t0
 
     total_generated = sum(c for _f, c in results)
     # the metric is DECODE throughput: measure the steady-state phase, from
     # the moment every sequence has its first token (prefill done — its own
-    # cost is reported as TTFT/prefill tok/s on stderr) to the last token.
-    # A request that never produced a token (error) reports first=None —
-    # exclude it rather than crash the whole bench run.
+    # cost is reported as TTFT/prefill tok/s) to the last token. A request
+    # that never produced a token (error) reports first=None — exclude it
+    # rather than crash the whole bench run.
     firsts = [f for f, _c in results if f is not None]
     if not firsts:
         raise RuntimeError("no request produced a first token")
@@ -238,31 +309,72 @@ async def run_bench(args) -> dict:
     tok_per_s = (steady_tokens / steady_wall if steady_wall > 0
                  else total_generated / wall)
     prefill_tok_s = seqs * prompt / (t_steady - t0)
+    ttft_p50 = statistics.median(ttfts)
+    _ckpt("measured", label=label, tokens=total_generated,
+          decode_tok_s=round(tok_per_s, 1),
+          prefill_tok_s=round(prefill_tok_s, 1))
+    return dict(tok_per_s=tok_per_s, prefill_tok_s=prefill_tok_s,
+                ttft_p50=ttft_p50, warmup_s=warmup_s,
+                total_generated=total_generated, wall=wall)
 
-    # HBM roofline for bandwidth-bound decode on this model/batch:
-    # each decode step streams all params + the batch's live KV context.
-    param_bytes = tree_bytes(engine.params)
-    kv_per_tok = (2 * cfg.num_kv_heads * cfg.head_dim * cfg.num_layers
-                  * np.dtype(cfg.dtype).itemsize)
-    avg_ctx = prompt + gen / 2
-    step_bytes = param_bytes + seqs * avg_ctx * kv_per_tok
-    roofline_steps = detect_bandwidth() * 1e9 / step_bytes
-    roofline_tok_s = roofline_steps * seqs
 
-    print(f"bench: {total_generated} tokens in {wall:.2f}s; "
-          f"steady decode {tok_per_s:.0f} tok/s; "
-          f"prefill {prefill_tok_s:.0f} tok/s; "
-          f"p50 TTFT {statistics.median(ttfts) * 1e3:.0f}ms; "
+async def run_attempt(args) -> dict:
+    """The whole attempt, one process: build -> prime -> measure ->
+    transports -> optional attn-impl A/B. ``jax_init`` already happened in
+    ``_attempt_main`` (it IS the probe)."""
+    import numpy as np
+
+    wd = args._wd
+    deadline = args._deadline  # monotonic; A/B only if budget remains
+
+    wd.arm("engine_build", STAGE_BUDGETS["engine_build"])
+    t0 = time.perf_counter()
+    engine, cfg, geometry, on_tpu = _build_engine(args.tier, args.attn_impl)
+    seqs, prompt, gen, pfs = geometry
+    _ckpt("engine_built", tier=args.tier, attn_impl=engine.attn_impl,
+          s=round(time.perf_counter() - t0, 1))
+
+    _prime_programs(engine, seqs, prompt, pfs, wd)
+
+    try:
+        m = await _measure_engine(engine, cfg, geometry, wd, "main")
+        # transport measurements, serialized with the step loop per the
+        # engine.pages contract
+        wd.arm("transport:inject", STAGE_BUDGETS["transport"])
+        kv_gbps = await engine.run_exclusive(_measure_kv_inject, engine)
+        wd.arm("transport:wire", STAGE_BUDGETS["transport"])
+        kv_wire_gbps = await _measure_kv_wire(engine)
+        wd.arm("transport:bulk", STAGE_BUDGETS["transport"])
+        kv_bulk_gbps = await _measure_kv_bulk(engine)
+        wd.arm("transport:e2e", STAGE_BUDGETS["transport"])
+        kv_e2e_gbps = await _measure_kv_bulk_inject(engine)
+
+        # HBM roofline for bandwidth-bound decode on this model/batch:
+        # each decode step streams all params + the batch's live KV context.
+        param_bytes = tree_bytes(engine.params)
+        kv_per_tok = (2 * cfg.num_kv_heads * cfg.head_dim * cfg.num_layers
+                      * np.dtype(cfg.dtype).itemsize)
+        avg_ctx = prompt + gen / 2
+        step_bytes = param_bytes + seqs * avg_ctx * kv_per_tok
+        roofline_steps = detect_bandwidth() * 1e9 / step_bytes
+        roofline_tok_s = roofline_steps * seqs
+    finally:
+        await engine.stop()
+
+    print(f"bench: {m['total_generated']} tokens in {m['wall']:.2f}s; "
+          f"steady decode {m['tok_per_s']:.0f} tok/s; "
+          f"prefill {m['prefill_tok_s']:.0f} tok/s; "
+          f"p50 TTFT {m['ttft_p50'] * 1e3:.0f}ms; "
           f"roofline {roofline_tok_s:.0f} tok/s "
           f"(params {param_bytes / 1e9:.2f} GB)", file=sys.stderr, flush=True)
 
     tpu_run = on_tpu and args.tier != "tiny"
-    return {
+    result = {
         "metric": f"decode_throughput_llama3b_bs{seqs}"
                   if tpu_run else "decode_throughput_tiny",
-        "value": round(tok_per_s, 1),
+        "value": round(m["tok_per_s"], 1),
         "unit": "tokens/sec",
-        "vs_baseline": round(tok_per_s / roofline_tok_s, 4),
+        "vs_baseline": round(m["tok_per_s"] / roofline_tok_s, 4),
         # the primary configuration really ran on the chip (the driver must
         # treat any CPU fallback JSON as a failed round, VERDICT r2 item 4)
         "valid": bool(tpu_run),
@@ -271,10 +383,46 @@ async def run_bench(args) -> dict:
         "kv_inject_gbps": kv_gbps,
         "kv_wire_gbps": kv_wire_gbps,
         "kv_bulk_gbps": kv_bulk_gbps,
-        "prefill_tok_s": round(prefill_tok_s, 1),
-        "ttft_p50_s": round(statistics.median(ttfts), 3),
-        "warmup_s": round(warmup_s, 1),
+        "kv_e2e_gbps": kv_e2e_gbps,
+        "prefill_tok_s": round(m["prefill_tok_s"], 1),
+        "ttft_p50_s": round(m["ttft_p50"], 3),
+        "warmup_s": round(m["warmup_s"], 1),
     }
+
+    # attn-impl A/B in the SAME process (round-4 open question:
+    # scan+pallas vs pallas_unrolled on chip) — another engine, same init.
+    ab_impl = args.ab
+    remaining = deadline - time.monotonic()
+    if ab_impl and ab_impl != engine.attn_impl and tpu_run \
+            and remaining >= STAGE_BUDGETS["ab"]:
+        del engine  # free HBM before the second engine builds
+        try:
+            wd.arm("ab:build", STAGE_BUDGETS["engine_build"])
+            engine2, cfg2, geo2, _ = _build_engine(args.tier, ab_impl)
+            _ckpt("ab_engine_built", attn_impl=engine2.attn_impl)
+            _prime_programs(engine2, geo2[0], geo2[1], geo2[3], wd,
+                            label="ab")
+            try:
+                wd.arm("ab:measure", STAGE_BUDGETS["measure"])
+                m2 = await _measure_engine(engine2, cfg2, geo2, wd, "ab")
+            finally:
+                await engine2.stop()
+            result["ab"] = {
+                "attn_impl": ab_impl,
+                "decode_tok_s": round(m2["tok_per_s"], 1),
+                "prefill_tok_s": round(m2["prefill_tok_s"], 1),
+                "ttft_p50_s": round(m2["ttft_p50"], 3),
+                "warmup_s": round(m2["warmup_s"], 1),
+            }
+        except Exception as e:  # the A/B is best-effort extra data
+            result["ab"] = {"attn_impl": ab_impl, "error": str(e)[:300]}
+    elif ab_impl and ab_impl != result["attn_impl"]:
+        result["ab"] = {"attn_impl": ab_impl,
+                        "error": (f"skipped (remaining {remaining:.0f}s"
+                                  f" < {STAGE_BUDGETS['ab']:.0f}s)"
+                                  if tpu_run else "skipped (not on tpu)")}
+    wd.disarm()
+    return result
 
 
 # target bytes per transport measurement: small samples measure framing
@@ -284,10 +432,10 @@ TRANSPORT_TARGET_BYTES = 128 * 1024 * 1024
 TRANSPORT_REPS = 5
 
 
-def _bench_frames(engine):
+def _bench_frames(engine, target_bytes: int = TRANSPORT_TARGET_BYTES):
     """Synthetic wire frames shaped like this engine's KV blocks (shared by
     the wire/bulk transport measurements so their GB/s are comparable).
-    Frame count/width sized so one full fetch moves >=TRANSPORT_TARGET_BYTES
+    Frame count/width sized so one full fetch moves >=target_bytes
     (the serving geometry: a 3B-model block is ~1.8 MB, so a 64-block prefix
     fetch is ~117 MB — measuring less benchmarks the framing, not the
     plane)."""
@@ -299,7 +447,7 @@ def _bench_frames(engine):
     blk_shape = (L,) + tuple(ref.shape[-4:])  # [L, 2, Hkv, ps, Dh]
     blk_bytes = int(np.prod(blk_shape)) * 2   # uint16 payload
     n_frames = 8
-    per_frame = max(4, -(-TRANSPORT_TARGET_BYTES // (n_frames * blk_bytes)))
+    per_frame = max(4, -(-target_bytes // (n_frames * blk_bytes)))
     chunk = np.ones((per_frame,) + blk_shape, np.uint16)
     meta = {"blocks": [[i, i, None] for i in range(per_frame)],
             "dtype": "uint16", "block_shape": list(blk_shape)}
@@ -427,6 +575,86 @@ def _measure_kv_inject(engine) -> float:
     return round(gbps, 2)
 
 
+async def _measure_kv_bulk_inject(engine) -> float:
+    """END-TO-END disagg KV handoff bandwidth (GB/s): the prefill->decode
+    path a real disagg deployment takes — bulk-socket fetch of
+    serving-geometry block frames AND host->device scatter of every frame
+    into the live page table, timed as one pipeline (VERDICT r4 item 3:
+    decide on-chip whether the host bounce is the bottleneck; compare
+    against ``kv_bulk_gbps``/``kv_inject_gbps`` which time the halves).
+    The per-frame receive work mirrors ``engine/transfer.inject_frame``:
+    zero-copy dtype reinterpret, block-major -> layer-major owning copy,
+    donated jitted scatter. Each rep runs inside an exclusive window (the
+    scatter reassigns ``engine.pages``)."""
+    import jax
+    import numpy as np
+
+    from dynamo_tpu.runtime.bulk import BulkServer, bulk_fetch, release_buffer
+
+    # scatter targets: a fixed window of real page ids, reused per frame.
+    # On the tiny smoke config (few pages, tiny blocks) a 128 MB stream
+    # would mean thousands of windowed scatter dispatches per rep — scale
+    # the payload down there; the 3B tiers keep the full-size stream.
+    n_ids = min(64, engine.allocator.num_pages - 2)
+    target = (TRANSPORT_TARGET_BYTES if n_ids >= 64
+              else 16 * 1024 * 1024)
+    meta, chunk, n_frames = _bench_frames(engine, target)
+    per_frame = chunk.shape[0]
+    n_ids = min(per_frame, n_ids)
+    ids = list(range(1, n_ids + 1))
+    blk_shape = tuple(meta["block_shape"])
+    ref = engine.pages[0] if isinstance(engine.pages, list) else engine.pages
+    page_dtype = ref.dtype  # same itemsize as the uint16 wire payload
+
+    server = BulkServer(
+        unix_path=f"/tmp/dynamo_bench_e2e_{os.getpid()}.sock").start()
+    server.register("kv", lambda payload: (
+        (meta, chunk) for _ in range(n_frames)))
+
+    def fetch_and_inject() -> int:
+        got = 0
+
+        def on_frame(_m, raw):
+            nonlocal got
+            got += len(raw)
+            if np.dtype(page_dtype).itemsize == 2:
+                # bf16 cache (the TPU tiers): zero-copy reinterpret of the
+                # uint16 wire payload, exactly like inject_frame
+                arr = np.frombuffer(raw, page_dtype).reshape(
+                    (per_frame,) + blk_shape)
+            else:  # float32 tiny tier: parse, widen below
+                arr = np.frombuffer(raw, np.uint16).reshape(
+                    (per_frame,) + blk_shape)
+            # EVERY received block pays the layer-major copy + scatter
+            # (windowed over the page-id range when the frame holds more
+            # blocks than the cache has pages — the tiny tier — else the
+            # e2e number silently degrades into the bulk-fetch number)
+            for off in range(0, per_frame, n_ids):
+                sl = arr[off:off + n_ids]
+                vals = np.moveaxis(sl, 0, 1)
+                vals = (vals.copy() if vals.dtype == page_dtype
+                        else vals.astype(page_dtype))
+                engine.scatter_pages_host(ids[:sl.shape[0]], vals)
+            release_buffer(raw)
+
+        bulk_fetch(server.address, "kv", {}, on_frame=on_frame)
+        # the scatters are dispatched async; make the rep time include the
+        # device actually finishing the writes
+        pages = (engine.pages[0] if isinstance(engine.pages, list)
+                 else engine.pages)
+        jax.block_until_ready(pages)
+        return got
+
+    async def fetch_once() -> int:
+        return await engine.run_exclusive(fetch_and_inject)
+
+    try:
+        return await _time_transport("e2e (bulk+inject)", fetch_once,
+                                     n_frames * chunk.nbytes)
+    finally:
+        server.stop()
+
+
 def _parse_args(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("--tier", choices=["full", "reduced", "tiny"],
@@ -436,11 +664,14 @@ def _parse_args(argv=None):
     p.add_argument("--attn-impl", default="auto",
                    help="engine attn_impl (auto/pallas/pallas_unrolled/"
                         "scan/unrolled) for on-chip A/B runs")
-    p.add_argument("--_child", action="store_true",
-                   help="internal: run the measurement in this process")
-    p.add_argument("--_prime", action="store_true",
-                   help="internal: compile the step programs into the "
-                        "persistent cache, run nothing")
+    p.add_argument("--ab", default="pallas_unrolled",
+                   help="second attn_impl to measure in the same attempt "
+                        "when budget remains ('' disables)")
+    p.add_argument("--_attempt", action="store_true",
+                   help="internal: run probe->prime->measure in this "
+                        "process")
+    p.add_argument("--child-budget", type=float, default=420.0,
+                   help="internal: attempt wall-clock budget (s)")
     p.add_argument("--budget", type=float, default=520.0,
                    help="orchestrator total wall-clock budget (s)")
     args = p.parse_args(argv)
@@ -449,142 +680,203 @@ def _parse_args(argv=None):
     return args
 
 
-def _child_main(args) -> None:
+def _attempt_main(args) -> None:
+    """One attempt, one process: the jax init IS the probe; everything
+    after it reuses the init this process already paid for."""
+    wd = Watchdog()
+    args._wd = wd
+    args._deadline = time.monotonic() + args.child_budget
+
+    wd.arm("jax_init", STAGE_BUDGETS["jax_init"])
+    t0 = time.perf_counter()
     if os.environ.get("BENCH_FORCE_CPU"):
         from dynamo_tpu.utils.platform import force_cpu_platform
 
         force_cpu_platform()
-    if args._prime:
-        engine, _cfg, (seqs, prompt, _gen, pfs), _on_tpu = _build_engine(args)
-        _prime_programs(engine, seqs, prompt, pfs)
-        print(json.dumps({"primed": True}), flush=True)
-        return
-    result = asyncio.run(run_bench(args))
+    import jax
+
+    devs = jax.devices()
+    _ckpt("init_ok", s=round(time.perf_counter() - t0, 1),
+          platform=devs[0].platform, n_devices=len(devs),
+          device_kind=getattr(devs[0], "device_kind", "?"))
+
+    result = asyncio.run(run_attempt(args))
     print(json.dumps(result), flush=True)
 
 
-def _run_attempt(argv: list[str], env: dict, timeout: float) -> dict | None:
-    """Run one child; return its parsed JSON result line or None."""
+# ---------------------------------------------------------------------------
+# orchestrator
+
+PROBE_GAP = 10.0      # pause between failed attempts
+# stage rank for "furthest progress" bookkeeping across attempts
+_STAGE_RANK = ["start", "init_ok", "engine_built", "primed", "warmup_done",
+               "measured"]
+
+
+def _progress_rank(p: dict) -> tuple:
+    stage = p.get("stage", "start")
+    base = _STAGE_RANK.index(stage) if stage in _STAGE_RANK else 0
+    return (base, p.get("programs_primed", 0))
+
+
+# orchestrator-side stall kill: the child's own watchdog is the primary
+# stall guard, but a tunnel init that hangs INSIDE a C call holding the
+# GIL starves the watchdog thread too — so the orchestrator also kills on
+# checkpoint inactivity. Pre-init gets a tight window (init budget +
+# margin); later stages get the largest stage budget + margin (a compile
+# legitimately prints nothing for minutes).
+STALL_KILL_PRE_INIT_S = 100.0
+STALL_KILL_S = 340.0
+
+
+def _run_attempt_proc(argv: list[str], env: dict,
+                      timeout: float) -> tuple[dict | None, dict]:
+    """Run one attempt child; stream its stderr (forwarding everything,
+    parsing ``bench-ckpt:`` lines). Returns (parsed stdout JSON | None,
+    progress summary dict for the attempt)."""
     cmd = [sys.executable, os.path.abspath(__file__)] + argv
     print(f"bench: attempt {argv} timeout={timeout:.0f}s",
           file=sys.stderr, flush=True)
-    try:
-        proc = subprocess.run(
-            cmd, env=env, timeout=timeout,
-            stdout=subprocess.PIPE, stderr=sys.stderr)
-    except subprocess.TimeoutExpired:
-        print("bench: attempt timed out", file=sys.stderr, flush=True)
-        return None
-    for line in reversed(proc.stdout.decode(errors="replace").splitlines()):
+    progress: dict = {"stage": "start", "programs_primed": 0}
+    last_activity = [time.monotonic()]
+
+    proc = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE)
+
+    def pump_stderr():
+        for raw in proc.stderr:
+            line = raw.decode(errors="replace")
+            sys.stderr.write(line)
+            sys.stderr.flush()
+            line = line.strip()
+            is_ckpt = line.startswith("bench-ckpt: ")
+            # Pre-init, only OUR checkpoints count as activity: a hung
+            # tunnel init can chatter native log lines from C++ (no GIL
+            # needed) while starving the child's watchdog thread, and
+            # those must not defeat the pre-init stall kill. Post-init
+            # any output counts (transport result lines are not ckpts).
+            if is_ckpt or progress["stage"] != "start":
+                last_activity[0] = time.monotonic()
+            if is_ckpt:
+                try:
+                    ck = json.loads(line[len("bench-ckpt: "):])
+                except json.JSONDecodeError:
+                    continue
+                stage = ck.get("stage")
+                if ck.get("label") == "ab" or str(stage).startswith("ab"):
+                    continue  # A/B extras must not regress main progress
+                if stage == "primed":
+                    progress["programs_primed"] += 1
+                    progress["stage"] = "primed"
+                    progress.setdefault("prime_s", []).append(
+                        ck.get("s", 0.0))
+                elif stage == "hung":
+                    progress["hung_at"] = ck.get("at")
+                    progress["hung_after_s"] = ck.get("s")
+                elif stage in _STAGE_RANK:
+                    progress["stage"] = stage
+                    if stage == "init_ok":
+                        progress["init_s"] = ck.get("s")
+                        progress["platform"] = ck.get("platform")
+
+    t = threading.Thread(target=pump_stderr, daemon=True)
+    t.start()
+    deadline = time.monotonic() + timeout
+    killed = None
+    while True:
+        try:
+            proc.wait(timeout=2.0)
+            break
+        except subprocess.TimeoutExpired:
+            pass
+        now = time.monotonic()
+        idle = now - last_activity[0]
+        idle_cap = (STALL_KILL_PRE_INIT_S if progress["stage"] == "start"
+                    else STALL_KILL_S)
+        if now > deadline:
+            killed = "orchestrator timeout"
+        elif idle > idle_cap:
+            killed = f"no activity for {idle:.0f}s at {progress['stage']}"
+        if killed:
+            proc.kill()
+            proc.wait()
+            progress["killed"] = killed
+            print(f"bench: attempt killed ({killed})",
+                  file=sys.stderr, flush=True)
+            t.join(timeout=5.0)
+            return None, progress
+    out = proc.stdout.read()
+    t.join(timeout=5.0)
+    for line in reversed(out.decode(errors="replace").splitlines()):
         line = line.strip()
         if line.startswith("{"):
             try:
-                return json.loads(line)
+                return json.loads(line), progress
             except json.JSONDecodeError:
                 continue
     print(f"bench: attempt exited rc={proc.returncode} without a result",
           file=sys.stderr, flush=True)
-    return None
-
-
-PROBE_WINDOW = 75.0   # max seconds a single probe may take (init hang guard)
-PROBE_GAP = 10.0      # pause between failed probes
+    return None, progress
 
 
 def main() -> None:
     args = _parse_args()
-    if args._child or args._prime:
-        _child_main(args)
+    if args._attempt:
+        _attempt_main(args)
         return
 
-    # Orchestrator: never imports jax. Probe the TPU continuously across
-    # the whole budget; the moment one probe succeeds, prime the compile
-    # cache and run the measurement, degrading full -> reduced tier as the
-    # budget shrinks. CPU fallback only when the chip never answered.
+    # Orchestrator: never imports jax. Launch single-process attempts back
+    # to back across the whole budget (each attempt's jax init IS the
+    # probe), degrade full -> reduced tier as the budget shrinks, track the
+    # furthest stage any attempt reached. CPU fallback only when the chip
+    # never answered.
     deadline = time.monotonic() + args.budget
     cpu_reserve = 120.0
 
     tpu_env = dict(os.environ)
-    probe_code = "import jax; jax.devices()"
     if os.environ.get("BENCH_TEST_CPU_CHAIN"):
-        # CI hook: drive the probe-success -> prime -> measure chain on
-        # CPU (the TPU site hook would otherwise hang every probe, and
-        # env vars alone cannot out-pin it — see utils/platform.py)
-        probe_code = ("from dynamo_tpu.utils.platform import "
-                      "force_cpu_platform; force_cpu_platform()")
+        # CI hook: drive the whole attempt chain on forced-CPU jax (the
+        # TPU site hook would otherwise hang every init, and env vars
+        # alone cannot out-pin it — see utils/platform.py)
         tpu_env["BENCH_FORCE_CPU"] = "1"
     else:
         tpu_env.pop("JAX_PLATFORMS", None)  # let the TPU plugin register
-    errors: list[str] = []
-    probes = 0
-    primed: set[str] = set()  # per tier: full-tier programs don't warm reduced
-    measure_attempts = 0
-    while time.monotonic() + cpu_reserve < deadline:
-        probe_budget = min(PROBE_WINDOW,
-                           deadline - time.monotonic() - cpu_reserve)
-        if probe_budget <= 5.0:
-            break
-        probes += 1
-        t_probe = time.monotonic()
-        try:
-            probe_rc = subprocess.run(
-                [sys.executable, "-c", probe_code],
-                env=tpu_env, timeout=probe_budget,
-                stdout=subprocess.DEVNULL,
-                stderr=subprocess.DEVNULL).returncode
-        except subprocess.TimeoutExpired:
-            probe_rc = -1
-        if probe_rc != 0:
-            print(f"bench: tpu probe {probes} failed/hung "
-                  f"({time.monotonic() - t_probe:.0f}s)", file=sys.stderr,
-                  flush=True)
-            if probes <= 5:
-                errors.append(f"tpu probe {probes} failed")
-            if time.monotonic() + cpu_reserve < deadline:
-                time.sleep(PROBE_GAP)
-            continue
-        print(f"bench: tpu probe {probes} OK "
-              f"({time.monotonic() - t_probe:.0f}s)", file=sys.stderr,
-              flush=True)
 
+    errors: list[str] = []
+    attempts = 0
+    best_progress: dict = {"stage": "start", "programs_primed": 0}
+    while time.monotonic() + cpu_reserve < deadline:
         remaining = deadline - time.monotonic() - cpu_reserve
         if remaining < 45.0:
-            errors.append("tpu up but budget exhausted")
             break
+        attempts += 1
         if args.tier == "tiny":
             # the user asked for the smoke config: honor it (still runs on
-            # the TPU when one answered the probe)
+            # the TPU when the init answers)
             tier = "tiny"
-        elif (args.tier == "full" and remaining >= 240.0
-                and measure_attempts == 0):
+        elif args.tier == "full" and remaining >= 240.0 and attempts == 1:
             tier = "full"
         else:  # degrade only: never escalate past what was asked for
             tier = "reduced" if args.tier == "full" else args.tier
-        common = ["--tier", tier, "--attn-impl", args.attn_impl]
-        # prime the compile cache in its own child: even if it dies partway,
-        # every program it finished is persisted for the measurement child
-        if tier not in primed and remaining >= 150.0:
-            prime_budget = remaining - 90.0
-            r = _run_attempt(["--_prime"] + common, tpu_env,
-                             min(prime_budget, 300.0))
-            if r is not None and r.get("primed", False):
-                primed.add(tier)
-            else:
-                errors.append(f"prime child ({tier}) failed/timed out")
-            remaining = deadline - time.monotonic() - cpu_reserve
-            if remaining < 45.0:
-                errors.append("primed but budget exhausted")
-                break
-        measure_attempts += 1
-        result = _run_attempt(["--_child"] + common, tpu_env,
-                              min(remaining, 380.0))
+        # cap a healthy-but-slow child well above the main-run stage
+        # budgets so a long-budget run (the tunnel watcher) has room for
+        # the in-process A/B; stalls are caught by the watchdog + the
+        # activity kill, not this cap
+        child_budget = min(remaining, 1200.0)
+        argv = ["--_attempt", "--tier", tier,
+                "--attn-impl", args.attn_impl, "--ab", args.ab,
+                "--child-budget", f"{child_budget:.0f}"]
+        result, progress = _run_attempt_proc(argv, tpu_env, child_budget)
+        if _progress_rank(progress) > _progress_rank(best_progress):
+            best_progress = progress
         if result is not None:
-            result["attempts"] = measure_attempts
-            result["probes"] = probes
+            result["attempts"] = attempts
+            result["best_progress"] = best_progress
             print(json.dumps(result), flush=True)
             return
-        errors.append(f"tpu measure attempt {measure_attempts} "
-                      f"(tier {tier}) failed/timed out")
+        desc = progress.get("hung_at") or progress.get("stage", "start")
+        if attempts <= 6:
+            errors.append(f"attempt {attempts} ({tier}) died at {desc}")
         if time.monotonic() + cpu_reserve < deadline:
             time.sleep(PROBE_GAP)
 
@@ -593,8 +885,10 @@ def main() -> None:
     cpu_env = dict(os.environ)
     cpu_env["JAX_PLATFORMS"] = "cpu"
     cpu_env["BENCH_FORCE_CPU"] = "1"
-    result = _run_attempt(["--_child", "--tier", "tiny"], cpu_env,
-                          max(deadline - time.monotonic(), 60.0))
+    result, _p = _run_attempt_proc(
+        ["--_attempt", "--tier", "tiny", "--ab", "",
+         "--child-budget", f"{max(deadline - time.monotonic(), 60.0):.0f}"],
+        cpu_env, max(deadline - time.monotonic(), 60.0))
     if result is None:
         result = {"metric": "decode_throughput", "value": 0.0,
                   "unit": "tokens/sec", "vs_baseline": 0.0}
@@ -605,7 +899,8 @@ def main() -> None:
     # records a failed round instead of mistaking the toy number for the
     # real one (VERDICT r2: a fallback at rc=0 read as success)
     result["valid"] = False
-    result["probes"] = probes
+    result["attempts"] = attempts
+    result["best_progress"] = best_progress
     result["error"] = "; ".join(errors)
     print(json.dumps(result), flush=True)
 

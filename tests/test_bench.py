@@ -1,12 +1,16 @@
-"""Benchmark orchestrator: the probe -> prime -> measure chain.
+"""Benchmark orchestrator: the single-process probe -> prime -> measure
+attempt.
 
-Three rounds of BENCH_r*.json failures were orchestration failures, not
-measurement failures — so the orchestration itself is under test. The
-``BENCH_TEST_CPU_CHAIN`` hook makes probes and children run on forced-CPU
-jax (the TPU site hook would hang them in this environment), driving the
-EXACT code path a live chip window takes: probe succeeds, the priming
-child compiles the three step programs into the persistent cache, the
-measurement child runs warm and emits one JSON line.
+Four rounds of BENCH_r*.json failures were orchestration failures, not
+measurement failures — so the orchestration itself is under test. Round 5
+collapsed the probe/prime/measure children into ONE child whose jax init IS
+the probe (a successful init is never thrown away), with an internal
+watchdog and incremental ``bench-ckpt:`` checkpoints the orchestrator uses
+to record how far the best attempt got. The ``BENCH_TEST_CPU_CHAIN`` hook
+makes the attempt child run on forced-CPU jax (the TPU site hook would hang
+it in this environment), driving the EXACT code path a live chip window
+takes: init checkpoint, per-program prime checkpoints, warmup, measurement,
+one JSON line.
 """
 
 import json
@@ -17,13 +21,10 @@ import sys
 BENCH = os.path.join(os.path.dirname(__file__), "..", "bench.py")
 
 
-def test_probe_prime_measure_chain():
+def test_single_child_attempt_chain():
     env = dict(os.environ)
     env["BENCH_TEST_CPU_CHAIN"] = "1"
     env.pop("JAX_PLATFORMS", None)
-    # the budget is a CEILING the orchestrator plans against, not a
-    # duration — it must leave >= 150s headroom after the cpu reserve for
-    # the priming child to be scheduled; the tiny run finishes in ~30s
     r = subprocess.run(
         [sys.executable, BENCH, "--budget", "420", "--tier", "tiny"],
         env=env, capture_output=True, timeout=380)
@@ -31,28 +32,36 @@ def test_probe_prime_measure_chain():
     line = r.stdout.decode().strip().splitlines()[-1]
     result = json.loads(line)
     stderr = r.stderr.decode()
-    # the chain really ran: probe succeeded, all three programs primed,
-    # the measurement used an attempt slot (not the CPU fallback)
-    assert "tpu probe 1 OK" in stderr
+    # the chain really ran IN ONE CHILD: init checkpoint, then all three
+    # programs primed, then the measurement — no separate probe/prime
+    # processes (the r4 design burned three TPU inits per attempt)
+    assert '"stage": "init_ok"' in stderr
     for prog in ("prefill", "decode", "chained"):
-        assert f"primed {prog}" in stderr, stderr[-2000:]
+        assert f'"program": "{prog}"' in stderr, stderr[-2000:]
+    assert '"stage": "measured"' in stderr
     assert result["attempts"] == 1
-    assert result["probes"] == 1
     assert "error" not in result
     assert result["value"] > 0
+    # the orchestrator recorded the furthest stage the attempt reached
+    assert result["best_progress"]["stage"] == "measured"
+    assert result["best_progress"]["programs_primed"] == 3
+    assert result["best_progress"]["platform"] == "cpu"
+    # all four transport planes measured (bulk, wire, inject, e2e)
+    for key in ("kv_inject_gbps", "kv_wire_gbps", "kv_bulk_gbps",
+                "kv_e2e_gbps"):
+        assert result[key] > 0, key
     # forced-CPU children are honest about validity
     assert result["valid"] is False
     assert result["tier"] == "tiny"
 
 
-def test_cpu_fallback_when_probes_fail():
-    """No TPU and no CPU-chain hook: probes hang/fail and the orchestrator
-    must still emit one invalid JSON line via the CPU fallback."""
+def test_cpu_fallback_when_attempts_fail():
+    """No TPU and no CPU-chain hook: the attempt can't init and the
+    orchestrator must still emit one invalid JSON line via the CPU
+    fallback."""
     env = dict(os.environ)
     env.pop("BENCH_TEST_CPU_CHAIN", None)
-    # make the real probe fail FAST (no tunnel wait): point the children at
-    # a python that cannot import jax... simplest honest knob: a tiny
-    # budget so probe windows collapse and the fallback path runs
+    # a tiny budget collapses the attempt loop so the fallback path runs
     r = subprocess.run(
         [sys.executable, BENCH, "--budget", "1", "--tier", "tiny"],
         env=env, capture_output=True, timeout=240)
@@ -60,3 +69,4 @@ def test_cpu_fallback_when_probes_fail():
     result = json.loads(r.stdout.decode().strip().splitlines()[-1])
     assert result["valid"] is False
     assert "error" in result
+    assert "best_progress" in result
