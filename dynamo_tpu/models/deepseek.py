@@ -467,10 +467,13 @@ def _dense_mlp(lp: Dict[str, jnp.ndarray], x: jnp.ndarray) -> jnp.ndarray:
 # ----------------------------------------------------------------- forward
 
 def _layer_step(cfg: ModelConfig, lp, h, positions, total_lens, new_lens,
-                page_table, pages, lidx, *, moe: bool, layered: bool):
+                page_table, pages, lidx, *, moe: bool, layered: bool,
+                use_pallas: bool = False):
     """One decoder layer against the paged latent cache. ``layered`` means
     ``pages`` is the per-layer buffer (unrolled path) instead of the
-    stacked cache."""
+    stacked cache. ``use_pallas`` routes S==1 through the MLA Pallas
+    decode kernel (``ops/pallas/mla_decode.py``) when the geometry
+    supports it."""
     from dynamo_tpu.ops.attention import _pad_table
 
     q_lat, q_pe, c_kv, k_pe, w_uv = _mla_qkv(cfg, lp, h, positions)
@@ -484,7 +487,19 @@ def _layer_step(cfg: ModelConfig, lp, h, positions, total_lens, new_lens,
     S = h.shape[1]
     P = page_table.shape[1]
     ps = pages.shape[-2]
-    if S > 1 and P > PAGES_PER_CHUNK:
+    if use_pallas and S == 1:
+        from dynamo_tpu.ops.pallas.mla_decode import (
+            mla_paged_decode_layer, mla_paged_decode_stacked)
+
+        if layered:
+            lat = mla_paged_decode_layer(q_lat, q_pe, pages, page_table,
+                                         total_lens, _mla_scale(cfg))
+        else:
+            lat = mla_paged_decode_stacked(q_lat, q_pe, pages, lidx,
+                                           page_table, total_lens,
+                                           _mla_scale(cfg))
+        h = _expand_and_project(cfg, lp, h, lat, w_uv)
+    elif S > 1 and P > PAGES_PER_CHUNK:
         table = _pad_table(page_table, PAGES_PER_CHUNK)
 
         def gather_chunk(c):
@@ -514,10 +529,19 @@ def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
             new_lens: jnp.ndarray,
             attn_impl: Optional[Callable] = None
             ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Scan forward (same contract as llama.forward). ``attn_impl`` is
-    IGNORED: MLA attention runs in latent space, which the GQA Pallas
-    kernels do not model — the XLA paths serve this family."""
-    del attn_impl
+    """Scan forward (same contract as llama.forward). The GQA Pallas
+    kernels the engine passes as ``attn_impl`` cannot run latent
+    attention, so they are never CALLED here — but an impl carrying the
+    ``pallas_paged_kernel`` marker (both stacked kernels set it) opts
+    S==1 steps into the MLA decode kernel
+    (``ops/pallas/mla_decode.py``) when the geometry supports it
+    (kv_lora_rank % 128 == 0 — true for real V2/V3 checkpoints); prefill
+    keeps the XLA blockwise latent path. Any other non-None impl is
+    ignored (the XLA paths serve), matching gemma's marker pattern."""
+    from dynamo_tpu.ops.pallas.mla_decode import supports as mla_supports
+
+    use_pallas = (getattr(attn_impl, "pallas_paged_kernel", False)
+                  and mla_supports(cfg.kv_lora_rank, pages.shape[-2]))
     K = cfg.first_k_dense_replace
     h = params["embed"][tokens]
 
@@ -527,7 +551,8 @@ def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
             lp, lidx = xs
             h, pages = _layer_step(cfg, lp, h, positions, total_lens,
                                    new_lens, page_table, pages, lidx,
-                                   moe=moe, layered=False)
+                                   moe=moe, layered=False,
+                                   use_pallas=use_pallas)
             return (h, pages), None
         return step
 
@@ -548,9 +573,14 @@ def forward_unrolled(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
                      new_lens: jnp.ndarray,
                      attn_impl: Optional[Callable] = None
                      ) -> Tuple[jnp.ndarray, List[jnp.ndarray]]:
-    """Python-unrolled forward over per-layer latent buffers. ``attn_impl``
-    is IGNORED (see ``forward``)."""
-    del attn_impl
+    """Python-unrolled forward over per-layer latent buffers. An
+    ``attn_impl`` carrying the ``pallas_paged_kernel`` marker opts S==1
+    steps into the per-layer MLA Pallas kernel (see ``forward``)."""
+    from dynamo_tpu.ops.pallas.mla_decode import supports as mla_supports
+
+    use_pallas = (getattr(attn_impl, "pallas_paged_kernel", False)
+                  and mla_supports(cfg.kv_lora_rank,
+                                   pages_list[0].shape[-2]))
     K = cfg.first_k_dense_replace
     h = params["embed"][tokens]
     out_pages: List[jnp.ndarray] = []
@@ -561,7 +591,7 @@ def forward_unrolled(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
         lp = {k: v[li] for k, v in stack.items()}
         h, kv = _layer_step(cfg, lp, h, positions, total_lens, new_lens,
                             page_table, pages_list[l], 0, moe=moe,
-                            layered=True)
+                            layered=True, use_pallas=use_pallas)
         out_pages.append(kv)
     return _logits(cfg, params, h, new_lens), out_pages
 

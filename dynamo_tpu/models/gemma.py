@@ -15,9 +15,11 @@ architecture differences (verified against transformers'
   paged attention mask so the SAME paged cache serves both kinds;
 - query scale from ``query_pre_attn_scalar`` instead of ``head_dim``.
 
-The Pallas decode kernel does not implement softcap/window yet, so this
-family always runs the XLA attention paths (``forward_unrolled`` ignores
-the ``attn_impl`` override); blockwise prefill applies as usual.
+Both stacked Pallas kernels (decode AND prefill, ``ops/pallas/``) carry
+the per-layer window + softcap operands, so the scan forward serves this
+family fully on kernels under ``attn_impl="pallas"``; ``forward_unrolled``
+still ignores the override (the per-layer decode kernel variant has no
+window/softcap) and runs the XLA paths.
 """
 
 from __future__ import annotations
@@ -155,10 +157,9 @@ def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
             attn_impl: Optional[Callable] = None
             ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Scan-over-layers forward. ``attn_impl`` is honored only when it
-    advertises ``supports_window_softcap`` (the stacked Pallas DECODE
-    kernel carries gemma's per-layer sliding window + logit soft-capping;
-    the prefill kernel does not) — otherwise the XLA paths serve, with
-    identical math."""
+    advertises ``supports_window_softcap`` (both stacked Pallas kernels —
+    decode and prefill — carry gemma's per-layer sliding window + logit
+    soft-capping) — otherwise the XLA paths serve, with identical math."""
     if not getattr(attn_impl, "supports_window_softcap", False):
         attn_impl = None
     attn_impl = attn_impl or paged_attention

@@ -15,5 +15,10 @@ from dynamo_tpu.ops.pallas.decode import (
     paged_decode_attention,
     paged_decode_attention_stacked,
 )
+from dynamo_tpu.ops.pallas.mla_decode import (
+    mla_paged_decode_layer,
+    mla_paged_decode_stacked,
+)
 
-__all__ = ["paged_decode_attention", "paged_decode_attention_stacked"]
+__all__ = ["paged_decode_attention", "paged_decode_attention_stacked",
+           "mla_paged_decode_layer", "mla_paged_decode_stacked"]

@@ -276,8 +276,13 @@ def paged_decode_attention_stacked(q: jnp.ndarray, pages: jnp.ndarray,
 
 
 # marker the gemma forward checks before handing this impl its per-layer
-# window / softcap kwargs (the prefill kernel does not carry them)
+# window / softcap kwargs
 paged_decode_attention_stacked.supports_window_softcap = True
+# marker for families whose attention the GQA kernels cannot run directly
+# (deepseek MLA): a passed impl carrying it opts the family into its own
+# Pallas kernels (ops/pallas/mla_decode.py) instead of being called
+paged_decode_attention.pallas_paged_kernel = True
+paged_decode_attention_stacked.pallas_paged_kernel = True
 
 
 __all__ = ["paged_decode_attention", "paged_decode_attention_stacked",

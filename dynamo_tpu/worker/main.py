@@ -68,6 +68,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="ring-attention sequence parallelism: prompts longer "
                         "than the prefill chunk budget prefill in one "
                         "sequence-sharded step over this many devices")
+    p.add_argument("--attn-impl", default="auto",
+                   choices=["auto", "pallas", "pallas_unrolled", "scan",
+                            "unrolled"],
+                   help="engine attention implementation (auto = Pallas "
+                        "kernels on TPU, XLA scan elsewhere); explicit "
+                        "values drive on-chip A/Bs")
     p.add_argument("--moe-backend", choices=["dense", "dispatch"],
                    default=None,
                    help="MoE expert compute: dense (every expert, every "
@@ -136,7 +142,8 @@ def build_engine(args: argparse.Namespace) -> JaxEngine:
         max_num_seqs=args.max_num_seqs,
         max_prefill_chunk=args.max_prefill_chunk,
         max_context=min(args.max_context, cfg.max_position_embeddings),
-        num_top_logprobs=args.num_top_logprobs)
+        num_top_logprobs=args.num_top_logprobs,
+        attn_impl=args.attn_impl)
     forward_fn = None
     pp = args.pipeline_parallel_size
     if pp > 1:

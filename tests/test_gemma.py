@@ -93,6 +93,31 @@ def test_decode_matches_full_prefill():
                                rtol=2e-4, atol=2e-4)
 
 
+def test_forward_pallas_prefill_matches_xla():
+    """S>1 prefill through the Pallas prefill kernel (which now carries
+    gemma's per-layer window + softcap; interpret mode on CPU) must match
+    the XLA path — the engine's attn_impl="pallas" gemma serving path."""
+    from dynamo_tpu.ops.pallas.prefill import paged_prefill_attention_stacked
+
+    cfg = ModelConfig.tiny(model_type="gemma2", num_layers=4, head_dim=128,
+                           sliding_window=6, attn_logit_softcap=40.0,
+                           final_logit_softcap=25.0)
+    params = gemma.init_params(cfg, jax.random.PRNGKey(5))
+    prompt = list(np.random.RandomState(2).randint(1, 255, size=13))
+    ref, _ = _prefill(params, cfg, prompt,
+                      gemma.make_pages(cfg, 8, 8, dtype=jnp.float32),
+                      _alloc(1, 4))
+    toks = jnp.asarray([prompt], jnp.int32)
+    pos = jnp.asarray([list(range(len(prompt)))], jnp.int32)
+    lens = jnp.asarray([len(prompt)], jnp.int32)
+    got, _ = gemma.forward(params, cfg, toks, pos,
+                           gemma.make_pages(cfg, 8, 8, dtype=jnp.float32),
+                           _alloc(1, 4), lens, lens,
+                           attn_impl=paged_prefill_attention_stacked)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
 def test_unrolled_matches_scan():
     cfg = ModelConfig.tiny(model_type="gemma2", num_layers=4,
                            sliding_window=6, attn_logit_softcap=40.0)

@@ -417,6 +417,38 @@ class TestPallasPrefill:
                                    np.asarray(out, np.float32),
                                    rtol=3e-2, atol=3e-2)
 
+    def test_window_softcap_matches_xla(self):
+        """gemma-2 semantics in the PREFILL kernel: per-row sliding window
+        (with before-window chunks skipped) + logit soft-capping must
+        match the XLA path — closes the r4 gap that kept Gemma-2 prefill
+        off the kernel (models/gemma.py)."""
+        from dynamo_tpu.ops.attention import paged_attention
+        from dynamo_tpu.ops.pallas.prefill import (
+            paged_prefill_attention_stacked)
+        pages, q, table = self._mk(seed=11)
+        B, S = q.shape[:2]
+        # continuation rows deep enough that a 16-token window starts
+        # past chunk 0 (exercises the c0 chunk skip)
+        start = jnp.array([0, 40, 3], jnp.int32)
+        new = jnp.array([S, S, 9], jnp.int32)
+        positions = start[:, None] + jnp.arange(S)[None, :]
+        positions = jnp.where(jnp.arange(S)[None, :] < new[:, None],
+                              positions, 0)
+        total = start + new
+        for win, cap in ((16, None), (0, 30.0), (16, 30.0), (40, 8.0)):
+            ref = paged_attention(q, pages, 1, table, positions, total,
+                                  0.088, window=jnp.asarray(win, jnp.int32),
+                                  softcap=cap)
+            out = paged_prefill_attention_stacked(
+                q, pages, 1, table, positions, total, 0.088,
+                window=win, softcap=cap, interpret=True)
+            for b in range(B):
+                nb = int(new[b])
+                np.testing.assert_allclose(
+                    np.asarray(ref[b, :nb], np.float32),
+                    np.asarray(out[b, :nb], np.float32),
+                    rtol=3e-2, atol=3e-2, err_msg=f"win={win} cap={cap}")
+
     def test_inside_scan_traced_layer(self):
         from dynamo_tpu.ops.attention import paged_attention
         from dynamo_tpu.ops.pallas.prefill import (
