@@ -305,18 +305,29 @@ class HttpService:
             await resp.write(sse.SseEvent(
                 event=name,
                 data=json.dumps(value, separators=(",", ":"))).encode())
-        queue: asyncio.Queue = asyncio.Queue()
+        # bounded: the pumps await put() when the client reads slowly, so
+        # generation paces to the SSE write rate instead of accumulating
+        # chunks without backpressure (ADVICE r4; matches the n==1 path's
+        # implicit pacing). 8 chunks/choice of slack keeps the choices
+        # interleaving without coupling their schedulers.
+        queue: asyncio.Queue = asyncio.Queue(maxsize=8 * n)
 
         async def pump(i, pre, d):
             gen = pipeline.run_chat(pre, d)
             try:
-                async for chunk in gen:
-                    await queue.put((i, chunk))
+                try:
+                    async for chunk in gen:
+                        await queue.put((i, chunk))
+                finally:
+                    await gen.aclose()
+                await queue.put((i, None))
+            except asyncio.CancelledError:
+                # the consumer cancelled us (client gone): it will never
+                # get() again, so a sentinel put on the now-bounded queue
+                # could block forever — skip it and exit cancelled
+                raise
             except Exception as e:  # noqa: BLE001 — surface per stream
                 await queue.put((i, e))
-            finally:
-                await gen.aclose()
-                await queue.put((i, None))
 
         tasks = [asyncio.create_task(pump(i, pre, d))
                  for i, (pre, d) in enumerate(pairs)]

@@ -39,6 +39,12 @@ class ModelDeploymentCard:
     # full. Default matches JaxEngineConfig's default — workers that raise
     # the engine K must set this too (worker/main.py does).
     num_top_logprobs: int = 8
+    # widest sparse penalty/logit_bias window the serving engine ships per
+    # request (JaxEngineConfig.penalty_window); the preprocessor rejects
+    # logit_bias wider than this instead of silently dropping entries on
+    # device. Workers that change the engine window must set this too
+    # (worker/main.py does).
+    penalty_window: int = 32
     extra: Dict[str, Any] = field(default_factory=dict)
 
     def load_tokenizer(self):
@@ -88,6 +94,12 @@ class ModelDeploymentCard:
             "tokenizer_json": self.tokenizer_json,
             "tokenizer_path": self.tokenizer_path,
             "hf_config": self.hf_config,
+            # engine-capability advertisements: without these on the wire
+            # the frontend preprocessor falls back to defaults and either
+            # rejects requests the worker could serve or accepts ones the
+            # device would truncate
+            "num_top_logprobs": self.num_top_logprobs,
+            "penalty_window": self.penalty_window,
             "extra": self.extra,
         }
 
@@ -106,6 +118,8 @@ class ModelDeploymentCard:
             tokenizer_json=d.get("tokenizer_json"),
             tokenizer_path=d.get("tokenizer_path"),
             hf_config=d.get("hf_config", {}),
+            num_top_logprobs=d.get("num_top_logprobs", 8),
+            penalty_window=d.get("penalty_window", 32),
             extra=d.get("extra", {}),
         )
 

@@ -429,20 +429,25 @@ def _gate_noaux(cfg: ModelConfig, lp: Dict[str, jnp.ndarray],
 
 
 def _moe_mlp(cfg: ModelConfig, lp: Dict[str, jnp.ndarray],
-             x: jnp.ndarray) -> jnp.ndarray:
+             x: jnp.ndarray, ep_mesh=None
+             ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Routed experts + shared experts. ``cfg.moe_backend`` picks the
     routed compute: dense-mask (every expert, decode-batch default) or the
     capacity-factor token dispatch (``models/moe.py expert_dispatch`` —
-    the wide-EP path that makes 256-expert DeepSeek-V3 credible)."""
+    the wide-EP path that makes 256-expert DeepSeek-V3 credible).
+    Returns ``(out, dropped_assignments)`` (dropped is a static 0 on the
+    dense backend); ``ep_mesh`` pins dispatch buffers to the ep axis."""
     top_w, top_i = _gate(cfg, lp, x)
+    dropped = jnp.zeros((), jnp.int32)
     if cfg.moe_backend == "dispatch":
         from dynamo_tpu.models.moe import expert_dispatch
         B, S, H = x.shape
-        routed = expert_dispatch(
+        routed, dropped = expert_dispatch(
             x.reshape(B * S, H), top_w.reshape(B * S, -1),
             top_i.reshape(B * S, -1), lp["w_gate"], lp["w_up"],
             lp["w_down"], cfg.num_experts,
-            cfg.moe_capacity_factor).reshape(B, S, H).astype(x.dtype)
+            cfg.moe_capacity_factor, ep_mesh=ep_mesh)
+        routed = routed.reshape(B, S, H).astype(x.dtype)
     else:
         weights = jnp.sum(
             jax.nn.one_hot(top_i, cfg.num_experts, dtype=jnp.float32)
@@ -457,7 +462,7 @@ def _moe_mlp(cfg: ModelConfig, lp: Dict[str, jnp.ndarray],
         shared = (jax.nn.silu(x @ lp["ws_gate"])
                   * (x @ lp["ws_up"])) @ lp["ws_down"]
         routed = routed + shared
-    return routed
+    return routed, dropped
 
 
 def _dense_mlp(lp: Dict[str, jnp.ndarray], x: jnp.ndarray) -> jnp.ndarray:
@@ -468,12 +473,12 @@ def _dense_mlp(lp: Dict[str, jnp.ndarray], x: jnp.ndarray) -> jnp.ndarray:
 
 def _layer_step(cfg: ModelConfig, lp, h, positions, total_lens, new_lens,
                 page_table, pages, lidx, *, moe: bool, layered: bool,
-                use_pallas: bool = False):
+                use_pallas: bool = False, ep_mesh=None):
     """One decoder layer against the paged latent cache. ``layered`` means
     ``pages`` is the per-layer buffer (unrolled path) instead of the
     stacked cache. ``use_pallas`` routes S==1 through the MLA Pallas
     decode kernel (``ops/pallas/mla_decode.py``) when the geometry
-    supports it."""
+    supports it. Returns ``(h, pages, dropped_assignments)``."""
     from dynamo_tpu.ops.attention import _pad_table
 
     q_lat, q_pe, c_kv, k_pe, w_uv = _mla_qkv(cfg, lp, h, positions)
@@ -519,18 +524,23 @@ def _layer_step(cfg: ModelConfig, lp, h, positions, total_lens, new_lens,
         h = _mla_attend(cfg, lp, h, q_lat, q_pe, w_uv, ckv_ctx, kpe_ctx,
                         positions, total_lens)
     x = _rms_norm(h, lp["mlp_norm"], cfg.rms_norm_eps)
-    h = h + (_moe_mlp(cfg, lp, x) if moe else _dense_mlp(lp, x))
-    return h, pages
+    if moe:
+        mlp, dropped = _moe_mlp(cfg, lp, x, ep_mesh=ep_mesh)
+    else:
+        mlp, dropped = _dense_mlp(lp, x), jnp.zeros((), jnp.int32)
+    h = h + mlp
+    return h, pages, dropped
 
 
 def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
             positions: jnp.ndarray, pages: jnp.ndarray,
             page_table: jnp.ndarray, total_lens: jnp.ndarray,
             new_lens: jnp.ndarray,
-            attn_impl: Optional[Callable] = None
-            ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Scan forward (same contract as llama.forward). The GQA Pallas
-    kernels the engine passes as ``attn_impl`` cannot run latent
+            attn_impl: Optional[Callable] = None, ep_mesh=None
+            ) -> Tuple[jnp.ndarray, jnp.ndarray, dict]:
+    """Scan forward (llama.forward contract plus the ``aux`` third return
+    carrying ``moe_dropped_assignments``, like models/moe.py). The GQA
+    Pallas kernels the engine passes as ``attn_impl`` cannot run latent
     attention, so they are never CALLED here — but an impl carrying the
     ``pallas_paged_kernel`` marker (both stacked kernels set it) opts
     S==1 steps into the MLA decode kernel
@@ -544,16 +554,17 @@ def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
                   and mla_supports(cfg.kv_lora_rank, pages.shape[-2]))
     K = cfg.first_k_dense_replace
     h = params["embed"][tokens]
+    total_dropped = jnp.zeros((), jnp.int32)
 
     def body(moe):
         def step(carry, xs):
             h, pages = carry
             lp, lidx = xs
-            h, pages = _layer_step(cfg, lp, h, positions, total_lens,
-                                   new_lens, page_table, pages, lidx,
-                                   moe=moe, layered=False,
-                                   use_pallas=use_pallas)
-            return (h, pages), None
+            h, pages, dropped = _layer_step(
+                cfg, lp, h, positions, total_lens, new_lens, page_table,
+                pages, lidx, moe=moe, layered=False, use_pallas=use_pallas,
+                ep_mesh=ep_mesh)
+            return (h, pages), dropped
         return step
 
     if K and "dense_layers" in params:
@@ -561,18 +572,20 @@ def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
             body(False), (h, pages),
             (params["dense_layers"], jnp.arange(K)))
     if "moe_layers" in params:
-        (h, pages), _ = jax.lax.scan(
+        (h, pages), drops = jax.lax.scan(
             body(True), (h, pages),
             (params["moe_layers"], K + jnp.arange(cfg.num_layers - K)))
-    return _logits(cfg, params, h, new_lens), pages
+        total_dropped = jnp.sum(drops)
+    aux = {"moe_dropped_assignments": total_dropped}
+    return _logits(cfg, params, h, new_lens), pages, aux
 
 
 def forward_unrolled(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
                      positions: jnp.ndarray, pages_list: List[jnp.ndarray],
                      page_table: jnp.ndarray, total_lens: jnp.ndarray,
                      new_lens: jnp.ndarray,
-                     attn_impl: Optional[Callable] = None
-                     ) -> Tuple[jnp.ndarray, List[jnp.ndarray]]:
+                     attn_impl: Optional[Callable] = None, ep_mesh=None
+                     ) -> Tuple[jnp.ndarray, List[jnp.ndarray], dict]:
     """Python-unrolled forward over per-layer latent buffers. An
     ``attn_impl`` carrying the ``pallas_paged_kernel`` marker opts S==1
     steps into the per-layer MLA Pallas kernel (see ``forward``)."""
@@ -584,16 +597,20 @@ def forward_unrolled(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     K = cfg.first_k_dense_replace
     h = params["embed"][tokens]
     out_pages: List[jnp.ndarray] = []
+    total_dropped = jnp.zeros((), jnp.int32)
     for l in range(cfg.num_layers):
         moe = l >= K
         stack = params["moe_layers"] if moe else params["dense_layers"]
         li = l - K if moe else l
         lp = {k: v[li] for k, v in stack.items()}
-        h, kv = _layer_step(cfg, lp, h, positions, total_lens, new_lens,
-                            page_table, pages_list[l], 0, moe=moe,
-                            layered=True, use_pallas=use_pallas)
+        h, kv, dropped = _layer_step(
+            cfg, lp, h, positions, total_lens, new_lens, page_table,
+            pages_list[l], 0, moe=moe, layered=True,
+            use_pallas=use_pallas, ep_mesh=ep_mesh)
+        total_dropped = total_dropped + dropped
         out_pages.append(kv)
-    return _logits(cfg, params, h, new_lens), out_pages
+    aux = {"moe_dropped_assignments": total_dropped}
+    return _logits(cfg, params, h, new_lens), out_pages, aux
 
 
 # ------------------------------------------------------------------ loader

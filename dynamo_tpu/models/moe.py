@@ -59,6 +59,13 @@ def moe_mlp(cfg: ModelConfig, lp: Dict[str, jnp.ndarray],
     return jnp.einsum("bse,bseh->bsh", weights.astype(out.dtype), out)
 
 
+# decode-size batches get their dispatch capacity padded to 4x the
+# expected per-expert load: drops become vanishingly rare where they would
+# perturb a live conversation token, at a buffer cost that is negligible
+# at these sizes (ADVICE r4: C was often 1-2 at decode, silently dropping)
+_SMALL_BATCH_T = 64
+
+
 def _router_topk(cfg: ModelConfig, lp: Dict[str, jnp.ndarray],
                  x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Shared router: softmax over expert logits, top-k, optional renorm.
@@ -72,7 +79,8 @@ def _router_topk(cfg: ModelConfig, lp: Dict[str, jnp.ndarray],
 
 
 def moe_mlp_dispatch(cfg: ModelConfig, lp: Dict[str, jnp.ndarray],
-                     x: jnp.ndarray) -> jnp.ndarray:
+                     x: jnp.ndarray, ep_mesh=None
+                     ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Capacity-factor token dispatch (GShard/Switch style): each expert
     computes only a fixed-capacity buffer of its ROUTED tokens instead of
     every token — expert FLOPs drop from ``E`` to ``~k * capacity_factor``
@@ -84,39 +92,57 @@ def moe_mlp_dispatch(cfg: ModelConfig, lp: Dict[str, jnp.ndarray],
     Tokens routed past an expert's capacity are dropped for that expert
     (combine weight zero) — standard overflow semantics; raise
     ``cfg.moe_capacity_factor`` to make drops impossible at a given batch.
-    x: [B, S, H] (already normed) -> [B, S, H].
+    Returns ``(out [B, S, H], dropped_assignments scalar int32)`` — the
+    drop count flows to worker stats so operators can tell overflow
+    degradation from model behavior (VERDICT r4 weak 5).
 
-    Under GSPMD the expert-buffer gather/scatter and the [E, C, H]
-    expert einsums shard over ``ep`` (XLA lowers the cross-shard moves to
-    all-to-alls on ICI).
+    ``ep_mesh`` (a Mesh with an ``ep`` axis, passed by the engine when EP
+    is active) pins the ``[E, C, H]`` dispatch buffers to ``P("ep")`` so
+    each chip holds only its ``[E_local, C]`` slice; XLA lowers the
+    token scatter/combine across shards to all-to-alls on ICI.
     """
     B, S, H = x.shape
     xt = x.reshape(B * S, H)
     top_w, top_i = _router_topk(cfg, lp, xt)              # [T, k]
-    out = expert_dispatch(xt, top_w, top_i, lp["w_gate"], lp["w_up"],
-                          lp["w_down"], cfg.num_experts,
-                          cfg.moe_capacity_factor)
-    return out.reshape(B, S, H).astype(x.dtype)
+    out, dropped = expert_dispatch(
+        xt, top_w, top_i, lp["w_gate"], lp["w_up"], lp["w_down"],
+        cfg.num_experts, cfg.moe_capacity_factor, ep_mesh=ep_mesh)
+    return out.reshape(B, S, H).astype(x.dtype), dropped
 
 
 def expert_dispatch(xt: jnp.ndarray, top_w: jnp.ndarray,
                     top_i: jnp.ndarray, w_gate, w_up, w_down,
-                    num_experts: int,
-                    capacity_factor: float) -> jnp.ndarray:
+                    num_experts: int, capacity_factor: float,
+                    ep_mesh=None) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Sort-based capacity dispatch core (routing-agnostic — the deepseek
     family reuses it with its own gate). Memory LINEAR in tokens (a
     one-hot [T, E, C] combine tensor is O(T^2 k cf / E): ~GBs at prefill
     chunk sizes). Assignments group by expert via a stable argsort; each
     one's rank inside its expert group is its capacity slot, ranks >= C
     drop (token-major priority within an expert: earlier tokens win).
+    Small (decode-size) batches pad C to 4x the expected per-expert load
+    so drops there are vanishingly rare (``_SMALL_BATCH_T``).
 
     xt [T, H]; top_w/top_i [T, k]; expert weights [E, H, I]/[E, I, H].
-    Returns [T, H] float32 (caller casts)."""
+    Returns ``(out [T, H] float32, dropped_assignments scalar int32)``
+    (caller casts out). ``ep_mesh``: see ``moe_mlp_dispatch``."""
     import math
     T, H = xt.shape
     E = num_experts
     k = top_i.shape[1]
     C = max(1, min(T, math.ceil(T * k * capacity_factor / E)))
+    if T <= _SMALL_BATCH_T:
+        C = min(T, max(C, math.ceil(4 * T * k / E)))
+
+    def shard_ep(arr):
+        """Pin an [E, ...] buffer's expert axis to the mesh's ep axis."""
+        if ep_mesh is None or ep_mesh.shape.get("ep", 1) <= 1:
+            return arr
+        from jax.sharding import NamedSharding, PartitionSpec
+        spec = PartitionSpec("ep", *([None] * (arr.ndim - 1)))
+        return jax.lax.with_sharding_constraint(
+            arr, NamedSharding(ep_mesh, spec))
+
     A = T * k
     flat_e = top_i.reshape(A)
     flat_w = top_w.reshape(A).astype(jnp.float32)
@@ -129,30 +155,37 @@ def expert_dispatch(xt: jnp.ndarray, top_w: jnp.ndarray,
     starts = jnp.cumsum(counts) - counts                  # [E]
     rank = jnp.arange(A) - starts[sorted_e]
     keep = rank < C
+    dropped = jnp.sum(~keep).astype(jnp.int32)
     # overflow assignments route to a trash row past the expert buffers
     dest = jnp.where(keep, sorted_e * C + rank, E * C)
 
     xe = jnp.zeros((E * C + 1, H), xt.dtype).at[dest].set(xt[sorted_t])
-    xe = xe[:E * C].reshape(E, C, H)                      # [E, C, H]
+    xe = shard_ep(xe[:E * C].reshape(E, C, H))            # [E, C, H]
     gate = jnp.einsum("ech,ehi->eci", xe, w_gate)
     up = jnp.einsum("ech,ehi->eci", xe, w_up)
-    ye = jnp.einsum("eci,eih->ech", jax.nn.silu(gate) * up,
-                    w_down)                               # [E, C, H]
+    ye = shard_ep(jnp.einsum("eci,eih->ech", jax.nn.silu(gate) * up,
+                             w_down))                     # [E, C, H]
 
     ye_flat = jnp.concatenate(
         [ye.reshape(E * C, H).astype(jnp.float32),
          jnp.zeros((1, H), jnp.float32)])                 # trash row = 0
     contrib = ye_flat[dest] * sorted_w[:, None]           # [A, H]
-    return jnp.zeros((T, H), jnp.float32).at[sorted_t].add(contrib)
+    out = jnp.zeros((T, H), jnp.float32).at[sorted_t].add(contrib)
+    return out, dropped
 
 
 def _moe_layer_tail(cfg: ModelConfig, lp: Dict[str, jnp.ndarray],
-                    h: jnp.ndarray, attn: jnp.ndarray) -> jnp.ndarray:
+                    h: jnp.ndarray, attn: jnp.ndarray, ep_mesh=None
+                    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Returns (h, dropped_assignments) — dropped is a static 0 on the
+    dense backend (it computes every expert; nothing can drop)."""
     h = _finish_attn(cfg, lp, h, attn)
     x = _rms_norm(h, lp["mlp_norm"], cfg.rms_norm_eps)
-    mlp = (moe_mlp_dispatch if cfg.moe_backend == "dispatch"
-           else moe_mlp)
-    return h + mlp(cfg, lp, x)
+    if cfg.moe_backend == "dispatch":
+        mlp, dropped = moe_mlp_dispatch(cfg, lp, x, ep_mesh=ep_mesh)
+    else:
+        mlp, dropped = moe_mlp(cfg, lp, x), jnp.zeros((), jnp.int32)
+    return h + mlp, dropped
 
 
 def init_params(cfg: ModelConfig, rng: jax.Array,
@@ -182,9 +215,11 @@ def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
             positions: jnp.ndarray, pages: jnp.ndarray,
             page_table: jnp.ndarray, total_lens: jnp.ndarray,
             new_lens: jnp.ndarray,
-            attn_impl: Optional[Callable] = None
-            ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Scan-over-layers MoE forward (same contract as llama.forward)."""
+            attn_impl: Optional[Callable] = None, ep_mesh=None
+            ) -> Tuple[jnp.ndarray, jnp.ndarray, dict]:
+    """Scan-over-layers MoE forward (llama.forward contract plus a third
+    ``aux`` return: ``{"moe_dropped_assignments": scalar}`` summed over
+    layers — the engine forwards it to worker stats)."""
     sm_scale = cfg.head_dim ** -0.5
     attn_impl = attn_impl or paged_attention
     h = params["embed"][tokens]
@@ -196,34 +231,39 @@ def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
         pages = write_kv(pages, lidx, k, v, page_table, positions, new_lens)
         attn = attn_impl(q, pages, lidx, page_table, positions,
                          total_lens, sm_scale)
-        h = _moe_layer_tail(cfg, lp, h, attn)
-        return (h, pages), None
+        h, dropped = _moe_layer_tail(cfg, lp, h, attn, ep_mesh=ep_mesh)
+        return (h, pages), dropped
 
-    (h, pages), _ = jax.lax.scan(
+    (h, pages), drops = jax.lax.scan(
         body, (h, pages), (params["layers"], jnp.arange(cfg.num_layers)))
-    return _logits(cfg, params, h, new_lens), pages
+    aux = {"moe_dropped_assignments": jnp.sum(drops)}
+    return _logits(cfg, params, h, new_lens), pages, aux
 
 
 def forward_unrolled(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
                      positions: jnp.ndarray, pages_list: List[jnp.ndarray],
                      page_table: jnp.ndarray, total_lens: jnp.ndarray,
                      new_lens: jnp.ndarray,
-                     attn_impl: Optional[Callable] = None
-                     ) -> Tuple[jnp.ndarray, List[jnp.ndarray]]:
-    """Unrolled MoE forward (same contract as llama.forward_unrolled)."""
+                     attn_impl: Optional[Callable] = None, ep_mesh=None
+                     ) -> Tuple[jnp.ndarray, List[jnp.ndarray], dict]:
+    """Unrolled MoE forward (llama.forward_unrolled contract plus the
+    ``aux`` drop-count return, see ``forward``)."""
     sm_scale = cfg.head_dim ** -0.5
     attn_impl = attn_impl or paged_attention_layer
     h = params["embed"][tokens]
     out_pages: List[jnp.ndarray] = []
+    total_dropped = jnp.zeros((), jnp.int32)
     for l in range(cfg.num_layers):
         lp = {k: v[l] for k, v in params["layers"].items()}
         q, k, v = _project_qkv(cfg, lp, h, positions)
         kv = write_kv_layer(pages_list[l], k, v, page_table, positions,
                             new_lens)
         attn = attn_impl(q, kv, page_table, positions, total_lens, sm_scale)
-        h = _moe_layer_tail(cfg, lp, h, attn)
+        h, dropped = _moe_layer_tail(cfg, lp, h, attn, ep_mesh=ep_mesh)
+        total_dropped = total_dropped + dropped
         out_pages.append(kv)
-    return _logits(cfg, params, h, new_lens), out_pages
+    aux = {"moe_dropped_assignments": total_dropped}
+    return _logits(cfg, params, h, new_lens), out_pages, aux
 
 
 __all__ = ["forward", "forward_unrolled", "init_params", "moe_mlp",

@@ -32,6 +32,21 @@ device computing its head/ffn shard and the standard two per-layer
 and the mlp down-projection) completing the activations. KV pages shard
 ``Hkv`` over tp inside each stage, so paged reads/writes stay chip-local
 exactly as in the plain tp path.
+
+PP also composes with DP (``pp x dp`` mesh): the batch splits over ``dp``
+OUTSIDE the pipeline ring — each dp replica pipelines its own
+microbatches — while the page pool stays REPLICATED across dp. The
+invariant that keeps the replicas' caches identical: before every cache
+write, the per-layer K/V (and the tick's table/position/new-length rows)
+``all_gather`` over dp, so every replica applies the identical GLOBAL
+write while attending only its local rows. The gathered K/V rows are KBs
+at decode (vs psum-merging whole page-stack deltas, which would move the
+entire cache per step).
+
+The stage body takes the engine's Pallas ``attn_impl`` (the stacked
+decode/prefill kernels run fine on a shard_map-local cache slab — same
+call signature as ``paged_attention``), so pp serving no longer forces
+the XLA scan path.
 """
 
 from __future__ import annotations
@@ -87,19 +102,27 @@ def pipeline_forward(params: Dict[str, Any], cfg: ModelConfig,
                      pages: jnp.ndarray, page_table: jnp.ndarray,
                      total_lens: jnp.ndarray, new_lens: jnp.ndarray,
                      mesh: Mesh, pp_axis: str = "pp", tp_axis: str = "tp",
-                     n_microbatches: int | None = None
+                     dp_axis: str = "dp",
+                     n_microbatches: int | None = None,
+                     attn_impl=None
                      ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Drop-in for ``llama.forward`` running the layers as a pp pipeline.
 
     Requires ``cfg.num_layers %% pp == 0``. ``n_microbatches`` must divide
-    the batch; the default picks the LARGEST divisor of B that is <= pp —
-    M == pp keeps every stage busy in steady state, smaller batches run
-    with pipeline bubbles rather than failing. ``pages`` is the stacked
-    cache ``[L, N, 2, Hkv, ps, Dh]``. A ``tp`` mesh axis > 1 additionally
-    head/ffn-shards each stage (weights placed by ``pp_sharding_fns``).
+    the PER-REPLICA batch; the default picks the LARGEST divisor of B/dp
+    that is <= pp — M == pp keeps every stage busy in steady state,
+    smaller batches run with pipeline bubbles rather than failing.
+    ``pages`` is the stacked cache ``[L, N, 2, Hkv, ps, Dh]``. A ``tp``
+    mesh axis > 1 additionally head/ffn-shards each stage (weights placed
+    by ``pp_sharding_fns``); a ``dp`` axis > 1 splits the batch across
+    replicas (module docstring: K/V writes all_gather over dp so the
+    replicated page pool stays consistent). ``attn_impl`` optionally
+    replaces the XLA paged attention inside the stage body — the stacked
+    Pallas kernels match the call signature.
     """
     n_stages = mesh.shape[pp_axis]
     tp = dict(mesh.shape).get(tp_axis, 1)
+    dp = dict(mesh.shape).get(dp_axis, 1)
     if n_stages == 1:
         from dynamo_tpu.models.llama import forward
         return forward(params, cfg, tokens, positions, pages, page_table,
@@ -112,13 +135,20 @@ def pipeline_forward(params: Dict[str, Any], cfg: ModelConfig,
                          f"intermediate_size={cfg.intermediate_size} not "
                          f"divisible by tp={tp}")
     B = tokens.shape[0]
-    # default: the largest microbatch count <= pp that divides B (a small
-    # serving batch pipelines with bubbles rather than failing)
+    if B % dp:
+        raise ValueError(f"batch {B} not divisible by dp={dp} (the engine "
+                         f"aligns its batch buckets to dp when cfg.mesh "
+                         f"is set)")
+    B_local = B // dp
+    # default: the largest microbatch count <= pp that divides the
+    # per-replica batch (a small serving batch pipelines with bubbles
+    # rather than failing)
     M = n_microbatches or max(m for m in range(1, n_stages + 1)
-                              if B % m == 0)
-    if B % M:
-        raise ValueError(f"batch {B} not divisible by n_microbatches={M}")
-    Bm = B // M
+                              if B_local % m == 0)
+    if B_local % M:
+        raise ValueError(f"per-replica batch {B_local} not divisible by "
+                         f"n_microbatches={M}")
+    Bm = B_local // M
     sm_scale = cfg.head_dim ** -0.5
     layers_per_stage = cfg.num_layers // n_stages
     # per-device view of the head/ffn dims under manual tp: _project_qkv
@@ -134,7 +164,7 @@ def pipeline_forward(params: Dict[str, Any], cfg: ModelConfig,
                  new_lens, pages_local):
         stage = lax.axis_index(pp_axis)
         last = n_stages - 1
-        # microbatch stacks [M, Bm, ...]
+        # microbatch stacks [M, Bm, ...] (per-dp-replica local rows)
         tok_mb = tokens.reshape(M, Bm, -1)
         pos_mb = positions.reshape(M, Bm, -1)
         tbl_mb = page_table.reshape(M, Bm, -1)
@@ -143,19 +173,31 @@ def pipeline_forward(params: Dict[str, Any], cfg: ModelConfig,
         S = tok_mb.shape[2]
         H = cfg.hidden_size
 
+        def gather_dp(x):
+            """Global batch rows for the cache write: every dp replica at
+            (stage, tick) processes the same microbatch index, so tiled
+            all_gathers line up and all replicas apply identical writes."""
+            if dp == 1:
+                return x
+            return lax.all_gather(x, dp_axis, axis=0, tiled=True)
+
         # local layer ids are GLOBAL indices into the pp-sharded page
         # stack's local slab (axis 0 of pages_local is layers_per_stage)
         local_layer_ids = jnp.arange(layers_per_stage)
 
         def run_stage(h, pages_local, pos, tbl, tot, new):
+            pos_g, tbl_g, new_g = gather_dp(pos), gather_dp(tbl), \
+                gather_dp(new)
+
             def body(carry, xs):
                 h, pages_local = carry
                 lp, lidx = xs
                 q, k, v = _project_qkv(cfg_local, lp, h, pos)
-                pages_local = write_kv(pages_local, lidx, k, v, tbl, pos,
-                                       new)
-                attn = paged_attention(q, pages_local, lidx, tbl, pos, tot,
-                                       sm_scale)
+                pages_local = write_kv(pages_local, lidx, gather_dp(k),
+                                       gather_dp(v), tbl_g, pos_g, new_g)
+                attend = attn_impl or paged_attention
+                attn = attend(q, pages_local, lidx, tbl, pos, tot,
+                              sm_scale)
                 if tp == 1:
                     h = _finish_layer(cfg, lp, h, attn)
                 else:
@@ -215,10 +257,10 @@ def pipeline_forward(params: Dict[str, Any], cfg: ModelConfig,
         pages_local, _h, out = lax.fori_loop(
             0, M + n_stages - 1, tick, (pages_local, h0, out0))
         # only the last stage holds real hidden states; broadcast them,
-        # then project to the vocab once
+        # then project to the vocab once (per-replica local rows)
         out = lax.psum(
             jnp.where(stage == last, out, jnp.zeros_like(out)), pp_axis)
-        hn = _rms_norm(out.reshape(B, H), params["final_norm"],
+        hn = _rms_norm(out.reshape(B_local, H), params["final_norm"],
                        cfg.rms_norm_eps)
         lm_head = params.get("lm_head")
         if lm_head is None:
@@ -228,12 +270,14 @@ def pipeline_forward(params: Dict[str, Any], cfg: ModelConfig,
 
     pages_spec = (P(pp_axis) if tp == 1
                   else P(pp_axis, None, None, tp_axis))
+    batch = P(dp_axis)                 # rows split across dp replicas
     specs_in = (
         _param_specs(params, pp_axis, tp),
-        P(), P(), P(), P(), P(),       # tokens/positions/table/total/new
-        pages_spec,                    # pages: layers staged, Hkv over tp
+        batch, batch, batch, batch, batch,  # tokens/pos/table/total/new
+        pages_spec,                    # pages: layers staged, Hkv over tp,
+                                       # REPLICATED over dp (gathered writes)
     )
-    specs_out = (P(), pages_spec)
+    specs_out = (batch, pages_spec)
     fn = jax.shard_map(shard_fn, mesh=mesh, in_specs=specs_in,
                        out_specs=specs_out, check_vma=False)
     logits, pages = fn(params, tokens, positions, page_table, total_lens,

@@ -80,18 +80,22 @@ class OpenAIPreprocessor:
         out.annotations = list((req.nvext.annotations if req.nvext else None) or [])
         return out
 
-    # widest logit_bias the serving engine's sparse penalty window
-    # carries per request (JaxEngineConfig.penalty_window default); more
-    # entries would be silently dropped on device, so reject instead
+    # fallback when the card predates the field: the engine's default
+    # sparse penalty window (JaxEngineConfig.penalty_window)
     MAX_LOGIT_BIAS = 32
 
     def _validate_logit_bias(self, lb):
         if not lb:
             return None
-        if len(lb) > self.MAX_LOGIT_BIAS:
+        # the SERVING engine's configured window (advertised on the model
+        # card by the worker, like num_top_logprobs) — a deployment with a
+        # narrower window must reject wide logit_bias instead of silently
+        # dropping entries on device (ADVICE r4)
+        limit = getattr(self.card, "penalty_window", self.MAX_LOGIT_BIAS)
+        if len(lb) > limit:
             raise ValueError(
-                f"logit_bias supports at most {self.MAX_LOGIT_BIAS} "
-                f"entries, got {len(lb)}")
+                f"logit_bias supports at most {limit} entries on this "
+                f"model's serving engine, got {len(lb)}")
         vocab = self.tokenizer.vocab_size
         out = {}
         for k, v in lb.items():

@@ -87,6 +87,11 @@ class WorkerStats:
     request_total_slots: int = 0
     num_requests_waiting: int = 0
     data_parallel_rank: Optional[int] = None
+    # cumulative MoE dispatch overflow (token-expert assignments dropped
+    # past expert capacity) — 0 on dense models/backends; a growing value
+    # tells an operator that output perturbation is dispatch overflow, not
+    # model behavior (extension over the reference's protocols.rs fields)
+    moe_dropped_tokens: int = 0
 
     def to_dict(self) -> Dict[str, Any]:
         return {
@@ -94,6 +99,7 @@ class WorkerStats:
             "request_total_slots": self.request_total_slots,
             "num_requests_waiting": self.num_requests_waiting,
             "data_parallel_rank": self.data_parallel_rank,
+            "moe_dropped_tokens": self.moe_dropped_tokens,
         }
 
     @classmethod
@@ -103,6 +109,7 @@ class WorkerStats:
             request_total_slots=d.get("request_total_slots", 0),
             num_requests_waiting=d.get("num_requests_waiting", 0),
             data_parallel_rank=d.get("data_parallel_rank"),
+            moe_dropped_tokens=d.get("moe_dropped_tokens", 0),
         )
 
 

@@ -265,12 +265,15 @@ def _buf_get(nbytes: int):
 
 def release_buffer(raw: Any) -> None:
     """Return a frame buffer received from ``bulk_fetch`` to the freelist
-    (after the consumer has fully copied/used it)."""
+    (after the consumer has fully copied/used it). Double-releasing the
+    same buffer is ignored — pooling one ndarray twice would hand it to
+    two concurrent fetches and interleave their frames (ADVICE r4)."""
     if not hasattr(raw, "nbytes"):
         return
     with _buf_lock:
         free = _buf_pool.setdefault(raw.nbytes, [])
-        if len(free) < _BUF_POOL_PER_SIZE:
+        if len(free) < _BUF_POOL_PER_SIZE \
+                and not any(b is raw for b in free):
             free.append(raw)
 
 

@@ -165,18 +165,19 @@ def build_engine(args: argparse.Namespace) -> JaxEngine:
                 f"model has {cfg.num_layers} layers — not divisible by "
                 f"--pipeline-parallel-size {pp}")
         pp_tp = args.tensor_parallel_size
-        mesh = make_mesh(MeshSpec(pp=pp, tp=pp_tp),
-                         devices=jax.devices()[:pp * pp_tp])
+        pp_dp = args.data_parallel_size
+        mesh = make_mesh(MeshSpec(pp=pp, tp=pp_tp, dp=pp_dp),
+                         devices=jax.devices()[:pp * pp_tp * pp_dp])
         shard_params, shard_pages = pp_sharding_fns(mesh, cfg)
-        engine_cfg.attn_impl = "scan"  # pipeline runs the stacked-cache path
         engine_cfg.shard_params_fn = shard_params
         engine_cfg.shard_pages_fn = shard_pages
+        if pp_dp > 1:
+            # the engine aligns batch buckets to dp and re-replicates the
+            # packed sample output when cfg.mesh carries a dp axis
+            engine_cfg.mesh = mesh
         forward_fn = functools.partial(pipeline_forward, mesh=mesh)
     tp, sp = args.tensor_parallel_size, args.sequence_parallel_size
     dp = args.data_parallel_size
-    if pp > 1 and dp > 1:
-        raise SystemExit("--pipeline-parallel-size does not combine with "
-                         "--data-parallel-size yet")
     if (tp > 1 or sp > 1 or dp > 1) and pp == 1:
         from dynamo_tpu.parallel.mesh import MeshSpec, make_mesh
         from dynamo_tpu.parallel.sharding import ModelSharding
@@ -226,6 +227,9 @@ async def amain(args: argparse.Namespace) -> None:
     endpoint = (drt.namespace(args.namespace).component(args.component)
                 .endpoint(args.endpoint))
     engine = build_engine(args)
+    # advertise the engine's sparse penalty/logit_bias window so the
+    # frontend preprocessor rejects requests the device would truncate
+    card.penalty_window = engine.cfg.penalty_window
 
     # a dead engine loop takes the worker's registration down with it, so
     # routers stop sending to a zombie (reference: task.rs critical tasks)

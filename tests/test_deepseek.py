@@ -40,9 +40,10 @@ def _prefill(params, cfg, rows, pages, table):
     for i, r in enumerate(rows):
         toks[i, :len(r)] = r
     pos = np.tile(np.arange(S, dtype=np.int32), (B, 1))
-    return deepseek.forward(params, cfg, jnp.asarray(toks),
-                            jnp.asarray(pos), pages, table,
-                            jnp.asarray(lens), jnp.asarray(lens))
+    logits, out_pages, _aux = deepseek.forward(
+        params, cfg, jnp.asarray(toks), jnp.asarray(pos), pages, table,
+        jnp.asarray(lens), jnp.asarray(lens))
+    return logits, out_pages
 
 
 def test_family_registry():
@@ -80,7 +81,7 @@ class TestForward:
         pages_b = make_pages(cfg, 6, 8, dtype=jnp.float32)
         _, pages_b = _prefill(params, cfg, [prompt[:-1]], pages_b, table)
         n = len(prompt) - 1
-        logits, _ = deepseek.forward(
+        logits, _, _ = deepseek.forward(
             params, cfg, jnp.asarray([[prompt[-1]]], jnp.int32),
             jnp.asarray([[n]], jnp.int32), pages_b, table,
             jnp.asarray([n + 1], jnp.int32), jnp.asarray([1], jnp.int32))
@@ -99,7 +100,7 @@ class TestForward:
         _, pages_b = _prefill(params, cfg, [prompt[:split]], pages_b, table)
         rest = prompt[split:]
         S = len(rest)
-        logits, _ = deepseek.forward(
+        logits, _, _ = deepseek.forward(
             params, cfg, jnp.asarray([rest], jnp.int32),
             jnp.asarray([list(range(split, split + S))], jnp.int32),
             pages_b, table, jnp.asarray([len(prompt)], jnp.int32),
@@ -151,10 +152,10 @@ class TestForward:
             1, 255, size=(B, S)), jnp.int32)
         pos = jnp.tile(jnp.arange(S, dtype=jnp.int32)[None], (B, 1))
         lens = jnp.full((B,), S, jnp.int32)
-        l1, p1 = deepseek.forward(
+        l1, p1, _ = deepseek.forward(
             params, cfg, toks, pos, make_pages(cfg, 8, 4, jnp.float32),
             table, lens, lens)
-        l2, p2 = deepseek.forward_unrolled(
+        l2, p2, _ = deepseek.forward_unrolled(
             params, cfg, toks, pos,
             make_pages_list(cfg, 8, 4, jnp.float32), table, lens, lens)
         np.testing.assert_allclose(np.asarray(l1), np.asarray(l2),

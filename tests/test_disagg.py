@@ -423,6 +423,23 @@ class TestBatchedFrameTransfer:
             await b.stop()
 
 
+def test_bulk_double_release_is_ignored():
+    """Releasing the same receive buffer twice must not pool it twice —
+    two concurrent fetches handed one ndarray would interleave their
+    frames (ADVICE r4)."""
+    import numpy as np
+
+    from dynamo_tpu.runtime import bulk
+
+    buf = np.empty(4096, np.uint8)
+    with bulk._buf_lock:
+        bulk._buf_pool.pop(4096, None)
+    bulk.release_buffer(buf)
+    bulk.release_buffer(buf)
+    with bulk._buf_lock:
+        assert sum(1 for b in bulk._buf_pool[4096] if b is buf) == 1
+
+
 class TestBulkPlaneDisagg:
     async def test_disagg_over_bulk_plane(self):
         """Disagg with the raw-socket bulk data plane: the prefill worker

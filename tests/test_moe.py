@@ -80,18 +80,75 @@ class TestMoeDispatch:
         lp = {k: v[0] for k, v in p["layers"].items()}
         x = self._x(cfg)
         dense = np.asarray(moe.moe_mlp(cfg, lp, x))
-        disp = np.asarray(moe.moe_mlp_dispatch(cfg, lp, x))
+        disp = np.asarray(moe.moe_mlp_dispatch(cfg, lp, x)[0])
         np.testing.assert_allclose(disp, dense, rtol=2e-4, atol=2e-4)
 
-    def test_overflow_drops_lowest_priority(self):
-        # capacity so tight some assignments must drop: output differs from
-        # dense but stays finite and bounded by it in magnitude
+    def test_overflow_drops_are_counted(self):
+        # capacity so tight some assignments must drop (T > the small-batch
+        # auto-raise threshold): output stays finite and the drop counter
+        # reports the EXACT overflow a numpy replay of the dispatch
+        # predicts (VERDICT r4 weak 5: drops used to be silent)
+        cfg = moe_cfg(moe_backend="dispatch", moe_capacity_factor=0.3)
+        p = moe.init_params(cfg, jax.random.PRNGKey(0))
+        lp = {k: v[0] for k, v in p["layers"].items()}
+        x = self._x(cfg, B=1, S=96)
+        out, dropped = moe.moe_mlp_dispatch(cfg, lp, x)
+        out = np.asarray(out)
+        assert np.isfinite(out).all()
+        # numpy replay: per-expert routed counts minus capacity
+        import math
+        T, k, E = 96, cfg.num_experts_per_tok, cfg.num_experts
+        C = max(1, min(T, math.ceil(T * k * cfg.moe_capacity_factor / E)))
+        _w, top_i = moe._router_topk(cfg, lp, x.reshape(T, -1))
+        counts = np.bincount(np.asarray(top_i).reshape(-1), minlength=E)
+        want = int(np.maximum(counts - C, 0).sum())
+        assert want > 0, "test geometry must actually overflow"
+        assert int(dropped) == want
+
+    def test_small_batch_capacity_autoraise(self):
+        # decode-size batches (T <= 64) get capacity padded to 4x the
+        # expected load: the tight capacity factor above must NOT drop here
         cfg = moe_cfg(moe_backend="dispatch", moe_capacity_factor=0.3)
         p = moe.init_params(cfg, jax.random.PRNGKey(0))
         lp = {k: v[0] for k, v in p["layers"].items()}
         x = self._x(cfg, B=1, S=16)
-        out = np.asarray(moe.moe_mlp_dispatch(cfg, lp, x))
-        assert np.isfinite(out).all()
+        out, dropped = moe.moe_mlp_dispatch(cfg, lp, x)
+        assert int(dropped) == 0
+        # and with drops impossible, dispatch matches dense exactly
+        dense = np.asarray(moe.moe_mlp(cfg, lp, x))
+        np.testing.assert_allclose(np.asarray(out), dense,
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_dispatch_buffers_shard_over_ep(self):
+        # with an ep mesh passed, the [E, C, H] dispatch buffers must be
+        # CONSTRAINED to P("ep") — each chip holds only [E_local, C, H]
+        from jax.sharding import NamedSharding, PartitionSpec
+        cfg = moe_cfg(moe_backend="dispatch", moe_capacity_factor=4.0)
+        p = moe.init_params(cfg, jax.random.PRNGKey(0))
+        mesh = make_mesh(MeshSpec(ep=2), devices=jax.devices()[:2])
+        shard = ModelSharding(cfg, mesh)
+        sp = shard.shard_params(p)
+        lp = {k: v[0] for k, v in sp["layers"].items()}
+        x = self._x(cfg)
+
+        def probe(cfg_, lp_, x_):
+            out, dropped = moe.moe_mlp_dispatch(cfg_, lp_, x_, ep_mesh=mesh)
+            return out, dropped
+
+        lowered = jax.jit(probe, static_argnums=(0,)).lower(cfg, lp, x)
+        txt = lowered.as_text()
+        # the buffer constraints must appear in the lowered module with
+        # the expert (leading) axis pinned to the mesh's ep axis — xe AND
+        # ye, so both the dispatch scatter and the combine gather cross
+        # shards as collectives instead of replicating [E, C, H]
+        n_constraints = txt.count('sharding_constraint %')
+        assert n_constraints >= 2 and '[{"ep"}, {}, {}]' in txt, \
+            txt[:2000]
+        out, dropped = jax.jit(probe, static_argnums=(0,))(cfg, lp, x)
+        dense = np.asarray(moe.moe_mlp(cfg, {k: v[0] for k, v in
+                                             p["layers"].items()}, x))
+        np.testing.assert_allclose(np.asarray(out), dense,
+                                   rtol=2e-3, atol=2e-3)
 
     def test_forward_ep_sharded_matches_dense_logits(self):
         cfg_dense = moe_cfg()
@@ -103,14 +160,14 @@ class TestMoeDispatch:
         table = jnp.array([[1, 2, 0], [3, 4, 0]], jnp.int32)
         total = jnp.full((B,), S, jnp.int32)
         new = jnp.full((B,), S, jnp.int32)
-        ref, _ = moe.forward(params, cfg_dense, tokens, positions,
+        ref, _, _ = moe.forward(params, cfg_dense, tokens, positions,
                              llama.make_pages(cfg_dense, 8, 4),
                              table, total, new)
         mesh = make_mesh(MeshSpec(ep=2), devices=jax.devices()[:2])
         shard = ModelSharding(cfg_disp, mesh)
         sp = shard.shard_params(params)
         pages = shard.shard_pages(llama.make_pages(cfg_disp, 8, 4))
-        got, _ = jax.jit(lambda p, pg: moe.forward(
+        got, _, _ = jax.jit(lambda p, pg: moe.forward(
             p, cfg_disp, tokens, positions, pg, table, total, new))(sp, pages)
         np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                    rtol=2e-3, atol=2e-3)
@@ -152,9 +209,9 @@ class TestMoeForward:
         table = jnp.array([[1, 2, 0], [3, 4, 0]], jnp.int32)
         total = jnp.full((B,), S, jnp.int32)
         new = jnp.full((B,), S, jnp.int32)
-        l1, _ = moe.forward(params, cfg, tokens, positions, stacked,
+        l1, _, _ = moe.forward(params, cfg, tokens, positions, stacked,
                             table, total, new)
-        l2, _ = moe.forward_unrolled(params, cfg, tokens, positions, layered,
+        l2, _, _ = moe.forward_unrolled(params, cfg, tokens, positions, layered,
                                      table, total, new)
         np.testing.assert_allclose(np.asarray(l1), np.asarray(l2),
                                    rtol=2e-5, atol=2e-5)
@@ -176,6 +233,26 @@ class TestMoeEngine:
             frames = [f async for f in eng.generate(make_req(range(1, 10), "m"))]
             toks = [t for f in frames for t in f.token_ids]
             assert len(toks) == 5
+        finally:
+            await eng.stop()
+
+    async def test_dispatch_drop_counter_reaches_worker_stats(self):
+        """An over-capacity prefill through the dispatch backend must show
+        up in engine stats as moe_dropped_tokens > 0 — operators can now
+        tell dispatch overflow from model behavior (VERDICT r4 weak 5)."""
+        cfg = moe_cfg(moe_backend="dispatch", moe_capacity_factor=0.3)
+        eng = JaxEngine.random_init(cfg, JaxEngineConfig(
+            num_pages=64, page_size=4, max_num_seqs=2,
+            max_prefill_chunk=128, max_context=256, min_prefill_bucket=96))
+        try:
+            frames = [f async for f in eng.generate(
+                make_req(range(1, 97), "drop", max_tokens=2))]
+            assert sum(len(f.token_ids) for f in frames) == 2
+            stats = eng.stats()
+            assert stats.worker_stats.moe_dropped_tokens > 0
+            # serialization carries the field end-to-end
+            assert stats.to_dict()["worker_stats"]["moe_dropped_tokens"] \
+                == stats.worker_stats.moe_dropped_tokens
         finally:
             await eng.stop()
 
@@ -261,9 +338,9 @@ class TestMoeLoader:
         toks = jnp.array([[1, 2, 3]], jnp.int32)
         pos = jnp.array([[0, 1, 2]], jnp.int32)
         table = jnp.array([[1]], jnp.int32)
-        logits, _ = moe.forward(params, cfg, toks, pos, pages, table,
-                                jnp.array([3], jnp.int32),
-                                jnp.array([3], jnp.int32))
+        logits, _, _ = moe.forward(params, cfg, toks, pos, pages, table,
+                                   jnp.array([3], jnp.int32),
+                                   jnp.array([3], jnp.int32))
         assert logits.shape == (1, cfg.vocab_size)
 
     def test_missing_expert_tensor_rejected(self, tmp_path):

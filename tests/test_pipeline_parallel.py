@@ -80,6 +80,87 @@ def test_pipeline_composes_with_tp():
                                rtol=2e-4, atol=2e-4)
 
 
+def test_pipeline_composes_with_dp():
+    """pp=2 x dp=2: batch rows split across dp replicas OUTSIDE the
+    pipeline ring; K/V writes all_gather over dp so the replicated page
+    pool stays consistent. Logits AND cache writes must match the plain
+    forward (VERDICT r4 item 6: the pp x dp restriction)."""
+    cfg, params, pages, tokens, positions, table, total, new = _setup()
+    ref_logits, ref_pages = llama.forward(
+        params, cfg, tokens, positions, pages, table, total, new)
+
+    mesh = make_mesh(MeshSpec(pp=2, dp=2), devices=jax.devices()[:4])
+    pages2 = llama.make_pages(cfg, num_pages=pages.shape[1], page_size=4,
+                              dtype=jnp.float32)
+    pp_logits, pp_pages = pipeline_forward(
+        params, cfg, tokens, positions, pages2, table, total, new,
+        mesh=mesh, n_microbatches=2)
+    np.testing.assert_allclose(np.asarray(pp_logits),
+                               np.asarray(ref_logits), rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(pp_pages[:, 1:]),
+                               np.asarray(ref_pages[:, 1:]),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_pipeline_pp_tp_dp_all_compose():
+    """pp=2 x tp=2 x dp=2 on all 8 virtual devices: stages + head shards
+    + batch replicas in one mesh (the reference engines' free pp x tp x dp
+    composition, launch/dynamo-run/src/main.rs:28)."""
+    from dynamo_tpu.parallel.pipeline import pp_sharding_fns
+
+    cfg, params, pages, tokens, positions, table, total, new = _setup()
+    ref_logits, ref_pages = llama.forward(
+        params, cfg, tokens, positions, pages, table, total, new)
+
+    mesh = make_mesh(MeshSpec(pp=2, tp=2, dp=2), devices=jax.devices()[:8])
+    shard_params, shard_pages = pp_sharding_fns(mesh, cfg)
+    p2 = shard_params(params)
+    pages2 = shard_pages(llama.make_pages(
+        cfg, num_pages=pages.shape[1], page_size=4, dtype=jnp.float32))
+    pp_logits, pp_pages = pipeline_forward(
+        p2, cfg, tokens, positions, pages2, table, total, new,
+        mesh=mesh, n_microbatches=2)
+    np.testing.assert_allclose(np.asarray(pp_logits),
+                               np.asarray(ref_logits), rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(pp_pages[:, 1:]),
+                               np.asarray(ref_pages[:, 1:]),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_pipeline_stage_runs_pallas_decode_kernel():
+    """The stacked Pallas decode kernel runs INSIDE a pp stage (shard_map
+    local cache slab; interpret mode on CPU): a decode step through the
+    pipeline with attn_impl must match the plain forward."""
+    from dynamo_tpu.ops.pallas.decode import paged_decode_attention_stacked
+
+    cfg = ModelConfig.tiny(num_layers=4, head_dim=128)
+    params = llama.init_params(cfg, jax.random.PRNGKey(2))
+    B, P_, ps = 4, 4, 8
+    prompt_len = 7
+    table = jnp.arange(1, 1 + B * P_, dtype=jnp.int32).reshape(B, P_)
+    toks = jnp.asarray(np.random.RandomState(1).randint(
+        1, cfg.vocab_size, size=(B, prompt_len)), jnp.int32)
+    pos = jnp.tile(jnp.arange(prompt_len, dtype=jnp.int32)[None], (B, 1))
+    lens = jnp.full((B,), prompt_len, jnp.int32)
+    pages = llama.make_pages(cfg, 1 + B * P_, ps, dtype=jnp.float32)
+    _, pages = llama.forward(params, cfg, toks, pos, pages, table, lens,
+                             lens)
+
+    # one decode token through both paths
+    dt = jnp.asarray([[9], [8], [7], [6]], jnp.int32)
+    dpos = jnp.full((B, 1), prompt_len, jnp.int32)
+    dtotal = jnp.full((B,), prompt_len + 1, jnp.int32)
+    done = jnp.ones((B,), jnp.int32)
+    ref_logits, _ = llama.forward(params, cfg, dt, dpos, pages, table,
+                                  dtotal, done)
+    mesh = make_mesh(MeshSpec(pp=2), devices=jax.devices()[:2])
+    pp_logits, _ = pipeline_forward(
+        params, cfg, dt, dpos, pages, table, dtotal, done, mesh=mesh,
+        n_microbatches=2, attn_impl=paged_decode_attention_stacked)
+    np.testing.assert_allclose(np.asarray(pp_logits),
+                               np.asarray(ref_logits), rtol=2e-3, atol=2e-3)
+
+
 def test_pp1_falls_through_to_plain():
     cfg, params, pages, tokens, positions, table, total, new = _setup()
     mesh = make_mesh(MeshSpec(pp=1), devices=jax.devices()[:1])
@@ -189,6 +270,54 @@ class TestPipelineServing:
                                 shard_params_fn=shard_params,
                                 shard_pages_fn=shard_pages)
         from dynamo_tpu.parallel.pipeline import pipeline_forward
+        eng = JaxEngine(cfg, params, ecfg2,
+                        forward_fn=functools.partial(pipeline_forward,
+                                                     mesh=mesh))
+        got = await run(eng)
+        assert got == want
+
+    async def test_engine_serves_with_pp_dp(self):
+        """pp=2 x dp=2 serving through the engine: cfg.mesh aligns the
+        batch buckets to dp and the pipeline splits rows across replicas —
+        greedy tokens must match a plain engine (restriction lifted,
+        VERDICT r4 item 6)."""
+        import functools
+
+        from dynamo_tpu.engine.jax_engine import JaxEngine, JaxEngineConfig
+        from dynamo_tpu.parallel.pipeline import (
+            pipeline_forward, pp_sharding_fns)
+        from dynamo_tpu.protocols.common import (
+            PreprocessedRequest, SamplingOptions, StopConditions)
+
+        def req(rid):
+            return PreprocessedRequest(
+                token_ids=[1, 2, 3, 4, 5, 6], request_id=rid,
+                stop_conditions=StopConditions(max_tokens=6),
+                sampling_options=SamplingOptions(temperature=0.0),
+                eos_token_ids=[])
+
+        async def run(engine):
+            try:
+                frames = [f async for f in engine.generate(req("r"))]
+                return [t for f in frames for t in f.token_ids]
+            finally:
+                await engine.stop()
+
+        cfg = ModelConfig.tiny(num_layers=4)
+        params = llama.init_params(cfg, jax.random.PRNGKey(1))
+        ecfg = JaxEngineConfig(num_pages=32, page_size=4, max_num_seqs=4,
+                               max_prefill_chunk=4, max_context=32,
+                               min_prefill_bucket=4, attn_impl="scan")
+        want = await run(JaxEngine(cfg, params, ecfg))
+
+        mesh = make_mesh(MeshSpec(pp=2, dp=2), devices=jax.devices()[:4])
+        shard_params, shard_pages = pp_sharding_fns(mesh)
+        ecfg2 = JaxEngineConfig(num_pages=32, page_size=4, max_num_seqs=4,
+                                max_prefill_chunk=4, max_context=32,
+                                min_prefill_bucket=4, attn_impl="scan",
+                                mesh=mesh,
+                                shard_params_fn=shard_params,
+                                shard_pages_fn=shard_pages)
         eng = JaxEngine(cfg, params, ecfg2,
                         forward_fn=functools.partial(pipeline_forward,
                                                      mesh=mesh))

@@ -80,6 +80,30 @@ def test_preprocess_rejects_overlong_prompt(card):
         pre.preprocess_completion(req)
 
 
+def test_logit_bias_limit_follows_engine_penalty_window(card):
+    """The serving engine's configured penalty_window (advertised on the
+    card, like num_top_logprobs) bounds accepted logit_bias — a narrower
+    deployment must reject instead of silently truncating on device
+    (ADVICE r4). The card fields survive the registration wire format."""
+    from dynamo_tpu.model_card import ModelDeploymentCard
+
+    card.penalty_window = 4
+    pre = OpenAIPreprocessor(card)
+    req = ChatCompletionRequest(
+        model="m", messages=[{"role": "user", "content": "hi"}],
+        logit_bias={str(i): 1.0 for i in range(5)})
+    with pytest.raises(ValueError, match="at most 4"):
+        pre.preprocess_chat(req)
+    ok = ChatCompletionRequest(
+        model="m", messages=[{"role": "user", "content": "hi"}],
+        logit_bias={str(i): 1.0 for i in range(4)})
+    assert pre.preprocess_chat(ok).sampling_options.logit_bias is not None
+    # wire round-trip preserves the engine-capability advertisements
+    back = ModelDeploymentCard.from_dict(card.to_dict())
+    assert back.penalty_window == 4
+    assert back.num_top_logprobs == card.num_top_logprobs
+
+
 def test_max_tokens_clamped_to_context(card):
     card.context_length = 16
     pre = OpenAIPreprocessor(card)

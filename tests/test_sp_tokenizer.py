@@ -113,6 +113,74 @@ class TestUnigram:
         assert out == "café"
 
 
+def _norm_spec(name: str = "", charsmap: bytes = b"",
+               add_dummy_prefix=None, remove_extra=None,
+               escape_ws=None, rule_tsv: bytes = b"") -> bytes:
+    body = b""
+    if name:
+        body += _len_field(1, name.encode())
+    if charsmap:
+        body += _len_field(2, charsmap)
+    if add_dummy_prefix is not None:
+        body += _field(3, 0, _varint(int(add_dummy_prefix)))
+    if remove_extra is not None:
+        body += _field(4, 0, _varint(int(remove_extra)))
+    if escape_ws is not None:
+        body += _field(5, 0, _varint(int(escape_ws)))
+    if rule_tsv:
+        body += _len_field(6, rule_tsv)
+    return _len_field(3, body)  # ModelProto.normalizer_spec = 3
+
+
+class TestNormalizerSpec:
+    def test_nfkc_charsmap_rejected_loudly(self):
+        """A model demanding nmt_nfkc (precompiled charsmap) must raise at
+        LOAD with a clear message — not silently mis-tokenize (VERDICT r4
+        weak 7)."""
+        import pytest
+        blob = unigram_model() + _norm_spec("nmt_nfkc",
+                                            charsmap=b"\x01\x02\x03")
+        with pytest.raises(ValueError, match="nmt_nfkc"):
+            SpTokenizer.from_bytes(blob)
+
+    def test_rule_tsv_rejected(self):
+        import pytest
+        blob = unigram_model() + _norm_spec("user_defined",
+                                            rule_tsv=b"a\tb\n")
+        with pytest.raises(ValueError, match="does not implement"):
+            SpTokenizer.from_bytes(blob)
+
+    def test_identity_spec_accepted(self):
+        blob = unigram_model() + _norm_spec("identity")
+        tk = SpTokenizer.from_bytes(blob)
+        assert tk.decode(tk.encode("hello world")) == "hello world"
+
+    def test_flags_respected(self):
+        # no dummy prefix: "hello" segments without a leading ▁
+        blob = unigram_model() + _norm_spec("identity",
+                                            add_dummy_prefix=False)
+        tk = SpTokenizer.from_bytes(blob)
+        ids = tk.encode("hello")
+        assert [tk._pieces[i][0] for i in ids][0] in ("he", "h")
+        # remove_extra_whitespaces collapses runs + strips edges
+        blob2 = unigram_model() + _norm_spec("identity",
+                                             remove_extra=True)
+        tk2 = SpTokenizer.from_bytes(blob2)
+        assert tk2.encode("  hello   world  ") == tk2.encode("hello world")
+
+    def test_tabs_and_newlines_byte_fallback(self):
+        """Identity-normalizer semantics: \\t and \\n are NOT rewritten to
+        the space piece — they byte-fallback exactly like real SP does for
+        the llama family (the charsmap models that DO rewrite them are
+        rejected at load)."""
+        tk = SpTokenizer.from_bytes(unigram_model())
+        ids = tk.encode("hello\tworld\n")
+        assert tk.decode(ids) == "hello\tworld\n"
+        byte_ids = {v for v in range(len(tk._pieces))
+                    if tk._pieces[v][2] == 6}
+        assert sum(1 for i in ids if i in byte_ids) >= 2
+
+
 class TestBpe:
     def test_merge_order(self):
         tk = SpTokenizer.from_bytes(bpe_model())
