@@ -529,10 +529,13 @@ async def _measure_kv_wire(engine) -> float:
     client = await RpcConnection(server.address).connect()
 
     async def fetch_once() -> int:
+        from dynamo_tpu.runtime.codec import release_buffer
+
         got = 0
         stream = await client.request("kv_wire_bench", {})
         async for frame in stream:
             got += len(frame["_raw"])
+            release_buffer(frame["_raw"])  # steady state: buffers recycle
         return got
 
     try:

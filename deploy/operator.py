@@ -28,6 +28,11 @@ The planner's runtime scale decisions still flow through
 ``deploy/reconciler.py`` (coordinator-KV -> replica patches); this
 controller owns the declarative shape. Run:
 ``python deploy/operator.py --kube-namespace dynamo``.
+
+SCOPE (also stated in docs/deployment.md): poll-based (no watches — next
+``--interval`` pass picks up changes; kubectl failures requeue after
+``--retry-interval``), no admission webhooks (invalid specs surface as
+``state: Failed``), single-namespace. One instance per namespace.
 """
 
 from __future__ import annotations
@@ -250,9 +255,14 @@ async def update_status(cr: Dict[str, Any], state: str,
 
 # --------------------------------------------------------------- reconcile
 
-async def reconcile_once(kube_namespace: str) -> int:
-    """One full pass over every graph CR; returns the CR count."""
+async def reconcile_once(kube_namespace: str) -> Tuple[int, int]:
+    """One full pass over every graph CR; returns (cr_count, failed_count).
+    A CR whose apply failed is marked ``Failed`` and counts toward the
+    failed total, which the controller loop uses to REQUEUE sooner than
+    the normal interval (the role of controller-runtime's error requeue
+    backoff)."""
     crs = await list_graph_crs(kube_namespace)
+    failed = 0
     for cr in crs:
         name = cr["metadata"]["name"]
         try:
@@ -260,6 +270,8 @@ async def reconcile_once(kube_namespace: str) -> int:
         except ValueError as e:
             logger.error("graph %s invalid: %s", name, e)
             await update_status(cr, "Failed", kube_namespace)
+            # invalid specs do NOT requeue fast: re-running cannot fix a
+            # bad CR — the user must edit it (the next normal pass sees it)
             continue
         ok = await apply_manifests(manifests)
         keep: Dict[str, List[str]] = {"deployment": [], "service": []}
@@ -267,26 +279,32 @@ async def reconcile_once(kube_namespace: str) -> int:
             keep[m["kind"].lower()].append(m["metadata"]["name"])
         await prune_children(name, keep, kube_namespace)
         state = (await graph_state(cr, kube_namespace)) if ok else "Failed"
+        if not ok:
+            failed += 1
         await update_status(cr, state, kube_namespace)
-    return len(crs)
+    return len(crs), failed
 
 
-async def run_controller(kube_namespace: str, interval: float) -> None:
+async def run_controller(kube_namespace: str, interval: float,
+                         retry_interval: float = 2.0) -> None:
     logger.info("graph controller reconciling %s/%s every %.0fs",
                 kube_namespace, PLURAL, interval)
     while True:
+        failed = 0
         try:
-            n = await reconcile_once(kube_namespace)
-            logger.debug("reconciled %d graph(s)", n)
+            _n, failed = await reconcile_once(kube_namespace)
         except Exception:  # noqa: BLE001 — controller must outlive blips
             logger.exception("reconcile pass failed")
-        await asyncio.sleep(interval)
+            failed = 1  # API-server/kubectl blip: retry soon
+        await asyncio.sleep(retry_interval if failed else interval)
 
 
 def main() -> None:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--kube-namespace", default="default")
     p.add_argument("--interval", type=float, default=10.0)
+    p.add_argument("--retry-interval", type=float, default=2.0,
+                   help="requeue delay after a pass with kubectl failures")
     p.add_argument("--once", action="store_true",
                    help="single reconcile pass (CI / cron)")
     args = p.parse_args()
@@ -296,7 +314,8 @@ def main() -> None:
         asyncio.run(reconcile_once(args.kube_namespace))
         return
     try:
-        asyncio.run(run_controller(args.kube_namespace, args.interval))
+        asyncio.run(run_controller(args.kube_namespace, args.interval,
+                                   args.retry_interval))
     except KeyboardInterrupt:
         pass
 

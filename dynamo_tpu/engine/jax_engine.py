@@ -27,6 +27,7 @@ load-metric publication.
 from __future__ import annotations
 
 import logging
+import threading
 from dataclasses import dataclass
 from typing import Callable, Optional, Tuple
 
@@ -228,6 +229,9 @@ class JaxEngine(ScheduledEngineBase):
         # constant-zero aux we never enqueue.
         self._pending_moe_drops: list = []
         self._moe_dropped_total = 0
+        # appends happen on the step worker thread, drains on either that
+        # thread (the >512 cap) or the event-loop thread (stats scrape)
+        self._moe_drops_lock = threading.Lock()
         self._moe_dispatch_active = (
             getattr(model_cfg, "moe_backend", "") == "dispatch")
         # multi-host: called with (kind, arrays, step) right before each
@@ -680,8 +684,11 @@ class JaxEngine(ScheduledEngineBase):
         if self._moe_dispatch_active and "moe_dropped_assignments" in aux:
             # device scalar; fetched lazily at stats-scrape time so the hot
             # loop never pays an extra host round trip
-            self._pending_moe_drops.append(aux["moe_dropped_assignments"])
-            if len(self._pending_moe_drops) > 512:
+            with self._moe_drops_lock:
+                self._pending_moe_drops.append(
+                    aux["moe_dropped_assignments"])
+                overflow = len(self._pending_moe_drops) > 512
+            if overflow:
                 # bounded memory: drain all but the freshest few (those may
                 # still be in flight; everything older has long completed)
                 self._drain_moe_drops(keep_last=8)
@@ -689,16 +696,20 @@ class JaxEngine(ScheduledEngineBase):
         return packed
 
     def _drain_moe_drops(self, keep_last: int = 0) -> None:
-        if len(self._pending_moe_drops) <= keep_last:
-            return
-        done = self._pending_moe_drops[:len(self._pending_moe_drops)
-                                       - keep_last]
-        self._pending_moe_drops = self._pending_moe_drops[-keep_last:] \
-            if keep_last else []
+        # swap the list out under the lock (appends race from the step
+        # worker thread, scrapes from the event loop); the device transfer
+        # runs OUTSIDE it so a slow fetch never blocks the step thread
+        with self._moe_drops_lock:
+            if len(self._pending_moe_drops) <= keep_last:
+                return
+            split = len(self._pending_moe_drops) - keep_last
+            done = self._pending_moe_drops[:split]
+            self._pending_moe_drops = self._pending_moe_drops[split:]
         # ONE batched transfer, not a device_get per scalar (each fetch is
         # a full round trip on a tunneled backend)
-        self._moe_dropped_total += int(sum(
-            int(x) for x in jax.device_get(done)))
+        total = int(sum(int(x) for x in jax.device_get(done)))
+        with self._moe_drops_lock:
+            self._moe_dropped_total += total
 
     def moe_dropped_total(self) -> int:
         """Cumulative MoE dispatch overflow count (token-expert assignments
@@ -706,7 +717,8 @@ class JaxEngine(ScheduledEngineBase):
         scalar — called from the stats scrape path, where blocking on at
         most the one in-flight step is acceptable."""
         self._drain_moe_drops(keep_last=0)
-        return self._moe_dropped_total
+        with self._moe_drops_lock:
+            return self._moe_dropped_total
 
     def stats(self):
         m = super().stats()

@@ -238,6 +238,9 @@ class TieredEngine(EngineBase):
                         continue
                     injected += await self.engine.run_exclusive(
                         inject_frame, self.engine, frame)
+                    # inject_frame copies; recycle the pooled trailer
+                    from dynamo_tpu.runtime.codec import release_buffer
+                    release_buffer(frame["_raw"])
             except Exception as e:  # noqa: BLE001 — peers are best-effort
                 logger.debug("G4 peer %x fetch failed: %s", iid, e)
                 continue

@@ -32,7 +32,14 @@ import struct
 import threading
 from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
-from dynamo_tpu.runtime.codec import MAX_FRAME, byte_view, pack, unpack
+from dynamo_tpu.runtime.codec import (
+    MAX_FRAME,
+    byte_view,
+    buf_get as _buf_get,
+    pack,
+    release_buffer,
+    unpack,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -241,40 +248,9 @@ def _connect(address: str, timeout: float) -> socket.socket:
                           f"{last_err}")
 
 
-# Receive-buffer freelist. Faulting in fresh anonymous pages for every
-# multi-MB frame costs more than the socket itself (measured: 1.9 GB/s into
-# a warm buffer vs 0.7 into a fresh one on this host class). Buffers are
-# np.empty so pages are NOT memset; a consumer that is done with a frame
-# calls ``release_buffer(raw)`` and the next fetch of the same frame size
-# reuses the warm pages. Unreleased buffers are simply garbage-collected —
-# release is an optimization, never a correctness requirement.
-_BUF_POOL_PER_SIZE = 4
-_buf_pool: Dict[int, List[Any]] = {}
-_buf_lock = threading.Lock()
-
-
-def _buf_get(nbytes: int):
-    import numpy as _np
-
-    with _buf_lock:
-        free = _buf_pool.get(nbytes)
-        if free:
-            return free.pop()
-    return _np.empty(nbytes, _np.uint8)
-
-
-def release_buffer(raw: Any) -> None:
-    """Return a frame buffer received from ``bulk_fetch`` to the freelist
-    (after the consumer has fully copied/used it). Double-releasing the
-    same buffer is ignored — pooling one ndarray twice would hand it to
-    two concurrent fetches and interleave their frames (ADVICE r4)."""
-    if not hasattr(raw, "nbytes"):
-        return
-    with _buf_lock:
-        free = _buf_pool.setdefault(raw.nbytes, [])
-        if len(free) < _BUF_POOL_PER_SIZE \
-                and not any(b is raw for b in free):
-            free.append(raw)
+# The receive-buffer freelist lives in runtime/codec.py (shared with the
+# RPC plane's large two-part trailers); ``release_buffer`` is re-exported
+# here because bulk consumers import it from this module.
 
 
 def _fetch_on(s: socket.socket, endpoint: str, payload: Any, ident: str,

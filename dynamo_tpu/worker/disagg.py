@@ -409,6 +409,8 @@ class DisaggDecodeHandler:
                 # backpressure loop would spin on window.acquire forever
                 abort.set()
         if not bulk_done:
+            from dynamo_tpu.runtime.codec import release_buffer
+
             kv_stream = await self._kv_client.direct(
                 {"block_hashes": hashes, "wire": 2}, iid)
             # batched two-part frames: inject frame k while frame k+1
@@ -419,6 +421,9 @@ class DisaggDecodeHandler:
                     total += len(frame["blocks"])
                     injected += await self.engine.run_exclusive(
                         inject_frame, self.engine, frame)
+                    # inject_frame made its owning copy; recycle the
+                    # pooled trailer buffer for the next frame
+                    release_buffer(frame["_raw"])
                 else:  # pre-batched single-block schema
                     legacy.append(BlockPayload.from_wire(frame))
             if legacy:

@@ -426,18 +426,19 @@ class TestBatchedFrameTransfer:
 def test_bulk_double_release_is_ignored():
     """Releasing the same receive buffer twice must not pool it twice —
     two concurrent fetches handed one ndarray would interleave their
-    frames (ADVICE r4)."""
+    frames (ADVICE r4). The freelist lives in runtime/codec.py, shared
+    with the RPC plane's pooled two-part trailers."""
     import numpy as np
 
-    from dynamo_tpu.runtime import bulk
+    from dynamo_tpu.runtime import bulk, codec
 
     buf = np.empty(4096, np.uint8)
-    with bulk._buf_lock:
-        bulk._buf_pool.pop(4096, None)
+    with codec._buf_lock:
+        codec._buf_pool.pop(4096, None)
+    bulk.release_buffer(buf)  # bulk re-exports codec's release
     bulk.release_buffer(buf)
-    bulk.release_buffer(buf)
-    with bulk._buf_lock:
-        assert sum(1 for b in bulk._buf_pool[4096] if b is buf) == 1
+    with codec._buf_lock:
+        assert sum(1 for b in codec._buf_pool[4096] if b is buf) == 1
 
 
 class TestBulkPlaneDisagg:

@@ -88,6 +88,9 @@ with open(log, "a") as f:
     f.write(json.dumps({"args": args, "stdin": stdin}) + "\n")
 def has(*words):
     return all(w in args for w in words)
+if has("apply") and os.environ.get("FAKE_APPLY_FAILS"):
+    sys.stderr.write("server unavailable")
+    sys.exit(1)
 if has("get") and any(a.startswith("dynamographdeployments") for a in args):
     print(open(os.environ["FAKE_CRS"]).read())
 elif has("get", "deployment"):
@@ -159,6 +162,65 @@ class TestReconcileLoop:
         body = json.loads(patch_args[patch_args.index("-p") + 1])
         assert body["status"]["state"] == "Ready"
         assert body["status"]["observedGeneration"] == 3
+
+    def test_apply_failure_marks_failed_and_requeues_fast(self, tmp_path):
+        """kubectl/apply failure: the CR transitions to status Failed AND
+        the controller loop requeues after --retry-interval instead of
+        waiting the full reconcile interval (the role of
+        controller-runtime's error requeue)."""
+        import asyncio
+
+        kdir = tmp_path / "bin"
+        kdir.mkdir()
+        kubectl = kdir / "kubectl"
+        kubectl.write_text(FAKE_KUBECTL)
+        kubectl.chmod(kubectl.stat().st_mode | stat.S_IEXEC)
+        log = tmp_path / "calls.jsonl"
+        crs = tmp_path / "crs.json"
+        crs.write_text(json.dumps({"items": [graph_cr()]}))
+        env = dict(os.environ)
+        env["PATH"] = f"{kdir}:{env['PATH']}"
+        env["FAKE_KUBECTL_LOG"] = str(log)
+        env["FAKE_CRS"] = str(crs)
+        env["FAKE_APPLY_FAILS"] = "1"
+        r = subprocess.run(
+            [sys.executable, os.path.join(os.path.dirname(__file__), "..",
+                                          "deploy", "operator.py"),
+             "--once", "--kube-namespace", "ns1"],
+            env=env, capture_output=True, timeout=60)
+        assert r.returncode == 0, r.stderr.decode()
+        calls = [json.loads(line) for line in log.read_text().splitlines()]
+        patches = [c["args"] for c in calls
+                   if "patch" in c["args"] and "--subresource=status"
+                   in c["args"]]
+        body = json.loads(patches[0][patches[0].index("-p") + 1])
+        assert body["status"]["state"] == "Failed"
+
+        # requeue timing: a failing pass sleeps retry_interval, a clean
+        # pass sleeps the full interval (reconcile_once stubbed)
+        sleeps = []
+        results = iter([(1, 1), (1, 0)])
+
+        async def fake_reconcile(ns):
+            return next(results)
+
+        async def fake_sleep(t):
+            sleeps.append(t)
+            if len(sleeps) >= 2:
+                raise asyncio.CancelledError
+
+        orig_reconcile = operator.reconcile_once
+        orig_sleep = operator.asyncio.sleep
+        operator.reconcile_once = fake_reconcile
+        operator.asyncio.sleep = fake_sleep
+        try:
+            with pytest.raises(asyncio.CancelledError):
+                asyncio.run(operator.run_controller(
+                    "ns1", interval=30.0, retry_interval=2.0))
+        finally:
+            operator.reconcile_once = orig_reconcile
+            operator.asyncio.sleep = orig_sleep
+        assert sleeps == [2.0, 30.0]
 
     def test_invalid_graph_marked_failed(self, tmp_path):
         kdir = tmp_path / "bin"

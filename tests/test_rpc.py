@@ -51,6 +51,47 @@ async def test_multiplexed_concurrent_streams():
         await server.stop()
 
 
+async def test_two_part_large_trailer_pooled_and_recycled():
+    """Multi-MB two-part trailers arrive as POOLED uint8 buffers (chunked
+    reads, no StreamReader join copy — ~25% of wire throughput at KV
+    sizes) and release_buffer() recycles the same backing buffer for the
+    next same-size frame; small trailers stay plain bytes."""
+    import numpy as np
+
+    from dynamo_tpu.runtime import codec
+    from dynamo_tpu.runtime.codec import Raw, release_buffer
+
+    big = np.arange(2 * 1024 * 1024, dtype=np.uint8) % 251
+    small = b"tiny-trailer"
+
+    async def handler(payload, ctx):
+        yield Raw({"kind": "big"}, big)
+        yield Raw({"kind": "small"}, small)
+        yield Raw({"kind": "big2"}, big)
+
+    server = await RpcServer().start()
+    server.register("kv", handler)
+    client = await RpcConnection(server.address).connect()
+    try:
+        with codec._buf_lock:
+            codec._buf_pool.pop(big.nbytes, None)
+        frames = [f async for f in await client.request("kv", {})]
+        raws = {f["kind"]: f["_raw"] for f in frames}
+        assert isinstance(raws["small"], bytes) and raws["small"] == small
+        assert isinstance(raws["big"], np.ndarray)
+        assert np.array_equal(raws["big"], big)
+        assert np.array_equal(raws["big2"], big)
+        # release -> the next same-size fetch reuses the SAME backing buffer
+        release_buffer(raws["big"])
+        frames2 = [f async for f in await client.request("kv", {})]
+        big_again = next(f["_raw"] for f in frames2 if f["kind"] == "big")
+        assert big_again is raws["big"]
+        assert np.array_equal(big_again, big)
+    finally:
+        await client.close()
+        await server.stop()
+
+
 async def test_handler_error_propagates():
     async def bad(payload, ctx):
         yield 1
