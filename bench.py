@@ -348,6 +348,8 @@ async def run_attempt(args) -> dict:
         kv_bulk_gbps = await _measure_kv_bulk(engine)
         wd.arm("transport:e2e", STAGE_BUDGETS["transport"])
         kv_e2e_gbps = await _measure_kv_bulk_inject(engine)
+        wd.arm("transport:direct", STAGE_BUDGETS["transport"])
+        kv_direct_gbps = await asyncio.to_thread(_measure_kv_direct, engine)
 
         # HBM roofline for bandwidth-bound decode on this model/batch:
         # each decode step streams all params + the batch's live KV context.
@@ -384,6 +386,7 @@ async def run_attempt(args) -> dict:
         "kv_wire_gbps": kv_wire_gbps,
         "kv_bulk_gbps": kv_bulk_gbps,
         "kv_e2e_gbps": kv_e2e_gbps,
+        "kv_direct_gbps": kv_direct_gbps,
         "prefill_tok_s": round(m["prefill_tok_s"], 1),
         "ttft_p50_s": round(m["ttft_p50"], 3),
         "warmup_s": round(m["warmup_s"], 1),
@@ -576,6 +579,52 @@ def _measure_kv_inject(engine) -> float:
           f"in {dt * 1e3:.1f}ms (median of {TRANSPORT_REPS}) "
           f"-> {gbps:.1f} GB/s", file=sys.stderr, flush=True)
     return round(gbps, 2)
+
+
+def _measure_kv_direct(engine):
+    """Device-direct transfer-plane bandwidth (GB/s): the jax transfer
+    server loopback — gathered device pages offered and pulled back into
+    the same client with NO host numpy in the KV path (the NIXL RDMA
+    role, ``engine/transfer.DeviceTransferPlane``; VERDICT r4 item 3's
+    chip-to-chip prototype). Returns None when the backend's client does
+    not support the transfer server (recorded, not fatal)."""
+    import jax
+
+    try:
+        from jax.experimental import transfer as jxfer
+        from jax.sharding import SingleDeviceSharding
+
+        n_blk = 1
+        while n_blk * 2 <= min(64, engine.allocator.num_pages - 2):
+            n_blk *= 2
+        ids = list(range(1, n_blk + 1))
+        data = engine.dispatch_gather_pages(ids)
+        jax.block_until_ready(data)
+        client = jax.devices()[0].client
+        srv = jxfer.start_transfer_server(
+            client, "127.0.0.1:0", ["127.0.0.1:0"])
+        conn = srv.connect(srv.address())
+        spec = jax.ShapeDtypeStruct(
+            data.shape, data.dtype,
+            sharding=SingleDeviceSharding(jax.devices()[0]))
+        times = []
+        for rep in range(TRANSPORT_REPS + 1):  # first rep warms the conn
+            t0 = time.perf_counter()
+            srv.await_pull(1000 + rep, [data])
+            (pulled,) = conn.pull(1000 + rep, [spec])
+            jax.block_until_ready(pulled)
+            times.append(time.perf_counter() - t0)
+        dt = statistics.median(times[1:])
+        nbytes = data.size * data.dtype.itemsize
+        gbps = nbytes / dt / 1e9
+        print(f"bench: kv direct {n_blk} blocks ({nbytes / 1e6:.1f} MB) "
+              f"in {dt * 1e3:.1f}ms (median of {TRANSPORT_REPS}) "
+              f"-> {gbps:.2f} GB/s", file=sys.stderr, flush=True)
+        return round(gbps, 2)
+    except Exception as e:  # noqa: BLE001 — optional plane, record absence
+        print(f"bench: kv direct plane unavailable: {e}",
+              file=sys.stderr, flush=True)
+        return None
 
 
 async def _measure_kv_bulk_inject(engine) -> float:

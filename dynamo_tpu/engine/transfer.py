@@ -20,6 +20,7 @@ indistinguishable from having computed the prefix locally.
 from __future__ import annotations
 
 import asyncio
+import time
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -295,6 +296,177 @@ def serve_kv_export(engine: JaxEngine):
     return handler
 
 
+# ---------------------------------------------------------------------------
+# Device-direct cross-process transfer (jax.experimental.transfer)
+# ---------------------------------------------------------------------------
+
+# offered device arrays are dropped if nobody pulled them in this window
+OFFER_TTL_S = 120.0
+
+
+class DeviceTransferPlane:
+    """Cross-process device-to-device KV block pulls — the NIXL RDMA role
+    proper (reference ``lib/llm/src/block_manager/block/transfer/nixl.rs``,
+    ``nixl_connect/__init__.py:975-1122``).
+
+    Built on ``jax.experimental.transfer``: the prefill worker OFFERS a
+    gathered device array under a uuid on its transfer server; the decode
+    worker PULLS it straight into its own jax client — on TPU the bytes
+    ride the accelerator-aware transports, never a numpy host bounce
+    (contrast: the bulk/RPC planes gather to host, ship sockets, scatter
+    back). The offer/pull rendezvous metadata (uuid, address, shape,
+    dtype, block hashes) travels over the ordinary RPC control plane
+    (``serve_kv_export`` with ``{"direct": true}``).
+
+    Scope: single-device-per-process engines (the common prefill/decode
+    pair). Engines sharded over a mesh keep the bulk/RPC planes — a pull
+    onto a NamedSharding needs a shared global mesh across processes.
+    """
+
+    # bound the per-address connection cache: prefill restarts advertise
+    # fresh ephemeral ports, so a long-lived decode worker would otherwise
+    # accumulate one dead connection per historical address
+    MAX_CONNS = 8
+
+    def __init__(self, host: str = "127.0.0.1"):
+        self.host = host
+        self._server = None
+        self._conns: Dict[str, Any] = {}
+        self._offers: Dict[int, Tuple[float, Any]] = {}
+        self._next_uuid = int(time.time() * 1000) % (1 << 40)
+
+    # -- common ------------------------------------------------------------
+
+    def _ensure_server(self):
+        if self._server is None:
+            import jax as _jax
+            from jax.experimental import transfer as _transfer
+
+            client = _jax.devices()[0].client
+            # explicit transport addresses: without them the cross-process
+            # bulk-transport factory CHECK-fails (jaxlib streaming.cc:193)
+            self._server = _transfer.start_transfer_server(
+                client, f"{self.host}:0", [f"{self.host}:0"])
+        return self._server
+
+    @property
+    def address(self) -> str:
+        addr = self._ensure_server().address()
+        # jaxlib may report a wildcard bind; rewrite to the serve host
+        if addr.startswith(("0.0.0.0:", "[::]:")):
+            addr = f"{self.host}:{addr.rsplit(':', 1)[1]}"
+        return addr
+
+    # -- source (prefill) side ---------------------------------------------
+
+    def _prune_offers(self, now: float) -> None:
+        self._offers = {u: (t, a) for u, (t, a) in self._offers.items()
+                        if now - t < OFFER_TTL_S}
+
+    def offer(self, engine: JaxEngine, block_hashes: List[int]
+              ) -> Optional[Dict[str, Any]]:
+        """Gather the resident blocks ON DEVICE and offer them for one
+        pull. Runs under ``run_exclusive``. Returns the rendezvous dict
+        (wire-safe) or None when nothing is resident."""
+        metas, data = _export_device(engine, block_hashes)
+        if not metas:
+            return None
+        now = time.time()
+        self._prune_offers(now)
+        uuid = self._next_uuid
+        self._next_uuid += 1
+        server = self._ensure_server()
+        server.await_pull(uuid, [data])
+        # keep the array alive until acked or TTL — the offer holds the
+        # only reference once the engine moves on. The decode side ACKS a
+        # completed pull (serve_kv_export_direct payload {"ack": uuid}),
+        # so under traffic offers free promptly; an un-acked offer (decode
+        # crashed mid-pull) frees at the next offer/ack's TTL prune.
+        self._offers[uuid] = (now, data)
+        return {
+            "uuid": uuid,
+            "address": self.address,
+            "shape": list(data.shape),
+            "dtype": str(data.dtype),
+            "blocks": [[h, local, parent] for h, local, parent in metas],
+        }
+
+    def ack(self, uuid: int) -> None:
+        """Drop a pulled offer's device array (and any expired ones)."""
+        self._offers.pop(uuid, None)
+        self._prune_offers(time.time())
+
+    # -- destination (decode) side -----------------------------------------
+
+    def pull(self, offer: Dict[str, Any]):
+        """Pull an offered array device-to-device; returns the device
+        array. Touches NO engine state — callers run it on any thread
+        (with their own timeout) and commit via ``inject`` afterwards.
+        A failed pull evicts the cached connection so a retry against a
+        rebound peer reconnects."""
+        import jax as _jax
+        import jax.numpy as _jnp
+        from jax.sharding import SingleDeviceSharding
+
+        addr = offer["address"]
+        conn = self._conns.get(addr)
+        if conn is None:
+            if len(self._conns) >= self.MAX_CONNS:
+                self._conns.pop(next(iter(self._conns)))
+            conn = self._ensure_server().connect(addr)
+            self._conns[addr] = conn
+        spec = _jax.ShapeDtypeStruct(
+            tuple(offer["shape"]), _jnp.dtype(offer["dtype"]),
+            sharding=SingleDeviceSharding(_jax.devices()[0]))
+        try:
+            (data,) = conn.pull(offer["uuid"], [spec])
+            _jax.block_until_ready(data)
+        except Exception:
+            self._conns.pop(addr, None)
+            raise
+        return data
+
+    @staticmethod
+    def inject(engine: JaxEngine, offer: Dict[str, Any], data) -> int:
+        """Commit a pulled array's blocks into the cache. Runs under
+        ``run_exclusive`` (the scatter reassigns ``engine.pages``)."""
+        metas = [(b[0], b[1], b[2]) for b in offer["blocks"]]
+        # trim gather padding before the scatter re-pads for its own ids
+        return _inject_data(engine, metas, data[:, :len(metas)])
+
+    def pull_and_inject(self, engine: JaxEngine,
+                        offer: Dict[str, Any]) -> int:
+        """Composite pull + inject (in-process/test convenience; the
+        disagg handler runs the two phases separately so the network pull
+        never blocks the engine's exclusive window)."""
+        return self.inject(engine, offer, self.pull(offer))
+
+
+def serve_kv_export_direct(engine: JaxEngine,
+                           plane: DeviceTransferPlane):
+    """RPC handler serving device-direct rendezvous offers: payload
+    ``{"block_hashes": [...]}`` -> one offer dict (or an empty frame when
+    nothing is resident); ``{"ack": uuid}`` releases a pulled offer's
+    device array. Registered beside the frame/bulk exports."""
+
+    async def handler(payload: Any, ctx):
+        payload = payload or {}
+        if payload.get("ack") is not None:
+            plane.ack(int(payload["ack"]))
+            yield {"acked": True}
+            return
+        hashes = list(payload.get("block_hashes", []))
+        offer = await engine.run_exclusive(plane.offer, engine, hashes)
+        yield offer if offer is not None else {}
+
+    return handler
+
+
+KV_EXPORT_DIRECT_ENDPOINT = "kv_export_direct"
+
+
 __all__ = ["BlockPayload", "export_blocks", "inject_blocks",
            "export_frames", "inject_frame", "transfer_blocks_ici",
-           "serve_kv_export", "serve_kv_export_bulk", "BLOCKS_PER_FRAME"]
+           "serve_kv_export", "serve_kv_export_bulk", "BLOCKS_PER_FRAME",
+           "DeviceTransferPlane", "serve_kv_export_direct",
+           "KV_EXPORT_DIRECT_ENDPOINT"]

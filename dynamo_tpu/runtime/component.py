@@ -40,6 +40,10 @@ class Instance:
     # endpoints of the worker's bulk data plane (runtime/bulk.py) when it
     # serves one — the NIXL-role transport KV blocks ride instead of RPC
     bulk_address: str = ""
+    # jax transfer-server address (engine/transfer.DeviceTransferPlane)
+    # when the worker serves device-direct KV pulls — blocks move
+    # device-to-device with no host bounce (the NIXL RDMA role proper)
+    direct_address: str = ""
 
     @property
     def etcd_key(self) -> str:
@@ -60,6 +64,8 @@ class Instance:
         }
         if self.bulk_address:
             d["bulk_address"] = self.bulk_address
+        if self.direct_address:
+            d["direct_address"] = self.direct_address
         return json.dumps(d).encode()
 
     @classmethod
@@ -68,7 +74,8 @@ class Instance:
         return cls(
             namespace=d["namespace"], component=d["component"],
             endpoint=d["endpoint"], instance_id=d["instance_id"],
-            address=d["address"], bulk_address=d.get("bulk_address", ""))
+            address=d["address"], bulk_address=d.get("bulk_address", ""),
+            direct_address=d.get("direct_address", ""))
 
 
 class Namespace:
@@ -154,7 +161,8 @@ class Endpoint:
     async def serve(self, handler: Handler,
                     stats_provider: Optional[Callable[[], Any]] = None,
                     graceful_shutdown: bool = True,
-                    bulk_address: str = "") -> "ServedEndpoint":
+                    bulk_address: str = "",
+                    direct_address: str = "") -> "ServedEndpoint":
         """Register the handler on the local RpcServer and announce the
         instance in the coordinator under the primary lease.
 
@@ -169,7 +177,8 @@ class Endpoint:
         inst = Instance(
             namespace=self.namespace, component=self.component,
             endpoint=self.name, instance_id=lease.lease_id,
-            address=server.address, bulk_address=bulk_address)
+            address=server.address, bulk_address=bulk_address,
+            direct_address=direct_address)
         await drt.coord.put(inst.etcd_key, inst.to_json(), lease_id=lease.lease_id)
         logger.info("serving endpoint %s as instance %x at %s",
                     self.path, inst.instance_id, inst.address)

@@ -24,6 +24,7 @@ from typing import Any, AsyncIterator, Optional
 
 from dynamo_tpu.engine.jax_engine import JaxEngine
 from dynamo_tpu.engine.transfer import (
+    KV_EXPORT_DIRECT_ENDPOINT,
     BlockPayload,
     inject_blocks,
     inject_frame,
@@ -40,6 +41,26 @@ from dynamo_tpu.utils.aio import reap_task
 logger = logging.getLogger(__name__)
 
 KV_EXPORT_ENDPOINT = "kv_export"
+
+
+def make_device_transfer_plane(engine: JaxEngine):
+    """A ``DeviceTransferPlane`` for this engine, or None when the
+    device-direct path does not apply: the jax transfer API is missing,
+    or the engine's cache is sharded over a mesh (a cross-process pull
+    onto a NamedSharding needs a shared global mesh — those deployments
+    keep the bulk/RPC planes)."""
+    from jax.sharding import SingleDeviceSharding
+
+    try:
+        from jax.experimental import transfer  # noqa: F401
+    except ImportError:
+        return None
+    ref = engine.pages[0] if isinstance(engine.pages, list) else engine.pages
+    if not isinstance(ref.sharding, SingleDeviceSharding) \
+            and len(ref.sharding.device_set) > 1:
+        return None
+    from dynamo_tpu.engine.transfer import DeviceTransferPlane
+    return DeviceTransferPlane()
 
 
 def disagg_conf_key(namespace: str) -> str:
@@ -68,12 +89,13 @@ class PrefillQueueWorker:
 
     def __init__(self, engine: JaxEngine, drt: DistributedRuntime,
                  namespace: str, instance_id: int, bulk_address: str = "",
-                 concurrency: int = 2):
+                 concurrency: int = 2, direct_address: str = ""):
         self.engine = engine
         self.drt = drt
         self.namespace = namespace
         self.instance_id = instance_id
         self.bulk_address = bulk_address
+        self.direct_address = direct_address
         self.concurrency = concurrency
         self._tasks: list = []
         self.jobs_done = 0
@@ -129,6 +151,7 @@ class PrefillQueueWorker:
                 "out": final.to_dict() if final is not None else None,
                 "instance_id": self.instance_id,
                 "bulk_address": self.bulk_address,
+                "direct_address": self.direct_address,
             }
         except Exception:  # noqa: BLE001 — reply even on failure, so the
             # decode side falls back immediately instead of waiting out
@@ -176,15 +199,26 @@ class DisaggDecodeHandler:
         self.strategy = strategy
         self._gen_client = None
         self._kv_client = None
+        self._kv_direct_client = None
         self._router: Optional[PushRouter] = None
         self._conf_watch = None
         self._conf_task: Optional[asyncio.Task] = None
+        # device-direct pull plane (engine/transfer.DeviceTransferPlane):
+        # built lazily at start when the jax transfer API is available and
+        # the engine is single-device (mesh engines keep the host planes)
+        self._direct_plane = None
+        # bound on one device-direct pull; past it the (abandoned) pull
+        # thread is left behind and the transport ladder falls to bulk
+        self.direct_pull_timeout = 60.0
 
     async def start(self) -> "DisaggDecodeHandler":
         ns = self.drt.namespace(self.namespace)
         comp = ns.component(self.prefill_component)
         self._gen_client = await comp.endpoint("generate").client()
         self._kv_client = await comp.endpoint(KV_EXPORT_ENDPOINT).client()
+        self._kv_direct_client = await comp.endpoint(
+            KV_EXPORT_DIRECT_ENDPOINT).client()
+        self._direct_plane = make_device_transfer_plane(self.engine)
         self._router = PushRouter(self._gen_client, RouterMode.ROUND_ROBIN)
         self._conf_watch = await self.drt.coord.watch_prefix(
             disagg_conf_key(self.namespace))
@@ -200,7 +234,8 @@ class DisaggDecodeHandler:
                 await self._conf_watch.cancel()
             except Exception:
                 pass
-        for c in (self._gen_client, self._kv_client):
+        for c in (self._gen_client, self._kv_client,
+                  self._kv_direct_client):
             if c is not None:
                 await c.close()
 
@@ -271,7 +306,8 @@ class DisaggDecodeHandler:
             if hashes:
                 await self._pull_blocks(
                     hashes, reply["instance_id"],
-                    bulk_address=reply.get("bulk_address", ""))
+                    bulk_address=reply.get("bulk_address", ""),
+                    direct_address=reply.get("direct_address", ""))
             return final
         finally:
             try:
@@ -319,17 +355,55 @@ class DisaggDecodeHandler:
             return None
 
     async def _pull_blocks(self, hashes: list, iid: int,
-                           bulk_address: str = "") -> None:
+                           bulk_address: str = "",
+                           direct_address: str = "") -> None:
         """Fetch + inject the prefix blocks from prefill worker ``iid``.
 
-        Prefers the worker's bulk data plane (raw sockets, unix-first —
-        the NIXL-role transport); falls back to batched two-part frames on
-        the RPC plane when the instance advertises no bulk address."""
+        Transport ladder: DEVICE-DIRECT (jax transfer server — blocks move
+        chip-to-chip with no host bounce, the NIXL RDMA role) when both
+        sides run it, else the bulk data plane (raw sockets, unix-first),
+        else batched two-part frames on the RPC plane."""
         inst = self._kv_client.get_instance(iid)
         if not bulk_address and inst is not None:
             bulk_address = inst.bulk_address
+        if not direct_address and inst is not None:
+            direct_address = inst.direct_address
         injected = total = 0
         bulk_done = False
+        if direct_address and self._direct_plane is not None:
+            try:
+                offer_stream = await self._kv_direct_client.direct(
+                    {"block_hashes": hashes}, iid)
+                offer = None
+                async for o in offer_stream:
+                    offer = o
+                if offer and offer.get("uuid") is not None:
+                    # the network pull runs OUTSIDE the engine's exclusive
+                    # window (it touches no engine state) with a timeout —
+                    # a stalled transfer connection must never wedge the
+                    # decode loop; only the fast device scatter is
+                    # exclusive. A timed-out pull abandons its thread and
+                    # falls down the ladder.
+                    data = await asyncio.wait_for(
+                        asyncio.to_thread(self._direct_plane.pull, offer),
+                        timeout=self.direct_pull_timeout)
+                    injected = await self.engine.run_exclusive(
+                        self._direct_plane.inject, self.engine, offer,
+                        data)
+                    logger.debug("device-direct pull injected %d blocks "
+                                 "from %x", injected, iid)
+                    try:  # release the peer's pinned offer promptly
+                        ack = await self._kv_direct_client.direct(
+                            {"ack": offer["uuid"]}, iid)
+                        async for _ in ack:
+                            pass
+                    except Exception:  # noqa: BLE001 — TTL covers it
+                        pass
+                    return
+                return  # prefix evicted remotely: nothing to pull anywhere
+            except Exception as e:  # noqa: BLE001 — fall down the ladder
+                logger.warning("device-direct KV pull from %s failed (%s); "
+                               "trying the bulk plane", direct_address, e)
         if bulk_address:
             from dynamo_tpu.runtime.bulk import bulk_fetch, release_buffer
             # stream-and-inject: frames hop from the fetch thread into an
@@ -453,7 +527,9 @@ class DisaggDecodeHandler:
             hashes = [b[0] for b in blocks]
             await self._pull_blocks(hashes, int(params.get("instance_id", 0)),
                                     bulk_address=params.get("bulk_address",
-                                                            ""))
+                                                            ""),
+                                    direct_address=params.get(
+                                        "direct_address", ""))
         except Exception as e:  # noqa: BLE001 — prefix pull is best-effort
             logger.warning("inbound prefill block pull failed (%s); "
                            "decoding with local prefill", e)
@@ -521,13 +597,15 @@ class PrefillFirstHandler:
 
     def __init__(self, engine: JaxEngine, drt: DistributedRuntime,
                  namespace: str, decode_component: str,
-                 instance_id: int = 0, bulk_address: str = ""):
+                 instance_id: int = 0, bulk_address: str = "",
+                 direct_address: str = ""):
         self.engine = engine
         self.drt = drt
         self.namespace = namespace
         self.decode_component = decode_component
         self.instance_id = instance_id
         self.bulk_address = bulk_address
+        self.direct_address = direct_address
         self._decode_client = None
         self._router: Optional[PushRouter] = None
 
@@ -570,6 +648,8 @@ class PrefillFirstHandler:
             params["logprob"] = final.log_probs[0]
         params["instance_id"] = self.instance_id
         params["bulk_address"] = self.bulk_address
+        if self.direct_address:
+            params["direct_address"] = self.direct_address
         fwd.kv_transfer_params = params
         relayed = False
         try:

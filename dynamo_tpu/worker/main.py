@@ -354,12 +354,37 @@ async def amain(args: argparse.Namespace) -> None:
             bulk_handler = serve_kv_export_bulk(
                 engine, asyncio.get_running_loop())
         bulk_server.register(KV_EXPORT_ENDPOINT, bulk_handler)
-        await kv_ep.serve(kv_handler, bulk_address=bulk_server.address)
+        # device-direct plane (jax transfer server): blocks pull chip-to-
+        # chip with no host bounce when the decode side supports it; HBM-
+        # resident blocks only, so the tiered export keeps the host planes
+        direct_address = ""
+        if tiered is None:
+            from dynamo_tpu.engine.transfer import (
+                KV_EXPORT_DIRECT_ENDPOINT, serve_kv_export_direct)
+            from dynamo_tpu.worker.disagg import make_device_transfer_plane
+            plane = make_device_transfer_plane(engine)
+            if plane is not None:
+                try:
+                    plane.host = args.bulk_host
+                    direct_address = plane.address
+                    direct_ep = (drt.namespace(args.namespace)
+                                 .component(args.component)
+                                 .endpoint(KV_EXPORT_DIRECT_ENDPOINT))
+                    await direct_ep.serve(
+                        serve_kv_export_direct(engine, plane))
+                except Exception:  # noqa: BLE001 — serving must not die
+                    logger.exception("device-direct KV plane unavailable; "
+                                     "bulk/RPC planes serve")
+                    direct_address = ""
+        await kv_ep.serve(kv_handler, bulk_address=bulk_server.address,
+                          direct_address=direct_address)
         if prefill_first:
             # prefill-first: THIS worker is the chat entrypoint; decode
             # workers are internal. The handler forwards with our bulk
-            # address so decode pulls ride the fast plane.
+            # (and device-direct) addresses so decode pulls ride the
+            # fastest available plane.
             handler.bulk_address = bulk_server.address
+            handler.direct_address = direct_address
             await register_llm(drt, endpoint, card)
         else:
             await register_llm(drt, endpoint, card, model_type="prefill")
@@ -370,7 +395,8 @@ async def amain(args: argparse.Namespace) -> None:
             queue_worker = await PrefillQueueWorker(
                 tiered if tiered is not None else engine, drt, args.namespace,
                 instance_id=lease.lease_id,
-                bulk_address=bulk_server.address).start()
+                bulk_address=bulk_server.address,
+                direct_address=direct_address).start()
     elif args.disagg == "decode" and prefill_first:
         await register_llm(drt, endpoint, card, model_type="decode")
     else:

@@ -46,10 +46,13 @@ def test_single_child_attempt_chain():
     assert result["best_progress"]["stage"] == "measured"
     assert result["best_progress"]["programs_primed"] == 3
     assert result["best_progress"]["platform"] == "cpu"
-    # all four transport planes measured (bulk, wire, inject, e2e)
+    # all four host transport planes measured (bulk, wire, inject, e2e);
+    # the device-direct plane is best-effort (None when the backend's
+    # client lacks the transfer server) but the key must be present
     for key in ("kv_inject_gbps", "kv_wire_gbps", "kv_bulk_gbps",
                 "kv_e2e_gbps"):
         assert result[key] > 0, key
+    assert "kv_direct_gbps" in result
     # forced-CPU children are honest about validity
     assert result["valid"] is False
     assert result["tier"] == "tiny"

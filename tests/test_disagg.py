@@ -100,6 +100,85 @@ class TestBlockTransfer:
             await a.stop()
 
 
+class TestDeviceDirectTransfer:
+    """The jax transfer-server plane (engine/transfer.DeviceTransferPlane):
+    offer on the source engine, pull+inject into the destination with NO
+    numpy host bounce in the KV path — the NIXL RDMA role proper. Runs
+    in-process over a loopback transfer connection (the cross-process
+    topology was probed separately; same API surface)."""
+
+    async def test_offer_pull_inject_roundtrip(self):
+        from dynamo_tpu.engine.transfer import DeviceTransferPlane
+
+        a = JaxEngine.random_init(ModelConfig.tiny(), engine_cfg())
+        b = JaxEngine.random_init(ModelConfig.tiny(), engine_cfg())
+        try:
+            prompt = list(range(1, 14))  # 13 tokens -> 3 full blocks
+            solo = await collect(a.generate(make_req(prompt, "solo")))
+            solo_toks = [t for f in solo for t in f.token_ids]
+
+            req = make_req(prompt, "p")
+            req.prefill_only = True
+            frames = await collect(a.generate(req))
+            hashes = [blk[0] for blk in
+                      frames[-1].kv_transfer_params["blocks"]]
+
+            plane = DeviceTransferPlane()
+            offer = await a.run_exclusive(plane.offer, a, hashes)
+            assert offer is not None and len(offer["blocks"]) == 3
+            assert offer["address"]
+            injected = await b.run_exclusive(
+                plane.pull_and_inject, b, offer)
+            assert injected == 3
+
+            # the injected prefix must be a REAL cache hit producing the
+            # same greedy tokens as the aggregated run
+            out = await collect(b.generate(make_req(prompt, "d")))
+            assert out[-1].cached_tokens == 12
+            got = [t for f in out for t in f.token_ids]
+            assert got == solo_toks
+        finally:
+            await a.stop()
+            await b.stop()
+
+    async def test_offer_empty_when_blocks_evicted(self):
+        from dynamo_tpu.engine.transfer import DeviceTransferPlane
+
+        a = JaxEngine.random_init(ModelConfig.tiny(), engine_cfg())
+        try:
+            plane = DeviceTransferPlane()
+            offer = await a.run_exclusive(plane.offer, a, [123456789])
+            assert offer is None
+        finally:
+            await a.stop()
+
+    async def test_plane_gating(self):
+        """make_device_transfer_plane: single-device engines get a plane;
+        mesh-sharded caches keep the host planes (a cross-process pull
+        onto a NamedSharding needs a shared global mesh)."""
+        import jax
+
+        from dynamo_tpu.parallel import MeshSpec, ModelSharding, make_mesh
+        from dynamo_tpu.worker.disagg import make_device_transfer_plane
+
+        single = JaxEngine.random_init(ModelConfig.tiny(), engine_cfg())
+        try:
+            assert make_device_transfer_plane(single) is not None
+        finally:
+            await single.stop()
+
+        cfg = ModelConfig.tiny(num_kv_heads=2)
+        mesh = make_mesh(MeshSpec(tp=2), devices=jax.devices()[:2])
+        shard = ModelSharding(cfg, mesh)
+        sharded = JaxEngine.random_init(cfg, engine_cfg(
+            shard_params_fn=shard.shard_params,
+            shard_pages_fn=shard.shard_pages))
+        try:
+            assert make_device_transfer_plane(sharded) is None
+        finally:
+            await sharded.stop()
+
+
 class TestIciTransfer:
     """Device-to-device (ICI-path) block transfer between two engines in one
     process — the NIXL-replacement fast path. No np.ndarray round trip."""
@@ -218,6 +297,68 @@ class TestDisaggE2E:
             assert dec_engine.allocator.hits >= 3
             # prefill engine really did the prefill leg
             assert pre_engine.allocator.misses >= 3
+        finally:
+            if handler is not None:
+                await handler.stop()
+            for d in drts:
+                await d.close()
+            await coord.stop()
+
+    async def test_disagg_over_device_direct_plane(self):
+        """Disagg with the device-direct plane advertised (the wiring
+        worker.main sets up): the decode side's pull rides the jax
+        transfer connection — no bulk/RPC frame ever moves — and the
+        result still matches the aggregated engine."""
+        from dynamo_tpu.engine.transfer import (
+            KV_EXPORT_DIRECT_ENDPOINT, DeviceTransferPlane,
+            serve_kv_export_direct)
+        from dynamo_tpu.runtime.coordinator import Coordinator
+        prompt = list(range(1, 14))
+
+        solo = JaxEngine.random_init(ModelConfig.tiny(), engine_cfg())
+        try:
+            want = [t for f in await collect(
+                solo.generate(make_req(prompt, "solo"))) for t in f.token_ids]
+        finally:
+            await solo.stop()
+
+        coord = await Coordinator(port=0).start()
+        drts, handler = [], None
+        try:
+            pre_drt = await DistributedRuntime.create(
+                coordinator=coord.address)
+            drts.append(pre_drt)
+            pre_engine = JaxEngine.random_init(ModelConfig.tiny(),
+                                               engine_cfg())
+            plane = DeviceTransferPlane()
+            comp = pre_drt.namespace("ns").component("prefill")
+            await serve_engine(comp.endpoint("generate"), pre_engine)
+            await comp.endpoint(KV_EXPORT_DIRECT_ENDPOINT).serve(
+                serve_kv_export_direct(pre_engine, plane))
+            await comp.endpoint(KV_EXPORT_ENDPOINT).serve(
+                serve_kv_export(pre_engine),
+                direct_address=plane.address)
+
+            dec_drt = await DistributedRuntime.create(
+                coordinator=coord.address)
+            drts.append(dec_drt)
+            dec_engine = JaxEngine.random_init(ModelConfig.tiny(),
+                                               engine_cfg())
+            handler = await DisaggDecodeHandler(
+                dec_engine, dec_drt, "ns", "prefill").start()
+            assert handler._direct_plane is not None
+            await handler._gen_client.wait_for_instances(1, timeout=10)
+            await handler._kv_direct_client.wait_for_instances(1, timeout=10)
+
+            frames = await collect(handler.generate(make_req(prompt, "r1")))
+            got = [t for f in frames for t in f.token_ids]
+            assert got == want
+            # the pull really rode the transfer connection
+            assert plane.address in handler._direct_plane._conns
+            assert dec_engine.allocator.hits >= 3
+            # and the decode side ACKED: the prefill plane released the
+            # pinned device array instead of holding it for the TTL
+            assert not plane._offers
         finally:
             if handler is not None:
                 await handler.stop()
