@@ -591,8 +591,7 @@ def _measure_kv_direct(engine):
     import jax
 
     try:
-        from jax.experimental import transfer as jxfer
-        from jax.sharding import SingleDeviceSharding
+        from dynamo_tpu.engine.transfer import DeviceTransferPlane
 
         n_blk = 1
         while n_blk * 2 <= min(64, engine.allocator.num_pages - 2):
@@ -600,19 +599,14 @@ def _measure_kv_direct(engine):
         ids = list(range(1, n_blk + 1))
         data = engine.dispatch_gather_pages(ids)
         jax.block_until_ready(data)
-        client = jax.devices()[0].client
-        srv = jxfer.start_transfer_server(
-            client, "127.0.0.1:0", ["127.0.0.1:0"])
-        conn = srv.connect(srv.address())
-        spec = jax.ShapeDtypeStruct(
-            data.shape, data.dtype,
-            sharding=SingleDeviceSharding(jax.devices()[0]))
+        plane = DeviceTransferPlane()  # the ladder's production plane
         times = []
         for rep in range(TRANSPORT_REPS + 1):  # first rep warms the conn
             t0 = time.perf_counter()
-            srv.await_pull(1000 + rep, [data])
-            (pulled,) = conn.pull(1000 + rep, [spec])
-            jax.block_until_ready(pulled)
+            offer = plane.offer_array(data)
+            pulled = plane.pull(offer)
+            plane.ack(offer["uuid"])
+            del pulled
             times.append(time.perf_counter() - t0)
         dt = statistics.median(times[1:])
         nbytes = data.size * data.dtype.itemsize

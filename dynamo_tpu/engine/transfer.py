@@ -327,13 +327,25 @@ class DeviceTransferPlane:
     # fresh ephemeral ports, so a long-lived decode worker would otherwise
     # accumulate one dead connection per historical address
     MAX_CONNS = 8
+    # bound on offers awaiting a pull/ack. jaxlib's transfer server keeps
+    # an offered array registered until pulled (there is no retract API),
+    # so a decode side that keeps failing its pulls would otherwise pin
+    # one gathered array per request in HBM forever — past the cap,
+    # offer() refuses (returns None) and the decode falls down the
+    # transport ladder while still being served.
+    MAX_OUTSTANDING_OFFERS = 32
 
     def __init__(self, host: str = "127.0.0.1"):
+        import threading as _threading
+
         self.host = host
         self._server = None
         self._conns: Dict[str, Any] = {}
         self._offers: Dict[int, Tuple[float, Any]] = {}
         self._next_uuid = int(time.time() * 1000) % (1 << 40)
+        # offers mutate from the engine's exclusive worker thread AND the
+        # ack handler on the event loop; conns from concurrent pull threads
+        self._lock = _threading.Lock()
 
     # -- common ------------------------------------------------------------
 
@@ -359,42 +371,60 @@ class DeviceTransferPlane:
 
     # -- source (prefill) side ---------------------------------------------
 
-    def _prune_offers(self, now: float) -> None:
+    def _prune_offers_locked(self, now: float) -> None:
         self._offers = {u: (t, a) for u, (t, a) in self._offers.items()
                         if now - t < OFFER_TTL_S}
 
-    def offer(self, engine: JaxEngine, block_hashes: List[int]
-              ) -> Optional[Dict[str, Any]]:
-        """Gather the resident blocks ON DEVICE and offer them for one
-        pull. Runs under ``run_exclusive``. Returns the rendezvous dict
-        (wire-safe) or None when nothing is resident."""
-        metas, data = _export_device(engine, block_hashes)
-        if not metas:
-            return None
+    def offer_array(self, data) -> Dict[str, Any]:
+        """Register one device array for a single pull and return the
+        rendezvous dict (no ``blocks`` metadata — callers add their own).
+        Raises RuntimeError past ``MAX_OUTSTANDING_OFFERS``."""
         now = time.time()
-        self._prune_offers(now)
-        uuid = self._next_uuid
-        self._next_uuid += 1
         server = self._ensure_server()
+        with self._lock:
+            self._prune_offers_locked(now)
+            if len(self._offers) >= self.MAX_OUTSTANDING_OFFERS:
+                raise RuntimeError(
+                    f"{len(self._offers)} un-acked offers outstanding — "
+                    f"refusing to pin more HBM (decode pulls failing?)")
+            uuid = self._next_uuid
+            self._next_uuid += 1
+            # keep the array referenced until acked or TTL; jaxlib's
+            # server ALSO holds the registration until pulled (no retract
+            # API), which is why the outstanding cap above exists
+            self._offers[uuid] = (now, data)
         server.await_pull(uuid, [data])
-        # keep the array alive until acked or TTL — the offer holds the
-        # only reference once the engine moves on. The decode side ACKS a
-        # completed pull (serve_kv_export_direct payload {"ack": uuid}),
-        # so under traffic offers free promptly; an un-acked offer (decode
-        # crashed mid-pull) frees at the next offer/ack's TTL prune.
-        self._offers[uuid] = (now, data)
         return {
             "uuid": uuid,
             "address": self.address,
             "shape": list(data.shape),
             "dtype": str(data.dtype),
-            "blocks": [[h, local, parent] for h, local, parent in metas],
         }
+
+    def offer(self, engine: JaxEngine, block_hashes: List[int]
+              ) -> Optional[Dict[str, Any]]:
+        """Gather the resident blocks ON DEVICE and offer them for one
+        pull. Runs under ``run_exclusive``. Returns the rendezvous dict
+        (wire-safe) or None when nothing is resident / the offer table is
+        full (the decode side falls down the transport ladder)."""
+        metas, data = _export_device(engine, block_hashes)
+        if not metas:
+            return None
+        try:
+            out = self.offer_array(data)
+        except RuntimeError as e:
+            import logging
+            logging.getLogger(__name__).warning("direct offer refused: %s",
+                                                e)
+            return None
+        out["blocks"] = [[h, local, parent] for h, local, parent in metas]
+        return out
 
     def ack(self, uuid: int) -> None:
         """Drop a pulled offer's device array (and any expired ones)."""
-        self._offers.pop(uuid, None)
-        self._prune_offers(time.time())
+        with self._lock:
+            self._offers.pop(uuid, None)
+            self._prune_offers_locked(time.time())
 
     # -- destination (decode) side -----------------------------------------
 
@@ -409,12 +439,14 @@ class DeviceTransferPlane:
         from jax.sharding import SingleDeviceSharding
 
         addr = offer["address"]
-        conn = self._conns.get(addr)
-        if conn is None:
-            if len(self._conns) >= self.MAX_CONNS:
-                self._conns.pop(next(iter(self._conns)))
-            conn = self._ensure_server().connect(addr)
-            self._conns[addr] = conn
+        server = self._ensure_server()
+        with self._lock:
+            conn = self._conns.get(addr)
+            if conn is None:
+                while len(self._conns) >= self.MAX_CONNS:
+                    self._conns.pop(next(iter(self._conns)), None)
+                conn = server.connect(addr)
+                self._conns[addr] = conn
         spec = _jax.ShapeDtypeStruct(
             tuple(offer["shape"]), _jnp.dtype(offer["dtype"]),
             sharding=SingleDeviceSharding(_jax.devices()[0]))
@@ -422,9 +454,15 @@ class DeviceTransferPlane:
             (data,) = conn.pull(offer["uuid"], [spec])
             _jax.block_until_ready(data)
         except Exception:
-            self._conns.pop(addr, None)
+            self.evict(addr)
             raise
         return data
+
+    def evict(self, address: str) -> None:
+        """Drop a cached connection (failed/stalled peer — the next pull
+        to the address reconnects)."""
+        with self._lock:
+            self._conns.pop(address, None)
 
     @staticmethod
     def inject(engine: JaxEngine, offer: Dict[str, Any], data) -> int:

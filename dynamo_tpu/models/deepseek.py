@@ -504,6 +504,14 @@ def _layer_step(cfg: ModelConfig, lp, h, positions, total_lens, new_lens,
                                            page_table, total_lens,
                                            _mla_scale(cfg))
         h = _expand_and_project(cfg, lp, h, lat, w_uv)
+    elif use_pallas and not layered:
+        from dynamo_tpu.ops.pallas.mla_prefill import (
+            mla_paged_prefill_stacked)
+
+        lat = mla_paged_prefill_stacked(q_lat, q_pe, pages, lidx,
+                                        page_table, positions, total_lens,
+                                        _mla_scale(cfg))
+        h = _expand_and_project(cfg, lp, h, lat, w_uv)
     elif S > 1 and P > PAGES_PER_CHUNK:
         table = _pad_table(page_table, PAGES_PER_CHUNK)
 
@@ -543,11 +551,11 @@ def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     Pallas kernels the engine passes as ``attn_impl`` cannot run latent
     attention, so they are never CALLED here — but an impl carrying the
     ``pallas_paged_kernel`` marker (both stacked kernels set it) opts
-    S==1 steps into the MLA decode kernel
-    (``ops/pallas/mla_decode.py``) when the geometry supports it
-    (kv_lora_rank % 128 == 0 — true for real V2/V3 checkpoints); prefill
-    keeps the XLA blockwise latent path. Any other non-None impl is
-    ignored (the XLA paths serve), matching gemma's marker pattern."""
+    the family into its OWN latent kernels when the geometry supports it
+    (kv_lora_rank % 128 == 0 — true for real V2/V3 checkpoints): S==1
+    steps ride ``ops/pallas/mla_decode.py``, S>1 chunks
+    ``ops/pallas/mla_prefill.py``. Any other non-None impl is ignored
+    (the XLA paths serve), matching gemma's marker pattern."""
     from dynamo_tpu.ops.pallas.mla_decode import supports as mla_supports
 
     use_pallas = (getattr(attn_impl, "pallas_paged_kernel", False)

@@ -19,6 +19,8 @@ from dynamo_tpu.ops.pallas.mla_decode import (
     mla_paged_decode_layer,
     mla_paged_decode_stacked,
 )
+from dynamo_tpu.ops.pallas.mla_prefill import mla_paged_prefill_stacked
 
 __all__ = ["paged_decode_attention", "paged_decode_attention_stacked",
-           "mla_paged_decode_layer", "mla_paged_decode_stacked"]
+           "mla_paged_decode_layer", "mla_paged_decode_stacked",
+           "mla_paged_prefill_stacked"]

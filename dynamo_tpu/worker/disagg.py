@@ -210,6 +210,12 @@ class DisaggDecodeHandler:
         # bound on one device-direct pull; past it the (abandoned) pull
         # thread is left behind and the transport ladder falls to bulk
         self.direct_pull_timeout = 60.0
+        # circuit breaker: a timed-out address is skipped for this long
+        # (each timeout strands a 60s executor thread — without the
+        # breaker a black-holed peer would saturate the default executor
+        # and wedge even the bulk fallback's to_thread calls)
+        self.direct_down_window = 300.0
+        self._direct_down_until: dict = {}
 
     async def start(self) -> "DisaggDecodeHandler":
         ns = self.drt.namespace(self.namespace)
@@ -370,11 +376,14 @@ class DisaggDecodeHandler:
             direct_address = inst.direct_address
         injected = total = 0
         bulk_done = False
-        if direct_address and self._direct_plane is not None:
+        import time as _time
+        if (direct_address and self._direct_plane is not None
+                and _time.monotonic()
+                >= self._direct_down_until.get(direct_address, 0.0)):
+            offer = None
             try:
                 offer_stream = await self._kv_direct_client.direct(
                     {"block_hashes": hashes}, iid)
-                offer = None
                 async for o in offer_stream:
                     offer = o
                 if offer and offer.get("uuid") is not None:
@@ -382,8 +391,9 @@ class DisaggDecodeHandler:
                     # window (it touches no engine state) with a timeout —
                     # a stalled transfer connection must never wedge the
                     # decode loop; only the fast device scatter is
-                    # exclusive. A timed-out pull abandons its thread and
-                    # falls down the ladder.
+                    # exclusive. A timed-out pull abandons its thread,
+                    # evicts the connection, opens the circuit breaker for
+                    # the address, and falls down the ladder.
                     data = await asyncio.wait_for(
                         asyncio.to_thread(self._direct_plane.pull, offer),
                         timeout=self.direct_pull_timeout)
@@ -400,7 +410,19 @@ class DisaggDecodeHandler:
                     except Exception:  # noqa: BLE001 — TTL covers it
                         pass
                     return
-                return  # prefix evicted remotely: nothing to pull anywhere
+                # empty offer: blocks evicted remotely OR the peer's offer
+                # table is full — fall through to the host planes (the
+                # bulk fetch serves the full-table case; the evicted case
+                # costs one empty round trip)
+            except asyncio.TimeoutError:
+                self._direct_plane.evict(offer["address"] if offer
+                                         else direct_address)
+                self._direct_down_until[direct_address] = (
+                    _time.monotonic() + self.direct_down_window)
+                logger.warning(
+                    "device-direct KV pull from %s timed out after %.0fs; "
+                    "skipping the plane for %.0fs", direct_address,
+                    self.direct_pull_timeout, self.direct_down_window)
             except Exception as e:  # noqa: BLE001 — fall down the ladder
                 logger.warning("device-direct KV pull from %s failed (%s); "
                                "trying the bulk plane", direct_address, e)

@@ -141,6 +141,34 @@ class TestDeviceDirectTransfer:
             await a.stop()
             await b.stop()
 
+    async def test_offer_cap_bounds_pinned_memory(self):
+        """Un-acked offers pin device arrays (jaxlib keeps the
+        registration until pulled — no retract API), so past the cap
+        offer() refuses with None and the decode side falls down the
+        transport ladder instead of OOMing the prefill worker."""
+        from dynamo_tpu.engine.transfer import DeviceTransferPlane
+
+        a = JaxEngine.random_init(ModelConfig.tiny(), engine_cfg())
+        try:
+            req = make_req(list(range(1, 14)), "p")
+            req.prefill_only = True
+            frames = await collect(a.generate(req))
+            hashes = [blk[0] for blk in
+                      frames[-1].kv_transfer_params["blocks"]]
+            plane = DeviceTransferPlane()
+            plane.MAX_OUTSTANDING_OFFERS = 2
+            o1 = await a.run_exclusive(plane.offer, a, hashes)
+            o2 = await a.run_exclusive(plane.offer, a, hashes)
+            assert o1 and o2
+            refused = await a.run_exclusive(plane.offer, a, hashes)
+            assert refused is None
+            # acking frees a slot
+            plane.ack(o1["uuid"])
+            o3 = await a.run_exclusive(plane.offer, a, hashes)
+            assert o3 is not None
+        finally:
+            await a.stop()
+
     async def test_offer_empty_when_blocks_evicted(self):
         from dynamo_tpu.engine.transfer import DeviceTransferPlane
 
