@@ -350,16 +350,18 @@ class DeviceTransferPlane:
     # -- common ------------------------------------------------------------
 
     def _ensure_server(self):
-        if self._server is None:
-            import jax as _jax
-            from jax.experimental import transfer as _transfer
+        with self._lock:  # concurrent first pulls must not double-init
+            if self._server is None:
+                import jax as _jax
+                from jax.experimental import transfer as _transfer
 
-            client = _jax.devices()[0].client
-            # explicit transport addresses: without them the cross-process
-            # bulk-transport factory CHECK-fails (jaxlib streaming.cc:193)
-            self._server = _transfer.start_transfer_server(
-                client, f"{self.host}:0", [f"{self.host}:0"])
-        return self._server
+                client = _jax.devices()[0].client
+                # explicit transport addresses: without them the cross-
+                # process bulk-transport factory CHECK-fails (jaxlib
+                # streaming.cc:193)
+                self._server = _transfer.start_transfer_server(
+                    client, f"{self.host}:0", [f"{self.host}:0"])
+            return self._server
 
     @property
     def address(self) -> str:
@@ -389,11 +391,16 @@ class DeviceTransferPlane:
                     f"refusing to pin more HBM (decode pulls failing?)")
             uuid = self._next_uuid
             self._next_uuid += 1
-            # keep the array referenced until acked or TTL; jaxlib's
-            # server ALSO holds the registration until pulled (no retract
-            # API), which is why the outstanding cap above exists
+            # reserve the slot + keep the array referenced until acked or
+            # TTL; jaxlib's server ALSO holds the registration until
+            # pulled (no retract API), which is why the cap exists
             self._offers[uuid] = (now, data)
-        server.await_pull(uuid, [data])
+        try:
+            server.await_pull(uuid, [data])
+        except Exception:
+            with self._lock:  # failed registration must not eat a slot
+                self._offers.pop(uuid, None)
+            raise
         return {
             "uuid": uuid,
             "address": self.address,
@@ -442,11 +449,19 @@ class DeviceTransferPlane:
         server = self._ensure_server()
         with self._lock:
             conn = self._conns.get(addr)
-            if conn is None:
-                while len(self._conns) >= self.MAX_CONNS:
-                    self._conns.pop(next(iter(self._conns)), None)
-                conn = server.connect(addr)
-                self._conns[addr] = conn
+        if conn is None:
+            # connect OUTSIDE the lock: a black-holed peer must only
+            # stall THIS pull thread, never an evict()/offer() waiting on
+            # the lock from the event loop (the wedge the circuit breaker
+            # exists to prevent)
+            conn = server.connect(addr)
+            with self._lock:
+                if addr in self._conns:
+                    conn = self._conns[addr]  # lost the race: reuse first
+                else:
+                    while len(self._conns) >= self.MAX_CONNS:
+                        self._conns.pop(next(iter(self._conns)), None)
+                    self._conns[addr] = conn
         spec = _jax.ShapeDtypeStruct(
             tuple(offer["shape"]), _jnp.dtype(offer["dtype"]),
             sharding=SingleDeviceSharding(_jax.devices()[0]))

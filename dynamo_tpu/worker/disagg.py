@@ -20,6 +20,7 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import time
 from typing import Any, AsyncIterator, Optional
 
 from dynamo_tpu.engine.jax_engine import JaxEngine
@@ -376,10 +377,14 @@ class DisaggDecodeHandler:
             direct_address = inst.direct_address
         injected = total = 0
         bulk_done = False
-        import time as _time
+        now = time.monotonic()
+        # prune expired breaker entries: prefill restarts advertise fresh
+        # ephemeral ports, so per-address state must not grow unbounded
+        self._direct_down_until = {a: t for a, t in
+                                   self._direct_down_until.items()
+                                   if t > now}
         if (direct_address and self._direct_plane is not None
-                and _time.monotonic()
-                >= self._direct_down_until.get(direct_address, 0.0)):
+                and direct_address not in self._direct_down_until):
             offer = None
             try:
                 offer_stream = await self._kv_direct_client.direct(
@@ -418,7 +423,7 @@ class DisaggDecodeHandler:
                 self._direct_plane.evict(offer["address"] if offer
                                          else direct_address)
                 self._direct_down_until[direct_address] = (
-                    _time.monotonic() + self.direct_down_window)
+                    time.monotonic() + self.direct_down_window)
                 logger.warning(
                     "device-direct KV pull from %s timed out after %.0fs; "
                     "skipping the plane for %.0fs", direct_address,

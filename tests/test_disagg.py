@@ -394,6 +394,68 @@ class TestDisaggE2E:
                 await d.close()
             await coord.stop()
 
+    async def test_direct_pull_timeout_opens_breaker_and_falls_back(self):
+        """A hung device-direct pull: the request still serves (ladder
+        falls to the RPC export) and the circuit breaker marks the
+        address down so later requests skip the plane entirely."""
+        import time as _time
+
+        from dynamo_tpu.engine.transfer import (
+            KV_EXPORT_DIRECT_ENDPOINT, DeviceTransferPlane,
+            serve_kv_export_direct)
+        from dynamo_tpu.runtime.coordinator import Coordinator
+        prompt = list(range(1, 14))
+
+        solo = JaxEngine.random_init(ModelConfig.tiny(), engine_cfg())
+        try:
+            want = [t for f in await collect(
+                solo.generate(make_req(prompt, "solo"))) for t in f.token_ids]
+        finally:
+            await solo.stop()
+
+        coord = await Coordinator(port=0).start()
+        drts, handler = [], None
+        try:
+            pre_drt = await DistributedRuntime.create(
+                coordinator=coord.address)
+            drts.append(pre_drt)
+            pre_engine = JaxEngine.random_init(ModelConfig.tiny(),
+                                               engine_cfg())
+            plane = DeviceTransferPlane()
+            comp = pre_drt.namespace("ns").component("prefill")
+            await serve_engine(comp.endpoint("generate"), pre_engine)
+            await comp.endpoint(KV_EXPORT_DIRECT_ENDPOINT).serve(
+                serve_kv_export_direct(pre_engine, plane))
+            await comp.endpoint(KV_EXPORT_ENDPOINT).serve(
+                serve_kv_export(pre_engine),
+                direct_address=plane.address)
+
+            dec_drt = await DistributedRuntime.create(
+                coordinator=coord.address)
+            drts.append(dec_drt)
+            dec_engine = JaxEngine.random_init(ModelConfig.tiny(),
+                                               engine_cfg())
+            handler = await DisaggDecodeHandler(
+                dec_engine, dec_drt, "ns", "prefill").start()
+            await handler._gen_client.wait_for_instances(1, timeout=10)
+            await handler._kv_direct_client.wait_for_instances(1, timeout=10)
+            # wedge the pull; tiny timeout so the test stays fast
+            handler._direct_plane.pull = lambda offer: _time.sleep(5)
+            handler.direct_pull_timeout = 0.3
+
+            frames = await collect(handler.generate(make_req(prompt, "r1")))
+            got = [t for f in frames for t in f.token_ids]
+            assert got == want  # served via the RPC export fallback
+            assert handler._direct_down_until.get(plane.address, 0) \
+                > _time.monotonic()
+            assert dec_engine.allocator.hits >= 3
+        finally:
+            if handler is not None:
+                await handler.stop()
+            for d in drts:
+                await d.close()
+            await coord.stop()
+
     async def test_local_fallback_no_prefill_workers(self):
         """No prefill instances: decode handler must serve locally."""
         from dynamo_tpu.runtime.coordinator import Coordinator

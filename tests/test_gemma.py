@@ -118,6 +118,43 @@ def test_forward_pallas_prefill_matches_xla():
                                rtol=2e-4, atol=2e-4)
 
 
+async def test_engine_pallas_matches_scan():
+    """Serving gemma-2 with attn_impl="pallas" (decode AND prefill
+    kernels now carry the per-layer window + softcap; interpret mode on
+    CPU) streams the same greedy tokens as the XLA scan path."""
+    from dynamo_tpu.engine.jax_engine import JaxEngine, JaxEngineConfig
+    from dynamo_tpu.protocols.common import (
+        PreprocessedRequest, SamplingOptions, StopConditions)
+
+    cfg = ModelConfig.tiny(model_type="gemma2", num_layers=4, head_dim=128,
+                           sliding_window=6, attn_logit_softcap=40.0,
+                           final_logit_softcap=25.0)
+    params = gemma.init_params(cfg, jax.random.PRNGKey(7))
+
+    def req(rid):
+        return PreprocessedRequest(
+            token_ids=list(range(1, 11)), request_id=rid,
+            stop_conditions=StopConditions(max_tokens=5),
+            sampling_options=SamplingOptions(temperature=0.0),
+            eos_token_ids=[])
+
+    outs = {}
+    for impl in ("scan", "pallas"):
+        eng = JaxEngine(cfg, params, JaxEngineConfig(
+            num_pages=32, page_size=8, max_num_seqs=2, max_prefill_chunk=8,
+            max_context=64, min_prefill_bucket=4, attn_impl=impl))
+        try:
+            assert eng.attn_impl == impl
+            toks = []
+            async for f in eng.generate(req(impl)):
+                toks.extend(f.token_ids)
+            outs[impl] = toks
+        finally:
+            await eng.stop()
+    assert outs["pallas"] == outs["scan"]
+    assert len(outs["pallas"]) == 5
+
+
 def test_unrolled_matches_scan():
     cfg = ModelConfig.tiny(model_type="gemma2", num_layers=4,
                            sliding_window=6, attn_logit_softcap=40.0)
