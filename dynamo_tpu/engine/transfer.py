@@ -346,11 +346,17 @@ class DeviceTransferPlane:
         # offers mutate from the engine's exclusive worker thread AND the
         # ack handler on the event loop; conns from concurrent pull threads
         self._lock = _threading.Lock()
+        # server startup gets its OWN lock: start_transfer_server dials
+        # transports and can hang on a wedged backend — evict()/ack() on
+        # the event loop must never wait behind it
+        self._init_lock = _threading.Lock()
 
     # -- common ------------------------------------------------------------
 
     def _ensure_server(self):
-        with self._lock:  # concurrent first pulls must not double-init
+        if self._server is not None:  # fast path, no lock
+            return self._server
+        with self._init_lock:  # concurrent first pulls must not double-init
             if self._server is None:
                 import jax as _jax
                 from jax.experimental import transfer as _transfer
@@ -453,7 +459,10 @@ class DeviceTransferPlane:
             # connect OUTSIDE the lock: a black-holed peer must only
             # stall THIS pull thread, never an evict()/offer() waiting on
             # the lock from the event loop (the wedge the circuit breaker
-            # exists to prevent)
+            # exists to prevent). Two racing first pulls may both connect;
+            # the loser's connection is dropped unreferenced — jaxlib's
+            # TransferConnection exposes no close(), so GC is the only
+            # teardown (same for MAX_CONNS/evict() removals).
             conn = server.connect(addr)
             with self._lock:
                 if addr in self._conns:
