@@ -86,6 +86,114 @@ def _layer_spec(name: str, pp_axis: str, tp: int) -> P:
     return P(pp_axis, *_TP_TAILS.get(name, ()))
 
 
+# ------------------------------------------------------------- stage bodies
+# One adapter per supported family: the pieces of a layer that differ
+# (embedding, qkv projection, per-layer attention kwargs, the post-attention
+# tail with its tp psum points, the final vocab projection). The pipeline
+# schedule, KV writes, dp gathers, and microbatch ring are family-agnostic.
+
+
+class _LlamaStage:
+    def __init__(self, cfg: ModelConfig, cfg_local: ModelConfig):
+        self.cfg, self.cfg_local = cfg, cfg_local
+        self.sm_scale = cfg.head_dim ** -0.5
+
+    def embed(self, params, tok):
+        return params["embed"][tok]
+
+    def qkv(self, lp, h, pos):
+        return _project_qkv(self.cfg_local, lp, h, pos)
+
+    def attend_kwargs(self, global_lidx):
+        return {}
+
+    def finish(self, lp, h, attn, psum):
+        cfg = self.cfg
+        if psum is None:
+            return _finish_layer(cfg, lp, h, attn)
+        # manual tensor parallelism: each device holds its head slice of
+        # wo / ffn slice of w_down, so the projections produce PARTIAL
+        # sums — the standard two all-reduces per layer complete them
+        # (parallel/sharding.py places the plain-tp path identically;
+        # GSPMD inserts the same psums there automatically)
+        Bm_, S_ = h.shape[0], h.shape[1]
+        h = h + psum(attn.reshape(Bm_, S_, -1) @ lp["wo"])
+        x = _rms_norm(h, lp["mlp_norm"], cfg.rms_norm_eps)
+        mlp = (jax.nn.silu(x @ lp["w_gate"])
+               * (x @ lp["w_up"])) @ lp["w_down"]
+        return h + psum(mlp)
+
+    def tail(self, params, hidden):
+        hn = _rms_norm(hidden, params["final_norm"], self.cfg.rms_norm_eps)
+        lm_head = params.get("lm_head")
+        if lm_head is None:
+            lm_head = params["embed"].T
+        # model-dtype operands + f32 accumulation, matching llama._logits
+        # (f32-cast operands would run the vocab matmul at f32 MXU rate)
+        return jnp.dot(hn, lm_head, preferred_element_type=jnp.float32)
+
+
+class _GemmaStage:
+    """gemma-2: (1+w) RMSNorm sandwich around attention AND the GeGLU mlp,
+    sqrt(H)-scaled embedding, alternating per-layer sliding windows, logit
+    softcaps on attention and the final projection."""
+
+    def __init__(self, cfg: ModelConfig, cfg_local: ModelConfig):
+        from dynamo_tpu.models import gemma as _g
+
+        self._g = _g
+        self.cfg, self.cfg_local = cfg, cfg_local
+        self.sm_scale = _g._sm_scale(cfg)
+
+    def embed(self, params, tok):
+        return self._g._embed(self.cfg, params, tok)
+
+    def qkv(self, lp, h, pos):
+        return self._g._project_qkv(self.cfg_local, lp, h, pos)
+
+    def attend_kwargs(self, global_lidx):
+        cfg = self.cfg
+        win = 0
+        if cfg.sliding_window:
+            # even GLOBAL layers slide, odd are global (models/gemma.py
+            # layer_windows) — closed form on the traced stage-local index
+            win = jnp.where(global_lidx % 2 == 0, cfg.sliding_window, 0)
+        return {"window": win,
+                "softcap": cfg.attn_logit_softcap or None}
+
+    def finish(self, lp, h, attn, psum):
+        cfg, g = self.cfg, self._g
+        if psum is None:
+            return g._finish_layer(cfg, lp, h, attn)
+        eps = cfg.rms_norm_eps
+        Bm_, S_ = h.shape[0], h.shape[1]
+        attn_out = psum(attn.reshape(Bm_, S_, -1) @ lp["wo"])
+        h = h + g._rms_norm(attn_out, lp["post_attn_norm"], eps)
+        x = g._rms_norm(h, lp["pre_ffw_norm"], eps)
+        mlp = psum((jax.nn.gelu(x @ lp["w_gate"], approximate=True)
+                    * (x @ lp["w_up"])) @ lp["w_down"])
+        return h + g._rms_norm(mlp, lp["post_ffw_norm"], eps)
+
+    def tail(self, params, hidden):
+        cfg, g = self.cfg, self._g
+        hn = g._rms_norm(hidden, params["final_norm"], cfg.rms_norm_eps)
+        lm_head = params.get("lm_head")
+        if lm_head is None:
+            lm_head = params["embed"].T
+        # model-dtype operands + f32 accumulation (gemma._logits)
+        logits = jnp.dot(hn, lm_head, preferred_element_type=jnp.float32)
+        cap = cfg.final_logit_softcap
+        if cap:
+            logits = jnp.tanh(logits / cap) * cap
+        return logits
+
+
+_STAGE_ADAPTERS = {
+    "dynamo_tpu.models.llama": _LlamaStage,
+    "dynamo_tpu.models.gemma": _GemmaStage,
+}
+
+
 def _param_specs(params: Dict[str, Any], pp_axis: str,
                  tp: int) -> Dict[str, Any]:
     """Layer-stacked leaves shard axis 0 over pp (+ tp tails); the rest
@@ -119,14 +227,30 @@ def pipeline_forward(params: Dict[str, Any], cfg: ModelConfig,
     replicated page pool stays consistent). ``attn_impl`` optionally
     replaces the XLA paged attention inside the stage body — the stacked
     Pallas kernels match the call signature.
+
+    Families: the llama tree (llama/mistral/qwen dense) and gemma-2 (its
+    stage adapter carries the 4-norm sandwich, GeGLU, embed scaling,
+    alternating per-layer windows + both softcaps). MoE/MLA families are
+    refused — their layers differ from any staged body here and would
+    serve silently wrong outputs.
     """
+    from dynamo_tpu.models import get_family
+    family = get_family(cfg)
     n_stages = mesh.shape[pp_axis]
     tp = dict(mesh.shape).get(tp_axis, 1)
     dp = dict(mesh.shape).get(dp_axis, 1)
     if n_stages == 1:
-        from dynamo_tpu.models.llama import forward
-        return forward(params, cfg, tokens, positions, pages, page_table,
-                       total_lens, new_lens)
+        # no stage body runs: every family's own forward serves
+        out = family.forward(params, cfg, tokens, positions, pages,
+                             page_table, total_lens, new_lens)
+        return out[0], out[1]
+    adapter_factory = _STAGE_ADAPTERS.get(getattr(family, "__name__", ""))
+    if adapter_factory is None:
+        raise ValueError(
+            f"pipeline_forward has no stage adapter for "
+            f"{cfg.model_type!r} — running it through another family's "
+            f"layers would serve silently wrong outputs; use tp/dp/sp "
+            f"for this family (worker/main.py guards the flag)")
     if cfg.num_layers % n_stages:
         raise ValueError(f"num_layers={cfg.num_layers} not divisible by "
                          f"pp={n_stages}")
@@ -149,7 +273,6 @@ def pipeline_forward(params: Dict[str, Any], cfg: ModelConfig,
         raise ValueError(f"per-replica batch {B_local} not divisible by "
                          f"n_microbatches={M}")
     Bm = B_local // M
-    sm_scale = cfg.head_dim ** -0.5
     layers_per_stage = cfg.num_layers // n_stages
     # per-device view of the head/ffn dims under manual tp: _project_qkv
     # reshapes by head COUNTS, which are local inside the shard_map body
@@ -159,6 +282,15 @@ def pipeline_forward(params: Dict[str, Any], cfg: ModelConfig,
         cfg_local = dataclasses.replace(
             cfg, num_heads=cfg.num_heads // tp,
             num_kv_heads=cfg.num_kv_heads // tp)
+    stage_body = adapter_factory(cfg, cfg_local)
+    sm_scale = stage_body.sm_scale
+    # a passed attn_impl must carry the family's per-layer kwargs (the
+    # stacked Pallas kernels advertise window/softcap support); otherwise
+    # the XLA path serves — never silently drop a gemma window
+    attend = attn_impl or paged_attention
+    if (isinstance(stage_body, _GemmaStage) and attn_impl is not None
+            and not getattr(attn_impl, "supports_window_softcap", False)):
+        attend = paged_attention
 
     def shard_fn(params, tokens, positions, page_table, total_lens,
                  new_lens, pages_local):
@@ -192,28 +324,16 @@ def pipeline_forward(params: Dict[str, Any], cfg: ModelConfig,
             def body(carry, xs):
                 h, pages_local = carry
                 lp, lidx = xs
-                q, k, v = _project_qkv(cfg_local, lp, h, pos)
+                q, k, v = stage_body.qkv(lp, h, pos)
                 pages_local = write_kv(pages_local, lidx, gather_dp(k),
                                        gather_dp(v), tbl_g, pos_g, new_g)
-                attend = attn_impl or paged_attention
                 attn = attend(q, pages_local, lidx, tbl, pos, tot,
-                              sm_scale)
-                if tp == 1:
-                    h = _finish_layer(cfg, lp, h, attn)
-                else:
-                    # manual tensor parallelism: each device holds its head
-                    # slice of wo / ffn slice of w_down, so the projections
-                    # produce PARTIAL sums — the standard two all-reduces
-                    # per layer complete them (parallel/sharding.py places
-                    # the plain-tp path identically; GSPMD inserts the same
-                    # psums there automatically)
-                    Bm_, S_ = h.shape[0], h.shape[1]
-                    attn_out = attn.reshape(Bm_, S_, -1) @ lp["wo"]
-                    h = h + lax.psum(attn_out, tp_axis)
-                    x = _rms_norm(h, lp["mlp_norm"], cfg.rms_norm_eps)
-                    mlp = (jax.nn.silu(x @ lp["w_gate"])
-                           * (x @ lp["w_up"])) @ lp["w_down"]
-                    h = h + lax.psum(mlp, tp_axis)
+                              sm_scale,
+                              **stage_body.attend_kwargs(
+                                  stage * layers_per_stage + lidx))
+                psum = ((lambda x: lax.psum(x, tp_axis)) if tp > 1
+                        else None)
+                h = stage_body.finish(lp, h, attn, psum)
                 return (h, pages_local), None
 
             (h, pages_local), _ = lax.scan(
@@ -233,7 +353,7 @@ def pipeline_forward(params: Dict[str, Any], cfg: ModelConfig,
             # inactive ticks: mask page writes to the garbage page and let
             # the compute produce don't-care values
             new = jnp.where(active, new, 0)
-            h0 = params["embed"][tok]          # [Bm, S, H]
+            h0 = stage_body.embed(params, tok)  # [Bm, S, H]
             h = jnp.where(stage == 0, h0, h_in)
             h, pages_local = run_stage(h, pages_local, pos, tbl, tot, new)
             # last stage: record this microbatch's LAST-TOKEN hidden state
@@ -260,12 +380,7 @@ def pipeline_forward(params: Dict[str, Any], cfg: ModelConfig,
         # then project to the vocab once (per-replica local rows)
         out = lax.psum(
             jnp.where(stage == last, out, jnp.zeros_like(out)), pp_axis)
-        hn = _rms_norm(out.reshape(B_local, H), params["final_norm"],
-                       cfg.rms_norm_eps)
-        lm_head = params.get("lm_head")
-        if lm_head is None:
-            lm_head = params["embed"].T
-        logits = hn.astype(jnp.float32) @ lm_head.astype(jnp.float32)
+        logits = stage_body.tail(params, out.reshape(B_local, H))
         return logits, pages_local
 
     pages_spec = (P(pp_axis) if tp == 1

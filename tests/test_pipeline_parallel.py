@@ -173,6 +173,66 @@ def test_pp1_falls_through_to_plain():
     np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5)
 
 
+def test_rejects_families_without_stage_adapter():
+    """MoE/MLA layers differ from every staged body — running them
+    through one would serve silently wrong outputs, so the forward (and
+    the worker flag) refuse loudly."""
+    cfg = ModelConfig.tiny(num_experts=4, num_experts_per_tok=2,
+                           moe_intermediate_size=32,
+                           model_type="qwen3_moe", num_layers=4)
+    from dynamo_tpu.models import moe as _moe
+    params = _moe.init_params(cfg, jax.random.PRNGKey(0))
+    mesh = make_mesh(MeshSpec(pp=2), devices=jax.devices()[:2])
+    pages = llama.make_pages(cfg, 9, 4, dtype=jnp.float32)
+    tok = jnp.ones((2, 4), jnp.int32)
+    pos = jnp.tile(jnp.arange(4, dtype=jnp.int32)[None], (2, 1))
+    tbl = jnp.arange(1, 9, dtype=jnp.int32).reshape(2, 4)
+    lens = jnp.full((2,), 4, jnp.int32)
+    with pytest.raises(ValueError, match="no stage adapter"):
+        pipeline_forward(params, cfg, tok, pos, pages, tbl, lens, lens,
+                         mesh=mesh)
+
+
+@pytest.mark.parametrize("pp,tp", [(2, 1), (2, 2)])
+def test_pipeline_gemma_matches_plain_forward(pp, tp):
+    """gemma-2 through the pipeline stage adapter (4-norm sandwich,
+    GeGLU, alternating per-layer windows, both softcaps, embed scaling)
+    must reproduce gemma.forward's logits AND cache writes — pp and
+    pp x tp (manual psums around the sandwich norms)."""
+    from dynamo_tpu.models import gemma as _gemma
+    from dynamo_tpu.parallel.pipeline import pp_sharding_fns
+
+    cfg = ModelConfig.tiny(model_type="gemma2", num_layers=4,
+                           num_kv_heads=2, sliding_window=6,
+                           attn_logit_softcap=40.0,
+                           final_logit_softcap=25.0)
+    params = _gemma.init_params(cfg, jax.random.PRNGKey(3))
+    B, S, P_ = 4, 8, 4
+    tokens = jnp.asarray(np.random.RandomState(2).randint(
+        1, cfg.vocab_size, size=(B, S)), jnp.int32)
+    positions = jnp.tile(jnp.arange(S, dtype=jnp.int32)[None], (B, 1))
+    table = jnp.arange(1, 1 + B * P_, dtype=jnp.int32).reshape(B, P_)
+    new = jnp.asarray([S, S - 2, S, 3], jnp.int32)
+    total = new
+    pages = _gemma.make_pages(cfg, 1 + B * P_, 4, dtype=jnp.float32)
+    ref_logits, ref_pages = _gemma.forward(
+        params, cfg, tokens, positions, pages, table, total, new)
+
+    mesh = make_mesh(MeshSpec(pp=pp, tp=tp), devices=jax.devices()[:pp * tp])
+    shard_params, shard_pages = pp_sharding_fns(mesh, cfg)
+    p2 = shard_params(params)
+    pages2 = shard_pages(_gemma.make_pages(cfg, 1 + B * P_, 4,
+                                           dtype=jnp.float32))
+    pp_logits, pp_pages = pipeline_forward(
+        p2, cfg, tokens, positions, pages2, table, total, new,
+        mesh=mesh, n_microbatches=2)
+    np.testing.assert_allclose(np.asarray(pp_logits),
+                               np.asarray(ref_logits), rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(pp_pages[:, 1:]),
+                               np.asarray(ref_pages[:, 1:]),
+                               rtol=2e-4, atol=2e-4)
+
+
 def test_rejects_indivisible_shapes():
     cfg, params, pages, tokens, positions, table, total, new = _setup(L=4)
     mesh = make_mesh(MeshSpec(pp=2), devices=jax.devices()[:2])
