@@ -47,6 +47,13 @@ The stage body takes the engine's Pallas ``attn_impl`` (the stacked
 decode/prefill kernels run fine on a shard_map-local cache slab — same
 call signature as ``paged_attention``), so pp serving no longer forces
 the XLA scan path.
+
+The schedule is family-agnostic over STAGE ADAPTERS (``_STAGE_ADAPTERS``):
+llama-tree dense, gemma-2 (norm sandwich, GeGLU, per-layer windows,
+softcaps), and MoE (routed experts, FFN width tp-sharded with one psum
+after the linear combine). DeepSeek MLA is refused — its heterogeneous
+dense/MoE two-stack layout doesn't fit a uniform stage slab; that family
+serves via tp/dp/sp.
 """
 
 from __future__ import annotations
@@ -70,7 +77,8 @@ from dynamo_tpu.ops.attention import paged_attention, write_kv
 # tp tail (dims after the leading L axis) per layer-stacked leaf — the
 # same placement ``parallel/sharding.py`` uses for the plain tp path:
 # qkv/ffn-up shard their OUTPUT dim, out/down projections their INPUT dim
-# (so the partial products line up for the per-layer psum).
+# (so the partial products line up for the per-layer psum). Families with
+# differently-shaped leaves override via their stage adapter's TP_TAILS.
 _TP_TAILS: Dict[str, Tuple] = {
     "attn_norm": (), "mlp_norm": (), "q_norm": (), "k_norm": (),
     "wq": (None, "tp"), "wk": (None, "tp"), "wv": (None, "tp"),
@@ -79,11 +87,20 @@ _TP_TAILS: Dict[str, Tuple] = {
     "bq": ("tp",), "bk": ("tp",), "bv": ("tp",),
 }
 
+# MoE expert leaves carry a leading E dim: [L, E, H, I] / [L, E, I, H]
+_TP_TAILS_MOE: Dict[str, Tuple] = {
+    **_TP_TAILS,
+    "w_router": (),
+    "w_gate": (None, None, "tp"), "w_up": (None, None, "tp"),
+    "w_down": (None, "tp", None),
+}
 
-def _layer_spec(name: str, pp_axis: str, tp: int) -> P:
+
+def _layer_spec(name: str, pp_axis: str, tp: int,
+                tails: Dict[str, Tuple] = _TP_TAILS) -> P:
     if tp == 1:
         return P(pp_axis)
-    return P(pp_axis, *_TP_TAILS.get(name, ()))
+    return P(pp_axis, *tails.get(name, ()))
 
 
 # ------------------------------------------------------------- stage bodies
@@ -94,6 +111,8 @@ def _layer_spec(name: str, pp_axis: str, tp: int) -> P:
 
 
 class _LlamaStage:
+    TP_TAILS = _TP_TAILS
+
     def __init__(self, cfg: ModelConfig, cfg_local: ModelConfig):
         self.cfg, self.cfg_local = cfg, cfg_local
         self.sm_scale = cfg.head_dim ** -0.5
@@ -133,10 +152,40 @@ class _LlamaStage:
         return jnp.dot(hn, lm_head, preferred_element_type=jnp.float32)
 
 
+class _MoeStage(_LlamaStage):
+    """Mixtral/Qwen3-MoE: llama attention + routed experts. Under manual
+    tp the expert FFN width shards (``_TP_TAILS_MOE``); the token-combine
+    is LINEAR in the expert outputs, so ONE psum after the routed result
+    completes the partial down-products — same two all-reduce points per
+    layer as the dense family. The dispatch backend works too (its
+    scatter/combine is also linear); its drop counter is discarded here
+    (the pipeline returns the llama 2-tuple contract)."""
+
+    TP_TAILS = _TP_TAILS_MOE
+
+    def finish(self, lp, h, attn, psum):
+        from dynamo_tpu.models import moe as _moe
+
+        cfg = self.cfg
+        if psum is None:
+            h, _dropped = _moe._moe_layer_tail(cfg, lp, h, attn)
+            return h
+        Bm_, S_ = h.shape[0], h.shape[1]
+        h = h + psum(attn.reshape(Bm_, S_, -1) @ lp["wo"])
+        x = _rms_norm(h, lp["mlp_norm"], cfg.rms_norm_eps)
+        if cfg.moe_backend == "dispatch":
+            routed, _dropped = _moe.moe_mlp_dispatch(cfg, lp, x)
+        else:
+            routed = _moe.moe_mlp(cfg, lp, x)
+        return h + psum(routed)
+
+
 class _GemmaStage:
     """gemma-2: (1+w) RMSNorm sandwich around attention AND the GeGLU mlp,
     sqrt(H)-scaled embedding, alternating per-layer sliding windows, logit
     softcaps on attention and the final projection."""
+
+    TP_TAILS = _TP_TAILS
 
     def __init__(self, cfg: ModelConfig, cfg_local: ModelConfig):
         from dynamo_tpu.models import gemma as _g
@@ -191,15 +240,33 @@ class _GemmaStage:
 _STAGE_ADAPTERS = {
     "dynamo_tpu.models.llama": _LlamaStage,
     "dynamo_tpu.models.gemma": _GemmaStage,
+    "dynamo_tpu.models.moe": _MoeStage,
 }
 
 
-def _param_specs(params: Dict[str, Any], pp_axis: str,
-                 tp: int) -> Dict[str, Any]:
+def stage_adapter_for(cfg: ModelConfig):
+    """The pipeline stage adapter CLASS for this config's family, or None
+    when the family cannot stage (DeepSeek MLA). The worker flag guard and
+    both sharding/forward paths resolve through this one lookup."""
+    from dynamo_tpu.models import get_family
+
+    return _STAGE_ADAPTERS.get(getattr(get_family(cfg), "__name__", ""))
+
+
+def _ffn_width(cfg: ModelConfig) -> int:
+    """The per-layer FFN width the tp axis shards (expert width on MoE)."""
+    if cfg.num_experts:
+        return cfg.moe_intermediate_size or cfg.intermediate_size
+    return cfg.intermediate_size
+
+
+def _param_specs(params: Dict[str, Any], pp_axis: str, tp: int,
+                 tails: Dict[str, Tuple] = _TP_TAILS) -> Dict[str, Any]:
     """Layer-stacked leaves shard axis 0 over pp (+ tp tails); the rest
     replicate (incl. lm_head: the vocab projection runs once on the full
     hidden state after the pipeline, replicated per device)."""
-    layer_spec = {k: _layer_spec(k, pp_axis, tp) for k in params["layers"]}
+    layer_spec = {k: _layer_spec(k, pp_axis, tp, tails)
+                  for k in params["layers"]}
     specs: Dict[str, Any] = {k: P() for k in params if k != "layers"}
     specs["layers"] = layer_spec
     return specs
@@ -228,23 +295,25 @@ def pipeline_forward(params: Dict[str, Any], cfg: ModelConfig,
     replaces the XLA paged attention inside the stage body — the stacked
     Pallas kernels match the call signature.
 
-    Families: the llama tree (llama/mistral/qwen dense) and gemma-2 (its
-    stage adapter carries the 4-norm sandwich, GeGLU, embed scaling,
-    alternating per-layer windows + both softcaps). MoE/MLA families are
-    refused — their layers differ from any staged body here and would
-    serve silently wrong outputs.
+    Families (one stage adapter each, ``_STAGE_ADAPTERS``): the llama
+    tree (llama/mistral/qwen dense), gemma-2 (4-norm sandwich, GeGLU,
+    embed scaling, alternating per-layer windows + both softcaps), and
+    MoE (routed experts; dispatch-backend drop counts are NOT surfaced
+    under pp — the worker warns at startup). DeepSeek MLA is refused:
+    its layers differ from any staged body and would serve silently
+    wrong outputs.
     """
     from dynamo_tpu.models import get_family
-    family = get_family(cfg)
     n_stages = mesh.shape[pp_axis]
     tp = dict(mesh.shape).get(tp_axis, 1)
     dp = dict(mesh.shape).get(dp_axis, 1)
     if n_stages == 1:
         # no stage body runs: every family's own forward serves
-        out = family.forward(params, cfg, tokens, positions, pages,
-                             page_table, total_lens, new_lens)
+        out = get_family(cfg).forward(params, cfg, tokens, positions,
+                                      pages, page_table, total_lens,
+                                      new_lens)
         return out[0], out[1]
-    adapter_factory = _STAGE_ADAPTERS.get(getattr(family, "__name__", ""))
+    adapter_factory = stage_adapter_for(cfg)
     if adapter_factory is None:
         raise ValueError(
             f"pipeline_forward has no stage adapter for "
@@ -254,10 +323,10 @@ def pipeline_forward(params: Dict[str, Any], cfg: ModelConfig,
     if cfg.num_layers % n_stages:
         raise ValueError(f"num_layers={cfg.num_layers} not divisible by "
                          f"pp={n_stages}")
-    if tp > 1 and (cfg.num_kv_heads % tp or cfg.intermediate_size % tp):
+    if tp > 1 and (cfg.num_kv_heads % tp or _ffn_width(cfg) % tp):
         raise ValueError(f"num_kv_heads={cfg.num_kv_heads}/"
-                         f"intermediate_size={cfg.intermediate_size} not "
-                         f"divisible by tp={tp}")
+                         f"ffn_width={_ffn_width(cfg)} not divisible by "
+                         f"tp={tp}")
     B = tokens.shape[0]
     if B % dp:
         raise ValueError(f"batch {B} not divisible by dp={dp} (the engine "
@@ -387,7 +456,7 @@ def pipeline_forward(params: Dict[str, Any], cfg: ModelConfig,
                   else P(pp_axis, None, None, tp_axis))
     batch = P(dp_axis)                 # rows split across dp replicas
     specs_in = (
-        _param_specs(params, pp_axis, tp),
+        _param_specs(params, pp_axis, tp, stage_body.TP_TAILS),
         batch, batch, batch, batch, batch,  # tokens/pos/table/total/new
         pages_spec,                    # pages: layers staged, Hkv over tp,
                                        # REPLICATED over dp (gathered writes)
@@ -414,13 +483,18 @@ def pp_sharding_fns(mesh: Mesh, cfg: ModelConfig | None = None,
     from jax.sharding import NamedSharding
 
     tp = dict(mesh.shape).get(tp_axis, 1)
+    tails = _TP_TAILS
+    if cfg is not None:
+        adapter = stage_adapter_for(cfg)
+        if adapter is not None:
+            tails = adapter.TP_TAILS
     if tp > 1:
         if cfg is None:
             raise ValueError("pp x tp sharding needs the model config")
-        if cfg.num_kv_heads % tp or cfg.intermediate_size % tp:
+        if cfg.num_kv_heads % tp or _ffn_width(cfg) % tp:
             raise ValueError(
-                f"num_kv_heads={cfg.num_kv_heads}/intermediate_size="
-                f"{cfg.intermediate_size} not divisible by tp={tp}")
+                f"num_kv_heads={cfg.num_kv_heads}/ffn_width="
+                f"{_ffn_width(cfg)} not divisible by tp={tp}")
     pages_spec = (P(pp_axis) if tp == 1
                   else P(pp_axis, None, None, tp_axis))
 
@@ -428,7 +502,7 @@ def pp_sharding_fns(mesh: Mesh, cfg: ModelConfig | None = None,
         out = dict(params)
         out["layers"] = {
             k: jax.device_put(
-                v, NamedSharding(mesh, _layer_spec(k, pp_axis, tp)))
+                v, NamedSharding(mesh, _layer_spec(k, pp_axis, tp, tails)))
             for k, v in params["layers"].items()}
         for k, v in params.items():
             if k != "layers":
@@ -441,4 +515,4 @@ def pp_sharding_fns(mesh: Mesh, cfg: ModelConfig | None = None,
     return shard_params, shard_pages
 
 
-__all__ = ["pipeline_forward", "pp_sharding_fns"]
+__all__ = ["pipeline_forward", "pp_sharding_fns", "stage_adapter_for"]

@@ -164,16 +164,20 @@ def build_engine(args: argparse.Namespace) -> JaxEngine:
             raise SystemExit(
                 f"model has {cfg.num_layers} layers — not divisible by "
                 f"--pipeline-parallel-size {pp}")
-        from dynamo_tpu.models import get_family
-        from dynamo_tpu.parallel.pipeline import _STAGE_ADAPTERS
-        if getattr(get_family(cfg), "__name__", "") not in _STAGE_ADAPTERS:
+        from dynamo_tpu.parallel.pipeline import stage_adapter_for
+        if stage_adapter_for(cfg) is None:
             # only families with a pipeline stage adapter (llama tree,
-            # gemma-2) may stage; running a MoE/MLA model through another
-            # family's layers would serve silently wrong outputs
+            # gemma-2, MoE) may stage; running an MLA model through
+            # another family's layers would serve silently wrong outputs
             raise SystemExit(
                 f"--pipeline-parallel-size has no stage adapter for "
                 f"{cfg.model_type!r}; this family is served by tp/dp/sp "
                 f"instead")
+        if cfg.num_experts and cfg.moe_backend == "dispatch":
+            logger.warning(
+                "MoE dispatch drop accounting is not surfaced under "
+                "--pipeline-parallel-size: worker_stats.moe_dropped_tokens "
+                "will read 0 even when experts overflow capacity")
         pp_tp = args.tensor_parallel_size
         pp_dp = args.data_parallel_size
         mesh = make_mesh(MeshSpec(pp=pp, tp=pp_tp, dp=pp_dp),

@@ -174,14 +174,20 @@ def test_pp1_falls_through_to_plain():
 
 
 def test_rejects_families_without_stage_adapter():
-    """MoE/MLA layers differ from every staged body — running them
-    through one would serve silently wrong outputs, so the forward (and
-    the worker flag) refuse loudly."""
-    cfg = ModelConfig.tiny(num_experts=4, num_experts_per_tok=2,
-                           moe_intermediate_size=32,
-                           model_type="qwen3_moe", num_layers=4)
-    from dynamo_tpu.models import moe as _moe
-    params = _moe.init_params(cfg, jax.random.PRNGKey(0))
+    """MLA layers differ from every staged body — running them through
+    one would serve silently wrong outputs, so the forward (and the
+    worker flag) refuse loudly."""
+    cfg = ModelConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_layers=4, num_heads=4, num_kv_heads=1, head_dim=32,
+        model_type="deepseek_v2", dtype="float32",
+        q_lora_rank=0, kv_lora_rank=32, qk_rope_head_dim=16,
+        qk_nope_head_dim=32, v_head_dim=32,
+        num_experts=4, num_experts_per_tok=2, moe_intermediate_size=32,
+        n_shared_experts=1, first_k_dense_replace=1,
+        routed_scaling_factor=1.0)
+    from dynamo_tpu.models import deepseek as _ds
+    params = _ds.init_params(cfg, jax.random.PRNGKey(0))
     mesh = make_mesh(MeshSpec(pp=2), devices=jax.devices()[:2])
     pages = llama.make_pages(cfg, 9, 4, dtype=jnp.float32)
     tok = jnp.ones((2, 4), jnp.int32)
@@ -191,6 +197,51 @@ def test_rejects_families_without_stage_adapter():
     with pytest.raises(ValueError, match="no stage adapter"):
         pipeline_forward(params, cfg, tok, pos, pages, tbl, lens, lens,
                          mesh=mesh)
+
+
+@pytest.mark.parametrize("pp,tp,backend", [(2, 1, "dense"),
+                                           (2, 2, "dense"),
+                                           (2, 2, "dispatch")])
+def test_pipeline_moe_matches_plain_forward(pp, tp, backend):
+    """Mixtral/Qwen3-MoE through the MoE stage adapter: routed experts
+    inside the stage with the expert FFN width tp-sharded (the combine is
+    linear, so one psum completes the partial down-products) — logits AND
+    cache writes must match moe.forward on both expert backends."""
+    from dynamo_tpu.models import moe as _moe
+    from dynamo_tpu.parallel.pipeline import pp_sharding_fns
+
+    cfg = ModelConfig.tiny(num_experts=4, num_experts_per_tok=2,
+                           moe_intermediate_size=32, num_kv_heads=2,
+                           model_type="qwen3_moe", num_layers=4,
+                           moe_backend=backend, moe_capacity_factor=4.0)
+    params = _moe.init_params(cfg, jax.random.PRNGKey(4))
+    B, S, P_ = 4, 8, 4
+    tokens = jnp.asarray(np.random.RandomState(5).randint(
+        1, cfg.vocab_size, size=(B, S)), jnp.int32)
+    positions = jnp.tile(jnp.arange(S, dtype=jnp.int32)[None], (B, 1))
+    table = jnp.arange(1, 1 + B * P_, dtype=jnp.int32).reshape(B, P_)
+    new = jnp.asarray([S, S - 2, S, 3], jnp.int32)
+    total = new
+    pages = llama.make_pages(cfg, 1 + B * P_, 4, dtype=jnp.float32)
+    ref_logits, ref_pages, _aux = _moe.forward(
+        params, cfg, tokens, positions, pages, table, total, new)
+
+    mesh = make_mesh(MeshSpec(pp=pp, tp=tp), devices=jax.devices()[:pp * tp])
+    shard_params, shard_pages = pp_sharding_fns(mesh, cfg)
+    p2 = shard_params(params)
+    if tp > 1:  # expert FFN width really shards
+        wg = p2["layers"]["w_gate"]
+        assert wg.sharding.shard_shape(wg.shape)[-1] == 32 // tp
+    pages2 = shard_pages(llama.make_pages(cfg, 1 + B * P_, 4,
+                                          dtype=jnp.float32))
+    pp_logits, pp_pages = pipeline_forward(
+        p2, cfg, tokens, positions, pages2, table, total, new,
+        mesh=mesh, n_microbatches=2)
+    np.testing.assert_allclose(np.asarray(pp_logits),
+                               np.asarray(ref_logits), rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(pp_pages[:, 1:]),
+                               np.asarray(ref_pages[:, 1:]),
+                               rtol=2e-4, atol=2e-4)
 
 
 @pytest.mark.parametrize("pp,tp", [(2, 1), (2, 2)])
