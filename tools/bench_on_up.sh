@@ -13,4 +13,14 @@ python bench.py --budget 1200 --tier full \
 rc=$?
 echo "$(date +%H:%M:%S) bench_on_up: bench rc=$rc" >> /tmp/bench_live.log
 cat "/root/repo/BENCH_live_${ts}.json" >> /tmp/bench_live.log
+# second course while the window is (hopefully) still open: the MLA
+# kernel A/B on a DeepSeek-geometry model (VERDICT r4 weak 2). Skipped
+# when the main bench failed — its own init watchdog still bounds a
+# tunnel that dies between the two.
+if [ "$rc" -eq 0 ]; then
+  timeout 900 python tools/mla_bench.py \
+    > "/root/repo/BENCH_mla_${ts}.json" 2>> /tmp/bench_live.log
+  echo "$(date +%H:%M:%S) bench_on_up: mla rc=$?" >> /tmp/bench_live.log
+  cat "/root/repo/BENCH_mla_${ts}.json" >> /tmp/bench_live.log
+fi
 exit $rc

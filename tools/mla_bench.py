@@ -1,0 +1,127 @@
+"""On-chip MLA kernel A/B: DeepSeek-geometry serving, pallas vs scan.
+
+Runs a dense-MLA model (V3 attention geometry — nh=32, kv_lora_rank=512,
+rope 64 — scaled to fit one v5e chip) through the REAL serving engine
+twice, once with the MLA Pallas kernels (``attn_impl="pallas"``,
+``ops/pallas/mla_{decode,prefill}.py``) and once on the XLA latent paths
+(``scan``), and prints one JSON line PER ARM as it completes plus a
+final combined line — the measurement that decides whether the latent
+kernels earn their keep on hardware (VERDICT r4 weak 2: "DeepSeek hot
+path ... bandwidth efficiency on chip is unknown").
+
+Measurement methodology is bench.py's own ``_measure_engine`` (same
+warmup/steady-state accounting, so the numbers are comparable to the
+main bench), and bench.py's ``Watchdog`` bounds every stage — including
+the jax init itself, so a down tunnel kills this process at the init
+budget instead of hanging. Chained by ``tools/bench_on_up.sh`` after a
+SUCCESSFUL main bench; safe to run standalone (CPU runs are marked
+``valid: false``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from bench import STAGE_BUDGETS, Watchdog, _measure_engine  # noqa: E402
+
+
+def _mla_cfg():
+    from dynamo_tpu.models.config import ModelConfig
+
+    # ~1.6B dense params with the REAL V3 attention block shape: the MLA
+    # kernels see the exact per-layer geometry (nh x dkv x rope) that
+    # matters; depth/ffn scaled so params + KV fit a v5e chip easily
+    return ModelConfig(
+        vocab_size=32000, hidden_size=2048, intermediate_size=6144,
+        num_layers=16, num_heads=32, num_kv_heads=1, head_dim=512,
+        model_type="deepseek_v2", dtype="bfloat16",
+        q_lora_rank=0, kv_lora_rank=512, qk_rope_head_dim=64,
+        qk_nope_head_dim=128, v_head_dim=128,
+        num_experts=0, first_k_dense_replace=16,
+        routed_scaling_factor=1.0, max_position_embeddings=4096)
+
+
+async def _run(attn_impl: str, seqs: int, prompt: int, gen: int,
+               wd: Watchdog) -> dict:
+    from dynamo_tpu.engine.jax_engine import JaxEngine, JaxEngineConfig
+
+    wd.arm(f"build:{attn_impl}", STAGE_BUDGETS["engine_build"])
+    cfg = _mla_cfg()
+    pages_needed = seqs * ((prompt + gen) // 16 + 2)
+    max_ctx = -(-(prompt + gen + 64) // 16) * 16
+    prefill_seqs = min(8, seqs)
+    engine = JaxEngine.random_init(cfg, JaxEngineConfig(
+        num_pages=pages_needed + 16, page_size=16, max_num_seqs=seqs,
+        max_prefill_chunk=min(512, prompt), max_prefill_seqs=prefill_seqs,
+        max_context=max_ctx, min_prefill_bucket=min(512, prompt),
+        min_decode_bucket=seqs, attn_impl=attn_impl))
+    try:
+        m = await _measure_engine(engine, cfg,
+                                  (seqs, prompt, gen, prefill_seqs), wd,
+                                  attn_impl)
+    finally:
+        await engine.stop()
+    return {"attn_impl": engine.attn_impl,
+            "decode_tok_s": round(m["tok_per_s"], 1),
+            "prefill_tok_s": round(m["prefill_tok_s"], 1),
+            "ttft_p50_s": round(m["ttft_p50"], 3),
+            "warmup_s": round(m["warmup_s"], 1)}
+
+
+def main() -> None:
+    import argparse
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--seqs", type=int, default=16)
+    p.add_argument("--prompt", type=int, default=512)
+    p.add_argument("--gen", type=int, default=64)
+    p.add_argument("--small", action="store_true",
+                   help="CPU smoke shapes")
+    args = p.parse_args()
+    if args.small:
+        args.seqs, args.prompt, args.gen = 2, 32, 8
+
+    # the init IS the probe (bench.py's single-child design): a down
+    # tunnel dies at the init budget, not a caller's outer timeout
+    wd = Watchdog()
+    wd.arm("jax_init", STAGE_BUDGETS["jax_init"])
+    t0 = time.perf_counter()
+    if os.environ.get("BENCH_FORCE_CPU"):
+        from dynamo_tpu.utils.platform import force_cpu_platform
+
+        force_cpu_platform()
+    import jax
+
+    on_tpu = jax.devices()[0].platform in ("tpu", "axon")
+    print(f"mla_bench: init {time.perf_counter() - t0:.1f}s "
+          f"platform={jax.devices()[0].platform}", file=sys.stderr,
+          flush=True)
+    from dynamo_tpu.utils.platform import enable_compilation_cache
+    enable_compilation_cache()
+
+    result = {"metric": "mla_decode_ab", "valid": bool(on_tpu),
+              "seqs": args.seqs, "prompt": args.prompt, "gen": args.gen}
+    for impl in ("pallas", "scan"):
+        try:
+            arm = asyncio.run(_run(impl, args.seqs, args.prompt,
+                                   args.gen, wd))
+        except Exception as e:  # noqa: BLE001 — record, keep the other arm
+            arm = {"error": str(e)[:300]}
+        result[impl] = arm
+        # per-arm line: a window that closes mid-scan still leaves the
+        # completed pallas numbers in the artifact
+        print(json.dumps({"metric": "mla_decode_arm", "impl": impl,
+                          "valid": bool(on_tpu), **arm}), flush=True)
+    wd.disarm()
+    print(json.dumps(result), flush=True)
+
+
+if __name__ == "__main__":
+    main()
