@@ -35,6 +35,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from dynamo_tpu.ops.pallas.decode import _resolve_interpret
 from dynamo_tpu.ops.pallas.mla_decode import supports  # noqa: F401
+from dynamo_tpu.ops.pallas.prefill import shrink_query_block
 
 NEG_INF = -1e30
 
@@ -46,8 +47,19 @@ PAGES_PER_CHUNK = 8
 _TARGET_M_ROWS = 2048
 
 
-def _query_block(S: int, nh: int) -> int:
-    return max(1, min(S, max(8, _TARGET_M_ROWS // nh)))
+def _query_block(S: int, nh: int, dkv: int, span: int,
+                 slab_bytes: int) -> int:
+    """Query block bounded by MXU row target AND the scoped-VMEM stack.
+
+    The stack estimator mirrors ``prefill._fit_query_block``'s on-chip
+    calibration (v5e measured Mosaic temporaries at ~2× the naive
+    accounting): per query row, f32 score/prob/exp temporaries cost
+    ``~22*span`` bytes (the slot-batched s2 is [2, rows, span]) and the
+    f32 accumulator chain + q2/out copies cost ``~32*dkv`` bytes. At V3
+    geometry (nh=128, dkv=512) the old fixed 2048-row target estimated
+    ~39 MiB — far past the 16 MiB scoped limit the chip enforces."""
+    sb = max(1, min(S, max(8, _TARGET_M_ROWS // nh)))
+    return shrink_query_block(sb, 1, nh, 22 * span + 32 * dkv, slab_bytes)
 
 
 def _mla_prefill_kernel(q2_ref, kv_hbm, layer_ref, table_ref, qstart_ref,
@@ -159,7 +171,9 @@ def _mla_paged_prefill(q2, kv_pages, layer_idx, page_table, q_start,
     _L, _N, _2, _one, page_size, _ = kv_pages.shape
     P = page_table.shape[1]
     chunk = min(PAGES_PER_CHUNK, P)
-    SB = _query_block(S, nh)
+    span = chunk * page_size
+    slab_bytes = 2 * 2 * span * dkv * kv_pages.dtype.itemsize
+    SB = _query_block(S, nh, dkv, span, slab_bytes)
     n_q_blocks = -(-S // SB)
 
     kernel = functools.partial(_mla_prefill_kernel, page_size=page_size,

@@ -44,11 +44,46 @@ NEG_INF = -1e30
 PAGES_PER_CHUNK = 8
 
 # query rows per grid program: SB * Hq * Dh bf16 + f32 scores/acc must fit
-# VMEM next to the double-buffered kv slabs. At Llama-3B geometry
-# (Hkv=8, G=3, Dh=128) 128 rows put the working set near ~8 MB — half the
-# ~16 MB VMEM budget, leaving headroom for Mosaic temporaries (256 rows
-# measured ~13.5 MB on paper: too close to debut on hardware untested)
+# scoped VMEM next to the double-buffered kv slabs. The ceiling is the
+# 16 MiB scoped-vmem stack limit, and Mosaic's materialized temporaries
+# (exp input, p cast, acc update, the q/out transposes) roughly DOUBLE the
+# naive scores+acc accounting: on a real v5e, SB=128 at Llama-3B geometry
+# (Hq=24, Dh=128, span=128) measured 16.79 MiB of stack — 804 KiB OVER.
+# ``_fit_query_block`` shrinks SB per-geometry with an estimator
+# calibrated against that measurement; QUERY_BLOCK is only the upper bound.
 QUERY_BLOCK = 128
+
+# scoped-vmem stack budget the estimator targets: the hardware limit is
+# 16 MiB; 14 MiB leaves margin for the ~5% the calibrated estimator
+# underpredicts plus Mosaic's small fixed overheads
+VMEM_STACK_BUDGET = 14 * 2**20
+
+
+def shrink_query_block(sb: int, floor: int, row_heads: int,
+                       bytes_per_row: int, slab_bytes: int) -> int:
+    """Halve ``sb`` until ``row_heads * sb * bytes_per_row + slab_bytes``
+    fits ``VMEM_STACK_BUDGET`` (never below ``floor``). Shared by this
+    kernel and the MLA prefill kernel — each supplies its own calibrated
+    per-row byte cost."""
+    while sb > floor and row_heads * sb * bytes_per_row + slab_bytes \
+            > VMEM_STACK_BUDGET:
+        sb //= 2
+    return sb
+
+
+def _fit_query_block(S: int, Hq: int, Dh: int, span: int,
+                     slab_bytes: int) -> int:
+    """Largest query block (power-of-two rows ≥ 8) whose estimated scoped
+    VMEM stack fits the budget.
+
+    Estimator: the f32 score/prob/exp temporaries are ``Hq*SB*span`` (≈3
+    copies live) and the f32 accumulator chain is ``Hq*SB*Dh`` (≈4 copies),
+    plus bf16 q/out copies — ``Hq*SB*(14*span + 24*Dh)`` bytes total.
+    Calibrated on v5e: predicts 15.9 MiB where the chip measured 16.79 MiB
+    (Hq=24, SB=128, span=128, Dh=128), hence the conservative budget.
+    """
+    return shrink_query_block(min(QUERY_BLOCK, S), 8, Hq,
+                              14 * span + 24 * Dh, slab_bytes)
 
 
 def _prefill_kernel(q_ref, kv_hbm, layer_ref, window_ref, table_ref,
@@ -188,7 +223,9 @@ def _paged_prefill(q, kv_pages, layer_idx, window, page_table, q_start,
     _L, _N, _two, Hkv, page_size, _ = kv_pages.shape
     P = page_table.shape[1]
     chunk = min(PAGES_PER_CHUNK, P)
-    SB = min(QUERY_BLOCK, S)
+    span = chunk * page_size
+    slab_bytes = 2 * 2 * Hkv * span * Dh * kv_pages.dtype.itemsize
+    SB = _fit_query_block(S, Hq, Dh, span, slab_bytes)
     # S need not divide SB: pallas pads the ragged last block (its garbage
     # query rows attend to finite clamped pages and their outputs land in
     # the discarded pad region of out_ref)

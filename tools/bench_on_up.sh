@@ -4,23 +4,52 @@
 # design) and saves every artifact into the repo so a later driver-run
 # bench loads compiled programs from the persistent cache and the judge
 # can see the on-chip numbers even if the window closes again.
+# Exit code: 0 only when the bench produced a VALID on-chip result
+# (bench.py itself exits 0 even for the labelled CPU fallback).
 set -u
 cd /root/repo
+exec 9>/tmp/bench_on_up.lock
+flock -n 9 || { echo "bench_on_up: another run holds the lock"; exit 2; }
 ts=$(date +%H%M%S)
 echo "$(date +%H:%M:%S) bench_on_up: starting bench (ts=$ts)" >> /tmp/bench_live.log
 python bench.py --budget 1200 --tier full \
   > "/root/repo/BENCH_live_${ts}.json" 2>> /tmp/bench_live.log
 rc=$?
-echo "$(date +%H:%M:%S) bench_on_up: bench rc=$rc" >> /tmp/bench_live.log
+python - "$ts" <<'EOF'
+import json, sys
+try:
+    r = json.load(open(f"/root/repo/BENCH_live_{sys.argv[1]}.json"))
+    # a live_cache re-emission is an EARLIER window's number — this
+    # window did not reach the chip, so don't chain the MLA bench or
+    # keep a duplicate artifact
+    sys.exit(0 if r.get("valid") and r.get("source") != "live_cache"
+             else 1)
+except Exception:
+    sys.exit(1)
+EOF
+valid=$?
+echo "$(date +%H:%M:%S) bench_on_up: bench rc=$rc valid_rc=$valid" >> /tmp/bench_live.log
 cat "/root/repo/BENCH_live_${ts}.json" >> /tmp/bench_live.log
+# an invalid (CPU-fallback) artifact is just noise next to the valid ones
+[ "$valid" -ne 0 ] && rm -f "/root/repo/BENCH_live_${ts}.json"
 # second course while the window is (hopefully) still open: the MLA
 # kernel A/B on a DeepSeek-geometry model (VERDICT r4 weak 2). Skipped
 # when the main bench failed — its own init watchdog still bounds a
 # tunnel that dies between the two.
-if [ "$rc" -eq 0 ]; then
+if [ "$valid" -eq 0 ]; then
   timeout 900 python tools/mla_bench.py \
     > "/root/repo/BENCH_mla_${ts}.json" 2>> /tmp/bench_live.log
-  echo "$(date +%H:%M:%S) bench_on_up: mla rc=$?" >> /tmp/bench_live.log
+  mla_rc=$?
+  echo "$(date +%H:%M:%S) bench_on_up: mla rc=$mla_rc" >> /tmp/bench_live.log
   cat "/root/repo/BENCH_mla_${ts}.json" >> /tmp/bench_live.log
+  # drop failed/invalid MLA artifacts (rc!=0, or no arm measured)
+  python - "$ts" <<'EOF' || rm -f "/root/repo/BENCH_mla_${ts}.json"
+import json, sys
+try:
+    last = open(f"/root/repo/BENCH_mla_{sys.argv[1]}.json").read().strip().splitlines()[-1]
+    sys.exit(0 if json.loads(last).get("valid") else 1)
+except Exception:
+    sys.exit(1)
+EOF
 fi
-exit $rc
+exit $valid

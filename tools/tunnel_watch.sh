@@ -1,0 +1,36 @@
+#!/bin/bash
+# Persistent TPU-tunnel watcher (round-5 design; VERDICT r4 Next #1).
+#
+# The tunneled chip answers in windows minutes long, hours apart; a bench
+# launched outside a window burns its whole budget on hung inits. This
+# watcher inverts the structure: a cheap probe loop detects a window, and
+# only then fires the full bench chain (tools/bench_on_up.sh -> bench.py
+# single-process probe->prime->measure -> tools/mla_bench.py). Valid
+# results persist via bench.py's BENCH_live_best.json cache, which the
+# driver's end-of-round bench run emits if its own window is closed.
+#
+# Stops itself once a full-tier result AND an MLA result exist, or when
+# /tmp/tunnel_watch.stop appears.
+set -u
+log=/tmp/tunnel_watch.log
+echo "$(date +%H:%M:%S) tunnel_watch: started (pid $$)" >> "$log"
+while :; do
+  [ -f /tmp/tunnel_watch.stop ] && { echo "$(date +%H:%M:%S) stop file; exiting" >> "$log"; exit 0; }
+  if [ -f /root/repo/BENCH_live_best.json ] \
+     && python -c "import json,sys; r=json.load(open('/root/repo/BENCH_live_best.json')); sys.exit(0 if r.get('tier')=='full' and r.get('valid') else 1)" 2>/dev/null \
+     && ls /root/repo/BENCH_mla_*.json >/dev/null 2>&1; then
+    echo "$(date +%H:%M:%S) full-tier + MLA results exist; exiting" >> "$log"
+    exit 0
+  fi
+  # probe: a jax init that answers with a non-cpu backend inside 100s
+  # means the window is open (a closed tunnel hangs the init; the site
+  # hook never silently falls back to cpu, but check anyway)
+  if timeout 100 python -c "import jax; assert jax.default_backend() != 'cpu', jax.default_backend()" 2>/dev/null; then
+    echo "$(date +%H:%M:%S) tunnel up -> firing bench chain" >> "$log"
+    bash /root/repo/tools/bench_on_up.sh >> "$log" 2>&1
+    echo "$(date +%H:%M:%S) bench chain rc=$?" >> "$log"
+    sleep 30
+  else
+    sleep 60
+  fi
+done
