@@ -160,7 +160,7 @@ def tree_bytes(tree) -> int:
                for x in jax.tree_util.tree_leaves(tree))
 
 
-def _build_engine(tier: str, attn_impl: str):
+def _build_engine(tier: str, attn_impl: str, quantize: str = ""):
     """Build the engine for a tier; config is deterministic per tier so the
     persistent compile-cache keys match across runs."""
     import jax
@@ -192,7 +192,7 @@ def _build_engine(tier: str, attn_impl: str):
         max_context=max_ctx, min_prefill_bucket=min(512, prompt),
         min_prefill_seqs_bucket=prefill_seqs,
         min_decode_bucket=seqs,
-        attn_impl=attn_impl)
+        attn_impl=attn_impl, quantize=quantize)
     engine = JaxEngine.random_init(cfg, ecfg)
     return engine, cfg, (seqs, prompt, gen, prefill_seqs), on_tpu
 
@@ -392,13 +392,19 @@ async def run_attempt(args) -> dict:
         "warmup_s": round(m["warmup_s"], 1),
     }
 
+    # EARLY main-result line: the extras below (attn A/B, int8 leg) may
+    # outlive the tunnel window; the child's watchdog exit still leaves
+    # this line on stdout and the orchestrator takes the LAST parseable
+    # line — so a window that closes mid-extra keeps the main number.
+    print(json.dumps(result), flush=True)
+
     # attn-impl A/B in the SAME process (round-4 open question:
     # scan+pallas vs pallas_unrolled on chip) — another engine, same init.
     ab_impl = args.ab
     remaining = deadline - time.monotonic()
     if ab_impl and ab_impl != engine.attn_impl and tpu_run \
             and remaining >= STAGE_BUDGETS["ab"]:
-        del engine  # free HBM before the second engine builds
+        engine = None  # free HBM before the second engine builds
         try:
             wd.arm("ab:build", STAGE_BUDGETS["engine_build"])
             engine2, cfg2, geo2, _ = _build_engine(args.tier, ab_impl)
@@ -417,6 +423,8 @@ async def run_attempt(args) -> dict:
                 "ttft_p50_s": round(m2["ttft_p50"], 3),
                 "warmup_s": round(m2["warmup_s"], 1),
             }
+            engine2 = None  # free HBM for the int8 leg
+            print(json.dumps(result), flush=True)
         except Exception as e:  # the A/B is best-effort extra data
             result["ab"] = {"attn_impl": ab_impl, "error": str(e)[:300]}
     elif ab_impl and ab_impl != result["attn_impl"]:
@@ -424,6 +432,45 @@ async def run_attempt(args) -> dict:
                         "error": (f"skipped (remaining {remaining:.0f}s"
                                   f" < {STAGE_BUDGETS['ab']:.0f}s)"
                                   if tpu_run else "skipped (not on tpu)")}
+
+    # int8 W8A8-dynamic leg (ops/quant.py), same window, same init:
+    # decode is bandwidth-bound on the param stream, so quantization is
+    # the single biggest throughput lever — vs_bf16 is the measured
+    # speedup over the main engine, vs_baseline the fraction of the
+    # int8-params roofline.
+    remaining = deadline - time.monotonic()
+    if tpu_run and remaining >= STAGE_BUDGETS["ab"]:
+        engine = None  # free the main engine's HBM
+        try:
+            wd.arm("quant:build", STAGE_BUDGETS["engine_build"])
+            engine3, cfg3, geo3, _ = _build_engine(
+                args.tier, result["attn_impl"], quantize="int8")
+            q_param_bytes = tree_bytes(engine3.params)
+            _ckpt("quant_engine_built",
+                  params_gb=round(q_param_bytes / 1e9, 2))
+            _prime_programs(engine3, geo3[0], geo3[1], geo3[3], wd,
+                            label="quant")
+            try:
+                wd.arm("quant:measure", STAGE_BUDGETS["measure"])
+                m3 = await _measure_engine(engine3, cfg3, geo3, wd, "quant")
+            finally:
+                await engine3.stop()
+            q_step_bytes = q_param_bytes + seqs * avg_ctx * kv_per_tok
+            q_roof = detect_bandwidth() * 1e9 / q_step_bytes * seqs
+            result["quant"] = {
+                "mode": "int8",
+                "decode_tok_s": round(m3["tok_per_s"], 1),
+                "prefill_tok_s": round(m3["prefill_tok_s"], 1),
+                "ttft_p50_s": round(m3["ttft_p50"], 3),
+                "vs_bf16": round(m3["tok_per_s"] / m["tok_per_s"], 3),
+                "vs_baseline": round(m3["tok_per_s"] / q_roof, 4),
+            }
+        except Exception as e:  # best-effort extra data
+            result["quant"] = {"mode": "int8", "error": str(e)[:300]}
+    elif tpu_run:
+        result["quant"] = {"mode": "int8",
+                           "error": f"skipped (remaining {remaining:.0f}s"
+                                    f" < {STAGE_BUDGETS['ab']:.0f}s)"}
     wd.disarm()
     return result
 
@@ -884,8 +931,9 @@ def _run_attempt_proc(argv: list[str], env: dict,
                 except json.JSONDecodeError:
                     continue
                 stage = ck.get("stage")
-                if ck.get("label") == "ab" or str(stage).startswith("ab"):
-                    continue  # A/B extras must not regress main progress
+                if (ck.get("label") in ("ab", "quant")
+                        or str(stage).startswith(("ab", "quant"))):
+                    continue  # extras must not regress main progress
                 if stage == "primed":
                     progress["programs_primed"] += 1
                     progress["stage"] = "primed"
@@ -925,19 +973,30 @@ def _run_attempt_proc(argv: list[str], env: dict,
             print(f"bench: attempt killed ({killed})",
                   file=sys.stderr, flush=True)
             t.join(timeout=5.0)
-            return None, progress
+            # drain stdout even on the kill path: the child prints its
+            # main result EARLY (before the A/B and int8 extras), so a
+            # stall-kill during an extra must not discard a valid main
+            # measurement — that line is the whole point of four rounds
+            result = _last_json_line(proc.stdout.read())
+            return result, progress
     out = proc.stdout.read()
     t.join(timeout=5.0)
+    result = _last_json_line(out)
+    if result is None:
+        print(f"bench: attempt exited rc={proc.returncode} without a "
+              "result", file=sys.stderr, flush=True)
+    return result, progress
+
+
+def _last_json_line(out: bytes) -> dict | None:
     for line in reversed(out.decode(errors="replace").splitlines()):
         line = line.strip()
         if line.startswith("{"):
             try:
-                return json.loads(line), progress
+                return json.loads(line)
             except json.JSONDecodeError:
                 continue
-    print(f"bench: attempt exited rc={proc.returncode} without a result",
-          file=sys.stderr, flush=True)
-    return None, progress
+    return None
 
 
 def main() -> None:

@@ -88,6 +88,11 @@ class JaxEngineConfig:
     #                scan+pallas path)
     #   "auto"     — pallas on TPU, scan elsewhere
     attn_impl: str = "auto"
+    # weight quantization applied at load time: "" (serve the checkpoint
+    # dtype) or "int8" (W8A8-dynamic, ops/quant.py — halves the per-step
+    # parameter stream and runs the matmuls on the MXU's double-rate int8
+    # path; llama-family dense models only)
+    quantize: str = ""
     # pipelined decode: step N+1 consumes step N's sampled tokens directly
     # on device; the host fetches step N's results while N+1 runs, hiding
     # the device->host readback (which on a tunneled chip is ~80 ms — the
@@ -156,6 +161,25 @@ class JaxEngine(ScheduledEngineBase):
         self.params = params
         from dynamo_tpu.models import get_family
         family = get_family(model_cfg)
+        if self.cfg.quantize:
+            if self.cfg.quantize != "int8":
+                raise ValueError(
+                    f"quantize={self.cfg.quantize!r}: only 'int8' "
+                    "(W8A8 dynamic) is implemented")
+            if family is not llama:
+                # gemma's GeGLU and the MoE/MLA families have their own
+                # matmul sites that do not dispatch through quant.mm yet
+                raise ValueError(
+                    f"quantize='int8' currently covers the llama family "
+                    f"tree (llama/mistral/qwen dense); model_type "
+                    f"{model_cfg.model_type!r} is served bf16")
+            if self.cfg.shard_params_fn is not None:
+                raise ValueError(
+                    "quantize='int8' does not compose with sharded "
+                    "serving yet (the name-pattern sharding rules do not "
+                    "know the *_q/*_scale pairs)")
+            from dynamo_tpu.ops.quant import quantize_params
+            self.params = quantize_params(self.params)
         self._forward = forward_fn or family.forward
         self._forward_unrolled = family.forward_unrolled
         if (forward_fn is None and self.cfg.mesh is not None

@@ -39,6 +39,7 @@ from dynamo_tpu.ops.attention import (
     write_kv_layer,
 )
 from dynamo_tpu.ops.rope import apply_rope
+from dynamo_tpu.ops import quant
 
 Params = Dict[str, Any]
 
@@ -128,9 +129,9 @@ def _project_qkv(cfg: ModelConfig, lp: Dict[str, jnp.ndarray],
     B, S, _ = h.shape
     eps = cfg.rms_norm_eps
     x = _rms_norm(h, lp["attn_norm"], eps)
-    q = x @ lp["wq"]
-    k = x @ lp["wk"]
-    v = x @ lp["wv"]
+    q = quant.mm(lp, "wq", x)
+    k = quant.mm(lp, "wk", x)
+    v = quant.mm(lp, "wv", x)
     if cfg.attention_bias:
         q = q + lp["bq"]
         k = k + lp["bk"]
@@ -150,7 +151,7 @@ def _finish_attn(cfg: ModelConfig, lp: Dict[str, jnp.ndarray],
                  h: jnp.ndarray, attn: jnp.ndarray) -> jnp.ndarray:
     """Out-projection residual (shared with the MoE decoder)."""
     B, S, _ = h.shape
-    return h + attn.reshape(B, S, cfg.q_size) @ lp["wo"]
+    return h + quant.mm(lp, "wo", attn.reshape(B, S, cfg.q_size))
 
 
 def _finish_layer(cfg: ModelConfig, lp: Dict[str, jnp.ndarray],
@@ -158,7 +159,8 @@ def _finish_layer(cfg: ModelConfig, lp: Dict[str, jnp.ndarray],
     """Shared post-attention math: out-proj residual + gated MLP residual."""
     h = _finish_attn(cfg, lp, h, attn)
     x = _rms_norm(h, lp["mlp_norm"], cfg.rms_norm_eps)
-    return h + (jax.nn.silu(x @ lp["w_gate"]) * (x @ lp["w_up"])) @ lp["w_down"]
+    act = jax.nn.silu(quant.mm(lp, "w_gate", x)) * quant.mm(lp, "w_up", x)
+    return h + quant.mm(lp, "w_down", act)
 
 
 def _logits(cfg: ModelConfig, params: Params, h: jnp.ndarray,
@@ -167,6 +169,10 @@ def _logits(cfg: ModelConfig, params: Params, h: jnp.ndarray,
     last = jnp.maximum(new_lens - 1, 0)                    # [B]
     h_last = jnp.take_along_axis(
         h, last[:, None, None].astype(jnp.int32), axis=1)[:, 0]  # [B, H]
+    lm8 = params.get("lm_head_q")
+    if lm8 is not None:
+        return quant.qdot(h_last, lm8,
+                          params["lm_head_scale"]).astype(jnp.float32)
     lm_head = params.get("lm_head")
     if lm_head is None:
         lm_head = params["embed"].T

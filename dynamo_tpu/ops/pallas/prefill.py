@@ -67,7 +67,10 @@ def shrink_query_block(sb: int, floor: int, row_heads: int,
     per-row byte cost."""
     while sb > floor and row_heads * sb * bytes_per_row + slab_bytes \
             > VMEM_STACK_BUDGET:
-        sb //= 2
+        # clamp the halving so a non-power-of-two start (sb seeds from the
+        # prompt length S) cannot step BELOW the floor: 12 -> 6 would
+        # violate the kernel's minimum-rows contract
+        sb = max(floor, sb // 2)
     return sb
 
 

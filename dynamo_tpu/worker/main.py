@@ -74,6 +74,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="engine attention implementation (auto = Pallas "
                         "kernels on TPU, XLA scan elsewhere); explicit "
                         "values drive on-chip A/Bs")
+    p.add_argument("--quantize", choices=["", "int8"], default="",
+                   help="load-time weight quantization: int8 = W8A8 "
+                        "dynamic (halves the decode-step parameter "
+                        "stream; llama-family dense models)")
     p.add_argument("--moe-backend", choices=["dense", "dispatch"],
                    default=None,
                    help="MoE expert compute: dense (every expert, every "
@@ -143,7 +147,7 @@ def build_engine(args: argparse.Namespace) -> JaxEngine:
         max_prefill_chunk=args.max_prefill_chunk,
         max_context=min(args.max_context, cfg.max_position_embeddings),
         num_top_logprobs=args.num_top_logprobs,
-        attn_impl=args.attn_impl)
+        attn_impl=args.attn_impl, quantize=args.quantize)
     forward_fn = None
     pp = args.pipeline_parallel_size
     if pp > 1:

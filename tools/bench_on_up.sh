@@ -12,21 +12,17 @@ exec 9>/tmp/bench_on_up.lock
 flock -n 9 || { echo "bench_on_up: another run holds the lock"; exit 2; }
 ts=$(date +%H%M%S)
 echo "$(date +%H:%M:%S) bench_on_up: starting bench (ts=$ts)" >> /tmp/bench_live.log
-python bench.py --budget 1200 --tier full \
+# budget 2400: one window should fit main + attn A/B + int8 legs; the
+# child prints the main result early, so a window that closes mid-extra
+# still yields the headline number
+python bench.py --budget 2400 --tier full \
   > "/root/repo/BENCH_live_${ts}.json" 2>> /tmp/bench_live.log
 rc=$?
-python - "$ts" <<'EOF'
-import json, sys
-try:
-    r = json.load(open(f"/root/repo/BENCH_live_{sys.argv[1]}.json"))
-    # a live_cache re-emission is an EARLIER window's number — this
-    # window did not reach the chip, so don't chain the MLA bench or
-    # keep a duplicate artifact
-    sys.exit(0 if r.get("valid") and r.get("source") != "live_cache"
-             else 1)
-except Exception:
-    sys.exit(1)
-EOF
+# a live_cache re-emission is an EARLIER window's number — this window
+# did not reach the chip, so don't chain the MLA bench or keep a
+# duplicate artifact
+python tools/check_artifact.py "/root/repo/BENCH_live_${ts}.json" \
+  --reject-live-cache
 valid=$?
 echo "$(date +%H:%M:%S) bench_on_up: bench rc=$rc valid_rc=$valid" >> /tmp/bench_live.log
 cat "/root/repo/BENCH_live_${ts}.json" >> /tmp/bench_live.log
@@ -42,14 +38,8 @@ if [ "$valid" -eq 0 ]; then
   mla_rc=$?
   echo "$(date +%H:%M:%S) bench_on_up: mla rc=$mla_rc" >> /tmp/bench_live.log
   cat "/root/repo/BENCH_mla_${ts}.json" >> /tmp/bench_live.log
-  # drop failed/invalid MLA artifacts (rc!=0, or no arm measured)
-  python - "$ts" <<'EOF' || rm -f "/root/repo/BENCH_mla_${ts}.json"
-import json, sys
-try:
-    last = open(f"/root/repo/BENCH_mla_{sys.argv[1]}.json").read().strip().splitlines()[-1]
-    sys.exit(0 if json.loads(last).get("valid") else 1)
-except Exception:
-    sys.exit(1)
-EOF
+  # drop failed/invalid MLA artifacts (no arm measured / truncated)
+  python tools/check_artifact.py "/root/repo/BENCH_mla_${ts}.json" \
+    || rm -f "/root/repo/BENCH_mla_${ts}.json"
 fi
 exit $valid

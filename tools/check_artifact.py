@@ -1,0 +1,49 @@
+#!/usr/bin/env python
+"""Shared bench-artifact validity check for the tunnel-watcher shell chain.
+
+Usage: check_artifact.py FILE [--reject-live-cache] [--require-tier TIER]
+
+Exit 0 iff the file's LAST parseable JSON line (artifacts may hold
+per-arm/early lines above the final one, and a killed run truncates)
+says ``valid: true`` — plus any extra conditions:
+
+- ``--reject-live-cache``: fail on ``source: live_cache`` re-emissions
+  (an earlier window's number; the caller wants proof THIS window
+  reached the chip).
+- ``--require-tier TIER``: fail unless the result's tier matches.
+
+Used by tools/bench_on_up.sh (keep/drop artifacts, gate the MLA chain)
+and tools/tunnel_watch.sh (stop condition) so validity rules live once.
+"""
+import json
+import sys
+
+
+def main(argv) -> int:
+    path = argv[1]
+    flags = argv[2:]
+    try:
+        lines = [ln.strip() for ln in open(path).read().splitlines()]
+    except OSError:
+        return 1
+    r = None
+    for ln in reversed(lines):
+        if ln.startswith("{"):
+            try:
+                r = json.loads(ln)
+                break
+            except json.JSONDecodeError:
+                continue
+    if not r or not r.get("valid"):
+        return 1
+    if "--reject-live-cache" in flags and r.get("source") == "live_cache":
+        return 1
+    if "--require-tier" in flags:
+        want = flags[flags.index("--require-tier") + 1]
+        if r.get("tier") != want:
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -16,8 +16,8 @@ log=/tmp/tunnel_watch.log
 echo "$(date +%H:%M:%S) tunnel_watch: started (pid $$)" >> "$log"
 while :; do
   [ -f /tmp/tunnel_watch.stop ] && { echo "$(date +%H:%M:%S) stop file; exiting" >> "$log"; exit 0; }
-  if [ -f /root/repo/BENCH_live_best.json ] \
-     && python -c "import json,sys; r=json.load(open('/root/repo/BENCH_live_best.json')); sys.exit(0 if r.get('tier')=='full' and r.get('valid') else 1)" 2>/dev/null \
+  if python /root/repo/tools/check_artifact.py \
+       /root/repo/BENCH_live_best.json --require-tier full 2>/dev/null \
      && ls /root/repo/BENCH_mla_*.json >/dev/null 2>&1; then
     echo "$(date +%H:%M:%S) full-tier + MLA results exist; exiting" >> "$log"
     exit 0
