@@ -405,6 +405,7 @@ async def run_attempt(args) -> dict:
     if ab_impl and ab_impl != engine.attn_impl and tpu_run \
             and remaining >= STAGE_BUDGETS["ab"]:
         engine = None  # free HBM before the second engine builds
+        engine2 = None
         try:
             wd.arm("ab:build", STAGE_BUDGETS["engine_build"])
             engine2, cfg2, geo2, _ = _build_engine(args.tier, ab_impl)
@@ -423,10 +424,19 @@ async def run_attempt(args) -> dict:
                 "ttft_p50_s": round(m2["ttft_p50"], 3),
                 "warmup_s": round(m2["warmup_s"], 1),
             }
-            engine2 = None  # free HBM for the int8 leg
             print(json.dumps(result), flush=True)
         except Exception as e:  # the A/B is best-effort extra data
             result["ab"] = {"attn_impl": ab_impl, "error": str(e)[:300]}
+            if engine2 is not None:
+                try:
+                    await engine2.stop()
+                except Exception:
+                    pass
+        finally:
+            # always drop the A/B engine's HBM before the int8 leg
+            # builds a third engine — a failed prime must not cascade
+            # into a spurious int8 OOM
+            engine2 = None
     elif ab_impl and ab_impl != result["attn_impl"]:
         result["ab"] = {"attn_impl": ab_impl,
                         "error": (f"skipped (remaining {remaining:.0f}s"
@@ -1040,9 +1050,12 @@ def main() -> None:
             tier = "reduced" if args.tier == "full" else args.tier
         # cap a healthy-but-slow child well above the main-run stage
         # budgets so a long-budget run (the tunnel watcher) has room for
-        # the in-process A/B; stalls are caught by the watchdog + the
-        # activity kill, not this cap
-        child_budget = min(remaining, 1200.0)
+        # the in-process A/B + int8 extras; stalls are caught by the
+        # watchdog + the activity kill, not this cap. The watcher raises
+        # the cap via env so its 2400s budget actually reaches ONE child
+        # (main + both extras) instead of two from-scratch attempts.
+        cap = float(os.environ.get("BENCH_CHILD_CAP", "1200"))
+        child_budget = min(remaining, cap)
         argv = ["--_attempt", "--tier", tier,
                 "--attn-impl", args.attn_impl, "--ab", args.ab,
                 "--child-budget", f"{child_budget:.0f}"]

@@ -166,18 +166,21 @@ class JaxEngine(ScheduledEngineBase):
                 raise ValueError(
                     f"quantize={self.cfg.quantize!r}: only 'int8' "
                     "(W8A8 dynamic) is implemented")
-            if family is not llama:
-                # gemma's GeGLU and the MoE/MLA families have their own
-                # matmul sites that do not dispatch through quant.mm yet
+            from dynamo_tpu.models import gemma
+            if family is not llama and family is not gemma:
+                # the MoE/MLA families' expert/latent matmul sites do not
+                # dispatch through quant.mm yet
                 raise ValueError(
                     f"quantize='int8' currently covers the llama family "
-                    f"tree (llama/mistral/qwen dense); model_type "
-                    f"{model_cfg.model_type!r} is served bf16")
-            if self.cfg.shard_params_fn is not None:
+                    f"tree (llama/mistral/qwen dense) and gemma-2; "
+                    f"model_type {model_cfg.model_type!r} is served bf16")
+            if forward_fn is not None:
+                # custom forwards (the pp stage bodies) are not
+                # quant-aware: _LlamaStage.tail would silently fall back
+                # to embed.T when quantize_params pops "lm_head"
                 raise ValueError(
-                    "quantize='int8' does not compose with sharded "
-                    "serving yet (the name-pattern sharding rules do not "
-                    "know the *_q/*_scale pairs)")
+                    "quantize='int8' does not compose with a custom "
+                    "forward_fn (pipeline parallelism) yet")
             from dynamo_tpu.ops.quant import quantize_params
             self.params = quantize_params(self.params)
         self._forward = forward_fn or family.forward

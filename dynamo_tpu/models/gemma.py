@@ -39,6 +39,7 @@ from dynamo_tpu.ops.attention import (
     write_kv_layer,
 )
 from dynamo_tpu.ops.rope import apply_rope
+from dynamo_tpu.ops import quant
 
 Params = Dict[str, Any]
 
@@ -104,9 +105,9 @@ def _project_qkv(cfg: ModelConfig, lp: Dict[str, jnp.ndarray],
                  h: jnp.ndarray, positions: jnp.ndarray):
     B, S, _ = h.shape
     x = _rms_norm(h, lp["attn_norm"], cfg.rms_norm_eps)
-    q = (x @ lp["wq"]).reshape(B, S, cfg.num_heads, cfg.head_dim)
-    k = (x @ lp["wk"]).reshape(B, S, cfg.num_kv_heads, cfg.head_dim)
-    v = (x @ lp["wv"]).reshape(B, S, cfg.num_kv_heads, cfg.head_dim)
+    q = quant.mm(lp, "wq", x).reshape(B, S, cfg.num_heads, cfg.head_dim)
+    k = quant.mm(lp, "wk", x).reshape(B, S, cfg.num_kv_heads, cfg.head_dim)
+    v = quant.mm(lp, "wv", x).reshape(B, S, cfg.num_kv_heads, cfg.head_dim)
     q = apply_rope(q, positions, cfg.rope_theta)
     k = apply_rope(k, positions, cfg.rope_theta)
     return q, k, v
@@ -116,11 +117,12 @@ def _finish_layer(cfg: ModelConfig, lp: Dict[str, jnp.ndarray],
                   h: jnp.ndarray, attn: jnp.ndarray) -> jnp.ndarray:
     B, S, _ = h.shape
     eps = cfg.rms_norm_eps
-    attn_out = attn.reshape(B, S, cfg.q_size) @ lp["wo"]
+    attn_out = quant.mm(lp, "wo", attn.reshape(B, S, cfg.q_size))
     h = h + _rms_norm(attn_out, lp["post_attn_norm"], eps)
     x = _rms_norm(h, lp["pre_ffw_norm"], eps)
-    mlp = (jax.nn.gelu(x @ lp["w_gate"], approximate=True)
-           * (x @ lp["w_up"])) @ lp["w_down"]
+    act = (jax.nn.gelu(quant.mm(lp, "w_gate", x), approximate=True)
+           * quant.mm(lp, "w_up", x))
+    mlp = quant.mm(lp, "w_down", act)
     return h + _rms_norm(mlp, lp["post_ffw_norm"], eps)
 
 
@@ -130,11 +132,17 @@ def _logits(cfg: ModelConfig, params: Params, h: jnp.ndarray,
     last = jnp.maximum(new_lens - 1, 0)
     h_last = jnp.take_along_axis(
         h, last[:, None, None].astype(jnp.int32), axis=1)[:, 0]
-    lm_head = params.get("lm_head")
-    if lm_head is None:
-        lm_head = params["embed"].T
-    # model-dtype operands + f32 accumulation (see llama._logits)
-    logits = jnp.dot(h_last, lm_head, preferred_element_type=jnp.float32)
+    lm8 = params.get("lm_head_q")
+    if lm8 is not None:
+        logits = quant.qdot(h_last, lm8, params["lm_head_scale"],
+                            out_dtype=jnp.float32)
+    else:
+        lm_head = params.get("lm_head")
+        if lm_head is None:
+            lm_head = params["embed"].T
+        # model-dtype operands + f32 accumulation (see llama._logits)
+        logits = jnp.dot(h_last, lm_head,
+                         preferred_element_type=jnp.float32)
     cap = cfg.final_logit_softcap
     if cap:
         logits = jnp.tanh(logits / cap) * cap

@@ -171,8 +171,8 @@ def _logits(cfg: ModelConfig, params: Params, h: jnp.ndarray,
         h, last[:, None, None].astype(jnp.int32), axis=1)[:, 0]  # [B, H]
     lm8 = params.get("lm_head_q")
     if lm8 is not None:
-        return quant.qdot(h_last, lm8,
-                          params["lm_head_scale"]).astype(jnp.float32)
+        return quant.qdot(h_last, lm8, params["lm_head_scale"],
+                          out_dtype=jnp.float32)
     lm_head = params.get("lm_head")
     if lm_head is None:
         lm_head = params["embed"].T

@@ -54,13 +54,15 @@ def quantize_weight(w: jnp.ndarray, axis: int):
     return w8, scale
 
 
-def qdot(x: jnp.ndarray, w8: jnp.ndarray, w_scale: jnp.ndarray
-         ) -> jnp.ndarray:
+def qdot(x: jnp.ndarray, w8: jnp.ndarray, w_scale: jnp.ndarray,
+         out_dtype=None) -> jnp.ndarray:
     """``x @ w`` with int8 weights and dynamic per-token int8 activations.
 
     x: [..., K] (any float dtype); w8: [K, N] int8; w_scale: [N] f32.
     The int8×int8 contraction accumulates in int32 on the MXU; the two
-    scales re-enter in f32 and the result is cast back to ``x.dtype``.
+    scales re-enter in f32 and the result is cast to ``out_dtype``
+    (default ``x.dtype``). The logits call sites pass f32 so the final
+    projection keeps full-precision accumulation like the bf16 path.
     """
     xf = x.astype(jnp.float32)
     s_x = jnp.maximum(jnp.max(jnp.abs(xf), axis=-1, keepdims=True),
@@ -69,7 +71,8 @@ def qdot(x: jnp.ndarray, w8: jnp.ndarray, w_scale: jnp.ndarray
     y = jax.lax.dot_general(
         x8, w8, (((x8.ndim - 1,), (0,)), ((), ())),
         preferred_element_type=jnp.int32)                 # [..., N] i32
-    return (y.astype(jnp.float32) * s_x * w_scale).astype(x.dtype)
+    return (y.astype(jnp.float32) * s_x * w_scale).astype(
+        out_dtype or x.dtype)
 
 
 def quantize_params(params: Params) -> Params:

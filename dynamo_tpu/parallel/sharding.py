@@ -111,6 +111,32 @@ class ModelSharding:
             tp = self.mesh.shape.get("tp", 1)
             specs["lm_head"] = (P(None, "tp")
                                 if self.cfg.vocab_size % tp == 0 else P())
+        return self._add_quant_specs(specs)
+
+    def _add_quant_specs(self, specs: Dict[str, Any]) -> Dict[str, Any]:
+        """Specs for int8-quantized trees (``ops/quant.quantize_params``).
+
+        The int8 tensor shards exactly like the bf16 original; the
+        per-out-channel scale keeps the layer and out dims and drops the
+        contraction axis (axis 1 of a stacked ``[L, K, N]``, axis 0 of
+        ``lm_head``). Correctness under a SHARDED contraction (wo/w_down:
+        ``P(None, "tp", None)``): the scale multiply distributes over the
+        sum, so GSPMD may psum the int32 partials before or after the
+        rescale — both orders are exact. Extra spec keys are inert for
+        unquantized trees (``shard_params`` walks the tree's keys).
+        """
+        from dynamo_tpu.ops.quant import LAYER_WEIGHTS
+        layers = specs["layers"]
+        for name in LAYER_WEIGHTS:
+            spec = layers.get(name)
+            if spec is None or len(spec) != 3:
+                continue  # MoE 4-d expert stacks don't quantize yet
+            layers[name + "_q"] = spec
+            layers[name + "_scale"] = P(spec[0], spec[2])
+        lm = specs.get("lm_head")
+        if lm is not None:
+            specs["lm_head_q"] = lm
+            specs["lm_head_scale"] = P(lm[1]) if len(lm) == 2 else P()
         return specs
 
     def _deepseek_specs(self) -> Dict[str, Any]:

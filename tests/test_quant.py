@@ -140,15 +140,102 @@ class TestEngine:
 
         assert len(asyncio.run(go())) == 4
 
+    def test_engine_serves_int8_tp2(self):
+        """int8 composes with tensor parallelism: the sharding specs know
+        the *_q/*_scale pairs (int8 shards like the bf16 original, scales
+        drop the contraction axis), and a tp=2 engine serves greedily the
+        same tokens as the single-device int8 engine."""
+        from dynamo_tpu.engine.jax_engine import JaxEngine, JaxEngineConfig
+        from dynamo_tpu.parallel.sharding import tp_sharding
+        from dynamo_tpu.protocols.common import (
+            PreprocessedRequest,
+            SamplingOptions,
+            StopConditions,
+        )
+
+        cfg = _tiny_cfg(vocab_size=64)  # 64 % 2 == 0: lm_head shards
+        req = PreprocessedRequest(
+            token_ids=list(range(1, 20)),
+            sampling_options=SamplingOptions(temperature=0.0),
+            stop_conditions=StopConditions(max_tokens=4))
+
+        async def serve(ecfg):
+            eng = JaxEngine.random_init(cfg, ecfg)
+            toks = []
+            async for out in eng.generate(req):
+                toks.extend(out.token_ids or [])
+            await eng.stop()
+            return toks
+
+        base = dict(num_pages=32, page_size=16, max_num_seqs=2,
+                    max_prefill_chunk=32, max_context=128,
+                    attn_impl="scan", quantize="int8", seed=7)
+        ref = asyncio.run(serve(JaxEngineConfig(**base)))
+        ms = tp_sharding(cfg, 2)
+        sharded = asyncio.run(serve(JaxEngineConfig(
+            **base, shard_params_fn=ms.shard_params,
+            shard_pages_fn=ms.shard_pages)))
+        assert len(sharded) == 4
+        assert sharded == ref
+
+    def test_engine_serves_int8_gemma2(self):
+        """gemma-2's GeGLU/sandwich-norm sites dispatch through quant.mm
+        too — the family serves int8 end-to-end."""
+        from dynamo_tpu.engine.jax_engine import JaxEngine, JaxEngineConfig
+        from dynamo_tpu.protocols.common import (
+            PreprocessedRequest,
+            SamplingOptions,
+            StopConditions,
+        )
+
+        cfg = _tiny_cfg(model_type="gemma2", sliding_window=32,
+                        attn_logit_softcap=50.0, final_logit_softcap=30.0)
+        eng = JaxEngine.random_init(cfg, JaxEngineConfig(
+            num_pages=32, page_size=16, max_num_seqs=2,
+            max_prefill_chunk=32, max_context=128,
+            attn_impl="scan", quantize="int8"))
+        assert "wq_q" in eng.params["layers"]
+        req = PreprocessedRequest(
+            token_ids=list(range(1, 20)),
+            sampling_options=SamplingOptions(temperature=0.0),
+            stop_conditions=StopConditions(max_tokens=4))
+
+        async def go():
+            toks = []
+            async for out in eng.generate(req):
+                toks.extend(out.token_ids or [])
+            await eng.stop()
+            return toks
+
+        assert len(asyncio.run(go())) == 4
+
     def test_unsupported_family_rejected(self):
         from dynamo_tpu.engine.jax_engine import JaxEngine, JaxEngineConfig
 
-        cfg = _tiny_cfg(model_type="gemma2", num_heads=4, num_kv_heads=2,
-                        sliding_window=32)
+        cfg = _tiny_cfg(model_type="mixtral", num_experts=4,
+                        num_experts_per_tok=2)
         with pytest.raises(ValueError, match="llama family"):
             JaxEngine.random_init(cfg, JaxEngineConfig(
                 num_pages=16, page_size=16, max_num_seqs=2,
                 max_context=64, attn_impl="scan", quantize="int8"))
+
+    def test_custom_forward_rejected(self):
+        """Pipeline-parallel stage bodies are not quant-aware (the stage
+        tail would silently fall back to embed.T once lm_head is popped);
+        the engine must reject quantize + forward_fn instead of serving
+        wrong logits."""
+        from dynamo_tpu.engine.jax_engine import JaxEngine, JaxEngineConfig
+
+        def fake_forward(*a, **k):  # never called
+            raise AssertionError
+
+        cfg = _tiny_cfg()
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        with pytest.raises(ValueError, match="forward_fn"):
+            JaxEngine(cfg, params, JaxEngineConfig(
+                num_pages=16, page_size=16, max_num_seqs=2,
+                max_context=64, attn_impl="scan", quantize="int8"),
+                forward_fn=fake_forward)
 
     def test_bad_mode_rejected(self):
         from dynamo_tpu.engine.jax_engine import JaxEngine, JaxEngineConfig

@@ -12,10 +12,12 @@ exec 9>/tmp/bench_on_up.lock
 flock -n 9 || { echo "bench_on_up: another run holds the lock"; exit 2; }
 ts=$(date +%H%M%S)
 echo "$(date +%H:%M:%S) bench_on_up: starting bench (ts=$ts)" >> /tmp/bench_live.log
-# budget 2400: one window should fit main + attn A/B + int8 legs; the
-# child prints the main result early, so a window that closes mid-extra
-# still yields the headline number
-python bench.py --budget 2400 --tier full \
+# budget 2400 with a matching child cap: one window fits ONE child
+# running main + attn A/B + int8 legs (the default 1200 child cap would
+# split it into two from-scratch attempts); the child prints the main
+# result early, so a window that closes mid-extra still yields the
+# headline number
+BENCH_CHILD_CAP=2300 python bench.py --budget 2400 --tier full \
   > "/root/repo/BENCH_live_${ts}.json" 2>> /tmp/bench_live.log
 rc=$?
 # a live_cache re-emission is an EARLIER window's number — this window

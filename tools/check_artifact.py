@@ -3,9 +3,11 @@
 
 Usage: check_artifact.py FILE [--reject-live-cache] [--require-tier TIER]
 
-Exit 0 iff the file's LAST parseable JSON line (artifacts may hold
-per-arm/early lines above the final one, and a killed run truncates)
-says ``valid: true`` — plus any extra conditions:
+Exit 0 iff the file's LAST parseable JSON line (parsed by bench.py's own
+``_last_json_line``, so the checker can never disagree with the
+orchestrator about framing; artifacts may hold per-arm/early lines above
+the final one, and a killed run truncates) says ``valid: true`` — plus
+any extra conditions:
 
 - ``--reject-live-cache``: fail on ``source: live_cache`` re-emissions
   (an earlier window's number; the caller wants proof THIS window
@@ -15,25 +17,23 @@ says ``valid: true`` — plus any extra conditions:
 Used by tools/bench_on_up.sh (keep/drop artifacts, gate the MLA chain)
 and tools/tunnel_watch.sh (stop condition) so validity rules live once.
 """
-import json
+import os
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from bench import _last_json_line  # noqa: E402
 
 
 def main(argv) -> int:
     path = argv[1]
     flags = argv[2:]
     try:
-        lines = [ln.strip() for ln in open(path).read().splitlines()]
+        with open(path, "rb") as f:
+            r = _last_json_line(f.read())
     except OSError:
         return 1
-    r = None
-    for ln in reversed(lines):
-        if ln.startswith("{"):
-            try:
-                r = json.loads(ln)
-                break
-            except json.JSONDecodeError:
-                continue
     if not r or not r.get("valid"):
         return 1
     if "--reject-live-cache" in flags and r.get("source") == "live_cache":
