@@ -84,7 +84,12 @@ TIERS = {
 # measurements (20.4s worst compile) but tight enough that a hung tunnel
 # call dies inside the attempt instead of eating the whole budget.
 STAGE_BUDGETS = {
-    "jax_init": 80.0,
+    # r5 on-tunnel observation: an open-window init answers in ~4s; a
+    # closed window hangs forever. 100s is generous for the open case
+    # while keeping the attempt cycle short enough that a continuously
+    # looping watcher (tools/tunnel_watch.sh) lands an attempt inside a
+    # short window
+    "jax_init": 100.0,
     "engine_build": 150.0,
     "prime": 240.0,       # per program
     "warmup": 300.0,
@@ -903,7 +908,7 @@ def _progress_rank(p: dict) -> tuple:
 # checkpoint inactivity. Pre-init gets a tight window (init budget +
 # margin); later stages get the largest stage budget + margin (a compile
 # legitimately prints nothing for minutes).
-STALL_KILL_PRE_INIT_S = 100.0
+STALL_KILL_PRE_INIT_S = 130.0
 STALL_KILL_S = 340.0
 
 

@@ -99,6 +99,14 @@ class JaxEngineConfig:
     # dominant per-step cost at small batch). Disable for strict
     # step-at-a-time debugging.
     pipeline_decode: bool = True
+    # speculative decoding (engine/spec.py): n-gram prompt-lookup drafts
+    # verified K at a time in one [B, K+1] step (0 = off). Supersedes
+    # pipelined decode while on — draft proposal needs the sampled tokens
+    # on host, so steps can't chain; each step instead yields up to K+1
+    # tokens per row. Llama-family dense forwards (llama/mistral/qwen2/3).
+    spec_tokens: int = 0
+    spec_ngram_max: int = 4
+    spec_ngram_min: int = 2
     # mesh/sharding hooks (filled by dynamo_tpu.parallel when multi-chip)
     shard_params_fn: Optional[Callable] = None
     shard_pages_fn: Optional[Callable] = None
@@ -157,7 +165,10 @@ class JaxEngine(ScheduledEngineBase):
             max_prefill_chunk=self.cfg.max_prefill_chunk,
             max_context=self.cfg.max_context,
             max_prefill_seqs=self.cfg.max_prefill_seqs,
-            ring_threshold=ring_threshold)
+            ring_threshold=ring_threshold,
+            spec_tokens=int(self.cfg.spec_tokens or 0),
+            spec_ngram_max=self.cfg.spec_ngram_max,
+            spec_ngram_min=self.cfg.spec_ngram_min)
         self.params = params
         from dynamo_tpu.models import get_family
         family = get_family(model_cfg)
@@ -236,6 +247,22 @@ class JaxEngine(ScheduledEngineBase):
             self.params = self.cfg.shard_params_fn(self.params)
         if self.cfg.shard_pages_fn is not None:
             self.pages = self.cfg.shard_pages_fn(self.pages)
+        self.spec_K = int(self.cfg.spec_tokens or 0)
+        if self.spec_K:
+            import inspect
+            sig_fn = forward_fn or self._forward
+            try:
+                has_window = "logits_window" in inspect.signature(
+                    sig_fn).parameters
+            except (TypeError, ValueError):
+                has_window = False
+            if forward_fn is not None or not has_window:
+                raise ValueError(
+                    "spec_tokens>0 needs a family forward with "
+                    "logits_window support (the llama family tree: "
+                    "llama/mistral/qwen dense); custom forward_fns "
+                    f"(pipeline stages) and {model_cfg.model_type!r} "
+                    "are served without speculation")
         self.table_width = self.cfg.max_context // self.cfg.page_size
         self._rng = jax.random.PRNGKey(self.cfg.seed)
         self._step_counter = 0
@@ -247,6 +274,7 @@ class JaxEngine(ScheduledEngineBase):
         # donated — the host still fetches it after this dispatch.
         self._jit_chained = jax.jit(self._chained_step_impl,
                                     donate_argnums=(1,))
+        self._jit_spec = jax.jit(self._spec_step_impl, donate_argnums=(1,))
         self._last_packed = None  # most recent packed output (device)
         self.ring_steps = 0  # diagnostics: sequence-parallel prefills run
         self.chained_steps = 0  # diagnostics: pipelined decode steps run
@@ -339,6 +367,50 @@ class JaxEngine(ScheduledEngineBase):
         return self._step_impl(params, pages, tokens, positions, page_table,
                                total_lens, new_lens, rng, step, temperature,
                                top_k, top_p, pen)
+
+    def _spec_step_impl(self, params, pages, tokens, positions, page_table,
+                        total_lens, new_lens, rng, step, temperature, top_k,
+                        top_p):
+        """Speculative verify step: a [B, K+1] chunked forward whose
+        sampling tail rejection-samples the K drafts on device
+        (``ops/sampling.spec_verify``). tokens[:, 0] is each row's last
+        context token; tokens[:, 1:] are the drafts. Packs
+        [final_tok, final_lp_bits, n_acc, K draft_lp_bits] per row —
+        columns 0/1 line up with the normal packed layout so
+        ``fetch_packed``'s token/logprob view is shared."""
+        from dynamo_tpu.ops.sampling import spec_verify
+        (tokens, positions, page_table, total_lens, new_lens, temperature,
+         top_k, top_p) = self._shard_batch(
+            tokens, positions, page_table, total_lens, new_lens, temperature,
+            top_k, top_p)
+        attn = None
+        if self.attn_impl == "pallas":
+            from dynamo_tpu.ops.pallas.prefill import (
+                paged_prefill_attention_stacked as attn)
+        if self.attn_impl in ("scan", "pallas"):
+            logits, pages = self._forward(
+                params, self.model_cfg, tokens, positions, pages,
+                page_table, total_lens, new_lens,
+                **({"attn_impl": attn} if attn is not None else {}),
+                logits_window=tokens.shape[1])
+        else:
+            # unrolled paths: S > 1, so no decode kernel — XLA attention
+            logits, pages = self._forward_unrolled(
+                params, self.model_cfg, tokens, positions, pages,
+                page_table, total_lens, new_lens,
+                logits_window=tokens.shape[1])
+        key = jax.random.fold_in(rng, step)
+        n_acc, final_tok, final_lp, draft_lps = spec_verify(
+            logits, tokens, key, temperature, top_k, top_p)
+        bits = jax.lax.bitcast_convert_type
+        packed = jnp.concatenate(
+            [final_tok[:, None], bits(final_lp, jnp.int32)[:, None],
+             n_acc[:, None], bits(draft_lps, jnp.int32)], axis=1)
+        if self._dp > 1:
+            from jax.sharding import NamedSharding, PartitionSpec
+            packed = jax.lax.with_sharding_constraint(
+                packed, NamedSharding(self.cfg.mesh, PartitionSpec()))
+        return pages, packed, {}
 
     def _ring_step_impl(self, params, pages, tokens, positions, page_table,
                         total_lens, new_lens, rng, step, temperature, top_k,
@@ -506,6 +578,20 @@ class JaxEngine(ScheduledEngineBase):
 
     def _execute_plan(self, plan: StepPlan):
         """Build padded arrays, run the jitted step, fetch sampled tokens."""
+        from dynamo_tpu.engine.scheduler import SpecDecodeBatch
+        if isinstance(plan, SpecDecodeBatch):
+            arrays = self._spec_arrays(plan.seqs, plan.drafts)
+            plan._step_id = self._step_counter
+            if self.step_tap is not None:
+                self.step_tap("spec", arrays, self._step_counter)
+            packed = self._invoke_step("spec", arrays, self._step_counter)
+            self._step_counter += 1
+            host = np.asarray(packed)
+            sampled = host[:, 0]
+            logprobs = host[:, 1].copy().view(np.float32)
+            extras = {"spec_acc": host[:, 2],
+                      "spec_lps": host[:, 3:].copy().view(np.float32)}
+            return sampled, logprobs, extras
         P = self.table_width
         if isinstance(plan, PrefillBatch):
             chunks = plan.chunks
@@ -614,11 +700,52 @@ class JaxEngine(ScheduledEngineBase):
                     temp=temp, top_k=top_k, top_p=top_p,
                     **self._sampling_extras(seqs, B))
 
+    def _spec_arrays(self, seqs, drafts: np.ndarray) -> dict:
+        """Padded host arrays for one speculative verify step [B, K+1].
+
+        Row i feeds its last appended token at position len-1 (slot 0, the
+        token whose KV a plain decode step would write) followed by the K
+        drafts at positions len..len+K-1. total_lens covers all fed
+        positions so causal attention within the chunk sees every draft's
+        prefix; pad rows write nothing (new=0)."""
+        P = self.table_width
+        K = self.spec_K
+        B = _bucket(len(seqs), self.cfg.min_decode_bucket,
+                    self.cfg.max_num_seqs)
+        S = K + 1
+        toks = np.zeros((B, S), np.int32)
+        pos = np.zeros((B, S), np.int32)
+        table = np.zeros((B, P), np.int32)
+        total = np.ones(B, np.int32)
+        new = np.zeros(B, np.int32)
+        temp = np.zeros(B, np.float32)
+        top_k = np.zeros(B, np.int32)
+        top_p = np.ones(B, np.float32)
+        for i, seq in enumerate(seqs):
+            toks[i, 0] = seq.tokens.tokens()[-1]
+            toks[i, 1:] = drafts[i]
+            pos[i] = np.arange(len(seq) - 1, len(seq) + K)
+            table[i, :len(seq.page_ids)] = seq.page_ids
+            total[i] = len(seq) + K
+            new[i] = S
+            so = seq.request.sampling_options
+            if so.temperature is not None:
+                temp[i] = so.temperature
+            top_k[i] = so.top_k or 0
+            if so.top_p is not None:
+                top_p[i] = so.top_p
+        return dict(toks=toks, pos=pos, table=table, total=total, new=new,
+                    temp=temp, top_k=top_k, top_p=top_p)
+
     # -- pipelined decode (loop.py hooks) ----------------------------------
 
     @property
     def supports_pipelining(self) -> bool:
-        return self.cfg.pipeline_decode
+        # speculative decoding supersedes chaining: draft proposal needs
+        # the sampled tokens host-side, so steps cannot consume the
+        # previous step's on-device output — they multiply tokens/step
+        # instead of hiding the readback
+        return self.cfg.pipeline_decode and not self.spec_K
 
     def dispatch_decode(self, plan):
         """Dispatch one decode step WITHOUT fetching its results; returns
@@ -690,6 +817,15 @@ class JaxEngine(ScheduledEngineBase):
             self.pages = self._jit_scatter_pages(
                 self.pages, jnp.asarray(a["ids"]), jnp.asarray(a["vals"]))
             return None
+        if kind == "spec":
+            self.pages, packed, _aux = self._jit_spec(
+                self.params, self.pages, jnp.asarray(a["toks"]),
+                jnp.asarray(a["pos"]), jnp.asarray(a["table"]),
+                jnp.asarray(a["total"]), jnp.asarray(a["new"]),
+                self._rng, np.int32(step), jnp.asarray(a["temp"]),
+                jnp.asarray(a["top_k"]), jnp.asarray(a["top_p"]))
+            self._last_packed = packed
+            return packed
         if kind == "chained":
             prev = prev_packed if prev_packed is not None else self._last_packed
             pen = self._pen_arg(a, a["pos"].shape[0])

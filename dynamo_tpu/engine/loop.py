@@ -38,6 +38,7 @@ from dynamo_tpu.engine.scheduler import (
     Scheduler,
     SchedulerConfig,
     Sequence,
+    SpecDecodeBatch,
     StepPlan,
 )
 from dynamo_tpu.protocols.common import (
@@ -56,7 +57,9 @@ class ScheduledEngineBase(EngineBase):
     def __init__(self, num_pages: int, page_size: int, max_num_seqs: int,
                  max_prefill_chunk: int, max_context: int,
                  max_prefill_seqs: int = 8,
-                 ring_threshold: Optional[int] = None):
+                 ring_threshold: Optional[int] = None,
+                 spec_tokens: int = 0, spec_ngram_max: int = 4,
+                 spec_ngram_min: int = 2):
         if max_context % page_size:
             raise ValueError("max_context must be a multiple of page_size")
         self.max_context = max_context
@@ -64,7 +67,9 @@ class ScheduledEngineBase(EngineBase):
         self.scheduler = Scheduler(self.allocator, SchedulerConfig(
             max_num_seqs=max_num_seqs, max_prefill_chunk=max_prefill_chunk,
             max_prefill_seqs=max_prefill_seqs,
-            ring_threshold=ring_threshold))
+            ring_threshold=ring_threshold,
+            spec_tokens=spec_tokens, spec_ngram_max=spec_ngram_max,
+            spec_ngram_min=spec_ngram_min))
         self.scheduler.max_context_hint = max_context
         self._queues: Dict[str, asyncio.Queue] = {}
         self._work = asyncio.Event()
@@ -162,9 +167,80 @@ class ScheduledEngineBase(EngineBase):
             token_ids=[token], log_probs=[logprob],
             top_logprobs=[top] if top is not None else None))
 
+    def _plan_spec_appends(self, seq: Sequence,
+                           cand: List[Tuple[int, float]]
+                           ) -> Tuple[List[Tuple[int, float]], int]:
+        """Stop-aware truncation of one row's verify-step candidates
+        (accepted drafts + the final sampled token), WITHOUT mutating the
+        sequence: returns (tokens to append, count that are drafts).
+        Mirrors ``_accept_token``'s stop checks exactly — the subsequent
+        real appends re-derive the same conclusions from the same data;
+        keep the two in sync."""
+        sc = seq.request.stop_conditions
+        req = seq.request
+        n_gen, length = len(seq.generated), len(seq)
+        max_new = sc.max_tokens if sc.max_tokens is not None else (
+            self.max_context - seq.num_prompt)
+        out: List[Tuple[int, float]] = []
+        n_draft = 0
+        for idx, (tok, lp) in enumerate(cand):
+            out.append((tok, lp))
+            if idx < len(cand) - 1:
+                n_draft += 1
+            n_gen += 1
+            length += 1
+            min_ok = sc.min_tokens is None or n_gen >= sc.min_tokens
+            if ((not sc.ignore_eos and min_ok and tok in req.eos_token_ids)
+                    or (min_ok and sc.stop_token_ids
+                        and tok in sc.stop_token_ids)
+                    or n_gen >= max_new or length >= self.max_context):
+                break
+        return out, n_draft
+
+    def _process_spec(self, plan: SpecDecodeBatch, sampled: np.ndarray,
+                      logprobs: np.ndarray, extras: dict) -> None:
+        """Resolve one verify step: advance KV accounting over each row's
+        accepted prefix, then append accepted drafts + the final token."""
+        acc = extras["spec_acc"]
+        dlps = extras["spec_lps"]
+        advances: List[int] = []
+        appends: List[Optional[List[Tuple[int, float]]]] = []
+        for i, seq in enumerate(plan.seqs):
+            if seq.phase is not Phase.RUNNING or seq.cancelled:
+                # as the plain decode path: slot 0's KV (the real last
+                # token) is computed; nothing is appended
+                advances.append(1)
+                appends.append(None)
+                continue
+            cand = [(int(plan.drafts[i, j]), float(dlps[i, j]))
+                    for j in range(int(acc[i]))]
+            cand.append((int(sampled[i]), float(logprobs[i])))
+            toks, n_draft = self._plan_spec_appends(seq, cand)
+            advances.append(1 + n_draft)
+            appends.append(toks)
+        self.scheduler.on_spec_done(plan, advances)
+        for seq, toks in zip(plan.seqs, appends):
+            if toks is None:
+                if seq.cancelled and seq.phase is Phase.RUNNING:
+                    self._finish(seq, FinishReason.CANCELLED)
+                continue
+            for tok, lp in toks:
+                self._accept_token(seq, tok, lp)
+                if seq.phase is not Phase.RUNNING:
+                    break
+        events = self.allocator.drain_events()
+        if events and self.kv_event_cb is not None:
+            self.kv_event_cb(events)
+        if self.step_outcome_cb is not None:
+            self.step_outcome_cb(getattr(plan, "_step_id", None), True)
+
     def _process(self, plan: StepPlan, sampled: np.ndarray,
                  logprobs: np.ndarray,
                  extras: Optional[dict] = None) -> None:
+        if isinstance(plan, SpecDecodeBatch):
+            self._process_spec(plan, sampled, logprobs, extras)
+            return
+
         def top_for(i: int, seq: Sequence) -> Optional[Dict[int, float]]:
             # host dict building + per-token wire bytes only for requests
             # that asked (the device-side top-k is compiled in regardless)

@@ -35,9 +35,17 @@ from dataclasses import dataclass, field
 from enum import Enum
 from typing import Deque, Dict, List, Optional, Union
 
+import numpy as np
+
 from dynamo_tpu.engine.pages import OutOfPages, PageAllocator
+from dynamo_tpu.engine.spec import propose_ngram
 from dynamo_tpu.protocols.common import PreprocessedRequest
-from dynamo_tpu.protocols.events import ForwardPassMetrics, KvStats, WorkerStats
+from dynamo_tpu.protocols.events import (
+    ForwardPassMetrics,
+    KvStats,
+    SpecDecodeStats,
+    WorkerStats,
+)
 from dynamo_tpu.tokens import TokenBlockSequence
 
 
@@ -110,7 +118,23 @@ class DecodeBatch:
     seqs: List[Sequence]
 
 
-StepPlan = Union[PrefillBatch, DecodeBatch]
+@dataclass
+class SpecDecodeBatch:
+    """One speculative verify step over the running batch ([B, K+1] on
+    device): row i feeds its last context token plus ``drafts[i]`` and the
+    device verifies the drafts by exact rejection sampling
+    (``ops/sampling.spec_verify``). Emitted instead of a DecodeBatch when
+    speculation is enabled, every row is spec-eligible, and at least one
+    row produced a real n-gram draft (rows without a match carry padding
+    drafts — the step shape is uniform and their acceptance just stops
+    early)."""
+
+    seqs: List[Sequence]
+    drafts: np.ndarray          # [len(seqs), K] int32
+    has_draft: List[bool] = field(default_factory=list)  # real match per row
+
+
+StepPlan = Union[PrefillBatch, DecodeBatch, SpecDecodeBatch]
 
 
 @dataclass
@@ -129,6 +153,11 @@ class SchedulerConfig:
     # idle across many steps — a burst of long prompts could otherwise
     # starve decode growth and trigger preemption storms (ADVICE r2)
     max_ring_seqs: int = 2
+    # speculative decoding (engine/spec.py): drafts per verify step
+    # (0 = off) and the n-gram match sizes for the prompt-lookup proposer
+    spec_tokens: int = 0
+    spec_ngram_max: int = 4
+    spec_ngram_min: int = 2
 
 
 class Scheduler:
@@ -153,6 +182,9 @@ class Scheduler:
         # this to emit their CANCELLED frames (otherwise the caller's stream
         # would never terminate)
         self.reaped: List[Sequence] = []
+        # speculative-decode acceptance counters (reference surface:
+        # SpecDecodeStats in the metrics plane, protocols/events.py)
+        self.spec_stats = SpecDecodeStats()
 
     def drain_reaped(self) -> List[Sequence]:
         out, self.reaped = self.reaped, []
@@ -386,7 +418,96 @@ class Scheduler:
         ready = [s for s in ready if s.phase == Phase.RUNNING]
         if not ready:
             return None
+        if self.cfg.spec_tokens > 0:
+            spec = self._spec_plan(ready)
+            if spec is not None:
+                return spec
         return DecodeBatch(seqs=ready)
+
+    # -- speculative decoding ----------------------------------------------
+
+    @staticmethod
+    def _spec_eligible(seq: Sequence) -> bool:
+        """Rows whose sampling the verify step reproduces exactly.
+
+        Penalties / logit_bias mutate logits from host bookkeeping that
+        goes stale within a multi-token step; per-request seeds key their
+        randomness on a single token position; the top-K-alternatives
+        logprobs surface isn't packed by the verify step. Any such row
+        sends the whole batch down the plain decode path (same rule as
+        ``plan_chained``)."""
+        so = seq.request.sampling_options
+        rep_on = (so.repetition_penalty is not None
+                  and so.repetition_penalty > 0
+                  and so.repetition_penalty != 1.0)
+        return not (so.frequency_penalty or so.presence_penalty or rep_on
+                    or so.logit_bias or so.seed is not None or so.min_p
+                    or so.logprobs is not None)
+
+    def _spec_plan(self, ready: List[Sequence]) -> Optional[SpecDecodeBatch]:
+        """Try to upgrade this decode step to a [B, K+1] verify step."""
+        K = self.cfg.spec_tokens
+        if not all(self._spec_eligible(s) for s in ready):
+            return None
+        # context-ceiling guard (as plan_chained's): the verify step feeds
+        # positions len .. len+K-1 and needs pages/table slots for len+K
+        # tokens — a row within K of max_context would overrun the static
+        # page-table width (and the positions themselves). Those rows are
+        # about to finish; the plain decode step handles them.
+        if self.max_context_hint is not None and any(
+                len(s) + K >= self.max_context_hint for s in ready):
+            return None
+        drafts = np.zeros((len(ready), K), np.int32)
+        has = [False] * len(ready)
+        for i, seq in enumerate(ready):
+            d = propose_ngram(seq.tokens.tokens(), K,
+                              max_n=self.cfg.spec_ngram_max,
+                              min_n=self.cfg.spec_ngram_min)
+            if d is not None:
+                drafts[i] = d
+                has[i] = True
+            else:
+                # no match: pad with the last context token — the row still
+                # gets its guaranteed one token from slot 0, and rejection
+                # costs nothing the step isn't already spending
+                drafts[i] = seq.tokens.tokens()[-1]
+        if not any(has):
+            return None
+        # grow pages for the +K lookahead (positions len .. len+K-1). No
+        # preemption on this path — evicting a row already planned into
+        # this very batch would corrupt it; on pressure we just fall back
+        # to the plain decode step, which needs no extra pages. Pages
+        # allocated before the failure stay with their sequences (they are
+        # the very next pages those rows will use anyway).
+        for seq in ready:
+            need = self._pages_needed(len(seq) + K) - len(seq.page_ids)
+            if need > 0:
+                try:
+                    seq.page_ids.extend(self.alloc.allocate(need))
+                except OutOfPages:
+                    return None
+        return SpecDecodeBatch(seqs=list(ready), drafts=drafts, has_draft=has)
+
+    def on_spec_done(self, plan: SpecDecodeBatch,
+                     advances: List[int]) -> None:
+        """Advance accounting after a verify step.
+
+        ``advances[i]`` = 1 (the fed context token's KV at slot 0) + the
+        number of drafts row i actually APPENDED (accepted, then possibly
+        truncated by a stop). Slots past the advance hold rejected drafts'
+        KV — never committed (num_computed stops short), overwritten by the
+        next step that reaches those positions, and masked from attention
+        by true context length in between."""
+        for seq, adv in zip(plan.seqs, advances):
+            seq.num_computed += adv
+            self._commit_full_pages(seq)
+        K = self.cfg.spec_tokens
+        self.spec_stats.num_spec_tokens = K
+        self.spec_stats.num_drafts += sum(1 for h in plan.has_draft if h)
+        self.spec_stats.num_draft_tokens += K * sum(
+            1 for h in plan.has_draft if h)
+        self.spec_stats.num_accepted_tokens += sum(
+            max(0, a - 1) for a, h in zip(advances, plan.has_draft) if h)
 
     def plan_chained(self, prev: DecodeBatch) -> Optional[DecodeBatch]:
         """Plan decode step N+1 while step N's results are still on device.
@@ -484,8 +605,10 @@ class Scheduler:
                 gpu_cache_usage_perc=self.alloc.usage(),
                 gpu_prefix_cache_hit_rate=(hits / lookups) if lookups else 0.0,
             ),
+            spec_decode_stats=(self.spec_stats
+                               if self.cfg.spec_tokens > 0 else None),
         )
 
 
 __all__ = ["Scheduler", "SchedulerConfig", "Sequence", "Phase",
-           "PrefillChunk", "PrefillBatch", "DecodeBatch"]
+           "PrefillChunk", "PrefillBatch", "DecodeBatch", "SpecDecodeBatch"]

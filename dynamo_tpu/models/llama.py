@@ -164,14 +164,26 @@ def _finish_layer(cfg: ModelConfig, lp: Dict[str, jnp.ndarray],
 
 
 def _logits(cfg: ModelConfig, params: Params, h: jnp.ndarray,
-            new_lens: jnp.ndarray) -> jnp.ndarray:
+            new_lens: jnp.ndarray, window: int = 1) -> jnp.ndarray:
+    """Logits at each row's last ``window`` real new positions.
+
+    window == 1 (every normal step) returns [B, V]; window = W > 1 (the
+    speculative-verify step, which samples at all K+1 chunk slots) returns
+    [B, W, V]. Only W rows of hidden state hit the lm_head either way —
+    full [B, S, V] materialization stays off the table.
+    """
     h = _rms_norm(h, params["final_norm"], cfg.rms_norm_eps)
-    last = jnp.maximum(new_lens - 1, 0)                    # [B]
-    h_last = jnp.take_along_axis(
-        h, last[:, None, None].astype(jnp.int32), axis=1)[:, 0]  # [B, H]
+    if window == 1:
+        last = jnp.maximum(new_lens - 1, 0)                # [B]
+        h_sel = jnp.take_along_axis(
+            h, last[:, None, None].astype(jnp.int32), axis=1)[:, 0]  # [B, H]
+    else:
+        offs = jnp.arange(window, dtype=jnp.int32)[None, :]          # [1, W]
+        idx = jnp.maximum(new_lens[:, None] - window + offs, 0)      # [B, W]
+        h_sel = jnp.take_along_axis(h, idx[..., None], axis=1)       # [B,W,H]
     lm8 = params.get("lm_head_q")
     if lm8 is not None:
-        return quant.qdot(h_last, lm8, params["lm_head_scale"],
+        return quant.qdot(h_sel, lm8, params["lm_head_scale"],
                           out_dtype=jnp.float32)
     lm_head = params.get("lm_head")
     if lm_head is None:
@@ -179,14 +191,15 @@ def _logits(cfg: ModelConfig, params: Params, h: jnp.ndarray,
     # operands stay in the model dtype with f32 ACCUMULATION: casting
     # lm_head to f32 would double its HBM stream (the largest single
     # tensor of a decode step) and push the matmul off the bf16 MXU path
-    return jnp.dot(h_last, lm_head, preferred_element_type=jnp.float32)
+    return jnp.dot(h_sel, lm_head, preferred_element_type=jnp.float32)
 
 
 def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
             positions: jnp.ndarray, pages: jnp.ndarray,
             page_table: jnp.ndarray, total_lens: jnp.ndarray,
             new_lens: jnp.ndarray,
-            attn_impl: Optional[Callable] = None
+            attn_impl: Optional[Callable] = None,
+            logits_window: int = 1
             ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Scan-over-layers forward against the stacked paged cache.
 
@@ -222,7 +235,7 @@ def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     (h, pages), _ = jax.lax.scan(
         body, (h, pages),
         (params["layers"], jnp.arange(cfg.num_layers)))
-    return _logits(cfg, params, h, new_lens), pages
+    return _logits(cfg, params, h, new_lens, window=logits_window), pages
 
 
 def encode(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
@@ -267,7 +280,8 @@ def forward_unrolled(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
                      positions: jnp.ndarray, pages_list: List[jnp.ndarray],
                      page_table: jnp.ndarray, total_lens: jnp.ndarray,
                      new_lens: jnp.ndarray,
-                     attn_impl: Optional[Callable] = None
+                     attn_impl: Optional[Callable] = None,
+                     logits_window: int = 1
                      ) -> Tuple[jnp.ndarray, List[jnp.ndarray]]:
     """Unrolled forward over per-layer KV buffers (Pallas-kernel path).
 
@@ -287,7 +301,7 @@ def forward_unrolled(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
         attn = attn_impl(q, kv, page_table, positions, total_lens, sm_scale)
         h = _finish_layer(cfg, lp, h, attn)
         out_pages.append(kv)
-    return _logits(cfg, params, h, new_lens), out_pages
+    return _logits(cfg, params, h, new_lens, window=logits_window), out_pages
 
 
 __all__ = ["init_params", "forward", "forward_unrolled", "encode",

@@ -88,6 +88,42 @@ def apply_penalties(logits: jnp.ndarray, pen_ids: jnp.ndarray,
     return logits.at[rows, pen_ids].add(delta)
 
 
+def _masked_candidates(logits: jnp.ndarray, temperature: jnp.ndarray,
+                       top_k: jnp.ndarray, top_p: jnp.ndarray,
+                       min_p: Optional[jnp.ndarray] = None):
+    """Shared candidate filter of every sampling path.
+
+    logits: [R, V] f32; per-row temperature/top_k/top_p ([R]).
+    Returns (scaled [R, k], top_idx [R, k]) where ``scaled`` is the
+    temperature-scaled logits over the top ``k`` candidates with the
+    per-row top-k / top-p / min-p rejects set to -inf — ``softmax(scaled)``
+    is the exact distribution sampling draws from, and Gumbel-argmax over
+    ``scaled`` draws from it without materializing the softmax.
+    """
+    R, V = logits.shape
+    k = min(TOPK_MAX, V)
+    top_vals, top_idx = jax.lax.top_k(logits, k)          # [R, k]
+
+    ranks = jnp.arange(k)[None, :]                        # [1, k]
+    eff_k = jnp.where(top_k > 0, jnp.minimum(top_k, k), k)  # [R]
+    keep = ranks < eff_k[:, None]
+
+    temp = jnp.maximum(temperature, 1e-6)[:, None]
+    scaled = top_vals / temp
+    scaled = jnp.where(keep, scaled, -jnp.inf)
+    probs = jax.nn.softmax(scaled, axis=-1)
+    # top-p: keep the smallest prefix of candidates whose cumulative
+    # probability reaches top_p (always keep the first).
+    cum = jnp.cumsum(probs, axis=-1)
+    keep_p = (cum - probs) < top_p[:, None]
+    if min_p is not None:
+        # min_p (vLLM semantics): drop candidates whose post-temperature
+        # probability falls below min_p x the best candidate's (0 = off;
+        # candidate 0 always survives: probs[...,0] is the max)
+        keep_p &= probs >= min_p[:, None] * probs[:, :1]
+    return jnp.where(keep_p, scaled, -jnp.inf), top_idx
+
+
 def sample_tokens(logits: jnp.ndarray, rng: jax.Array,
                   temperature: jnp.ndarray, top_k: jnp.ndarray,
                   top_p: jnp.ndarray, seeds: Optional[jnp.ndarray] = None,
@@ -113,26 +149,8 @@ def sample_tokens(logits: jnp.ndarray, rng: jax.Array,
     logits = logits.astype(jnp.float32)
     B, V = logits.shape
     k = min(TOPK_MAX, V)
-    top_vals, top_idx = jax.lax.top_k(logits, k)          # [B, k]
-
-    ranks = jnp.arange(k)[None, :]                        # [1, k]
-    eff_k = jnp.where(top_k > 0, jnp.minimum(top_k, k), k)  # [B]
-    keep = ranks < eff_k[:, None]
-
-    temp = jnp.maximum(temperature, 1e-6)[:, None]
-    scaled = top_vals / temp
-    scaled = jnp.where(keep, scaled, -jnp.inf)
-    probs = jax.nn.softmax(scaled, axis=-1)
-    # top-p: keep the smallest prefix of candidates whose cumulative
-    # probability reaches top_p (always keep the first).
-    cum = jnp.cumsum(probs, axis=-1)
-    keep_p = (cum - probs) < top_p[:, None]
-    if min_p is not None:
-        # min_p (vLLM semantics): drop candidates whose post-temperature
-        # probability falls below min_p x the best candidate's (0 = off;
-        # candidate 0 always survives: probs[...,0] is the max)
-        keep_p &= probs >= min_p[:, None] * probs[:, :1]
-    scaled = jnp.where(keep_p, scaled, -jnp.inf)
+    scaled, top_idx = _masked_candidates(logits, temperature, top_k, top_p,
+                                         min_p)
 
     if seeds is None:
         gumbel = jax.random.gumbel(rng, (B, k), dtype=jnp.float32)
@@ -162,5 +180,85 @@ def sample_tokens(logits: jnp.ndarray, rng: jax.Array,
     return tokens.astype(jnp.int32), chosen_logit - logz
 
 
+def spec_verify(logits: jnp.ndarray, tokens: jnp.ndarray, rng: jax.Array,
+                temperature: jnp.ndarray, top_k: jnp.ndarray,
+                top_p: jnp.ndarray):
+    """Exact rejection-sampling verification of drafted tokens, one pass.
+
+    The speculative-decode acceptance rule (Leviathan et al.) with a
+    DETERMINISTIC proposal (the n-gram draft is a point mass): draft ``d``
+    at a position with target distribution ``p`` is accepted with
+    probability ``p(d)``, and on rejection the replacement is drawn from
+    ``p`` with ``d`` excluded, renormalized — together these sample exactly
+    from ``p``. Greedy rows (temperature 0) degenerate to "accept while the
+    draft equals the argmax", so greedy output is bit-identical with
+    speculation on or off. ``p`` here is the FILTERED distribution
+    (temperature/top-k/top-p via ``_masked_candidates``) — the same one
+    ``sample_tokens`` draws from.
+
+    logits: [B, S, V] — logits[:, j] is the next-token distribution after
+            consuming chunk slot j (predicts the token at slot j+1)
+    tokens: [B, S] the fed tokens; tokens[:, 0] is the last accepted
+            context token, tokens[:, j] (j >= 1) is draft j
+    returns (n_acc [B] i32 accepted drafts in [0, K],
+             final_tok [B] i32 — the rejection replacement, or the bonus
+             token sampled after all K drafts accepted,
+             final_lp [B] f32 logprob of final_tok under its UNfiltered
+             row logits (OpenAI logprob semantics, as sample_tokens),
+             draft_lps [B, K] f32 logprobs of each draft at its position)
+    """
+    lf = logits.astype(jnp.float32)
+    B, S, V = lf.shape
+    K = S - 1
+    k = min(TOPK_MAX, V)
+    rep = lambda a: jnp.repeat(a, S, axis=0)  # noqa: E731  [B] -> [B*S]
+    scaled, top_idx = _masked_candidates(
+        lf.reshape(B * S, V), rep(temperature), rep(top_k), rep(top_p))
+    scaled = scaled.reshape(B, S, k)
+    top_idx = top_idx.reshape(B, S, k)
+    q = jax.nn.softmax(scaled, axis=-1)                   # filtered probs
+
+    drafts = tokens[:, 1:]                                # [B, K]
+    in_cand = top_idx[:, :K] == drafts[..., None]         # [B, K, k]
+    p_draft = jnp.sum(jnp.where(in_cand, q[:, :K], 0.0), axis=-1)
+
+    k_u, k_g = jax.random.split(jax.random.fold_in(rng, 0x5bec))
+    u = jax.random.uniform(k_u, (B, K), dtype=jnp.float32)
+    greedy = (temperature <= 0.0)[:, None]
+    acc = jnp.where(greedy, drafts == top_idx[:, :K, 0], u < p_draft)
+    n_acc = jnp.sum(jnp.cumprod(acc.astype(jnp.int32), axis=1),
+                    axis=1).astype(jnp.int32)             # [B] in [0, K]
+
+    # final token from chunk slot n_acc: the rejection position, or slot K
+    # (the bonus draw) when everything was accepted
+    sel = n_acc[:, None, None]
+    scaled_a = jnp.take_along_axis(scaled, sel, axis=1)[:, 0]   # [B, k]
+    idx_a = jnp.take_along_axis(top_idx, sel, axis=1)[:, 0]     # [B, k]
+    d_rej = jnp.take_along_axis(drafts, jnp.minimum(n_acc, K - 1)[:, None],
+                                axis=1)[:, 0] if K > 0 else None
+    if d_rej is not None:
+        # residual of a rejection excludes the draft; a bonus draw does not
+        excl = (idx_a == d_rej[:, None]) & (n_acc < K)[:, None]
+        scaled_a = jnp.where(excl, -jnp.inf, scaled_a)
+    gumbel = jax.random.gumbel(k_g, (B, k), dtype=jnp.float32)
+    choice = jnp.argmax(scaled_a + gumbel, axis=-1)
+    # greedy: candidate 0 is correct for both cases — a greedy rejection
+    # means the draft was NOT candidate 0, so the exclusion never hides it
+    choice = jnp.where(temperature <= 0.0, 0, choice)
+    final_tok = jnp.take_along_axis(idx_a, choice[:, None], axis=1)[:, 0]
+
+    logz = jax.nn.logsumexp(lf, axis=-1)                  # [B, S]
+    if K > 0:
+        d_logit = jnp.take_along_axis(lf[:, :K], drafts[..., None],
+                                      axis=2)[..., 0]     # [B, K]
+        draft_lps = d_logit - logz[:, :K]
+    else:
+        draft_lps = jnp.zeros((B, 0), jnp.float32)
+    lf_a = jnp.take_along_axis(lf, sel, axis=1)[:, 0]     # [B, V]
+    logz_a = jnp.take_along_axis(logz, n_acc[:, None], axis=1)[:, 0]
+    f_logit = jnp.take_along_axis(lf_a, final_tok[:, None], axis=1)[:, 0]
+    return (n_acc, final_tok.astype(jnp.int32), f_logit - logz_a, draft_lps)
+
+
 __all__ = ["SamplingParamsBatch", "sample_tokens", "apply_penalties",
-           "TOPK_MAX"]
+           "spec_verify", "TOPK_MAX"]

@@ -91,6 +91,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--num-top-logprobs", type=int, default=8,
                    help="alternatives computed per sampled token (serves "
                         "OpenAI top_logprobs up to this; 0 disables)")
+    p.add_argument("--speculative-num-tokens", type=int, default=0,
+                   help="n-gram prompt-lookup speculative decoding: "
+                        "drafts verified per [B, K+1] step (0 disables; "
+                        "llama-family dense models; supersedes pipelined "
+                        "decode — engine/spec.py)")
+    p.add_argument("--speculative-ngram-max", type=int, default=4,
+                   help="largest context-suffix n-gram the prompt-lookup "
+                        "proposer matches")
+    p.add_argument("--speculative-ngram-min", type=int, default=2,
+                   help="smallest n-gram worth matching (1 is aggressive)")
     p.add_argument("--no-kv-events", action="store_true")
     p.add_argument("--num-nodes", type=int, default=1,
                    help="multi-host: total processes in the jax world")
@@ -147,7 +157,10 @@ def build_engine(args: argparse.Namespace) -> JaxEngine:
         max_prefill_chunk=args.max_prefill_chunk,
         max_context=min(args.max_context, cfg.max_position_embeddings),
         num_top_logprobs=args.num_top_logprobs,
-        attn_impl=args.attn_impl, quantize=args.quantize)
+        attn_impl=args.attn_impl, quantize=args.quantize,
+        spec_tokens=args.speculative_num_tokens,
+        spec_ngram_max=args.speculative_ngram_max,
+        spec_ngram_min=args.speculative_ngram_min)
     forward_fn = None
     pp = args.pipeline_parallel_size
     if pp > 1:

@@ -1,0 +1,350 @@
+"""Speculative decoding: n-gram proposer, on-device rejection-sampling
+verification, engine end-to-end equivalence, and acceptance metrics.
+
+Model for coverage: the reference serves speculation through its engines'
+configs (``components/backends/trtllm/engine_configs/llama4/eagle/``,
+``.../deepseek_r1/mtp/``) and surfaces ``SpecDecodeStats``; here the loop is
+engine-native (``engine/spec.py``, ``ops/sampling.spec_verify``), so the
+tests pin the two invariants that make speculation safe to turn on:
+
+- greedy output is BIT-IDENTICAL with speculation on or off (acceptance is
+  "draft == argmax", rejection replacement is the argmax), and
+- stops (EOS / stop ids / max_tokens) truncate inside an accepted run
+  exactly where the unspeculated stream would stop.
+"""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine.jax_engine import JaxEngine, JaxEngineConfig
+from dynamo_tpu.engine.spec import propose_ngram
+from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.ops.sampling import spec_verify
+from dynamo_tpu.protocols.common import (
+    FinishReason,
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+
+
+# ------------------------------------------------------------- proposer
+
+class TestProposeNgram:
+    def test_repeating_context_drafts_continuation(self):
+        # ... 5 6 7 8 | 5 6 7 -> the 4-gram isn't there, the 3-gram
+        # [5, 6, 7] recurs; continuation after it is [8, 5, 6, ...]
+        toks = [5, 6, 7, 8, 5, 6, 7]
+        assert propose_ngram(toks, k=3) == [8, 5, 6]
+
+    def test_most_recent_occurrence_wins(self):
+        # suffix [1, 2] occurs twice earlier with different continuations;
+        # the later one (-> 9) must win
+        toks = [1, 2, 7, 0, 1, 2, 9, 3, 1, 2]
+        assert propose_ngram(toks, k=1) == [9]
+
+    def test_no_match_returns_none(self):
+        assert propose_ngram([1, 2, 3, 4, 5], k=3) is None
+
+    def test_short_context_returns_none(self):
+        assert propose_ngram([1, 2], k=3, min_n=2) is None
+
+    def test_draft_padding_repeats_last(self):
+        # the continuation after the match runs out before k tokens: the
+        # final drafted token is repeated to keep the step shape static
+        toks = [3, 4, 3, 4]
+        assert propose_ngram(toks, k=3, min_n=2) == [3, 4, 4]
+
+    def test_min_n_gate(self):
+        # only a 1-gram repeats; min_n=2 must refuse it
+        toks = [9, 1, 2, 3, 9]
+        assert propose_ngram(toks, k=2, min_n=2) is None
+        assert propose_ngram(toks, k=2, min_n=1) == [1, 2]
+
+
+# ------------------------------------------------------------- verifier
+
+def _mk_logits(B, S, V, peaked_at=None, seed=0):
+    rng = np.random.default_rng(seed)
+    logits = rng.normal(size=(B, S, V)).astype(np.float32)
+    if peaked_at is not None:
+        for b in range(B):
+            for s in range(S):
+                logits[b, s, peaked_at[b][s]] += 50.0
+    return jnp.asarray(logits)
+
+
+class TestSpecVerify:
+    def test_greedy_accepts_argmax_prefix(self):
+        B, K, V = 2, 3, 32
+        # row 0: drafts equal the argmax chain -> all accepted, bonus is
+        # the argmax of the final slot; row 1: first draft wrong -> 0
+        # accepted, final token is slot 0's argmax
+        peak = [[7, 11, 13, 21], [5, 9, 9, 9]]
+        logits = _mk_logits(B, K + 1, V, peaked_at=peak)
+        tokens = jnp.asarray([[1, 7, 11, 13], [1, 0, 9, 9]], jnp.int32)
+        n_acc, final, final_lp, dlps = spec_verify(
+            logits, tokens, jax.random.PRNGKey(0),
+            jnp.zeros(B, jnp.float32), jnp.zeros(B, jnp.int32),
+            jnp.ones(B, jnp.float32))
+        assert n_acc.tolist() == [3, 0]
+        assert final.tolist() == [21, 5]
+        # accepted drafts are near-certain under the peaked logits
+        assert float(dlps[0, 0]) > -1e-3
+        assert float(final_lp[1]) > -1e-3
+
+    def test_certain_draft_always_accepted_at_temperature(self):
+        B, K, V = 1, 2, 16
+        peak = [[3, 4, 5]]
+        logits = _mk_logits(B, K + 1, V, peaked_at=peak)
+        tokens = jnp.asarray([[0, 3, 4]], jnp.int32)
+        for s in range(5):
+            n_acc, final, _, _ = spec_verify(
+                logits, tokens, jax.random.PRNGKey(s),
+                jnp.ones(B, jnp.float32), jnp.zeros(B, jnp.int32),
+                jnp.ones(B, jnp.float32))
+            assert int(n_acc[0]) == 2
+            assert int(final[0]) == 5
+
+    def test_impossible_draft_rejected_and_excluded(self):
+        B, K, V = 1, 2, 16
+        peak = [[3, 4, 5]]
+        logits = _mk_logits(B, K + 1, V, peaked_at=peak)
+        tokens = jnp.asarray([[0, 9, 4]], jnp.int32)  # draft 9 has ~0 prob
+        for s in range(5):
+            n_acc, final, _, _ = spec_verify(
+                logits, tokens, jax.random.PRNGKey(s),
+                jnp.ones(B, jnp.float32), jnp.zeros(B, jnp.int32),
+                jnp.ones(B, jnp.float32))
+            assert int(n_acc[0]) == 0
+            # replacement comes from slot 0's residual (draft excluded)
+            assert int(final[0]) != 9
+
+    def test_acceptance_rate_tracks_draft_probability(self):
+        # two-candidate logits: p(draft) = 0.7; over many keys the
+        # acceptance frequency must approach it (exactness of the
+        # rejection rule, not a smoke test)
+        V = 8
+        base = np.full(V, -1e9, np.float32)
+        base[3] = np.log(0.7)
+        base[5] = np.log(0.3)
+        logits = jnp.asarray(np.tile(base, (1, 2, 1)))
+        tokens = jnp.asarray([[0, 3]], jnp.int32)
+        hits = 0
+        N = 400
+        for s in range(N):
+            n_acc, _, _, _ = spec_verify(
+                logits, tokens, jax.random.PRNGKey(s),
+                jnp.ones(1, jnp.float32), jnp.zeros(1, jnp.int32),
+                jnp.ones(1, jnp.float32))
+            hits += int(n_acc[0])
+        assert abs(hits / N - 0.7) < 0.08
+
+    def test_rejection_residual_excludes_draft_only(self):
+        # p = {3: 0.6, 5: 0.4}; draft 5. When rejected, replacement must
+        # be 3 (the only other candidate)
+        V = 8
+        base = np.full(V, -1e9, np.float32)
+        base[3] = np.log(0.6)
+        base[5] = np.log(0.4)
+        logits = jnp.asarray(np.tile(base, (1, 2, 1)))
+        tokens = jnp.asarray([[0, 5]], jnp.int32)
+        for s in range(50):
+            n_acc, final, _, _ = spec_verify(
+                logits, tokens, jax.random.PRNGKey(s),
+                jnp.ones(1, jnp.float32), jnp.zeros(1, jnp.int32),
+                jnp.ones(1, jnp.float32))
+            if int(n_acc[0]) == 0:
+                assert int(final[0]) == 3
+
+
+# ------------------------------------------------------------- engine e2e
+
+def spec_engine(spec_tokens=3, **kw):
+    cfg = ModelConfig.tiny()
+    defaults = dict(num_pages=64, page_size=4, max_num_seqs=4,
+                    max_prefill_chunk=16, max_context=64,
+                    min_prefill_bucket=4, spec_tokens=spec_tokens,
+                    spec_ngram_min=1)
+    defaults.update(kw)
+    return JaxEngine.random_init(cfg, JaxEngineConfig(**defaults))
+
+
+def make_req(tokens, rid="r1", max_tokens=8, temperature=0.0, **kw):
+    return PreprocessedRequest(
+        token_ids=list(tokens), request_id=rid,
+        stop_conditions=StopConditions(max_tokens=max_tokens, **kw),
+        sampling_options=SamplingOptions(temperature=temperature),
+        eos_token_ids=[0])
+
+
+async def collect(engine, req):
+    frames = []
+    async for out in engine.generate(req):
+        frames.append(out)
+    return frames
+
+
+async def _greedy_tokens(eng, prompt, rid, max_tokens=10):
+    req = make_req(prompt, rid, max_tokens=max_tokens)
+    req.eos_token_ids = []
+    frames = await collect(eng, req)
+    assert frames[-1].finish_reason == FinishReason.LENGTH
+    return [t for f in frames for t in f.token_ids]
+
+
+PROMPT = [5, 6, 7, 8, 5, 6, 7, 8, 5, 6, 7]  # repetitive -> n-gram hits
+
+
+class TestEngineSpecDecode:
+    async def test_greedy_identical_with_and_without_spec(self):
+        base = spec_engine(spec_tokens=0)
+        try:
+            want = await _greedy_tokens(base, PROMPT, "base")
+        finally:
+            await base.stop()
+        eng = spec_engine(spec_tokens=3)
+        try:
+            got = await _greedy_tokens(eng, PROMPT, "spec")
+        finally:
+            await eng.stop()
+        assert got == want
+
+    async def test_forced_perfect_drafts_accept_and_match(self, monkeypatch):
+        """Drive the proposer with the true greedy continuation: every
+        draft accepts, the output still matches, and the acceptance
+        counters prove the multi-token path actually ran."""
+        base = spec_engine(spec_tokens=0)
+        try:
+            want = await _greedy_tokens(base, PROMPT, "base", max_tokens=9)
+        finally:
+            await base.stop()
+        full = list(PROMPT) + want
+
+        def oracle(tokens, k, max_n=4, min_n=2):
+            n = len(tokens)
+            if n >= len(full) or list(tokens) != full[:n]:
+                return None
+            cont = full[n:n + k]
+            while len(cont) < k:
+                cont.append(cont[-1])
+            return cont
+
+        import dynamo_tpu.engine.scheduler as sched_mod
+        monkeypatch.setattr(sched_mod, "propose_ngram", oracle)
+        eng = spec_engine(spec_tokens=3)
+        try:
+            got = await _greedy_tokens(eng, PROMPT, "spec", max_tokens=9)
+            stats = eng.stats().spec_decode_stats
+            assert stats is not None
+            assert stats.num_accepted_tokens > 0
+            assert stats.num_draft_tokens >= stats.num_accepted_tokens
+        finally:
+            await eng.stop()
+        assert got == want
+
+    async def test_stop_token_truncates_inside_accepted_run(self, monkeypatch):
+        base = spec_engine(spec_tokens=0)
+        try:
+            want = await _greedy_tokens(base, PROMPT, "base", max_tokens=8)
+        finally:
+            await base.stop()
+        stop_tok = want[4]  # stop mid-stream, inside a drafted run
+        full = list(PROMPT) + want
+
+        def oracle(tokens, k, max_n=4, min_n=2):
+            n = len(tokens)
+            if n >= len(full) or list(tokens) != full[:n]:
+                return None
+            cont = full[n:n + k]
+            while len(cont) < k:
+                cont.append(cont[-1])
+            return cont
+
+        import dynamo_tpu.engine.scheduler as sched_mod
+        monkeypatch.setattr(sched_mod, "propose_ngram", oracle)
+        eng = spec_engine(spec_tokens=3)
+        try:
+            req = make_req(PROMPT, "stop", max_tokens=8,
+                           stop_token_ids=[stop_tok])
+            req.eos_token_ids = []
+            frames = await collect(eng, req)
+            toks = [t for f in frames for t in f.token_ids]
+            assert toks == want[:5]  # truncated AT the stop token
+            assert frames[-1].finish_reason == FinishReason.STOP
+        finally:
+            await eng.stop()
+
+    async def test_context_ceiling_falls_back_to_plain_decode(self):
+        # a row within K of max_context must NOT be speculated: the +K
+        # lookahead would overrun the static page-table width. The run
+        # must finish cleanly at the LENGTH ceiling, not error the batch.
+        eng = spec_engine(spec_tokens=3, max_context=16)
+        try:
+            req = make_req(PROMPT, "ceil", max_tokens=32)
+            req.eos_token_ids = []
+            frames = await collect(eng, req)
+            toks = [t for f in frames for t in f.token_ids]
+            assert frames[-1].finish_reason == FinishReason.LENGTH
+            assert len(PROMPT) + len(toks) == 16
+        finally:
+            await eng.stop()
+
+    async def test_max_tokens_exact_under_spec(self):
+        eng = spec_engine(spec_tokens=3)
+        try:
+            toks = await _greedy_tokens(eng, PROMPT, "len", max_tokens=5)
+            assert len(toks) == 5
+        finally:
+            await eng.stop()
+
+    async def test_penalized_request_falls_back_to_plain_decode(self):
+        eng = spec_engine(spec_tokens=3)
+        try:
+            req = make_req(PROMPT, "pen", max_tokens=5)
+            req.eos_token_ids = []
+            req.sampling_options.frequency_penalty = 0.5
+            frames = await collect(eng, req)
+            toks = [t for f in frames for t in f.token_ids]
+            assert len(toks) == 5
+            stats = eng.stats().spec_decode_stats
+            assert stats.num_drafts == 0  # every step took the plain path
+        finally:
+            await eng.stop()
+
+    async def test_mixed_batch_rows_without_draft_ride_along(self):
+        # one repetitive prompt (drafts) + one non-repetitive (padding
+        # drafts) decoding together; both must match their solo greedy runs
+        solo = {}
+        base = spec_engine(spec_tokens=0)
+        try:
+            solo["a"] = await _greedy_tokens(base, PROMPT, "a", 6)
+            solo["b"] = await _greedy_tokens(base, [9, 3, 1, 4, 2], "b", 6)
+        finally:
+            await base.stop()
+        eng = spec_engine(spec_tokens=3)
+        try:
+            ra = make_req(PROMPT, "a", max_tokens=6)
+            rb = make_req([9, 3, 1, 4, 2], "b", max_tokens=6)
+            ra.eos_token_ids = rb.eos_token_ids = []
+            fa, fb = await asyncio.gather(collect(eng, ra), collect(eng, rb))
+            assert [t for f in fa for t in f.token_ids] == solo["a"]
+            assert [t for f in fb for t in f.token_ids] == solo["b"]
+        finally:
+            await eng.stop()
+
+    def test_unsupported_family_raises(self):
+        # the MoE family forward has no logits_window support: turning on
+        # speculation must fail loudly at construction, not serve silently
+        # without it
+        cfg = ModelConfig.tiny(num_experts=4, num_experts_per_tok=2,
+                               moe_intermediate_size=32,
+                               model_type="qwen3_moe")
+        with pytest.raises(ValueError, match="spec_tokens"):
+            JaxEngine.random_init(cfg, JaxEngineConfig(
+                num_pages=16, page_size=4, max_num_seqs=2,
+                max_prefill_chunk=8, max_context=32, spec_tokens=2))
