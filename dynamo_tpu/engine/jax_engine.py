@@ -103,7 +103,8 @@ class JaxEngineConfig:
     # verified K at a time in one [B, K+1] step (0 = off). Supersedes
     # pipelined decode while on — draft proposal needs the sampled tokens
     # on host, so steps can't chain; each step instead yields up to K+1
-    # tokens per row. Llama-family dense forwards (llama/mistral/qwen2/3).
+    # tokens per row. Llama-family dense forwards (llama/mistral/qwen2/3)
+    # and gemma-2.
     spec_tokens: int = 0
     spec_ngram_max: int = 4
     spec_ngram_min: int = 2
@@ -249,20 +250,24 @@ class JaxEngine(ScheduledEngineBase):
             self.pages = self.cfg.shard_pages_fn(self.pages)
         self.spec_K = int(self.cfg.spec_tokens or 0)
         if self.spec_K:
+            if forward_fn is not None:
+                raise ValueError(
+                    "spec_tokens>0 does not compose with a custom "
+                    "forward_fn (pipeline parallelism); drop "
+                    "--speculative-num-tokens or the pp flag")
             import inspect
-            sig_fn = forward_fn or self._forward
             try:
                 has_window = "logits_window" in inspect.signature(
-                    sig_fn).parameters
+                    self._forward).parameters
             except (TypeError, ValueError):
                 has_window = False
-            if forward_fn is not None or not has_window:
+            if not has_window:
                 raise ValueError(
                     "spec_tokens>0 needs a family forward with "
-                    "logits_window support (the llama family tree: "
-                    "llama/mistral/qwen dense); custom forward_fns "
-                    f"(pipeline stages) and {model_cfg.model_type!r} "
-                    "are served without speculation")
+                    "logits_window support (the llama family tree — "
+                    "llama/mistral/qwen dense — and gemma-2); "
+                    f"{model_cfg.model_type!r} has none — drop "
+                    "--speculative-num-tokens to serve it")
         self.table_width = self.cfg.max_context // self.cfg.page_size
         self._rng = jax.random.PRNGKey(self.cfg.seed)
         self._step_counter = 0
@@ -685,7 +690,7 @@ class JaxEngine(ScheduledEngineBase):
                 pos[i, 0] = len(seq)
                 total[i] = len(seq) + 1
             else:
-                toks[i, 0] = seq.tokens.tokens()[-1]
+                toks[i, 0] = seq.tokens.last_token()
                 pos[i, 0] = len(seq) - 1
                 total[i] = len(seq)
             table[i, :len(seq.page_ids)] = seq.page_ids
@@ -722,7 +727,7 @@ class JaxEngine(ScheduledEngineBase):
         top_k = np.zeros(B, np.int32)
         top_p = np.ones(B, np.float32)
         for i, seq in enumerate(seqs):
-            toks[i, 0] = seq.tokens.tokens()[-1]
+            toks[i, 0] = seq.tokens.last_token()
             toks[i, 1:] = drafts[i]
             pos[i] = np.arange(len(seq) - 1, len(seq) + K)
             table[i, :len(seq.page_ids)] = seq.page_ids

@@ -218,7 +218,9 @@ class ScheduledEngineBase(EngineBase):
             toks, n_draft = self._plan_spec_appends(seq, cand)
             advances.append(1 + n_draft)
             appends.append(toks)
-        self.scheduler.on_spec_done(plan, advances)
+        self.scheduler.on_spec_done(
+            plan, advances,
+            accepted=[int(acc[i]) for i in range(len(plan.seqs))])
         for seq, toks in zip(plan.seqs, appends):
             if toks is None:
                 if seq.cancelled and seq.phase is Phase.RUNNING:
@@ -228,6 +230,7 @@ class ScheduledEngineBase(EngineBase):
                 self._accept_token(seq, tok, lp)
                 if seq.phase is not Phase.RUNNING:
                     break
+        self.scheduler.commit_spec(plan)
         events = self.allocator.drain_events()
         if events and self.kv_event_cb is not None:
             self.kv_event_cb(events)

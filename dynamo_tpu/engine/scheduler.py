@@ -460,8 +460,8 @@ class Scheduler:
         drafts = np.zeros((len(ready), K), np.int32)
         has = [False] * len(ready)
         for i, seq in enumerate(ready):
-            d = propose_ngram(seq.tokens.tokens(), K,
-                              max_n=self.cfg.spec_ngram_max,
+            toks = seq.tokens.tokens()  # one O(context) pass per row
+            d = propose_ngram(toks, K, max_n=self.cfg.spec_ngram_max,
                               min_n=self.cfg.spec_ngram_min)
             if d is not None:
                 drafts[i] = d
@@ -470,7 +470,7 @@ class Scheduler:
                 # no match: pad with the last context token — the row still
                 # gets its guaranteed one token from slot 0, and rejection
                 # costs nothing the step isn't already spending
-                drafts[i] = seq.tokens.tokens()[-1]
+                drafts[i] = toks[-1]
         if not any(has):
             return None
         # grow pages for the +K lookahead (positions len .. len+K-1). No
@@ -488,8 +488,8 @@ class Scheduler:
                     return None
         return SpecDecodeBatch(seqs=list(ready), drafts=drafts, has_draft=has)
 
-    def on_spec_done(self, plan: SpecDecodeBatch,
-                     advances: List[int]) -> None:
+    def on_spec_done(self, plan: SpecDecodeBatch, advances: List[int],
+                     accepted: Optional[List[int]] = None) -> None:
         """Advance accounting after a verify step.
 
         ``advances[i]`` = 1 (the fed context token's KV at slot 0) + the
@@ -497,17 +497,35 @@ class Scheduler:
         truncated by a stop). Slots past the advance hold rejected drafts'
         KV — never committed (num_computed stops short), overwritten by the
         next step that reaches those positions, and masked from attention
-        by true context length in between."""
+        by true context length in between.
+
+        Advances accounting ONLY — page commits wait for
+        :meth:`commit_spec` AFTER the engine appended the accepted tokens:
+        committing here would index token blocks that do not exist yet
+        (``num_computed`` crosses a page boundary whose tokens are still
+        in the candidate list)."""
         for seq, adv in zip(plan.seqs, advances):
             seq.num_computed += adv
-            self._commit_full_pages(seq)
         K = self.cfg.spec_tokens
         self.spec_stats.num_spec_tokens = K
         self.spec_stats.num_drafts += sum(1 for h in plan.has_draft if h)
         self.spec_stats.num_draft_tokens += K * sum(
             1 for h in plan.has_draft if h)
+        # acceptance counts what the DEVICE accepted (draft quality), not
+        # what survived stop truncation / cancellation — an operator tuning
+        # K against the acceptance rate should not be steered by
+        # short-completion workloads
+        acc = accepted if accepted is not None else [
+            max(0, a - 1) for a in advances]
         self.spec_stats.num_accepted_tokens += sum(
-            max(0, a - 1) for a, h in zip(advances, plan.has_draft) if h)
+            a for a, h in zip(acc, plan.has_draft) if h)
+
+    def commit_spec(self, plan: SpecDecodeBatch) -> None:
+        """Commit full pages once the verify step's tokens are appended
+        (rows the appends finished are no-ops: ``finish`` already
+        committed and released their pages)."""
+        for seq in plan.seqs:
+            self._commit_full_pages(seq)
 
     def plan_chained(self, prev: DecodeBatch) -> Optional[DecodeBatch]:
         """Plan decode step N+1 while step N's results are still on device.

@@ -127,21 +127,28 @@ def _finish_layer(cfg: ModelConfig, lp: Dict[str, jnp.ndarray],
 
 
 def _logits(cfg: ModelConfig, params: Params, h: jnp.ndarray,
-            new_lens: jnp.ndarray) -> jnp.ndarray:
+            new_lens: jnp.ndarray, window: int = 1) -> jnp.ndarray:
+    """Logits at each row's last ``window`` real new positions ([B, V], or
+    [B, W, V] for the speculative-verify step — see llama._logits)."""
     h = _rms_norm(h, params["final_norm"], cfg.rms_norm_eps)
-    last = jnp.maximum(new_lens - 1, 0)
-    h_last = jnp.take_along_axis(
-        h, last[:, None, None].astype(jnp.int32), axis=1)[:, 0]
+    if window == 1:
+        last = jnp.maximum(new_lens - 1, 0)
+        h_sel = jnp.take_along_axis(
+            h, last[:, None, None].astype(jnp.int32), axis=1)[:, 0]
+    else:
+        offs = jnp.arange(window, dtype=jnp.int32)[None, :]
+        idx = jnp.maximum(new_lens[:, None] - window + offs, 0)
+        h_sel = jnp.take_along_axis(h, idx[..., None], axis=1)
     lm8 = params.get("lm_head_q")
     if lm8 is not None:
-        logits = quant.qdot(h_last, lm8, params["lm_head_scale"],
+        logits = quant.qdot(h_sel, lm8, params["lm_head_scale"],
                             out_dtype=jnp.float32)
     else:
         lm_head = params.get("lm_head")
         if lm_head is None:
             lm_head = params["embed"].T
         # model-dtype operands + f32 accumulation (see llama._logits)
-        logits = jnp.dot(h_last, lm_head,
+        logits = jnp.dot(h_sel, lm_head,
                          preferred_element_type=jnp.float32)
     cap = cfg.final_logit_softcap
     if cap:
@@ -162,7 +169,8 @@ def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
             positions: jnp.ndarray, pages: jnp.ndarray,
             page_table: jnp.ndarray, total_lens: jnp.ndarray,
             new_lens: jnp.ndarray,
-            attn_impl: Optional[Callable] = None
+            attn_impl: Optional[Callable] = None,
+            logits_window: int = 1
             ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Scan-over-layers forward. ``attn_impl`` is honored only when it
     advertises ``supports_window_softcap`` (both stacked Pallas kernels —
@@ -190,14 +198,15 @@ def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     (h, pages), _ = jax.lax.scan(
         body, (h, pages),
         (params["layers"], jnp.arange(cfg.num_layers), windows))
-    return _logits(cfg, params, h, new_lens), pages
+    return _logits(cfg, params, h, new_lens, window=logits_window), pages
 
 
 def forward_unrolled(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
                      positions: jnp.ndarray, pages_list: List[jnp.ndarray],
                      page_table: jnp.ndarray, total_lens: jnp.ndarray,
                      new_lens: jnp.ndarray,
-                     attn_impl: Optional[Callable] = None
+                     attn_impl: Optional[Callable] = None,
+                     logits_window: int = 1
                      ) -> Tuple[jnp.ndarray, List[jnp.ndarray]]:
     """Unrolled forward. ``attn_impl`` is IGNORED: the Pallas decode kernel
     implements neither soft-capping nor sliding windows, so gemma always
@@ -219,7 +228,7 @@ def forward_unrolled(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
                                      window=windows[l], softcap=softcap)
         h = _finish_layer(cfg, lp, h, attn)
         out_pages.append(kv)
-    return _logits(cfg, params, h, new_lens), out_pages
+    return _logits(cfg, params, h, new_lens, window=logits_window), out_pages
 
 
 __all__ = ["init_params", "forward", "forward_unrolled", "make_pages",

@@ -150,6 +150,16 @@ class TokenBlockSequence:
         out.extend(self._partial)
         return out
 
+    def last_token(self) -> int:
+        """O(1) accessor for the newest token — the decode hot path feeds
+        it every step; ``tokens()[-1]`` would rebuild the whole context
+        list per call."""
+        if self._partial:
+            return self._partial[-1]
+        if self._blocks:
+            return self._blocks[-1].tokens[-1]
+        raise IndexError("empty token sequence")
+
     # -- mutators ----------------------------------------------------------
 
     def append(self, token: int) -> Optional[TokenBlock]:

@@ -337,6 +337,29 @@ class TestEngineSpecDecode:
         finally:
             await eng.stop()
 
+    @pytest.mark.async_timeout(180)
+    async def test_gemma2_greedy_identical_with_and_without_spec(self):
+        # gemma-2's forward carries logits_window too (softcap applied to
+        # the whole [B, W, V] window) — same equivalence bar as llama
+        mk = dict(model_type="gemma2", num_layers=2, sliding_window=8,
+                  attn_logit_softcap=40.0, final_logit_softcap=25.0)
+        cfg = ModelConfig.tiny(**mk)
+        ecfg = dict(num_pages=64, page_size=4, max_num_seqs=4,
+                    max_prefill_chunk=16, max_context=64,
+                    min_prefill_bucket=4, spec_ngram_min=1)
+        base = JaxEngine.random_init(cfg, JaxEngineConfig(**ecfg))
+        try:
+            want = await _greedy_tokens(base, PROMPT, "base")
+        finally:
+            await base.stop()
+        eng = JaxEngine.random_init(
+            cfg, JaxEngineConfig(spec_tokens=3, **ecfg))
+        try:
+            got = await _greedy_tokens(eng, PROMPT, "spec")
+        finally:
+            await eng.stop()
+        assert got == want
+
     def test_unsupported_family_raises(self):
         # the MoE family forward has no logits_window support: turning on
         # speculation must fail loudly at construction, not serve silently
