@@ -298,6 +298,107 @@ class JaxEngine(ScheduledEngineBase):
         # dispatch so rank 0 can broadcast the step to follower ranks
         # (parallel/multihost.py); None on single-host workers
         self.step_tap: Optional[Callable] = None
+        # guided decoding (engine/guided.py): set by enable_guided once the
+        # worker knows the tokenizer's byte vocabulary
+        self._guided_vocab = None
+        self._guided_bytes = None
+        self._guided_reqs: dict = {}
+        self._grammar_cache: dict = {}
+        self._grammar_lock = threading.Lock()
+
+    # -- guided decoding ---------------------------------------------------
+
+    def enable_guided(self, token_bytes, eos_ids) -> None:
+        """Arm response_format support: ``token_bytes[id]`` is the byte
+        string token id appends to the output (None for special tokens),
+        ``eos_ids`` the ids allowed once the document completes."""
+        from dynamo_tpu.engine.guided import GuidedVocab
+        self._guided_bytes = list(token_bytes)
+        if len(self._guided_bytes) < self.model_cfg.vocab_size:
+            # model vocabs are usually PADDED past the tokenizer's: the
+            # mask must cover every logit column or the device-side gather
+            # clamps and padded ids inherit arbitrary bits from the last
+            # word (sampleable garbage that silently un-wedges the
+            # constraint)
+            self._guided_bytes += [None] * (
+                self.model_cfg.vocab_size - len(self._guided_bytes))
+        for e in eos_ids:
+            # an EOS that is a regular vocab entry (toy tokenizers) must
+            # never be walked as literal text — it ENDS the document
+            if 0 <= e < len(self._guided_bytes):
+                self._guided_bytes[e] = None
+        self._guided_vocab = GuidedVocab(self._guided_bytes, list(eos_ids))
+
+    def validate_request(self, request) -> Optional[str]:
+        spec = request.sampling_options.guided
+        if not spec:
+            return None
+        if self._guided_vocab is None:
+            return ("guided decoding (response_format) is not available: "
+                    "the worker did not register a token-byte vocabulary")
+        try:
+            self._grammar_for(spec)
+        except Exception as e:  # noqa: BLE001 — surface compile errors
+            return f"response_format rejected: {e}"
+        return None
+
+    def _grammar_for(self, spec: dict):
+        """Compile-or-cache a guided grammar. Called from BOTH the
+        event-loop thread (validate_request) and the step worker thread
+        (_guided_masks) — the lock keeps the evict/insert pair atomic."""
+        import json as _json
+
+        from dynamo_tpu.engine.guided import compile_guided
+        key = _json.dumps(spec, sort_keys=True)
+        with self._grammar_lock:
+            g = self._grammar_cache.get(key)
+        if g is None:
+            g = compile_guided(spec)
+            with self._grammar_lock:
+                if len(self._grammar_cache) >= 64:
+                    self._grammar_cache.pop(
+                        next(iter(self._grammar_cache)), None)
+                g = self._grammar_cache.setdefault(key, g)
+        return g
+
+    def _guided_masks(self, rows, B: int) -> Optional[np.ndarray]:
+        """Per-row packed allow-masks for this step, or None when no row
+        is constrained. Unconstrained rows are all-ones (the device no-op).
+        Automata catch up lazily from ``seq.generated`` — no token hook in
+        the loop, and replays/preemption revives re-walk deterministically."""
+        gv = self._guided_vocab
+        if gv is None:
+            return None
+        from dynamo_tpu.engine.guided import GuidedRequest
+        masks = None
+        for i, seq in enumerate(rows):
+            spec = seq.request.sampling_options.guided
+            if not spec:
+                continue
+            rid = seq.request.request_id
+            gr = self._guided_reqs.get(rid)
+            if gr is None or gr.n_seen > len(seq.generated):
+                # n_seen beyond generated = a preemption rewound the
+                # sequence; rebuild and re-walk from scratch
+                gr = GuidedRequest(self._grammar_for(spec), gv,
+                                   self._guided_bytes)
+                self._guided_reqs[rid] = gr
+            gr.catch_up(seq.generated)
+            gr.last_step = self._step_counter
+            m = gr.mask()
+            if m is not None:
+                if masks is None:
+                    masks = np.full((B, gv.words), 0xFFFFFFFF, np.uint32)
+                masks[i] = m
+        if len(self._guided_reqs) > 4 * self.cfg.max_num_seqs:
+            # size-capped eviction by last touch (finished requests are
+            # never unregistered explicitly — the step worker thread must
+            # not race the event-loop thread over scheduler state)
+            stale = sorted(self._guided_reqs.items(),
+                           key=lambda kv: getattr(kv[1], "last_step", 0))
+            for rid, _ in stale[:len(stale) // 2]:
+                del self._guided_reqs[rid]
+        return masks
 
     # -- compiled step -----------------------------------------------------
 
@@ -452,6 +553,11 @@ class JaxEngine(ScheduledEngineBase):
             logits = apply_penalties(logits, pen["ids"], pen["cnt"],
                                      pen["ctx"], pen["fp"], pen["pp"],
                                      pen["rp"], pen_bias=pen["bias"])
+            if "mask" in pen:
+                # guided allow-mask LAST: a penalty/bias can reweight
+                # inside the grammar but never resurrect an illegal token
+                from dynamo_tpu.ops.sampling import apply_vocab_mask
+                logits = apply_vocab_mask(logits, pen["mask"])
             seeds = pen["seeds"]
         sampled, logprobs = sample_tokens(
             logits, key, temperature, top_k, top_p, seeds=seeds,
@@ -546,14 +652,18 @@ class JaxEngine(ScheduledEngineBase):
                 cnt[i, j] = c
                 ctx[i, j] = 1.0 if x else 0.0
                 bias[i, j] = lb.get(t, 0.0)
-        if not any_active:
-            # common case: nobody in the batch uses penalties, bias, or
-            # seeds — ship nothing and take the pen=None trace (no extra
-            # host->device arrays, single batch-wide gumbel draw)
+        masks = self._guided_masks(rows, B)
+        if not any_active and masks is None:
+            # common case: nobody in the batch uses penalties, bias,
+            # seeds, or guided masks — ship nothing and take the pen=None
+            # trace (no extra host->device arrays, single batch-wide
+            # gumbel draw)
             return {}
         out.update(pen_ids=ids, pen_cnt=cnt, pen_ctx=ctx, pen_bias=bias,
                    pen_fp=fp, pen_pp=pp, pen_rp=rp, pen_min_p=min_p,
                    pen_active=np.ones(1, np.int32))
+        if masks is not None:
+            out["mask_words"] = masks
         return out
 
     def _pen_arg(self, a: dict, B: int):
@@ -564,7 +674,7 @@ class JaxEngine(ScheduledEngineBase):
         if not np.any(a.get("pen_active", 0)):
             return None
         z_ids = a.get("pen_ids")
-        return {
+        out = {
             "ids": jnp.asarray(z_ids if z_ids is not None
                                else np.zeros((B, W), np.int32)),
             "cnt": jnp.asarray(a.get("pen_cnt",
@@ -580,6 +690,12 @@ class JaxEngine(ScheduledEngineBase):
                                        np.zeros(B, np.float32))),
             "seeds": jnp.asarray(a.get("seeds", np.zeros(B, np.int32))),
         }
+        mask = a.get("mask_words")
+        if mask is not None:
+            # key present only when some row is guided: the with-mask and
+            # without-mask pen pytrees are two traces, both bounded
+            out["mask"] = jnp.asarray(mask)
+        return out
 
     def _execute_plan(self, plan: StepPlan):
         """Build padded arrays, run the jitted step, fetch sampled tokens."""

@@ -95,6 +95,13 @@ class ScheduledEngineBase(EngineBase):
 
     # -- subclass hook -----------------------------------------------------
 
+    def validate_request(self, request: PreprocessedRequest
+                         ) -> Optional[str]:
+        """Per-request admission check beyond size limits; subclasses
+        return an error string to fail the request before it queues
+        (JaxEngine rejects unsupported/unavailable guided specs here)."""
+        return None
+
     def _execute_plan(self, plan: StepPlan
                       ) -> Tuple[np.ndarray, np.ndarray, Optional[dict]]:
         """Run one step; returns (sampled_tokens, logprobs, extras) aligned
@@ -497,6 +504,11 @@ class ScheduledEngineBase(EngineBase):
                 finish_reason=FinishReason.ERROR,
                 error=(f"prompt of {len(request.token_ids)} tokens exceeds "
                        f"max context {self.max_context}"))
+            return
+        err = self.validate_request(request)
+        if err is not None:
+            yield LLMEngineOutput(finish_reason=FinishReason.ERROR,
+                                  error=err)
             return
         q: asyncio.Queue = asyncio.Queue()
         self._queues[rid] = q

@@ -442,7 +442,7 @@ class Scheduler:
                   and so.repetition_penalty != 1.0)
         return not (so.frequency_penalty or so.presence_penalty or rep_on
                     or so.logit_bias or so.seed is not None or so.min_p
-                    or so.logprobs is not None)
+                    or so.logprobs is not None or so.guided)
 
     def _spec_plan(self, ready: List[Sequence]) -> Optional[SpecDecodeBatch]:
         """Try to upgrade this decode step to a [B, K+1] verify step."""
@@ -557,16 +557,17 @@ class Scheduler:
             if seq.phase is not Phase.RUNNING or seq.cancelled:
                 return None
             so = seq.request.sampling_options
-            if (so.frequency_penalty or so.presence_penalty
+            if (so.frequency_penalty or so.presence_penalty or so.guided
                     or (so.repetition_penalty is not None
                         and so.repetition_penalty > 0
                         and so.repetition_penalty != 1.0)):
-                # penalty windows are built from host bookkeeping, which at
-                # chain-planning time still excludes step N's token — a
-                # chained step would penalize one token stale (an immediate
-                # repetition would escape). Penalized traffic takes the
-                # fetch-then-plan flow; seeds alone are fine (their keys
-                # fold the token position, not host state).
+                # penalty windows and guided-decoding masks are built from
+                # host bookkeeping, which at chain-planning time still
+                # excludes step N's token — a chained step would penalize
+                # one token stale / mask against a stale automaton state.
+                # Such traffic takes the fetch-then-plan flow; seeds alone
+                # are fine (their keys fold the token position, not host
+                # state).
                 return None
             sc = seq.request.stop_conditions
             max_new = sc.max_tokens if sc.max_tokens is not None else (

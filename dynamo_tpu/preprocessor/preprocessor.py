@@ -142,6 +142,14 @@ class OpenAIPreprocessor:
             # than the request asked for
             engine_k = getattr(self.card, "num_top_logprobs", 20)
             logprobs = min(logprobs, 20, engine_k)
+        # response_format -> guided decoding; the grammar is compiled here
+        # too (and discarded) so a bad schema 400s at the frontend instead
+        # of erroring the stream at the worker
+        guided = (req.guided_spec()
+                  if isinstance(req, ChatCompletionRequest) else None)
+        if guided is not None:
+            from dynamo_tpu.engine.guided import compile_guided
+            compile_guided(guided)  # raises GuidedUnsupported (ValueError)
         sampling = SamplingOptions(
             temperature=req.temperature,
             top_p=req.top_p,
@@ -154,6 +162,7 @@ class OpenAIPreprocessor:
             seed=req.seed,
             n=req.n,
             logprobs=logprobs,
+            guided=guided,
         )
         return PreprocessedRequest(
             token_ids=token_ids,

@@ -303,5 +303,18 @@ class SpTokenizer:
         from dynamo_tpu.preprocessor.tokenizer import DecodeStream
         return DecodeStream(self, skip_special_tokens)
 
+    def token_bytes(self) -> List[Optional[bytes]]:
+        """Byte string per token id for guided decoding (None for
+        control/unknown pieces) — the ``HfTokenizer.token_bytes``
+        counterpart: metaspace becomes a space, ``<0xNN>`` byte-fallback
+        pieces become their byte."""
+        out: List[Optional[bytes]] = [None] * len(self._pieces)
+        for i, (piece, _score, ptype) in enumerate(self._pieces):
+            if ptype == _BYTE and len(piece) == 6:
+                out[i] = bytes([int(piece[3:5], 16)])
+            elif ptype in (_NORMAL, _USER_DEFINED):
+                out[i] = piece.replace(_SPACE, " ").encode("utf-8")
+        return out
+
 
 __all__ = ["SpTokenizer"]

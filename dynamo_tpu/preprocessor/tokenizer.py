@@ -17,6 +17,26 @@ from tokenizers import Tokenizer
 # replacement char appears while a multi-byte sequence is still incomplete
 _REPLACEMENT = "�"
 
+_BYTE_DECODER: Optional[dict] = None
+
+
+def _gpt2_byte_decoder() -> dict:
+    """char -> byte unmapping of the standard byte-level BPE alphabet
+    (the gpt2 ``bytes_to_unicode`` table, inverted)."""
+    global _BYTE_DECODER
+    if _BYTE_DECODER is None:
+        bs = (list(range(0x21, 0x7F)) + list(range(0xA1, 0xAD))
+              + list(range(0xAE, 0x100)))
+        cs = bs[:]
+        n = 0
+        for b in range(256):
+            if b not in bs:
+                bs.append(b)
+                cs.append(256 + n)
+                n += 1
+        _BYTE_DECODER = {chr(c): b for b, c in zip(bs, cs)}
+    return _BYTE_DECODER
+
 
 class HfTokenizer:
     """Thin wrapper over a `tokenizers.Tokenizer` (thread-safe encode/decode)."""
@@ -51,6 +71,43 @@ class HfTokenizer:
 
     def decode_stream(self, skip_special_tokens: bool = True) -> "DecodeStream":
         return DecodeStream(self, skip_special_tokens)
+
+    def token_bytes(self) -> List[Optional[bytes]]:
+        """The byte string each token id appends to the output (None for
+        special/added tokens) — the vocabulary view guided decoding walks
+        (``engine/guided.py``). Handles byte-level BPE (gpt2 char->byte
+        unmapping), sentencepiece-style pieces (metaspace + <0xNN> byte
+        fallback), and plain vocabularies."""
+        with self._lock:
+            size = self._tk.get_vocab_size()
+            vocab = self._tk.get_vocab(with_added_tokens=True)
+            byte_level = '"ByteLevel"' in (self._tk.to_str() or "")
+            specials = set()
+            try:
+                for tid, at in self._tk.get_added_tokens_decoder().items():
+                    if getattr(at, "special", True):
+                        specials.add(int(tid))
+            except AttributeError:
+                # older tokenizers builds: anything present only in the
+                # with-added vocab is an added token — treat ALL of them
+                # as special (a literal b"<s>" walking a grammar while
+                # the detokenizer drops it would desync text from walk)
+                base = self._tk.get_vocab(with_added_tokens=False)
+                specials = {tid for tok, tid in vocab.items()
+                            if tok not in base}
+        out: List[Optional[bytes]] = [None] * size
+        dec = _gpt2_byte_decoder()
+        for tok, tid in vocab.items():
+            if not 0 <= tid < size or tid in specials:
+                continue
+            if byte_level and all(c in dec for c in tok):
+                out[tid] = bytes(dec[c] for c in tok)
+            elif (len(tok) == 6 and tok.startswith("<0x")
+                  and tok.endswith(">")):
+                out[tid] = bytes([int(tok[3:5], 16)])     # SP byte fallback
+            else:
+                out[tid] = tok.replace("▁", " ").encode("utf-8")
+        return out
 
 
 class DecodeStream:

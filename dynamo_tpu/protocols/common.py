@@ -89,6 +89,9 @@ class SamplingOptions:
     # vLLM-style min_p: drop candidates whose probability is below
     # min_p * max-candidate-probability (0 = off)
     min_p: Optional[float] = None
+    # guided decoding (OpenAI response_format -> engine/guided.py):
+    # {"mode": "json"} or {"mode": "json_schema", "schema": {...}}
+    guided: Optional[Dict[str, Any]] = None
 
     def to_dict(self) -> Dict[str, Any]:
         d = _asdict_shallow(self)
@@ -100,7 +103,7 @@ class SamplingOptions:
         kw = {k: d.get(k) for k in (
             "temperature", "top_p", "top_k", "frequency_penalty",
             "presence_penalty", "repetition_penalty", "seed", "logprobs",
-            "min_p")}
+            "min_p", "guided")}
         lb = d.get("logit_bias")
         if lb:
             # wire form may carry string token-id keys (OpenAI JSON)

@@ -74,6 +74,9 @@ class ChatCompletionRequest(BaseModel):
     user: Optional[str] = None
     tools: Optional[List[Dict[str, Any]]] = None
     tool_choice: Optional[Union[str, Dict[str, Any]]] = None
+    # OpenAI structured outputs: {"type": "text" | "json_object"} or
+    # {"type": "json_schema", "json_schema": {"schema": {...}, ...}}
+    response_format: Optional[Dict[str, Any]] = None
     nvext: Optional[Extensions] = None
 
     def stop_list(self) -> Optional[List[str]]:
@@ -83,6 +86,26 @@ class ChatCompletionRequest(BaseModel):
 
     def effective_max_tokens(self) -> Optional[int]:
         return self.max_completion_tokens or self.max_tokens
+
+    def guided_spec(self) -> Optional[Dict[str, Any]]:
+        """Map response_format to the engine's guided-decoding spec
+        (``engine/guided.py``); raises ValueError on malformed input."""
+        rf = self.response_format
+        if not rf:
+            return None
+        kind = rf.get("type")
+        if kind in (None, "text"):
+            return None
+        if kind == "json_object":
+            return {"mode": "json"}
+        if kind == "json_schema":
+            js = rf.get("json_schema") or {}
+            schema = js.get("schema")
+            if not isinstance(schema, dict):
+                raise ValueError(
+                    "response_format.json_schema.schema must be an object")
+            return {"mode": "json_schema", "schema": schema}
+        raise ValueError(f"unsupported response_format type {kind!r}")
 
 
 class CompletionRequest(BaseModel):

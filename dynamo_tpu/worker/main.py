@@ -261,6 +261,15 @@ async def amain(args: argparse.Namespace) -> None:
     # advertise the engine's sparse penalty/logit_bias window so the
     # frontend preprocessor rejects requests the device would truncate
     card.penalty_window = engine.cfg.penalty_window
+    # arm guided decoding (response_format): the engine needs the
+    # tokenizer's byte view of the vocabulary to walk grammar masks
+    if hasattr(engine, "enable_guided"):
+        try:
+            engine.enable_guided(card.load_tokenizer().token_bytes(),
+                                 card.eos_token_ids)
+        except Exception:  # noqa: BLE001 — guided off beats worker down
+            logging.getLogger(__name__).exception(
+                "guided decoding disabled: token_bytes extraction failed")
 
     # a dead engine loop takes the worker's registration down with it, so
     # routers stop sending to a zombie (reference: task.rs critical tasks)

@@ -1,0 +1,502 @@
+"""Guided decoding: the JSON pushdown automaton, schema compilation, token
+masks, and (in test_http_service/test_serve flows) the response_format
+surface.
+
+Model for coverage: the reference forwards ``response_format`` to its CUDA
+engines, whose guided backends (outlines/xgrammar style) define the
+behavior bar: constrained output is always parseable, schema-conformant,
+and generation can always continue (no dead-end states).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine.guided import (
+    Grammar,
+    GuidedRequest,
+    GuidedUnsupported,
+    GuidedVocab,
+    compile_guided,
+    eos_ok,
+    initial_state,
+    step,
+)
+
+
+def feed(g, text, state=None):
+    """Feed a string byte-by-byte; returns final state or None."""
+    st = initial_state(g) if state is None else state
+    for b in text.encode():
+        st = step(g, st, b)
+        if st is None:
+            return None
+    return st
+
+
+def accepts(g, text):
+    """Whole-document acceptance: every byte legal AND EOS legal after."""
+    st = feed(g, text)
+    return st is not None and eos_ok(g, st)
+
+
+def prefix_ok(g, text):
+    return feed(g, text) is not None
+
+
+# ------------------------------------------------------------ generic JSON
+
+class TestAnyJson:
+    g = Grammar.any_json()
+
+    @pytest.mark.parametrize("doc", [
+        "{}", "[]", '""', "0", "-1", "3.14", "1e9", "-0.5E-2", "true",
+        "false", "null", '{"a": 1}', '{"a": {"b": [1, 2, {}]}}',
+        '[1, "two", null, true, [2.5]]', '"esc \\" \\\\ \\n \\u00e9"',
+        ' { "a" : [ 1 , 2 ] }', '{"a": 1, "b": 2}', '{\n "a": 1\n}',
+    ])
+    def test_accepts(self, doc):
+        assert accepts(self.g, doc), doc
+
+    @pytest.mark.parametrize("doc", [
+        "{", "[", '"open', "01", "1.", "1e", "+1", "tru", "nul",
+        "{a: 1}", "{'a': 1}", '{"a" 1}', '{"a": 1,}', "[1 2]", "[,1]",
+        '"bad \\x"', "{} {}", "12 34",
+        "{}  ",          # trailing whitespace: nothing may follow `done`
+        '{    "a": 1}',  # > MAX_WS blanks in one gap
+    ])
+    def test_rejects(self, doc):
+        assert not accepts(self.g, doc), doc
+
+    def test_python_json_agrees_on_accepts(self):
+        # everything we accept must parse with the stdlib
+        for doc in ['{"k": [1, -2.5e3, "s", true, null, {}]}', "[[[]]]"]:
+            assert accepts(self.g, doc)
+            json.loads(doc)
+
+    def test_string_content_must_be_utf8(self):
+        g = self.g
+        assert accepts(g, '"café"')                  # 2-byte UTF-8
+        assert accepts(g, '"☃ \U0001f600"')          # 3- and 4-byte
+        st = feed(g, '"')
+        assert step(g, st, 0x80) is None                  # bare continuation
+        assert step(g, st, 0xC0) is None                  # overlong lead
+        st2 = step(g, st, 0xC3)                           # lead needs 1 more
+        assert st2 is not None
+        assert step(g, st2, 0x22) is None                 # quote mid-char
+        assert not eos_ok(g, st2)
+        assert step(g, st2, 0xA9) is not None             # valid continuation
+
+    def test_duplicate_keys_allowed_generic(self):
+        # generic JSON mode does not track keys (open objects)
+        assert accepts(self.g, '{"a": 1, "a": 2}')
+
+
+class TestJsonObjectMode:
+    g = Grammar.any_object()
+
+    def test_root_must_be_object(self):
+        assert accepts(self.g, '{"x": [1, 2]}')
+        assert not prefix_ok(self.g, "[")
+        assert not prefix_ok(self.g, '"')
+        assert not prefix_ok(self.g, "1")
+
+
+# ------------------------------------------------------------ schema mode
+
+SCHEMA = {
+    "type": "object",
+    "properties": {
+        "name": {"type": "string"},
+        "age": {"type": "integer"},
+        "tags": {"type": "array", "items": {"type": "string"}},
+        "mood": {"enum": ["happy", "sad"]},
+        "extra": {"type": ["number", "null"]},
+    },
+    "required": ["name", "age"],
+}
+
+
+class TestSchema:
+    g = Grammar.from_schema(SCHEMA)
+
+    @pytest.mark.parametrize("doc", [
+        '{"name": "bob", "age": 3}',
+        '{"age": 0, "name": ""}',
+        '{"name": "x", "age": 1, "tags": ["a", "b"]}',
+        '{"name": "x", "age": 1, "mood": "sad"}',
+        '{"name": "x", "age": 1, "extra": -2.5}',
+        '{"name": "x", "age": 1, "extra": null}',
+    ])
+    def test_accepts(self, doc):
+        assert accepts(self.g, doc), doc
+        json.loads(doc)  # and it is valid JSON
+
+    @pytest.mark.parametrize("doc", [
+        '{"name": "bob"}',                      # missing required age
+        '{}',                                   # missing required
+        '{"name": "bob", "age": 3.5}',          # integer violated
+        '{"name": 1, "age": 3}',                # wrong type
+        '{"name": "b", "age": 1, "mood": "angry"}',   # not in enum
+        '{"name": "b", "age": 1, "other": 2}',        # undeclared key
+        '{"name": "b", "age": 1, "name": "c"}',       # duplicate key
+        '{"name": "b", "age": 1, "tags": [1]}',       # item type
+    ])
+    def test_rejects(self, doc):
+        assert not accepts(self.g, doc), doc
+
+    def test_no_dead_ends_on_duplicate_key_path(self):
+        # after using "name", a second "name key must be rejected at the
+        # FIRST byte that commits to it (found live: byte-level rejection
+        # only at the closing quote left '"nam' as a reachable dead end —
+        # the mask zeroed out and the constraint wedged off)
+        st = feed(self.g, '{"name": "b", ')
+        assert st is not None
+        assert feed(self.g, '"age', st) is not None
+        # 'n' only leads to the used "name": rejected at the first byte
+        assert feed(self.g, '"n', st) is None
+        assert feed(self.g, '"name"', st) is None
+
+    def test_shared_prefix_keys_prune_exactly(self):
+        g = Grammar.from_schema({
+            "type": "object",
+            "properties": {"ab": {"type": "integer"},
+                           "ac": {"type": "integer"}},
+            "required": ["ab", "ac"],
+        })
+        st = feed(g, '{"ab": 1, ')
+        assert st is not None
+        assert feed(g, '"a', st) is not None    # "ac" still reachable
+        assert feed(g, '"ab', st) is None       # only the used key below
+        assert accepts(g, '{"ab": 1, "ac": 2}')
+
+    def test_comma_blocked_when_no_keys_remain(self):
+        doc = ('{"name": "b", "age": 1, "tags": [], "mood": "sad", '
+               '"extra": null')
+        st = feed(self.g, doc)
+        assert st is not None
+        assert feed(self.g, ",", st) is None
+        assert accepts(self.g, doc + "}")
+
+    def test_empty_object_schema_blocks_keys(self):
+        g = Grammar.from_schema({"type": "object"})
+        assert accepts(g, "{}")
+        assert not prefix_ok(g, '{"')
+
+
+class TestSchemaCompile:
+    def test_unsupported_keyword_raises(self):
+        with pytest.raises(GuidedUnsupported, match="pattern"):
+            Grammar.from_schema({"type": "string", "pattern": "a+"})
+
+    def test_additional_properties_true_raises(self):
+        with pytest.raises(GuidedUnsupported):
+            Grammar.from_schema({"type": "object",
+                                 "additionalProperties": True})
+
+    def test_required_not_in_properties_raises(self):
+        with pytest.raises(GuidedUnsupported):
+            Grammar.from_schema({"type": "object", "required": ["x"],
+                                 "properties": {}})
+
+    def test_ambiguous_union_raises(self):
+        with pytest.raises(GuidedUnsupported):
+            Grammar.from_schema({"anyOf": [{"type": "string"},
+                                           {"enum": ["a", "b"]}]})
+
+    def test_const_and_bool_enum(self):
+        g = Grammar.from_schema({"const": "yes"})
+        assert accepts(g, '"yes"')
+        assert not accepts(g, '"no"')
+        g2 = Grammar.from_schema({"enum": [True, None, 5]})
+        for ok in ("true", "null", "5"):
+            assert accepts(g2, ok), ok
+        assert not accepts(g2, "false")
+
+    def test_root_union_honors_every_branch(self):
+        # composite roots compile branch nodes first; the automaton must
+        # start at the UNION node, not node 0 (the first branch)
+        g = Grammar.from_schema({"type": ["number", "null"]})
+        assert accepts(g, "null")
+        assert accepts(g, "1.5")
+        assert not accepts(g, '"s"')
+        g2 = Grammar.from_schema({"anyOf": [{"type": "string"},
+                                            {"type": "integer"}]})
+        assert accepts(g2, "7")
+        assert accepts(g2, '"x"')
+
+    def test_boolean_subschema_rejected_as_unsupported(self):
+        # "items": true is valid JSON Schema; it must 400, not TypeError
+        with pytest.raises(GuidedUnsupported, match="objects"):
+            Grammar.from_schema({"type": "array", "items": True})
+        with pytest.raises(GuidedUnsupported, match="ref"):
+            Grammar.from_schema({"$ref": {}})
+
+    def test_number_length_cap_has_no_dead_ends(self):
+        from dynamo_tpu.engine.guided import MAX_NUM_LEN
+        g = Grammar.any_json()
+        # a 23-digit integer followed by '.' used to leave a state with
+        # ZERO legal continuations (mask empties, constraint wedges off)
+        st = feed(g, "1" * (MAX_NUM_LEN - 1))
+        assert st is not None
+        assert feed(g, ".", st) is None        # no room for a digit after
+        assert eos_ok(g, st)                   # but the integer can end
+        st2 = feed(g, "1" * (MAX_NUM_LEN - 2))
+        st3 = feed(g, ".", st2)                # room for exactly one digit
+        assert st3 is not None
+        assert feed(g, "5", st3) is not None
+        # and every reachable num state always has SOME continuation or
+        # is accepting
+        for doc in ("1" * (MAX_NUM_LEN - 2) + "e",
+                    "1" * (MAX_NUM_LEN - 3) + "e+"):
+            stx = feed(g, doc)
+            if stx is not None:
+                assert any(step(g, stx, b) is not None
+                           for b in range(256)) or eos_ok(g, stx)
+
+    def test_prefix_enum_literal_can_terminate(self):
+        # enum [1, 12]: after "1" the lit trie node is terminal WITH an
+        # outgoing edge; EOS must resolve it like a terminator byte would,
+        # or the value 1 is unreachable
+        g = Grammar.from_schema({"enum": [1, 12]})
+        assert accepts(g, "1")
+        assert accepts(g, "12")
+        assert not accepts(g, "2")
+        st = feed(g, "1")
+        assert eos_ok(g, st)
+
+    def test_recursive_ref(self):
+        g = Grammar.from_schema({
+            "$defs": {"node": {
+                "type": "object",
+                "properties": {
+                    "v": {"type": "integer"},
+                    "next": {"$ref": "#/$defs/node"},
+                },
+                "required": ["v"],
+            }},
+            "$ref": "#/$defs/node",
+        })
+        assert accepts(g, '{"v": 1}')
+        assert accepts(g, '{"v": 1, "next": {"v": 2, "next": {"v": 3}}}')
+        assert not accepts(g, '{"next": {"v": 2}}')
+
+    def test_json_mode_specs(self):
+        assert accepts(compile_guided({"mode": "json"}), '{"a": 1}')
+        with pytest.raises(GuidedUnsupported):
+            compile_guided({"mode": "regex"})
+
+
+# ------------------------------------------------------------ token masks
+
+def tiny_vocab():
+    """A vocabulary mixing single bytes and multi-byte chunks."""
+    toks = [bytes([b]) for b in range(32, 127)]           # printable ascii
+    toks += [b'{"', b'":', b'", ', b'"}', b"true", b"false", b"null",
+             b"name", b"age", b'{"name": "', b": ", b", ", b'"a', b'b"']
+    toks.append(None)                                     # special
+    return toks, len(toks) - 1                            # eos = the special?
+
+
+class TestMasks:
+    def setup_method(self):
+        toks, _ = tiny_vocab()
+        self.toks = toks + [None]
+        self.eos = len(self.toks) - 1
+        self.vocab = GuidedVocab(self.toks, [self.eos])
+
+    def unpack(self, m):
+        V = len(self.toks)
+        bits = np.zeros(V, bool)
+        for t in range(V):
+            bits[t] = bool((int(m[t >> 5]) >> (t & 31)) & 1)
+        return bits
+
+    def test_mask_matches_bruteforce(self):
+        g = Grammar.from_schema(SCHEMA)
+        req = GuidedRequest(g, self.vocab, self.toks)
+        st = feed(g, '{"name": "b", "age"')
+        req.state = st
+        bits = self.unpack(req.mask())
+        for t, bs in enumerate(self.toks):
+            if bs is None:
+                want = False
+            else:
+                want = feed(g, bs.decode("latin1"), st) is not None
+            assert bits[t] == want, (t, bs)
+
+    def test_eos_only_after_complete(self):
+        g = Grammar.any_object()
+        req = GuidedRequest(g, self.vocab, self.toks)
+        bits0 = self.unpack(req.mask())
+        assert not bits0[self.eos]
+        req.state = feed(g, '{"a": 1}')
+        bits1 = self.unpack(req.mask())
+        assert bits1[self.eos]
+
+    def test_advance_by_token_ids(self):
+        g = Grammar.from_schema(SCHEMA)
+        req = GuidedRequest(g, self.vocab, self.toks)
+        ids = [self.toks.index(b'{"name": "'), self.toks.index(b'b"')]
+        req.catch_up(ids)
+        assert not req.wedged
+        # next must allow ", " (towards "age") but never "}" (required
+        # age missing) nor EOS
+        bits = self.unpack(req.mask())
+        assert bits[self.toks.index(b', ')]
+        assert not bits[self.toks.index(bytes([0x7D]))]
+        assert not bits[self.eos]
+
+    def test_off_grammar_token_wedges_instead_of_poisoning(self):
+        g = Grammar.any_object()
+        req = GuidedRequest(g, self.vocab, self.toks)
+        req.catch_up([self.toks.index(b"true")])          # illegal at root
+        assert req.wedged
+        assert req.mask() is None
+
+    def test_mask_cache_reuses_states(self):
+        g = Grammar.any_object()
+        req = GuidedRequest(g, self.vocab, self.toks)
+        m1 = req.mask()
+        m2 = self.vocab.mask(g, req.state)
+        assert m1 is m2
+
+
+# ------------------------------------------------------------ engine e2e
+
+import asyncio  # noqa: E402
+
+from dynamo_tpu.engine.jax_engine import JaxEngine, JaxEngineConfig  # noqa: E402
+from dynamo_tpu.models.config import ModelConfig  # noqa: E402
+from dynamo_tpu.preprocessor.tokenizer import HfTokenizer  # noqa: E402
+from dynamo_tpu.protocols.common import (  # noqa: E402
+    FinishReason,
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.utils.testing import make_test_tokenizer  # noqa: E402
+
+
+def guided_engine():
+    tok = HfTokenizer(make_test_tokenizer())
+    eos = tok.token_to_id("<eos>")
+    cfg = ModelConfig.tiny(vocab_size=512)
+    eng = JaxEngine.random_init(cfg, JaxEngineConfig(
+        num_pages=128, page_size=4, max_num_seqs=4, max_prefill_chunk=16,
+        max_context=256, min_prefill_bucket=4))
+    # model vocab (512) > tokenizer vocab: enable_guided must pad the
+    # byte table itself or padded ids would read garbage mask bits
+    eng.enable_guided(tok.token_bytes(), [eos])
+    return eng, tok, eos, eng._guided_bytes
+
+
+def guided_req(guided, rid="g1", max_tokens=64, eos=None, temperature=0.0):
+    return PreprocessedRequest(
+        token_ids=[40, 41, 42], request_id=rid,
+        stop_conditions=StopConditions(max_tokens=max_tokens),
+        sampling_options=SamplingOptions(temperature=temperature,
+                                         guided=guided),
+        eos_token_ids=[eos] if eos is not None else [])
+
+
+async def run_req(eng, req):
+    frames = []
+    async for out in eng.generate(req):
+        frames.append(out)
+    return frames
+
+
+def text_of(frames, tb, eos=None):
+    ids = [t for f in frames for t in f.token_ids if t != eos]
+    return b"".join(tb[t] or b"" for t in ids).decode("utf-8", "replace")
+
+
+class TestEngineGuided:
+    async def test_const_schema_forces_exact_output(self):
+        eng, tok, eos, tb = guided_engine()
+        try:
+            req = guided_req({"mode": "json_schema",
+                              "schema": {"const": 5}}, eos=eos)
+            frames = await run_req(eng, req)
+            assert frames[-1].finish_reason == FinishReason.EOS
+            # leading whitespace (<= MAX_WS) before the root value is legal
+            assert text_of(frames, tb, eos).strip() == "5"
+        finally:
+            await eng.stop()
+
+    async def test_schema_object_output_conforms(self):
+        eng, tok, eos, tb = guided_engine()
+        try:
+            schema = {
+                "type": "object",
+                "properties": {"mood": {"enum": ["up", "dn"]},
+                               "n": {"type": "integer"}},
+                "required": ["mood", "n"],
+            }
+            req = guided_req({"mode": "json_schema", "schema": schema},
+                             eos=eos, max_tokens=96)
+            frames = await run_req(eng, req)
+            assert frames[-1].finish_reason == FinishReason.EOS
+            doc = json.loads(text_of(frames, tb, eos))
+            assert set(doc) <= {"mood", "n"}
+            assert doc["mood"] in ("up", "dn")
+            assert isinstance(doc["n"], int)
+        finally:
+            await eng.stop()
+
+    async def test_json_mode_prefix_always_legal(self):
+        eng, tok, eos, tb = guided_engine()
+        try:
+            req = guided_req({"mode": "json"}, eos=eos, max_tokens=24)
+            frames = await run_req(eng, req)
+            text = text_of(frames, tb, eos)
+            g = Grammar.any_object()
+            if frames[-1].finish_reason == FinishReason.EOS:
+                assert accepts(g, text)
+                json.loads(text)
+            else:  # length-truncated: still a legal JSON prefix
+                assert prefix_ok(g, text.lstrip())
+        finally:
+            await eng.stop()
+
+    async def test_mixed_batch_leaves_unguided_rows_untouched(self):
+        eng, tok, eos, tb = guided_engine()
+        try:
+            plain = guided_req(None, rid="p1", max_tokens=8)
+            solo = [t for f in await run_req(eng, plain)
+                    for t in f.token_ids]
+            g = guided_req({"mode": "json"}, rid="g2", eos=eos,
+                           max_tokens=24)
+            p2 = guided_req(None, rid="p2", max_tokens=8)
+            fg, fp = await asyncio.gather(run_req(eng, g), run_req(eng, p2))
+            assert [t for f in fp for t in f.token_ids] == solo
+            assert prefix_ok(Grammar.any_object(),
+                             text_of(fg, tb, eos).lstrip())
+        finally:
+            await eng.stop()
+
+    async def test_unarmed_engine_rejects_guided_requests(self):
+        cfg = ModelConfig.tiny()
+        eng = JaxEngine.random_init(cfg, JaxEngineConfig(
+            num_pages=16, page_size=4, max_num_seqs=2,
+            max_prefill_chunk=8, max_context=32))
+        try:
+            frames = await run_req(eng, guided_req({"mode": "json"}))
+            assert frames[-1].finish_reason == FinishReason.ERROR
+            assert "not available" in frames[-1].error
+        finally:
+            await eng.stop()
+
+    async def test_bad_schema_rejected_per_request(self):
+        eng, tok, eos, tb = guided_engine()
+        try:
+            req = guided_req({"mode": "json_schema",
+                              "schema": {"type": "string",
+                                         "pattern": "x+"}}, eos=eos)
+            frames = await run_req(eng, req)
+            assert frames[-1].finish_reason == FinishReason.ERROR
+            assert "response_format rejected" in frames[-1].error
+        finally:
+            await eng.stop()

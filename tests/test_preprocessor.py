@@ -251,3 +251,49 @@ async def test_backend_closes_engine_stream_on_early_exit():
     await gen.__anext__()
     await gen.aclose()
     assert closed == [True]
+
+
+def test_response_format_maps_to_guided(card):
+    """response_format flows to SamplingOptions.guided; bad specs 400 at
+    the frontend (ValueError) instead of erroring the worker stream."""
+    pre = OpenAIPreprocessor(card)
+
+    def chat(**kw):
+        return ChatCompletionRequest(
+            model="m", messages=[{"role": "user", "content": "hi"}], **kw)
+
+    assert pre.preprocess_chat(chat()).sampling_options.guided is None
+    assert pre.preprocess_chat(chat(
+        response_format={"type": "text"})).sampling_options.guided is None
+    got = pre.preprocess_chat(chat(
+        response_format={"type": "json_object"})).sampling_options.guided
+    assert got == {"mode": "json"}
+    schema = {"type": "object", "properties": {"a": {"type": "integer"}},
+              "required": ["a"]}
+    got = pre.preprocess_chat(chat(response_format={
+        "type": "json_schema",
+        "json_schema": {"name": "x", "schema": schema},
+    })).sampling_options.guided
+    assert got == {"mode": "json_schema", "schema": schema}
+
+    with pytest.raises(ValueError, match="response_format"):
+        pre.preprocess_chat(chat(response_format={"type": "grammar"}))
+    with pytest.raises(ValueError, match="schema must be an object"):
+        pre.preprocess_chat(chat(response_format={"type": "json_schema"}))
+    # unsupported schema keywords reject at the FRONTEND
+    with pytest.raises(ValueError, match="pattern"):
+        pre.preprocess_chat(chat(response_format={
+            "type": "json_schema",
+            "json_schema": {"schema": {"type": "string", "pattern": "x"}},
+        }))
+
+
+def test_guided_survives_wire_roundtrip(card):
+    from dynamo_tpu.protocols.common import PreprocessedRequest
+    pre = OpenAIPreprocessor(card)
+    req = ChatCompletionRequest(
+        model="m", messages=[{"role": "user", "content": "hi"}],
+        response_format={"type": "json_object"})
+    p = pre.preprocess_chat(req)
+    back = PreprocessedRequest.from_dict(p.to_dict())
+    assert back.sampling_options.guided == {"mode": "json"}
