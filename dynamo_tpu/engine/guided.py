@@ -151,7 +151,38 @@ class Grammar:
         # composite schemas (unions, type lists) compile their branch
         # nodes FIRST — the root is whatever _compile returns, not node 0
         g.root = g._compile(root)
+        g._check_union_cycles()
         return g
+
+    def _check_union_cycles(self) -> None:
+        """Reject schemas whose $ref/anyOf structure forms a cycle with no
+        intervening construct (e.g. ``a = {"$ref": "#/$defs/a"}``): such a
+        union dispatches to another union forever, so value dispatch would
+        recurse unboundedly at mask time — and a RecursionError on the
+        step thread would error every co-batched request, not 400 the one
+        degenerate schema."""
+        edges = {
+            i: {t for t in node[1].values()
+                if self.nodes[t][0] == "union"}
+            for i, node in enumerate(self.nodes) if node[0] == "union"
+        }
+        seen: Dict[int, int] = {}        # 0 = in progress, 1 = done
+
+        def visit(n: int) -> None:
+            state = seen.get(n)
+            if state == 0:
+                raise GuidedUnsupported(
+                    "$ref/anyOf cycle with no intervening object or "
+                    "array: the schema matches nothing")
+            if state == 1:
+                return
+            seen[n] = 0
+            for t in edges.get(n, ()):
+                visit(t)
+            seen[n] = 1
+
+        for n in edges:
+            visit(n)
 
     _IGNORED = frozenset((
         "title", "description", "default", "examples", "$schema", "$id",

@@ -103,8 +103,8 @@ class JaxEngineConfig:
     # verified K at a time in one [B, K+1] step (0 = off). Supersedes
     # pipelined decode while on — draft proposal needs the sampled tokens
     # on host, so steps can't chain; each step instead yields up to K+1
-    # tokens per row. Llama-family dense forwards (llama/mistral/qwen2/3)
-    # and gemma-2.
+    # tokens per row. Every built-in family serves speculated (their
+    # forwards carry logits_window); custom forward_fns (pp stages) do not.
     spec_tokens: int = 0
     spec_ngram_max: int = 4
     spec_ngram_min: int = 2
@@ -264,9 +264,8 @@ class JaxEngine(ScheduledEngineBase):
             if not has_window:
                 raise ValueError(
                     "spec_tokens>0 needs a family forward with "
-                    "logits_window support (the llama family tree — "
-                    "llama/mistral/qwen dense — and gemma-2); "
-                    f"{model_cfg.model_type!r} has none — drop "
+                    "logits_window support (all built-in families carry "
+                    f"it); {model_cfg.model_type!r} has none — drop "
                     "--speculative-num-tokens to serve it")
         self.table_width = self.cfg.max_context // self.cfg.page_size
         self._rng = jax.random.PRNGKey(self.cfg.seed)
@@ -494,17 +493,20 @@ class JaxEngine(ScheduledEngineBase):
             from dynamo_tpu.ops.pallas.prefill import (
                 paged_prefill_attention_stacked as attn)
         if self.attn_impl in ("scan", "pallas"):
-            logits, pages = self._forward(
+            out = self._forward(
                 params, self.model_cfg, tokens, positions, pages,
                 page_table, total_lens, new_lens,
                 **({"attn_impl": attn} if attn is not None else {}),
                 logits_window=tokens.shape[1])
         else:
             # unrolled paths: S > 1, so no decode kernel — XLA attention
-            logits, pages = self._forward_unrolled(
+            out = self._forward_unrolled(
                 params, self.model_cfg, tokens, positions, pages,
                 page_table, total_lens, new_lens,
                 logits_window=tokens.shape[1])
+        # MoE families return a third aux dict (dispatch drop counts)
+        logits, pages = out[0], out[1]
+        aux = out[2] if len(out) > 2 else {}
         key = jax.random.fold_in(rng, step)
         n_acc, final_tok, final_lp, draft_lps = spec_verify(
             logits, tokens, key, temperature, top_k, top_p)
@@ -516,7 +518,7 @@ class JaxEngine(ScheduledEngineBase):
             from jax.sharding import NamedSharding, PartitionSpec
             packed = jax.lax.with_sharding_constraint(
                 packed, NamedSharding(self.cfg.mesh, PartitionSpec()))
-        return pages, packed, {}
+        return pages, packed, aux
 
     def _ring_step_impl(self, params, pages, tokens, positions, page_table,
                         total_lens, new_lens, rng, step, temperature, top_k,
@@ -939,15 +941,15 @@ class JaxEngine(ScheduledEngineBase):
                 self.pages, jnp.asarray(a["ids"]), jnp.asarray(a["vals"]))
             return None
         if kind == "spec":
-            self.pages, packed, _aux = self._jit_spec(
+            # shares the post-step aux handling below: a MoE family's
+            # verify step reports dispatch drops like any other step
+            self.pages, packed, aux = self._jit_spec(
                 self.params, self.pages, jnp.asarray(a["toks"]),
                 jnp.asarray(a["pos"]), jnp.asarray(a["table"]),
                 jnp.asarray(a["total"]), jnp.asarray(a["new"]),
                 self._rng, np.int32(step), jnp.asarray(a["temp"]),
                 jnp.asarray(a["top_k"]), jnp.asarray(a["top_p"]))
-            self._last_packed = packed
-            return packed
-        if kind == "chained":
+        elif kind == "chained":
             prev = prev_packed if prev_packed is not None else self._last_packed
             pen = self._pen_arg(a, a["pos"].shape[0])
             self.pages, packed, aux = self._jit_chained(

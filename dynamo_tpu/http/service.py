@@ -488,7 +488,7 @@ class HttpService:
     # validate.rs)
     _RESPONSES_UNSUPPORTED = (
         "previous_response_id", "tools", "tool_choice", "reasoning",
-        "store", "truncation", "include", "parallel_tool_calls", "text",
+        "store", "truncation", "include", "parallel_tool_calls",
         "background")
 
     async def handle_responses(self, request: web.Request) -> web.Response:
@@ -524,6 +524,35 @@ class HttpService:
             messages.append({"role": "system",
                              "content": raw["instructions"]})
         messages.append({"role": "user", "content": raw["input"]})
+        # Responses API structured outputs: text.format carries the schema
+        # INLINE ({"type": "json_schema", "schema": ..., "name": ...});
+        # map to the chat response_format shape the engine understands
+        response_format = None
+        text_cfg = raw.get("text")
+        if text_cfg not in (None, {}):
+            if not isinstance(text_cfg, dict):
+                return _error(400, "text must be an object")
+            unknown = set(text_cfg) - {"format"}
+            if unknown:
+                return _error(
+                    501, f"unsupported text field(s): {sorted(unknown)}",
+                    "not_implemented")
+            fmt = text_cfg.get("format") or {}
+            if not isinstance(fmt, dict):
+                return _error(400, "text.format must be an object")
+            kind = fmt.get("type")
+            if kind in (None, "text"):
+                pass
+            elif kind == "json_object":
+                response_format = {"type": "json_object"}
+            elif kind == "json_schema":
+                response_format = {
+                    "type": "json_schema",
+                    "json_schema": {"name": fmt.get("name", "schema"),
+                                    "schema": fmt.get("schema")}}
+            else:
+                return _error(400,
+                              f"unsupported text.format type {kind!r}")
         try:
             chat = ChatCompletionRequest(
                 model=model,
@@ -531,6 +560,7 @@ class HttpService:
                 temperature=raw.get("temperature"),
                 top_p=raw.get("top_p"),
                 max_tokens=raw.get("max_output_tokens"),
+                response_format=response_format,
             )
         except ValidationError as e:
             return _error(400, f"invalid request: {e}")

@@ -544,7 +544,8 @@ def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
             positions: jnp.ndarray, pages: jnp.ndarray,
             page_table: jnp.ndarray, total_lens: jnp.ndarray,
             new_lens: jnp.ndarray,
-            attn_impl: Optional[Callable] = None, ep_mesh=None
+            attn_impl: Optional[Callable] = None, ep_mesh=None,
+            logits_window: int = 1
             ) -> Tuple[jnp.ndarray, jnp.ndarray, dict]:
     """Scan forward (llama.forward contract plus the ``aux`` third return
     carrying ``moe_dropped_assignments``, like models/moe.py). The GQA
@@ -585,14 +586,16 @@ def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
             (params["moe_layers"], K + jnp.arange(cfg.num_layers - K)))
         total_dropped = jnp.sum(drops)
     aux = {"moe_dropped_assignments": total_dropped}
-    return _logits(cfg, params, h, new_lens), pages, aux
+    return (_logits(cfg, params, h, new_lens, window=logits_window),
+            pages, aux)
 
 
 def forward_unrolled(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
                      positions: jnp.ndarray, pages_list: List[jnp.ndarray],
                      page_table: jnp.ndarray, total_lens: jnp.ndarray,
                      new_lens: jnp.ndarray,
-                     attn_impl: Optional[Callable] = None, ep_mesh=None
+                     attn_impl: Optional[Callable] = None, ep_mesh=None,
+                     logits_window: int = 1
                      ) -> Tuple[jnp.ndarray, List[jnp.ndarray], dict]:
     """Python-unrolled forward over per-layer latent buffers. An
     ``attn_impl`` carrying the ``pallas_paged_kernel`` marker opts S==1
@@ -618,7 +621,8 @@ def forward_unrolled(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
         total_dropped = total_dropped + dropped
         out_pages.append(kv)
     aux = {"moe_dropped_assignments": total_dropped}
-    return _logits(cfg, params, h, new_lens), out_pages, aux
+    return (_logits(cfg, params, h, new_lens, window=logits_window),
+            out_pages, aux)
 
 
 # ------------------------------------------------------------------ loader

@@ -215,7 +215,8 @@ def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
             positions: jnp.ndarray, pages: jnp.ndarray,
             page_table: jnp.ndarray, total_lens: jnp.ndarray,
             new_lens: jnp.ndarray,
-            attn_impl: Optional[Callable] = None, ep_mesh=None
+            attn_impl: Optional[Callable] = None, ep_mesh=None,
+            logits_window: int = 1
             ) -> Tuple[jnp.ndarray, jnp.ndarray, dict]:
     """Scan-over-layers MoE forward (llama.forward contract plus a third
     ``aux`` return: ``{"moe_dropped_assignments": scalar}`` summed over
@@ -237,14 +238,16 @@ def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     (h, pages), drops = jax.lax.scan(
         body, (h, pages), (params["layers"], jnp.arange(cfg.num_layers)))
     aux = {"moe_dropped_assignments": jnp.sum(drops)}
-    return _logits(cfg, params, h, new_lens), pages, aux
+    return (_logits(cfg, params, h, new_lens, window=logits_window),
+            pages, aux)
 
 
 def forward_unrolled(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
                      positions: jnp.ndarray, pages_list: List[jnp.ndarray],
                      page_table: jnp.ndarray, total_lens: jnp.ndarray,
                      new_lens: jnp.ndarray,
-                     attn_impl: Optional[Callable] = None, ep_mesh=None
+                     attn_impl: Optional[Callable] = None, ep_mesh=None,
+                     logits_window: int = 1
                      ) -> Tuple[jnp.ndarray, List[jnp.ndarray], dict]:
     """Unrolled MoE forward (llama.forward_unrolled contract plus the
     ``aux`` drop-count return, see ``forward``)."""
@@ -263,7 +266,8 @@ def forward_unrolled(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
         total_dropped = total_dropped + dropped
         out_pages.append(kv)
     aux = {"moe_dropped_assignments": total_dropped}
-    return _logits(cfg, params, h, new_lens), out_pages, aux
+    return (_logits(cfg, params, h, new_lens, window=logits_window),
+            out_pages, aux)
 
 
 __all__ = ["forward", "forward_unrolled", "init_params", "moe_mlp",

@@ -100,6 +100,9 @@ class ChatCompletionRequest(BaseModel):
             return {"mode": "json"}
         if kind == "json_schema":
             js = rf.get("json_schema") or {}
+            if not isinstance(js, dict):
+                raise ValueError(
+                    "response_format.json_schema must be an object")
             schema = js.get("schema")
             if not isinstance(schema, dict):
                 raise ValueError(

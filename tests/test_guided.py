@@ -266,6 +266,26 @@ class TestSchemaCompile:
         st = feed(g, "1")
         assert eos_ok(g, st)
 
+    def test_vacuous_ref_cycle_rejected_at_compile(self):
+        # a = $ref a matches nothing; it must 400 at compile, not
+        # RecursionError on the step thread (which would error the batch)
+        with pytest.raises(GuidedUnsupported, match="cycle"):
+            Grammar.from_schema({"$defs": {"a": {"$ref": "#/$defs/a"}},
+                                 "$ref": "#/$defs/a"})
+        with pytest.raises(GuidedUnsupported, match="cycle"):
+            Grammar.from_schema({
+                "$defs": {"a": {"$ref": "#/$defs/b"},
+                          "b": {"$ref": "#/$defs/a"}},
+                "$ref": "#/$defs/a"})
+
+    def test_non_dict_json_schema_field_is_value_error(self):
+        from dynamo_tpu.protocols.openai import ChatCompletionRequest
+        req = ChatCompletionRequest(
+            model="m", messages=[{"role": "user", "content": "x"}],
+            response_format={"type": "json_schema", "json_schema": "oops"})
+        with pytest.raises(ValueError, match="must be an object"):
+            req.guided_spec()
+
     def test_recursive_ref(self):
         g = Grammar.from_schema({
             "$defs": {"node": {

@@ -360,14 +360,67 @@ class TestEngineSpecDecode:
             await eng.stop()
         assert got == want
 
-    def test_unsupported_family_raises(self):
-        # the MoE family forward has no logits_window support: turning on
-        # speculation must fail loudly at construction, not serve silently
-        # without it
+    async def test_moe_family_greedy_identical_with_and_without_spec(self):
         cfg = ModelConfig.tiny(num_experts=4, num_experts_per_tok=2,
                                moe_intermediate_size=32,
                                model_type="qwen3_moe")
+        ecfg = dict(num_pages=64, page_size=4, max_num_seqs=4,
+                    max_prefill_chunk=16, max_context=64,
+                    min_prefill_bucket=4, spec_ngram_min=1)
+        base = JaxEngine.random_init(cfg, JaxEngineConfig(**ecfg))
+        try:
+            want = await _greedy_tokens(base, PROMPT, "base")
+        finally:
+            await base.stop()
+        eng = JaxEngine.random_init(
+            cfg, JaxEngineConfig(spec_tokens=3, **ecfg))
+        try:
+            got = await _greedy_tokens(eng, PROMPT, "spec")
+        finally:
+            await eng.stop()
+        assert got == want
+
+    @pytest.mark.async_timeout(240)
+    async def test_deepseek_greedy_identical_with_and_without_spec(self):
+        # MLA latent cache + MoE aux: the verify step runs the blockwise
+        # latent attention over a [B, K+1] chunk
+        cfg = ModelConfig(
+            vocab_size=256, hidden_size=64, intermediate_size=128,
+            num_layers=2, num_heads=4, num_kv_heads=1, head_dim=32,
+            model_type="deepseek_v2", dtype="float32",
+            q_lora_rank=0, kv_lora_rank=32, qk_rope_head_dim=16,
+            qk_nope_head_dim=32, v_head_dim=32,
+            num_experts=4, num_experts_per_tok=2, moe_intermediate_size=32,
+            n_shared_experts=2, first_k_dense_replace=1,
+            routed_scaling_factor=1.0)
+        ecfg = dict(num_pages=64, page_size=4, max_num_seqs=4,
+                    max_prefill_chunk=16, max_context=64,
+                    min_prefill_bucket=4, spec_ngram_min=1)
+        base = JaxEngine.random_init(cfg, JaxEngineConfig(**ecfg))
+        try:
+            want = await _greedy_tokens(base, PROMPT, "base")
+        finally:
+            await base.stop()
+        eng = JaxEngine.random_init(
+            cfg, JaxEngineConfig(spec_tokens=3, **ecfg))
+        try:
+            got = await _greedy_tokens(eng, PROMPT, "spec")
+        finally:
+            await eng.stop()
+        assert got == want
+
+    def test_custom_forward_fn_raises(self):
+        # custom forwards (pipeline-parallel stage bodies) cannot carry
+        # the verify step's logits window: loud error, not silent no-spec
+        cfg = ModelConfig.tiny()
+        from dynamo_tpu.models import llama
+
+        def custom_forward(*a, **k):
+            return llama.forward(*a, **k)
+
+        params = llama.init_params(cfg, __import__("jax").random.PRNGKey(0))
         with pytest.raises(ValueError, match="spec_tokens"):
-            JaxEngine.random_init(cfg, JaxEngineConfig(
+            JaxEngine(cfg, params, JaxEngineConfig(
                 num_pages=16, page_size=4, max_num_seqs=2,
-                max_prefill_chunk=8, max_context=32, spec_tokens=2))
+                max_prefill_chunk=8, max_context=32, spec_tokens=2),
+                forward_fn=custom_forward)

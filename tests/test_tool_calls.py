@@ -191,6 +191,49 @@ class TestHttpWiring:
         finally:
             await service.stop()
 
+    async def test_responses_text_format_maps_to_guided(self):
+        """Responses API structured outputs: ``text.format`` carries the
+        schema inline; the bridge maps it to chat response_format (and so
+        to the engine's guided decoding). Bad schemas 400 with the grammar
+        compiler's message; unknown text subfields stay 501."""
+        service = await _service_for('{"a": 1}')
+        base = f"http://127.0.0.1:{service.port}/v1/responses"
+        try:
+            async with aiohttp.ClientSession() as s:
+                # json_schema format flows through (echo engine ignores
+                # the constraint; the plumbing must accept + 200)
+                r = await (await s.post(base, json={
+                    "model": "tool-model", "input": "hi",
+                    "text": {"format": {
+                        "type": "json_schema", "name": "t",
+                        "schema": {"type": "object"}}}})).json()
+                assert r["status"] == "completed"
+                # json_object too
+                resp = await s.post(base, json={
+                    "model": "tool-model", "input": "hi",
+                    "text": {"format": {"type": "json_object"}}})
+                assert resp.status == 200
+                # unsupported schema keyword -> 400 at the frontend
+                resp = await s.post(base, json={
+                    "model": "tool-model", "input": "hi",
+                    "text": {"format": {
+                        "type": "json_schema", "name": "t",
+                        "schema": {"type": "string", "pattern": "x"}}}})
+                assert resp.status == 400
+                assert "pattern" in json.dumps(await resp.json())
+                # unknown text subfield -> 501
+                resp = await s.post(base, json={
+                    "model": "tool-model", "input": "hi",
+                    "text": {"verbosity": "low"}})
+                assert resp.status == 501
+                # unknown format type -> 400
+                resp = await s.post(base, json={
+                    "model": "tool-model", "input": "hi",
+                    "text": {"format": {"type": "grammar"}}})
+                assert resp.status == 400
+        finally:
+            await service.stop()
+
     async def test_without_tools_text_passes_through(self):
         text = '{"name": "get_weather", "parameters": {"city": "Paris"}}'
         service = await _service_for(text)
