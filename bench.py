@@ -165,7 +165,8 @@ def tree_bytes(tree) -> int:
                for x in jax.tree_util.tree_leaves(tree))
 
 
-def _build_engine(tier: str, attn_impl: str, quantize: str = ""):
+def _build_engine(tier: str, attn_impl: str, quantize: str = "",
+                  spec_tokens: int = 0):
     """Build the engine for a tier; config is deterministic per tier so the
     persistent compile-cache keys match across runs."""
     import jax
@@ -197,9 +198,26 @@ def _build_engine(tier: str, attn_impl: str, quantize: str = ""):
         max_context=max_ctx, min_prefill_bucket=min(512, prompt),
         min_prefill_seqs_bucket=prefill_seqs,
         min_decode_bucket=seqs,
-        attn_impl=attn_impl, quantize=quantize)
+        attn_impl=attn_impl, quantize=quantize, spec_tokens=spec_tokens)
     engine = JaxEngine.random_init(cfg, ecfg)
     return engine, cfg, (seqs, prompt, gen, prefill_seqs), on_tpu
+
+
+def _step_arrays(P: int, B: int, S: int) -> dict:
+    """Synthetic padded step arrays (garbage-page writes): the ONE
+    construction priming and the step-timing legs share, so they always
+    dispatch identically-shaped programs."""
+    import numpy as np
+
+    return dict(
+        toks=np.zeros((B, S), np.int32),
+        pos=np.tile(np.arange(S, dtype=np.int32)[None], (B, 1)),
+        table=np.zeros((B, P), np.int32),
+        total=np.full((B,), S, np.int32),
+        new=np.zeros((B,), np.int32),  # nothing written: garbage page
+        temp=np.zeros((B,), np.float32),
+        top_k=np.zeros((B,), np.int32),
+        top_p=np.ones((B,), np.float32))
 
 
 def _prime_programs(engine, seqs: int, prompt: int, prefill_seqs: int,
@@ -211,24 +229,11 @@ def _prime_programs(engine, seqs: int, prompt: int, prefill_seqs: int,
     program — the on-chip compile-time diagnostic three rounds of failed
     benches never produced."""
     import jax
-    import numpy as np
 
     P = engine.table_width
-
-    def arrays(B, S):
-        return dict(
-            toks=np.zeros((B, S), np.int32),
-            pos=np.tile(np.arange(S, dtype=np.int32)[None], (B, 1)),
-            table=np.zeros((B, P), np.int32),
-            total=np.full((B,), S, np.int32),
-            new=np.zeros((B,), np.int32),  # nothing written: garbage page
-            temp=np.zeros((B,), np.float32),
-            top_k=np.zeros((B,), np.int32),
-            top_p=np.ones((B,), np.float32))
-
-    plans = [("prefill", "step", arrays(prefill_seqs, prompt)),
-             ("decode", "step", arrays(seqs, 1)),
-             ("chained", "chained", arrays(seqs, 1))]
+    plans = [("prefill", "step", _step_arrays(P, prefill_seqs, prompt)),
+             ("decode", "step", _step_arrays(P, seqs, 1)),
+             ("chained", "chained", _step_arrays(P, seqs, 1))]
     for name, kind, a in plans:
         wd.arm(f"prime:{name}", STAGE_BUDGETS["prime"])
         t0 = time.perf_counter()
@@ -486,8 +491,72 @@ async def run_attempt(args) -> dict:
         result["quant"] = {"mode": "int8",
                            "error": f"skipped (remaining {remaining:.0f}s"
                                     f" < {STAGE_BUDGETS['ab']:.0f}s)"}
+
+    # speculative-decoding leg: time the [B, K+1] verify step against the
+    # [B, 1] decode step DIRECTLY (synthetic arrays, no scheduler). A
+    # random-weight model accepts ~nothing, so end-to-end spec tok/s would
+    # measure the model, not the machinery; the step-time ratio gives the
+    # honest engine numbers — breakeven acceptance (spec wins when
+    # 1 + E[accepted] > t_verify/t_decode) and the ceiling speedup at
+    # full acceptance.
+    remaining = deadline - time.monotonic()
+    SPEC_K = 4
+    if tpu_run and remaining >= STAGE_BUDGETS["ab"]:
+        engine3 = None  # release the quant leg's int8 params before
+        engine5 = None  # a fifth engine builds
+        try:
+            wd.arm("spec:build", STAGE_BUDGETS["engine_build"])
+            engine5, cfg5, geo5, _ = _build_engine(
+                args.tier, result["attn_impl"], spec_tokens=SPEC_K)
+            _ckpt("spec_engine_built", k=SPEC_K)
+            t_dec = _time_step_kind(engine5, "step", geo5[0], 1, wd,
+                                    "spec:decode")
+            t_ver = _time_step_kind(engine5, "spec", geo5[0], SPEC_K + 1,
+                                    wd, "spec:verify")
+            result["spec"] = {
+                "k": SPEC_K,
+                "decode_step_ms": round(t_dec * 1e3, 2),
+                "verify_step_ms": round(t_ver * 1e3, 2),
+                "step_ratio": round(t_ver / t_dec, 3),
+                "breakeven_acceptance": round(
+                    max(0.0, (t_ver / t_dec - 1.0)) / SPEC_K, 3),
+                "speedup_at_full_acceptance": round(
+                    (1 + SPEC_K) * t_dec / t_ver, 2),
+            }
+            print(json.dumps(result), flush=True)
+        except Exception as e:  # best-effort extra data
+            result["spec"] = {"k": SPEC_K, "error": str(e)[:300]}
+        finally:
+            if engine5 is not None:
+                try:
+                    await engine5.stop()
+                except Exception:
+                    pass
+    elif tpu_run:
+        result["spec"] = {"k": SPEC_K,
+                          "error": f"skipped (remaining {remaining:.0f}s"
+                                   f" < {STAGE_BUDGETS['ab']:.0f}s)"}
     wd.disarm()
     return result
+
+
+def _time_step_kind(engine, kind: str, B: int, S: int, wd: Watchdog,
+                    label: str, reps: int = 30) -> float:
+    """Median wall time of one jitted step dispatched via _invoke_step
+    with garbage-page synthetic arrays (compile included in warmup)."""
+    import jax
+
+    a = _step_arrays(engine.table_width, B, S)
+    wd.arm(f"prime:{label}", STAGE_BUDGETS["prime"])
+    jax.block_until_ready(engine._invoke_step(kind, a, 0))
+    wd.arm(f"measure:{label}", STAGE_BUDGETS["measure"])
+    times = []
+    for i in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(engine._invoke_step(kind, a, i + 1))
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    return times[len(times) // 2]
 
 
 # target bytes per transport measurement: small samples measure framing

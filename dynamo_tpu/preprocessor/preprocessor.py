@@ -34,6 +34,26 @@ from dynamo_tpu.protocols.openai import (
 
 logger = logging.getLogger(__name__)
 
+# frontend-side guided-spec validation cache: apps typically post the SAME
+# json_schema on every request, and compiling a grammar per request on the
+# service event loop would be pure waste — remember which canonical specs
+# compiled cleanly (the worker keeps its own grammar cache for serving)
+_GUIDED_OK: Dict[str, bool] = {}
+_GUIDED_OK_CAP = 128
+
+
+def _validate_guided_spec(spec: Dict[str, Any]) -> None:
+    import json as _json
+
+    key = _json.dumps(spec, sort_keys=True)
+    if _GUIDED_OK.get(key):
+        return
+    from dynamo_tpu.engine.guided import compile_guided
+    compile_guided(spec)   # raises GuidedUnsupported (a ValueError)
+    if len(_GUIDED_OK) >= _GUIDED_OK_CAP:
+        _GUIDED_OK.pop(next(iter(_GUIDED_OK)))
+    _GUIDED_OK[key] = True
+
 # annotation keys (parity: reference nvext annotations "formatted_prompt",
 # "token_ids", "query_instance_id")
 ANNOTATION_FORMATTED_PROMPT = "formatted_prompt"
@@ -142,14 +162,13 @@ class OpenAIPreprocessor:
             # than the request asked for
             engine_k = getattr(self.card, "num_top_logprobs", 20)
             logprobs = min(logprobs, 20, engine_k)
-        # response_format -> guided decoding; the grammar is compiled here
-        # too (and discarded) so a bad schema 400s at the frontend instead
-        # of erroring the stream at the worker
+        # response_format -> guided decoding; the grammar is validated
+        # here too so a bad schema 400s at the frontend instead of
+        # erroring the stream at the worker
         guided = (req.guided_spec()
                   if isinstance(req, ChatCompletionRequest) else None)
         if guided is not None:
-            from dynamo_tpu.engine.guided import compile_guided
-            compile_guided(guided)  # raises GuidedUnsupported (ValueError)
+            _validate_guided_spec(guided)
         sampling = SamplingOptions(
             temperature=req.temperature,
             top_p=req.top_p,

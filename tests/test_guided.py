@@ -266,6 +266,26 @@ class TestSchemaCompile:
         st = feed(g, "1")
         assert eos_ok(g, st)
 
+    def test_nullable_recursive_ref_union(self):
+        # the common linked-list shape: anyOf [$ref, null] where the ref
+        # is still compiling when the union forms — dispatch must resolve
+        # to the FINISHED ref ('{' vs 'n' are disjoint), not reject
+        g = Grammar.from_schema({
+            "$defs": {"node": {
+                "type": "object",
+                "properties": {"v": {"type": "integer"},
+                               "next": {"anyOf": [
+                                   {"$ref": "#/$defs/node"},
+                                   {"type": "null"}]}},
+                "required": ["v", "next"],
+            }},
+            "$ref": "#/$defs/node",
+        })
+        assert accepts(g, '{"v": 1, "next": null}')
+        assert accepts(
+            g, '{"v": 1, "next": {"v": 2, "next": null}}')
+        assert not accepts(g, '{"v": 1, "next": 5}')
+
     def test_vacuous_ref_cycle_rejected_at_compile(self):
         # a = $ref a matches nothing; it must 400 at compile, not
         # RecursionError on the step thread (which would error the batch)
@@ -345,6 +365,23 @@ class TestMasks:
             else:
                 want = feed(g, bs.decode("latin1"), st) is not None
             assert bits[t] == want, (t, bs)
+
+    def test_string_state_mask_matches_bruteforce(self):
+        # the string-interior fast path must agree with stepping every
+        # token, for DIFFERENT stacks below the same string frame
+        g = Grammar.from_schema(SCHEMA)
+        for prefix in ('{"name": "par', '{"name": "x", "tags": ["t'):
+            st = feed(g, prefix)
+            assert st is not None and st[-1] == ("str",)
+            req = GuidedRequest(g, self.vocab, self.toks)
+            req.state = st
+            bits = self.unpack(req.mask())
+            for t, bs in enumerate(self.toks):
+                if bs is None:
+                    want = False
+                else:
+                    want = feed(g, bs.decode("latin1"), st) is not None
+                assert bits[t] == want, (prefix, t, bs)
 
     def test_eos_only_after_complete(self):
         g = Grammar.any_object()
