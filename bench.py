@@ -491,6 +491,11 @@ async def run_attempt(args) -> dict:
         result["quant"] = {"mode": "int8",
                            "error": f"skipped (remaining {remaining:.0f}s"
                                     f" < {STAGE_BUDGETS['ab']:.0f}s)"}
+    if "quant" in result:
+        # checkpoint the quant numbers before the spec leg arms: the
+        # orchestrator takes the LAST parseable stdout line, so a watchdog
+        # kill mid-spec must not discard an already-measured extra
+        print(json.dumps(result), flush=True)
 
     # speculative-decoding leg: time the [B, K+1] verify step against the
     # [B, 1] decode step DIRECTLY (synthetic arrays, no scheduler). A

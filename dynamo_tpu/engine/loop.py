@@ -356,17 +356,40 @@ class ScheduledEngineBase(EngineBase):
     async def _loop(self) -> None:
         try:
             await self._loop_body()
-        except BaseException:
-            if not self._stopping and self.on_loop_exit is not None:
-                try:
-                    self.on_loop_exit()
-                except Exception:
-                    logger.exception("on_loop_exit hook failed")
+        except BaseException as e:
+            if not self._stopping:
+                # the loop is dead: every in-flight and queued request
+                # would otherwise hang forever on a queue nobody fills —
+                # fail them all NOW (found live: a host-side bookkeeping
+                # bug froze every open stream with zero signal)
+                logger.exception("engine loop died")
+                self._fail_all_requests(e)
+                if self.on_loop_exit is not None:
+                    try:
+                        self.on_loop_exit()
+                    except Exception:
+                        logger.exception("on_loop_exit hook failed")
             raise
         finally:
             # whether stopped or crashed, nobody will drain the queue again —
             # fail pending exclusive work so callers don't hang forever
             self._fail_exclusive("engine loop exited")
+
+    def _fail_all_requests(self, e: BaseException) -> None:
+        """Terminate every active and waiting stream with an ERROR frame."""
+        err = f"engine loop died: {e}"
+        for seq in list(self.scheduler.active.values()):
+            try:
+                self.scheduler.finish(seq)
+            except Exception:  # noqa: BLE001 — emit the frame regardless
+                logger.exception("finish during loop-death cleanup failed")
+            self._emit(seq, LLMEngineOutput(
+                finish_reason=FinishReason.ERROR, error=err))
+        while self.scheduler.waiting:
+            seq = self.scheduler.waiting.popleft()
+            self._emit(seq, LLMEngineOutput(
+                finish_reason=FinishReason.ERROR, error=err))
+        self._drain_reaped()
 
     def _fail_exclusive(self, reason: str) -> None:
         while self._exclusive:
@@ -497,6 +520,14 @@ class ScheduledEngineBase(EngineBase):
     async def generate(self, request: PreprocessedRequest,
                        ctx=None) -> AsyncIterator[LLMEngineOutput]:
         await self.start()
+        if (self._loop_task is not None and self._loop_task.done()
+                and not self._stopping):
+            # the loop died earlier: requests arriving AFTER
+            # _fail_all_requests ran would otherwise enqueue onto a
+            # scheduler no loop will ever drain
+            yield LLMEngineOutput(finish_reason=FinishReason.ERROR,
+                                  error="engine loop is dead")
+            return
         rid = request.request_id or f"req-{id(request):x}"
         request.request_id = rid
         if len(request.token_ids) >= self.max_context:

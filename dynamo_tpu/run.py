@@ -52,6 +52,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-prefill-chunk", type=int, default=1024)
     p.add_argument("--dtype", default="bfloat16")
     p.add_argument("--tensor-parallel-size", type=int, default=1)
+    p.add_argument("--speculative-num-tokens", type=int, default=0,
+                   help="n-gram prompt-lookup speculative decoding "
+                        "(see worker.main --speculative-num-tokens)")
     return p
 
 
@@ -88,19 +91,23 @@ def build_engine_and_card(out: str, args) -> Tuple[EngineBase, ModelDeploymentCa
         if not args.model_path:
             raise SystemExit("out=jax requires --model-path")
         from dynamo_tpu.models.hub import resolve_model_path
-        from dynamo_tpu.worker.main import build_engine
+        from dynamo_tpu.worker.main import (
+            arm_guided, build_engine, build_parser)
         args.model_path = resolve_model_path(args.model_path)
         card = ModelDeploymentCard.from_local_path(args.model_path,
                                                    name=args.model_name)
-        ns = argparse.Namespace(
-            model_path=args.model_path, dtype=args.dtype,
-            num_pages=args.num_pages, page_size=args.page_size,
-            max_num_seqs=args.max_num_seqs,
-            max_prefill_chunk=args.max_prefill_chunk,
-            max_context=args.max_context,
-            tensor_parallel_size=args.tensor_parallel_size,
-            random_weights=args.random_weights)
-        return build_engine(ns), card
+        # start from the WORKER parser's own defaults so build_engine's
+        # knob set can grow without silently breaking this CLI (found
+        # live: a hand-built Namespace was missing every flag added since)
+        ns = build_parser().parse_args(["--model-path", args.model_path])
+        for k in ("dtype", "num_pages", "page_size", "max_num_seqs",
+                  "max_prefill_chunk", "max_context",
+                  "tensor_parallel_size", "random_weights",
+                  "speculative_num_tokens"):
+            setattr(ns, k, getattr(args, k))
+        engine = build_engine(ns)
+        arm_guided(engine, card)
+        return engine, card
     raise SystemExit(f"unknown engine {out!r}; choose echo|mocker|jax")
 
 

@@ -141,6 +141,20 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def arm_guided(engine, card) -> None:
+    """Give the engine the tokenizer's byte vocabulary so response_format
+    guided decoding works; a failure disables the feature, never the
+    process. Shared by the worker and the single-process run CLI."""
+    if not hasattr(engine, "enable_guided"):
+        return
+    try:
+        engine.enable_guided(card.load_tokenizer().token_bytes(),
+                             card.eos_token_ids)
+    except Exception:  # noqa: BLE001 — guided off beats worker down
+        logging.getLogger(__name__).exception(
+            "guided decoding disabled: token_bytes extraction failed")
+
+
 def build_engine(args: argparse.Namespace) -> JaxEngine:
     is_gguf = args.model_path.endswith(".gguf")
     if is_gguf:
@@ -263,13 +277,7 @@ async def amain(args: argparse.Namespace) -> None:
     card.penalty_window = engine.cfg.penalty_window
     # arm guided decoding (response_format): the engine needs the
     # tokenizer's byte view of the vocabulary to walk grammar masks
-    if hasattr(engine, "enable_guided"):
-        try:
-            engine.enable_guided(card.load_tokenizer().token_bytes(),
-                                 card.eos_token_ids)
-        except Exception:  # noqa: BLE001 — guided off beats worker down
-            logging.getLogger(__name__).exception(
-                "guided decoding disabled: token_bytes extraction failed")
+    arm_guided(engine, card)
 
     # a dead engine loop takes the worker's registration down with it, so
     # routers stop sending to a zombie (reference: task.rs critical tasks)

@@ -267,6 +267,35 @@ async def collect(engine, req):
     return frames
 
 
+class TestLoopDeath:
+    async def test_loop_death_errors_streams_instead_of_hanging(self):
+        """An exception in the loop's HOST-side bookkeeping (outside the
+        per-plan try blocks) must terminate every open stream with an
+        ERROR frame — not leave them waiting on a queue nobody fills."""
+        eng = tiny_engine()
+        try:
+            boom = RuntimeError("bookkeeping bug")
+
+            def bad_process(plan, *a, **k):
+                raise boom
+
+            eng._process = bad_process
+            req = make_req([1, 2, 3, 4, 5], "r1", max_tokens=4)
+            req.eos_token_ids = []
+            frames = await asyncio.wait_for(collect(eng, req), timeout=20)
+            assert frames[-1].finish_reason == FinishReason.ERROR
+            assert "engine loop died" in frames[-1].error
+            assert eng._loop_task.done()
+            # a request arriving AFTER the death must fail fast too, not
+            # enqueue onto a scheduler no loop will ever drain
+            late = make_req([1, 2, 3], "late", max_tokens=2)
+            frames2 = await asyncio.wait_for(collect(eng, late), timeout=10)
+            assert frames2[-1].finish_reason == FinishReason.ERROR
+            assert "loop is dead" in frames2[-1].error
+        finally:
+            await eng.stop()
+
+
 class TestJaxEngine:
     async def test_generates_max_tokens(self):
         eng = tiny_engine()

@@ -1,0 +1,39 @@
+"""The single-process ``dynamo_tpu.run`` CLI, driven as a real process.
+
+Model for coverage: reference ``launch/dynamo-run`` smoke flows. The
+``out=jax`` path regressed once already — the CLI built its engine from a
+hand-rolled Namespace that silently lacked every worker flag added after
+it was written — so this drives the REAL subprocess end to end (batch
+in, jsonl out), with speculation on to cover the flag plumbing.
+"""
+
+import json
+import subprocess
+import sys
+
+from dynamo_tpu.utils.testing import make_test_model_dir
+
+
+def test_batch_jax_engine_end_to_end(tmp_path):
+    model_dir = make_test_model_dir(str(tmp_path / "m"))
+    prompts = tmp_path / "prompts.jsonl"
+    prompts.write_text(
+        json.dumps({"prompt": "one two three one two three", "max_tokens": 6})
+        + "\n" + json.dumps({"prompt": "hello", "max_tokens": 4}) + "\n")
+    out = tmp_path / "out.jsonl"
+    proc = subprocess.run(
+        [sys.executable, "-m", "dynamo_tpu.run",
+         f"in=batch:{prompts}", "out=jax",
+         "--model-path", model_dir, "--random-weights",
+         "--num-pages", "64", "--page-size", "4", "--max-num-seqs", "4",
+         "--max-prefill-chunk", "16", "--max-context", "128",
+         "--dtype", "float32",
+         "--speculative-num-tokens", "2",
+         "--output", str(out)],
+        capture_output=True, text=True, timeout=420)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [json.loads(l) for l in out.read_text().splitlines()]
+    assert len(lines) == 2
+    assert lines[0]["index"] == 0 and lines[1]["index"] == 1
+    for r in lines:
+        assert isinstance(r["text"], str)
