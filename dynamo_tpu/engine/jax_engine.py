@@ -100,14 +100,17 @@ class JaxEngineConfig:
     # step-at-a-time debugging.
     pipeline_decode: bool = True
     # speculative decoding (engine/spec.py): n-gram prompt-lookup drafts
-    # verified K at a time in one [B, K+1] step (0 = off). Supersedes
-    # pipelined decode while on — draft proposal needs the sampled tokens
-    # on host, so steps can't chain; each step instead yields up to K+1
-    # tokens per row. Every built-in family serves speculated (their
-    # forwards carry logits_window); custom forward_fns (pp stages) do not.
+    # verified K at a time in one [B, K+1] step (0 = off), yielding up to
+    # K+1 tokens per step. Composes with pipelined decode: verify steps
+    # can't chain (drafts need the sampled tokens host-side), but plain
+    # decode steps between them still hide the readback, with the chain
+    # broken every spec_chain_break steps to let fresh context draft.
+    # Every built-in family serves speculated (their forwards carry
+    # logits_window); custom forward_fns (pp stages) do not.
     spec_tokens: int = 0
     spec_ngram_max: int = 4
     spec_ngram_min: int = 2
+    spec_chain_break: int = 8
     # mesh/sharding hooks (filled by dynamo_tpu.parallel when multi-chip)
     shard_params_fn: Optional[Callable] = None
     shard_pages_fn: Optional[Callable] = None
@@ -169,7 +172,8 @@ class JaxEngine(ScheduledEngineBase):
             ring_threshold=ring_threshold,
             spec_tokens=int(self.cfg.spec_tokens or 0),
             spec_ngram_max=self.cfg.spec_ngram_max,
-            spec_ngram_min=self.cfg.spec_ngram_min)
+            spec_ngram_min=self.cfg.spec_ngram_min,
+            spec_chain_break=self.cfg.spec_chain_break)
         self.params = params
         from dynamo_tpu.models import get_family
         family = get_family(model_cfg)
@@ -864,11 +868,12 @@ class JaxEngine(ScheduledEngineBase):
 
     @property
     def supports_pipelining(self) -> bool:
-        # speculative decoding supersedes chaining: draft proposal needs
-        # the sampled tokens host-side, so steps cannot consume the
-        # previous step's on-device output — they multiply tokens/step
-        # instead of hiding the readback
-        return self.cfg.pipeline_decode and not self.spec_K
+        # speculation and chaining COMPOSE: verify steps themselves can't
+        # chain (drafts need the sampled tokens host-side), but plain
+        # decode steps between them still do — the scheduler breaks a
+        # chain every spec_chain_break steps so fresh context gets a
+        # chance to draft (plan_chained)
+        return self.cfg.pipeline_decode
 
     def dispatch_decode(self, plan):
         """Dispatch one decode step WITHOUT fetching its results; returns

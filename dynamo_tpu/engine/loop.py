@@ -59,7 +59,7 @@ class ScheduledEngineBase(EngineBase):
                  max_prefill_seqs: int = 8,
                  ring_threshold: Optional[int] = None,
                  spec_tokens: int = 0, spec_ngram_max: int = 4,
-                 spec_ngram_min: int = 2):
+                 spec_ngram_min: int = 2, spec_chain_break: int = 8):
         if max_context % page_size:
             raise ValueError("max_context must be a multiple of page_size")
         self.max_context = max_context
@@ -69,7 +69,8 @@ class ScheduledEngineBase(EngineBase):
             max_prefill_seqs=max_prefill_seqs,
             ring_threshold=ring_threshold,
             spec_tokens=spec_tokens, spec_ngram_max=spec_ngram_max,
-            spec_ngram_min=spec_ngram_min))
+            spec_ngram_min=spec_ngram_min,
+            spec_chain_break=spec_chain_break))
         self.scheduler.max_context_hint = max_context
         self._queues: Dict[str, asyncio.Queue] = {}
         self._work = asyncio.Event()

@@ -158,6 +158,12 @@ class SchedulerConfig:
     spec_tokens: int = 0
     spec_ngram_max: int = 4
     spec_ngram_min: int = 2
+    # with speculation on, plain decode steps may still CHAIN (pipelined
+    # decode) when no draft matched — but a chain never consults the
+    # proposer, so it is broken after this many consecutive chained steps
+    # to give fresh context a chance to draft. 0 disables chaining while
+    # speculation is on.
+    spec_chain_break: int = 8
 
 
 class Scheduler:
@@ -185,6 +191,9 @@ class Scheduler:
         # speculative-decode acceptance counters (reference surface:
         # SpecDecodeStats in the metrics plane, protocols/events.py)
         self.spec_stats = SpecDecodeStats()
+        # consecutive chained steps since the last schedule() (the
+        # spec_chain_break counter)
+        self._chain_run = 0
 
     def drain_reaped(self) -> List[Sequence]:
         out, self.reaped = self.reaped, []
@@ -393,6 +402,7 @@ class Scheduler:
 
     def schedule(self) -> Optional[StepPlan]:
         """Pick the next engine step, or None if there is nothing to run."""
+        self._chain_run = 0
         # drop cancelled active sequences
         for seq in [s for s in self.active.values() if s.cancelled]:
             self.finish(seq)
@@ -553,6 +563,13 @@ class Scheduler:
         """
         if self.waiting:
             return None
+        if self.cfg.spec_tokens > 0:
+            # chains never consult the draft proposer: break periodically
+            # so repetitive context gets its verify steps (the chain's
+            # readback-hiding covers the non-matching stretches)
+            if (self.cfg.spec_chain_break <= 0
+                    or self._chain_run >= self.cfg.spec_chain_break):
+                return None
         for seq in prev.seqs:
             if seq.phase is not Phase.RUNNING or seq.cancelled:
                 return None
@@ -589,6 +606,7 @@ class Scheduler:
                     seq.page_ids.extend(self.alloc.allocate(need))
                 except OutOfPages:
                     return None
+        self._chain_run += 1
         return DecodeBatch(seqs=list(prev.seqs))
 
     def on_step_done(self, plan: StepPlan) -> None:

@@ -94,7 +94,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--speculative-num-tokens", type=int, default=0,
                    help="n-gram prompt-lookup speculative decoding: "
                         "drafts verified per [B, K+1] step (0 disables; "
-                        "llama-family dense models; supersedes pipelined "
+                        "all built-in families; composes with pipelined "
                         "decode — engine/spec.py)")
     p.add_argument("--speculative-ngram-max", type=int, default=4,
                    help="largest context-suffix n-gram the prompt-lookup "

@@ -294,6 +294,24 @@ class TestEngineSpecDecode:
         finally:
             await eng.stop()
 
+    @pytest.mark.parametrize("chain_break", [0, 1, 8])
+    async def test_chained_and_spec_steps_interleave_identically(
+            self, chain_break):
+        # speculation composes with pipelined decode: plain steps chain
+        # between verify steps (broken every spec_chain_break). Greedy
+        # output must be identical for any break cadence.
+        base = spec_engine(spec_tokens=0)
+        try:
+            want = await _greedy_tokens(base, PROMPT, "base", 12)
+        finally:
+            await base.stop()
+        eng = spec_engine(spec_tokens=3, spec_chain_break=chain_break)
+        try:
+            got = await _greedy_tokens(eng, PROMPT, "spec", 12)
+        finally:
+            await eng.stop()
+        assert got == want
+
     async def test_max_tokens_exact_under_spec(self):
         eng = spec_engine(spec_tokens=3)
         try:
