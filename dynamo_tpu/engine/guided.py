@@ -254,6 +254,14 @@ class Grammar:
             return self._compile_union(
                 [self._compile({**s, "type": one}) for one in t])
         if t == "object" or (t is None and "properties" in s):
+            if ("properties" not in s and not s.get("required")
+                    and s.get("additionalProperties") is None):
+                # bare {"type": "object"}: standard JSON-Schema semantics —
+                # ANY keys and values (the forced-tool-call envelope's
+                # unconstrained `arguments` relies on this). Declaring
+                # `properties` (or additionalProperties: false) switches to
+                # the CLOSED structured-outputs object.
+                return self._push_node(("obj", None, None, frozenset()))
             props_s = s.get("properties") or {}
             req = frozenset(s.get("required") or ())
             missing = req - set(props_s)

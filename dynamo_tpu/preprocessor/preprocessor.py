@@ -169,6 +169,23 @@ class OpenAIPreprocessor:
                   if isinstance(req, ChatCompletionRequest) else None)
         if guided is not None:
             _validate_guided_spec(guided)
+        elif isinstance(req, ChatCompletionRequest):
+            # forced function calling (tool_choice 'required' / named):
+            # constrain generation to a parseable tool-call JSON. A tool's
+            # own parameter schema may use keywords the grammar cannot
+            # enforce — degrade its arguments to any-object rather than
+            # rejecting the user's tools (unlike response_format, the
+            # schema here is OURS, not the client's explicit ask).
+            from dynamo_tpu.preprocessor.tools import (
+                degrade_tool_spec, forced_tool_guided_spec)
+            forced = forced_tool_guided_spec(req.tools, req.tool_choice)
+            if forced is not None:
+                try:
+                    _validate_guided_spec(forced)
+                except ValueError:
+                    forced = degrade_tool_spec(forced)
+                    _validate_guided_spec(forced)
+                guided = forced
         sampling = SamplingOptions(
             temperature=req.temperature,
             top_p=req.top_p,

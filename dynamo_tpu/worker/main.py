@@ -101,6 +101,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "proposer matches")
     p.add_argument("--speculative-ngram-min", type=int, default=2,
                    help="smallest n-gram worth matching (1 is aggressive)")
+    p.add_argument("--speculative-chain-break", type=int, default=8,
+                   help="with speculation on, break a pipelined decode "
+                        "chain after this many steps so fresh context "
+                        "gets a chance to draft (0 disables chaining)")
     p.add_argument("--no-kv-events", action="store_true")
     p.add_argument("--num-nodes", type=int, default=1,
                    help="multi-host: total processes in the jax world")
@@ -174,7 +178,8 @@ def build_engine(args: argparse.Namespace) -> JaxEngine:
         attn_impl=args.attn_impl, quantize=args.quantize,
         spec_tokens=args.speculative_num_tokens,
         spec_ngram_max=args.speculative_ngram_max,
-        spec_ngram_min=args.speculative_ngram_min)
+        spec_ngram_min=args.speculative_ngram_min,
+        spec_chain_break=args.speculative_chain_break)
     forward_fn = None
     pp = args.pipeline_parallel_size
     if pp > 1:

@@ -179,8 +179,21 @@ class TestSchema:
         assert feed(self.g, ",", st) is None
         assert accepts(self.g, doc + "}")
 
-    def test_empty_object_schema_blocks_keys(self):
+    def test_bare_object_schema_is_open(self):
+        # standard JSON-Schema semantics: no properties declared = any
+        # keys/values (the forced-tool-call arguments envelope)
         g = Grammar.from_schema({"type": "object"})
+        assert accepts(g, "{}")
+        assert accepts(g, '{"anything": [1, {"x": null}]}')
+
+    def test_required_without_properties_still_rejects(self):
+        # the open-object shortcut must not swallow this contradiction
+        with pytest.raises(GuidedUnsupported, match="required"):
+            Grammar.from_schema({"type": "object", "required": ["a"]})
+
+    def test_additional_properties_false_closes_empty_object(self):
+        g = Grammar.from_schema({"type": "object",
+                                 "additionalProperties": False})
         assert accepts(g, "{}")
         assert not prefix_ok(g, '{"')
 
@@ -531,6 +544,31 @@ class TestEngineGuided:
             assert [t for f in fp for t in f.token_ids] == solo
             assert prefix_ok(Grammar.any_object(),
                              text_of(fg, tb, eos).lstrip())
+        finally:
+            await eng.stop()
+
+    async def test_forced_tool_call_generates_parseable_call(self):
+        # the forced-tool envelope end to end: a random-weight model under
+        # the grammar MUST emit a JSON doc parse_tool_calls accepts
+        from dynamo_tpu.preprocessor.tools import (
+            forced_tool_guided_spec, parse_tool_calls)
+        eng, tok, eos, tb = guided_engine()
+        try:
+            spec = forced_tool_guided_spec(
+                [{"type": "function", "function": {
+                    "name": "up", "parameters": {
+                        "type": "object",
+                        "properties": {"n": {"type": "integer"}},
+                        "required": ["n"]}}}],
+                "required")
+            req = guided_req(spec, eos=eos, max_tokens=96)
+            frames = await run_req(eng, req)
+            assert frames[-1].finish_reason == FinishReason.EOS
+            calls = parse_tool_calls(text_of(frames, tb, eos))
+            assert len(calls) == 1
+            assert calls[0]["function"]["name"] == "up"
+            args = json.loads(calls[0]["function"]["arguments"])
+            assert isinstance(args["n"], int)
         finally:
             await eng.stop()
 

@@ -1,0 +1,98 @@
+"""Process-level structured-outputs e2e: real frontend + jax worker over
+TCP, response_format constraining actual generation.
+
+Model for coverage: the engines' guided backends behind the reference's
+``response_format`` passthrough — the bar is that delivered output is
+parseable, schema-conformant JSON, for every choice of an n>1 request,
+and that a bad schema 400s at the frontend.
+"""
+
+import json
+
+import aiohttp
+import pytest
+
+from dynamo_tpu.utils.testing import make_test_model_dir
+from tests.procutils import ManagedProcess, free_port
+from tests.test_serve_e2e import frontend, wait_model
+
+SCHEMA = {"type": "object",
+          "properties": {"mood": {"enum": ["up", "dn"]},
+                         "n": {"type": "integer"}},
+          "required": ["mood", "n"]}
+
+
+def guided_worker(coord_port: int, model_dir: str):
+    return ManagedProcess(
+        ["dynamo_tpu.worker.main", "--coordinator",
+         f"127.0.0.1:{coord_port}", "--model-path", model_dir,
+         "--model-name", "g-model", "--random-weights",
+         "--page-size", "4", "--num-pages", "128", "--max-num-seqs", "4",
+         "--max-prefill-chunk", "32", "--max-context", "512"],
+        name="guided-worker", ready_line="jax worker serving",
+        timeout=120.0)
+
+
+class TestGuidedServeE2E:
+    @pytest.mark.async_timeout(240)
+    async def test_schema_constrains_real_serving(self, tmp_path):
+        model_dir = make_test_model_dir(str(tmp_path / "m"), vocab_size=512)
+        coord_port, http_port = free_port(), free_port()
+        base = f"http://127.0.0.1:{http_port}"
+        async with frontend(coord_port, http_port):
+            async with guided_worker(coord_port, model_dir):
+                await wait_model(base, "g-model")
+                async with aiohttp.ClientSession() as s:
+                    body = {"model": "g-model", "max_tokens": 96, "n": 2,
+                            "temperature": 0.7, "seed": None,
+                            "messages": [{"role": "user",
+                                          "content": "emit the json"}],
+                            "response_format": {
+                                "type": "json_schema",
+                                "json_schema": {"name": "t",
+                                                "schema": SCHEMA}}}
+                    r = await (await s.post(
+                        f"{base}/v1/chat/completions", json=body)).json()
+                    assert len(r["choices"]) == 2, r
+                    for choice in r["choices"]:
+                        doc = json.loads(choice["message"]["content"])
+                        assert set(doc) <= {"mood", "n"}
+                        assert doc["mood"] in ("up", "dn")
+                        assert isinstance(doc["n"], int)
+
+                    # bad schema -> 400 at the frontend with the
+                    # compiler's message
+                    body["response_format"] = {
+                        "type": "json_schema",
+                        "json_schema": {"schema": {"type": "string",
+                                                   "pattern": "x+"}}}
+                    resp = await s.post(f"{base}/v1/chat/completions",
+                                        json=body)
+                    assert resp.status == 400
+                    assert "pattern" in json.dumps(await resp.json())
+
+                    # forced function calling: tool_choice='required'
+                    # must yield a real tool_calls finish with arguments
+                    # conforming to the tool's parameter schema
+                    tool_body = {
+                        "model": "g-model", "max_tokens": 96,
+                        "temperature": 0.0,
+                        "messages": [{"role": "user",
+                                      "content": "call the tool"}],
+                        "tools": [{"type": "function", "function": {
+                            "name": "set_mood",
+                            "parameters": {
+                                "type": "object",
+                                "properties": {
+                                    "mood": {"enum": ["up", "dn"]}},
+                                "required": ["mood"]}}}],
+                        "tool_choice": "required"}
+                    r = await (await s.post(
+                        f"{base}/v1/chat/completions",
+                        json=tool_body)).json()
+                    choice = r["choices"][0]
+                    assert choice["finish_reason"] == "tool_calls", r
+                    (call,) = choice["message"]["tool_calls"]
+                    assert call["function"]["name"] == "set_mood"
+                    args = json.loads(call["function"]["arguments"])
+                    assert args["mood"] in ("up", "dn")

@@ -234,6 +234,98 @@ class TestHttpWiring:
         finally:
             await service.stop()
 
+    def test_forced_tool_guided_spec_shapes(self):
+        from dynamo_tpu.preprocessor.tools import forced_tool_guided_spec
+        tools = [
+            {"type": "function", "function": {
+                "name": "get_weather",
+                "parameters": {"type": "object",
+                               "properties": {"city": {"type": "string"}},
+                               "required": ["city"]}}},
+            {"type": "function", "function": {"name": "get_time",
+                                              "parameters": {}}},
+        ]
+        # auto/none/absent: nothing forced
+        assert forced_tool_guided_spec(tools, "auto") is None
+        assert forced_tool_guided_spec(tools, "none") is None
+        assert forced_tool_guided_spec(tools, None) is None
+        # named function: exact parameters schema
+        spec = forced_tool_guided_spec(tools, {
+            "type": "function", "function": {"name": "get_weather"}})
+        props = spec["schema"]["properties"]
+        assert props["name"] == {"const": "get_weather"}
+        assert props["arguments"]["properties"]["city"] == {
+            "type": "string"}
+        # required with several tools: name constrained, arguments open
+        spec = forced_tool_guided_spec(tools, "required")
+        assert spec["schema"]["properties"]["name"] == {
+            "enum": ["get_time", "get_weather"]}
+        assert spec["schema"]["properties"]["arguments"] == {
+            "type": "object"}
+        # error cases -> 400s
+        import pytest
+        with pytest.raises(ValueError, match="unknown function"):
+            forced_tool_guided_spec(tools, {
+                "type": "function", "function": {"name": "nope"}})
+        with pytest.raises(ValueError, match="needs tools"):
+            forced_tool_guided_spec([], "required")
+
+    def test_forced_tool_spec_degrades_unsupported_params(self):
+        from dynamo_tpu.engine.guided import compile_guided
+        from dynamo_tpu.preprocessor.tools import (
+            degrade_tool_spec, forced_tool_guided_spec)
+        tools = [{"type": "function", "function": {
+            "name": "grep",
+            "parameters": {"type": "object",
+                           "properties": {"pat": {"type": "string",
+                                                  "pattern": "x+"}}}}}]
+        spec = forced_tool_guided_spec(tools, "required")
+        import pytest
+        from dynamo_tpu.engine.guided import GuidedUnsupported
+        with pytest.raises(GuidedUnsupported):
+            compile_guided(spec)
+        compile_guided(degrade_tool_spec(spec))  # envelope still enforced
+
+    def test_required_without_tools_rejects(self):
+        from dynamo_tpu.preprocessor import OpenAIPreprocessor
+        from dynamo_tpu.protocols.openai import ChatCompletionRequest
+        from dynamo_tpu.utils.testing import make_test_card
+        import pytest
+        pre = OpenAIPreprocessor(make_test_card())
+        req = ChatCompletionRequest(
+            model="m", messages=[{"role": "user", "content": "hi"}],
+            tool_choice="required")
+        with pytest.raises(ValueError, match="needs tools"):
+            pre.preprocess_chat(req)
+
+    def test_non_object_parameters_fall_back_to_open_arguments(self):
+        from dynamo_tpu.preprocessor.tools import forced_tool_guided_spec
+        spec = forced_tool_guided_spec(
+            [{"type": "function", "function": {
+                "name": "f", "parameters": {"type": "string"}}}],
+            "required")
+        # a string-typed parameters schema would force unparseable
+        # arguments; the envelope keeps them an object
+        assert spec["schema"]["properties"]["arguments"] == {
+            "type": "object"}
+
+    def test_preprocessor_forces_tool_call_grammar(self):
+        from dynamo_tpu.preprocessor import OpenAIPreprocessor
+        from dynamo_tpu.protocols.openai import ChatCompletionRequest
+        from dynamo_tpu.utils.testing import make_test_card
+        pre = OpenAIPreprocessor(make_test_card())
+        req = ChatCompletionRequest(
+            model="m", messages=[{"role": "user", "content": "hi"}],
+            tools=[{"type": "function", "function": {
+                "name": "f", "parameters": {"type": "object"}}}],
+            tool_choice="required")
+        guided = pre.preprocess_chat(req).sampling_options.guided
+        assert guided is not None
+        assert guided["schema"]["properties"]["name"] == {"const": "f"}
+        # auto: not forced
+        req.tool_choice = "auto"
+        assert pre.preprocess_chat(req).sampling_options.guided is None
+
     async def test_without_tools_text_passes_through(self):
         text = '{"name": "get_weather", "parameters": {"city": "Paris"}}'
         service = await _service_for(text)
