@@ -56,6 +56,18 @@ class MetricsAggregator:
                                  labels, registry=self.registry)
         self.hit_rate = Gauge(f"{ns}_prefix_cache_hit_rate", "",
                               labels, registry=self.registry)
+        # speculative decoding acceptance (cumulative per worker; gauges
+        # SET to the scraped running totals — the source accumulates)
+        self.spec_drafts = Gauge(f"{ns}_spec_drafts", "",
+                                 labels, registry=self.registry)
+        self.spec_draft_tokens = Gauge(f"{ns}_spec_draft_tokens", "",
+                                       labels, registry=self.registry)
+        self.spec_accepted_tokens = Gauge(
+            f"{ns}_spec_accepted_tokens", "", labels,
+            registry=self.registry)
+        # MoE dispatch-backend overflow (token-expert assignments dropped)
+        self.moe_dropped = Gauge(f"{ns}_moe_dropped_tokens", "",
+                                 labels, registry=self.registry)
         self.router_isl_blocks = Counter(
             "dynamo_router_isl_blocks_total", "", registry=self.registry)
         self.router_overlap_blocks = Counter(
@@ -114,6 +126,15 @@ class MetricsAggregator:
                         m.kv_stats.gpu_cache_usage_perc)
                     self.hit_rate.labels(w).set(
                         m.kv_stats.gpu_prefix_cache_hit_rate)
+                    if m.spec_decode_stats is not None:
+                        sd = m.spec_decode_stats
+                        self.spec_drafts.labels(w).set(sd.num_drafts)
+                        self.spec_draft_tokens.labels(w).set(
+                            sd.num_draft_tokens)
+                        self.spec_accepted_tokens.labels(w).set(
+                            sd.num_accepted_tokens)
+                    self.moe_dropped.labels(w).set(
+                        m.worker_stats.moe_dropped_tokens)
             except asyncio.CancelledError:
                 raise
             except Exception:

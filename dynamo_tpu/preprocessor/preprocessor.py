@@ -162,20 +162,16 @@ class OpenAIPreprocessor:
             # than the request asked for
             engine_k = getattr(self.card, "num_top_logprobs", 20)
             logprobs = min(logprobs, 20, engine_k)
-        # response_format -> guided decoding; the grammar is validated
-        # here too so a bad schema 400s at the frontend instead of
-        # erroring the stream at the worker
-        guided = (req.guided_spec()
-                  if isinstance(req, ChatCompletionRequest) else None)
-        if guided is not None:
-            _validate_guided_spec(guided)
-        elif isinstance(req, ChatCompletionRequest):
-            # forced function calling (tool_choice 'required' / named):
-            # constrain generation to a parseable tool-call JSON. A tool's
-            # own parameter schema may use keywords the grammar cannot
-            # enforce — degrade its arguments to any-object rather than
-            # rejecting the user's tools (unlike response_format, the
-            # schema here is OURS, not the client's explicit ask).
+        # guided decoding. A FORCED tool call (tool_choice 'required' /
+        # named) is the stronger contract and wins over response_format —
+        # and its validation (unknown function, required-without-tools ->
+        # 400) runs regardless. A tool's own parameter schema may use
+        # keywords the grammar cannot enforce; degrade its arguments to
+        # any-object rather than rejecting the user's tools (unlike
+        # response_format, that schema is OURS, not the client's explicit
+        # ask).
+        guided = None
+        if isinstance(req, ChatCompletionRequest):
             from dynamo_tpu.preprocessor.tools import (
                 degrade_tool_spec, forced_tool_guided_spec)
             forced = forced_tool_guided_spec(req.tools, req.tool_choice)
@@ -186,6 +182,12 @@ class OpenAIPreprocessor:
                     forced = degrade_tool_spec(forced)
                     _validate_guided_spec(forced)
                 guided = forced
+            else:
+                # response_format: the client's own schema — bad specs
+                # 400 here instead of erroring the worker stream
+                guided = req.guided_spec()
+                if guided is not None:
+                    _validate_guided_spec(guided)
         sampling = SamplingOptions(
             temperature=req.temperature,
             top_p=req.top_p,

@@ -59,8 +59,18 @@ class TestMetricsComponent:
             engine = MockerEngine(MockEngineArgs(
                 num_pages=32, page_size=4, speedup_ratio=1000.0))
             ep = wdrt.namespace("ns").component("tpu").endpoint("generate")
+            def stats_with_extras():
+                # augment with the optional planes the aggregator exports
+                # (spec acceptance + MoE dispatch drops)
+                d = engine.stats().to_dict()
+                d["spec_decode_stats"] = {
+                    "num_spec_tokens": 4, "num_drafts": 3,
+                    "num_draft_tokens": 12, "num_accepted_tokens": 7}
+                d["worker_stats"]["moe_dropped_tokens"] = 5
+                return d
+
             await serve_engine(ep, engine,
-                               stats_provider=lambda: engine.stats().to_dict())
+                               stats_provider=stats_with_extras)
 
             mdrt = await DistributedRuntime.create(coordinator=coord.address)
             drts.append(mdrt)
@@ -70,16 +80,22 @@ class TestMetricsComponent:
                 kv_hit_rate_subject("ns", "tpu"),
                 KVHitRateEvent(worker_id=1, isl_blocks=10,
                                overlap_blocks=4).to_dict())
-            for _ in range(50):
+            for _ in range(100):
                 from prometheus_client import generate_latest
                 text = generate_latest(agg.registry).decode()
-                if ("dynamo_worker_kv_total_blocks" in text
+                # require actual SAMPLES (a labelled series), not just the
+                # HELP/TYPE headers every registered gauge always emits
+                if ("dynamo_worker_spec_accepted_tokens{worker=" in text
                         and "dynamo_router_isl_blocks_total 10.0" in text):
                     break
                 await asyncio.sleep(0.1)
             text = generate_latest(agg.registry).decode()
             assert "dynamo_worker_kv_total_blocks" in text
             assert "dynamo_router_isl_blocks_total 10.0" in text
+            assert 'dynamo_worker_spec_accepted_tokens{worker=' in text
+            assert "7.0" in text.split(
+                "dynamo_worker_spec_accepted_tokens{")[1][:40]
+            assert "dynamo_worker_moe_dropped_tokens{" in text
             await agg.stop()
             await engine.stop()
         finally:

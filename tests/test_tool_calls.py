@@ -286,6 +286,25 @@ class TestHttpWiring:
             compile_guided(spec)
         compile_guided(degrade_tool_spec(spec))  # envelope still enforced
 
+    def test_forced_tool_wins_over_response_format(self):
+        from dynamo_tpu.preprocessor import OpenAIPreprocessor
+        from dynamo_tpu.protocols.openai import ChatCompletionRequest
+        from dynamo_tpu.utils.testing import make_test_card
+        import pytest
+        pre = OpenAIPreprocessor(make_test_card())
+        req = ChatCompletionRequest(
+            model="m", messages=[{"role": "user", "content": "hi"}],
+            response_format={"type": "json_object"},
+            tools=[{"type": "function", "function": {
+                "name": "f", "parameters": {"type": "object"}}}],
+            tool_choice="required")
+        guided = pre.preprocess_chat(req).sampling_options.guided
+        assert guided["schema"]["properties"]["name"] == {"const": "f"}
+        # and tool_choice validation fires even with response_format set
+        req.tool_choice = {"type": "function", "function": {"name": "nope"}}
+        with pytest.raises(ValueError, match="unknown function"):
+            pre.preprocess_chat(req)
+
     def test_required_without_tools_rejects(self):
         from dynamo_tpu.preprocessor import OpenAIPreprocessor
         from dynamo_tpu.protocols.openai import ChatCompletionRequest
