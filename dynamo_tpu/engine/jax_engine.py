@@ -477,16 +477,30 @@ class JaxEngine(ScheduledEngineBase):
                                total_lens, new_lens, rng, step, temperature,
                                top_k, top_p, pen)
 
+    def _topk_cols(self, lf):
+        """Top-K alternative (ids, logprob-bit) columns for the OpenAI
+        logprobs surface — the ONE implementation both the plain sampling
+        tail and the spec verify step pack (K clamps to the vocab; the
+        host unpack mirrors the same clamp)."""
+        kt = min(self.cfg.num_top_logprobs, lf.shape[-1])
+        vals, ids = jax.lax.top_k(lf, kt)
+        lps = vals - jax.nn.logsumexp(lf, axis=-1, keepdims=True)
+        return (ids.astype(jnp.int32),
+                jax.lax.bitcast_convert_type(lps, jnp.int32))
+
     def _spec_step_impl(self, params, pages, tokens, positions, page_table,
                         total_lens, new_lens, rng, step, temperature, top_k,
                         top_p):
         """Speculative verify step: a [B, K+1] chunked forward whose
         sampling tail rejection-samples the K drafts on device
         (``ops/sampling.spec_verify``). tokens[:, 0] is each row's last
-        context token; tokens[:, 1:] are the drafts. Packs
-        [final_tok, final_lp_bits, n_acc, K draft_lp_bits] per row —
-        columns 0/1 line up with the normal packed layout so
-        ``fetch_packed``'s token/logprob view is shared."""
+        context token; tokens[:, 1:] are the drafts. Packs, per row:
+        ``[final_tok, final_lp_bits, n_acc, K draft_lp_bits]`` and — when
+        ``num_top_logprobs`` > 0 — the per-chunk-slot top alternatives
+        ``[S*kt top ids, S*kt top lp bits]`` with ``kt = min(K_top, V)``
+        (``_topk_cols``; the host unpack in ``_execute_plan`` mirrors the
+        same layout). Columns 0/1 line up with the normal packed layout
+        so ``fetch_packed``'s token/logprob view is shared."""
         from dynamo_tpu.ops.sampling import spec_verify
         (tokens, positions, page_table, total_lens, new_lens, temperature,
          top_k, top_p) = self._shard_batch(
@@ -515,9 +529,17 @@ class JaxEngine(ScheduledEngineBase):
         n_acc, final_tok, final_lp, draft_lps = spec_verify(
             logits, tokens, key, temperature, top_k, top_p)
         bits = jax.lax.bitcast_convert_type
-        packed = jnp.concatenate(
-            [final_tok[:, None], bits(final_lp, jnp.int32)[:, None],
-             n_acc[:, None], bits(draft_lps, jnp.int32)], axis=1)
+        cols = [final_tok[:, None], bits(final_lp, jnp.int32)[:, None],
+                n_acc[:, None], bits(draft_lps, jnp.int32)]
+        if self.cfg.num_top_logprobs > 0:
+            # per-POSITION top alternatives (the OpenAI logprobs surface;
+            # the same columns the plain step packs, one set per chunk
+            # slot): [B, S*kt] ids then [B, S*kt] logprob bits
+            B = logits.shape[0]
+            ids, lp_bits = self._topk_cols(logits.astype(jnp.float32))
+            cols.append(ids.reshape(B, -1))
+            cols.append(lp_bits.reshape(B, -1))
+        packed = jnp.concatenate(cols, axis=1)
         if self._dp > 1:
             from jax.sharding import NamedSharding, PartitionSpec
             packed = jax.lax.with_sharding_constraint(
@@ -573,13 +595,10 @@ class JaxEngine(ScheduledEngineBase):
             min_p=pen["min_p"] if pen is not None else None)
         cols = [sampled[:, None],
                 jax.lax.bitcast_convert_type(logprobs, jnp.int32)[:, None]]
-        K = self.cfg.num_top_logprobs
-        if K > 0:
-            lf = logits.astype(jnp.float32)
-            vals, ids = jax.lax.top_k(lf, min(K, lf.shape[-1]))
-            top_lps = vals - jax.nn.logsumexp(lf, axis=-1, keepdims=True)
-            cols.append(ids.astype(jnp.int32))
-            cols.append(jax.lax.bitcast_convert_type(top_lps, jnp.int32))
+        if self.cfg.num_top_logprobs > 0:
+            ids, lp_bits = self._topk_cols(logits.astype(jnp.float32))
+            cols.append(ids)
+            cols.append(lp_bits)
         packed = jnp.concatenate(cols, axis=1)
         if self._dp > 1:
             # gather the dp-sharded rows back to every rank (rank 0 reads
@@ -714,10 +733,23 @@ class JaxEngine(ScheduledEngineBase):
             packed = self._invoke_step("spec", arrays, self._step_counter)
             self._step_counter += 1
             host = np.asarray(packed)
+            B = host.shape[0]
+            K, S = self.spec_K, self.spec_K + 1
+            # mirror _topk_cols' vocab clamp or the unpack misaligns on
+            # toy models with vocab < num_top_logprobs
+            kt = min(self.cfg.num_top_logprobs,
+                     self.model_cfg.vocab_size)
             sampled = host[:, 0]
             logprobs = host[:, 1].copy().view(np.float32)
             extras = {"spec_acc": host[:, 2],
-                      "spec_lps": host[:, 3:].copy().view(np.float32)}
+                      "spec_lps": host[:, 3:3 + K].copy().view(np.float32)}
+            if kt > 0:
+                base = 3 + K
+                extras["spec_top_ids"] = host[
+                    :, base:base + S * kt].reshape(B, S, kt)
+                extras["spec_top_lps"] = host[
+                    :, base + S * kt:base + 2 * S * kt].copy().view(
+                    np.float32).reshape(B, S, kt)
             return sampled, logprobs, extras
         P = self.table_width
         if isinstance(plan, PrefillBatch):

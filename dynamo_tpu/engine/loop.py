@@ -176,10 +176,11 @@ class ScheduledEngineBase(EngineBase):
             top_logprobs=[top] if top is not None else None))
 
     def _plan_spec_appends(self, seq: Sequence,
-                           cand: List[Tuple[int, float]]
-                           ) -> Tuple[List[Tuple[int, float]], int]:
+                           cand: List[Tuple[int, float, int]]
+                           ) -> Tuple[List[Tuple[int, float, int]], int]:
         """Stop-aware truncation of one row's verify-step candidates
-        (accepted drafts + the final sampled token), WITHOUT mutating the
+        (accepted drafts + the final sampled token, each tagged with its
+        chunk slot for the logprobs surface), WITHOUT mutating the
         sequence: returns (tokens to append, count that are drafts).
         Mirrors ``_accept_token``'s stop checks exactly — the subsequent
         real appends re-derive the same conclusions from the same data;
@@ -189,10 +190,10 @@ class ScheduledEngineBase(EngineBase):
         n_gen, length = len(seq.generated), len(seq)
         max_new = sc.max_tokens if sc.max_tokens is not None else (
             self.max_context - seq.num_prompt)
-        out: List[Tuple[int, float]] = []
+        out: List[Tuple[int, float, int]] = []
         n_draft = 0
-        for idx, (tok, lp) in enumerate(cand):
-            out.append((tok, lp))
+        for idx, (tok, lp, pos) in enumerate(cand):
+            out.append((tok, lp, pos))
             if idx < len(cand) - 1:
                 n_draft += 1
             n_gen += 1
@@ -211,8 +212,22 @@ class ScheduledEngineBase(EngineBase):
         accepted prefix, then append accepted drafts + the final token."""
         acc = extras["spec_acc"]
         dlps = extras["spec_lps"]
+        top_ids = extras.get("spec_top_ids")    # [B, K+1, Ktop] or None
+
+        def top_for(i: int, pos: int, seq: Sequence
+                    ) -> Optional[Dict[int, float]]:
+            # chunk slot `pos` predicts the token appended at candidate
+            # index pos (drafts 0..a-1 at their own slots, the final
+            # token at slot n_acc) — same OpenAI surface the plain step
+            # packs, per position
+            if top_ids is None or seq.request.sampling_options.logprobs \
+                    is None:
+                return None
+            return {int(t): float(l) for t, l in
+                    zip(top_ids[i, pos], extras["spec_top_lps"][i, pos])}
+
         advances: List[int] = []
-        appends: List[Optional[List[Tuple[int, float]]]] = []
+        appends: List[Optional[List[Tuple[int, float, int]]]] = []
         for i, seq in enumerate(plan.seqs):
             if seq.phase is not Phase.RUNNING or seq.cancelled:
                 # as the plain decode path: slot 0's KV (the real last
@@ -220,22 +235,22 @@ class ScheduledEngineBase(EngineBase):
                 advances.append(1)
                 appends.append(None)
                 continue
-            cand = [(int(plan.drafts[i, j]), float(dlps[i, j]))
+            cand = [(int(plan.drafts[i, j]), float(dlps[i, j]), j)
                     for j in range(int(acc[i]))]
-            cand.append((int(sampled[i]), float(logprobs[i])))
+            cand.append((int(sampled[i]), float(logprobs[i]), int(acc[i])))
             toks, n_draft = self._plan_spec_appends(seq, cand)
             advances.append(1 + n_draft)
             appends.append(toks)
         self.scheduler.on_spec_done(
             plan, advances,
             accepted=[int(acc[i]) for i in range(len(plan.seqs))])
-        for seq, toks in zip(plan.seqs, appends):
+        for i, (seq, toks) in enumerate(zip(plan.seqs, appends)):
             if toks is None:
                 if seq.cancelled and seq.phase is Phase.RUNNING:
                     self._finish(seq, FinishReason.CANCELLED)
                 continue
-            for tok, lp in toks:
-                self._accept_token(seq, tok, lp)
+            for tok, lp, pos in toks:
+                self._accept_token(seq, tok, lp, top_for(i, pos, seq))
                 if seq.phase is not Phase.RUNNING:
                     break
         self.scheduler.commit_spec(plan)

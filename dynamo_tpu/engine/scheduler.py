@@ -442,17 +442,18 @@ class Scheduler:
 
         Penalties / logit_bias mutate logits from host bookkeeping that
         goes stale within a multi-token step; per-request seeds key their
-        randomness on a single token position; the top-K-alternatives
-        logprobs surface isn't packed by the verify step. Any such row
-        sends the whole batch down the plain decode path (same rule as
-        ``plan_chained``)."""
+        randomness on a single token position; guided masks need the
+        automaton synced token by token. Any such row sends the whole
+        batch down the plain decode path (same rule as ``plan_chained``).
+        Top-logprobs requests ARE eligible — the verify step packs
+        per-position alternatives."""
         so = seq.request.sampling_options
         rep_on = (so.repetition_penalty is not None
                   and so.repetition_penalty > 0
                   and so.repetition_penalty != 1.0)
         return not (so.frequency_penalty or so.presence_penalty or rep_on
                     or so.logit_bias or so.seed is not None or so.min_p
-                    or so.logprobs is not None or so.guided)
+                    or so.guided)
 
     def _spec_plan(self, ready: List[Sequence]) -> Optional[SpecDecodeBatch]:
         """Try to upgrade this decode step to a [B, K+1] verify step."""

@@ -320,6 +320,65 @@ class TestEngineSpecDecode:
         finally:
             await eng.stop()
 
+    async def test_logprobs_ride_spec_steps(self, monkeypatch):
+        # top-logprobs requests are spec-ELIGIBLE: the verify step packs
+        # per-position alternatives. Driven with oracle drafts so verify
+        # steps definitely produce multi-token accepts: same tokens, same
+        # alternative ids, close logprob values as the plain path.
+        def lp_req(rid):
+            r = make_req(PROMPT, rid, max_tokens=9)
+            r.eos_token_ids = []
+            r.sampling_options.logprobs = 3
+            return r
+
+        async def run(eng, rid):
+            frames = await collect(eng, lp_req(rid))
+            toks = [t for f in frames for t in f.token_ids]
+            tops = [d for f in frames for d in (f.top_logprobs or [])]
+            return toks, tops
+
+        base = spec_engine(spec_tokens=0)
+        try:
+            want_toks, want_tops = await run(base, "b")
+        finally:
+            await base.stop()
+        full = list(PROMPT) + want_toks
+
+        def oracle(tokens, k, max_n=4, min_n=2):
+            n = len(tokens)
+            if n >= len(full) or list(tokens) != full[:n]:
+                return None
+            cont = full[n:n + k]
+            while len(cont) < k:
+                cont.append(cont[-1])
+            return cont
+
+        import dynamo_tpu.engine.scheduler as sched_mod
+        monkeypatch.setattr(sched_mod, "propose_ngram", oracle)
+        eng = spec_engine(spec_tokens=3)
+        try:
+            got_toks, got_tops = await run(eng, "s")
+            stats = eng.stats().spec_decode_stats
+            assert stats.num_accepted_tokens > 0   # multi-token accepts ran
+        finally:
+            await eng.stop()
+        assert got_toks == want_toks
+        assert len(got_tops) == len(want_tops) == 9
+        for g, w in zip(got_tops, want_tops):
+            assert set(g) == set(w)        # same alternative token ids
+            for t in g:                    # logits from a [B,S] chunk vs a
+                assert abs(g[t] - w[t]) < 1e-3   # [B,1] step: ulp drift ok
+
+    async def test_topk_wider_than_vocab_clamps(self):
+        # num_top_logprobs > vocab_size: pack and unpack must agree on the
+        # clamped width (was a latent misalignment crash)
+        eng = spec_engine(spec_tokens=2, num_top_logprobs=300)
+        try:
+            toks = await _greedy_tokens(eng, PROMPT, "clamp", 5)
+            assert len(toks) == 5
+        finally:
+            await eng.stop()
+
     async def test_penalized_request_falls_back_to_plain_decode(self):
         eng = spec_engine(spec_tokens=3)
         try:
