@@ -141,6 +141,10 @@ class HttpService:
         pipeline = self.manager.get(req.model)
         if pipeline is None:
             return _error(404, f"model {req.model!r} not found")
+        if req.dimensions is not None and req.dimensions <= 0:
+            # before the forward pass — an invalid ask must not pay for
+            # the model compute it then discards
+            return _error(400, "dimensions must be positive")
         try:
             vectors, prompt_tokens = await pipeline.generate_embeddings(req)
         except NotImplementedError as e:
@@ -148,6 +152,23 @@ class HttpService:
         except Exception as e:  # noqa: BLE001
             logger.exception("embeddings failed")
             return _error(500, str(e), "internal_error")
+        if req.dimensions is not None and vectors:
+            if req.dimensions > len(vectors[0]):
+                return _error(
+                    400, f"dimensions={req.dimensions} exceeds the "
+                         f"model's embedding width {len(vectors[0])}")
+            # OpenAI-style dimensionality reduction: truncate (vectors are
+            # mean-pooled hidden states, not unit-norm — no renormalize)
+            vectors = [v[:req.dimensions] for v in vectors]
+        if req.encoding_format == "base64":
+            # the official openai client requests base64 BY DEFAULT and
+            # decodes little-endian float32 bytes
+            import base64
+
+            import numpy as _np
+            vectors = [base64.b64encode(
+                _np.asarray(v, _np.float32).tobytes()).decode()
+                for v in vectors]
         resp = EmbeddingResponse(
             data=[EmbeddingData(index=i, embedding=v)
                   for i, v in enumerate(vectors)],

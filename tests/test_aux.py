@@ -112,6 +112,34 @@ class TestEmbeddings:
                 assert len(body["data"][0]["embedding"]) == 64
                 assert body["usage"]["prompt_tokens"] > 0
 
+                # base64 encoding (the openai client's DEFAULT): the
+                # little-endian f32 bytes must decode to the float form
+                r64 = await s.post(f"{base}/v1/embeddings", json={
+                    "model": "emb", "input": ["hello", "world"],
+                    "encoding_format": "base64"})
+                assert r64.status == 200, await r64.text()
+                body64 = await r64.json()
+                import base64 as b64
+                dec = np.frombuffer(
+                    b64.b64decode(body64["data"][0]["embedding"]),
+                    dtype=np.float32)
+                np.testing.assert_allclose(
+                    dec, np.asarray(body["data"][0]["embedding"],
+                                    np.float32), rtol=1e-6)
+
+                # dimensions: truncation, not silent ignore; invalid or
+                # over-width asks 400 (over-width only after the width is
+                # known, non-positive before any compute)
+                rd = await s.post(f"{base}/v1/embeddings", json={
+                    "model": "emb", "input": "hello", "dimensions": 16})
+                assert len((await rd.json())["data"][0]["embedding"]) == 16
+                assert (await s.post(f"{base}/v1/embeddings", json={
+                    "model": "emb", "input": "x",
+                    "dimensions": 0})).status == 400
+                assert (await s.post(f"{base}/v1/embeddings", json={
+                    "model": "emb", "input": "x",
+                    "dimensions": 1024})).status == 400
+
                 # echo pipelines don't embed: clean 501
                 r2 = await s.post(f"{base}/v1/embeddings", json={
                     "model": "nope", "input": "x"})
