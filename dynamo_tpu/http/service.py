@@ -167,7 +167,7 @@ class HttpService:
 
             import numpy as _np
             vectors = [base64.b64encode(
-                _np.asarray(v, _np.float32).tobytes()).decode()
+                _np.asarray(v, _np.dtype("<f4")).tobytes()).decode()
                 for v in vectors]
         resp = EmbeddingResponse(
             data=[EmbeddingData(index=i, embedding=v)

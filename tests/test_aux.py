@@ -122,7 +122,7 @@ class TestEmbeddings:
                 import base64 as b64
                 dec = np.frombuffer(
                     b64.b64decode(body64["data"][0]["embedding"]),
-                    dtype=np.float32)
+                    dtype=np.dtype("<f4"))   # explicit LE: the contract
                 np.testing.assert_allclose(
                     dec, np.asarray(body["data"][0]["embedding"],
                                     np.float32), rtol=1e-6)

@@ -332,6 +332,57 @@ class TestDisaggE2E:
                 await d.close()
             await coord.stop()
 
+    async def test_disagg_decode_worker_with_speculation(self):
+        """The decode worker of a disagg pair runs speculative decoding:
+        the injected prefix feeds the n-gram proposer and verify steps run
+        on the injected cache; greedy tokens identical to the aggregated
+        baseline (with a repetitive prompt so drafts actually fire)."""
+        from dynamo_tpu.runtime.coordinator import Coordinator
+        prompt = [5, 6, 7, 8, 5, 6, 7, 8, 5, 6, 7, 8, 5]
+
+        solo = JaxEngine.random_init(ModelConfig.tiny(), engine_cfg())
+        try:
+            want = [t for f in await collect(
+                solo.generate(make_req(prompt, "solo", max_tokens=8)))
+                for t in f.token_ids]
+        finally:
+            await solo.stop()
+
+        coord = await Coordinator(port=0).start()
+        drts, handler = [], None
+        try:
+            pre_drt = await DistributedRuntime.create(
+                coordinator=coord.address)
+            drts.append(pre_drt)
+            pre_engine = JaxEngine.random_init(ModelConfig.tiny(),
+                                               engine_cfg())
+            comp = pre_drt.namespace("ns").component("prefill")
+            await serve_engine(comp.endpoint("generate"), pre_engine)
+            await comp.endpoint(KV_EXPORT_ENDPOINT).serve(
+                serve_kv_export(pre_engine))
+
+            dec_drt = await DistributedRuntime.create(
+                coordinator=coord.address)
+            drts.append(dec_drt)
+            dec_engine = JaxEngine.random_init(
+                ModelConfig.tiny(),
+                engine_cfg(spec_tokens=3, spec_ngram_min=1))
+            handler = await DisaggDecodeHandler(
+                dec_engine, dec_drt, "ns", "prefill").start()
+            await handler._gen_client.wait_for_instances(1, timeout=10)
+
+            frames = await collect(handler.generate(
+                make_req(prompt, "r1", max_tokens=8)))
+            got = [t for f in frames for t in f.token_ids]
+            assert got == want
+            assert dec_engine.allocator.hits >= 3   # prefix injected
+        finally:
+            if handler is not None:
+                await handler.stop()
+            for d in drts:
+                await d.close()
+            await coord.stop()
+
     async def test_disagg_over_device_direct_plane(self):
         """Disagg with the device-direct plane advertised (the wiring
         worker.main sets up): the decode side's pull rides the jax
