@@ -966,6 +966,11 @@ class JaxEngine(ScheduledEngineBase):
         if kind == "embed":
             self._embed_batch_raw(a["toks"], a["mask"])
             return None
+        if kind == "score":
+            # follower side of a prompt-scoring broadcast: join the SPMD
+            # jit, discard the (replicated) result
+            self._score_batch_raw(a["toks"], a["mask"])
+            return None
         if kind == "gather":
             # follower side of a broadcast page gather: join the SPMD op,
             # discard the (replicated) result
@@ -1197,6 +1202,77 @@ class JaxEngine(ScheduledEngineBase):
             # de-lockstep the ranks' collective order
             return await self.run_exclusive(self._embed_batch, token_lists)
         return await asyncio.to_thread(self._embed_batch, token_lists)
+
+    # -- prompt scoring (echo + logprobs / loglikelihood) ------------------
+
+    def _score_batch(self, token_lists):
+        """Per-token prompt logprobs (one-shot dense forward, no KV —
+        the OpenAI ``echo`` + lm-eval loglikelihood surface). Returns a
+        list of (lps, top_ids [n, top_n], top_lps [n, top_n]) per input;
+        index 0 carries no context (lp 0).
+
+        Bounded by ``max_context`` like generation: the dense forward
+        materializes [B, H, S, S] attention scores per layer, so an
+        unbounded prompt would be a one-request OOM."""
+        from dynamo_tpu.models import get_family
+        family = get_family(self.model_cfg)
+        score = getattr(family, "score", None)
+        if score is None:
+            raise NotImplementedError(
+                f"{self.model_cfg.model_type} has no prompt-scoring path")
+        longest = max(len(t) for t in token_lists)
+        if longest > self.cfg.max_context:
+            raise ValueError(
+                f"prompt of {longest} tokens exceeds max context "
+                f"{self.cfg.max_context} for scoring")
+        self._ensure_score_jit(score)
+        B = len(token_lists)
+        chunk = 256
+        S = max(chunk, -(-longest // chunk) * chunk)
+        toks = np.zeros((B, S), np.int32)
+        mask = np.zeros((B, S), bool)
+        for i, ids in enumerate(token_lists):
+            n = min(len(ids), S)
+            toks[i, :n] = ids[:n]
+            mask[i, :n] = True
+        if self.step_tap is not None:
+            self.step_tap("score", {"toks": toks, "mask": mask},
+                          self._step_counter)
+            self._step_counter += 1
+        lps, tids, tlps = self._score_batch_raw(toks, mask)
+        lps, tids, tlps = (np.asarray(lps), np.asarray(tids),
+                          np.asarray(tlps))
+        return [(lps[i, :len(t)], tids[i, :len(t)], tlps[i, :len(t)])
+                for i, t in enumerate(token_lists)]
+
+    def _ensure_score_jit(self, score=None):
+        if hasattr(self, "_jit_score"):
+            return
+        if score is None:
+            from dynamo_tpu.models import get_family
+            score = get_family(self.model_cfg).score
+        rep = None
+        if self.cfg.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+            rep = NamedSharding(self.cfg.mesh, PartitionSpec())
+        top_n = max(1, min(self.cfg.num_top_logprobs or 1,
+                           self.model_cfg.vocab_size))
+        self._jit_score = jax.jit(
+            lambda p, t, m: score(p, self.model_cfg, t, m, top_n=top_n),
+            **({"out_shardings": rep} if rep is not None else {}))
+
+    def _score_batch_raw(self, toks, mask):
+        """Leader AND follower entry (identical arrays keep SPMD ranks in
+        lockstep, as _embed_batch_raw)."""
+        self._ensure_score_jit()
+        return self._jit_score(self.params, jnp.asarray(toks),
+                               jnp.asarray(mask))
+
+    async def score(self, token_lists):
+        import asyncio
+        if self.step_tap is not None:
+            return await self.run_exclusive(self._score_batch, token_lists)
+        return await asyncio.to_thread(self._score_batch, token_lists)
 
     @classmethod
     def random_init(cls, model_cfg: ModelConfig,

@@ -636,7 +636,59 @@ class HttpService:
                           "for legacy completions", "not_implemented")
         request_id = new_request_id("cmpl")
         timer = RequestTimer(self.metrics, req.model, "completions")
+
         try:
+            # echo: return the prompt (and, with logprobs, per-prompt-token
+            # logprobs — the lm-eval loglikelihood surface) ahead of any
+            # generated text. Scoring is a one-shot dense forward
+            # (engine.score); max_tokens=0 makes the request pure scoring.
+            # Inside the try so every early exit closes the request timer
+            # and unexpected failures map like any other handler error.
+            echo_text, echo_entries, echo_ids = "", None, None
+            if req.echo:
+                if req.stream:
+                    timer.done("501")
+                    return _error(501, "echo with streaming is not "
+                                  "implemented", "not_implemented")
+                p = req.prompt
+                if (isinstance(p, list) and p
+                        and isinstance(p[0], (str, list))):
+                    if len(p) > 1:
+                        timer.done("501")
+                        return _error(501, "echo with multiple prompts is "
+                                      "not implemented", "not_implemented")
+                    p = p[0]
+                tok = pipeline.preprocessor.tokenizer
+                echo_ids = list(p) if isinstance(p, list) else tok.encode(p)
+                if not echo_ids:
+                    raise ValueError("echo needs a non-empty prompt")
+                ds = tok.decode_stream(skip_special_tokens=False)
+                pieces = [ds.step(int(t)) for t in echo_ids]
+                echo_text = "".join(pieces)
+                if req.logprobs is not None:
+                    try:
+                        lps, tids, tlps = await pipeline.score_prompt(
+                            echo_ids)
+                    except NotImplementedError as e:
+                        timer.done("501")
+                        return _error(501, str(e), "not_implemented")
+                    echo_entries = []
+                    # alternatives per position: up to min(requested N,
+                    # the engine's num_top_logprobs) — the same cap the
+                    # generation path advertises via the model card
+                    n_top = min(req.logprobs, tids.shape[1])
+                    for j, piece in enumerate(pieces):
+                        e = {"token": piece,
+                             "logprob": None if j == 0 else float(lps[j]),
+                             "top_logprobs": []}
+                        if j > 0 and n_top > 0:
+                            e["top_logprobs"] = [
+                                {"token": tok.decode(
+                                    [int(tids[j, k])],
+                                    skip_special_tokens=False),
+                                 "logprob": float(tlps[j, k])}
+                                for k in range(n_top)]
+                        echo_entries.append(e)
             if req.stream:
                 return await self._stream_completion(request, req, pipeline,
                                                      request_id, timer)
@@ -670,16 +722,28 @@ class HttpService:
                     await gen.aclose()
                 return "".join(text_parts), finish, lp_entries, u
 
-            tasks = [asyncio.create_task(one_choice(i)) for i in range(n)]
-            try:
-                results = await asyncio.gather(*tasks)
-            except BaseException:
-                for t in tasks:
-                    t.cancel()
-                raise
+            if req.echo and req.max_tokens == 0:
+                # pure scoring: no generation at all. Only an EXPLICIT 0 —
+                # a JSON null means "the default", like the non-echo path
+                u0 = Usage(prompt_tokens=len(echo_ids),
+                           total_tokens=len(echo_ids))
+                results = [("", "length", [], u0) for _ in range(n)]
+            else:
+                tasks = [asyncio.create_task(one_choice(i))
+                         for i in range(n)]
+                try:
+                    results = await asyncio.gather(*tasks)
+                except BaseException:
+                    for t in tasks:
+                        t.cancel()
+                    raise
             usage = Usage()
             choices = []
             for i, (text, finish, lp_entries, u) in enumerate(results):
+                if req.echo:
+                    text = echo_text + text
+                    if echo_entries is not None:
+                        lp_entries = echo_entries + lp_entries
                 choices.append(CompletionChoice(
                     index=i, text=text,
                     finish_reason=finish or "stop",

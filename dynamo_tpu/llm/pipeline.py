@@ -88,6 +88,12 @@ class ServicePipeline:
         Raises NotImplementedError when this pipeline's engine can't embed."""
         raise NotImplementedError("this pipeline does not serve embeddings")
 
+    async def score_prompt(self, token_ids):
+        """Per-token prompt logprobs for the legacy completions ``echo``
+        surface. Returns (lps, top1_ids, top1_lps) arrays aligned with
+        ``token_ids``. NotImplementedError when the engine can't score."""
+        raise NotImplementedError("this pipeline does not score prompts")
+
     def resolve_annotations(self, preprocessed: PreprocessedRequest) -> bool:
         """Fill router-level annotation responses. Returns True if the
         request is annotation-only (answered without generating)."""
@@ -123,6 +129,13 @@ class LocalEnginePipeline(ServicePipeline):
         vectors = await embed(token_lists)
         return ([[float(x) for x in v] for v in vectors],
                 sum(len(t) for t in token_lists))
+
+    async def score_prompt(self, token_ids):
+        score = getattr(self.engine, "score", None)
+        if score is None:
+            raise NotImplementedError("engine has no prompt-scoring path")
+        [(lps, tids, tlps)] = await score([list(token_ids)])
+        return lps, tids, tlps
 
 
 class ComposedPipeline(ServicePipeline):

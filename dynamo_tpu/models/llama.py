@@ -238,13 +238,13 @@ def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     return _logits(cfg, params, h, new_lens, window=logits_window), pages
 
 
-def encode(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
-           mask: jnp.ndarray) -> jnp.ndarray:
-    """Dense (non-paged) forward for embeddings: mean-pooled final hidden
-    state over real tokens. tokens/mask: [B, S]; returns [B, H] float32.
-
-    Serves the /v1/embeddings surface (reference: ``http/service/openai.rs``
-    embeddings route; the reference delegates the model to an engine)."""
+def _dense_hidden(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
+                  mask: jnp.ndarray) -> jnp.ndarray:
+    """Causal dense (non-paged) transformer forward shared by the
+    one-shot surfaces — ``encode`` (embeddings pooling) and ``score``
+    (prompt logprobs). Materializes [B, H, S, S] attention scores per
+    layer (under the scan), so callers must bound S. Returns the
+    final-norm hidden states [B, S, H]."""
     B, S = tokens.shape
     sm_scale = cfg.head_dim ** -0.5
     positions = jnp.tile(jnp.arange(S, dtype=jnp.int32)[None], (B, 1))
@@ -269,11 +269,80 @@ def encode(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
         return h, None
 
     h, _ = jax.lax.scan(body, h, params["layers"])
-    h = _rms_norm(h, params["final_norm"], cfg.rms_norm_eps)
+    return _rms_norm(h, params["final_norm"], cfg.rms_norm_eps)
+
+
+def encode(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
+           mask: jnp.ndarray) -> jnp.ndarray:
+    """Dense (non-paged) forward for embeddings: mean-pooled final hidden
+    state over real tokens. tokens/mask: [B, S]; returns [B, H] float32.
+
+    Serves the /v1/embeddings surface (reference: ``http/service/openai.rs``
+    embeddings route; the reference delegates the model to an engine)."""
+    h = _dense_hidden(params, cfg, tokens, mask)
     m = mask.astype(jnp.float32)[..., None]
     pooled = jnp.sum(h.astype(jnp.float32) * m, axis=1) / jnp.maximum(
         jnp.sum(m, axis=1), 1.0)
     return pooled
+
+
+def score(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
+          mask: jnp.ndarray, chunk: int = 256, top_n: int = 1
+          ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Prompt scoring for the OpenAI ``echo`` + logprobs surface (the
+    lm-eval loglikelihood workflow): log P(token[j] | tokens[:j]) for every
+    position, plus the ``top_n`` highest alternatives at each position.
+
+    Dense causal forward (no KV cache — shares :func:`_dense_hidden` with
+    ``encode``; the caller bounds S, see JaxEngine._score_batch), with the
+    LM head applied per S-chunk under ``lax.scan`` so the full [B, S, V]
+    logits tensor never materializes.
+
+    tokens/mask: [B, S] (S padded to a multiple of ``chunk``)
+    returns (target_lps [B, S] f32 — position 0 is 0 (no context),
+             top_ids [B, S, top_n] i32, top_lps [B, S, top_n] f32) —
+    tops at position j are the model's best alternatives for position j
+    given tokens[:j].
+    """
+    B, S = tokens.shape
+    h = _dense_hidden(params, cfg, tokens, mask)
+    lm8 = params.get("lm_head_q")
+    lm_head = params.get("lm_head")
+    if lm_head is None and lm8 is None:
+        lm_head = params["embed"].T
+
+    # chunked LM head: position j-1's logits score token j
+    nc = S // chunk
+    h_c = h.reshape(B, nc, chunk, -1).swapaxes(0, 1)       # [nc, B, c, H]
+    # targets for chunk c, slot k = tokens[:, c*chunk + k + 1]
+    tgt = jnp.concatenate([tokens[:, 1:], tokens[:, :1]], axis=1)
+    t_c = tgt.reshape(B, nc, chunk).swapaxes(0, 1)         # [nc, B, c]
+
+    def head_chunk(_, xs):
+        hc, tc = xs
+        if lm8 is not None:       # int8-quantized serving: same head
+            logits = quant.qdot(hc, lm8, params["lm_head_scale"],
+                                out_dtype=jnp.float32)
+        else:
+            logits = jnp.dot(hc, lm_head,
+                             preferred_element_type=jnp.float32)  # [B,c,V]
+        lsm = jax.nn.log_softmax(logits, axis=-1)
+        t_lp = jnp.take_along_axis(lsm, tc[..., None], axis=-1)[..., 0]
+        top_lp, top_id = jax.lax.top_k(lsm, top_n)    # [B, c, top_n]
+        return None, (t_lp, top_id.astype(jnp.int32), top_lp)
+
+    _, (t_lp, top_id, top_lp) = jax.lax.scan(head_chunk, None, (h_c, t_c))
+    # [nc, B, c, ...] -> [B, S, ...]; shift: position j-1 scored token j
+    def unchunk(a):
+        return a.swapaxes(0, 1).reshape((B, S) + a.shape[3:])
+    t_lp, top_id, top_lp = unchunk(t_lp), unchunk(top_id), unchunk(top_lp)
+    z = jnp.zeros((B, 1), jnp.float32)
+    target_lps = jnp.concatenate([z, t_lp[:, :-1]], axis=1)
+    top_ids = jnp.concatenate(
+        [jnp.zeros((B, 1, top_n), jnp.int32), top_id[:, :-1]], axis=1)
+    top_lps = jnp.concatenate(
+        [jnp.zeros((B, 1, top_n), jnp.float32), top_lp[:, :-1]], axis=1)
+    return target_lps, top_ids, top_lps
 
 
 def forward_unrolled(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
@@ -304,5 +373,5 @@ def forward_unrolled(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     return _logits(cfg, params, h, new_lens, window=logits_window), out_pages
 
 
-__all__ = ["init_params", "forward", "forward_unrolled", "encode",
+__all__ = ["init_params", "forward", "forward_unrolled", "encode", "score",
            "make_pages", "make_pages_list"]

@@ -1,0 +1,144 @@
+"""Prompt scoring: the legacy completions ``echo`` + logprobs surface
+(the lm-eval loglikelihood workflow).
+
+The crispest correctness check cross-validates two INDEPENDENT attention
+implementations: tokens generated greedily by the paged serving engine
+carry logprobs; scoring the full (prompt + generated) sequence with the
+dense no-cache forward must reproduce those values at the same positions.
+"""
+
+import json
+
+import aiohttp
+
+from dynamo_tpu.engine.jax_engine import JaxEngine, JaxEngineConfig
+from dynamo_tpu.http.service import HttpService
+from dynamo_tpu.llm.model_manager import ModelManager
+from dynamo_tpu.llm.pipeline import LocalEnginePipeline
+from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.protocols.common import (
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.utils.testing import make_test_card
+
+
+def engine():
+    return JaxEngine.random_init(
+        ModelConfig.tiny(vocab_size=300), JaxEngineConfig(
+            num_pages=64, page_size=4, max_num_seqs=4,
+            max_prefill_chunk=16, min_prefill_bucket=4, max_context=512))
+
+
+class TestScore:
+    async def test_score_matches_generation_logprobs(self):
+        eng = engine()
+        try:
+            prompt = [7, 3, 9, 4, 11, 2, 9]
+            req = PreprocessedRequest(
+                token_ids=list(prompt), request_id="g",
+                stop_conditions=StopConditions(max_tokens=4),
+                sampling_options=SamplingOptions(temperature=0.0),
+                eos_token_ids=[])
+            gen_toks, gen_lps = [], []
+            async for out in eng.generate(req):
+                gen_toks += out.token_ids
+                gen_lps += out.log_probs or []
+            assert len(gen_toks) == 4
+            [(lps, tids, tlps)] = await eng.score([prompt + gen_toks])
+            for k in range(4):
+                pos = len(prompt) + k
+                # dense no-cache forward vs paged serving forward
+                assert abs(float(lps[pos]) - gen_lps[k]) < 2e-3, (k, pos)
+                # greedy generation: the argmax alternative IS the token
+                assert int(tids[pos][0]) == gen_toks[k]
+                assert abs(float(tlps[pos][0]) - gen_lps[k]) < 2e-3
+        finally:
+            await eng.stop()
+
+    async def test_score_batch_lengths(self):
+        eng = engine()
+        try:
+            outs = await eng.score([[1, 2, 3], [4, 5, 6, 7, 8]])
+            assert [len(o[0]) for o in outs] == [3, 5]
+            assert float(outs[0][0][0]) == 0.0   # position 0: no context
+        finally:
+            await eng.stop()
+
+
+class TestEchoHttp:
+    async def test_echo_scoring_and_generation(self):
+        card = make_test_card(name="echo-score")
+        eng = engine()
+        manager = ModelManager()
+        manager.add("echo-score", LocalEnginePipeline(card, eng))
+        service = await HttpService(manager, host="127.0.0.1",
+                                    port=0).start()
+        try:
+            base = f"http://127.0.0.1:{service.port}"
+            async with aiohttp.ClientSession() as s:
+                # pure scoring: echo + max_tokens=0 + logprobs
+                r = await s.post(f"{base}/v1/completions", json={
+                    "model": "echo-score", "prompt": "hello world",
+                    "echo": True, "max_tokens": 0, "logprobs": 1})
+                assert r.status == 200, await r.text()
+                body = await r.json()
+                choice = body["choices"][0]
+                assert choice["text"] == "hello world"
+                lp = choice["logprobs"]
+                assert lp["tokens"][0] and "".join(
+                    lp["tokens"]) == "hello world"
+                assert lp["token_logprobs"][0] is None
+                assert all(isinstance(x, float)
+                           for x in lp["token_logprobs"][1:])
+                assert len(lp["top_logprobs"][1]) == 1  # asked logprobs=1
+                assert body["usage"]["prompt_tokens"] == len(lp["tokens"])
+
+                # echo + generation: text starts with the prompt and the
+                # logprob arrays cover prompt + generated tokens
+                r2 = await s.post(f"{base}/v1/completions", json={
+                    "model": "echo-score", "prompt": "hello world",
+                    "echo": True, "max_tokens": 3, "logprobs": 0})
+                body2 = await r2.json()
+                c2 = body2["choices"][0]
+                assert c2["text"].startswith("hello world")
+                n_prompt = len(lp["tokens"])
+                assert len(c2["logprobs"]["token_logprobs"]) == n_prompt + 3
+
+                # echo without logprobs: prompt text only, no logprobs obj
+                r3 = await s.post(f"{base}/v1/completions", json={
+                    "model": "echo-score", "prompt": "hi", "echo": True,
+                    "max_tokens": 2})
+                c3 = (await r3.json())["choices"][0]
+                assert c3["text"].startswith("hi")
+                assert c3.get("logprobs") is None
+
+                # multiple prompts with echo: explicit 501
+                r4 = await s.post(f"{base}/v1/completions", json={
+                    "model": "echo-score", "prompt": ["a", "b"],
+                    "echo": True, "max_tokens": 0})
+                assert r4.status == 501
+
+                # logprobs=3: three alternatives per position (clamped to
+                # the engine's num_top_logprobs)
+                r5 = await s.post(f"{base}/v1/completions", json={
+                    "model": "echo-score", "prompt": "hey",
+                    "echo": True, "max_tokens": 0, "logprobs": 3})
+                lp5 = (await r5.json())["choices"][0]["logprobs"]
+                # text-keyed OpenAI dicts collapse alternatives whose
+                # byte tokens render identically (e.g. two invalid-UTF-8
+                # bytes both showing as the replacement char)
+                assert 1 <= len(lp5["top_logprobs"][1]) <= 3
+
+                # a prompt beyond max_context must 400, not OOM the dense
+                # scoring forward
+                r6 = await s.post(f"{base}/v1/completions", json={
+                    "model": "echo-score",
+                    "prompt": list(range(1, 260)) * 3,
+                    "echo": True, "max_tokens": 0, "logprobs": 0})
+                assert r6.status == 400
+                assert "max context" in json.dumps(await r6.json())
+        finally:
+            await service.stop()
+            await eng.stop()
