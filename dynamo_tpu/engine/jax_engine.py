@@ -111,6 +111,12 @@ class JaxEngineConfig:
     spec_ngram_max: int = 4
     spec_ngram_min: int = 2
     spec_chain_break: int = 8
+    # prompt-scoring (completions echo + logprobs) length cap. Scoring is
+    # a DENSE forward with per-layer [B, H, S, S] f32 attention, so its
+    # memory is quadratic where paged generation's is linear — the ceiling
+    # must be far below a long-context max_context (32k would be ~137 GB
+    # per layer at 32 heads). Clamped to max_context.
+    score_max_tokens: int = 4096
     # mesh/sharding hooks (filled by dynamo_tpu.parallel when multi-chip)
     shard_params_fn: Optional[Callable] = None
     shard_pages_fn: Optional[Callable] = None
@@ -121,6 +127,11 @@ class JaxEngineConfig:
     mesh: Optional[object] = None
     sp_axis: str = "sp"
     ring_threshold: Optional[int] = None
+
+
+# prompt-scoring LM-head chunk: the ONE constant both the host padding
+# (_score_batch) and the traced reshape (family score()) must share
+_SCORE_CHUNK = 256
 
 
 def _bucket(n: int, lo: int, hi: int) -> int:
@@ -1211,23 +1222,28 @@ class JaxEngine(ScheduledEngineBase):
         list of (lps, top_ids [n, top_n], top_lps [n, top_n]) per input;
         index 0 carries no context (lp 0).
 
-        Bounded by ``max_context`` like generation: the dense forward
-        materializes [B, H, S, S] attention scores per layer, so an
-        unbounded prompt would be a one-request OOM."""
+        Bounded by ``score_max_tokens`` (NOT just max_context): the dense
+        forward materializes [B, H, S, S] attention scores per layer —
+        quadratic memory where paged generation's is linear — so a long
+        but generation-legal prompt must still be refused here."""
         from dynamo_tpu.models import get_family
         family = get_family(self.model_cfg)
         score = getattr(family, "score", None)
         if score is None:
             raise NotImplementedError(
                 f"{self.model_cfg.model_type} has no prompt-scoring path")
+        if not token_lists:
+            return []
+        cap = min(self.cfg.score_max_tokens, self.cfg.max_context)
         longest = max(len(t) for t in token_lists)
-        if longest > self.cfg.max_context:
+        if longest > cap:
             raise ValueError(
                 f"prompt of {longest} tokens exceeds max context "
-                f"{self.cfg.max_context} for scoring")
+                f"{cap} for scoring (dense-forward cap; "
+                f"engine score_max_tokens={self.cfg.score_max_tokens})")
         self._ensure_score_jit(score)
         B = len(token_lists)
-        chunk = 256
+        chunk = _SCORE_CHUNK
         S = max(chunk, -(-longest // chunk) * chunk)
         toks = np.zeros((B, S), np.int32)
         mask = np.zeros((B, S), bool)
@@ -1258,7 +1274,8 @@ class JaxEngine(ScheduledEngineBase):
         top_n = max(1, min(self.cfg.num_top_logprobs or 1,
                            self.model_cfg.vocab_size))
         self._jit_score = jax.jit(
-            lambda p, t, m: score(p, self.model_cfg, t, m, top_n=top_n),
+            lambda p, t, m: score(p, self.model_cfg, t, m,
+                                  chunk=_SCORE_CHUNK, top_n=top_n),
             **({"out_shardings": rep} if rep is not None else {}))
 
     def _score_batch_raw(self, toks, mask):

@@ -658,6 +658,9 @@ class HttpService:
                         return _error(501, "echo with multiple prompts is "
                                       "not implemented", "not_implemented")
                     p = p[0]
+                    # the generation half must see the SAME unwrapped
+                    # prompt (preprocess rejects list prompts)
+                    req = req.model_copy(update={"prompt": p})
                 tok = pipeline.preprocessor.tokenizer
                 echo_ids = list(p) if isinstance(p, list) else tok.encode(p)
                 if not echo_ids:

@@ -129,7 +129,10 @@ class ModelWatcher:
                 self.drt, client, entry.card, **self.kv_router_config)
         else:
             router = PushRouter(client, self.router_mode)
-        return RemotePipeline(entry.card, router)
+        from dynamo_tpu.llm.register import AUX_ENDPOINT
+        aux_ep = (self.drt.namespace(entry.namespace)
+                  .component(entry.component).endpoint(AUX_ENDPOINT))
+        return RemotePipeline(entry.card, router, aux_endpoint=aux_ep)
 
     async def _handle_delete(self, key: str) -> None:
         # key: models/{name}/{instance:x}

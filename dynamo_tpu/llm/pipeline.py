@@ -83,6 +83,17 @@ class ServicePipeline:
                 preprocessed, self.engine_stream(preprocessed)):
             yield out
 
+    def _embedding_token_lists(self, req) -> "list[list[int]]":
+        """Normalize an EmbeddingRequest's input into token id lists."""
+        inputs = req.input
+        if isinstance(inputs, str):
+            inputs = [inputs]
+        elif inputs and isinstance(inputs[0], int):
+            inputs = [inputs]  # single pre-tokenized prompt
+        return [item if isinstance(item, list)
+                else self.preprocessor.tokenizer.encode(item)
+                for item in inputs]
+
     async def generate_embeddings(self, req) -> "tuple[list, int]":
         """Tokenize the input(s) and embed. Returns (vectors, prompt_tokens).
         Raises NotImplementedError when this pipeline's engine can't embed."""
@@ -90,7 +101,7 @@ class ServicePipeline:
 
     async def score_prompt(self, token_ids):
         """Per-token prompt logprobs for the legacy completions ``echo``
-        surface. Returns (lps, top1_ids, top1_lps) arrays aligned with
+        surface. Returns (lps, top_ids, top_lps) arrays aligned with
         ``token_ids``. NotImplementedError when the engine can't score."""
         raise NotImplementedError("this pipeline does not score prompts")
 
@@ -117,15 +128,7 @@ class LocalEnginePipeline(ServicePipeline):
         embed = getattr(self.engine, "embed", None)
         if embed is None:
             raise NotImplementedError("engine has no embedding path")
-        inputs = req.input
-        if isinstance(inputs, str):
-            inputs = [inputs]
-        elif inputs and isinstance(inputs[0], int):
-            inputs = [inputs]  # single pre-tokenized prompt
-        token_lists = [
-            item if isinstance(item, list)
-            else self.preprocessor.tokenizer.encode(item)
-            for item in inputs]
+        token_lists = self._embedding_token_lists(req)
         vectors = await embed(token_lists)
         return ([[float(x) for x in v] for v in vectors],
                 sum(len(t) for t in token_lists))
@@ -159,7 +162,8 @@ class RemotePipeline(ServicePipeline):
     ``link([MigrationOperator], router_sink(router))``."""
 
     def __init__(self, card: ModelDeploymentCard, router: PushRouter,
-                 migration_limit: Optional[int] = None):
+                 migration_limit: Optional[int] = None,
+                 aux_endpoint=None):
         super().__init__(card)
         from dynamo_tpu.llm.operators import (
             MigrationOperator, link, router_sink)
@@ -168,6 +172,48 @@ class RemotePipeline(ServicePipeline):
                                 else card.migration_limit)
         self._source = link([MigrationOperator(self.migration_limit)],
                             router_sink(router))
+        # workers' one-shot aux plane (embeddings + prompt scoring);
+        # client created lazily on first use
+        self._aux_endpoint = aux_endpoint
+        self._aux_client = None
+
+    async def _aux_call(self, payload: dict) -> dict:
+        if self._aux_endpoint is None:
+            raise NotImplementedError(
+                "this deployment exposes no aux (embed/score) plane")
+        if self._aux_client is None:
+            self._aux_client = await self._aux_endpoint.client()
+        import random
+        ids = self._aux_client.instance_ids()
+        if not ids:
+            raise NotImplementedError(
+                "no worker serves the aux (embed/score) plane")
+        stream = await self._aux_client.direct(payload, random.choice(ids))
+        async for item in stream:
+            err = item.get("error") if isinstance(item, dict) else None
+            if err:
+                # typed by the worker: "value" = bad request (400-class),
+                # anything else = the capability is absent (501-class)
+                if item.get("kind") == "value":
+                    raise ValueError(err)
+                raise NotImplementedError(err)
+            return item
+        raise ConnectionError("aux stream ended without a response")
+
+    async def generate_embeddings(self, req) -> "tuple[list, int]":
+        token_lists = self._embedding_token_lists(req)
+        resp = await self._aux_call(
+            {"op": "embed", "token_lists": token_lists})
+        return resp["vectors"], sum(len(t) for t in token_lists)
+
+    async def score_prompt(self, token_ids):
+        import numpy as np
+        resp = await self._aux_call(
+            {"op": "score", "token_lists": [list(token_ids)]})
+        [s] = resp["scores"]
+        return (np.asarray(s["lps"], np.float32),
+                np.asarray(s["top_ids"], np.int32),
+                np.asarray(s["top_lps"], np.float32))
 
     def resolve_annotations(self, preprocessed: PreprocessedRequest) -> bool:
         from dynamo_tpu.preprocessor.preprocessor import (

@@ -40,6 +40,50 @@ async def serve_engine(endpoint: Endpoint, engine: EngineBase,
                                 stats_provider=stats_provider)
 
 
+AUX_ENDPOINT = "aux"
+
+
+def aux_handler(engine: EngineBase):
+    """One-shot auxiliary ops next to the generate plane: embeddings and
+    prompt scoring (echo + logprobs). Unary request/response over the
+    same RPC plane — this is what lets DISTRIBUTED frontends serve
+    /v1/embeddings and completions echo, not just in-process pipelines."""
+
+    async def handler(payload, ctx):
+        op = (payload or {}).get("op")
+        token_lists = (payload or {}).get("token_lists") or []
+        try:
+            if op == "embed" and hasattr(engine, "embed"):
+                vectors = await engine.embed(token_lists)
+                yield {"vectors": [[float(x) for x in row]
+                                   for row in vectors]}
+                return
+            if op == "score" and hasattr(engine, "score"):
+                outs = await engine.score(token_lists)
+                yield {"scores": [
+                    {"lps": [float(x) for x in lps],
+                     "top_ids": [[int(i) for i in r] for r in tids],
+                     "top_lps": [[float(x) for x in r] for r in tlps]}
+                    for lps, tids, tlps in outs]}
+                return
+        except ValueError as e:
+            # typed: the frontend maps "value" to a 400-class error and
+            # anything else to 501 — never by matching message text
+            yield {"error": str(e), "kind": "value"}
+            return
+        except NotImplementedError as e:
+            yield {"error": str(e), "kind": "unsupported"}
+            return
+        yield {"error": f"unsupported aux op {op!r}", "kind": "unsupported"}
+
+    return handler
+
+
+async def serve_aux(component, engine: EngineBase) -> ServedEndpoint:
+    """Serve the aux plane on a component (alongside ``generate``)."""
+    return await component.endpoint(AUX_ENDPOINT).serve(aux_handler(engine))
+
+
 async def register_llm(drt: DistributedRuntime, endpoint: Endpoint,
                        card: ModelDeploymentCard,
                        model_type: str = "chat") -> ModelEntry:

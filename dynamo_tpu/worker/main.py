@@ -374,6 +374,13 @@ async def amain(args: argparse.Namespace) -> None:
     else:
         await serve_engine(endpoint, tiered if tiered is not None else engine,
                            stats_provider=worker_stats)
+    # the aux plane (embeddings + prompt scoring) rides every worker that
+    # serves chat traffic, so DISTRIBUTED frontends can offer
+    # /v1/embeddings and completions echo (RemotePipeline calls it)
+    if args.disagg != "prefill" or prefill_first:
+        from dynamo_tpu.llm.register import serve_aux
+        await serve_aux(
+            drt.namespace(args.namespace).component(args.component), engine)
     bulk_server = None
     queue_worker = None
     if args.disagg == "prefill":

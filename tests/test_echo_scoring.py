@@ -67,6 +67,50 @@ class TestScore:
             await eng.stop()
 
 
+class TestDistributedAuxPlane:
+    """Embeddings and echo scoring through the DISTRIBUTED stack: real
+    frontend + worker processes, the frontend's RemotePipeline calling
+    the worker's aux endpoint (both used to 501 remotely)."""
+
+    async def test_embeddings_and_echo_via_frontend(self, tmp_path):
+        from dynamo_tpu.utils.testing import make_test_model_dir
+        from tests.procutils import ManagedProcess, free_port
+        from tests.test_serve_e2e import frontend, wait_model
+
+        model_dir = make_test_model_dir(str(tmp_path / "m"))
+        coord_port, http_port = free_port(), free_port()
+        base = f"http://127.0.0.1:{http_port}"
+        worker = ManagedProcess(
+            ["dynamo_tpu.worker.main", "--coordinator",
+             f"127.0.0.1:{coord_port}", "--model-path", model_dir,
+             "--model-name", "aux-model", "--random-weights",
+             "--page-size", "4", "--num-pages", "64",
+             "--max-num-seqs", "4", "--max-prefill-chunk", "16",
+             "--max-context", "256"],
+            name="aux-worker", ready_line="jax worker serving",
+            timeout=120.0)
+        async with frontend(coord_port, http_port):
+            async with worker:
+                await wait_model(base, "aux-model")
+                async with aiohttp.ClientSession() as s:
+                    r = await s.post(f"{base}/v1/embeddings", json={
+                        "model": "aux-model", "input": ["hi", "there"]})
+                    assert r.status == 200, await r.text()
+                    body = await r.json()
+                    assert len(body["data"]) == 2
+                    assert len(body["data"][0]["embedding"]) == 64
+
+                    r2 = await s.post(f"{base}/v1/completions", json={
+                        "model": "aux-model", "prompt": "hello world",
+                        "echo": True, "max_tokens": 0, "logprobs": 1})
+                    assert r2.status == 200, await r2.text()
+                    c = (await r2.json())["choices"][0]
+                    assert c["text"] == "hello world"
+                    assert c["logprobs"]["token_logprobs"][0] is None
+                    assert all(isinstance(x, float) for x in
+                               c["logprobs"]["token_logprobs"][1:])
+
+
 class TestEchoHttp:
     async def test_echo_scoring_and_generation(self):
         card = make_test_card(name="echo-score")
@@ -119,6 +163,15 @@ class TestEchoHttp:
                     "model": "echo-score", "prompt": ["a", "b"],
                     "echo": True, "max_tokens": 0})
                 assert r4.status == 501
+
+                # a SINGLE-element list prompt must also generate (the
+                # unwrap has to reach the generation half, not just echo)
+                r4b = await s.post(f"{base}/v1/completions", json={
+                    "model": "echo-score", "prompt": ["hi"],
+                    "echo": True, "max_tokens": 2})
+                assert r4b.status == 200, await r4b.text()
+                assert (await r4b.json())["choices"][0][
+                    "text"].startswith("hi")
 
                 # logprobs=3: three alternatives per position (clamped to
                 # the engine's num_top_logprobs)
