@@ -111,12 +111,11 @@ class JaxEngineConfig:
     spec_ngram_max: int = 4
     spec_ngram_min: int = 2
     spec_chain_break: int = 8
-    # prompt-scoring (completions echo + logprobs) length cap. Scoring is
-    # a DENSE forward with per-layer [B, H, S, S] f32 attention, so its
-    # memory is quadratic where paged generation's is linear — the ceiling
-    # must be far below a long-context max_context (32k would be ~137 GB
-    # per layer at 32 heads). Clamped to max_context.
-    score_max_tokens: int = 4096
+    # prompt-scoring (completions echo + logprobs) length cap; 0 = use
+    # max_context. Scoring runs the PAGED chunked-prefill forward against
+    # scratch pages (linear memory, any family), so the generation
+    # ceiling is the natural bound.
+    score_max_tokens: int = 0
     # mesh/sharding hooks (filled by dynamo_tpu.parallel when multi-chip)
     shard_params_fn: Optional[Callable] = None
     shard_pages_fn: Optional[Callable] = None
@@ -263,6 +262,16 @@ class JaxEngine(ScheduledEngineBase):
             self.params = self.cfg.shard_params_fn(self.params)
         if self.cfg.shard_pages_fn is not None:
             self.pages = self.cfg.shard_pages_fn(self.pages)
+        import inspect
+        try:
+            # gate for the logits_window surfaces (speculative verify +
+            # prompt scoring); computed once — custom forward_fns
+            # (pipeline stages) and exotic families lack the kwarg
+            self._fwd_has_logits_window = (
+                "logits_window" in inspect.signature(
+                    self._forward).parameters)
+        except (TypeError, ValueError):
+            self._fwd_has_logits_window = False
         self.spec_K = int(self.cfg.spec_tokens or 0)
         if self.spec_K:
             if forward_fn is not None:
@@ -270,13 +279,7 @@ class JaxEngine(ScheduledEngineBase):
                     "spec_tokens>0 does not compose with a custom "
                     "forward_fn (pipeline parallelism); drop "
                     "--speculative-num-tokens or the pp flag")
-            import inspect
-            try:
-                has_window = "logits_window" in inspect.signature(
-                    self._forward).parameters
-            except (TypeError, ValueError):
-                has_window = False
-            if not has_window:
+            if not self._fwd_has_logits_window:
                 raise ValueError(
                     "spec_tokens>0 needs a family forward with "
                     "logits_window support (all built-in families carry "
@@ -1222,26 +1225,24 @@ class JaxEngine(ScheduledEngineBase):
         list of (lps, top_ids [n, top_n], top_lps [n, top_n]) per input;
         index 0 carries no context (lp 0).
 
-        Bounded by ``score_max_tokens`` (NOT just max_context): the dense
-        forward materializes [B, H, S, S] attention scores per layer —
-        quadratic memory where paged generation's is linear — so a long
-        but generation-legal prompt must still be refused here."""
-        from dynamo_tpu.models import get_family
-        family = get_family(self.model_cfg)
-        score = getattr(family, "score", None)
-        if score is None:
-            raise NotImplementedError(
-                f"{self.model_cfg.model_type} has no prompt-scoring path")
+        Runs the family's PAGED chunked-prefill forward against scratch
+        pages with ``logits_window`` covering each full chunk — linear
+        memory, every family, no second attention implementation. The
+        dense ``llama.score`` remains as an independent test oracle."""
         if not token_lists:
             return []
-        cap = min(self.cfg.score_max_tokens, self.cfg.max_context)
+        cap = (self.cfg.score_max_tokens or self.cfg.max_context)
+        cap = min(cap, self.cfg.max_context)
         longest = max(len(t) for t in token_lists)
         if longest > cap:
             raise ValueError(
                 f"prompt of {longest} tokens exceeds max context "
-                f"{cap} for scoring (dense-forward cap; "
-                f"engine score_max_tokens={self.cfg.score_max_tokens})")
-        self._ensure_score_jit(score)
+                f"{cap} for scoring")
+        if not self._fwd_has_logits_window:
+            raise NotImplementedError(
+                f"{self.model_cfg.model_type} has no prompt-scoring "
+                "path (forward lacks logits_window / custom forward_fn)")
+        self._ensure_score_jit()
         B = len(token_lists)
         chunk = _SCORE_CHUNK
         S = max(chunk, -(-longest // chunk) * chunk)
@@ -1261,21 +1262,94 @@ class JaxEngine(ScheduledEngineBase):
         return [(lps[i, :len(t)], tids[i, :len(t)], tlps[i, :len(t)])
                 for i, t in enumerate(token_lists)]
 
-    def _ensure_score_jit(self, score=None):
+    def _score_impl(self, params, tokens, mask):
+        """Chunked-prefill scoring as ONE jitted program: scratch pages,
+        per-row disjoint page ranges, a ``lax.scan`` over full chunks of
+        the family forward with ``logits_window=chunk``; each chunk's
+        window logits score its own tokens' successors.
+
+        Padding discipline that keeps it exact: S is a multiple of the
+        chunk so every chunk is FULL (``new_lens`` uniform); pad
+        positions write KV into the row's own pages past its real length
+        and are attended by NOTHING real (pads only exist in the final
+        partial region, after every real position).
+        """
+        cfg = self.model_cfg
+        B, S = tokens.shape
+        chunk = _SCORE_CHUNK
+        ps = self.cfg.page_size
+        per_row = -(-S // ps)   # ceil: ps need not divide the padded S
+        # llama.make_pages is the universal (config-driven) page builder —
+        # the engine's own cache uses it for every family, deepseek's
+        # latent geometry included
+        pages = llama.make_pages(cfg, B * per_row + 1, ps)
+        table = (1 + jnp.arange(B * per_row, dtype=jnp.int32)
+                 ).reshape(B, per_row)
+        nc = S // chunk
+        toks_c = tokens.reshape(B, nc, chunk).swapaxes(0, 1)  # [nc, B, c]
+        # target for global position p is tokens[p+1] (the token position
+        # p's logits predict) — shifted ONCE here so the last slot of a
+        # chunk reaches across the chunk boundary
+        tgt = jnp.concatenate([tokens[:, 1:], tokens[:, :1]], axis=1)
+        tgt_c = tgt.reshape(B, nc, chunk).swapaxes(0, 1)
+
+        top_n = max(1, min(self.cfg.num_top_logprobs or 1,
+                           cfg.vocab_size))
+
+        attn_kw = {}
+        if self.attn_impl == "pallas":
+            # same chunked-prefill kernel the serving prefill and
+            # spec-verify steps run (S > 1)
+            from dynamo_tpu.ops.pallas.prefill import (
+                paged_prefill_attention_stacked)
+            attn_kw = {"attn_impl": paged_prefill_attention_stacked}
+
+        def body(pages, xs):
+            tc, gc, ci = xs
+            pos = (ci * chunk
+                   + jnp.arange(chunk, dtype=jnp.int32))[None, :]
+            pos = jnp.tile(pos, (B, 1))
+            total = jnp.full((B,), (ci + 1) * chunk, jnp.int32)
+            new = jnp.full((B,), chunk, jnp.int32)
+            out = self._forward(params, cfg, tc, pos, pages, table,
+                                total, new, logits_window=chunk,
+                                **attn_kw)
+            logits, pages = out[0], out[1]          # [B, chunk, V]
+            lsm = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+            # gather INSIDE the scan: only [B, chunk(, top_n)] leaves each
+            # step — the full [B, S, V] logits never materialize
+            t_lp = jnp.take_along_axis(lsm, gc[..., None], axis=-1)[..., 0]
+            top_lp, top_id = jax.lax.top_k(lsm, top_n)
+            return pages, (t_lp, top_id.astype(jnp.int32), top_lp)
+
+        _, (t_lp, top_id, top_lp) = jax.lax.scan(
+            body, pages, (toks_c, tgt_c, jnp.arange(nc)))
+
+        def unchunk(a):
+            return a.swapaxes(0, 1).reshape((B, S) + a.shape[3:])
+
+        t_lp, top_id, top_lp = (unchunk(t_lp), unchunk(top_id),
+                                unchunk(top_lp))
+        # position j-1 predicts token j; index 0 has no context (and the
+        # wrapped final target is dropped by the same shift)
+        z = jnp.zeros((B, 1), jnp.float32)
+        target_lps = jnp.concatenate([z, t_lp[:, :-1]], axis=1)
+        top_ids = jnp.concatenate(
+            [jnp.zeros((B, 1, top_n), jnp.int32), top_id[:, :-1]], axis=1)
+        top_lps = jnp.concatenate(
+            [jnp.zeros((B, 1, top_n), jnp.float32), top_lp[:, :-1]],
+            axis=1)
+        return target_lps, top_ids, top_lps
+
+    def _ensure_score_jit(self):
         if hasattr(self, "_jit_score"):
             return
-        if score is None:
-            from dynamo_tpu.models import get_family
-            score = get_family(self.model_cfg).score
         rep = None
         if self.cfg.mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec
             rep = NamedSharding(self.cfg.mesh, PartitionSpec())
-        top_n = max(1, min(self.cfg.num_top_logprobs or 1,
-                           self.model_cfg.vocab_size))
         self._jit_score = jax.jit(
-            lambda p, t, m: score(p, self.model_cfg, t, m,
-                                  chunk=_SCORE_CHUNK, top_n=top_n),
+            self._score_impl,
             **({"out_shardings": rep} if rep is not None else {}))
 
     def _score_batch_raw(self, toks, mask):

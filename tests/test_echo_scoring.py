@@ -10,6 +10,7 @@ dense no-cache forward must reproduce those values at the same positions.
 import json
 
 import aiohttp
+import pytest
 
 from dynamo_tpu.engine.jax_engine import JaxEngine, JaxEngineConfig
 from dynamo_tpu.http.service import HttpService
@@ -56,6 +57,80 @@ class TestScore:
                 assert abs(float(tlps[pos][0]) - gen_lps[k]) < 2e-3
         finally:
             await eng.stop()
+
+    async def test_paged_scorer_matches_dense_oracle(self):
+        # the serving scorer is the PAGED chunked-prefill forward; the
+        # dense no-cache llama.score stays as an independent oracle
+        import jax
+        import numpy as np
+
+        from dynamo_tpu.models import llama
+        eng = engine()
+        try:
+            prompt = [9, 2, 14, 3, 8, 1, 5, 5, 12]
+            [(lps, tids, tlps)] = await eng.score([prompt])
+            toks = np.zeros((1, 256), np.int32)
+            toks[0, :len(prompt)] = prompt
+            mask = np.zeros((1, 256), bool)
+            mask[0, :len(prompt)] = True
+            d_lps, d_tids, d_tlps = jax.jit(
+                lambda p, t, m: llama.score(p, eng.model_cfg, t, m,
+                                            top_n=tids.shape[1]))(
+                eng.params, toks, mask)
+            np.testing.assert_allclose(
+                np.asarray(lps), np.asarray(d_lps)[0, :len(prompt)],
+                rtol=1e-3, atol=1e-3)
+            assert np.array_equal(
+                np.asarray(tids)[1:], np.asarray(d_tids)[0, 1:len(prompt)])
+        finally:
+            await eng.stop()
+
+    @pytest.mark.async_timeout(420)
+    async def test_all_families_score(self):
+        # the paged scorer is family-agnostic (logits_window): gemma-2,
+        # MoE, and DeepSeek all score, cross-checked against their own
+        # greedy generation logprobs
+        cfgs = [
+            ModelConfig.tiny(model_type="gemma2", num_layers=2,
+                             sliding_window=8, attn_logit_softcap=40.0,
+                             final_logit_softcap=25.0),
+            ModelConfig.tiny(model_type="qwen3_moe", num_experts=4,
+                             num_experts_per_tok=2,
+                             moe_intermediate_size=32),
+            ModelConfig(
+                vocab_size=256, hidden_size=64, intermediate_size=128,
+                num_layers=2, num_heads=4, num_kv_heads=1, head_dim=32,
+                model_type="deepseek_v2", dtype="float32",
+                q_lora_rank=0, kv_lora_rank=32, qk_rope_head_dim=16,
+                qk_nope_head_dim=32, v_head_dim=32, num_experts=4,
+                num_experts_per_tok=2, moe_intermediate_size=32,
+                n_shared_experts=2, first_k_dense_replace=1,
+                routed_scaling_factor=1.0),
+        ]
+        for cfg in cfgs:
+            eng = JaxEngine.random_init(cfg, JaxEngineConfig(
+                num_pages=64, page_size=4, max_num_seqs=4,
+                max_prefill_chunk=16, min_prefill_bucket=4,
+                max_context=512))
+            try:
+                prompt = [7, 3, 9, 4, 11, 2, 9]
+                req = PreprocessedRequest(
+                    token_ids=list(prompt), request_id="g",
+                    stop_conditions=StopConditions(max_tokens=3),
+                    sampling_options=SamplingOptions(temperature=0.0),
+                    eos_token_ids=[])
+                gen_toks, gen_lps = [], []
+                async for out in eng.generate(req):
+                    gen_toks += out.token_ids
+                    gen_lps += out.log_probs or []
+                [(lps, tids, tlps)] = await eng.score([prompt + gen_toks])
+                for k in range(3):
+                    pos = len(prompt) + k
+                    assert abs(float(lps[pos]) - gen_lps[k]) < 2e-3, \
+                        (cfg.model_type, k)
+                    assert int(tids[pos][0]) == gen_toks[k], cfg.model_type
+            finally:
+                await eng.stop()
 
     async def test_score_batch_lengths(self):
         eng = engine()

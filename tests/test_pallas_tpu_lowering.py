@@ -162,6 +162,31 @@ def test_flagship_decode_step_lowers_for_tpu():
     _assert_mosaic(exp)
 
 
+def test_prompt_scoring_program_lowers_for_tpu():
+    """The engine's paged prompt-scoring program (chunked-prefill scan
+    with the Pallas prefill kernel inside, per-chunk LM-head gather)
+    exports for the TPU platform at a 3B-like geometry — the program a
+    completions echo+logprobs request runs on chip."""
+    import dataclasses
+
+    from dynamo_tpu.engine.jax_engine import JaxEngine, JaxEngineConfig
+    from dynamo_tpu.models import llama
+    from dynamo_tpu.models.config import ModelConfig
+
+    cfg = dataclasses.replace(ModelConfig.llama32_3b(), num_layers=2)
+    eng = JaxEngine(
+        cfg, jax.eval_shape(
+            lambda: llama.init_params(cfg, jax.random.PRNGKey(0))),
+        JaxEngineConfig(num_pages=16, page_size=16, max_num_seqs=2,
+                        max_prefill_chunk=256, max_context=512,
+                        attn_impl="pallas"))
+    exp = jax.export.export(jax.jit(eng._score_impl), platforms=["tpu"])(
+        eng.params,
+        jax.ShapeDtypeStruct((1, 512), jnp.int32),
+        jax.ShapeDtypeStruct((1, 512), jnp.bool_))
+    _assert_mosaic(exp)
+
+
 def test_deepseek_mla_forward_lowers_for_tpu():
     """DeepSeek forward with BOTH MLA kernels (decode S=1 and prefill
     S>1 traces) exports for TPU at a V3-like attention geometry."""
