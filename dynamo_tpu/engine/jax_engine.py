@@ -112,10 +112,12 @@ class JaxEngineConfig:
     spec_ngram_min: int = 2
     spec_chain_break: int = 8
     # prompt-scoring (completions echo + logprobs) length cap; 0 = use
-    # max_context. Scoring runs the PAGED chunked-prefill forward against
-    # scratch pages (linear memory, any family), so the generation
-    # ceiling is the natural bound.
-    score_max_tokens: int = 0
+    # max_context. Scoring runs the PAGED chunked-prefill forward — linear
+    # memory — but against a FRESH scratch cache allocated next to the
+    # live serving pool, so the default stays bounded: a ~max_context
+    # scoring request on a long-context deployment would otherwise
+    # double-allocate HBM mid-serve. Raise deliberately.
+    score_max_tokens: int = 4096
     # mesh/sharding hooks (filled by dynamo_tpu.parallel when multi-chip)
     shard_params_fn: Optional[Callable] = None
     shard_pages_fn: Optional[Callable] = None
@@ -1220,10 +1222,10 @@ class JaxEngine(ScheduledEngineBase):
     # -- prompt scoring (echo + logprobs / loglikelihood) ------------------
 
     def _score_batch(self, token_lists):
-        """Per-token prompt logprobs (one-shot dense forward, no KV —
-        the OpenAI ``echo`` + lm-eval loglikelihood surface). Returns a
-        list of (lps, top_ids [n, top_n], top_lps [n, top_n]) per input;
-        index 0 carries no context (lp 0).
+        """Per-token prompt logprobs (the OpenAI ``echo`` + lm-eval
+        loglikelihood surface). Returns a list of
+        (lps, top_ids [n, top_n], top_lps [n, top_n]) per input; index 0
+        carries no context (lp 0).
 
         Runs the family's PAGED chunked-prefill forward against scratch
         pages with ``logits_window`` covering each full chunk — linear

@@ -369,6 +369,77 @@ class TestEngineSpecDecode:
             for t in g:                    # logits from a [B,S] chunk vs a
                 assert abs(g[t] - w[t]) < 1e-3   # [B,1] step: ulp drift ok
 
+    async def test_cancel_mid_speculation_leaves_engine_healthy(
+            self, monkeypatch):
+        # cancel while verify steps are the active plan (oracle drafts
+        # keep the spec path engaged): the stream must end CANCELLED and
+        # the engine must serve a follow-up normally
+        class Ctx:
+            cancelled = False
+
+        base = spec_engine(spec_tokens=0)
+        try:
+            want = await _greedy_tokens(base, PROMPT, "b", 16)
+        finally:
+            await base.stop()
+        full = list(PROMPT) + want
+
+        def oracle(tokens, k, max_n=4, min_n=2):
+            n = len(tokens)
+            if n >= len(full) or list(tokens) != full[:n]:
+                return None
+            cont = full[n:n + k]
+            while len(cont) < k:
+                cont.append(cont[-1])
+            return cont
+
+        import dynamo_tpu.engine.scheduler as sched_mod
+        monkeypatch.setattr(sched_mod, "propose_ngram", oracle)
+        eng = spec_engine(spec_tokens=3)
+        try:
+            ctx = Ctx()
+            req = make_req(PROMPT, "cx", max_tokens=64)
+            req.eos_token_ids = []
+            frames = []
+            async for out in eng.generate(req, ctx=ctx):
+                frames.append(out)
+                if sum(len(f.token_ids) for f in frames) >= 4:
+                    ctx.cancelled = True
+            assert frames[-1].finish_reason == FinishReason.CANCELLED
+            assert eng.stats().spec_decode_stats.num_drafts > 0
+
+            follow = await _greedy_tokens(eng, PROMPT, "fw", 6)
+            assert follow == want[:6]
+        finally:
+            await eng.stop()
+
+    async def test_preemption_under_speculation_resumes_identically(self):
+        # page pressure preempts one sequence while speculation is on;
+        # the revived stream must match its uncontended greedy run (the
+        # verify step's +K page lookahead must not corrupt the revive)
+        solo = spec_engine(spec_tokens=3)
+        try:
+            ref = make_req(list(range(11, 18)), "solo", max_tokens=9)
+            ref.eos_token_ids = []
+            want = [t for f in await collect(solo, ref)
+                    for t in f.token_ids]
+        finally:
+            await solo.stop()
+
+        eng = spec_engine(spec_tokens=3, num_pages=8, max_context=32)
+        try:
+            a = make_req(list(range(1, 8)), "a", max_tokens=9)
+            b = make_req(list(range(11, 18)), "b", max_tokens=9)
+            a.eos_token_ids = []
+            b.eos_token_ids = []
+            ra, rb = await asyncio.gather(collect(eng, a), collect(eng, b))
+            for frames in (ra, rb):
+                toks = [t for f in frames for t in f.token_ids]
+                assert len(toks) == 9
+            assert [t for f in rb for t in f.token_ids] == want
+        finally:
+            await eng.stop()
+
     async def test_topk_wider_than_vocab_clamps(self):
         # num_top_logprobs > vocab_size: pack and unpack must agree on the
         # clamped width (was a latent misalignment crash)
