@@ -1238,8 +1238,11 @@ class JaxEngine(ScheduledEngineBase):
         longest = max(len(t) for t in token_lists)
         if longest > cap:
             raise ValueError(
-                f"prompt of {longest} tokens exceeds max context "
-                f"{cap} for scoring")
+                f"prompt of {longest} tokens exceeds the scoring cap "
+                f"{cap} (engine score_max_tokens="
+                f"{self.cfg.score_max_tokens or 'max_context'}, "
+                f"max_context {self.cfg.max_context}) — raise "
+                "score_max_tokens to score longer prompts")
         if not self._fwd_has_logits_window:
             raise NotImplementedError(
                 f"{self.model_cfg.model_type} has no prompt-scoring "

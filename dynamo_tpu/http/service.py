@@ -367,6 +367,14 @@ class HttpService:
                 if chunk.usage is not None and not chunk.choices:
                     usage.prompt_tokens = chunk.usage.prompt_tokens
                     usage.completion_tokens += chunk.usage.completion_tokens
+                    if (i == 0 and chunk.usage.prompt_tokens_details
+                            is not None):
+                        # prompt-caching details from CHOICE 0 only: later
+                        # concurrent choices hit the prefix cache choice 0
+                        # just populated, which would claim a cold prompt
+                        # was cached
+                        usage.prompt_tokens_details = \
+                            chunk.usage.prompt_tokens_details
                     continue
                 # token accounting from stream i's delta counter (a chunk
                 # may carry several tokens; chunks != tokens)
@@ -384,7 +392,8 @@ class HttpService:
                 await resp.write(sse.encode_data({
                     "id": request_id, "object": "chat.completion.chunk",
                     "created": now_unix(), "model": req.model,
-                    "choices": [], "usage": usage.model_dump()}))
+                    "choices": [],
+                    "usage": usage.model_dump(exclude_none=True)}))
             await resp.write(sse.encode_done())
         except (ConnectionResetError, asyncio.CancelledError):
             status = "499"
@@ -496,6 +505,11 @@ class HttpService:
             # prompt tokens count ONCE; completion tokens sum over choices
             usage.prompt_tokens = u.prompt_tokens
             usage.completion_tokens += u.completion_tokens
+            # prompt-caching details from CHOICE 0 only: later concurrent
+            # choices hit the prefix cache choice 0 just populated, which
+            # would claim a cold prompt was served cached
+            if i == 0 and u.prompt_tokens_details is not None:
+                usage.prompt_tokens_details = u.prompt_tokens_details
         usage.total_tokens = usage.prompt_tokens + usage.completion_tokens
         body = ChatCompletionResponse(
             id=request_id, created=now_unix(), model=req.model,

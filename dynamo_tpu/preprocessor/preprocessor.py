@@ -228,6 +228,7 @@ class DeltaGenerator:
         self._first = True
         self.prompt_tokens = 0
         self.completion_tokens = 0
+        self.cached_tokens: Optional[int] = None
 
     def chunk_from(self, out: BackendOutput) -> List[ChatCompletionChunk]:
         chunks: List[ChatCompletionChunk] = []
@@ -236,6 +237,8 @@ class DeltaGenerator:
             self.prompt_tokens = out.prompt_tokens
         if out.completion_tokens is not None:
             self.completion_tokens = out.completion_tokens
+        if out.cached_tokens is not None:
+            self.cached_tokens = out.cached_tokens
         role = "assistant" if self._first else None
         self._first = False
         # emit on logprob entries too: a frame whose tokens decoded to no
@@ -263,7 +266,12 @@ class DeltaGenerator:
             usage=Usage(
                 prompt_tokens=self.prompt_tokens,
                 completion_tokens=self.completion_tokens,
-                total_tokens=self.prompt_tokens + self.completion_tokens))
+                total_tokens=self.prompt_tokens + self.completion_tokens,
+                # OpenAI prompt-caching surface: how many prompt tokens
+                # were served from the prefix cache
+                prompt_tokens_details=(
+                    {"cached_tokens": self.cached_tokens}
+                    if self.cached_tokens is not None else None)))
 
 
 __all__ = ["OpenAIPreprocessor", "DeltaGenerator",

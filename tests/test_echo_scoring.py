@@ -266,7 +266,7 @@ class TestEchoHttp:
                     "prompt": list(range(1, 260)) * 3,
                     "echo": True, "max_tokens": 0, "logprobs": 0})
                 assert r6.status == 400
-                assert "max context" in json.dumps(await r6.json())
+                assert "scoring cap" in json.dumps(await r6.json())
         finally:
             await service.stop()
             await eng.stop()
