@@ -1237,12 +1237,16 @@ class JaxEngine(ScheduledEngineBase):
         cap = min(cap, self.cfg.max_context)
         longest = max(len(t) for t in token_lists)
         if longest > cap:
+            # name the knob that actually binds: raising the other one
+            # cannot help
+            knob = ("score_max_tokens" if cap < self.cfg.max_context
+                    else "max_context")
             raise ValueError(
                 f"prompt of {longest} tokens exceeds the scoring cap "
-                f"{cap} (engine score_max_tokens="
+                f"{cap} (score_max_tokens="
                 f"{self.cfg.score_max_tokens or 'max_context'}, "
-                f"max_context {self.cfg.max_context}) — raise "
-                "score_max_tokens to score longer prompts")
+                f"max_context {self.cfg.max_context}) — raise {knob} "
+                "to score longer prompts")
         if not self._fwd_has_logits_window:
             raise NotImplementedError(
                 f"{self.model_cfg.model_type} has no prompt-scoring "

@@ -62,6 +62,17 @@ def _legacy_logprobs(entries: List[dict], offset_start: int = 0):
     return out, off
 
 
+def _merge_choice_usage(usage: "Usage", u: "Usage", i: int) -> None:
+    """Fold one choice's usage into the request total: prompt tokens count
+    ONCE, completion tokens sum, and prompt-caching details come from
+    CHOICE 0 only — later concurrent choices hit the prefix cache choice 0
+    just populated, which would claim a cold prompt was served cached."""
+    usage.prompt_tokens = u.prompt_tokens
+    usage.completion_tokens += u.completion_tokens
+    if i == 0 and u.prompt_tokens_details is not None:
+        usage.prompt_tokens_details = u.prompt_tokens_details
+
+
 def _error(status: int, message: str, etype: str = "invalid_request_error") -> web.Response:
     return web.json_response(
         {"error": {"message": message, "type": etype, "code": status}},
@@ -365,16 +376,7 @@ class HttpService:
                 if isinstance(chunk, Exception):
                     raise chunk
                 if chunk.usage is not None and not chunk.choices:
-                    usage.prompt_tokens = chunk.usage.prompt_tokens
-                    usage.completion_tokens += chunk.usage.completion_tokens
-                    if (i == 0 and chunk.usage.prompt_tokens_details
-                            is not None):
-                        # prompt-caching details from CHOICE 0 only: later
-                        # concurrent choices hit the prefix cache choice 0
-                        # just populated, which would claim a cold prompt
-                        # was cached
-                        usage.prompt_tokens_details = \
-                            chunk.usage.prompt_tokens_details
+                    _merge_choice_usage(usage, chunk.usage, i)
                     continue
                 # token accounting from stream i's delta counter (a chunk
                 # may carry several tokens; chunks != tokens)
@@ -502,14 +504,7 @@ class HttpService:
                                else finish_reason or "stop"),
                 logprobs=(ChoiceLogprobs(content=lp_entries)
                           if lp_entries else None)))
-            # prompt tokens count ONCE; completion tokens sum over choices
-            usage.prompt_tokens = u.prompt_tokens
-            usage.completion_tokens += u.completion_tokens
-            # prompt-caching details from CHOICE 0 only: later concurrent
-            # choices hit the prefix cache choice 0 just populated, which
-            # would claim a cold prompt was served cached
-            if i == 0 and u.prompt_tokens_details is not None:
-                usage.prompt_tokens_details = u.prompt_tokens_details
+            _merge_choice_usage(usage, u, i)
         usage.total_tokens = usage.prompt_tokens + usage.completion_tokens
         body = ChatCompletionResponse(
             id=request_id, created=now_unix(), model=req.model,
@@ -631,7 +626,11 @@ class HttpService:
             }],
             "usage": {"input_tokens": usage.prompt_tokens,
                       "output_tokens": usage.completion_tokens,
-                      "total_tokens": usage.total_tokens},
+                      "total_tokens": usage.total_tokens,
+                      # Responses-API prompt-caching surface
+                      "input_tokens_details": {
+                          "cached_tokens": (usage.prompt_tokens_details
+                                            or {}).get("cached_tokens", 0)}},
         })
 
     async def handle_completions(self, request: web.Request) -> web.StreamResponse:
@@ -734,7 +733,11 @@ class HttpService:
                                 prompt_tokens=out.prompt_tokens or 0,
                                 completion_tokens=out.completion_tokens or 0,
                                 total_tokens=(out.prompt_tokens or 0)
-                                + (out.completion_tokens or 0))
+                                + (out.completion_tokens or 0),
+                                prompt_tokens_details=(
+                                    {"cached_tokens": out.cached_tokens}
+                                    if out.cached_tokens is not None
+                                    else None))
                 finally:
                     await gen.aclose()
                 return "".join(text_parts), finish, lp_entries, u
@@ -766,8 +769,7 @@ class HttpService:
                     finish_reason=finish or "stop",
                     logprobs=(_legacy_logprobs(lp_entries)[0]
                               if lp_entries else None)))
-                usage.prompt_tokens = u.prompt_tokens
-                usage.completion_tokens += u.completion_tokens
+                _merge_choice_usage(usage, u, i)
             usage.total_tokens = (usage.prompt_tokens
                                   + usage.completion_tokens)
             body = CompletionResponse(
