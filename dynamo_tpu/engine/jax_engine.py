@@ -1237,10 +1237,15 @@ class JaxEngine(ScheduledEngineBase):
         cap = min(cap, self.cfg.max_context)
         longest = max(len(t) for t in token_lists)
         if longest > cap:
-            # name the knob that actually binds: raising the other one
-            # cannot help
-            knob = ("score_max_tokens" if cap < self.cfg.max_context
-                    else "max_context")
+            # name the knob(s) that actually bind: raising a non-binding
+            # one cannot help, and when both are equal BOTH bind
+            smt = self.cfg.score_max_tokens or self.cfg.max_context
+            if smt < self.cfg.max_context:
+                knob = "score_max_tokens"
+            elif smt > self.cfg.max_context:
+                knob = "max_context"
+            else:
+                knob = "score_max_tokens AND max_context"
             raise ValueError(
                 f"prompt of {longest} tokens exceeds the scoring cap "
                 f"{cap} (score_max_tokens="

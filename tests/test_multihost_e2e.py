@@ -71,6 +71,23 @@ def test_two_process_tp8_serving(tmp_path):
                     timeout=aiohttp.ClientTimeout(total=120))).json()
                 # lockstep determinism through the two-process mesh
                 assert r2["choices"][0]["message"]["content"] == text1
+
+                # the aux plane's one-shot jits broadcast to followers in
+                # the same lockstep: embeddings + echo scoring must both
+                # answer (a desynced rank would hang or kill a worker)
+                re_ = await (await s.post(
+                    f"{base}/v1/embeddings",
+                    json={"model": "mh-model", "input": "hello"},
+                    timeout=aiohttp.ClientTimeout(total=120))).json()
+                assert len(re_["data"][0]["embedding"]) > 0
+                rs = await (await s.post(
+                    f"{base}/v1/completions",
+                    json={"model": "mh-model", "prompt": "hello world",
+                          "echo": True, "max_tokens": 0, "logprobs": 0},
+                    timeout=aiohttp.ClientTimeout(total=120))).json()
+                assert rs["choices"][0]["text"] == "hello world"
+                assert rs["choices"][0]["logprobs"][
+                    "token_logprobs"][0] is None
             assert w0.proc.poll() is None and w1.proc.poll() is None
         finally:
             for p in (w1, w0, fe):
