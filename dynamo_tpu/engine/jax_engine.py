@@ -380,6 +380,23 @@ class JaxEngine(ScheduledEngineBase):
                 g = self._grammar_cache.setdefault(key, g)
         return g
 
+    def _guided_req_for(self, seq, spec: dict):
+        """Get-or-(re)build the per-request automaton and sync it to the
+        sequence's generated tokens — shared by the plain per-step masks
+        and the verify step's per-slot masks. ``n_seen`` beyond
+        ``generated`` means a preemption rewound the sequence; rebuild
+        and re-walk from scratch."""
+        from dynamo_tpu.engine.guided import GuidedRequest
+        rid = seq.request.request_id
+        gr = self._guided_reqs.get(rid)
+        if gr is None or gr.n_seen > len(seq.generated):
+            gr = GuidedRequest(self._grammar_for(spec), self._guided_vocab,
+                               self._guided_bytes)
+            self._guided_reqs[rid] = gr
+        gr.catch_up(seq.generated)
+        gr.last_step = self._step_counter
+        return gr
+
     def _guided_masks(self, rows, B: int) -> Optional[np.ndarray]:
         """Per-row packed allow-masks for this step, or None when no row
         is constrained. Unconstrained rows are all-ones (the device no-op).
@@ -388,22 +405,12 @@ class JaxEngine(ScheduledEngineBase):
         gv = self._guided_vocab
         if gv is None:
             return None
-        from dynamo_tpu.engine.guided import GuidedRequest
         masks = None
         for i, seq in enumerate(rows):
             spec = seq.request.sampling_options.guided
             if not spec:
                 continue
-            rid = seq.request.request_id
-            gr = self._guided_reqs.get(rid)
-            if gr is None or gr.n_seen > len(seq.generated):
-                # n_seen beyond generated = a preemption rewound the
-                # sequence; rebuild and re-walk from scratch
-                gr = GuidedRequest(self._grammar_for(spec), gv,
-                                   self._guided_bytes)
-                self._guided_reqs[rid] = gr
-            gr.catch_up(seq.generated)
-            gr.last_step = self._step_counter
+            gr = self._guided_req_for(seq, spec)
             m = gr.mask()
             if m is not None:
                 if masks is None:
@@ -506,7 +513,7 @@ class JaxEngine(ScheduledEngineBase):
 
     def _spec_step_impl(self, params, pages, tokens, positions, page_table,
                         total_lens, new_lens, rng, step, temperature, top_k,
-                        top_p):
+                        top_p, gmask=None):
         """Speculative verify step: a [B, K+1] chunked forward whose
         sampling tail rejection-samples the K drafts on device
         (``ops/sampling.spec_verify``). tokens[:, 0] is each row's last
@@ -541,6 +548,15 @@ class JaxEngine(ScheduledEngineBase):
         # MoE families return a third aux dict (dispatch drop counts)
         logits, pages = out[0], out[1]
         aux = out[2] if len(out) > 2 else {}
+        if gmask is not None:
+            # mask ONCE here so the packed top alternatives below see the
+            # same constrained distribution the verifier samples from —
+            # the plain path masks before its top-K too
+            from dynamo_tpu.ops.sampling import apply_vocab_mask
+            Bm, Sm, Vm = logits.shape
+            logits = apply_vocab_mask(
+                logits.astype(jnp.float32).reshape(Bm * Sm, Vm),
+                gmask.reshape(Bm * Sm, -1)).reshape(Bm, Sm, Vm)
         key = jax.random.fold_in(rng, step)
         n_acc, final_tok, final_lp, draft_lps = spec_verify(
             logits, tokens, key, temperature, top_k, top_p)
@@ -896,6 +912,7 @@ class JaxEngine(ScheduledEngineBase):
         temp = np.zeros(B, np.float32)
         top_k = np.zeros(B, np.int32)
         top_p = np.ones(B, np.float32)
+        gmask = None
         for i, seq in enumerate(seqs):
             toks[i, 0] = seq.tokens.last_token()
             toks[i, 1:] = drafts[i]
@@ -909,8 +926,67 @@ class JaxEngine(ScheduledEngineBase):
             top_k[i] = so.top_k or 0
             if so.top_p is not None:
                 top_p[i] = so.top_p
-        return dict(toks=toks, pos=pos, table=table, total=total, new=new,
-                    temp=temp, top_k=top_k, top_p=top_p)
+            row_masks = self._guided_spec_masks(seq, drafts[i], S)
+            if row_masks is not None:
+                if gmask is None:
+                    gmask = np.full(
+                        (B, S, self._guided_vocab.words), 0xFFFFFFFF,
+                        np.uint32)
+                gmask[i] = row_masks
+        out = dict(toks=toks, pos=pos, table=table, total=total, new=new,
+                   temp=temp, top_k=top_k, top_p=top_p)
+        if gmask is not None:
+            out["gmask"] = gmask
+        return out
+
+    def _guided_spec_masks(self, seq, row_drafts, S: int):
+        """Per-chunk-slot allow-masks for one guided row of a verify step.
+
+        Slot j's mask is computed from the automaton state AFTER walking
+        drafts 1..j — the host knows the whole draft path up front. A
+        draft the grammar rejects simply stops the walk: its own slot's
+        mask zeroes it (so verification rejects there), and later slots'
+        masks are never consulted (acceptance cannot pass the rejection).
+        Returns None for unguided/wedged rows (the device no-op)."""
+        spec = seq.request.sampling_options.guided
+        gv = self._guided_vocab
+        if not spec or gv is None:
+            return None
+        from dynamo_tpu.engine.guided import step
+        gr = self._guided_req_for(seq, spec)
+        m0 = gr.mask()
+        if m0 is None:
+            return None           # wedged: serve unconstrained
+        out = np.full((S, gv.words), 0xFFFFFFFF, np.uint32)
+        out[0] = m0
+        st = gr.state
+        for j, tid in enumerate(row_drafts[:S - 1], start=1):
+            if int(tid) in gv.eos_ids:
+                # a drafted EOS leaves the automaton state unchanged —
+                # exactly what GuidedRequest.advance does when an ignored
+                # EOS is appended — so constraints continue past it
+                out[j] = out[j - 1]
+                continue
+            bs = (self._guided_bytes[int(tid)]
+                  if int(tid) < len(self._guided_bytes) else None)
+            if bs is None:
+                break             # special/illegal draft: walk ends
+            ok = True
+            for b in bs:
+                st2 = step(gr.grammar, st, b)
+                if st2 is None:
+                    ok = False
+                    break
+                st = st2
+            if not ok:
+                break
+            m = gv.mask(gr.grammar, st)
+            if not m.any():
+                # a continuation-free state mid-path would NaN the slot's
+                # softmax; leave it unconstrained (the wedge behavior)
+                break
+            out[j] = m
+        return out
 
     # -- pipelined decode (loop.py hooks) ----------------------------------
 
@@ -1001,12 +1077,14 @@ class JaxEngine(ScheduledEngineBase):
         if kind == "spec":
             # shares the post-step aux handling below: a MoE family's
             # verify step reports dispatch drops like any other step
+            gm = a.get("gmask")
             self.pages, packed, aux = self._jit_spec(
                 self.params, self.pages, jnp.asarray(a["toks"]),
                 jnp.asarray(a["pos"]), jnp.asarray(a["table"]),
                 jnp.asarray(a["total"]), jnp.asarray(a["new"]),
                 self._rng, np.int32(step), jnp.asarray(a["temp"]),
-                jnp.asarray(a["top_k"]), jnp.asarray(a["top_p"]))
+                jnp.asarray(a["top_k"]), jnp.asarray(a["top_p"]),
+                jnp.asarray(gm) if gm is not None else None)
         elif kind == "chained":
             prev = prev_packed if prev_packed is not None else self._last_packed
             pen = self._pen_arg(a, a["pos"].shape[0])
@@ -1238,12 +1316,14 @@ class JaxEngine(ScheduledEngineBase):
         longest = max(len(t) for t in token_lists)
         if longest > cap:
             # name the knob(s) that actually bind: raising a non-binding
-            # one cannot help, and when both are equal BOTH bind
-            smt = self.cfg.score_max_tokens or self.cfg.max_context
-            if smt < self.cfg.max_context:
-                knob = "score_max_tokens"
-            elif smt > self.cfg.max_context:
+            # one cannot help. An UNSET score_max_tokens (0) follows
+            # max_context automatically, so only max_context binds then;
+            # when both are explicitly equal, BOTH bind.
+            smt = self.cfg.score_max_tokens
+            if not smt or smt > self.cfg.max_context:
                 knob = "max_context"
+            elif smt < self.cfg.max_context:
+                knob = "score_max_tokens"
             else:
                 knob = "score_max_tokens AND max_context"
             raise ValueError(

@@ -442,18 +442,19 @@ class Scheduler:
 
         Penalties / logit_bias mutate logits from host bookkeeping that
         goes stale within a multi-token step; per-request seeds key their
-        randomness on a single token position; guided masks need the
-        automaton synced token by token. Any such row sends the whole
-        batch down the plain decode path (same rule as ``plan_chained``).
-        Top-logprobs requests ARE eligible — the verify step packs
-        per-position alternatives."""
+        randomness on a single token position. Any such row sends the
+        whole batch down the plain decode path (same rule as
+        ``plan_chained``). Top-logprobs requests ARE eligible (the verify
+        step packs per-position alternatives), and so are GUIDED rows —
+        the host walks the automaton along the known draft path and ships
+        one allow-mask per chunk slot (JaxEngine._guided_spec_masks), so
+        structured outputs keep their exactness under speculation."""
         so = seq.request.sampling_options
         rep_on = (so.repetition_penalty is not None
                   and so.repetition_penalty > 0
                   and so.repetition_penalty != 1.0)
         return not (so.frequency_penalty or so.presence_penalty or rep_on
-                    or so.logit_bias or so.seed is not None or so.min_p
-                    or so.guided)
+                    or so.logit_bias or so.seed is not None or so.min_p)
 
     def _spec_plan(self, ready: List[Sequence]) -> Optional[SpecDecodeBatch]:
         """Try to upgrade this decode step to a [B, K+1] verify step."""

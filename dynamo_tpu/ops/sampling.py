@@ -200,7 +200,8 @@ def sample_tokens(logits: jnp.ndarray, rng: jax.Array,
 
 def spec_verify(logits: jnp.ndarray, tokens: jnp.ndarray, rng: jax.Array,
                 temperature: jnp.ndarray, top_k: jnp.ndarray,
-                top_p: jnp.ndarray):
+                top_p: jnp.ndarray,
+                mask_words: Optional[jnp.ndarray] = None):
     """Exact rejection-sampling verification of drafted tokens, one pass.
 
     The speculative-decode acceptance rule (Leviathan et al.) with a
@@ -218,6 +219,15 @@ def spec_verify(logits: jnp.ndarray, tokens: jnp.ndarray, rng: jax.Array,
             consuming chunk slot j (predicts the token at slot j+1)
     tokens: [B, S] the fed tokens; tokens[:, 0] is the last accepted
             context token, tokens[:, j] (j >= 1) is draft j
+    mask_words: optional [B, S, ceil(V/32)] uint32 guided-decoding
+            allow-masks, one PER CHUNK SLOT (the host walks the grammar
+            automaton along the draft path, so slot j's mask reflects the
+            state after drafts 1..j). Applied to the logits before
+            filtering, exactly like the plain path — a mask-illegal draft
+            gets probability 0 and is rejected, and the replacement /
+            bonus draw is masked by its own slot's state. Reported
+            logprobs are then under the MASKED distribution (the one
+            actually sampled from), matching the plain guided path.
     returns (n_acc [B] i32 accepted drafts in [0, K],
              final_tok [B] i32 — the rejection replacement, or the bonus
              token sampled after all K drafts accepted,
@@ -228,6 +238,10 @@ def spec_verify(logits: jnp.ndarray, tokens: jnp.ndarray, rng: jax.Array,
     lf = logits.astype(jnp.float32)
     B, S, V = lf.shape
     K = S - 1
+    if mask_words is not None:
+        lf = apply_vocab_mask(
+            lf.reshape(B * S, V),
+            mask_words.reshape(B * S, -1)).reshape(B, S, V)
     k = min(TOPK_MAX, V)
     rep = lambda a: jnp.repeat(a, S, axis=0)  # noqa: E731  [B] -> [B*S]
     scaled, top_idx = _masked_candidates(

@@ -449,13 +449,13 @@ from dynamo_tpu.protocols.common import (  # noqa: E402
 from dynamo_tpu.utils.testing import make_test_tokenizer  # noqa: E402
 
 
-def guided_engine():
+def guided_engine(**kw):
     tok = HfTokenizer(make_test_tokenizer())
     eos = tok.token_to_id("<eos>")
     cfg = ModelConfig.tiny(vocab_size=512)
     eng = JaxEngine.random_init(cfg, JaxEngineConfig(
         num_pages=128, page_size=4, max_num_seqs=4, max_prefill_chunk=16,
-        max_context=256, min_prefill_bucket=4))
+        max_context=256, min_prefill_bucket=4, **kw))
     # model vocab (512) > tokenizer vocab: enable_guided must pad the
     # byte table itself or padded ids would read garbage mask bits
     eng.enable_guided(tok.token_bytes(), [eos])
@@ -571,6 +571,87 @@ class TestEngineGuided:
             assert isinstance(args["n"], int)
         finally:
             await eng.stop()
+
+    async def test_guided_composes_with_speculation(self, monkeypatch):
+        """Guided rows are spec-eligible: the host walks the automaton
+        along the draft path and ships per-slot masks, so structured
+        output keeps exactness under speculation — greedy output
+        identical to the unspeculated guided run, with accepts > 0 under
+        oracle drafts and conformance even under garbage drafts."""
+        schema = {"type": "object",
+                  "properties": {"mood": {"enum": ["up", "dn"]},
+                                 "n": {"type": "integer"}},
+                  "required": ["mood", "n"]}
+        spec = {"mode": "json_schema", "schema": schema}
+
+        async def run(eng):
+            frames = await run_req(eng, guided_req(
+                spec, eos=eng._g_eos, max_tokens=96))
+            assert frames[-1].finish_reason == FinishReason.EOS
+            return [t for f in frames for t in f.token_ids]
+
+        def build(spec_tokens):
+            kw = ({"spec_tokens": spec_tokens, "spec_ngram_min": 1}
+                  if spec_tokens else {})
+            eng, tok, eos, tb = guided_engine(**kw)
+            eng._g_eos = eos
+            return eng, tb
+
+        base, tb = build(0)
+        try:
+            want = await run(base)
+        finally:
+            await base.stop()
+        text = b"".join(tb[t] or b"" for t in want
+                        if tb[t] is not None).decode("utf-8", "replace")
+        json.loads(text)   # the reference output conforms
+
+        # natural n-gram drafts
+        eng, tb2 = build(3)
+        try:
+            got = await run(eng)
+        finally:
+            await eng.stop()
+        assert got == want
+
+        # oracle drafts (the true continuation): accepts must be > 0 and
+        # output identical — masks cannot veto legal drafts
+        full_ids = [40, 41, 42] + want
+
+        def oracle(tokens, k, max_n=4, min_n=2):
+            n = len(tokens)
+            if n >= len(full_ids) or list(tokens) != full_ids[:n]:
+                return None
+            cont = full_ids[n:n + k]
+            while len(cont) < k:
+                cont.append(cont[-1])
+            return cont
+
+        import dynamo_tpu.engine.scheduler as sched_mod
+        monkeypatch.setattr(sched_mod, "propose_ngram", oracle)
+        eng2, _ = build(3)
+        try:
+            got2 = await run(eng2)
+            stats = eng2.stats().spec_decode_stats
+            assert stats.num_accepted_tokens > 0
+        finally:
+            await eng2.stop()
+        assert got2 == want
+
+        # garbage drafts: every draft is grammar-illegal at its slot —
+        # verification must reject them all and output still conforms
+        bad = tb.index(b"\x7f") if b"\x7f" in tb else 1
+
+        def garbage(tokens, k, max_n=4, min_n=2):
+            return [bad] * k
+
+        monkeypatch.setattr(sched_mod, "propose_ngram", garbage)
+        eng3, _ = build(3)
+        try:
+            got3 = await run(eng3)
+        finally:
+            await eng3.stop()
+        assert got3 == want
 
     async def test_unarmed_engine_rejects_guided_requests(self):
         cfg = ModelConfig.tiny()
