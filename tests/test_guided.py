@@ -341,6 +341,87 @@ class TestSchemaCompile:
             compile_guided({"mode": "regex"})
 
 
+class TestSchemaProperty:
+    """Property test: random schemas + documents conforming BY
+    CONSTRUCTION must accept; targeted mutations must reject."""
+
+    def _rand_schema_and_doc(self, rng, depth=0):
+        kind = rng.choice(
+            ["object", "integer", "number", "string", "boolean", "null",
+             "enum", "array"] if depth < 2 else
+            ["integer", "number", "string", "boolean", "null", "enum"])
+        if kind == "object":
+            n = rng.randint(1, 3)
+            props = {}
+            names = rng.sample(["alpha", "beta", "g mma", "d\"e", "e_f",
+                                "k1", "k2"], n)
+            docs = {}
+            for name in names:
+                s, d = self._rand_schema_and_doc(rng, depth + 1)
+                props[name] = s
+                docs[name] = d
+            req = rng.sample(names, rng.randint(0, n))
+            # the doc carries every required key, DROPS some optional
+            # ones, and emits keys in shuffled (non-declaration) order —
+            # the any-order + optional-omission acceptance is the hard
+            # part of closed-object compilation
+            keep = [nm for nm in names
+                    if nm in req or rng.random() < 0.6]
+            rng.shuffle(keep)
+            doc = {nm: docs[nm] for nm in keep}
+            return ({"type": "object", "properties": props,
+                     "required": req}, doc)
+        if kind == "array":
+            return ({"type": "array", "items": {"type": "integer"}},
+                    [rng.randint(-5, 5) for _ in range(rng.randint(0, 3))])
+        if kind == "integer":
+            return {"type": "integer"}, rng.randint(-100, 100)
+        if kind == "number":
+            return {"type": "number"}, round(rng.uniform(-10, 10), 3)
+        if kind == "string":
+            return ({"type": "string"},
+                    rng.choice(["", "plain", 'quo"te', "esc\\ape",
+                                "café ☃", "tab\there"]))
+        if kind == "boolean":
+            return {"type": "boolean"}, rng.choice([True, False])
+        if kind == "null":
+            return {"type": "null"}, None
+        vals = rng.sample(["aa", "ab", "zz", "q"], rng.randint(1, 3))
+        return {"enum": vals}, rng.choice(vals)
+
+    def test_random_schemas_accept_conforming_docs(self):
+        import random
+        rng = random.Random(7)
+        for trial in range(40):
+            schema, doc = self._rand_schema_and_doc(rng)
+            g = Grammar.from_schema(schema)
+            text = json.dumps(doc)
+            assert accepts(g, text), (trial, schema, text)
+            # a mutation outside the schema must reject: append junk
+            assert not accepts(g, text + "x"), (trial, schema)
+
+    def test_object_mutations_reject(self):
+        import random
+        rng = random.Random(11)
+        ran = 0
+        for trial in range(20):
+            # force a top-level object so EVERY trial asserts
+            schema, doc = None, None
+            while schema is None or schema.get("type") != "object":
+                schema, doc = self._rand_schema_and_doc(rng)
+            g = Grammar.from_schema(schema)
+            bad = dict(doc)
+            bad["__undeclared__"] = 1
+            assert not accepts(g, json.dumps(bad)), (trial, schema)
+            req = schema.get("required") or []
+            if req:
+                missing = dict(doc)
+                missing.pop(req[0], None)
+                assert not accepts(g, json.dumps(missing)), (trial, schema)
+            ran += 1
+        assert ran == 20
+
+
 # ------------------------------------------------------------ token masks
 
 def tiny_vocab():
