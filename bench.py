@@ -408,6 +408,12 @@ async def run_attempt(args) -> dict:
     # line — so a window that closes mid-extra keeps the main number.
     print(json.dumps(result), flush=True)
 
+    if args.skip_extras:
+        # the banking attempt: hand the window back to the orchestrator
+        # for the full-tier attempt instead of spending it on extras
+        wd.disarm()
+        return result
+
     # attn-impl A/B in the SAME process (round-4 open question:
     # scan+pallas vs pallas_unrolled on chip) — another engine, same init.
     ab_impl = args.ab
@@ -852,6 +858,11 @@ def _parse_args(argv=None):
     p.add_argument("--_attempt", action="store_true",
                    help="internal: run probe->prime->measure in this "
                         "process")
+    p.add_argument("--skip-extras", action="store_true",
+                   help="internal: main measurement only (no A/B, int8, "
+                        "or spec legs) — the BANKING attempt uses this so "
+                        "a medium tunnel window still reaches the full "
+                        "tier in the same orchestrator run")
     p.add_argument("--child-budget", type=float, default=420.0,
                    help="internal: attempt wall-clock budget (s)")
     p.add_argument("--budget", type=float, default=520.0,
@@ -1189,6 +1200,13 @@ def main() -> None:
         argv = ["--_attempt", "--tier", tier,
                 "--attn-impl", args.attn_impl, "--ab", args.ab,
                 "--child-budget", f"{child_budget:.0f}"]
+        if (tier == "reduced" and args.tier == "full" and banked is None
+                and not full_failed and remaining >= 600.0):
+            # the banking attempt: headline number FIRST; extras ride the
+            # full-tier attempt that can still follow in this run. A
+            # terminal reduced attempt (short budget, or full already
+            # died) keeps its extras — nothing else will run them.
+            argv.append("--skip-extras")
         result, progress = _run_attempt_proc(argv, tpu_env, child_budget)
         if _progress_rank(progress) > _progress_rank(best_progress):
             best_progress = progress
