@@ -16,6 +16,7 @@ from dynamo_tpu.http.service import HttpService
 from dynamo_tpu.llm.model_manager import ModelManager, ModelWatcher
 from dynamo_tpu.runtime.push_router import RouterMode
 from dynamo_tpu.runtime.runtime import DEFAULT_COORDINATOR, DistributedRuntime
+from dynamo_tpu.utils.config import RuntimeConfig
 from dynamo_tpu.utils.logging import configure_logging
 
 logger = logging.getLogger(__name__)
@@ -35,6 +36,32 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--no-kv-events", action="store_true",
                         help="KV router predicts cache contents instead of "
                              "subscribing to worker events")
+    # request-lifecycle robustness knobs; defaults layer through
+    # RuntimeConfig (dataclass defaults -> TOML -> DYN_RUNTIME_* env)
+    try:
+        cfg = RuntimeConfig.load()
+    except Exception:
+        # a malformed config file/env must not take out --help (or hide
+        # the argparse usage behind a traceback); flag values still win
+        logger.warning("bad runtime config; using built-in defaults for "
+                       "CLI flag defaults", exc_info=True)
+        cfg = RuntimeConfig()
+    parser.add_argument("--request-timeout-s", type=float,
+                        default=cfg.request_timeout_s,
+                        help="default end-to-end request deadline in seconds "
+                             "(0 disables; per-request nvext.timeout_s or "
+                             "X-Request-Timeout override)")
+    parser.add_argument("--max-inflight", type=int,
+                        default=cfg.http_max_inflight,
+                        help="shed (503 + Retry-After) past this many "
+                             "concurrent requests (0 = unlimited)")
+    parser.add_argument("--max-model-inflight", type=int,
+                        default=cfg.http_max_model_inflight,
+                        help="per-model concurrent-request high-water mark "
+                             "(0 = unlimited)")
+    parser.add_argument("--shed-retry-after-s", type=float,
+                        default=cfg.http_shed_retry_after_s,
+                        help="Retry-After hint on shed responses")
     return parser
 
 
@@ -51,8 +78,12 @@ async def amain(args: argparse.Namespace) -> None:
             "use_kv_events": not args.no_kv_events,
         })
     await watcher.start()
-    service = await HttpService(manager, host=args.http_host,
-                                port=args.http_port).start()
+    service = await HttpService(
+        manager, host=args.http_host, port=args.http_port,
+        request_timeout_s=args.request_timeout_s,
+        max_inflight=args.max_inflight,
+        max_model_inflight=args.max_model_inflight,
+        shed_retry_after_s=args.shed_retry_after_s).start()
     if args.standalone:
         print(f"coordinator listening on {drt._embedded.address}", flush=True)
     print(f"frontend listening on {service.host}:{service.port}", flush=True)

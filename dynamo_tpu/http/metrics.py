@@ -51,6 +51,10 @@ class FrontendMetrics:
         self.output_tokens = Counter(
             f"{ns}_output_tokens_total", "Generated tokens",
             ["model"], registry=self.registry)
+        self.shed_total = Counter(
+            f"{ns}_requests_shed_total",
+            "Requests shed at admission (503) by overload protection",
+            ["model", "endpoint", "reason"], registry=self.registry)
 
     def render(self) -> bytes:
         return generate_latest(self.registry)

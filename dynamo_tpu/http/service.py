@@ -11,6 +11,8 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import math
+import time
 from typing import Any, Dict, List, Optional
 
 from aiohttp import web
@@ -20,6 +22,7 @@ from dynamo_tpu.http.metrics import FrontendMetrics, RequestTimer
 from dynamo_tpu.llm.model_manager import ModelManager
 from dynamo_tpu.protocols import sse
 from dynamo_tpu.protocols.common import FinishReason
+from dynamo_tpu.runtime.rpc import DeadlineExceededError
 from dynamo_tpu.protocols.openai import (
     ChatChoice,
     ChatCompletionRequest,
@@ -79,15 +82,36 @@ def _error(status: int, message: str, etype: str = "invalid_request_error") -> w
         status=status)
 
 
+async def _sse_error(resp: web.StreamResponse, exc: Exception,
+                     err_type: str) -> None:
+    """Terminal SSE error event + [DONE] — once streaming has begun the 200
+    status line is already on the wire, so errors ride the event stream."""
+    await resp.write(sse.encode_data(
+        {"error": {"message": str(exc), "type": err_type}}))
+    await resp.write(sse.encode_done())
+
+
 class HttpService:
     """The frontend HTTP server; routes into a ModelManager's pipelines."""
 
     def __init__(self, manager: ModelManager, host: str = "0.0.0.0",
-                 port: int = 8080, metrics: Optional[FrontendMetrics] = None):
+                 port: int = 8080, metrics: Optional[FrontendMetrics] = None,
+                 request_timeout_s: float = 0.0,
+                 max_inflight: int = 0, max_model_inflight: int = 0,
+                 shed_retry_after_s: float = 1.0):
         self.manager = manager
         self.host = host
         self.port = port
         self.metrics = metrics or FrontendMetrics()
+        # request-lifecycle robustness knobs (see utils/config.RuntimeConfig):
+        # default end-to-end deadline (0 = none) and overload high-water
+        # marks (0 = unlimited) for total / per-model concurrent requests
+        self.request_timeout_s = request_timeout_s
+        self.max_inflight = max_inflight
+        self.max_model_inflight = max_model_inflight
+        self.shed_retry_after_s = shed_retry_after_s
+        self._inflight_total = 0
+        self._inflight_by_model: Dict[str, int] = {}
         self.app = web.Application(client_max_size=64 * 1024 * 1024)
         self.app.router.add_post("/v1/chat/completions", self.handle_chat)
         self.app.router.add_post("/v1/responses", self.handle_responses)
@@ -142,6 +166,67 @@ class HttpService:
     def set_clear_kv_hook(self, hook) -> None:
         self._clear_kv_hook = hook
 
+    # -- overload shedding + deadlines -------------------------------------
+
+    def _shed_or_admit(self, model: str,
+                       endpoint: str) -> Optional[web.Response]:
+        """Admission control: returns a 503 + Retry-After response when a
+        high-water mark is hit, else admits (callers MUST pair with
+        ``_release`` in a finally).  Shed requests are counted in
+        ``dynamo_frontend_requests_shed_total``."""
+        if self.max_inflight and self._inflight_total >= self.max_inflight:
+            reason = "inflight_high_water"
+        elif (self.max_model_inflight
+              and self._inflight_by_model.get(model, 0)
+              >= self.max_model_inflight):
+            reason = "model_inflight_high_water"
+        else:
+            self._inflight_total += 1
+            self._inflight_by_model[model] = \
+                self._inflight_by_model.get(model, 0) + 1
+            return None
+        self.metrics.shed_total.labels(model, endpoint, reason).inc()
+        self.metrics.requests_total.labels(model, endpoint, "503").inc()
+        resp = _error(503, "server overloaded; retry later", "overloaded")
+        resp.headers["Retry-After"] = str(
+            max(1, math.ceil(self.shed_retry_after_s)))
+        return resp
+
+    def _release(self, model: str) -> None:
+        self._inflight_total = max(0, self._inflight_total - 1)
+        n = self._inflight_by_model.get(model, 0) - 1
+        if n <= 0:
+            self._inflight_by_model.pop(model, None)
+        else:
+            self._inflight_by_model[model] = n
+
+    def _resolve_deadline(self, http_req: web.Request,
+                          nvext=None) -> Optional[float]:
+        """Absolute unix deadline for a request: per-request override
+        (``nvext.timeout_s``, then the ``X-Request-Timeout`` header, seconds)
+        falling back to the configured service default; None = no deadline.
+        Raises ValueError (-> 400) on a malformed or non-positive override."""
+        timeout: Optional[float] = None
+        if nvext is not None and getattr(nvext, "timeout_s", None) is not None:
+            timeout = float(nvext.timeout_s)
+        else:
+            hdr = http_req.headers.get("X-Request-Timeout")
+            if hdr is not None:
+                try:
+                    timeout = float(hdr)
+                except ValueError:
+                    raise ValueError(
+                        f"invalid X-Request-Timeout header: {hdr!r}") from None
+        if timeout is not None and (not math.isfinite(timeout)
+                                    or timeout <= 0):
+            # JSON NaN/Infinity parse fine and would defeat the deadline
+            raise ValueError("request timeout must be positive and finite")
+        if timeout is None:
+            timeout = self.request_timeout_s
+        if not timeout or timeout <= 0:
+            return None
+        return time.time() + timeout
+
     async def handle_embeddings(self, request: web.Request) -> web.Response:
         from dynamo_tpu.protocols.openai import (
             EmbeddingData, EmbeddingRequest, EmbeddingResponse)
@@ -156,6 +241,9 @@ class HttpService:
             # before the forward pass — an invalid ask must not pay for
             # the model compute it then discards
             return _error(400, "dimensions must be positive")
+        shed = self._shed_or_admit(req.model, "embeddings")
+        if shed is not None:
+            return shed
         try:
             vectors, prompt_tokens = await pipeline.generate_embeddings(req)
         except NotImplementedError as e:
@@ -163,6 +251,8 @@ class HttpService:
         except Exception as e:  # noqa: BLE001
             logger.exception("embeddings failed")
             return _error(500, str(e), "internal_error")
+        finally:
+            self._release(req.model)
         if req.dimensions is not None and vectors:
             if req.dimensions > len(vectors[0]):
                 return _error(
@@ -198,16 +288,27 @@ class HttpService:
             return _error(404, f"model {req.model!r} not found", "model_not_found")
         if not 1 <= req.n <= MAX_CHOICES:
             return _error(400, f"n must be between 1 and {MAX_CHOICES}")
+        try:
+            deadline = self._resolve_deadline(request, req.nvext)
+        except ValueError as e:
+            return _error(400, str(e))
+        shed = self._shed_or_admit(req.model, "chat")
+        if shed is not None:
+            return shed
         request_id = new_request_id()
         timer = RequestTimer(self.metrics, req.model, "chat")
         try:
             if req.stream:
                 return await self._stream_chat(request, req, pipeline,
-                                               request_id, timer)
-            return await self._aggregate_chat(req, pipeline, request_id, timer)
+                                               request_id, timer, deadline)
+            return await self._aggregate_chat(req, pipeline, request_id,
+                                              timer, deadline)
         except ValueError as e:
             timer.done("400")
             return _error(400, str(e))
+        except DeadlineExceededError as e:
+            timer.done("504")
+            return _error(504, str(e), "deadline_exceeded")
         except ConnectionResetError:
             timer.done("499")  # client went away mid-write
             raise
@@ -221,14 +322,18 @@ class HttpService:
             logger.exception("chat handler error")
             timer.done("500")
             return _error(500, str(e), "internal_error")
+        finally:
+            self._release(req.model)
 
     async def _stream_chat(self, http_req: web.Request,
                            req: ChatCompletionRequest, pipeline,
-                           request_id: str, timer: RequestTimer
+                           request_id: str, timer: RequestTimer,
+                           deadline: Optional[float] = None
                            ) -> web.StreamResponse:
         # preprocess before preparing the response so validation errors can
         # still produce a clean HTTP 400
-        preprocessed, delta = pipeline.prepare_chat(req, request_id)
+        preprocessed, delta = pipeline.prepare_chat(req, request_id,
+                                                    deadline_unix=deadline)
         annotation_only = pipeline.resolve_annotations(preprocessed)
         resp = web.StreamResponse(headers={
             "Content-Type": "text/event-stream",
@@ -251,7 +356,7 @@ class HttpService:
         if max(1, req.n or 1) > 1:
             return await self._stream_chat_multi(
                 resp, req, pipeline, request_id, timer,
-                (preprocessed, delta), include_usage)
+                (preprocessed, delta), include_usage, deadline)
         gen = pipeline.run_chat(preprocessed, delta)
         emitted_tokens = 0
         try:
@@ -307,12 +412,15 @@ class HttpService:
             # client disconnected: stop generating (parity: disconnect.rs)
             status = "499"
             raise
+        except DeadlineExceededError as e:
+            # mid-stream deadline: a clean typed SSE error, no migration
+            # replay (the router never saw a connection-shaped failure)
+            status = "504"
+            await _sse_error(resp, e, "deadline_exceeded")
         except Exception as e:
             logger.exception("stream error for %s", request_id)
             status = "500"
-            await resp.write(sse.encode_data(
-                {"error": {"message": str(e), "type": "internal_error"}}))
-            await resp.write(sse.encode_done())
+            await _sse_error(resp, e, "internal_error")
         finally:
             await gen.aclose()
             timer.done(status)
@@ -321,7 +429,8 @@ class HttpService:
 
     async def _stream_chat_multi(self, resp, req, pipeline,
                                  request_id: str, timer: RequestTimer,
-                                 first_prepared, include_usage: bool):
+                                 first_prepared, include_usage: bool,
+                                 deadline: Optional[float] = None):
         """n > 1 streaming: the n choice generators run concurrently and
         their chunks interleave on one SSE stream, each rewritten to its
         choice index (standard OpenAI multi-choice streaming). Tool-call
@@ -330,7 +439,7 @@ class HttpService:
         Per-choice usage chunks aggregate into ONE final usage chunk."""
         n = req.n
         pairs = [first_prepared] + [
-            self._prepare_choice(req, pipeline, request_id, i)
+            self._prepare_choice(req, pipeline, request_id, i, deadline)
             for i in range(1, n)]
         # requested annotations ride ahead of the deltas, same as n == 1
         for name, value in first_prepared[0].annotations_payload.items():
@@ -400,12 +509,13 @@ class HttpService:
         except (ConnectionResetError, asyncio.CancelledError):
             status = "499"
             raise
+        except DeadlineExceededError as e:
+            status = "504"
+            await _sse_error(resp, e, "deadline_exceeded")
         except Exception as e:  # noqa: BLE001
             logger.exception("multi-choice stream error for %s", request_id)
             status = "500"
-            await resp.write(sse.encode_data(
-                {"error": {"message": str(e), "type": "internal_error"}}))
-            await resp.write(sse.encode_done())
+            await _sse_error(resp, e, "internal_error")
         finally:
             for t in tasks:
                 t.cancel()
@@ -423,16 +533,18 @@ class HttpService:
         rid = request_id if index == 0 else f"{request_id}-c{index}"
         return rid, (seed + index if seed is not None and index else seed)
 
-    def _prepare_choice(self, req, pipeline, request_id: str, index: int):
+    def _prepare_choice(self, req, pipeline, request_id: str, index: int,
+                        deadline: Optional[float] = None):
         """(preprocessed, delta) for choice ``index`` of an n-way chat."""
         rid, seed = self._choice_identity(request_id, req.seed, index)
-        preprocessed, delta = pipeline.prepare_chat(req, rid)
+        preprocessed, delta = pipeline.prepare_chat(req, rid,
+                                                    deadline_unix=deadline)
         preprocessed.sampling_options.seed = seed
         return preprocessed, delta
 
     async def _collect_chat(self, req: ChatCompletionRequest, pipeline,
                             request_id: str, timer: RequestTimer,
-                            prepared=None):
+                            prepared=None, deadline: Optional[float] = None):
         """Drain the chunk stream; returns (text, finish_reason,
         lp_entries, usage) — shared by the aggregated chat response and
         the /v1/responses bridge."""
@@ -441,7 +553,8 @@ class HttpService:
         finish_reason: Optional[str] = None
         usage = Usage()
         preprocessed, delta = (prepared if prepared is not None
-                               else pipeline.prepare_chat(req, request_id))
+                               else pipeline.prepare_chat(
+                                   req, request_id, deadline_unix=deadline))
         gen = pipeline.run_chat(preprocessed, delta)
         emitted_tokens = 0
         try:
@@ -462,7 +575,8 @@ class HttpService:
         return "".join(text_parts), finish_reason, lp_entries, usage
 
     async def _aggregate_chat(self, req: ChatCompletionRequest, pipeline,
-                              request_id: str, timer: RequestTimer
+                              request_id: str, timer: RequestTimer,
+                              deadline: Optional[float] = None
                               ) -> web.Response:
         """Aggregate the chunk stream into one response (parity:
         ``protocols/openai/chat_completions/aggregator.rs``); ``n > 1``
@@ -472,7 +586,7 @@ class HttpService:
         tasks = [asyncio.create_task(
             self._collect_chat(req, pipeline, request_id, timer,
                                prepared=self._prepare_choice(
-                                   req, pipeline, request_id, i)))
+                                   req, pipeline, request_id, i, deadline)))
             for i in range(n)]
         try:
             results = await asyncio.gather(*tasks)
@@ -594,14 +708,24 @@ class HttpService:
             )
         except ValidationError as e:
             return _error(400, f"invalid request: {e}")
+        try:
+            deadline = self._resolve_deadline(request)
+        except ValueError as e:
+            return _error(400, str(e))
+        shed = self._shed_or_admit(model, "responses")
+        if shed is not None:
+            return shed
         request_id = new_request_id("resp")
         timer = RequestTimer(self.metrics, model, "responses")
         try:
             text, _finish, _lps, usage = await self._collect_chat(
-                chat, pipeline, request_id, timer)
+                chat, pipeline, request_id, timer, deadline=deadline)
         except ValueError as e:  # same mapping as handle_chat
             timer.done("400")
             return _error(400, str(e))
+        except DeadlineExceededError as e:
+            timer.done("504")
+            return _error(504, str(e), "deadline_exceeded")
         except ConnectionError as e:
             timer.done("503")
             return _error(503, str(e), "service_unavailable")
@@ -609,6 +733,8 @@ class HttpService:
             timer.done("500")
             logger.exception("responses request %s failed", request_id)
             return _error(500, str(e), "internal_error")
+        finally:
+            self._release(model)
         timer.done("200", usage.prompt_tokens)
         return web.json_response({
             "id": request_id,
@@ -647,6 +773,13 @@ class HttpService:
         if req.stream and n > 1:
             return _error(501, "streaming with n > 1 is not implemented "
                           "for legacy completions", "not_implemented")
+        try:
+            deadline = self._resolve_deadline(request, req.nvext)
+        except ValueError as e:
+            return _error(400, str(e))
+        shed = self._shed_or_admit(req.model, "completions")
+        if shed is not None:
+            return shed
         request_id = new_request_id("cmpl")
         timer = RequestTimer(self.metrics, req.model, "completions")
 
@@ -707,7 +840,8 @@ class HttpService:
                         echo_entries.append(e)
             if req.stream:
                 return await self._stream_completion(request, req, pipeline,
-                                                     request_id, timer)
+                                                     request_id, timer,
+                                                     deadline)
 
             async def one_choice(i: int):
                 rid, seed = self._choice_identity(request_id, req.seed, i)
@@ -717,7 +851,8 @@ class HttpService:
                 lp_entries: List[dict] = []
                 finish = None
                 u = Usage()
-                gen = pipeline.generate_completion(req_i, rid)
+                gen = pipeline.generate_completion(req_i, rid,
+                                                   deadline_unix=deadline)
                 try:
                     async for out in gen:
                         if out.error:
@@ -780,6 +915,9 @@ class HttpService:
         except ValueError as e:
             timer.done("400")
             return _error(400, str(e))
+        except DeadlineExceededError as e:
+            timer.done("504")
+            return _error(504, str(e), "deadline_exceeded")
         except ConnectionResetError:
             timer.done("499")
             raise
@@ -793,10 +931,13 @@ class HttpService:
             logger.exception("completions handler error")
             timer.done("500")
             return _error(500, str(e), "internal_error")
+        finally:
+            self._release(req.model)
 
     async def _stream_completion(self, http_req: web.Request,
                                  req: CompletionRequest, pipeline,
-                                 request_id: str, timer: RequestTimer
+                                 request_id: str, timer: RequestTimer,
+                                 deadline: Optional[float] = None
                                  ) -> web.StreamResponse:
         resp = web.StreamResponse(headers={
             "Content-Type": "text/event-stream",
@@ -804,7 +945,8 @@ class HttpService:
         await resp.prepare(http_req)
         status = "200"
         created = now_unix()
-        gen = pipeline.generate_completion(req, request_id)
+        gen = pipeline.generate_completion(req, request_id,
+                                           deadline_unix=deadline)
         lp_offset = 0
         try:
             async for out in gen:
@@ -832,12 +974,13 @@ class HttpService:
         except (ConnectionResetError, asyncio.CancelledError):
             status = "499"
             raise
+        except DeadlineExceededError as e:
+            status = "504"
+            await _sse_error(resp, e, "deadline_exceeded")
         except Exception as e:
             logger.exception("completion stream error for %s", request_id)
             status = "500"
-            await resp.write(sse.encode_data(
-                {"error": {"message": str(e), "type": "internal_error"}}))
-            await resp.write(sse.encode_done())
+            await _sse_error(resp, e, "internal_error")
         finally:
             await gen.aclose()
             timer.done(status)

@@ -110,10 +110,18 @@ class MigrationOperator(Operator):
 
 
 def router_sink(router) -> Source:
-    """Terminal source: one streamed hop through a PushRouter."""
+    """Terminal source: one streamed hop through a PushRouter.
+
+    The request deadline rides the RPC ``req`` frame headers so the worker
+    can drop expired work, and the returned ``ResponseStream`` enforces it
+    between frames (``DeadlineExceededError`` — which this sink does NOT
+    translate, so the migration operator never replays expired requests)."""
+    from dynamo_tpu.runtime.rpc import deadline_headers
 
     async def source(request: PreprocessedRequest):
-        async for payload in router.generate_stream(request.to_dict()):
+        async for payload in router.generate_stream(
+                request.to_dict(),
+                headers=deadline_headers(request.deadline_unix)):
             yield LLMEngineOutput.from_dict(payload)
 
     return source

@@ -12,6 +12,8 @@ classes.
 
 from __future__ import annotations
 
+import asyncio
+import time
 from typing import Any, AsyncIterator, Dict, Optional
 
 from dynamo_tpu.backend import Backend
@@ -32,6 +34,41 @@ from dynamo_tpu.protocols.openai import (
 from dynamo_tpu.runtime.push_router import PushRouter
 
 
+async def _deadline_guard(stream: AsyncIterator[LLMEngineOutput],
+                          deadline_unix: float
+                          ) -> AsyncIterator[LLMEngineOutput]:
+    """Enforce a request deadline between frames of an engine stream.
+
+    The remote hop already enforces in ``ResponseStream``; this covers
+    in-process engines (``LocalEnginePipeline`` — the single-process
+    server), so ``X-Request-Timeout`` / ``nvext.timeout_s`` behave the
+    same on every topology.  Closing the underlying generator (the raise
+    unwinds through the service layer's ``aclose``) releases the engine's
+    scheduler slot."""
+    from dynamo_tpu.runtime.rpc import DeadlineExceededError
+    it = stream.__aiter__()
+    try:
+        while True:
+            remaining = deadline_unix - time.time()
+            try:
+                if remaining <= 0:
+                    raise asyncio.TimeoutError
+                out = await asyncio.wait_for(it.__anext__(), timeout=remaining)
+            except asyncio.TimeoutError:
+                raise DeadlineExceededError(
+                    "request deadline exceeded mid-stream") from None
+            except StopAsyncIteration:
+                return
+            yield out
+    finally:
+        # deterministic engine-slot release on any unwind (deadline, client
+        # disconnect): the guard owns the inner stream now, so the service
+        # layer's aclose() stops at the guard unless it forwards
+        aclose = getattr(stream, "aclose", None)
+        if aclose is not None:
+            await aclose()
+
+
 class ServicePipeline:
     """Base: owns preprocessor + backend; subclasses provide the engine hop."""
 
@@ -45,11 +82,27 @@ class ServicePipeline:
                       ) -> AsyncIterator[LLMEngineOutput]:
         raise NotImplementedError
 
+    def _deadlined_stream(self, request: PreprocessedRequest
+                          ) -> AsyncIterator[LLMEngineOutput]:
+        """The engine hop, with deadline enforcement when the request
+        carries one (no-op wrapper otherwise)."""
+        stream = self.engine_stream(request)
+        if request.deadline_unix is None:
+            return stream
+        return _deadline_guard(stream, request.deadline_unix)
+
     def prepare_chat(self, req: ChatCompletionRequest,
-                     request_id: Optional[str] = None):
+                     request_id: Optional[str] = None,
+                     deadline_unix: Optional[float] = None):
         """Preprocess only; lets the HTTP layer inspect annotations before
-        streaming.  Returns (PreprocessedRequest, DeltaGenerator)."""
+        streaming.  Returns (PreprocessedRequest, DeltaGenerator).
+
+        ``deadline_unix`` stamps the end-to-end request deadline onto the
+        preprocessed request; the remote hop propagates it to the worker and
+        enforces it between frames."""
         preprocessed = self.preprocessor.preprocess_chat(req, request_id)
+        if deadline_unix is not None:
+            preprocessed.deadline_unix = deadline_unix
         delta = DeltaGenerator(
             model=req.model, request_id=request_id,
             include_usage=bool(req.stream_options and req.stream_options.include_usage))
@@ -59,7 +112,7 @@ class ServicePipeline:
                        delta: DeltaGenerator
                        ) -> AsyncIterator[ChatCompletionChunk]:
         async for out in self.backend.transform(
-                preprocessed, self.engine_stream(preprocessed)):
+                preprocessed, self._deadlined_stream(preprocessed)):
             for chunk in delta.chunk_from(out):
                 yield chunk
         # always emit the final usage chunk; the streaming HTTP layer drops it
@@ -75,12 +128,15 @@ class ServicePipeline:
             yield chunk
 
     async def generate_completion(self, req: CompletionRequest,
-                                  request_id: Optional[str] = None
+                                  request_id: Optional[str] = None,
+                                  deadline_unix: Optional[float] = None
                                   ) -> AsyncIterator[BackendOutput]:
         """Completions pipeline: streams BackendOutput (text deltas)."""
         preprocessed = self.preprocessor.preprocess_completion(req, request_id)
+        if deadline_unix is not None:
+            preprocessed.deadline_unix = deadline_unix
         async for out in self.backend.transform(
-                preprocessed, self.engine_stream(preprocessed)):
+                preprocessed, self._deadlined_stream(preprocessed)):
             yield out
 
     def _embedding_token_lists(self, req) -> "list[list[int]]":
@@ -235,6 +291,13 @@ class RemotePipeline(ServicePipeline):
     def engine_stream(self, request: PreprocessedRequest
                       ) -> AsyncIterator[LLMEngineOutput]:
         return self._source(request)
+
+    def _deadlined_stream(self, request: PreprocessedRequest
+                          ) -> AsyncIterator[LLMEngineOutput]:
+        # the remote hop already enforces the deadline between frames in
+        # ResponseStream (and the worker drops expired work); wrapping it
+        # again would only add a second wait_for timer per frame
+        return self.engine_stream(request)
 
 
 __all__ = ["ServicePipeline", "LocalEnginePipeline", "RemotePipeline",

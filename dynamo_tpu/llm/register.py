@@ -21,11 +21,42 @@ logger = logging.getLogger(__name__)
 
 
 def engine_handler(engine: EngineBase) -> Callable:
-    """Bridge an EngineBase into an RPC endpoint handler (dict payloads)."""
+    """Bridge an EngineBase into an RPC endpoint handler (dict payloads).
+
+    Deadline enforcement: a request that arrives already expired is refused
+    before it touches the scheduler, and one that expires mid-generation is
+    cancelled between frames — either way the worker stops generating tokens
+    nobody is waiting for (the caller's ``ResponseStream`` raised
+    ``DeadlineExceededError`` at the same deadline)."""
 
     async def handler(payload: Any, ctx) -> AsyncIterator[Any]:
+        from dynamo_tpu.protocols.common import FinishReason
         request = PreprocessedRequest.from_dict(payload)
+        if ctx is not None and getattr(ctx, "deadline_expired", False):
+            logger.warning("request %s arrived with its deadline already "
+                           "expired; dropping", request.request_id)
+            yield LLMEngineOutput(
+                error="request deadline expired before admission",
+                finish_reason=FinishReason.ERROR).to_dict()
+            return
         async for out in engine.generate(request, ctx):
+            if (ctx is not None and getattr(ctx, "deadline_expired", False)
+                    and out.finish_reason is None):
+                # nobody is waiting for this stream anymore: release the
+                # scheduler slot (cooperative cancel; closing the generator
+                # also runs engine.generate's finally -> scheduler.cancel)
+                logger.warning("request %s exceeded its deadline "
+                               "mid-generation; cancelling",
+                               request.request_id)
+                ctx.cancel()
+                # explicit error frame, not a bare return: if the worker's
+                # clock runs ahead of the caller's, the caller's own
+                # deadline hasn't tripped yet — a clean ``final`` would
+                # surface as a 200 with silently truncated output
+                yield LLMEngineOutput(
+                    error="request deadline exceeded mid-generation",
+                    finish_reason=FinishReason.ERROR).to_dict()
+                return
             yield out.to_dict()
 
     return handler

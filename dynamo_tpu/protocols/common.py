@@ -134,6 +134,11 @@ class PreprocessedRequest:
     estimated_prefix_hit_num_blocks: Optional[int] = None
     kv_transfer_params: Optional[Dict[str, Any]] = None
     prefill_only: bool = False
+    # end-to-end request deadline, absolute unix seconds (None = none).
+    # Set by the HTTP frontend (config default or per-request override) and
+    # propagated to the worker in the RPC ``req`` frame headers; expired
+    # work is dropped instead of generating tokens nobody is waiting for.
+    deadline_unix: Optional[float] = None
     # local-only (not serialized): annotation responses filled by the
     # preprocessor/router, emitted as SSE events by the HTTP layer
     annotations_payload: Dict[str, Any] = field(default_factory=dict)
@@ -151,6 +156,7 @@ class PreprocessedRequest:
             "estimated_prefix_hit_num_blocks": self.estimated_prefix_hit_num_blocks,
             "kv_transfer_params": self.kv_transfer_params,
             "prefill_only": self.prefill_only,
+            "deadline_unix": self.deadline_unix,
         }
 
     @classmethod
@@ -167,6 +173,7 @@ class PreprocessedRequest:
             estimated_prefix_hit_num_blocks=d.get("estimated_prefix_hit_num_blocks"),
             kv_transfer_params=d.get("kv_transfer_params"),
             prefill_only=bool(d.get("prefill_only", False)),
+            deadline_unix=d.get("deadline_unix"),
         )
 
 

@@ -24,6 +24,10 @@ class Extensions(BaseModel):
     annotations: Optional[List[str]] = None
     ignore_eos: Optional[bool] = None
     greed_sampling: Optional[bool] = None
+    # per-request end-to-end deadline override (seconds from arrival);
+    # takes precedence over the X-Request-Timeout header and the service's
+    # configured default
+    timeout_s: Optional[float] = None
 
 
 class ChatMessage(BaseModel):

@@ -19,6 +19,7 @@ from __future__ import annotations
 import argparse
 import asyncio
 import json
+import logging
 import sys
 import time
 from typing import Optional, Tuple
@@ -114,10 +115,26 @@ def build_engine_and_card(out: str, args) -> Tuple[EngineBase, ModelDeploymentCa
 async def run_http(pipeline: LocalEnginePipeline, args) -> None:
     from dynamo_tpu.http.service import HttpService
     from dynamo_tpu.llm.model_manager import ModelManager
+    from dynamo_tpu.utils.config import RuntimeConfig
     manager = ModelManager()
     manager.add(pipeline.card.name, pipeline)
+    # the single-process server honors the same request-lifecycle knobs as
+    # the distributed frontend (DYN_RUNTIME_REQUEST_TIMEOUT_S, shedding
+    # high-water marks — see docs/deployment.md)
+    try:
+        cfg = RuntimeConfig.load()
+    except Exception:
+        logging.getLogger(__name__).warning(
+            "bad runtime config; request-lifecycle knobs use defaults",
+            exc_info=True)
+        cfg = RuntimeConfig()
     service = await HttpService(manager, host=args.http_host,
-                                port=args.http_port).start()
+                                port=args.http_port,
+                                request_timeout_s=cfg.request_timeout_s,
+                                max_inflight=cfg.http_max_inflight,
+                                max_model_inflight=cfg.http_max_model_inflight,
+                                shed_retry_after_s=cfg.http_shed_retry_after_s,
+                                ).start()
     print(f"listening on {service.host}:{service.port} "
           f"(model {pipeline.card.name})", flush=True)
     try:

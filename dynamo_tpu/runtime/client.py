@@ -43,6 +43,10 @@ class Client:
                 inst = Instance.from_json(value)
                 self._instances[inst.instance_id] = inst
             self._watch_task = asyncio.create_task(self._watch_loop())
+        # keepalive/conn-death notifications from the shared pool: a
+        # connection that dies without an explicit drop marks its backing
+        # instance(s) down here, ahead of lease expiry
+        drt.rpc_pool.add_down_listener(self._on_address_down)
         return self
 
     async def _watch_loop(self) -> None:
@@ -93,6 +97,15 @@ class Client:
             if inst is not None:
                 self._drt.rpc_pool.drop(inst.address)
 
+    def _on_address_down(self, address: str) -> None:
+        """Pool notification: a connection died unexpectedly (remote crash or
+        keepalive miss-budget exhaustion) — mark the instance(s) at that
+        address down.  ``report_instance_down``'s pool drop is a no-op here
+        (the pool already evicted the dead connection), so no recursion."""
+        for iid, inst in list(self._instances.items()):
+            if inst.address == address and iid not in self._down:
+                self.report_instance_down(iid)
+
     async def wait_for_instances(self, n: int = 1, timeout: float = 30.0) -> List[Instance]:
         """Block until at least ``n`` instances are visible."""
         deadline = asyncio.get_running_loop().time() + timeout
@@ -122,6 +135,7 @@ class Client:
         return await conn.request(f"{self.endpoint.path}", payload, headers)
 
     async def close(self) -> None:
+        self._drt.rpc_pool.remove_down_listener(self._on_address_down)
         await reap_task(self._watch_task)
         if self._watch is not None:
             try:

@@ -12,10 +12,16 @@ import asyncio
 import enum
 import logging
 import random
+import time
 from typing import Any, AsyncIterator, Dict, Optional
 
 from dynamo_tpu.runtime.client import Client
-from dynamo_tpu.runtime.rpc import ResponseStream, StreamEndedError
+from dynamo_tpu.runtime.rpc import (
+    DEADLINE_HEADER,
+    DeadlineExceededError,
+    ResponseStream,
+    StreamEndedError,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -31,10 +37,16 @@ class PushRouter:
     """Routes requests across an endpoint's live instances."""
 
     def __init__(self, client: Client, mode: RouterMode = RouterMode.ROUND_ROBIN,
-                 retries: int = 3):
+                 retries: int = 3, backoff_base_s: float = 0.05,
+                 backoff_cap_s: float = 2.0):
         self.client = client
         self.mode = mode
         self.retries = retries
+        # decorrelated-jitter backoff between failover attempts: during an
+        # outage a tight retry loop hammers the surviving instances at the
+        # exact moment they're absorbing the failed one's traffic (0 = off)
+        self.backoff_base_s = backoff_base_s
+        self.backoff_cap_s = backoff_cap_s
         self._rr = 0
 
     def select_instance(self) -> int:
@@ -58,7 +70,15 @@ class PushRouter:
         caller-pinned ``instance_id`` is never silently rerouted.
         """
         last_err: Optional[Exception] = None
-        for _attempt in range(max(1, self.retries)):
+        attempts = max(1, self.retries)
+        sleep_s = self.backoff_base_s
+        deadline = (headers or {}).get(DEADLINE_HEADER)
+        for attempt in range(attempts):
+            if deadline is not None and time.time() >= deadline:
+                # failover must not hold a request past its deadline, nor
+                # dispatch already-expired work a worker will only drop
+                raise DeadlineExceededError(
+                    "request deadline exceeded during failover")
             iid = instance_id if instance_id is not None else self.select_instance()
             try:
                 return iid, await self.client.direct(payload, iid, headers)
@@ -67,6 +87,16 @@ class PushRouter:
                 self.client.report_instance_down(iid)
                 if instance_id is not None:
                     break  # caller pinned the instance; don't fail over silently
+                if attempt + 1 < attempts and self.backoff_base_s > 0:
+                    # decorrelated jitter: each sleep is uniform between the
+                    # base and 3x the previous sleep, capped — retries from
+                    # many callers spread out instead of arriving in lockstep
+                    sleep_s = min(self.backoff_cap_s,
+                                  random.uniform(self.backoff_base_s,
+                                                 sleep_s * 3))
+                    if deadline is not None:
+                        sleep_s = min(sleep_s, max(0.0, deadline - time.time()))
+                    await asyncio.sleep(sleep_s)
         raise ConnectionError(
             f"all attempts to reach {self.client.endpoint.path} failed: {last_err}")
 

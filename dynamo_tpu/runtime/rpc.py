@@ -23,9 +23,10 @@ from __future__ import annotations
 import asyncio
 import itertools
 import logging
+import os
 import time
 from dataclasses import dataclass, field
-from typing import Any, AsyncIterator, Awaitable, Callable, Dict, Optional
+from typing import Any, AsyncIterator, Awaitable, Callable, Dict, List, Optional
 
 from dynamo_tpu.runtime.codec import Raw, read_frame, send_frame
 from dynamo_tpu.utils.aio import reap_task
@@ -33,6 +34,56 @@ from dynamo_tpu.utils.aio import reap_task
 logger = logging.getLogger(__name__)
 
 Handler = Callable[[Any, "RequestContext"], AsyncIterator[Any]]
+
+# Keepalive health probing defaults (gRPC-style: any inbound frame counts as
+# liveness proof; pings only generate traffic when the connection is quiet).
+# A worker that is alive-but-stuck — engine deadlock, GC pause, network
+# partition with the TCP connection still open — never closes its socket, so
+# stream-drop detection alone hangs callers forever.  The ping loop bounds
+# that: after ``interval * miss_budget`` seconds of silence the connection is
+# torn down and every in-flight stream takes the existing ``drop`` path.
+# Defaults layer: RuntimeConfig (dataclass -> TOML -> DYN_RUNTIME_* env),
+# then the short-form DYN_KEEPALIVE_* env wins.  Resolved lazily (at pool
+# construction, not import) so programmatic/monkeypatched env changes take
+# effect and importing this module never does TOML file I/O.
+def keepalive_defaults() -> "tuple[float, int]":
+    interval, budget = 5.0, 3
+    try:
+        from dynamo_tpu.utils.config import RuntimeConfig
+        cfg = RuntimeConfig.load()
+        interval, budget = cfg.keepalive_interval_s, cfg.keepalive_miss_budget
+    except Exception:  # a bad TOML/env must not break connection setup
+        logger.warning("bad runtime config; keepalive falls back to "
+                       "%.1fs x %d", interval, budget, exc_info=True)
+    # short-form env strings need coercion (RuntimeConfig.load already
+    # type-coerces its own sources); fall back per-value so one bad knob
+    # doesn't discard the other's configured value
+    raw_interval = os.environ.get("DYN_KEEPALIVE_INTERVAL")
+    raw_budget = os.environ.get("DYN_KEEPALIVE_MISS_BUDGET")
+    try:
+        interval = float(raw_interval) if raw_interval is not None else interval
+    except (TypeError, ValueError):
+        logger.warning("malformed DYN_KEEPALIVE_INTERVAL %r; using %.1fs",
+                       raw_interval, interval)
+    try:
+        budget = int(raw_budget) if raw_budget is not None else budget
+    except (TypeError, ValueError):
+        logger.warning("malformed DYN_KEEPALIVE_MISS_BUDGET %r; using %d",
+                       raw_budget, budget)
+    return interval, budget
+
+# Wire header carrying the request deadline (absolute unix seconds, caller's
+# clock — same-DC clock skew is far below useful deadline granularity).
+DEADLINE_HEADER = "deadline_unix"
+
+
+def deadline_headers(deadline_unix: Optional[float]) -> Optional[Dict[str, Any]]:
+    """RPC headers carrying a request deadline; None when there is none.
+    The one place the wire shape of deadline propagation is written down —
+    every hop (router sink, disagg forwards) builds its headers here."""
+    if deadline_unix is None:
+        return None
+    return {DEADLINE_HEADER: deadline_unix}
 
 
 class StreamEndedError(ConnectionError):
@@ -42,6 +93,14 @@ class StreamEndedError(ConnectionError):
     "Stream ended before generation completed")."""
 
 
+class DeadlineExceededError(TimeoutError):
+    """The request's end-to-end deadline passed before the stream finished.
+
+    Deliberately NOT a ConnectionError subclass: the migration operator
+    replays on connection-shaped failures, and an expired request must not
+    be replayed onto another worker nobody is waiting for."""
+
+
 @dataclass
 class RequestContext:
     """Per-request context passed to endpoint handlers."""
@@ -49,11 +108,24 @@ class RequestContext:
     request_id: str
     endpoint: str
     headers: Dict[str, Any] = field(default_factory=dict)
+    # absolute unix-seconds deadline propagated from the caller (``req``
+    # frame header); None = no deadline
+    deadline_unix: Optional[float] = None
     _cancel_event: asyncio.Event = field(default_factory=asyncio.Event)
 
     @property
     def cancelled(self) -> bool:
         return self._cancel_event.is_set()
+
+    @property
+    def deadline_expired(self) -> bool:
+        return self.deadline_unix is not None and time.time() >= self.deadline_unix
+
+    def time_remaining(self) -> Optional[float]:
+        """Seconds until the deadline (negative if past); None = no deadline."""
+        if self.deadline_unix is None:
+            return None
+        return self.deadline_unix - time.time()
 
     def cancel(self) -> None:
         self._cancel_event.set()
@@ -145,6 +217,12 @@ class RpcServer:
             async with wlock:
                 await send_frame(writer, obj, raw)
 
+        async def pong(rid: Any) -> None:
+            try:
+                await send({"op": "pong", "rid": rid})
+            except (ConnectionError, RuntimeError):
+                pass  # peer vanished; the read loop will notice
+
         try:
             while True:
                 frame = await read_frame(reader)
@@ -153,10 +231,23 @@ class RpcServer:
                 op = frame.get("op")
                 if op == "req":
                     sid = frame["sid"]
+                    headers = frame.get("headers", {}) or {}
+                    deadline = headers.get(DEADLINE_HEADER)
+                    try:
+                        deadline = (float(deadline)
+                                    if deadline is not None else None)
+                    except (TypeError, ValueError):
+                        # a malformed header must fail open (no deadline),
+                        # not unwind the read loop and kill every stream
+                        # multiplexed on this connection
+                        logger.warning("ignoring malformed %s header %r",
+                                       DEADLINE_HEADER, deadline)
+                        deadline = None
                     ctx = RequestContext(
-                        request_id=frame.get("headers", {}).get("request_id", str(sid)),
+                        request_id=headers.get("request_id", str(sid)),
                         endpoint=frame["endpoint"],
-                        headers=frame.get("headers", {}),
+                        headers=headers,
+                        deadline_unix=deadline,
                     )
                     streams[sid] = ctx
                     task = asyncio.create_task(
@@ -177,7 +268,13 @@ class RpcServer:
                     if task is not None:
                         task.cancel()
                 elif op == "ping":
-                    await send({"op": "pong", "rid": frame.get("rid")})
+                    # answer off the read loop: awaiting the shared wlock
+                    # here would park cancel/req processing behind any large
+                    # in-flight send — and the cancel path is exactly what a
+                    # deadline-expired peer needs processed promptly
+                    task = asyncio.create_task(pong(frame.get("rid")))
+                    self._active_tasks.add(task)
+                    task.add_done_callback(self._active_tasks.discard)
         except ConnectionError:
             pass  # CancelledError must propagate (see utils/aio.reap_task)
         finally:
@@ -253,12 +350,16 @@ class ResponseStream:
     """Async iterator over one request's response frames.
 
     Raises ``StreamEndedError`` if the connection drops before ``final``; a
-    server-reported handler error raises ``RuntimeError``.
+    server-reported handler error raises ``RuntimeError``; an expired request
+    deadline raises ``DeadlineExceededError`` (enforced between frames, so a
+    silent worker can't hold a caller past its deadline).
     """
 
-    def __init__(self, conn: "RpcConnection", sid: int):
+    def __init__(self, conn: "RpcConnection", sid: int,
+                 deadline_unix: Optional[float] = None):
         self._conn = conn
         self.sid = sid
+        self.deadline_unix = deadline_unix
         self.queue: asyncio.Queue = asyncio.Queue()
         self.finished = False
 
@@ -268,7 +369,31 @@ class ResponseStream:
     async def __anext__(self) -> Any:
         if self.finished:
             raise StopAsyncIteration
-        kind, value = await self.queue.get()
+        if self.deadline_unix is None:
+            kind, value = await self.queue.get()
+        else:
+            remaining = self.deadline_unix - time.time()
+            try:
+                if remaining <= 0:
+                    raise asyncio.TimeoutError
+                try:
+                    # fast path: a frame already queued skips wait_for's
+                    # per-token task + timer allocation on the hot path
+                    kind, value = self.queue.get_nowait()
+                except asyncio.QueueEmpty:
+                    kind, value = await asyncio.wait_for(self.queue.get(),
+                                                         timeout=remaining)
+            except asyncio.TimeoutError:
+                # raise AT the deadline: the cancel frame is sent in the
+                # background (send_cancel can wait seconds on a writer lock
+                # wedged by the very connection that went silent)
+                self.finished = True
+                self._conn._streams.pop(self.sid, None)
+                self._conn.spawn_cancel(self.sid)
+                while not self.queue.empty():
+                    self.queue.get_nowait()
+                raise DeadlineExceededError(
+                    "request deadline exceeded mid-stream") from None
         if kind == "data":
             return value
         self.finished = True
@@ -281,39 +406,74 @@ class ResponseStream:
 
     async def cancel(self) -> None:
         """Tell the worker to stop and finish this stream locally (the worker
-        may be hard-cancelled mid-await and never send a final frame)."""
+        may be hard-cancelled mid-await and never send a final frame).
+
+        Idempotent: a second cancel (or one on an already-finished stream) is
+        a no-op, and queued frames are drained so a late ``drop`` sentinel
+        can't leak into a reused sid map."""
+        if self.finished:
+            return
+        self.finished = True
+        self._conn._streams.pop(self.sid, None)
         await self._conn.send_cancel(self.sid)
-        if not self.finished:
-            self.finished = True
-            self._conn._streams.pop(self.sid, None)
+        while not self.queue.empty():
+            self.queue.get_nowait()
 
 
 class RpcConnection:
-    """One multiplexed duplex connection to a worker's RpcServer."""
+    """One multiplexed duplex connection to a worker's RpcServer.
 
-    def __init__(self, address: str):
+    ``keepalive_interval > 0`` arms a ping loop: when nothing (data, pong,
+    anything) has arrived for ``keepalive_interval * keepalive_miss_budget``
+    seconds the connection is torn down — in-flight streams get the ``drop``
+    sentinel (so migration/failover fire exactly as for a crashed worker) and
+    ``on_unexpected_close`` is invoked (the pool uses it to notify clients so
+    the instance is marked down)."""
+
+    def __init__(self, address: str, keepalive_interval: float = 0.0,
+                 keepalive_miss_budget: int = 3):
         host, _, port = address.rpartition(":")
         self.address = address
         self.host, self.port = host or "127.0.0.1", int(port)
+        self.keepalive_interval = keepalive_interval
+        self.keepalive_miss_budget = max(1, keepalive_miss_budget)
         self._sids = itertools.count(1)
         self._streams: Dict[int, ResponseStream] = {}
         self._reader: Optional[asyncio.StreamReader] = None
         self._writer: Optional[asyncio.StreamWriter] = None
         self._wlock: Optional[asyncio.Lock] = None
         self._reader_task: Optional[asyncio.Task] = None
+        self._ping_task: Optional[asyncio.Task] = None
+        self._bg_tasks: set = set()  # fire-and-forget cancels (spawn_cancel)
+        self._last_seen = 0.0  # loop time of the last inbound frame
+        self._closing = False  # explicit close() — don't fire death callbacks
+        self.keepalive_expired = False
+        # fired (synchronously, once) when the connection dies without an
+        # explicit close(): conn drop OR keepalive miss-budget exhaustion
+        self.on_unexpected_close: Optional[Callable[["RpcConnection"], None]] = None
         self.alive = False
 
     async def connect(self) -> "RpcConnection":
         self._reader, self._writer = await asyncio.open_connection(
             self.host, self.port, limit=16 * 1024 * 1024)
         self._wlock = asyncio.Lock()
+        self._last_seen = asyncio.get_running_loop().time()
         self._reader_task = asyncio.create_task(self._read_loop())
+        if self.keepalive_interval > 0:
+            self._ping_task = asyncio.create_task(self._ping_loop())
         self.alive = True
         return self
 
     async def close(self) -> None:
         self.alive = False
+        self._closing = True
+        cur = asyncio.current_task()
+        if self._ping_task is not None and self._ping_task is not cur:
+            await reap_task(self._ping_task)
         await reap_task(self._reader_task)
+        for task in list(self._bg_tasks):
+            await reap_task(task)
+        self._bg_tasks.clear()
         if self._writer:
             try:
                 self._writer.close()
@@ -321,13 +481,97 @@ class RpcConnection:
             except Exception:
                 pass
 
+    async def _ping_loop(self) -> None:
+        """Probe a quiet connection; kill it when the miss budget is
+        exhausted.  Any inbound frame resets the silence clock, so a healthy
+        connection under load never pays for pongs it doesn't need, and a
+        connection is only ever torn down after a probe sent SINCE the last
+        inbound frame went unanswered (so even miss_budget=1 can't expire a
+        healthy idle connection that was never probed).
+
+        Caveat: inbound liveness is credited per COMPLETE frame, so on links
+        where a single RPC-plane frame can take longer than
+        ``interval * miss_budget`` to arrive (bulk KV riding the RPC
+        fallback cross-host), size the budget above the worst-case frame
+        time or disable probing for that pool.  The outbound analogue is
+        handled below: a probe that can't be written because a large send
+        holds the writer only counts as missed when the transport's write
+        buffer is NOT draining (a frozen peer stops draining it; a slow
+        healthy one keeps consuming)."""
+        loop = asyncio.get_running_loop()
+        rids = itertools.count(1)
+        budget_s = self.keepalive_interval * self.keepalive_miss_budget
+        last_ping = 0.0  # loop time of the newest (attempted) probe
+        last_buf: Optional[int] = None  # write-buffer size at last miss
+        while True:
+            await asyncio.sleep(self.keepalive_interval)
+            now = loop.time()
+            silent_for = now - self._last_seen
+            if silent_for >= budget_s and last_ping > self._last_seen:
+                logger.warning(
+                    "rpc connection %s silent for %.2fs (keepalive budget "
+                    "%.2fs): tearing down", self.address, silent_for, budget_s)
+                self.keepalive_expired = True
+                self._abort()
+                return
+            if silent_for < self.keepalive_interval / 2:
+                last_buf = None
+                continue  # recent traffic proves liveness — no probe needed
+            try:
+                # bound the probe: _wlock may be held by a request blocked
+                # in drain() against a peer that stopped reading — waiting
+                # on it unbounded would starve the budget check above and
+                # defeat frozen-worker detection exactly when it matters
+                await asyncio.wait_for(self._send_ping(next(rids)),
+                                       timeout=self.keepalive_interval)
+                last_ping = now
+                last_buf = None
+            except asyncio.TimeoutError:
+                # probe blocked behind a large in-flight send: only count
+                # it as missed when the peer isn't draining our bytes
+                buf = self._write_buffer_size()
+                if buf is not None and last_buf is not None and buf < last_buf:
+                    self._last_seen = now  # peer is consuming: alive
+                else:
+                    last_ping = now
+                last_buf = buf
+            except (ConnectionError, RuntimeError):
+                self._abort()
+                return
+
+    def _write_buffer_size(self) -> Optional[int]:
+        try:
+            return self._writer.transport.get_write_buffer_size()
+        except Exception:
+            return None
+
+    async def _send_ping(self, rid: int) -> None:
+        async with self._wlock:
+            await send_frame(self._writer, {"op": "ping", "rid": rid})
+
+    def _abort(self) -> None:
+        """Tear down from inside the connection's own tasks: cancelling the
+        reader fires its ``finally`` (drop sentinels + death callback)."""
+        self.alive = False
+        if self._reader_task is not None:
+            self._reader_task.cancel()
+        if self._writer is not None:
+            try:
+                self._writer.close()
+            except Exception:
+                pass
+
     async def _read_loop(self) -> None:
+        loop = asyncio.get_running_loop()
         try:
             while True:
                 frame = await read_frame(self._reader)
                 if frame is None:
                     break
+                self._last_seen = loop.time()
                 op = frame.get("op")
+                if op == "pong":
+                    continue
                 sid = frame.get("sid")
                 stream = self._streams.get(sid)
                 if stream is None:
@@ -350,13 +594,22 @@ class RpcConnection:
             for stream in list(self._streams.values()):
                 stream.queue.put_nowait(("drop", None))
             self._streams.clear()
+            if not self._closing and self.on_unexpected_close is not None:
+                cb, self.on_unexpected_close = self.on_unexpected_close, None
+                try:
+                    cb(self)
+                except Exception:
+                    logger.exception("connection death callback failed")
 
     async def request(self, endpoint: str, payload: Any,
                       headers: Optional[Dict[str, Any]] = None) -> ResponseStream:
         if not self.alive:
             raise ConnectionError(f"connection to {self.address} is down")
         sid = next(self._sids)
-        stream = ResponseStream(self, sid)
+        deadline = (headers or {}).get(DEADLINE_HEADER)
+        stream = ResponseStream(
+            self, sid,
+            deadline_unix=float(deadline) if deadline is not None else None)
         self._streams[sid] = stream
         try:
             async with self._wlock:
@@ -369,22 +622,65 @@ class RpcConnection:
             raise ConnectionError(str(e)) from e
         return stream
 
+    def spawn_cancel(self, sid: int) -> None:
+        """Fire-and-forget cancel frame, tracked so it is reaped at close
+        (used by the deadline path, which must not block on the writer)."""
+        task = asyncio.ensure_future(self.send_cancel(sid))
+        self._bg_tasks.add(task)
+        task.add_done_callback(self._bg_tasks.discard)
+
     async def send_cancel(self, sid: int) -> None:
         if not self.alive:
             return
         try:
-            async with self._wlock:
-                await send_frame(self._writer, {"op": "cancel", "sid": sid})
+            # best-effort and BOUNDED: _wlock may be held by a send blocked
+            # against a stuck peer, and cancel rides the deadline path —
+            # which must never wait on the very connection that's wedged
+            # (keepalive will tear it down)
+            await asyncio.wait_for(self._send_cancel(sid), timeout=5.0)
+        except asyncio.TimeoutError:
+            pass
         except (ConnectionError, RuntimeError):
             self.alive = False
 
+    async def _send_cancel(self, sid: int) -> None:
+        async with self._wlock:
+            await send_frame(self._writer, {"op": "cancel", "sid": sid})
+
 
 class RpcClientPool:
-    """Connection pool: one live RpcConnection per worker address."""
+    """Connection pool: one live RpcConnection per worker address.
 
-    def __init__(self) -> None:
+    Every pooled connection runs the keepalive ping loop (interval 0
+    disables).  When a connection dies without an explicit ``drop`` — remote
+    crash or keepalive expiry — registered down-listeners are notified with
+    the address, so endpoint clients can mark the backing instance down ahead
+    of lease expiry (frozen-worker detection as fast as crashed-worker
+    detection)."""
+
+    def __init__(self, keepalive_interval: Optional[float] = None,
+                 keepalive_miss_budget: Optional[int] = None) -> None:
+        default_interval, default_budget = keepalive_defaults()
+        self.keepalive_interval = (keepalive_interval
+                                   if keepalive_interval is not None
+                                   else default_interval)
+        self.keepalive_miss_budget = (keepalive_miss_budget
+                                      if keepalive_miss_budget is not None
+                                      else default_budget)
         self._conns: Dict[str, RpcConnection] = {}
         self._locks: Dict[str, asyncio.Lock] = {}
+        self._down_listeners: List[Callable[[str], None]] = []
+        self._close_tasks: set = set()
+
+    def add_down_listener(self, cb: Callable[[str], None]) -> None:
+        """``cb(address)`` fires when a pooled connection dies unexpectedly."""
+        self._down_listeners.append(cb)
+
+    def remove_down_listener(self, cb: Callable[[str], None]) -> None:
+        try:
+            self._down_listeners.remove(cb)
+        except ValueError:
+            pass
 
     async def get(self, address: str) -> RpcConnection:
         conn = self._conns.get(address)
@@ -395,20 +691,48 @@ class RpcClientPool:
             conn = self._conns.get(address)
             if conn is not None and conn.alive:
                 return conn
-            conn = RpcConnection(address)
+            conn = RpcConnection(
+                address, keepalive_interval=self.keepalive_interval,
+                keepalive_miss_budget=self.keepalive_miss_budget)
+            conn.on_unexpected_close = self._conn_died
             await conn.connect()
             self._conns[address] = conn
             return conn
 
+    def _conn_died(self, conn: RpcConnection) -> None:
+        if self._conns.get(conn.address) is conn:
+            self._conns.pop(conn.address, None)
+        for cb in list(self._down_listeners):
+            try:
+                cb(conn.address)
+            except Exception:
+                logger.exception("pool down-listener failed for %s",
+                                 conn.address)
+
     def drop(self, address: str) -> None:
         conn = self._conns.pop(address, None)
         if conn is not None:
-            asyncio.ensure_future(conn.close())
+            conn.on_unexpected_close = None  # explicit drop, not a death
+            # track the close task: an unreferenced ensure_future can be
+            # GC'd mid-flight and swallows exceptions silently
+            task = asyncio.ensure_future(conn.close())
+            self._close_tasks.add(task)
+            task.add_done_callback(self._reap_close)
+
+    def _reap_close(self, task: asyncio.Task) -> None:
+        self._close_tasks.discard(task)
+        if not task.cancelled() and task.exception() is not None:
+            logger.warning("pooled connection close failed: %r",
+                           task.exception())
 
     async def close(self) -> None:
         for conn in list(self._conns.values()):
+            conn.on_unexpected_close = None
             await conn.close()
         self._conns.clear()
+        if self._close_tasks:
+            await asyncio.gather(*list(self._close_tasks),
+                                 return_exceptions=True)
 
 
 __all__ = [
@@ -418,6 +742,10 @@ __all__ = [
     "ResponseStream",
     "RequestContext",
     "StreamEndedError",
+    "DeadlineExceededError",
     "EndpointStats",
     "Handler",
+    "DEADLINE_HEADER",
+    "deadline_headers",
+    "keepalive_defaults",
 ]

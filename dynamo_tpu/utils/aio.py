@@ -13,18 +13,20 @@ async def reap_task(task: Optional[asyncio.Task]) -> None:
     ``try: await task except CancelledError: pass`` is subtly wrong: if the
     *caller* is cancelled while awaiting the child, the same exception type is
     raised and gets swallowed — the caller keeps running and (since asyncio
-    delivers cancellation once) can never be cancelled again.  Re-raise when
-    our own task has a pending cancellation.
+    delivers cancellation once) can never be cancelled again.
     """
     if task is None:
         return
     task.cancel()
-    try:
-        await task
-    except asyncio.CancelledError:
-        cur = asyncio.current_task()
-        if cur is not None and cur.cancelling():
-            raise
+    # ``await task`` cannot distinguish the child's CancelledError from the
+    # caller's own (pre-3.11 there is no Task.cancelling()), so use
+    # asyncio.wait: it never propagates the child's exception, meaning a
+    # CancelledError out of it is only ever OURS — on every version.
+    await asyncio.wait({task})
+    if not task.cancelled():
+        exc = task.exception()
+        if exc is not None:
+            raise exc
 
 
 __all__ = ["reap_task"]

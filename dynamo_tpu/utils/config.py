@@ -13,9 +13,13 @@ from __future__ import annotations
 
 import dataclasses
 import os
-import tomllib
 from dataclasses import dataclass
 from typing import Any, Dict, Optional
+
+try:
+    import tomllib  # py311+
+except ModuleNotFoundError:  # pragma: no cover — py310 images ship tomli
+    import tomli as tomllib
 
 ENV_PREFIX = "DYN_RUNTIME_"
 CONFIG_PATH_ENV = "DYN_CONFIG_PATH"
@@ -30,6 +34,23 @@ class RuntimeConfig:
     log_level: str = "INFO"
     system_enabled: bool = False
     system_port: int = 0
+    # -- request-lifecycle robustness ----------------------------------
+    # default end-to-end request deadline applied by the HTTP frontend
+    # (seconds; 0 disables — per-request nvext.timeout_s / X-Request-Timeout
+    # override either way)
+    request_timeout_s: float = 0.0
+    # RPC keepalive health probing: ping a quiet connection every
+    # ``keepalive_interval_s``; after ``keepalive_miss_budget`` intervals of
+    # total silence the connection is torn down and the instance marked
+    # down (0 interval disables probing)
+    keepalive_interval_s: float = 5.0
+    keepalive_miss_budget: int = 3
+    # HTTP overload shedding high-water marks (0 = unlimited): total
+    # concurrent requests, and concurrent requests per model; shed requests
+    # get 503 + Retry-After ``http_shed_retry_after_s``
+    http_max_inflight: int = 0
+    http_max_model_inflight: int = 0
+    http_shed_retry_after_s: float = 1.0
 
     @classmethod
     def load(cls, path: Optional[str] = None,
@@ -43,20 +64,31 @@ class RuntimeConfig:
             values.update(doc.get("runtime", {}))
         for f in dataclasses.fields(cls):
             raw = env.get(f"{ENV_PREFIX}{f.name.upper()}")
-            if raw is None:
-                continue
-            if f.type in ("int", int):
-                values[f.name] = int(raw)
-            elif f.type in ("float", float):
-                values[f.name] = float(raw)
-            elif f.type in ("bool", bool):
-                values[f.name] = raw.lower() in ("1", "true", "yes")
-            else:
+            if raw is not None:
                 values[f.name] = raw
         known = {f.name for f in dataclasses.fields(cls)}
         unknown = set(values) - known
         if unknown:
             raise ValueError(f"unknown config keys: {sorted(unknown)}")
+        # coerce by declared field type so env strings AND quoted TOML
+        # values ("256") land as the right type at load time — a malformed
+        # value fails here, not as a TypeError deep in a request path
+        for f in dataclasses.fields(cls):
+            if f.name not in values:
+                continue
+            v = values[f.name]
+            try:
+                if f.type in ("int", int):
+                    values[f.name] = int(v)
+                elif f.type in ("float", float):
+                    values[f.name] = float(v)
+                elif f.type in ("bool", bool):
+                    values[f.name] = (v if isinstance(v, bool)
+                                      else str(v).lower() in ("1", "true", "yes"))
+            except (TypeError, ValueError):
+                raise ValueError(
+                    f"config key {f.name!r}: cannot coerce {v!r} "
+                    f"to {f.type}") from None
         return cls(**values)
 
 

@@ -1,0 +1,140 @@
+"""Deterministic transport-fault injection for tests and chaos drills.
+
+``ChaosProxy`` is a byte-level TCP proxy that sits between an RPC client and
+an ``RpcServer`` (or any TCP service) and injects the faults that are hard to
+provoke on a real socket pair:
+
+- ``blackhole()``: stop forwarding in both directions while KEEPING every
+  connection open — the alive-but-stuck worker (engine deadlock, GC pause,
+  network partition with open TCP).  Stream-drop detection never fires; only
+  keepalive probing or request deadlines can catch it.
+- ``set_delay(s)``: add latency to every forwarded chunk (slow network).
+- ``heal()``: resume forwarding (bytes held during the blackhole flow again).
+
+Scenarios become deterministic: point the client at ``proxy.address`` instead
+of the worker's own, then flip faults mid-stream.  Parity in intent with the
+reference's fault-tolerance suite (``tests/fault_tolerance/``), which kills
+processes; this adds the fault class process-kills can't express.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Optional, Set
+
+from dynamo_tpu.utils.aio import reap_task
+
+logger = logging.getLogger(__name__)
+
+
+class ChaosProxy:
+    """TCP proxy with switchable blackhole/delay fault injection."""
+
+    def __init__(self, upstream: str, host: str = "127.0.0.1", port: int = 0):
+        uhost, _, uport = upstream.rpartition(":")
+        self.upstream_host = uhost or "127.0.0.1"
+        self.upstream_port = int(uport)
+        self.host = host
+        self.port = port
+        self._server: Optional[asyncio.base_events.Server] = None
+        self._forwarding = asyncio.Event()
+        self._forwarding.set()
+        self._delay_s = 0.0
+        self._tasks: Set[asyncio.Task] = set()
+        self._writers: Set[asyncio.StreamWriter] = set()
+        self.bytes_forwarded = 0
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    @property
+    def blackholed(self) -> bool:
+        return not self._forwarding.is_set()
+
+    async def start(self) -> "ChaosProxy":
+        self._server = await asyncio.start_server(
+            self._handle, self.host, self.port, limit=16 * 1024 * 1024)
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self
+
+    async def stop(self) -> None:
+        if self._server:
+            self._server.close()
+        for t in list(self._tasks):
+            await reap_task(t)
+        for w in list(self._writers):
+            try:
+                w.close()
+            except Exception:
+                pass
+        if self._server:
+            await self._server.wait_closed()
+
+    # -- fault controls ----------------------------------------------------
+
+    def blackhole(self) -> None:
+        """Stop forwarding, keep connections open (the stuck-worker fault)."""
+        self._forwarding.clear()
+
+    def heal(self) -> None:
+        """Resume forwarding; bytes held during the blackhole flow again."""
+        self._forwarding.set()
+
+    def set_delay(self, seconds: float) -> None:
+        """Add per-chunk forwarding latency (0 restores full speed)."""
+        self._delay_s = max(0.0, seconds)
+
+    # -- plumbing ----------------------------------------------------------
+
+    async def _handle(self, creader: asyncio.StreamReader,
+                      cwriter: asyncio.StreamWriter) -> None:
+        try:
+            ureader, uwriter = await asyncio.open_connection(
+                self.upstream_host, self.upstream_port,
+                limit=16 * 1024 * 1024)
+        except OSError:
+            cwriter.close()
+            return
+        self._writers.update((cwriter, uwriter))
+        up = asyncio.create_task(self._pump(creader, uwriter))
+        down = asyncio.create_task(self._pump(ureader, cwriter))
+        for t in (up, down):
+            self._tasks.add(t)
+            t.add_done_callback(self._tasks.discard)
+        try:
+            await asyncio.gather(up, down, return_exceptions=True)
+        finally:
+            for w in (cwriter, uwriter):
+                self._writers.discard(w)
+                try:
+                    w.close()
+                except Exception:
+                    pass
+
+    async def _pump(self, reader: asyncio.StreamReader,
+                    writer: asyncio.StreamWriter) -> None:
+        try:
+            while True:
+                data = await reader.read(64 * 1024)
+                if not data:
+                    break
+                if self._delay_s:
+                    await asyncio.sleep(self._delay_s)
+                # blackhole: hold the chunk here — the connection stays
+                # open and silent, exactly like a frozen remote
+                await self._forwarding.wait()
+                writer.write(data)
+                await writer.drain()
+                self.bytes_forwarded += len(data)
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+
+__all__ = ["ChaosProxy"]

@@ -65,7 +65,8 @@ def parse_env_filter(spec: str) -> Tuple[int, Dict[str, int]]:
 
 
 def _load_toml_config(path: str) -> dict:
-    import tomllib
+    # one py310 tomli shim for the whole package, kept in utils.config
+    from dynamo_tpu.utils.config import tomllib
 
     with open(path, "rb") as f:
         data = tomllib.load(f)

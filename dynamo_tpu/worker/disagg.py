@@ -36,6 +36,7 @@ from dynamo_tpu.protocols.common import (
     PreprocessedRequest,
 )
 from dynamo_tpu.runtime.push_router import PushRouter, RouterMode
+from dynamo_tpu.runtime.rpc import DeadlineExceededError, deadline_headers
 from dynamo_tpu.runtime.runtime import DistributedRuntime
 from dynamo_tpu.utils.aio import reap_task
 
@@ -342,7 +343,10 @@ class DisaggDecodeHandler:
         try:
             iid = self._router.select_instance()
             final: Optional[LLMEngineOutput] = None
-            stream = await self._gen_client.direct(preq.to_dict(), iid)
+            # the end-to-end deadline rides the internal hop too, so a
+            # stuck prefill worker can't hold the decode worker past it
+            stream = await self._gen_client.direct(
+                preq.to_dict(), iid, deadline_headers(preq.deadline_unix))
             async for payload in stream:
                 out = LLMEngineOutput.from_dict(payload)
                 if out.finish_reason is not None:
@@ -354,6 +358,10 @@ class DisaggDecodeHandler:
             if hashes:
                 await self._pull_blocks(hashes, iid)
             return final
+        except DeadlineExceededError:
+            # the request is already expired: a local-prefill fallback would
+            # burn the longest class of prompts for a caller that's gone
+            raise
         except Exception as e:  # noqa: BLE001 — disagg must never fail a
             # request: any remote-leg error (connection, malformed frame,
             # inject failure) falls back to local prefill
@@ -681,12 +689,15 @@ class PrefillFirstHandler:
         relayed = False
         try:
             iid = self._router.select_instance()
-            stream = await self._decode_client.direct(fwd.to_dict(), iid)
+            stream = await self._decode_client.direct(
+                fwd.to_dict(), iid, deadline_headers(fwd.deadline_unix))
             async for payload in stream:
                 out = LLMEngineOutput.from_dict(payload)
                 relayed = relayed or bool(out.token_ids)
                 yield out
             return
+        except DeadlineExceededError:
+            raise  # expired request: never restart it locally
         except Exception as e:  # noqa: BLE001 — decode hop failed: the
             # prefix is still cached here, finish the request locally —
             # but ONLY if nothing was relayed yet. After a partial relay a

@@ -201,3 +201,242 @@ class TestBarrier:
             await worker.close()
         finally:
             await coord.stop()
+
+
+# ---------------------------------------------------------------------------
+# Request-lifecycle robustness: keepalive vs frozen workers, deadlines,
+# frontend overload shedding.  Fault injection is transport-level
+# (utils/faults.ChaosProxy) so the stuck-worker scenarios are deterministic.
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+class TestFrozenWorkerKeepalive:
+    async def test_blackholed_worker_detected_and_marked_down(self):
+        """A worker that stalls with its TCP connection OPEN (engine
+        deadlock / GC pause / partition) produces no stream-drop signal —
+        only the keepalive ping loop can catch it.  The connection must be
+        torn down within the miss budget, in-flight streams take the drop
+        path (migration fires), and the instance is marked down."""
+        import dataclasses
+
+        from dynamo_tpu.utils.faults import ChaosProxy
+
+        coord = await Coordinator(port=0).start()
+        drts, proxy = [], None
+        try:
+            w, _e = await start_slow_worker(coord.address, decode_s=0.05)
+            drts.append(w)
+            proxy = await ChaosProxy(w.rpc_server.address).start()
+            fe = await DistributedRuntime.create(coordinator=coord.address)
+            drts.append(fe)
+            # fast keepalive so detection fits the test budget: teardown
+            # after 3 * 0.05s of total silence on the connection
+            fe.rpc_pool.keepalive_interval = 0.05
+            fe.rpc_pool.keepalive_miss_budget = 3
+            client = await (fe.namespace("ns").component("w")
+                            .endpoint("generate").client())
+            await client.wait_for_instances(1, timeout=10)
+            [inst] = client.instances()
+            # re-point the registration at the chaos proxy so the data
+            # plane (and its faults) sit between frontend and worker
+            proxied = dataclasses.replace(inst, address=proxy.address)
+            await fe.coord.put(proxied.etcd_key, proxied.to_json())
+            for _ in range(200):
+                insts = client.instances()
+                if insts and insts[0].address == proxy.address:
+                    break
+                await asyncio.sleep(0.02)
+            assert client.instances()[0].address == proxy.address
+
+            card = make_test_card(name="m", kv_cache_block_size=4)
+            pipeline = RemotePipeline(
+                card, PushRouter(client, backoff_base_s=0.01,
+                                 backoff_cap_s=0.05),
+                migration_limit=1)
+            req = make_req(range(1, 10), "r1", max_tokens=100)
+            frames = []
+            async for out in pipeline.engine_stream(req):
+                frames.append(out)
+                n = sum(len(f.token_ids) for f in frames)
+                if n >= 3 and not proxy.blackholed:
+                    proxy.blackhole()  # worker alive, connection silent
+            # migration fired (drop path) and found no healthy instance:
+            # clean error, not an indefinite hang
+            assert frames[-1].finish_reason == FinishReason.ERROR
+            assert "migrations" in (frames[-1].error or "")
+            # keepalive marked the frozen instance down ahead of lease expiry
+            assert client.instance_ids() == []
+        finally:
+            if proxy is not None:
+                await proxy.stop()
+            for d in drts:
+                try:
+                    await d.close()
+                except Exception:
+                    pass
+            await coord.stop()
+
+
+@pytest.mark.chaos
+class TestRequestDeadline:
+    async def test_deadline_mid_stream_no_migration_replay(self):
+        """A request that exceeds its end-to-end deadline mid-stream raises
+        DeadlineExceededError — a clean, typed error the migration operator
+        does NOT replay (the worker is healthy; the request is just late)."""
+        import time as _time
+
+        from dynamo_tpu.runtime.rpc import DeadlineExceededError
+
+        coord = await Coordinator(port=0).start()
+        drts = []
+        try:
+            w, _e = await start_slow_worker(coord.address, decode_s=0.05)
+            drts.append(w)
+            fe = await DistributedRuntime.create(coordinator=coord.address)
+            drts.append(fe)
+            client = await (fe.namespace("ns").component("w")
+                            .endpoint("generate").client())
+            await client.wait_for_instances(1, timeout=10)
+            card = make_test_card(name="m", kv_cache_block_size=4)
+            pipeline = RemotePipeline(card, PushRouter(client),
+                                      migration_limit=3)
+            req = make_req(range(1, 10), "r1", max_tokens=200)
+            req.deadline_unix = _time.time() + 0.4
+            frames = []
+            with pytest.raises(DeadlineExceededError):
+                async for out in pipeline.engine_stream(req):
+                    frames.append(out)
+            # some tokens streamed before the deadline, nowhere near all
+            n = sum(len(f.token_ids) for f in frames)
+            assert 0 < n < 200
+            # exactly ONE generate request reached the worker: no replay
+            assert w.rpc_server.stats("ns/w/generate").requests == 1
+            # and the healthy worker was NOT marked down
+            assert client.instance_ids() != []
+            # worker dropped the expired work: scheduler slot released
+            for _ in range(100):
+                if not _e.scheduler.active:
+                    break
+                await asyncio.sleep(0.02)
+            assert not _e.scheduler.active
+        finally:
+            for d in drts:
+                try:
+                    await d.close()
+                except Exception:
+                    pass
+            await coord.stop()
+
+    async def test_local_pipeline_deadline_enforced_via_http(self):
+        """Deadlines also bind on in-process engines (single-process server):
+        X-Request-Timeout on a LocalEnginePipeline chat -> 504."""
+        import aiohttp
+
+        from dynamo_tpu.engine.base import EchoEngine
+        from dynamo_tpu.http.service import HttpService
+        from dynamo_tpu.llm.model_manager import ModelManager
+        from dynamo_tpu.llm.pipeline import LocalEnginePipeline
+
+        card = make_test_card(name="echo-model")
+        manager = ModelManager()
+        manager.add(card.name, LocalEnginePipeline(
+            card, EchoEngine(delay_s=0.05)))
+        service = await HttpService(manager, host="127.0.0.1", port=0).start()
+        try:
+            async with aiohttp.ClientSession() as s:
+                r = await s.post(
+                    f"http://127.0.0.1:{service.port}/v1/chat/completions",
+                    json={"model": "echo-model",
+                          "messages": [{"role": "user", "content":
+                                        "a prompt long enough to stream "
+                                        "well past the deadline"}],
+                          "max_tokens": 100},
+                    headers={"X-Request-Timeout": "0.3"})
+                body = await r.json()
+                assert r.status == 504, (r.status, body)
+                assert body["error"]["type"] == "deadline_exceeded"
+        finally:
+            await service.stop()
+
+    async def test_expired_on_arrival_dropped_before_admission(self):
+        """A request arriving past its deadline is refused before touching
+        the scheduler."""
+        import time as _time
+
+        from dynamo_tpu.llm.register import engine_handler
+        from dynamo_tpu.protocols.common import LLMEngineOutput as _O  # noqa
+        from dynamo_tpu.runtime.rpc import RequestContext
+
+        from dynamo_tpu.mocker import MockEngineArgs, MockerEngine
+        engine = MockerEngine(MockEngineArgs(
+            num_pages=16, page_size=4, max_num_seqs=4, max_prefill_chunk=16,
+            max_context=64, speedup_ratio=100.0))
+        await engine.start()
+        try:
+            handler = engine_handler(engine)
+            ctx = RequestContext(request_id="r1", endpoint="gen",
+                                 deadline_unix=_time.time() - 1.0)
+            req = make_req(range(1, 5), "r1", max_tokens=5)
+            frames = [f async for f in handler(req.to_dict(), ctx)]
+            assert len(frames) == 1
+            assert "deadline" in (frames[0].get("error") or "")
+            assert not engine.scheduler.active  # never admitted
+        finally:
+            await engine.stop()
+
+
+@pytest.mark.chaos
+class TestOverloadShedding:
+    async def test_shed_returns_503_then_recovers(self):
+        """Past the inflight high-water mark the frontend sheds with 503 +
+        Retry-After (and counts it in /metrics); once load drains, new
+        requests are admitted again."""
+        import aiohttp
+
+        from dynamo_tpu.engine.base import EchoEngine
+        from dynamo_tpu.http.service import HttpService
+        from dynamo_tpu.llm.model_manager import ModelManager
+        from dynamo_tpu.llm.pipeline import LocalEnginePipeline
+
+        card = make_test_card(name="echo-model")
+        manager = ModelManager()
+        manager.add(card.name, LocalEnginePipeline(
+            card, EchoEngine(delay_s=0.02)))
+        service = await HttpService(manager, host="127.0.0.1", port=0,
+                                    max_inflight=1,
+                                    shed_retry_after_s=2.0).start()
+        base = f"http://127.0.0.1:{service.port}"
+        payload = {"model": "echo-model", "stream": True,
+                   "messages": [{"role": "user",
+                                 "content": "a reasonably long prompt"}],
+                   "max_tokens": 50}
+        try:
+            async with aiohttp.ClientSession() as s:
+                # request A: admitted; read ONE chunk so it is provably
+                # in-flight, keep the stream open
+                ra = await s.post(f"{base}/v1/chat/completions", json=payload)
+                assert ra.status == 200
+                await ra.content.readline()
+                # request B: shed at the high-water mark
+                async with s.post(f"{base}/v1/chat/completions",
+                                  json=payload) as rb:
+                    assert rb.status == 503
+                    assert rb.headers.get("Retry-After") == "2"
+                    body = await rb.json()
+                    assert body["error"]["type"] == "overloaded"
+                # shed counter exported through /metrics
+                async with s.get(f"{base}/metrics") as rm:
+                    text = await rm.text()
+                    assert "dynamo_frontend_requests_shed_total" in text
+                    assert 'reason="inflight_high_water"' in text
+                # drain A; capacity frees up
+                await ra.content.read()
+                ra.close()
+                # request C: admitted again (service recovered)
+                async with s.post(f"{base}/v1/chat/completions",
+                                  json=payload) as rc:
+                    assert rc.status == 200
+                    await rc.content.read()
+        finally:
+            await service.stop()

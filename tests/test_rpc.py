@@ -212,3 +212,184 @@ async def test_stats_endpoint():
         await conn.close()
     finally:
         await server.stop()
+
+
+# ---------------------------------------------------------------------------
+# Request-lifecycle robustness: deadlines, keepalive, cancel hygiene, pool
+# ---------------------------------------------------------------------------
+
+
+async def test_response_stream_deadline_between_frames():
+    """A stream whose worker goes silent raises DeadlineExceededError at the
+    deadline — and DeadlineExceededError is NOT connection-shaped, so the
+    migration operator never replays it."""
+    import time
+
+    from dynamo_tpu.runtime.rpc import DEADLINE_HEADER, DeadlineExceededError
+
+    async def one_then_hang(payload, ctx):
+        yield 1
+        await asyncio.sleep(30)
+        yield 2
+
+    server = await RpcServer().start()
+    server.register("gen", one_then_hang)
+    try:
+        conn = await RpcConnection(server.address).connect()
+        stream = await conn.request(
+            "gen", {}, headers={DEADLINE_HEADER: time.time() + 0.3})
+        assert await stream.__anext__() == 1
+        t0 = time.monotonic()
+        with pytest.raises(DeadlineExceededError):
+            await stream.__anext__()
+        assert time.monotonic() - t0 < 5  # bounded by the deadline, not 30s
+        assert stream.finished
+        assert not isinstance(DeadlineExceededError("x"), ConnectionError)
+        await conn.close()
+    finally:
+        await server.stop()
+
+
+async def test_deadline_propagates_to_request_context():
+    """The deadline header lands on the worker's RequestContext."""
+    import time
+
+    from dynamo_tpu.runtime.rpc import DEADLINE_HEADER
+
+    seen = {}
+
+    async def probe(payload, ctx):
+        seen["deadline"] = ctx.deadline_unix
+        seen["remaining"] = ctx.time_remaining()
+        yield 0
+
+    server = await RpcServer().start()
+    server.register("gen", probe)
+    try:
+        conn = await RpcConnection(server.address).connect()
+        deadline = time.time() + 5.0
+        s = await conn.request("gen", {}, headers={DEADLINE_HEADER: deadline})
+        async for _ in s:
+            pass
+        assert seen["deadline"] == pytest.approx(deadline)
+        assert 0 < seen["remaining"] <= 5.0
+        await conn.close()
+    finally:
+        await server.stop()
+
+
+async def test_cancel_is_idempotent_and_drains_queue():
+    """Double-cancel is a no-op and queued frames are drained, so a late
+    drop sentinel can't leak into a reused sid slot."""
+
+    async def burst(payload, ctx):
+        for i in range(5):
+            yield i
+        await asyncio.sleep(30)
+
+    server = await RpcServer().start()
+    server.register("gen", burst)
+    try:
+        conn = await RpcConnection(server.address).connect()
+        stream = await conn.request("gen", {})
+        assert await stream.__anext__() == 0
+        await asyncio.sleep(0.1)  # let the burst queue up
+        await stream.cancel()
+        assert stream.finished and stream.queue.empty()
+        await stream.cancel()  # no-op, no error
+        assert stream.queue.empty()
+        # finished stream iterates as ended, not as dropped
+        with pytest.raises(StopAsyncIteration):
+            await stream.__anext__()
+        await conn.close()
+    finally:
+        await server.stop()
+
+
+async def test_keepalive_detects_blackholed_connection():
+    """A connection whose peer goes silent (open TCP, no frames — the
+    alive-but-stuck worker) is torn down once the keepalive miss budget is
+    exhausted; in-flight streams take the drop path."""
+    from dynamo_tpu.utils.faults import ChaosProxy
+
+    async def one_then_hang(payload, ctx):
+        yield 1
+        await asyncio.sleep(30)
+        yield 2
+
+    server = await RpcServer().start()
+    server.register("gen", one_then_hang)
+    proxy = await ChaosProxy(server.address).start()
+    try:
+        conn = await RpcConnection(proxy.address, keepalive_interval=0.05,
+                                   keepalive_miss_budget=3).connect()
+        stream = await conn.request("gen", {})
+        assert await stream.__anext__() == 1
+        proxy.blackhole()
+        with pytest.raises(StreamEndedError):
+            await asyncio.wait_for(stream.__anext__(), 5)
+        assert conn.keepalive_expired
+        assert not conn.alive
+        await conn.close()
+    finally:
+        await proxy.stop()
+        await server.stop()
+
+
+async def test_keepalive_quiet_but_healthy_connection_survives():
+    """Pings keep a quiet-but-reachable connection alive (pongs count as
+    traffic), and a later request on it still works."""
+    server = await RpcServer().start()
+    server.register("gen", echo_handler)
+    try:
+        conn = await RpcConnection(server.address, keepalive_interval=0.05,
+                                   keepalive_miss_budget=3).connect()
+        await asyncio.sleep(0.5)  # many intervals of request silence
+        assert conn.alive and not conn.keepalive_expired
+        s = await conn.request("gen", {"tokens": [7]})
+        assert [f async for f in s] == [{"tok": 7}]
+        await conn.close()
+    finally:
+        await server.stop()
+
+
+async def test_pool_notifies_down_listener_and_reaps_drop():
+    """Pool fires down-listeners on unexpected connection death (not on
+    explicit drop), and drop()'s async close is tracked and reaped."""
+    from dynamo_tpu.runtime.rpc import RpcClientPool
+
+    async def hang(payload, ctx):
+        yield 1
+        await asyncio.sleep(30)
+
+    died = []
+    server = await RpcServer().start()
+    server.register("gen", hang)
+    server2 = await RpcServer().start()
+    server2.register("gen", hang)
+    pool = RpcClientPool(keepalive_interval=0.05, keepalive_miss_budget=2)
+    pool.add_down_listener(died.append)
+    try:
+        # explicit drop: closed cleanly, no death notification
+        conn2 = await pool.get(server2.address)
+        pool.drop(server2.address)
+        await asyncio.sleep(0.1)
+        assert died == [] and not pool._close_tasks
+
+        # unexpected death (server killed mid-stream): listener fires
+        conn = await pool.get(server.address)
+        s = await conn.request("gen", {})
+        assert await s.__anext__() == 1
+        await server.stop()
+        with pytest.raises(StreamEndedError):
+            await asyncio.wait_for(s.__anext__(), 5)
+        for _ in range(50):
+            if died:
+                break
+            await asyncio.sleep(0.02)
+        assert died == [server.address]
+        assert server.address not in pool._conns  # evicted
+    finally:
+        await pool.close()
+        await server2.stop()
+        await server.stop()
