@@ -78,12 +78,16 @@ async def amain(args: argparse.Namespace) -> None:
             "use_kv_events": not args.no_kv_events,
         })
     await watcher.start()
-    service = await HttpService(
+    service = HttpService(
         manager, host=args.http_host, port=args.http_port,
         request_timeout_s=args.request_timeout_s,
         max_inflight=args.max_inflight,
         max_model_inflight=args.max_model_inflight,
-        shed_retry_after_s=args.shed_retry_after_s).start()
+        shed_retry_after_s=args.shed_retry_after_s)
+    # control-plane health rides the same /metrics page as request metrics
+    # (dynamo_coord_connected, dynamo_coord_reconnects_total, ...)
+    service.metrics.attach_coord(drt.coord)
+    await service.start()
     if args.standalone:
         print(f"coordinator listening on {drt._embedded.address}", flush=True)
     print(f"frontend listening on {service.host}:{service.port}", flush=True)

@@ -56,8 +56,53 @@ class FrontendMetrics:
             "Requests shed at admission (503) by overload protection",
             ["model", "endpoint", "reason"], registry=self.registry)
 
+    def attach_coord(self, coord) -> "CoordClientMetrics":
+        """Expose the process's coordinator-connection health next to the
+        request metrics (``dynamo_coord_*`` series on the same /metrics)."""
+        return CoordClientMetrics(coord, registry=self.registry)
+
     def render(self) -> bytes:
         return generate_latest(self.registry)
+
+
+class CoordClientMetrics:
+    """Custom collector sampling a ``CoordClient``'s supervision state.
+
+    Series: ``dynamo_coord_connected`` (gauge, 1 while the control-plane
+    connection is up and resynced), ``dynamo_coord_reconnects_total`` /
+    ``dynamo_coord_resyncs_total`` (counters), and
+    ``dynamo_coord_last_outage_seconds`` (gauge, duration of the most recent
+    survived outage). Sampled at scrape time — no wiring inside the client."""
+
+    def __init__(self, coord, registry: Optional[CollectorRegistry] = None):
+        self.coord = coord
+        if registry is not None:
+            registry.register(self)
+
+    def collect(self):
+        from prometheus_client.core import (
+            CounterMetricFamily,
+            GaugeMetricFamily,
+        )
+        yield GaugeMetricFamily(
+            "dynamo_coord_connected",
+            "1 while the coordinator connection is up and resynced",
+            value=1.0 if self.coord.connected else 0.0)
+        rec = CounterMetricFamily(
+            "dynamo_coord_reconnects",
+            "Coordinator connections re-established after an outage")
+        rec.add_metric([], float(self.coord.reconnects_total))
+        yield rec
+        res = CounterMetricFamily(
+            "dynamo_coord_resyncs",
+            "State resync attempts after a reconnect (exceeds "
+            "dynamo_coord_reconnects_total when resyncs are retried)")
+        res.add_metric([], float(self.coord.resyncs_total))
+        yield res
+        yield GaugeMetricFamily(
+            "dynamo_coord_last_outage_seconds",
+            "Duration of the most recent survived coordinator outage",
+            value=float(self.coord.last_outage_s))
 
 
 class RequestTimer:
@@ -98,4 +143,4 @@ class RequestTimer:
             self.m.input_tokens.labels(self.model).inc(prompt_tokens)
 
 
-__all__ = ["FrontendMetrics", "RequestTimer"]
+__all__ = ["FrontendMetrics", "CoordClientMetrics", "RequestTimer"]

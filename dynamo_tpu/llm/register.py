@@ -15,6 +15,7 @@ from dynamo_tpu.engine.base import EngineBase
 from dynamo_tpu.model_card import ModelDeploymentCard, ModelEntry
 from dynamo_tpu.protocols.common import LLMEngineOutput, PreprocessedRequest
 from dynamo_tpu.runtime.component import Endpoint, ServedEndpoint
+from dynamo_tpu.runtime.coordinator import replay_registry
 from dynamo_tpu.runtime.runtime import DistributedRuntime
 
 logger = logging.getLogger(__name__)
@@ -115,6 +116,26 @@ async def serve_aux(component, engine: EngineBase) -> ServedEndpoint:
     return await component.endpoint(AUX_ENDPOINT).serve(aux_handler(engine))
 
 
+def _model_replay(coord) -> dict:
+    """name -> (entry, lease) this process registered: register_llm can run
+    more than once (model reload/replace, repeated test registrations on a
+    shared client), so the shared registry replaces instead of accumulating
+    superseded cards."""
+    async def _republish(reg: dict) -> None:
+        for name, (entry, lease) in list(reg.items()):
+            # a restarted (possibly state-wiped) coordinator re-learns the
+            # card under the CURRENT primary lease id — which the resync may
+            # just have re-granted, moving the entry to a new
+            # models/{name}/{lease:x} key (frontends absorb the churn
+            # through their models/ watch)
+            await coord.put(entry.key(lease.lease_id), entry.to_json(),
+                            lease_id=lease.lease_id)
+            logger.info("re-published model %s after coordinator resync",
+                        name)
+
+    return replay_registry(coord, "_model_replay", dict, _republish)
+
+
 async def register_llm(drt: DistributedRuntime, endpoint: Endpoint,
                        card: ModelDeploymentCard,
                        model_type: str = "chat") -> ModelEntry:
@@ -130,6 +151,7 @@ async def register_llm(drt: DistributedRuntime, endpoint: Endpoint,
     lease = await drt.primary_lease()
     await drt.coord.put(entry.key(lease.lease_id), entry.to_json(),
                         lease_id=lease.lease_id)
+    _model_replay(drt.coord)[card.name] = (entry, lease)
     logger.info("registered model %s at %s", card.name, endpoint.path)
     return entry
 

@@ -38,6 +38,39 @@ class BarrierError(RuntimeError):
     pass
 
 
+class _ResyncPuts:
+    """Replays this participant's barrier keys across a coordinator restart.
+
+    Barrier keys ride the primary lease, so a state-wiped coordinator loses
+    them; while a rendezvous is in flight each participant keeps its own
+    puts here and re-issues them from a resync hook (under the lease's
+    CURRENT id — the resync may have re-granted it). Peers' watches then see
+    the re-puts as synthesized events and the rendezvous completes instead
+    of deadlocking."""
+
+    def __init__(self, drt: Any, lease: Any):
+        self._drt = drt
+        self._lease = lease
+        self._keys: dict = {}
+        drt.coord.add_resync_hook(self._replay)
+
+    async def put(self, key: str, value: bytes) -> None:
+        self._keys[key] = value
+        await self._drt.coord.put(key, value,
+                                  lease_id=self._lease.lease_id)
+
+    async def _replay(self) -> None:
+        for key, value in self._keys.items():
+            await self._drt.coord.put(key, value,
+                                      lease_id=self._lease.lease_id)
+        if self._keys:
+            logger.info("re-published %d barrier key(s) after coordinator "
+                        "resync", len(self._keys))
+
+    def close(self) -> None:
+        self._drt.coord.remove_resync_hook(self._replay)
+
+
 async def leader_barrier(drt, barrier_id: str, data: Any, num_workers: int,
                          timeout: float = 60.0) -> None:
     """Publish data, await ``num_workers`` check-ins, mark complete.
@@ -46,18 +79,17 @@ async def leader_barrier(drt, barrier_id: str, data: Any, num_workers: int,
     fast) and ``BarrierError`` raises.
     """
     lease = await drt.primary_lease()
-    await drt.coord.put(_data_key(barrier_id),
-                        json.dumps(data).encode(),
-                        lease_id=lease.lease_id)
-    watch = await drt.coord.watch_prefix(_worker_prefix(barrier_id))
-    try:
+    puts = _ResyncPuts(drt, lease)
+    watch = None
+    try:  # from here: puts.close() must run even if the first put fails
+        await puts.put(_data_key(barrier_id), json.dumps(data).encode())
+        watch = await drt.coord.watch_prefix(_worker_prefix(barrier_id))
         seen = {key for key, _v in watch.snapshot}
         deadline = asyncio.get_running_loop().time() + timeout
         while len(seen) < num_workers:
             remaining = deadline - asyncio.get_running_loop().time()
             if remaining <= 0:
-                await drt.coord.put(_status_key(barrier_id), b"abort",
-                                    lease_id=lease.lease_id)
+                await puts.put(_status_key(barrier_id), b"abort")
                 raise BarrierError(
                     f"barrier {barrier_id}: {len(seen)}/{num_workers} workers "
                     f"after {timeout}s")
@@ -68,23 +100,26 @@ async def leader_barrier(drt, barrier_id: str, data: Any, num_workers: int,
                 continue
             if ev.type == "put":
                 seen.add(ev.key)
-        await drt.coord.put(_status_key(barrier_id), b"complete",
-                            lease_id=lease.lease_id)
+        await puts.put(_status_key(barrier_id), b"complete")
     finally:
-        try:
-            await watch.cancel()
-        except Exception:
-            pass
+        puts.close()
+        if watch is not None:
+            try:
+                await watch.cancel()
+            except Exception:
+                pass
 
 
 async def worker_barrier(drt, barrier_id: str, worker_name: str,
                          timeout: float = 60.0) -> Any:
     """Check in and wait for completion; returns the leader's data."""
     lease = await drt.primary_lease()
-    await drt.coord.put(f"{_worker_prefix(barrier_id)}{worker_name}",
-                        worker_name.encode(), lease_id=lease.lease_id)
-    watch = await drt.coord.watch_prefix(_status_key(barrier_id))
-    try:
+    puts = _ResyncPuts(drt, lease)
+    watch = None
+    try:  # from here: puts.close() must run even if the first put fails
+        await puts.put(f"{_worker_prefix(barrier_id)}{worker_name}",
+                       worker_name.encode())
+        watch = await drt.coord.watch_prefix(_status_key(barrier_id))
         status: Optional[bytes] = None
         for _key, value in watch.snapshot:
             status = value
@@ -108,10 +143,12 @@ async def worker_barrier(drt, barrier_id: str, worker_name: str,
             raise BarrierError(f"barrier {barrier_id}: data vanished")
         return json.loads(raw)
     finally:
-        try:
-            await watch.cancel()
-        except Exception:
-            pass
+        puts.close()
+        if watch is not None:
+            try:
+                await watch.cancel()
+            except Exception:
+                pass
 
 
 __all__ = ["leader_barrier", "worker_barrier", "BarrierError"]

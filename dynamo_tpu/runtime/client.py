@@ -53,6 +53,14 @@ class Client:
         # NOTE: never catch CancelledError here — swallowing it breaks
         # cancellation of any task awaiting this one (asyncio delegates
         # A.cancel() to B.cancel() when A awaits B).
+        #
+        # A coordinator crash does NOT end this stream: the supervised
+        # CoordClient re-establishes the watch on reconnect and synthesizes
+        # put/delete deltas from a prefix re-scan (including the instance-id
+        # churn of re-granted leases). While the coordinator is down, no
+        # events arrive and routing continues from the cached ``_instances``
+        # snapshot. The stream ends only when the client is permanently
+        # closed — at that point discovery is frozen on the last snapshot.
         async for ev in self._watch:
             if ev.type == "put" and ev.value is not None:
                 inst = Instance.from_json(ev.value)
@@ -65,6 +73,10 @@ class Client:
                     self._down.discard(iid)
             self._changed.set()
             self._changed = asyncio.Event()
+        logger.warning(
+            "instance watch for %s ended (coordinator client closed); "
+            "discovery frozen on %d cached instance(s)",
+            self.endpoint.path, len(self._instances))
 
     @staticmethod
     def _id_from_key(key: str) -> Optional[int]:

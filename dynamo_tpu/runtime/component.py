@@ -11,6 +11,8 @@ watch on ``instances/{namespace}/{component}/{endpoint}``.  Event subjects use
 
 from __future__ import annotations
 
+import asyncio
+import dataclasses
 import json
 import logging
 from dataclasses import dataclass
@@ -182,7 +184,11 @@ class Endpoint:
         await drt.coord.put(inst.etcd_key, inst.to_json(), lease_id=lease.lease_id)
         logger.info("serving endpoint %s as instance %x at %s",
                     self.path, inst.instance_id, inst.address)
-        return ServedEndpoint(self, inst, rpc_name)
+        se = ServedEndpoint(self, inst, rpc_name)
+        # tracked for coordinator resync: a restarted (possibly state-wiped)
+        # coordinator re-learns this instance via drt._resync_registrations
+        drt._served.add(se)
+        return se
 
     async def client(self, **kw: Any) -> "Client":
         from dynamo_tpu.runtime.client import Client
@@ -196,6 +202,23 @@ class Endpoint:
         return f"Endpoint({self.path})"
 
 
+async def _delete_when_connected(drt: Any, key: str) -> None:
+    """Land a shutdown-time instance delete that failed mid-outage.
+
+    Idempotent against the resync hook's own pending-delete pass (deleting
+    a missing key is a no-op); gives up when the client closes for good."""
+    while key in drt._pending_deletes:
+        try:
+            await drt.coord.wait_connected()
+            await drt.coord.delete(key)
+            drt._pending_deletes.discard(key)
+            return
+        except ConnectionError:
+            if drt.coord.closed.is_set():
+                return
+            await asyncio.sleep(0.05)  # reconnect raced us; re-park
+
+
 class ServedEndpoint:
     """Handle for a live served endpoint; ``shutdown()`` deregisters it."""
 
@@ -204,12 +227,33 @@ class ServedEndpoint:
         self.instance = instance
         self._rpc_name = rpc_name
 
+    def _reannounce(self, lease_id: int) -> None:
+        """Rebuild the instance record against the (possibly re-granted)
+        primary lease before a resync re-put: instance ids == lease ids, so
+        a new lease id means a new instance id and a new KV key."""
+        if self.instance.instance_id != lease_id:
+            self.instance = dataclasses.replace(self.instance,
+                                                instance_id=lease_id)
+
     async def shutdown(self) -> None:
         drt = self.endpoint._drt
+        # untrack first so a racing coordinator resync can't re-announce a
+        # deliberately shut-down instance; park the key as pending-delete
+        # until the delete actually lands — shutting down mid-outage must
+        # not leave a ghost instance the (still-alive) primary lease would
+        # sustain forever after reconnect
+        drt._served.discard(self)
+        drt._pending_deletes.add(self.instance.etcd_key)
         try:
             await drt.coord.delete(self.instance.etcd_key)
+            drt._pending_deletes.discard(self.instance.etcd_key)
         except Exception:
-            pass
+            # the resync hook retries pending deletes — but a shutdown
+            # racing the TAIL of a resync (hooks already ran, connection
+            # not yet up) would wait a whole extra outage for the next
+            # one, so also retry as soon as the client reconnects
+            drt.runtime.spawn(_delete_when_connected(drt, self.instance.etcd_key),
+                              name=f"pending-delete-{self.instance.instance_id:x}")
         if drt.rpc_server is not None:
             drt.rpc_server.unregister(self._rpc_name)
 

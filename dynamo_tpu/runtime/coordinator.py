@@ -30,13 +30,16 @@ import asyncio
 import fnmatch
 import itertools
 import logging
+import os
+import random
 import time
 from collections import deque
 from dataclasses import dataclass, field
-from typing import Any, AsyncIterator, Callable, Dict, List, Optional, Tuple
+from typing import (Any, AsyncIterator, Awaitable, Callable, Dict, List,
+                    Optional, Tuple)
 
 from dynamo_tpu.runtime.codec import read_frame, send_frame, write_frame
-from dynamo_tpu.utils.aio import reap_task
+from dynamo_tpu.utils.aio import decorrelated_jitter, reap_task
 
 logger = logging.getLogger(__name__)
 
@@ -130,6 +133,11 @@ class Coordinator:
         self._queues: Dict[str, "deque[bytes]"] = {}
         self._queue_pulls: Dict[str, "deque[Tuple[_Conn, Any]]"] = {}
         self._ids = itertools.count(1)
+        # boot epoch: lets a resyncing client tell "same server, state
+        # intact" from "fresh/wiped server" — the id counter restarts on a
+        # real process restart, so a probed lease id may EXIST yet belong
+        # to another client's re-grant; epoch mismatch forces re-grants
+        self._epoch = random.getrandbits(63)
         self._server: Optional[asyncio.base_events.Server] = None
         self._lease_task: Optional[asyncio.Task] = None
         self._conns: set = set()
@@ -303,7 +311,8 @@ class Coordinator:
                              "pullers": len(self._queue_pulls.get(
                                  f["queue"], ()))})
         elif op == "ping":
-            await conn.send({"rid": rid, "ok": True, "time": time.time()})
+            await conn.send({"rid": rid, "ok": True, "time": time.time(),
+                             "epoch": self._epoch})
         else:
             await conn.send({"rid": rid, "ok": False, "error": f"unknown op {op!r}"})
 
@@ -477,14 +486,33 @@ class WatchEvent:
 
 
 class Watch:
-    """A live prefix watch: initial snapshot + async iterator of events."""
+    """A live prefix watch: initial snapshot + async iterator of events.
+
+    The watch survives coordinator reconnects: ``state`` tracks the
+    last-delivered view of the prefix, and on resync the client re-scans the
+    prefix and diffs against it, synthesizing put/delete events so consumers
+    see one consistent stream instead of EOF (see CoordClient._resync)."""
 
     def __init__(self, client: "CoordClient", watch_id: int,
-                 snapshot: List[Dict[str, Any]]):
+                 snapshot: List[Dict[str, Any]], prefix: str = ""):
         self._client = client
         self.watch_id = watch_id
+        self.prefix = prefix
         self.snapshot = [(i["key"], i["value"]) for i in snapshot]
+        # last-known view: key -> (value, lease_id); tuples are stored by
+        # identity so the resync grace pass can tell "unchanged since the
+        # outage" from "re-put with the same value"
+        self.state: Dict[str, Tuple[Optional[bytes], int]] = {
+            i["key"]: (i["value"], i.get("lease", 0)) for i in snapshot}
         self.queue: asyncio.Queue = asyncio.Queue()
+        self.cancelled = False
+
+    def _deliver(self, ev: WatchEvent) -> None:
+        if ev.type == "put":
+            self.state[ev.key] = (ev.value, ev.lease_id)
+        elif ev.type == "delete":
+            self.state.pop(ev.key, None)
+        self.queue.put_nowait(ev)
 
     def __aiter__(self) -> AsyncIterator[WatchEvent]:
         return self
@@ -496,16 +524,26 @@ class Watch:
         return ev
 
     async def cancel(self) -> None:
+        # flag first: a resync running concurrently must not resurrect
+        # this watch when it swaps in the re-registered id map
+        self.cancelled = True
         await self._client.unwatch(self.watch_id)
 
 
 class Subscription:
-    """A live pub/sub subscription: async iterator of (subject, payload)."""
+    """A live pub/sub subscription: async iterator of (subject, payload).
 
-    def __init__(self, client: "CoordClient", sub_id: int):
+    Remembers its subject/queue_group so the client can re-subscribe it
+    transparently after a coordinator reconnect."""
+
+    def __init__(self, client: "CoordClient", sub_id: int, subject: str = "",
+                 queue_group: Optional[str] = None):
         self._client = client
         self.sub_id = sub_id
+        self.subject = subject
+        self.queue_group = queue_group
         self.queue: asyncio.Queue = asyncio.Queue()
+        self.cancelled = False
 
     def __aiter__(self) -> "Subscription":
         return self
@@ -517,11 +555,25 @@ class Subscription:
         return item
 
     async def cancel(self) -> None:
+        self.cancelled = True  # see Watch.cancel
         await self._client.unsubscribe(self.sub_id)
 
 
 class Lease:
-    """Client-side lease handle with automatic keep-alive task."""
+    """Client-side lease handle with automatic keep-alive task.
+
+    Robustness contract (vs. the original fail-on-first-error loop):
+
+    - transient keep-alive failures retry within the TTL budget instead of
+      declaring the lease lost immediately;
+    - a coordinator disconnect parks the loop until the supervised client
+      reconnects — the resync protocol re-probes the lease and, if the
+      server no longer knows it, re-grants it under a NEW id, mutating
+      ``lease_id`` in place and firing ``on_relocated`` callbacks;
+    - ``lost`` fires only when the lease is genuinely unrecoverable: the
+      client closed (or gave up reconnecting), or keep-alives kept failing
+      past a full TTL while connected.
+    """
 
     def __init__(self, client: "CoordClient", lease_id: int, ttl: float):
         self.client = client
@@ -529,6 +581,30 @@ class Lease:
         self.ttl = ttl
         self._task: Optional[asyncio.Task] = None
         self.lost = asyncio.Event()
+        self._relocated_cbs: List[Callable[[int, int], None]] = []
+        self._last_ok = time.monotonic()
+
+    def on_relocated(self, cb: Callable[[int, int], None]) -> None:
+        """Register ``cb(old_id, new_id)``, fired when a coordinator resync
+        re-grants this lease under a fresh id."""
+        self._relocated_cbs.append(cb)
+
+    def _relocate(self, new_id: int) -> None:
+        old, self.lease_id = self.lease_id, new_id
+        self._last_ok = time.monotonic()
+        logger.info("lease %d relocated to %d by coordinator resync",
+                    old, new_id)
+        for cb in list(self._relocated_cbs):
+            try:
+                cb(old, new_id)
+            except Exception:
+                logger.exception("lease relocated callback failed")
+
+    def _mark_lost(self) -> None:
+        # deregister before signalling: a later resync must not re-grant a
+        # lease nobody keeps alive any more
+        self.client._lease_handles.discard(self)
+        self.lost.set()
 
     def start_keepalive(self) -> None:
         self._task = asyncio.create_task(self._keepalive_loop())
@@ -536,51 +612,245 @@ class Lease:
     async def _keepalive_loop(self) -> None:
         # (no CancelledError catch: see utils/aio.reap_task)
         interval = max(self.ttl / 3.0, 0.1)
+        retry_sleep = max(min(interval / 4.0, 0.25), 0.02)
+        self._last_ok = time.monotonic()
         while True:
             await asyncio.sleep(interval)
-            try:
-                await self.client.keepalive(self.lease_id)
-            except Exception:
-                logger.warning("lease %d keep-alive failed", self.lease_id)
-                self.lost.set()
-                return
+            while True:
+                if self.client.closed.is_set():
+                    self._mark_lost()
+                    return
+                if not self.client.connected:
+                    # outage: the resync protocol re-probes / re-grants this
+                    # lease as part of reconnecting, so just wait it out
+                    try:
+                        await self.client.wait_connected()
+                    except ConnectionError:
+                        logger.warning("lease %d lost: coordinator client "
+                                       "closed", self.lease_id)
+                        self._mark_lost()
+                        return
+                    self._last_ok = time.monotonic()
+                    break
+                try:
+                    # bounded: a half-open connection (blackholed but not
+                    # reset — read loop never errors) must not hang the RPC
+                    # forever, or the lease silently expires server-side
+                    # while this loop still believes it is healthy; a hang
+                    # lands in the TTL-budget branch below like any other
+                    # transient failure
+                    await asyncio.wait_for(
+                        self.client.keepalive(self.lease_id),
+                        timeout=interval)
+                    self._last_ok = time.monotonic()
+                    break
+                except ConnectionError:
+                    # the write side can fail before the read loop marks the
+                    # connection down; yield briefly so we land in the
+                    # disconnected branch above instead of spinning
+                    await asyncio.sleep(retry_sleep)
+                    continue
+                except Exception:
+                    # transient server-side refusal (e.g. "lease not found"
+                    # racing an in-flight relocation): retry inside the TTL
+                    # budget before giving the lease up for dead
+                    if time.monotonic() - self._last_ok >= self.ttl:
+                        logger.warning(
+                            "lease %d keep-alive failed past its %.1fs TTL "
+                            "budget; lost", self.lease_id, self.ttl)
+                        self._mark_lost()
+                        return
+                    await asyncio.sleep(retry_sleep)
 
     async def revoke(self) -> None:
         if self._task:
             self._task.cancel()
+        # deregister first so a concurrent resync can't resurrect it
+        self.client._lease_handles.discard(self)
         try:
             await self.client.revoke(self.lease_id)
         except Exception:
             pass
 
 
-class CoordClient:
-    """Async client for the Coordinator."""
+def replay_registry(client: Any, attr: str, factory: Callable[[], Any],
+                    replay: Callable[[Any], Awaitable[None]]) -> Any:
+    """Owner-replay registry cached on ``client`` under ``attr``, with ONE
+    resync hook replaying its contents after every reconnect.
 
-    def __init__(self, address: str):
+    Handles are constructed per call-site (kv buckets, model registrations),
+    so a hook per handle would accumulate on the client forever and replay
+    superseded state; a shared registry gives replace-not-accumulate
+    semantics. Only the first caller's ``replay`` is attached; ``client``
+    may be any duck-typed store — no hook on ones without resync support."""
+    reg = getattr(client, attr, None)
+    if reg is None:
+        reg = factory()
+        setattr(client, attr, reg)
+        if hasattr(client, "add_resync_hook"):
+            async def _replay_hook() -> None:
+                await replay(reg)
+
+            client.add_resync_hook(_replay_hook)
+    return reg
+
+
+class CoordClient:
+    """Async client for the Coordinator, with a supervised connection.
+
+    A coordinator crash/restart is transparent to consumers (parity with how
+    the reference's etcd/NATS clients survive server restarts):
+
+    - on disconnect, in-flight calls fail fast with ``ConnectionError`` but
+      watches, subscriptions and leases are KEPT; a background task retries
+      the connection with decorrelated-jitter backoff;
+    - on reconnect, a **resync protocol** runs: live leases are probed and
+      re-granted (new ids) where the server lost them, registered *resync
+      hooks* replay owner state (instance registrations, model cards,
+      barrier check-ins), every watch re-scans its prefix and diffs against
+      its last-known state to synthesize put/delete deltas, and event
+      subscriptions are re-established;
+    - ``closed`` now means *permanently* closed: ``close()`` was called, or
+      the reconnect give-up window (``DYN_COORD_RECONNECT_MAX_S``) elapsed.
+
+    Knobs (env, or constructor overrides): ``DYN_COORD_RECONNECT`` (0
+    disables supervision and restores fail-on-first-disconnect),
+    ``DYN_COORD_RECONNECT_BASE_S`` / ``_CAP_S`` (backoff),
+    ``DYN_COORD_RECONNECT_MAX_S`` (0 = retry forever) and
+    ``DYN_COORD_RESYNC_GRACE_S`` (stale-read window before a key missing
+    from the post-restart scan is reported deleted).
+    """
+
+    def __init__(self, address: str, reconnect: Optional[bool] = None,
+                 reconnect_base_s: Optional[float] = None,
+                 reconnect_cap_s: Optional[float] = None,
+                 reconnect_max_s: Optional[float] = None,
+                 resync_grace_s: Optional[float] = None,
+                 resync_timeout_s: Optional[float] = None):
         host, _, port = address.rpartition(":")
         self.host, self.port = host or "127.0.0.1", int(port)
+        env = os.environ.get
+        self.reconnect = (env("DYN_COORD_RECONNECT", "1").lower()
+                          not in ("0", "false", "no")
+                          if reconnect is None else reconnect)
+        self.reconnect_base_s = (float(env("DYN_COORD_RECONNECT_BASE_S",
+                                           "0.05"))
+                                 if reconnect_base_s is None
+                                 else reconnect_base_s)
+        self.reconnect_cap_s = (float(env("DYN_COORD_RECONNECT_CAP_S", "2.0"))
+                                if reconnect_cap_s is None else reconnect_cap_s)
+        self.reconnect_max_s = (float(env("DYN_COORD_RECONNECT_MAX_S", "0"))
+                                if reconnect_max_s is None else reconnect_max_s)
+        self.resync_grace_s = (float(env("DYN_COORD_RESYNC_GRACE_S", "5.0"))
+                               if resync_grace_s is None else resync_grace_s)
+        self.resync_timeout_s = (float(env("DYN_COORD_RESYNC_TIMEOUT_S",
+                                           "30.0"))
+                                 if resync_timeout_s is None
+                                 else resync_timeout_s)
         self._reader: Optional[asyncio.StreamReader] = None
         self._writer: Optional[asyncio.StreamWriter] = None
         self._rids = itertools.count(1)
         self._pending: Dict[int, asyncio.Future] = {}
         self._watches: Dict[int, Watch] = {}
         self._subs: Dict[int, Subscription] = {}
+        self._lease_handles: set = set()
+        self._resync_hooks: List[Callable] = []
         # events/messages that raced ahead of watch/subscription registration
         # (the server's response and a first event can share one TCP segment)
         self._orphan_events: Dict[int, list] = {}
         self._orphan_msgs: Dict[int, list] = {}
         self._reader_task: Optional[asyncio.Task] = None
+        self._reconnect_task: Optional[asyncio.Task] = None
+        self._resync_task: Optional[asyncio.Task] = None
+        # what the last resync attempt re-registered on the live connection;
+        # re-swept for late cancels once connected. Objects, not ids: a
+        # wiped server's restarted id counter reuses NUMBERS, so an id alone
+        # cannot say whose registration it names
+        self._resync_watch_objs: List[Watch] = []
+        self._resync_sub_objs: List[Subscription] = []
+        self._deferred: set = set()  # grace-delayed delete tasks
         self._wlock: Optional[asyncio.Lock] = None
+        self._connected = asyncio.Event()
+        self._closing = False
+        self._disconnected_at: Optional[float] = None
+        self._server_epoch: Optional[int] = None
+        self._conn_lost_flag = False  # current connection died (see below)
         self.closed = asyncio.Event()
+        # observability (exported via http/metrics.CoordClientMetrics)
+        self.reconnects_total = 0
+        self.resyncs_total = 0
+        self.last_outage_s = 0.0
+
+    # -- connection supervision --------------------------------------------
+
+    @property
+    def connected(self) -> bool:
+        return self._connected.is_set() and not self.closed.is_set()
+
+    async def wait_connected(self, timeout: Optional[float] = None) -> None:
+        """Block until the connection is up and resynced; raises
+        ``ConnectionError`` when the client is permanently closed (or on
+        ``timeout``)."""
+        if self.closed.is_set():
+            raise ConnectionError("coordinator client closed")
+        if self._connected.is_set():
+            return
+        conn = asyncio.ensure_future(self._connected.wait())
+        clo = asyncio.ensure_future(self.closed.wait())
+        try:
+            done, _ = await asyncio.wait({conn, clo}, timeout=timeout,
+                                         return_when=asyncio.FIRST_COMPLETED)
+            if conn in done:
+                return
+            if clo in done:
+                raise ConnectionError("coordinator client closed")
+            raise ConnectionError(
+                "timed out waiting for coordinator reconnect")
+        finally:
+            conn.cancel()
+            clo.cancel()
+
+    def add_resync_hook(self, hook: Callable) -> Callable:
+        """Register an async callable invoked after leases are re-established
+        on every reconnect, BEFORE watches are re-scanned — re-put owner
+        state (instance registrations, model cards, barrier keys) here so
+        the resync diff already sees it. Returns ``hook`` for symmetry with
+        ``remove_resync_hook``."""
+        self._resync_hooks.append(hook)
+        return hook
+
+    def remove_resync_hook(self, hook: Callable) -> None:
+        try:
+            self._resync_hooks.remove(hook)
+        except ValueError:
+            pass
 
     async def connect(self) -> "CoordClient":
         self._reader, self._writer = await asyncio.open_connection(self.host, self.port)
         self._wlock = asyncio.Lock()
-        self._reader_task = asyncio.create_task(self._read_loop())
+        self._connected.set()
+        self._reader_task = asyncio.create_task(self._read_loop(self._reader))
+        # baseline boot epoch: resync compares against it to tell a blipped
+        # server (state intact, probe leases) from a fresh one (re-grant)
+        try:
+            # bounded like resync: a server that accepts TCP but never
+            # answers must not hang startup forever
+            self._server_epoch = (await asyncio.wait_for(
+                self._call("ping"),
+                timeout=self.resync_timeout_s or None)).get("epoch")
+        except BaseException:
+            # a half-opened connection (server died mid-handshake) must not
+            # leave a background reconnect loop running on an object the
+            # caller is about to abandon — connect() either works or is void
+            await self.close()
+            raise
         return self
 
     async def close(self) -> None:
+        self._closing = True
+        if self._reconnect_task is not None:
+            await reap_task(self._reconnect_task)
+            self._reconnect_task = None
         await reap_task(self._reader_task)
         if self._writer:
             try:
@@ -588,7 +858,7 @@ class CoordClient:
                 await self._writer.wait_closed()
             except Exception:
                 pass
-        self.closed.set()
+        self._finalize_closed()
 
     async def __aenter__(self) -> "CoordClient":
         return await self.connect()
@@ -596,53 +866,356 @@ class CoordClient:
     async def __aexit__(self, *exc) -> None:
         await self.close()
 
-    async def _read_loop(self) -> None:
+    def _dispatch_frame(self, frame: Dict[str, Any]) -> None:
+        if "rid" in frame and frame["rid"] is not None:
+            fut = self._pending.pop(frame["rid"], None)
+            if fut and not fut.done():
+                fut.set_result(frame)
+        elif frame.get("evt") == "watch":
+            ev = WatchEvent(frame["type"], frame["key"],
+                            frame.get("value"), frame.get("lease", 0))
+            w = self._watches.get(frame["watch_id"])
+            if w:
+                w._deliver(ev)
+            else:
+                buf = self._orphan_events.setdefault(frame["watch_id"], [])
+                if len(buf) < 10_000:
+                    buf.append(ev)
+        elif frame.get("evt") == "msg":
+            item = (frame["subject"], frame["payload"])
+            s = self._subs.get(frame["sub_id"])
+            if s:
+                s.queue.put_nowait(item)
+            else:
+                buf = self._orphan_msgs.setdefault(frame["sub_id"], [])
+                if len(buf) < 10_000:
+                    buf.append(item)
+
+    async def _read_loop(self, reader: asyncio.StreamReader) -> None:
         try:
             while True:
-                frame = await read_frame(self._reader)
+                frame = await read_frame(reader)
                 if frame is None:
                     break
-                if "rid" in frame and frame["rid"] is not None:
-                    fut = self._pending.pop(frame["rid"], None)
-                    if fut and not fut.done():
-                        fut.set_result(frame)
-                elif frame.get("evt") == "watch":
-                    ev = WatchEvent(frame["type"], frame["key"],
-                                    frame.get("value"), frame.get("lease", 0))
-                    w = self._watches.get(frame["watch_id"])
-                    if w:
-                        w.queue.put_nowait(ev)
-                    else:
-                        buf = self._orphan_events.setdefault(frame["watch_id"], [])
-                        if len(buf) < 10_000:
-                            buf.append(ev)
-                elif frame.get("evt") == "msg":
-                    item = (frame["subject"], frame["payload"])
-                    s = self._subs.get(frame["sub_id"])
-                    if s:
-                        s.queue.put_nowait(item)
-                    else:
-                        buf = self._orphan_msgs.setdefault(frame["sub_id"], [])
-                        if len(buf) < 10_000:
-                            buf.append(item)
-        except ConnectionError:
+                self._dispatch_frame(frame)
+        except (ConnectionError, OSError):
             pass  # CancelledError must propagate (see utils/aio.reap_task)
         finally:
-            self.closed.set()
-            for fut in self._pending.values():
-                if not fut.done():
-                    fut.set_exception(ConnectionError("coordinator connection lost"))
-            self._pending.clear()
-            for w in self._watches.values():
-                w.queue.put_nowait(None)
-            for s in self._subs.values():
-                s.queue.put_nowait(None)
+            self._on_conn_lost(reader)
+
+    def _on_conn_lost(self, reader: asyncio.StreamReader) -> None:
+        if reader is not self._reader:
+            return  # a stale loop from a superseded connection
+        # a still-running reconnect task (below we early-return rather than
+        # double-supervise) must not declare success on this dead
+        # connection: it re-checks this flag after its resync completes
+        self._conn_lost_flag = True
+        self._connected.clear()
+        # in-flight calls fail fast (callers retry or surface the outage);
+        # orphan buffers are connection-scoped — clear them so events from a
+        # dead watch registration can't accumulate forever (nor leak into a
+        # reconnected session whose server assigns fresh ids)
+        for fut in self._pending.values():
+            if not fut.done():
+                fut.set_exception(
+                    ConnectionError("coordinator connection lost"))
+        self._pending.clear()
+        self._orphan_events.clear()
+        self._orphan_msgs.clear()
+        # registrations from the dead connection need no undo
+        self._resync_watch_objs = []
+        self._resync_sub_objs = []
+        # a pending grace-window delete must not fire while offline: no
+        # events arrive to refute it (the owner may have re-put the key on
+        # the server, invisibly to us), and the next resync re-diffs anyway
+        for t in list(self._deferred):
+            t.cancel()
+        if self._closing or not self.reconnect:
+            self._finalize_closed()
+            return
+        if self._reconnect_task is not None and not self._reconnect_task.done():
+            return  # supervision already running; it retries on its own
+        self._disconnected_at = time.monotonic()
+        logger.warning("coordinator connection %s:%d lost; reconnecting",
+                       self.host, self.port)
+        self._reconnect_task = asyncio.create_task(self._reconnect_loop())
+
+    def _finalize_closed(self) -> None:
+        """Permanent teardown: fail everything and end every iterator."""
+        if self.closed.is_set():
+            return
+        self.closed.set()
+        for fut in self._pending.values():
+            if not fut.done():
+                fut.set_exception(
+                    ConnectionError("coordinator connection lost"))
+        self._pending.clear()
+        for t in list(self._deferred):
+            t.cancel()
+        for w in self._watches.values():
+            w.queue.put_nowait(None)
+        for s in self._subs.values():
+            s.queue.put_nowait(None)
+
+    async def _reconnect_loop(self) -> None:
+        sleep_s = self.reconnect_base_s
+
+        def backoff() -> float:
+            # a fleet of clients must not stampede the restarted
+            # coordinator in lockstep (same helper as push_router failover)
+            return decorrelated_jitter(sleep_s, self.reconnect_base_s,
+                                       self.reconnect_cap_s)
+
+        while True:
+            if self._closing:
+                return
+            down_for = time.monotonic() - (self._disconnected_at
+                                           or time.monotonic())
+            if self.reconnect_max_s and down_for > self.reconnect_max_s:
+                logger.error(
+                    "giving up on coordinator %s:%d after %.1fs offline",
+                    self.host, self.port, down_for)
+                self._finalize_closed()
+                return
+            try:
+                # bounded attempt: a blackholed address must not park the
+                # loop for the kernel connect timeout (minutes) — backoff
+                # pacing and the give-up window only advance between tries
+                reader, writer = await asyncio.wait_for(
+                    asyncio.open_connection(self.host, self.port),
+                    timeout=max(self.reconnect_cap_s, 1.0))
+            except (OSError, asyncio.TimeoutError):
+                sleep_s = backoff()
+                await asyncio.sleep(sleep_s)
+                continue
+            old_writer, self._reader, self._writer = \
+                self._writer, reader, writer
+            self._conn_lost_flag = False  # tracking the NEW connection now
+            if old_writer is not None:
+                try:
+                    old_writer.close()
+                except Exception:
+                    pass
+            self._reader_task = asyncio.create_task(self._read_loop(reader))
+            try:
+                # bounded: a server that accepts the connection but never
+                # answers (frozen / blackholed half-open) must not park
+                # supervision forever — the give-up window is only checked
+                # between attempts. wait_for runs _resync in its OWN task,
+                # so _call's disconnected-fail-fast exemption tracks it.
+                # attempts, not completions: divergence from
+                # reconnects_total below is the retried-resync signal
+                self.resyncs_total += 1
+                self._resync_task = asyncio.ensure_future(self._resync())
+                try:
+                    await asyncio.wait_for(self._resync_task,
+                                           timeout=self.resync_timeout_s
+                                           or None)
+                finally:
+                    self._resync_task = None
+                if self._conn_lost_flag:
+                    # the connection died during resync, after answering the
+                    # last call — the read loop's _on_conn_lost deferred to
+                    # this (still-running) task, so the retry is on us:
+                    # declaring success would wedge the client forever
+                    raise ConnectionError("connection lost during resync")
+            except Exception as e:  # noqa: BLE001 — any resync failure
+                # (connection died again, server error) restarts supervision
+                logger.warning("coordinator resync failed (%s); retrying", e)
+                try:
+                    writer.close()
+                except Exception:
+                    pass
+                sleep_s = backoff()
+                await asyncio.sleep(sleep_s)
+                continue
+            outage = time.monotonic() - (self._disconnected_at
+                                         or time.monotonic())
+            self._disconnected_at = None
+            self.reconnects_total += 1
+            self.last_outage_s = outage
+            self._connected.set()
+            # re-sweep for cancels that raced the resync after its own
+            # cancelled-sweep passed: their unwatch RPC failed fast while
+            # the resync held the connection, but the registration is LIVE.
+            # Each object's current id was issued to IT on this connection,
+            # so this can never hit a sibling (unlike sweeping raw ids —
+            # a wiped server's restarted counter reuses numbers); an id the
+            # resync's own sweep already dropped errors harmlessly
+            for w in self._resync_watch_objs:
+                if w.cancelled:
+                    self._orphan_events.pop(w.watch_id, None)
+                    try:
+                        await self._call("unwatch", watch_id=w.watch_id)
+                    except Exception:
+                        pass
+            self._resync_watch_objs = []
+            for s in self._resync_sub_objs:
+                if s.cancelled:
+                    self._orphan_msgs.pop(s.sub_id, None)
+                    try:
+                        await self._call("unsubscribe", sub_id=s.sub_id)
+                    except Exception:
+                        pass
+            self._resync_sub_objs = []
+            logger.info(
+                "coordinator %s:%d reconnected after %.2fs outage; resynced "
+                "%d lease(s), %d watch(es), %d subscription(s), %d hook(s)",
+                self.host, self.port, outage, len(self._lease_handles),
+                len(self._watches), len(self._subs),
+                len(self._resync_hooks))
+            return
+
+    async def _resync(self) -> None:
+        """Rebuild server-side session state on a fresh connection.
+
+        Order matters: leases first (hooks attach keys to them), then the
+        resync hooks (owners re-put their state so the watch re-scan below
+        already includes it), then watches (prefix re-scan + diff against
+        each watch's last-known state), then subscriptions."""
+        # 0. boot epoch: a changed epoch means a fresh/wiped process whose
+        # restarted id counter may have RE-ISSUED our old lease ids to other
+        # clients — an existence probe would then adopt a foreign lease
+        # (and die with it when its real owner revokes). Same epoch means
+        # the server's state survived and probing is trustworthy.
+        epoch = (await self._call("ping")).get("epoch")
+        fresh_server = epoch != self._server_epoch
+        # 1. leases: probe-or-regrant. A lease that survived the outage
+        # (connection blip, or restart without state wipe within TTL) keeps
+        # its id — zero churn; one the server lost is re-granted under a
+        # fresh id and the handle relocates in place.
+        for lease in list(self._lease_handles):
+            if not fresh_server:
+                try:
+                    await self._call("keepalive", lease=lease.lease_id)
+                    continue
+                except ConnectionError:
+                    raise
+                except Exception:
+                    pass  # lease not found -> re-grant below
+            resp = await self._call("grant_lease", ttl=lease.ttl)
+            lease._relocate(int(resp["lease"]))
+        # only now: a retry after a partial lease pass must still see the
+        # epoch as fresh and re-grant the remainder
+        self._server_epoch = epoch
+        # 2. resync hooks: replay owner state under the fresh leases
+        for hook in list(self._resync_hooks):
+            try:
+                await hook()
+            except (ConnectionError, OSError):
+                raise
+            except Exception:
+                logger.exception("coordinator resync hook failed")
+        # 3. watches: re-register, then diff the fresh snapshot against the
+        # watcher's last-known state, synthesizing deltas. A state-wiped
+        # server restarts its id counter, so a fresh id routinely collides
+        # with a sibling's OLD id: detach the registry up front (events that
+        # arrive mid-registration park in the orphan buffer instead of
+        # hitting a stale same-id entry) and swap the new map in whole.
+        watches = [w for w in self._watches.values() if not w.cancelled]
+        self._watches = {}
+        scans = []
+        try:
+            for w in watches:
+                resp = await self._call("watch_prefix", prefix=w.prefix)
+                w.watch_id = int(resp["watch_id"])
+                scans.append(resp.get("items", []))
+        except BaseException:
+            # keep the watch set for the retry; ids from the failed attempt
+            # are dead and may collide, so key uniquely (the next attempt
+            # iterates values() and re-registers by prefix)
+            self._watches = {-i: w for i, w in enumerate(watches, 1)}
+            raise
+        self._watches = {w.watch_id: w for w in watches if not w.cancelled}
+        self._resync_watch_objs = watches  # for the post-connect re-sweep
+        for w, items in zip(watches, scans):
+            if w.cancelled:
+                continue
+            # diff first, then the live events that raced the registration
+            # (the server's response and a first event can share one TCP
+            # segment — same race watch_prefix() drains after registering)
+            self._resync_watch(w, items)
+            for ev in self._orphan_events.pop(w.watch_id, []):
+                w._deliver(ev)
+        for w in watches:
+            if w.cancelled:
+                # cancelled while this resync was re-registering it: the
+                # cancel's own unwatch went to the dead connection, so undo
+                # the fresh registration or the server streams the prefix
+                # into a dropped id forever
+                await self._call("unwatch", watch_id=w.watch_id)
+                self._orphan_events.pop(w.watch_id, None)
+        # 4. subscriptions: re-subscribe under fresh server-side ids (same
+        # detach/swap/drain dance as watches)
+        subs = [s for s in self._subs.values() if not s.cancelled]
+        self._subs = {}
+        try:
+            for s in subs:
+                resp = await self._call("subscribe", subject=s.subject,
+                                        queue_group=s.queue_group)
+                s.sub_id = int(resp["sub_id"])
+        except BaseException:
+            self._subs = {-i: s for i, s in enumerate(subs, 1)}
+            raise
+        self._subs = {s.sub_id: s for s in subs if not s.cancelled}
+        self._resync_sub_objs = subs  # for the post-connect re-sweep
+        # drain BEFORE the sweep's awaits: once the swap is live, new
+        # messages go straight to the queues, and a message orphaned during
+        # re-registration must not be delivered after one that arrived later
+        for s in self._subs.values():
+            for item in self._orphan_msgs.pop(s.sub_id, []):
+                s.queue.put_nowait(item)
+        for s in subs:
+            if s.cancelled:  # see the watch sweep above
+                await self._call("unsubscribe", sub_id=s.sub_id)
+                self._orphan_msgs.pop(s.sub_id, None)
+
+    def _resync_watch(self, w: Watch, items: List[Dict[str, Any]]) -> None:
+        new = {i["key"]: (i["value"], i.get("lease", 0)) for i in items}
+        old = dict(w.state)
+        for key in sorted(new):
+            value, lease_id = new[key]
+            prev = old.get(key)
+            if prev is None or prev[0] != value or prev[1] != lease_id:
+                w._deliver(WatchEvent("put", key, value, lease_id))
+        missing = {k: old[k] for k in old if k not in new}
+        if not missing:
+            return
+        if self.resync_grace_s <= 0:
+            for key, (_value, lease_id) in sorted(missing.items()):
+                w._deliver(WatchEvent("delete", key, None, lease_id))
+            return
+        # stale-read window: a key absent right after a restart is usually a
+        # peer that simply hasn't resynced yet (its re-put is racing ours) —
+        # report the delete only if it stays gone past the grace window, so
+        # consumers (instance discovery, model cards) never flap through
+        # empty during a restart
+        task = asyncio.create_task(self._deferred_deletes(w, missing))
+        self._deferred.add(task)
+        task.add_done_callback(self._deferred.discard)
+
+    async def _deferred_deletes(
+            self, w: Watch,
+            missing: Dict[str, Tuple[Optional[bytes], int]]) -> None:
+        await asyncio.sleep(self.resync_grace_s)
+        for key, stamp in sorted(missing.items()):
+            # identity check: a re-put (even of an equal value) stored a new
+            # tuple; only untouched-since-the-outage keys get the delete
+            if w.state.get(key) is stamp and self._watches.get(w.watch_id) is w:
+                w._deliver(WatchEvent("delete", key, None, stamp[1]))
 
     async def _call(self, op: str, **kw: Any) -> Dict[str, Any]:
         if self._writer is None:
             raise ConnectionError("not connected")
         if self.closed.is_set():
             raise ConnectionError("coordinator connection lost")
+        if (not self._connected.is_set()
+                and asyncio.current_task() is not self._resync_task):
+            # disconnected: fail fast so callers keep serving from cached
+            # state instead of hanging on a dead socket (the resync task
+            # itself is exempt — it runs before connected is set)
+            raise ConnectionError("coordinator disconnected "
+                                  "(reconnect in progress)")
         rid = next(self._rids)
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[rid] = fut
@@ -663,6 +1236,11 @@ class CoordClient:
             resp = fut.result()
         finally:
             closed_wait.cancel()
+            if not fut.done():
+                # cancelled from outside (e.g. a wait_for-bounded caller):
+                # drop the entry or a half-open connection accrues one per
+                # attempt; the read loop tolerates replies to unknown rids
+                self._pending.pop(rid, None)
         if not resp.get("ok"):
             raise RuntimeError(f"coordinator {op} failed: {resp.get('error')}")
         return resp
@@ -695,6 +1273,7 @@ class CoordClient:
     async def grant_lease(self, ttl: float = 10.0, keepalive: bool = True) -> Lease:
         resp = await self._call("grant_lease", ttl=ttl)
         lease = Lease(self, resp["lease"], resp["ttl"])
+        self._lease_handles.add(lease)  # resync re-probes / re-grants it
         if keepalive:
             lease.start_keepalive()
         return lease
@@ -709,18 +1288,25 @@ class CoordClient:
 
     async def watch_prefix(self, prefix: str) -> Watch:
         resp = await self._call("watch_prefix", prefix=prefix)
-        w = Watch(self, resp["watch_id"], resp.get("items", []))
+        w = Watch(self, resp["watch_id"], resp.get("items", []), prefix=prefix)
         self._watches[w.watch_id] = w
         # drain events that arrived between the server registering the watch
         # and us registering the Watch object (no await between these lines)
         for ev in self._orphan_events.pop(w.watch_id, []):
-            w.queue.put_nowait(ev)
+            w._deliver(ev)
         return w
 
     async def unwatch(self, watch_id: int) -> None:
         self._watches.pop(watch_id, None)
-        await self._call("unwatch", watch_id=watch_id)
         self._orphan_events.pop(watch_id, None)  # drop in-flight stragglers
+        try:
+            await self._call("unwatch", watch_id=watch_id)
+        except ConnectionError:
+            pass  # disconnected: the dead server session is gone anyway,
+            # and the resync protocol won't re-establish a popped watch; a
+            # cancel racing a mid-flight resync (which may already hold a
+            # LIVE registration for this watch) is undone by the
+            # post-connect re-sweep of _resync_watch_objs
 
     # -- pub/sub -----------------------------------------------------------
 
@@ -730,7 +1316,8 @@ class CoordClient:
     async def subscribe(self, subject: str,
                         queue_group: Optional[str] = None) -> Subscription:
         resp = await self._call("subscribe", subject=subject, queue_group=queue_group)
-        s = Subscription(self, resp["sub_id"])
+        s = Subscription(self, resp["sub_id"], subject=subject,
+                         queue_group=queue_group)
         self._subs[s.sub_id] = s
         for item in self._orphan_msgs.pop(s.sub_id, []):
             s.queue.put_nowait(item)
@@ -738,8 +1325,12 @@ class CoordClient:
 
     async def unsubscribe(self, sub_id: int) -> None:
         self._subs.pop(sub_id, None)
-        await self._call("unsubscribe", sub_id=sub_id)
         self._orphan_msgs.pop(sub_id, None)
+        try:
+            await self._call("unsubscribe", sub_id=sub_id)
+        except ConnectionError:
+            pass  # popped subs are not resynced; see unwatch for the
+            # mid-resync race the post-connect re-sweep covers
 
     # -- object store ------------------------------------------------------
     # (reference: NATS object store carrying model-card artifacts,
@@ -813,6 +1404,8 @@ class CoordClient:
         into an orphaned future."""
         if self._writer is None:
             raise ConnectionError("not connected")
+        if self.closed.is_set() or not self._connected.is_set():
+            raise ConnectionError("coordinator connection lost")
         rid = next(self._rids)
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[rid] = fut
@@ -864,6 +1457,42 @@ class CoordClient:
 
     async def ping(self) -> float:
         return (await self._call("ping"))["time"]
+
+
+def main() -> None:
+    """Standalone coordinator process (``python -m
+    dynamo_tpu.runtime.coordinator --port 6650``).
+
+    Running the control plane as its own process is what makes the
+    crash/restart drills in docs/deployment.md ("Control-plane outages")
+    real: kill -9 this and start a fresh one on the same port — every
+    supervised ``CoordClient`` reconnects and resyncs its state."""
+    import argparse
+
+    from dynamo_tpu.utils.logging import configure_logging
+
+    parser = argparse.ArgumentParser(description="dynamo_tpu coordinator")
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=6650)
+    args = parser.parse_args()
+    configure_logging()
+
+    async def _run() -> None:
+        coord = await Coordinator(host=args.host, port=args.port).start()
+        print(f"coordinator listening on {coord.address}", flush=True)
+        try:
+            await asyncio.Event().wait()  # serve until killed
+        finally:
+            await coord.stop()
+
+    try:
+        asyncio.run(_run())
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()
 
 
 __all__ = ["Coordinator", "CoordClient", "Watch", "WatchEvent", "Subscription",

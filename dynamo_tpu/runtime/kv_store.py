@@ -22,6 +22,7 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from dynamo_tpu.runtime import codec
+from dynamo_tpu.runtime.coordinator import replay_registry
 
 
 class KeyValueBucket:
@@ -101,19 +102,56 @@ class MemoryKeyValueStore(KeyValueStore):
 # ------------------------------------------------------------- coordinator
 
 
+class _ReplayRegistry(dict):
+    """key -> (expiry, raw envelope) this PROCESS wrote. An amortized sweep
+    on write keeps it bounded by LIVE entries even for TTL'd keys written
+    once and never read again (entries()'s lazy collection never sees
+    those)."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._puts = 0
+
+    def record(self, key: str, exp: float, raw: bytes) -> None:
+        self[key] = (exp, raw)
+        self._puts += 1
+        if self._puts >= max(64, len(self)):
+            self._puts = 0
+            now = time.time()
+            for k in [k for k, (e, _raw) in self.items() if e and e <= now]:
+                self.pop(k, None)
+
+
+def _replay_registry(coord) -> _ReplayRegistry:
+    """The client's resync-replay registry: entries re-put after a
+    coordinator restart (a state-wiped coordinator loses unleased keys
+    too). Writer-side ownership keeps replay conflict-free: each process
+    re-puts only what it wrote last."""
+    async def _replay(reg: _ReplayRegistry) -> None:
+        now = time.time()
+        for key, (exp, raw) in list(reg.items()):
+            if exp and exp <= now:
+                reg.pop(key, None)  # expired while we were away
+                continue
+            await coord.put(key, raw)
+
+    return replay_registry(coord, "_kvstore_replay", _ReplayRegistry, _replay)
+
+
 class _CoordBucket(KeyValueBucket):
     def __init__(self, coord, name: str, ttl: Optional[float]):
         self._coord = coord
         self._prefix = f"kvstore/{name}/"
         self.ttl = ttl
+        self._written = _replay_registry(coord)
 
-    def _wrap(self, value: bytes) -> bytes:
+    def _wrap(self, value: bytes) -> Tuple[float, bytes]:
         exp = (time.time() + self.ttl) if self.ttl else 0.0
         # the WRITER's ttl rides in the envelope: readers use it as the
         # collection grace window, so a no-TTL read handle can't collect
         # a just-expired entry out from under a racing re-put
-        return codec.pack({"e": exp, "v": bytes(value),
-                           "t": float(self.ttl or 0.0)})
+        return exp, codec.pack({"e": exp, "v": bytes(value),
+                                "t": float(self.ttl or 0.0)})
 
     def _unwrap(self, raw: bytes) -> Optional[bytes]:
         d = codec.unpack(raw)
@@ -122,7 +160,10 @@ class _CoordBucket(KeyValueBucket):
         return d["v"]
 
     async def put(self, key: str, value: bytes) -> None:
-        await self._coord.put(self._prefix + key, self._wrap(value))
+        exp, raw = self._wrap(value)
+        full = self._prefix + key
+        self._written.record(full, exp, raw)
+        await self._coord.put(full, raw)
 
     async def get(self, key: str) -> Optional[bytes]:
         raw = await self._coord.get(self._prefix + key)
@@ -135,6 +176,7 @@ class _CoordBucket(KeyValueBucket):
         return self._unwrap(raw)
 
     async def delete(self, key: str) -> bool:
+        self._written.pop(self._prefix + key, None)
         return (await self._coord.delete(self._prefix + key)) > 0
 
     async def entries(self) -> List[Tuple[str, bytes]]:
@@ -151,6 +193,7 @@ class _CoordBucket(KeyValueBucket):
                 # rewritten the envelope, so the delete-vs-put race is
                 # confined to entries dead for >= 2x their TTL
                 if d["e"] + grace <= time.time():
+                    self._written.pop(k, None)
                     await self._coord.delete(k)
                 continue
             out.append((k[len(self._prefix):], d["v"]))

@@ -16,6 +16,7 @@ import time
 from typing import Any, AsyncIterator, Dict, Optional
 
 from dynamo_tpu.runtime.client import Client
+from dynamo_tpu.utils.aio import decorrelated_jitter
 from dynamo_tpu.runtime.rpc import (
     DEADLINE_HEADER,
     DeadlineExceededError,
@@ -88,12 +89,8 @@ class PushRouter:
                 if instance_id is not None:
                     break  # caller pinned the instance; don't fail over silently
                 if attempt + 1 < attempts and self.backoff_base_s > 0:
-                    # decorrelated jitter: each sleep is uniform between the
-                    # base and 3x the previous sleep, capped — retries from
-                    # many callers spread out instead of arriving in lockstep
-                    sleep_s = min(self.backoff_cap_s,
-                                  random.uniform(self.backoff_base_s,
-                                                 sleep_s * 3))
+                    sleep_s = decorrelated_jitter(
+                        sleep_s, self.backoff_base_s, self.backoff_cap_s)
                     if deadline is not None:
                         sleep_s = min(sleep_s, max(0.0, deadline - time.time()))
                     await asyncio.sleep(sleep_s)

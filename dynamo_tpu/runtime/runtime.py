@@ -93,6 +93,15 @@ class DistributedRuntime:
         # serialize lazy init: concurrent serve() calls must share one lease
         # and one RpcServer
         self._init_lock = asyncio.Lock()
+        # live ServedEndpoints, re-announced on coordinator resync: a
+        # restarted (possibly state-wiped) coordinator learns every
+        # instance again under the re-established primary lease
+        self._served: set = set()
+        # instance keys whose shutdown-time delete failed (outage in
+        # progress); retried by the resync hook AFTER the re-puts, so a
+        # shutdown racing the hook's _served snapshot still wins
+        self._pending_deletes: set = set()
+        coord.add_resync_hook(self._resync_registrations)
 
     @classmethod
     async def create(cls, coordinator: str = DEFAULT_COORDINATOR,
@@ -160,6 +169,39 @@ class DistributedRuntime:
     async def _watch_lease(self, lease: Lease) -> None:
         await lease.lost.wait()
         raise ConnectionError("primary lease lost")
+
+    async def _resync_registrations(self) -> None:
+        """Coordinator resync hook: re-announce every served endpoint.
+
+        The primary lease may have been re-granted under a NEW id during the
+        resync, and instance ids == lease ids — so each instance record is
+        rebuilt against the current lease before the re-put. Clients absorb
+        the id churn through their watches (put of the new key now; the old
+        key's delete after the stale-read grace window)."""
+        lease = self._primary_lease
+        if lease is not None:
+            for se in list(self._served):
+                if se not in self._served:
+                    continue  # shut down while we iterated; its own delete
+                    # (or _pending_deletes) targets the pre-relocation key
+                se._reannounce(lease.lease_id)
+                await self.coord.put(se.instance.etcd_key,
+                                     se.instance.to_json(),
+                                     lease_id=lease.lease_id)
+                if se not in self._served:
+                    # shutdown raced the put: it parked the OLD key, but we
+                    # just re-announced under the relocated id — park the
+                    # NEW key too or the live lease sustains a ghost forever
+                    self._pending_deletes.add(se.instance.etcd_key)
+                    continue
+                logger.info("re-registered %s as instance %x after "
+                            "coordinator resync", se.endpoint.path,
+                            se.instance.instance_id)
+        # deletes LAST: a shutdown that raced the snapshot above (or whose
+        # delete failed mid-outage) must not leave its ghost behind
+        for key in list(self._pending_deletes):
+            await self.coord.delete(key)
+            self._pending_deletes.discard(key)
 
     # -- typed event bus ---------------------------------------------------
 

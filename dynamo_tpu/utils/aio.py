@@ -3,7 +3,15 @@
 from __future__ import annotations
 
 import asyncio
+import random
 from typing import Optional
+
+
+def decorrelated_jitter(prev_s: float, base_s: float, cap_s: float) -> float:
+    """Next backoff sleep: uniform between the base and 3x the previous
+    sleep, capped — retries from many callers spread out instead of
+    arriving at the recovering server in lockstep."""
+    return min(cap_s, random.uniform(base_s, max(prev_s, base_s) * 3))
 
 
 async def reap_task(task: Optional[asyncio.Task]) -> None:

@@ -15,12 +15,20 @@ Scenarios become deterministic: point the client at ``proxy.address`` instead
 of the worker's own, then flip faults mid-stream.  Parity in intent with the
 reference's fault-tolerance suite (``tests/fault_tolerance/``), which kills
 processes; this adds the fault class process-kills can't express.
+
+``CoordinatorOutage`` is the control-plane sibling: kill an in-process
+``Coordinator`` abruptly (clients see a hard TCP close, like ``kill -9``) and
+relisten on the SAME port, with or without a state wipe — so chaos tests can
+exercise both a blipped connection (state intact, leases still ticking) and a
+fresh empty coordinator (the real crash/restart, everything to resync).
 """
 
 from __future__ import annotations
 
 import asyncio
+import itertools
 import logging
+import random
 from typing import Optional, Set
 
 from dynamo_tpu.utils.aio import reap_task
@@ -137,4 +145,61 @@ class ChaosProxy:
                 pass
 
 
-__all__ = ["ChaosProxy"]
+class CoordinatorOutage:
+    """Kill-and-relisten harness around an in-process ``Coordinator``.
+
+    ``kill()`` tears the server down abruptly (live connections get a hard
+    close — what a ``kill -9`` looks like from the client side) while
+    remembering the bound port; ``restart(wipe_state=...)`` re-binds the
+    same port, optionally after wiping every piece of server state (KV,
+    leases, watches, subscriptions, queues) to model a fresh process.
+    """
+
+    def __init__(self, coordinator):
+        self.coordinator = coordinator
+        self.outages = 0
+
+    @property
+    def address(self) -> str:
+        return self.coordinator.address
+
+    async def kill(self) -> None:
+        """Stop serving; the port stays reserved for ``restart()``."""
+        await self.coordinator.stop()
+        self.outages += 1
+        logger.info("coordinator %s killed (outage #%d)",
+                    self.coordinator.address, self.outages)
+
+    async def restart(self, wipe_state: bool = True) -> None:
+        """Relisten on the same host:port; ``wipe_state=True`` models a
+        crashed-and-respawned coordinator (empty KV, no leases), False a
+        supervisor restart that kept state in memory."""
+        c = self.coordinator
+        if wipe_state:
+            c._kv.clear()
+            c._leases.clear()
+            c._watches.clear()
+            c._subs_exact.clear()
+            c._subs_wild.clear()
+            c._queue_rr.clear()
+            c._queues.clear()
+            c._queue_pulls.clear()
+            # a genuinely fresh process restarts the id counter at 1, so
+            # fresh watch/sub/lease ids COLLIDE with pre-outage ids —
+            # resync code must survive that, so the drill reproduces it
+            c._ids = itertools.count(1)
+            c._epoch = random.getrandbits(63)  # new process, new boot epoch
+        await c.start()
+        logger.info("coordinator restarted on %s (state %s)", c.address,
+                    "wiped" if wipe_state else "kept")
+
+    async def blip(self, downtime_s: float = 0.0,
+                   wipe_state: bool = True) -> None:
+        """kill -> (optional dwell) -> restart, one call."""
+        await self.kill()
+        if downtime_s > 0:
+            await asyncio.sleep(downtime_s)
+        await self.restart(wipe_state=wipe_state)
+
+
+__all__ = ["ChaosProxy", "CoordinatorOutage"]

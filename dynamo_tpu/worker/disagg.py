@@ -119,7 +119,18 @@ class PrefillQueueWorker:
             try:
                 pulled = await self.drt.coord.queue_pull(queue)
             except ConnectionError:
-                return  # coordinator gone; runtime shutdown handles the rest
+                # coordinator outage: park until the supervised client
+                # reconnects (a queued pull doesn't survive the server's
+                # session, so just re-issue it), or exit on permanent close
+                try:
+                    await self.drt.coord.wait_connected()
+                except ConnectionError:
+                    return  # gone for good; runtime shutdown handles the rest
+                # the write side can fail before the read loop marks the
+                # connection down, making wait_connected return immediately;
+                # yield briefly so the retry can't hot-spin
+                await asyncio.sleep(0.05)
+                continue
             if pulled is None:
                 continue
             raw, age_s = pulled

@@ -197,3 +197,304 @@ async def test_object_store_lease_expiry():
             assert await c.obj_get("b", "o") == b"x" * 100
             await asyncio.sleep(1.5)
             assert await c.obj_get("b", "o") is None
+
+
+# -- supervised reconnect + resync (control-plane outage survival) -----------
+
+from dynamo_tpu.utils.faults import CoordinatorOutage  # noqa: E402
+
+
+async def test_reconnect_after_blip_keeps_kv_and_lease():
+    """A kill/relisten WITHOUT state wipe is invisible: same lease id, keys
+    intact, calls resume."""
+    coord = await Coordinator(port=0).start()
+    outage = CoordinatorOutage(coord)
+    try:
+        async with CoordClient(coord.address,
+                               reconnect_base_s=0.02) as c:
+            lease = await c.grant_lease(ttl=5.0)
+            before = lease.lease_id
+            await c.put("k", b"v", lease_id=lease.lease_id)
+            await outage.blip(downtime_s=0.2, wipe_state=False)
+            await c.wait_connected(timeout=10)
+            assert c.reconnects_total == 1
+            assert lease.lease_id == before  # lease survived server-side
+            assert not lease.lost.is_set()
+            assert await c.get("k") == b"v"
+    finally:
+        await coord.stop()
+
+
+async def test_calls_fail_fast_while_disconnected():
+    coord = await Coordinator(port=0).start()
+    outage = CoordinatorOutage(coord)
+    try:
+        async with CoordClient(coord.address) as c:
+            await c.put("k", b"v")
+            await outage.kill()
+            await asyncio.sleep(0.1)
+            t0 = asyncio.get_running_loop().time()
+            with pytest.raises(ConnectionError):
+                await c.get("k")
+            assert asyncio.get_running_loop().time() - t0 < 1.0
+            assert not c.closed.is_set()  # disconnected, not dead
+            await outage.restart(wipe_state=False)
+            await c.wait_connected(timeout=10)
+            assert await c.get("k") == b"v"
+    finally:
+        await coord.stop()
+
+
+async def test_lease_relocated_on_wiped_restart():
+    """A state-wiped restart re-grants lost leases under NEW ids and fires
+    the relocated callbacks; keys re-put by resync hooks ride the new id."""
+    coord = await Coordinator(port=0).start()
+    outage = CoordinatorOutage(coord)
+    try:
+        async with CoordClient(coord.address,
+                               reconnect_base_s=0.02) as c:
+            lease = await c.grant_lease(ttl=2.0)
+            old = lease.lease_id
+            moves = []
+            lease.on_relocated(lambda o, n: moves.append((o, n)))
+
+            async def republish():
+                await c.put("inst", b"v", lease_id=lease.lease_id)
+
+            c.add_resync_hook(republish)
+            await republish()
+            await outage.blip(downtime_s=0.1)
+            await c.wait_connected(timeout=10)
+            # re-granted under a fresh server-side grant; the NUMBER may
+            # even repeat (a fresh process restarts its id counter)
+            assert moves == [(old, lease.lease_id)]
+            assert not lease.lost.is_set()
+            assert await c.get("inst") == b"v"
+            # the re-put key is attached to the NEW lease: keepalive sustains
+            # it past the original TTL
+            await asyncio.sleep(2.5)
+            assert await c.get("inst") == b"v"
+    finally:
+        await coord.stop()
+
+
+async def test_watch_resync_synthesizes_put_and_delete_deltas():
+    """Across a wiped restart a watcher sees one consistent stream: a put for
+    the re-registered key (new lease id) and a delete for the old key after
+    the stale-read grace window — never an EOF."""
+    coord = await Coordinator(port=0).start()
+    outage = CoordinatorOutage(coord)
+    owner = await CoordClient(coord.address, reconnect_base_s=0.02).connect()
+    watcher = await CoordClient(coord.address, reconnect_base_s=0.02,
+                                resync_grace_s=0.3).connect()
+    try:
+        # burn server ids so the re-granted lease cannot numerically collide
+        # with the original (a fresh process restarts its counter at 1, and
+        # an id-reuse re-grant would make new_key == old_key: correctly NO
+        # deltas — but this test is about observing them)
+        for _ in range(5):
+            await (await owner.grant_lease(ttl=5.0, keepalive=False)).revoke()
+        lease = await owner.grant_lease(ttl=2.0)
+        old_key = f"inst/w:{lease.lease_id:x}"
+        await owner.put(old_key, b"v", lease_id=lease.lease_id)
+
+        async def republish():
+            await owner.put(f"inst/w:{lease.lease_id:x}", b"v",
+                            lease_id=lease.lease_id)
+
+        owner.add_resync_hook(republish)
+        w = await watcher.watch_prefix("inst/")
+        assert w.snapshot == [(old_key, b"v")]
+
+        await outage.blip(downtime_s=0.1)
+        await owner.wait_connected(timeout=10)
+        await watcher.wait_connected(timeout=10)
+
+        evs = []
+        while len(evs) < 2:
+            evs.append(await asyncio.wait_for(w.__anext__(), timeout=5))
+        new_key = f"inst/w:{lease.lease_id:x}"
+        assert [(e.type, e.key) for e in evs] == [
+            ("put", new_key), ("delete", old_key)]
+    finally:
+        await owner.close()
+        await watcher.close()
+        await coord.stop()
+
+
+async def test_watch_resync_unchanged_keys_stay_silent():
+    """A blip with state KEPT synthesizes nothing: the re-scan matches the
+    watcher's last-known state exactly."""
+    coord = await Coordinator(port=0).start()
+    outage = CoordinatorOutage(coord)
+    try:
+        async with CoordClient(coord.address, reconnect_base_s=0.02,
+                               resync_grace_s=0.2) as c:
+            await c.put("s/a", b"1")
+            w = await c.watch_prefix("s/")
+            await outage.blip(downtime_s=0.1, wipe_state=False)
+            await c.wait_connected(timeout=10)
+            await asyncio.sleep(0.5)  # past the grace window
+            assert w.queue.empty()
+            # the re-established watch is live: new puts stream through
+            await c.put("s/b", b"2")
+            ev = await asyncio.wait_for(w.__anext__(), timeout=5)
+            assert (ev.type, ev.key) == ("put", "s/b")
+    finally:
+        await coord.stop()
+
+
+async def test_subscription_survives_restart():
+    coord = await Coordinator(port=0).start()
+    outage = CoordinatorOutage(coord)
+    try:
+        async with CoordClient(coord.address, reconnect_base_s=0.02) as a, \
+                CoordClient(coord.address, reconnect_base_s=0.02) as b:
+            sub = await b.subscribe("ev.>")
+            await outage.blip(downtime_s=0.1)
+            await a.wait_connected(timeout=10)
+            await b.wait_connected(timeout=10)
+            assert await a.publish("ev.x", b"p") == 1
+            subject, payload = await asyncio.wait_for(sub.__anext__(),
+                                                      timeout=5)
+            assert (subject, payload) == ("ev.x", b"p")
+    finally:
+        await coord.stop()
+
+
+async def test_keepalive_retries_transient_failure_within_ttl():
+    """A server-side keep-alive refusal is retried inside the TTL budget; the
+    lease is declared lost only when refusals persist past a full TTL."""
+    coord = await Coordinator(port=0).start()
+    try:
+        async with CoordClient(coord.address) as c:
+            lease = await c.grant_lease(ttl=1.0)
+            # revoke server-side only: every subsequent keepalive gets
+            # "lease not found" — a persistent refusal
+            await c.revoke(lease.lease_id)
+            t0 = asyncio.get_running_loop().time()
+            await asyncio.wait_for(lease.lost.wait(), timeout=10)
+            elapsed = asyncio.get_running_loop().time() - t0
+            # not lost on the FIRST failed ping (~ttl/3), only after the
+            # budget: at least one retry window elapsed
+            assert elapsed >= 0.9, elapsed
+    finally:
+        await coord.stop()
+
+
+async def test_orphan_buffers_cleared_on_disconnect():
+    coord = await Coordinator(port=0).start()
+    outage = CoordinatorOutage(coord)
+    try:
+        async with CoordClient(coord.address, reconnect_base_s=0.02) as c:
+            # orphans parked under server ids from the CURRENT session must
+            # not leak into the next one (fresh server assigns fresh ids)
+            c._orphan_events[12345] = [object()]
+            c._orphan_msgs[54321] = [("s", b"p")]
+            await outage.blip(downtime_s=0.1)
+            await c.wait_connected(timeout=10)
+            assert not c._orphan_events
+            assert not c._orphan_msgs
+    finally:
+        await coord.stop()
+
+
+async def test_reconnect_disabled_restores_fail_fast():
+    """reconnect=False keeps the PR-2 semantics: first disconnect closes the
+    client, ends watch iterators, and marks leases lost."""
+    coord = await Coordinator(port=0).start()
+    try:
+        c = await CoordClient(coord.address, reconnect=False).connect()
+        lease = await c.grant_lease(ttl=5.0)
+        w = await c.watch_prefix("z/")
+        await coord.stop()
+        await asyncio.wait_for(c.closed.wait(), timeout=5)
+        with pytest.raises(StopAsyncIteration):
+            await asyncio.wait_for(w.__anext__(), timeout=5)
+        await asyncio.wait_for(lease.lost.wait(), timeout=5)
+        await c.close()
+    finally:
+        await coord.stop()
+
+
+async def test_reconnect_gives_up_after_max_window():
+    coord = await Coordinator(port=0).start()
+    try:
+        c = await CoordClient(coord.address, reconnect_base_s=0.02,
+                              reconnect_max_s=0.5).connect()
+        lease = await c.grant_lease(ttl=5.0)
+        await coord.stop()  # never restarted
+        await asyncio.wait_for(c.closed.wait(), timeout=10)
+        await asyncio.wait_for(lease.lost.wait(), timeout=5)
+        await c.close()
+    finally:
+        await coord.stop()
+
+async def test_wiped_restart_reuses_ids_without_clobbering_watches():
+    """A fresh coordinator process restarts its id counter at 1, so
+    re-registered watches/subs get ids that COLLIDE with pre-outage ids of
+    their siblings. Every watch must still deliver after the resync (an
+    in-place id remap would silently clobber one)."""
+    coord = await Coordinator(port=0).start()
+    outage = CoordinatorOutage(coord)
+    try:
+        async with CoordClient(coord.address, reconnect_base_s=0.02,
+                               resync_grace_s=0.1) as c:
+            # watches take ids 1..3, the lease 4: on resync the lease is
+            # re-granted FIRST (taking id 1), shifting each watch's fresh
+            # id onto its NEXT sibling's old id — the clobber direction an
+            # in-place pop/insert remap gets wrong
+            ws = [await c.watch_prefix(f"p{i}/") for i in range(3)]
+            lease = await c.grant_lease(ttl=5.0)
+            sub = await c.subscribe("ev.>")
+            old_ids = [w.watch_id for w in ws]
+            await outage.blip(downtime_s=0.1, wipe_state=True)
+            await c.wait_connected(timeout=10)
+            assert not lease.lost.is_set()
+            # new ids overlap the old range — the collision case is real
+            assert set(w.watch_id for w in ws) & set(old_ids)
+            for i, w in enumerate(ws):
+                await c.put(f"p{i}/k", b"v")
+                ev = await asyncio.wait_for(w.__anext__(), timeout=5)
+                assert (ev.type, ev.key) == ("put", f"p{i}/k"), i
+            assert await c.publish("ev.x", b"m") == 1
+            assert await asyncio.wait_for(
+                sub.__anext__(), timeout=5) == ("ev.x", b"m")
+    finally:
+        await coord.stop()
+
+async def test_wiped_restart_does_not_adopt_foreign_lease():
+    """After a wiped restart, the server's restarted id counter can hand a
+    NEW client's lease the same number an old client held. The old client's
+    resync must detect the fresh boot epoch and re-grant unconditionally —
+    an existence probe would adopt the foreign lease and die with it when
+    its real owner revokes."""
+    coord = await Coordinator(port=0).start()
+    outage = CoordinatorOutage(coord)
+    a = b = None
+    try:
+        a = await CoordClient(coord.address, reconnect_base_s=0.5,
+                              reconnect_cap_s=0.6).connect()
+        la = await a.grant_lease(ttl=5.0)
+        old = la.lease_id
+        await outage.kill()
+        await asyncio.sleep(0.2)  # a's first attempt fails; it backs off
+        await outage.restart(wipe_state=True)
+        # a fresh client wins the post-restart race and is granted the
+        # SAME numeric id the old server had given `a`
+        b = await CoordClient(coord.address).connect()
+        lb = await b.grant_lease(ttl=5.0)
+        assert lb.lease_id == old  # precondition: the collision is real
+        await a.wait_connected(timeout=10)
+        assert la.lease_id != lb.lease_id  # re-granted, not adopted
+        # b revoking ITS lease must not tear down a's state
+        await a.put("ka", b"v", lease_id=la.lease_id)
+        await lb.revoke()
+        await asyncio.sleep(0.1)
+        assert await a.get("ka") == b"v"
+        assert not la.lost.is_set()
+    finally:
+        for c in (a, b):
+            if c is not None:
+                await c.close()
+        await coord.stop()

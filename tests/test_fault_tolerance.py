@@ -35,6 +35,7 @@ from dynamo_tpu.runtime.barrier import (
 from dynamo_tpu.runtime.coordinator import Coordinator
 from dynamo_tpu.runtime.push_router import PushRouter, RouterMode
 from dynamo_tpu.runtime.runtime import DistributedRuntime
+from dynamo_tpu.utils.faults import CoordinatorOutage
 from dynamo_tpu.utils.testing import make_test_card
 
 
@@ -153,17 +154,37 @@ class TestLeaseExpiry:
 
 
 class TestCoordinatorDeath:
-    async def test_worker_shuts_down_on_lost_lease(self):
+    async def test_worker_shuts_down_after_reconnect_giveup(self, monkeypatch):
+        """A coordinator that never comes back still fences the worker — but
+        only after the reconnect give-up window, not on the first failed
+        keepalive (the supervised client survives transient outages)."""
+        monkeypatch.setenv("DYN_COORD_RECONNECT_MAX_S", "0.5")
         coord = await Coordinator(port=0).start()
         w, _e = await start_slow_worker(coord.address)
         assert not w.runtime.is_shutdown
-        await coord.stop()  # coordinator gone: keepalive fails -> lease lost
+        await coord.stop()  # gone for good: give-up -> lease lost -> shutdown
         for _ in range(150):
             if w.runtime.is_shutdown:
                 break
             await asyncio.sleep(0.1)
         assert w.runtime.is_shutdown
         await w.close()
+
+    async def test_worker_survives_outage_with_reconnect(self):
+        """With supervision on (the default), a blipped coordinator does NOT
+        kill the worker: the lease parks during the outage and resyncs."""
+        coord = await Coordinator(port=0).start()
+        outage = CoordinatorOutage(coord)
+        try:
+            w, _e = await start_slow_worker(coord.address)
+            await outage.blip(downtime_s=0.3, wipe_state=True)
+            await w.coord.wait_connected(timeout=10)
+            await asyncio.sleep(0.5)  # room for a post-resync keepalive beat
+            assert not w.runtime.is_shutdown
+            assert w.coord.reconnects_total == 1
+            await w.close()
+        finally:
+            await coord.stop()
 
 
 class TestBarrier:
@@ -440,3 +461,165 @@ class TestOverloadShedding:
                     await rc.content.read()
         finally:
             await service.stop()
+
+
+# ---------------------------------------------------------------------------
+# Control-plane outage survival: coordinator killed and restarted (state
+# wiped) mid-serve.  Fault injection via utils/faults.CoordinatorOutage —
+# clients see a hard TCP close, then the same port comes back empty.
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+class TestCoordinatorOutageMidServe:
+    async def test_requests_survive_outage_and_discovery_converges(
+            self, monkeypatch):
+        """kill -9 the coordinator mid-stream, restart it with EMPTY state:
+        the in-flight request completes from cached instances (zero
+        failures), and after the restart the worker is re-registered under
+        its new lease id and the client's view converges to exactly that
+        instance — at which point fresh requests route normally."""
+        monkeypatch.setenv("DYN_COORD_RESYNC_GRACE_S", "0.5")
+        coord = await Coordinator(port=0).start()
+        outage = CoordinatorOutage(coord)
+        drts = []
+        try:
+            w, _e = await start_slow_worker(coord.address, decode_s=0.03)
+            drts.append(w)
+            fe = await DistributedRuntime.create(coordinator=coord.address)
+            drts.append(fe)
+            client = await (fe.namespace("ns").component("w")
+                            .endpoint("generate").client())
+            await client.wait_for_instances(1, timeout=10)
+            [old_id] = client.instance_ids()
+            card = make_test_card(name="m", kv_cache_block_size=4)
+            pipeline = RemotePipeline(card, PushRouter(client),
+                                      migration_limit=3)
+
+            # stream a request; kill the coordinator a few tokens in and
+            # restart it (wiped) while tokens are still flowing
+            req = make_req(range(1, 10), "r1", max_tokens=30)
+            frames = []
+            restarted = False
+            async for out in pipeline.engine_stream(req):
+                frames.append(out)
+                n = sum(len(f.token_ids) for f in frames)
+                if n >= 5 and outage.outages == 0:
+                    await outage.kill()
+                elif n >= 10 and outage.outages == 1 and not restarted:
+                    restarted = True
+                    await outage.restart(wipe_state=True)
+            toks = [t for f in frames for t in f.token_ids]
+            assert len(toks) == 30  # completed across the outage, no error
+            assert frames[-1].finish_reason == FinishReason.LENGTH
+
+            # both sides reconnected + resynced
+            await w.coord.wait_connected(timeout=10)
+            await fe.coord.wait_connected(timeout=10)
+            assert fe.coord.reconnects_total >= 1
+            assert w.coord.reconnects_total >= 1
+
+            # worker re-registered under the re-granted lease (ids == lease
+            # ids; a fresh coordinator restarts its counter, so the number
+            # may repeat OR churn depending on re-grant race order); the
+            # client converges to exactly the re-registered instance
+            new_id = (await w.primary_lease()).lease_id
+            for _ in range(150):
+                if client.instance_ids() == [new_id]:
+                    break
+                await asyncio.sleep(0.05)
+            assert client.instance_ids() == [new_id]
+
+            # the recovered control plane routes fresh requests
+            req2 = make_req(range(1, 8), "r2", max_tokens=10)
+            toks2 = [t async for f in pipeline.engine_stream(req2)
+                     for t in f.token_ids]
+            assert len(toks2) == 10
+        finally:
+            for d in drts:
+                try:
+                    await d.close()
+                except Exception:
+                    pass
+            await coord.stop()
+
+    async def test_model_card_watch_recovers_after_wiped_restart(
+            self, monkeypatch):
+        """A frontend's models/ watch keeps delivering across a state-wiped
+        restart: register_llm's resync hook re-publishes the card and the
+        watch re-scan synthesizes the put for the new models/ key."""
+        monkeypatch.setenv("DYN_COORD_RESYNC_GRACE_S", "0.3")
+        coord = await Coordinator(port=0).start()
+        outage = CoordinatorOutage(coord)
+        drts = []
+        try:
+            w, _e = await start_slow_worker(coord.address, name="mm")
+            drts.append(w)
+            fe = await DistributedRuntime.create(coordinator=coord.address)
+            drts.append(fe)
+            from dynamo_tpu.llm.model_manager import (
+                MODEL_ROOT_PREFIX,
+                ModelManager,
+                ModelWatcher,
+            )
+            manager = ModelManager()
+            watcher = ModelWatcher(fe, manager)
+            await watcher.start()
+            assert "mm" in manager.names()
+
+            await outage.blip(downtime_s=0.2, wipe_state=True)
+            await w.coord.wait_connected(timeout=10)
+            await fe.coord.wait_connected(timeout=10)
+
+            # the card rode the worker's (re-granted) primary lease: a fresh
+            # key appears via the resynced watch and the manager keeps (or
+            # re-learns) the model without ever dropping a request on a
+            # missing model
+            for _ in range(100):
+                entries = await fe.coord.get_prefix(MODEL_ROOT_PREFIX)
+                if entries and "mm" in manager.names():
+                    break
+                await asyncio.sleep(0.05)
+            assert "mm" in manager.names()
+            entries = await fe.coord.get_prefix(MODEL_ROOT_PREFIX)
+            assert entries  # re-published under the new lease id
+            await watcher.stop()
+        finally:
+            for d in drts:
+                try:
+                    await d.close()
+                except Exception:
+                    pass
+            await coord.stop()
+
+    async def test_barrier_rendezvous_across_wiped_restart(self, monkeypatch):
+        """A rendezvous in flight when the coordinator dies completes after
+        the restart: every participant's _ResyncPuts hook replays its keys
+        under the re-granted leases."""
+        monkeypatch.setenv("DYN_COORD_RESYNC_GRACE_S", "0.3")
+        coord = await Coordinator(port=0).start()
+        outage = CoordinatorOutage(coord)
+        try:
+            leader = await DistributedRuntime.create(coordinator=coord.address)
+            worker = await DistributedRuntime.create(coordinator=coord.address)
+            data = {"mesh": [2, 4]}
+            # leader starts waiting for 2 workers; only one checks in, then
+            # the coordinator dies and comes back EMPTY
+            lead = asyncio.create_task(
+                leader_barrier(leader, "bo", data, num_workers=2, timeout=30))
+            w1 = asyncio.create_task(
+                worker_barrier(worker, "bo", "host1", timeout=30))
+            await asyncio.sleep(0.5)  # both puts landed, rendezvous parked
+            await outage.blip(downtime_s=0.2, wipe_state=True)
+            await leader.coord.wait_connected(timeout=10)
+            await worker.coord.wait_connected(timeout=10)
+            # the second worker joins on the restarted coordinator
+            late = await DistributedRuntime.create(coordinator=coord.address)
+            w2 = asyncio.create_task(
+                worker_barrier(late, "bo", "host2", timeout=30))
+            results = await asyncio.gather(lead, w1, w2)
+            assert results[1] == data and results[2] == data
+            for d in (leader, worker, late):
+                await d.close()
+        finally:
+            await coord.stop()
