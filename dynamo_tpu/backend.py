@@ -13,6 +13,7 @@ and the stream finishes with ``FinishReason.STOP``.
 from __future__ import annotations
 
 import logging
+import time
 from typing import AsyncIterator, List, Optional
 
 from dynamo_tpu.model_card import ModelDeploymentCard
@@ -124,6 +125,10 @@ class Backend:
         completion = 0
         # None = logprobs off; 0 = sampled token only; N = +N alternatives
         want_logprobs = request.sampling_options.logprobs
+        # detokenize stage accounting: the per-frame decode work is
+        # interleaved with engine frames, so it's accumulated and recorded
+        # as ONE retroactive span at stream end (utils/tracing)
+        detok_s = 0.0
 
         try:
             async for out in engine_stream:
@@ -131,6 +136,7 @@ class Backend:
                     yield BackendOutput(error=out.error,
                                         finish_reason=FinishReason.ERROR)
                     return
+                _t0 = time.perf_counter()
                 emit_ids: List[int] = []
                 pieces: List[str] = []
                 lp_content: Optional[List[dict]] = (
@@ -172,6 +178,7 @@ class Backend:
                             kept.append(e)
                             acc += len(e["token"])
                         lp_content = kept
+                detok_s += time.perf_counter() - _t0
                 if finish is not None:
                     if jail.matched is None:
                         text += jail.flush()
@@ -201,6 +208,14 @@ class Backend:
             aclose = getattr(engine_stream, "aclose", None)
             if aclose is not None:
                 await aclose()
+            if detok_s > 0:
+                # retroactive span: the accumulated decode time, anchored so
+                # it ends now (the stage breakdown cares about the total,
+                # not the interleaving)
+                from dynamo_tpu.utils.tracing import get_tracer
+                now = time.time()
+                get_tracer().record("detokenize", now - detok_s, now,
+                                    attrs={"accumulated": True})
 
 
 __all__ = ["Backend", "StopJail"]

@@ -40,17 +40,28 @@ class EchoEngine(EngineBase):
 
     async def generate(self, request: PreprocessedRequest,
                        ctx=None) -> AsyncIterator[LLMEngineOutput]:
+        import time
+        t0 = time.time()
         max_tokens = request.stop_conditions.max_tokens or len(request.token_ids)
         n = min(len(request.token_ids), max_tokens)
+        # first-frame stage stamps, same shape the scheduled engine loop
+        # emits — so tracing tests get queue/prefill/decode spans without a
+        # real engine (queue is zero-width; "prefill" is the per-token delay
+        # before the first frame)
+        def first_timings():
+            return {"enqueued_unix": t0, "admitted_unix": t0,
+                    "first_unix": time.time()}
         for i in range(n):
             if ctx is not None and getattr(ctx, "cancelled", False):
                 yield LLMEngineOutput(finish_reason=FinishReason.CANCELLED)
                 return
             if self.delay_s:
                 await asyncio.sleep(self.delay_s)
-            yield LLMEngineOutput(token_ids=[request.token_ids[i]])
+            yield LLMEngineOutput(token_ids=[request.token_ids[i]],
+                                  timings=first_timings() if i == 0 else None)
         yield LLMEngineOutput(
             finish_reason=FinishReason.LENGTH,
+            timings=first_timings() if n == 0 else None,
             prompt_tokens=len(request.token_ids), completion_tokens=n)
 
 

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 from collections import deque
 from typing import (
     Any,
@@ -130,6 +131,19 @@ class ScheduledEngineBase(EngineBase):
     # -- frame emission ----------------------------------------------------
 
     def _emit(self, seq: Sequence, out: LLMEngineOutput) -> None:
+        if not seq.timings_sent and (out.token_ids
+                                     or out.finish_reason is not None):
+            # first content-bearing frame: ship the stage boundaries so the
+            # serving layer can stitch queue/prefill/decode trace spans
+            # (utils/tracing.StageStitcher) without reaching into the engine
+            seq.timings_sent = True
+            t = {"enqueued_unix": seq.enqueued_unix,
+                 "first_unix": time.time()}
+            if seq.admitted_unix is not None:
+                t["admitted_unix"] = seq.admitted_unix
+            if seq.cached_tokens:
+                t["cached_tokens"] = float(seq.cached_tokens)
+            out.timings = t
         q = self._queues.get(seq.request.request_id)
         if q is not None:
             q.put_nowait(out)

@@ -61,7 +61,8 @@ class Sequence:
 
     __slots__ = ("request", "tokens", "page_ids", "committed_pages",
                  "num_computed", "cached_tokens", "num_prompt", "generated",
-                 "phase", "cancelled", "arrival", "salt_hash")
+                 "phase", "cancelled", "arrival", "salt_hash",
+                 "enqueued_unix", "admitted_unix", "timings_sent")
 
     def __init__(self, request: PreprocessedRequest, page_size: int,
                  salt_hash: int = 0):
@@ -79,6 +80,12 @@ class Sequence:
         self.phase = Phase.WAITING
         self.cancelled = False
         self.arrival = time.monotonic()
+        # wall-clock stage boundaries for the tracing layer (utils/tracing):
+        # queue = enqueued -> first admission, prefill = admission -> first
+        # emitted frame; the engine loop ships them on the first frame
+        self.enqueued_unix = time.time()
+        self.admitted_unix: Optional[float] = None
+        self.timings_sent = False
 
     def __len__(self) -> int:
         return len(self.tokens)
@@ -260,6 +267,8 @@ class Scheduler:
         seq.page_ids = match.page_ids + fresh
         seq.committed_pages = len(match.page_ids)
         seq.num_computed = cached
+        if seq.admitted_unix is None:  # keep the FIRST admission (a
+            seq.admitted_unix = time.time()  # preemption revive re-admits)
         if not seq.generated:  # first admission: report the prefix hit
             seq.cached_tokens = cached
         seq.phase = Phase.PREFILL

@@ -24,6 +24,42 @@ _ITL_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
                 0.5, 1.0)
 _DUR_BUCKETS = (0.01, 0.05, 0.1, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0,
                 300.0)
+# stage spans range from sub-ms tokenize to multi-second prefill/decode
+_STAGE_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                  0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+
+
+class StageMetrics:
+    """``dynamo_tpu_stage_duration_seconds{stage}`` — per-stage request
+    latency breakdown (queue|prefill|kv_transfer|decode|tokenize|detokenize),
+    fed from locally-finished trace spans (``utils/tracing``).  Registered on
+    BOTH the frontend registry and the worker system-server registry under
+    the same name, so dashboards join one series across roles; each process
+    observes only the spans it produced (adopted remote spans don't re-fire),
+    so nothing double-counts."""
+
+    def __init__(self, registry: Optional[CollectorRegistry] = None):
+        self.duration = Histogram(
+            "dynamo_tpu_stage_duration_seconds",
+            "Per-stage request latency breakdown (trace-span stages)",
+            ["stage"], buckets=_STAGE_BUCKETS, registry=registry)
+        self._attached: set = set()
+
+    def attach(self, tracer) -> None:
+        """Observe this tracer's stage spans (idempotent per tracer)."""
+        if id(tracer) in self._attached:
+            return
+        self._attached.add(id(tracer))
+        tracer.add_listener(self._on_span)
+
+    def detach(self, tracer) -> None:
+        self._attached.discard(id(tracer))
+        tracer.remove_listener(self._on_span)
+
+    def _on_span(self, span) -> None:
+        from dynamo_tpu.utils.tracing import STAGES
+        if span.name in STAGES:
+            self.duration.labels(span.name).observe(span.duration_s)
 
 
 class FrontendMetrics:
@@ -55,6 +91,9 @@ class FrontendMetrics:
             f"{ns}_requests_shed_total",
             "Requests shed at admission (503) by overload protection",
             ["model", "endpoint", "reason"], registry=self.registry)
+        # per-stage latency breakdown from trace spans; HttpService attaches
+        # the process tracer at start and detaches at stop
+        self.stage = StageMetrics(self.registry)
 
     def attach_coord(self, coord) -> "CoordClientMetrics":
         """Expose the process's coordinator-connection health next to the
@@ -143,4 +182,5 @@ class RequestTimer:
             self.m.input_tokens.labels(self.model).inc(prompt_tokens)
 
 
-__all__ = ["FrontendMetrics", "CoordClientMetrics", "RequestTimer"]
+__all__ = ["FrontendMetrics", "CoordClientMetrics", "RequestTimer",
+           "StageMetrics"]

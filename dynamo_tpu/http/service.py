@@ -23,6 +23,11 @@ from dynamo_tpu.llm.model_manager import ModelManager
 from dynamo_tpu.protocols import sse
 from dynamo_tpu.protocols.common import FinishReason
 from dynamo_tpu.runtime.rpc import DeadlineExceededError
+from dynamo_tpu.runtime.system_server import (
+    trace_get_response,
+    trace_list_response,
+)
+from dynamo_tpu.utils.tracing import get_tracer
 from dynamo_tpu.protocols.openai import (
     ChatChoice,
     ChatCompletionRequest,
@@ -121,11 +126,20 @@ class HttpService:
         self.app.router.add_get("/health", self.handle_health)
         self.app.router.add_get("/live", self.handle_live)
         self.app.router.add_get("/metrics", self.handle_metrics)
+        self.app.router.add_get("/v1/traces", self.handle_traces)
+        self.app.router.add_get("/v1/traces/{trace_id}", self.handle_trace)
         self.app.router.add_post("/clear_kv_blocks", self.handle_clear_kv)
         self._runner: Optional[web.AppRunner] = None
         self._clear_kv_hook = None  # async () -> dict
+        # the process tracer: every request opens a root span here; the
+        # flight recorder behind /v1/traces and the per-stage histogram
+        # (metrics.stage) both hang off it
+        self.tracer = get_tracer()
+        if not self.tracer.service:
+            self.tracer.service = "frontend"
 
     async def start(self) -> "HttpService":
+        self.metrics.stage.attach(self.tracer)
         self._runner = web.AppRunner(self.app, access_log=None)
         await self._runner.setup()
         site = web.TCPSite(self._runner, self.host, self.port)
@@ -136,6 +150,7 @@ class HttpService:
         return self
 
     async def stop(self) -> None:
+        self.metrics.stage.detach(self.tracer)
         if self._runner is not None:
             await self._runner.cleanup()
 
@@ -158,6 +173,13 @@ class HttpService:
             ModelInfo(id=name, created=now_unix()) for name in self.manager.names()])
         return web.json_response(models.model_dump())
 
+    async def handle_traces(self, request: web.Request) -> web.Response:
+        return trace_list_response(self.tracer, request)
+
+    async def handle_trace(self, request: web.Request) -> web.Response:
+        return trace_get_response(self.tracer,
+                                  request.match_info["trace_id"])
+
     async def handle_clear_kv(self, request: web.Request) -> web.Response:
         if self._clear_kv_hook is None:
             return web.json_response({"cleared": []})
@@ -165,6 +187,16 @@ class HttpService:
 
     def set_clear_kv_hook(self, hook) -> None:
         self._clear_kv_hook = hook
+
+    @staticmethod
+    def _stamp_rid(resp: web.StreamResponse,
+                   request_id: str) -> web.StreamResponse:
+        """X-Request-Id on an unprepared response (streamed responses set
+        it in their constructor headers — after ``prepare`` it's too
+        late)."""
+        if not resp.prepared:
+            resp.headers["X-Request-Id"] = request_id
+        return resp
 
     # -- overload shedding + deadlines -------------------------------------
 
@@ -244,20 +276,23 @@ class HttpService:
         shed = self._shed_or_admit(req.model, "embeddings")
         if shed is not None:
             return shed
+        request_id = new_request_id("embd")
         try:
             vectors, prompt_tokens = await pipeline.generate_embeddings(req)
         except NotImplementedError as e:
-            return _error(501, str(e))
+            return self._stamp_rid(_error(501, str(e)), request_id)
         except Exception as e:  # noqa: BLE001
             logger.exception("embeddings failed")
-            return _error(500, str(e), "internal_error")
+            return self._stamp_rid(_error(500, str(e), "internal_error"),
+                                   request_id)
         finally:
             self._release(req.model)
         if req.dimensions is not None and vectors:
             if req.dimensions > len(vectors[0]):
-                return _error(
+                return self._stamp_rid(_error(
                     400, f"dimensions={req.dimensions} exceeds the "
-                         f"model's embedding width {len(vectors[0])}")
+                         f"model's embedding width {len(vectors[0])}"),
+                    request_id)
             # OpenAI-style dimensionality reduction: truncate (vectors are
             # mean-pooled hidden states, not unit-norm — no renormalize)
             vectors = [v[:req.dimensions] for v in vectors]
@@ -276,7 +311,9 @@ class HttpService:
             model=req.model,
             usage=Usage(prompt_tokens=prompt_tokens,
                         total_tokens=prompt_tokens))
-        return web.json_response(resp.model_dump(exclude_none=True))
+        return self._stamp_rid(
+            web.json_response(resp.model_dump(exclude_none=True)),
+            request_id)
 
     async def handle_chat(self, request: web.Request) -> web.StreamResponse:
         try:
@@ -295,35 +332,51 @@ class HttpService:
         shed = self._shed_or_admit(req.model, "chat")
         if shed is not None:
             return shed
+        # the frontend mints the request id ONCE: it rides every RPC hop's
+        # headers (so worker logs/counters see the same id), names the root
+        # trace span, and returns to the client as X-Request-Id
         request_id = new_request_id()
         timer = RequestTimer(self.metrics, req.model, "chat")
+        root = self.tracer.start_trace("http_request", attrs={
+            "request_id": request_id, "model": req.model,
+            "endpoint": "chat"})
         try:
             if req.stream:
                 return await self._stream_chat(request, req, pipeline,
                                                request_id, timer, deadline)
-            return await self._aggregate_chat(req, pipeline, request_id,
-                                              timer, deadline)
+            return self._stamp_rid(await self._aggregate_chat(
+                req, pipeline, request_id, timer, deadline), request_id)
         except ValueError as e:
             timer.done("400")
-            return _error(400, str(e))
+            root.set_error(str(e))
+            return self._stamp_rid(_error(400, str(e)), request_id)
         except DeadlineExceededError as e:
             timer.done("504")
-            return _error(504, str(e), "deadline_exceeded")
+            root.set_error(str(e))
+            return self._stamp_rid(_error(504, str(e), "deadline_exceeded"),
+                                   request_id)
         except ConnectionResetError:
             timer.done("499")  # client went away mid-write
+            root.set_error("client disconnected")
             raise
         except ConnectionError as e:
             timer.done("503")
-            return _error(503, str(e), "service_unavailable")
+            root.set_error(str(e))
+            return self._stamp_rid(
+                _error(503, str(e), "service_unavailable"), request_id)
         except asyncio.CancelledError:
             timer.done("499")
+            root.set_error("cancelled")
             raise
         except Exception as e:
             logger.exception("chat handler error")
             timer.done("500")
-            return _error(500, str(e), "internal_error")
+            root.set_error(str(e))
+            return self._stamp_rid(_error(500, str(e), "internal_error"),
+                                   request_id)
         finally:
             self._release(req.model)
+            root.finish()
 
     async def _stream_chat(self, http_req: web.Request,
                            req: ChatCompletionRequest, pipeline,
@@ -338,7 +391,8 @@ class HttpService:
         resp = web.StreamResponse(headers={
             "Content-Type": "text/event-stream",
             "Cache-Control": "no-cache",
-            "Connection": "keep-alive"})
+            "Connection": "keep-alive",
+            "X-Request-Id": request_id})
         await resp.prepare(http_req)
         if annotation_only:
             # e.g. query_instance_id: answer with the annotation events and
@@ -424,6 +478,10 @@ class HttpService:
         finally:
             await gen.aclose()
             timer.done(status)
+            if status not in ("200",):
+                sp = self.tracer.current_span()
+                if sp is not None:
+                    sp.set_error(f"stream ended with status {status}")
         await resp.write_eof()
         return resp
 
@@ -717,26 +775,37 @@ class HttpService:
             return shed
         request_id = new_request_id("resp")
         timer = RequestTimer(self.metrics, model, "responses")
+        root = self.tracer.start_trace("http_request", attrs={
+            "request_id": request_id, "model": model,
+            "endpoint": "responses"})
         try:
             text, _finish, _lps, usage = await self._collect_chat(
                 chat, pipeline, request_id, timer, deadline=deadline)
         except ValueError as e:  # same mapping as handle_chat
             timer.done("400")
-            return _error(400, str(e))
+            root.set_error(str(e))
+            return self._stamp_rid(_error(400, str(e)), request_id)
         except DeadlineExceededError as e:
             timer.done("504")
-            return _error(504, str(e), "deadline_exceeded")
+            root.set_error(str(e))
+            return self._stamp_rid(_error(504, str(e), "deadline_exceeded"),
+                                   request_id)
         except ConnectionError as e:
             timer.done("503")
-            return _error(503, str(e), "service_unavailable")
+            root.set_error(str(e))
+            return self._stamp_rid(
+                _error(503, str(e), "service_unavailable"), request_id)
         except Exception as e:  # noqa: BLE001 — surface as API error
             timer.done("500")
+            root.set_error(str(e))
             logger.exception("responses request %s failed", request_id)
-            return _error(500, str(e), "internal_error")
+            return self._stamp_rid(_error(500, str(e), "internal_error"),
+                                   request_id)
         finally:
             self._release(model)
+            root.finish()
         timer.done("200", usage.prompt_tokens)
-        return web.json_response({
+        return self._stamp_rid(web.json_response({
             "id": request_id,
             "object": "response",
             "created_at": now_unix(),
@@ -757,7 +826,7 @@ class HttpService:
                       "input_tokens_details": {
                           "cached_tokens": (usage.prompt_tokens_details
                                             or {}).get("cached_tokens", 0)}},
-        })
+        }), request_id)
 
     async def handle_completions(self, request: web.Request) -> web.StreamResponse:
         try:
@@ -782,7 +851,9 @@ class HttpService:
             return shed
         request_id = new_request_id("cmpl")
         timer = RequestTimer(self.metrics, req.model, "completions")
-
+        root = self.tracer.start_trace("http_request", attrs={
+            "request_id": request_id, "model": req.model,
+            "endpoint": "completions"})
         try:
             # echo: return the prompt (and, with logprobs, per-prompt-token
             # logprobs — the lm-eval loglikelihood surface) ahead of any
@@ -911,28 +982,40 @@ class HttpService:
                 id=request_id, created=now_unix(), model=req.model,
                 choices=choices, usage=usage)
             timer.done("200", usage.prompt_tokens)
-            return web.json_response(body.model_dump(exclude_none=True))
+            return self._stamp_rid(
+                web.json_response(body.model_dump(exclude_none=True)),
+                request_id)
         except ValueError as e:
             timer.done("400")
-            return _error(400, str(e))
+            root.set_error(str(e))
+            return self._stamp_rid(_error(400, str(e)), request_id)
         except DeadlineExceededError as e:
             timer.done("504")
-            return _error(504, str(e), "deadline_exceeded")
+            root.set_error(str(e))
+            return self._stamp_rid(_error(504, str(e), "deadline_exceeded"),
+                                   request_id)
         except ConnectionResetError:
             timer.done("499")
+            root.set_error("client disconnected")
             raise
         except ConnectionError as e:
             timer.done("503")
-            return _error(503, str(e), "service_unavailable")
+            root.set_error(str(e))
+            return self._stamp_rid(
+                _error(503, str(e), "service_unavailable"), request_id)
         except asyncio.CancelledError:
             timer.done("499")
+            root.set_error("cancelled")
             raise
         except Exception as e:
             logger.exception("completions handler error")
             timer.done("500")
-            return _error(500, str(e), "internal_error")
+            root.set_error(str(e))
+            return self._stamp_rid(_error(500, str(e), "internal_error"),
+                                   request_id)
         finally:
             self._release(req.model)
+            root.finish()
 
     async def _stream_completion(self, http_req: web.Request,
                                  req: CompletionRequest, pipeline,
@@ -941,7 +1024,8 @@ class HttpService:
                                  ) -> web.StreamResponse:
         resp = web.StreamResponse(headers={
             "Content-Type": "text/event-stream",
-            "Cache-Control": "no-cache"})
+            "Cache-Control": "no-cache",
+            "X-Request-Id": request_id})
         await resp.prepare(http_req)
         status = "200"
         created = now_unix()

@@ -30,6 +30,11 @@ from dynamo_tpu.protocols.common import (
     PreprocessedRequest,
 )
 from dynamo_tpu.runtime.rpc import StreamEndedError
+from dynamo_tpu.utils.tracing import (
+    SPANS_FRAME_KEY,
+    StageStitcher,
+    get_tracer,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -92,7 +97,13 @@ class MigrationOperator(Operator):
                               f"(after {attempt - 1} migrations)",
                         finish_reason=FinishReason.ERROR)
                     return
-                req = self._rebuild(request, generated)
+                req = self._rebuild(request, generated, attempt)
+                span = get_tracer().current_span()
+                if span is not None:
+                    # the replay keeps the SAME trace: the event marks where
+                    # the first worker's spans stop and the survivor's begin
+                    span.add_event("migration", attempt=attempt,
+                                   tokens_done=len(generated), error=str(e))
                 logger.warning(
                     "migrating request %s (attempt %d/%d, %d tokens done)",
                     request.request_id, attempt, self.migration_limit,
@@ -100,9 +111,13 @@ class MigrationOperator(Operator):
 
     @staticmethod
     def _rebuild(original: PreprocessedRequest,
-                 generated: List[int]) -> PreprocessedRequest:
+                 generated: List[int],
+                 attempt: int = 0) -> PreprocessedRequest:
         req = PreprocessedRequest.from_dict(original.to_dict())
         req.token_ids = list(original.token_ids) + list(generated)
+        # the receiving worker counts replays it absorbs
+        # (dynamo_worker_migration_replays_total)
+        req.migration_attempt = attempt
         sc = req.stop_conditions
         if sc.max_tokens is not None:
             sc.max_tokens = max(1, sc.max_tokens - len(generated))
@@ -112,26 +127,44 @@ class MigrationOperator(Operator):
 def router_sink(router) -> Source:
     """Terminal source: one streamed hop through a PushRouter.
 
-    The request deadline rides the RPC ``req`` frame headers so the worker
-    can drop expired work, and the returned ``ResponseStream`` enforces it
-    between frames (``DeadlineExceededError`` — which this sink does NOT
-    translate, so the migration operator never replays expired requests)."""
-    from dynamo_tpu.runtime.rpc import deadline_headers
+    The request deadline and frontend-minted request id ride the RPC ``req``
+    frame headers (trace context is injected by the connection itself) so the
+    worker can drop expired work and log under the same id; the returned
+    ``ResponseStream`` enforces the deadline between frames
+    (``DeadlineExceededError`` — which this sink does NOT translate, so the
+    migration operator never replays expired requests).  Worker-shipped trace
+    spans on the final frame are adopted into the local tracer so the
+    frontend's flight recorder holds the stitched tree."""
+    from dynamo_tpu.runtime.rpc import request_headers
 
     async def source(request: PreprocessedRequest):
+        tracer = get_tracer()
         async for payload in router.generate_stream(
                 request.to_dict(),
-                headers=deadline_headers(request.deadline_unix)):
+                headers=request_headers(request.deadline_unix,
+                                        request.request_id)):
+            if isinstance(payload, dict) and SPANS_FRAME_KEY in payload:
+                tracer.adopt(payload.pop(SPANS_FRAME_KEY))
             yield LLMEngineOutput.from_dict(payload)
 
     return source
 
 
 def engine_sink(engine) -> Source:
-    """Terminal source: a local in-process engine."""
+    """Terminal source: a local in-process engine.  Stage spans
+    (queue/prefill/decode) come from the engine's first-frame timing stamps,
+    the same stitching the remote worker handler does — so the single-process
+    server gets the identical per-stage breakdown."""
 
-    def source(request: PreprocessedRequest):
-        return engine.generate(request)
+    async def source(request: PreprocessedRequest):
+        stitcher = StageStitcher(get_tracer(),
+                                 skip_decode=request.prefill_only)
+        try:
+            async for out in engine.generate(request):
+                stitcher.on_frame(out)
+                yield out
+        finally:
+            stitcher.close()
 
     return source
 

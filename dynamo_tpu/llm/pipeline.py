@@ -100,7 +100,10 @@ class ServicePipeline:
         ``deadline_unix`` stamps the end-to-end request deadline onto the
         preprocessed request; the remote hop propagates it to the worker and
         enforces it between frames."""
-        preprocessed = self.preprocessor.preprocess_chat(req, request_id)
+        from dynamo_tpu.utils.tracing import get_tracer
+        with get_tracer().span("tokenize") as sp:
+            preprocessed = self.preprocessor.preprocess_chat(req, request_id)
+            sp.set_attr("prompt_tokens", len(preprocessed.token_ids))
         if deadline_unix is not None:
             preprocessed.deadline_unix = deadline_unix
         delta = DeltaGenerator(
@@ -132,7 +135,11 @@ class ServicePipeline:
                                   deadline_unix: Optional[float] = None
                                   ) -> AsyncIterator[BackendOutput]:
         """Completions pipeline: streams BackendOutput (text deltas)."""
-        preprocessed = self.preprocessor.preprocess_completion(req, request_id)
+        from dynamo_tpu.utils.tracing import get_tracer
+        with get_tracer().span("tokenize") as sp:
+            preprocessed = self.preprocessor.preprocess_completion(
+                req, request_id)
+            sp.set_attr("prompt_tokens", len(preprocessed.token_ids))
         if deadline_unix is not None:
             preprocessed.deadline_unix = deadline_unix
         async for out in self.backend.transform(

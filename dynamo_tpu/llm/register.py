@@ -28,37 +28,93 @@ def engine_handler(engine: EngineBase) -> Callable:
     before it touches the scheduler, and one that expires mid-generation is
     cancelled between frames — either way the worker stops generating tokens
     nobody is waiting for (the caller's ``ResponseStream`` raised
-    ``DeadlineExceededError`` at the same deadline)."""
+    ``DeadlineExceededError`` at the same deadline).
+
+    Tracing: each request opens a hop span from the inbound RPC trace
+    context; the engine's first-frame timing stamps become queue/prefill
+    spans, the tail a decode span, and every span of this worker's fragment
+    (including adopted disagg sub-hops) ships back to the caller on the
+    final frame (``trace_spans``) so the frontend's flight recorder holds
+    one stitched tree.  Admission outcomes feed the worker-side counters
+    (``dynamo_worker_requests_total``)."""
 
     async def handler(payload: Any, ctx) -> AsyncIterator[Any]:
         from dynamo_tpu.protocols.common import FinishReason
+        from dynamo_tpu.utils.tracing import (
+            SPANS_FRAME_KEY, StageStitcher, get_tracer)
+        from dynamo_tpu.worker.metrics import get_worker_metrics
+        tracer = get_tracer()
+        metrics = get_worker_metrics()
         request = PreprocessedRequest.from_dict(payload)
+        hop = tracer.start_hop(
+            "worker.generate",
+            headers=getattr(ctx, "headers", None),
+            attrs={"request_id": request.request_id,
+                   "endpoint": getattr(ctx, "endpoint", ""),
+                   "prompt_tokens": len(request.token_ids)})
+        if request.migration_attempt:
+            metrics.migration_replays.inc()
+            hop.set_attr("migration_attempt", request.migration_attempt)
         if ctx is not None and getattr(ctx, "deadline_expired", False):
             logger.warning("request %s arrived with its deadline already "
                            "expired; dropping", request.request_id)
-            yield LLMEngineOutput(
+            metrics.requests_total.labels("refused_expired").inc()
+            hop.set_error("request deadline expired before admission")
+            final = LLMEngineOutput(
                 error="request deadline expired before admission",
                 finish_reason=FinishReason.ERROR).to_dict()
+            final[SPANS_FRAME_KEY] = tracer.finish_hop(hop)
+            yield final
             return
-        async for out in engine.generate(request, ctx):
-            if (ctx is not None and getattr(ctx, "deadline_expired", False)
-                    and out.finish_reason is None):
-                # nobody is waiting for this stream anymore: release the
-                # scheduler slot (cooperative cancel; closing the generator
-                # also runs engine.generate's finally -> scheduler.cancel)
-                logger.warning("request %s exceeded its deadline "
-                               "mid-generation; cancelling",
-                               request.request_id)
-                ctx.cancel()
-                # explicit error frame, not a bare return: if the worker's
-                # clock runs ahead of the caller's, the caller's own
-                # deadline hasn't tripped yet — a clean ``final`` would
-                # surface as a 200 with silently truncated output
-                yield LLMEngineOutput(
-                    error="request deadline exceeded mid-generation",
-                    finish_reason=FinishReason.ERROR).to_dict()
-                return
-            yield out.to_dict()
+        metrics.requests_total.labels("admitted").inc()
+        stitcher = StageStitcher(tracer, parent=hop,
+                                 skip_decode=request.prefill_only)
+        try:
+            async for out in engine.generate(request, ctx):
+                stitcher.on_frame(out)
+                if (ctx is not None
+                        and getattr(ctx, "deadline_expired", False)
+                        and out.finish_reason is None):
+                    # nobody is waiting for this stream anymore: release the
+                    # scheduler slot (cooperative cancel; closing the
+                    # generator also runs engine.generate's finally ->
+                    # scheduler.cancel)
+                    logger.warning("request %s exceeded its deadline "
+                                   "mid-generation; cancelling",
+                                   request.request_id)
+                    ctx.cancel()
+                    metrics.requests_total.labels("deadline_cancelled").inc()
+                    hop.set_error("request deadline exceeded mid-generation")
+                    stitcher.close()
+                    # explicit error frame, not a bare return: if the
+                    # worker's clock runs ahead of the caller's, the
+                    # caller's own deadline hasn't tripped yet — a clean
+                    # ``final`` would surface as a 200 with silently
+                    # truncated output
+                    final = LLMEngineOutput(
+                        error="request deadline exceeded mid-generation",
+                        finish_reason=FinishReason.ERROR).to_dict()
+                    final[SPANS_FRAME_KEY] = tracer.finish_hop(hop)
+                    yield final
+                    return
+                if out.finish_reason is not None:
+                    if out.error:
+                        metrics.requests_total.labels("error").inc()
+                        hop.set_error(out.error)
+                    stitcher.close()
+                    final = out.to_dict()
+                    final[SPANS_FRAME_KEY] = tracer.finish_hop(hop)
+                    yield final
+                    return
+                yield out.to_dict()
+        finally:
+            # caller cancelled / connection dropped mid-stream: the
+            # fragment still lands in THIS worker's flight recorder (kept
+            # by the always-keep-errored rule) even though nothing ships
+            if not hop.finished:
+                stitcher.close()
+                hop.set_error("stream closed before completion")
+                hop.finish()
 
     return handler
 

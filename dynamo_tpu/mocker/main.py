@@ -68,10 +68,26 @@ async def amain(args: argparse.Namespace) -> None:
     await serve_engine(endpoint, engine,
                        stats_provider=lambda: engine.stats().to_dict())
     await register_llm(drt, endpoint, card)
+    # same observability surface as the real worker (worker/main.py):
+    # counters + stage histogram + flight recorder on the system server
+    from dynamo_tpu.runtime.system_server import SystemServer
+    from dynamo_tpu.utils.tracing import get_tracer
+    from dynamo_tpu.worker.metrics import get_worker_metrics
+    tracer = get_tracer()
+    if not tracer.service:
+        tracer.service = "mocker"
+    wm = get_worker_metrics()
+    wm.attach_tracer(tracer)
+    system = SystemServer.from_env(registry=wm.registry, tracer=tracer)
+    if system is not None:
+        system.health.register("engine", ready=True)
+        await system.start()
     print(f"mocker worker serving model {card.name}", flush=True)
     try:
         await drt.runtime.wait_shutdown()
     finally:
+        if system is not None:
+            await system.stop()
         if event_pump is not None:
             event_pump.cancel()
         await engine.stop()

@@ -134,6 +134,10 @@ class PreprocessedRequest:
     estimated_prefix_hit_num_blocks: Optional[int] = None
     kv_transfer_params: Optional[Dict[str, Any]] = None
     prefill_only: bool = False
+    # >0 on a migration replay: the frontend's MigrationOperator stamps the
+    # attempt number when it re-issues a dropped stream, so the receiving
+    # worker can count replays it absorbs
+    migration_attempt: int = 0
     # end-to-end request deadline, absolute unix seconds (None = none).
     # Set by the HTTP frontend (config default or per-request override) and
     # propagated to the worker in the RPC ``req`` frame headers; expired
@@ -156,6 +160,7 @@ class PreprocessedRequest:
             "estimated_prefix_hit_num_blocks": self.estimated_prefix_hit_num_blocks,
             "kv_transfer_params": self.kv_transfer_params,
             "prefill_only": self.prefill_only,
+            "migration_attempt": self.migration_attempt,
             "deadline_unix": self.deadline_unix,
         }
 
@@ -173,6 +178,7 @@ class PreprocessedRequest:
             estimated_prefix_hit_num_blocks=d.get("estimated_prefix_hit_num_blocks"),
             kv_transfer_params=d.get("kv_transfer_params"),
             prefill_only=bool(d.get("prefill_only", False)),
+            migration_attempt=int(d.get("migration_attempt", 0)),
             deadline_unix=d.get("deadline_unix"),
         )
 
@@ -195,6 +201,10 @@ class LLMEngineOutput:
     prompt_tokens: Optional[int] = None
     completion_tokens: Optional[int] = None
     cached_tokens: Optional[int] = None
+    # stage timing stamps (unix seconds), attached by the engine loop to the
+    # FIRST emitted frame: enqueued_unix/admitted_unix/first_unix — the raw
+    # material for the queue/prefill trace spans (utils/tracing.StageStitcher)
+    timings: Optional[Dict[str, float]] = None
 
     def to_dict(self) -> Dict[str, Any]:
         d: Dict[str, Any] = {"token_ids": list(self.token_ids)}
@@ -202,7 +212,7 @@ class LLMEngineOutput:
             d["finish_reason"] = self.finish_reason.value
         for k in ("cum_log_probs", "log_probs", "top_logprobs", "error",
                   "kv_transfer_params", "prompt_tokens", "completion_tokens",
-                  "cached_tokens"):
+                  "cached_tokens", "timings"):
             v = getattr(self, k)
             if v is not None:
                 d[k] = v
@@ -222,6 +232,7 @@ class LLMEngineOutput:
             prompt_tokens=d.get("prompt_tokens"),
             completion_tokens=d.get("completion_tokens"),
             cached_tokens=d.get("cached_tokens"),
+            timings=d.get("timings"),
         )
 
 

@@ -30,6 +30,7 @@ from typing import Any, AsyncIterator, Awaitable, Callable, Dict, List, Optional
 
 from dynamo_tpu.runtime.codec import Raw, read_frame, send_frame
 from dynamo_tpu.utils.aio import reap_task
+from dynamo_tpu.utils.tracing import get_tracer
 
 logger = logging.getLogger(__name__)
 
@@ -75,15 +76,34 @@ def keepalive_defaults() -> "tuple[float, int]":
 # Wire header carrying the request deadline (absolute unix seconds, caller's
 # clock — same-DC clock skew is far below useful deadline granularity).
 DEADLINE_HEADER = "deadline_unix"
+# Wire header carrying the frontend-minted request id: every hop propagates
+# it (router sink, disagg forwards) instead of synthesizing a stream-local
+# one, so one id follows the request across processes and into logs.
+REQUEST_ID_HEADER = "request_id"
+
+
+def request_headers(deadline_unix: Optional[float] = None,
+                    request_id: Optional[str] = None,
+                    extra: Optional[Dict[str, Any]] = None
+                    ) -> Optional[Dict[str, Any]]:
+    """RPC headers for one hop: deadline + request id (+ extras); None when
+    empty.  The one place the wire shape of request-metadata propagation is
+    written down — every hop builds its headers here.  Trace context
+    (``trace_id``/``parent_span_id``) is NOT added here: the connection
+    injects it from the ambient span at send time (see ``request``)."""
+    h: Dict[str, Any] = {}
+    if deadline_unix is not None:
+        h[DEADLINE_HEADER] = deadline_unix
+    if request_id:
+        h[REQUEST_ID_HEADER] = request_id
+    if extra:
+        h.update(extra)
+    return h or None
 
 
 def deadline_headers(deadline_unix: Optional[float]) -> Optional[Dict[str, Any]]:
-    """RPC headers carrying a request deadline; None when there is none.
-    The one place the wire shape of deadline propagation is written down —
-    every hop (router sink, disagg forwards) builds its headers here."""
-    if deadline_unix is None:
-        return None
-    return {DEADLINE_HEADER: deadline_unix}
+    """Back-compat shim: headers carrying only a deadline."""
+    return request_headers(deadline_unix=deadline_unix)
 
 
 class StreamEndedError(ConnectionError):
@@ -244,7 +264,10 @@ class RpcServer:
                                        DEADLINE_HEADER, deadline)
                         deadline = None
                     ctx = RequestContext(
-                        request_id=headers.get("request_id", str(sid)),
+                        # the frontend-minted id propagated in headers; the
+                        # stream-local sid is only a last-resort fallback
+                        # for callers that sent no id at all
+                        request_id=headers.get(REQUEST_ID_HEADER, str(sid)),
                         endpoint=frame["endpoint"],
                         headers=headers,
                         deadline_unix=deadline,
@@ -605,6 +628,14 @@ class RpcConnection:
                       headers: Optional[Dict[str, Any]] = None) -> ResponseStream:
         if not self.alive:
             raise ConnectionError(f"connection to {self.address} is down")
+        # trace context rides EVERY hop automatically: the caller's current
+        # span (contextvar) becomes the remote hop's parent, so router,
+        # disagg, and aux forwards stitch without per-call-site wiring
+        trace_ctx = get_tracer().current_headers()
+        if trace_ctx:
+            merged = dict(trace_ctx)
+            merged.update(headers or {})
+            headers = merged
         sid = next(self._sids)
         deadline = (headers or {}).get(DEADLINE_HEADER)
         stream = ResponseStream(
@@ -746,6 +777,8 @@ __all__ = [
     "EndpointStats",
     "Handler",
     "DEADLINE_HEADER",
+    "REQUEST_ID_HEADER",
     "deadline_headers",
+    "request_headers",
     "keepalive_defaults",
 ]

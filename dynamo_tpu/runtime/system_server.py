@@ -1,10 +1,15 @@
-"""Per-process system HTTP server: /health, /live, /metrics.
+"""Per-process system HTTP server: /health, /live, /metrics, /v1/traces.
 
 Parity: reference ``lib/runtime/src/http_server.rs:104-140`` — every process
 (worker, frontend, router) can expose a small operational server, enabled by
 ``DYN_SYSTEM_ENABLED=1`` on port ``DYN_SYSTEM_PORT`` (0 = ephemeral).
 Health is endpoint-gated like the reference's ``SystemHealth``: the process
 is "ready" once every registered subsystem reports ready.
+
+When constructed with a ``tracer`` (``utils/tracing.Tracer``) the server
+also exposes that process's flight recorder: ``GET /v1/traces`` (newest
+first, ``?limit=&offset=`` pagination) and ``GET /v1/traces/{trace_id}``
+(the full span tree) — see ``docs/observability.md``.
 """
 
 from __future__ import annotations
@@ -43,16 +48,20 @@ class SystemServer:
     def __init__(self, health: Optional[SystemHealth] = None,
                  registry: Optional[CollectorRegistry] = None,
                  extra_metrics: Optional[Callable[[], bytes]] = None,
-                 host: str = "0.0.0.0", port: int = 0):
+                 host: str = "0.0.0.0", port: int = 0,
+                 tracer=None):
         self.health = health or SystemHealth()
         self.registry = registry
         self.extra_metrics = extra_metrics
+        self.tracer = tracer
         self.host = host
         self.port = port
         self.app = web.Application()
         self.app.router.add_get("/health", self.handle_health)
         self.app.router.add_get("/live", self.handle_live)
         self.app.router.add_get("/metrics", self.handle_metrics)
+        self.app.router.add_get("/v1/traces", self.handle_traces)
+        self.app.router.add_get("/v1/traces/{trace_id}", self.handle_trace)
         self._runner: Optional[web.AppRunner] = None
 
     @classmethod
@@ -97,5 +106,40 @@ class SystemServer:
             body += self.extra_metrics()
         return web.Response(body=body, content_type="text/plain")
 
+    async def handle_traces(self, request: web.Request) -> web.Response:
+        return trace_list_response(self.tracer, request)
 
-__all__ = ["SystemServer", "SystemHealth"]
+    async def handle_trace(self, request: web.Request) -> web.Response:
+        return trace_get_response(self.tracer,
+                                  request.match_info["trace_id"])
+
+
+def trace_list_response(tracer, request: web.Request) -> web.Response:
+    """``GET /v1/traces`` body from a flight recorder — shared between the
+    system server and the HTTP frontend so the surface cannot drift."""
+    if tracer is None:
+        return web.json_response(
+            {"error": "tracing is not enabled on this process"}, status=404)
+    try:
+        limit = int(request.query.get("limit", "50"))
+        offset = int(request.query.get("offset", "0"))
+    except ValueError:
+        return web.json_response(
+            {"error": "limit/offset must be integers"}, status=400)
+    return web.json_response(tracer.traces(limit=limit, offset=offset))
+
+
+def trace_get_response(tracer, trace_id: str) -> web.Response:
+    if tracer is None:
+        return web.json_response(
+            {"error": "tracing is not enabled on this process"}, status=404)
+    record = tracer.get_trace(trace_id)
+    if record is None:
+        return web.json_response(
+            {"error": f"no such trace: {trace_id} (evicted or sampled "
+                      "out of the flight recorder)"}, status=404)
+    return web.json_response(record)
+
+
+__all__ = ["SystemServer", "SystemHealth", "trace_list_response",
+           "trace_get_response"]

@@ -26,6 +26,28 @@ import time
 from typing import Dict, Optional, Tuple
 
 
+class TraceContextFilter(logging.Filter):
+    """Stamp ``trace_id``/``request_id`` from the ambient trace span onto
+    every log record, so any line emitted while serving a request carries
+    the ids needed to pull its trace from ``/v1/traces/{trace_id}`` — the
+    link that makes a 504/migration/outage incident reconstructible from
+    logs alone."""
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        if getattr(record, "trace_id", None) is None:
+            try:
+                from dynamo_tpu.utils.tracing import get_tracer
+                span = get_tracer().current_span()
+            except Exception:  # logging must never fail on tracing
+                span = None
+            if span is not None:
+                record.trace_id = span.trace_id
+                rid = span.attrs.get("request_id")
+                if rid:
+                    record.request_id = rid
+        return True
+
+
 class JsonlFormatter(logging.Formatter):
     def __init__(self, local_tz: bool = False):
         super().__init__()
@@ -39,9 +61,34 @@ class JsonlFormatter(logging.Formatter):
             "target": record.name,
             "message": record.getMessage(),
         }
+        for key in ("trace_id", "request_id"):
+            value = getattr(record, key, None)
+            if value:
+                out[key] = value
         if record.exc_info:
             out["exception"] = self.formatException(record.exc_info)
         return json.dumps(out)
+
+
+class HumanFormatter(logging.Formatter):
+    """Stderr format, with a ``[rid=... trace=...]`` suffix when the record
+    was emitted inside a traced request."""
+
+    def __init__(self):
+        super().__init__("%(asctime)s %(levelname)s %(name)s: %(message)s")
+
+    def format(self, record: logging.LogRecord) -> str:
+        line = super().format(record)
+        rid = getattr(record, "request_id", None)
+        trace = getattr(record, "trace_id", None)
+        if rid or trace:
+            parts = []
+            if rid:
+                parts.append(f"rid={rid}")
+            if trace:
+                parts.append(f"trace={trace}")
+            line += f" [{' '.join(parts)}]"
+        return line
 
 
 def parse_env_filter(spec: str) -> Tuple[int, Dict[str, int]]:
@@ -103,8 +150,7 @@ def configure_logging(level: Optional[str] = None) -> None:
     if str(jsonl).lower() in ("1", "true"):
         stderr_handler.setFormatter(JsonlFormatter(local_tz))
     else:
-        stderr_handler.setFormatter(logging.Formatter(
-            "%(asctime)s %(levelname)s %(name)s: %(message)s"))
+        stderr_handler.setFormatter(HumanFormatter())
     handlers = [stderr_handler]
     if jsonl and str(jsonl).lower() not in ("1", "true"):
         # a path: append JSONL records there alongside stderr
@@ -112,13 +158,16 @@ def configure_logging(level: Optional[str] = None) -> None:
         file_handler.setFormatter(JsonlFormatter(local_tz))
         handlers.append(file_handler)
 
+    trace_filter = TraceContextFilter()
     root = logging.getLogger()
     root.handlers.clear()
     for h in handlers:
+        h.addFilter(trace_filter)
         root.addHandler(h)
     root.setLevel(default_level)
     for name, lvl in target_levels.items():
         logging.getLogger(name).setLevel(lvl)
 
 
-__all__ = ["configure_logging", "JsonlFormatter", "parse_env_filter"]
+__all__ = ["configure_logging", "JsonlFormatter", "HumanFormatter",
+           "TraceContextFilter", "parse_env_filter"]

@@ -36,9 +36,14 @@ from dynamo_tpu.protocols.common import (
     PreprocessedRequest,
 )
 from dynamo_tpu.runtime.push_router import PushRouter, RouterMode
-from dynamo_tpu.runtime.rpc import DeadlineExceededError, deadline_headers
+from dynamo_tpu.runtime.rpc import DeadlineExceededError, request_headers
 from dynamo_tpu.runtime.runtime import DistributedRuntime
 from dynamo_tpu.utils.aio import reap_task
+from dynamo_tpu.utils.tracing import (
+    SPANS_FRAME_KEY,
+    StageStitcher,
+    get_tracer,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -153,25 +158,45 @@ class PrefillQueueWorker:
             logger.info("dropping stale prefill job %s (queued %.1fs)",
                         job.get("req", {}).get("request_id"), age_s)
             return
+        tracer = get_tracer()
+        # the decode side packed its trace context into the job (the queue
+        # rides the coordinator, not RPC headers): this worker's fragment
+        # stitches under the decode worker's prefill span
+        hop = tracer.start_hop("prefill_worker.job",
+                               headers=job.get("trace"),
+                               attrs={"request_id":
+                                      job.get("req", {}).get("request_id",
+                                                             ""),
+                                      "queued_s": round(age_s, 6)})
+        stitcher = StageStitcher(tracer, parent=hop, skip_decode=True)
+        # pre-set so the finally's publish can never NameError, even on a
+        # BaseException (cancellation) out of the engine stream
+        reply = {"out": None, "instance_id": self.instance_id}
         try:
             req = PreprocessedRequest.from_dict(job["req"])
             req.prefill_only = True
             final: Optional[LLMEngineOutput] = None
             async for out in self.engine.generate(req):
+                stitcher.on_frame(out)
                 if out.finish_reason is not None:
                     final = out
+            if final is not None and final.error:
+                hop.set_error(final.error)
             reply = {
                 "out": final.to_dict() if final is not None else None,
                 "instance_id": self.instance_id,
                 "bulk_address": self.bulk_address,
                 "direct_address": self.direct_address,
             }
-        except Exception:  # noqa: BLE001 — reply even on failure, so the
-            # decode side falls back immediately instead of waiting out
+        except Exception as e:  # noqa: BLE001 — reply even on failure, so
+            # the decode side falls back immediately instead of waiting out
             # its queue timeout
+            hop.set_error(repr(e))
             reply = {"out": None, "instance_id": self.instance_id}
             raise
         finally:
+            stitcher.close()
+            reply[SPANS_FRAME_KEY] = tracer.finish_hop(hop)
             await self.drt.coord.publish(job["reply"], codec.pack(reply))
 
 
@@ -302,24 +327,32 @@ class DisaggDecodeHandler:
         preq = PreprocessedRequest.from_dict(preq.to_dict())
         preq.request_id = f"{rid}-q"
         preq.prefill_only = True
+        tracer = get_tracer()
         sub = await self.drt.subscribe_events(subject)
         try:
-            await self.drt.coord.queue_push(
-                prefill_queue_name(self.namespace),
-                codec.pack({"req": preq.to_dict(), "reply": subject,
-                            "ttl": self.queue_timeout}))
-            try:
-                _subj, reply = await asyncio.wait_for(
-                    sub.__anext__(), timeout=self.queue_timeout)
-            except asyncio.TimeoutError:
-                logger.warning("prefill queue reply timed out after %.1fs",
-                               self.queue_timeout)
-                return None
-            if not reply.get("out"):
-                return None
-            final = LLMEngineOutput.from_dict(reply["out"])
-            if final.error:
-                return None
+            with tracer.span("prefill", attrs={"remote": True,
+                                               "leg": "queue"}) as psp:
+                await self.drt.coord.queue_push(
+                    prefill_queue_name(self.namespace),
+                    codec.pack({"req": preq.to_dict(), "reply": subject,
+                                "ttl": self.queue_timeout,
+                                # the prefill worker's fragment parents here
+                                "trace": psp.headers() or None}))
+                try:
+                    _subj, reply = await asyncio.wait_for(
+                        sub.__anext__(), timeout=self.queue_timeout)
+                except asyncio.TimeoutError:
+                    logger.warning("prefill queue reply timed out after "
+                                   "%.1fs", self.queue_timeout)
+                    psp.set_error("prefill queue reply timeout")
+                    return None
+                tracer.adopt(reply.get(SPANS_FRAME_KEY))
+                if not reply.get("out"):
+                    return None
+                final = LLMEngineOutput.from_dict(reply["out"])
+                if final.error:
+                    psp.set_error(final.error)
+                    return None
             params = final.kv_transfer_params or {}
             hashes = [b[0] for b in params.get("blocks", [])]
             if hashes:
@@ -352,18 +385,28 @@ class DisaggDecodeHandler:
             if final is not None:
                 return final
         try:
+            tracer = get_tracer()
             iid = self._router.select_instance()
             final: Optional[LLMEngineOutput] = None
-            # the end-to-end deadline rides the internal hop too, so a
+            # the end-to-end deadline and request id ride the internal hop
+            # too (trace context auto-injected by the connection), so a
             # stuck prefill worker can't hold the decode worker past it
-            stream = await self._gen_client.direct(
-                preq.to_dict(), iid, deadline_headers(preq.deadline_unix))
-            async for payload in stream:
-                out = LLMEngineOutput.from_dict(payload)
-                if out.finish_reason is not None:
-                    final = out
-            if final is None or final.error:
-                return None
+            with tracer.span("prefill",
+                             attrs={"remote": True, "leg": "direct",
+                                    "instance": f"{iid:x}"}) as psp:
+                stream = await self._gen_client.direct(
+                    preq.to_dict(), iid,
+                    request_headers(preq.deadline_unix, preq.request_id))
+                async for payload in stream:
+                    if isinstance(payload, dict) and SPANS_FRAME_KEY in payload:
+                        tracer.adopt(payload.pop(SPANS_FRAME_KEY))
+                    out = LLMEngineOutput.from_dict(payload)
+                    if out.finish_reason is not None:
+                        final = out
+                if final is None or final.error:
+                    psp.set_error((final.error if final is not None
+                                   else None) or "no final prefill frame")
+                    return None
             params = final.kv_transfer_params or {}
             hashes = [b[0] for b in params.get("blocks", [])]
             if hashes:
@@ -394,6 +437,42 @@ class DisaggDecodeHandler:
             bulk_address = inst.bulk_address
         if not direct_address and inst is not None:
             direct_address = inst.direct_address
+        tracer = get_tracer()
+        kv_span = tracer.start_span(
+            "kv_transfer", attrs={"blocks": len(hashes),
+                                  "instance": f"{iid:x}"})
+
+        def _count_bytes(n: int, plane: str) -> None:
+            # per-plane attrs: a ladder fall-through (direct pull ok, inject
+            # failed, bulk finished the job) must not attribute one plane's
+            # bytes to another; "plane" records the plane that served the
+            # tail of the transfer
+            kv_span.set_attr("plane", plane)
+            kv_span.set_attr(
+                f"bytes_{plane}",
+                int(kv_span.attrs.get(f"bytes_{plane}", 0)) + int(n))
+            kv_span.set_attr(
+                "bytes", int(kv_span.attrs.get("bytes", 0)) + int(n))
+            try:
+                from dynamo_tpu.worker.metrics import get_worker_metrics
+                get_worker_metrics().disagg_kv_bytes.labels(
+                    "pulled", plane).inc(int(n))
+            except Exception:  # noqa: BLE001 — accounting must not fail IO
+                logger.exception("kv byte accounting failed")
+
+        try:
+            await self._pull_blocks_inner(hashes, iid, bulk_address,
+                                          direct_address, _count_bytes,
+                                          kv_span)
+        except BaseException as e:
+            kv_span.set_error(repr(e))
+            raise
+        finally:
+            kv_span.finish()
+
+    async def _pull_blocks_inner(self, hashes: list, iid: int,
+                                 bulk_address: str, direct_address: str,
+                                 _count_bytes, kv_span) -> None:
         injected = total = 0
         bulk_done = False
         now = time.monotonic()
@@ -421,9 +500,11 @@ class DisaggDecodeHandler:
                     data = await asyncio.wait_for(
                         asyncio.to_thread(self._direct_plane.pull, offer),
                         timeout=self.direct_pull_timeout)
+                    _count_bytes(getattr(data, "nbytes", 0), "direct")
                     injected = await self.engine.run_exclusive(
                         self._direct_plane.inject, self.engine, offer,
                         data)
+                    kv_span.set_attr("injected", injected)
                     logger.debug("device-direct pull injected %d blocks "
                                  "from %x", injected, iid)
                     try:  # release the peer's pinned offer promptly
@@ -475,6 +556,7 @@ class DisaggDecodeHandler:
                 nonlocal injected, total
                 meta = dict(meta)
                 meta["_raw"] = raw
+                _count_bytes(len(raw), "bulk")
                 total += len(meta["blocks"])
                 try:
                     injected += await self.engine.run_exclusive(
@@ -538,6 +620,7 @@ class DisaggDecodeHandler:
             legacy: list = []
             async for frame in kv_stream:
                 if "_raw" in frame:
+                    _count_bytes(len(frame["_raw"]), "rpc")
                     total += len(frame["blocks"])
                     injected += await self.engine.run_exclusive(
                         inject_frame, self.engine, frame)
@@ -551,6 +634,7 @@ class DisaggDecodeHandler:
                 injected += await self.engine.run_exclusive(
                     inject_blocks, self.engine, legacy)
         if total:
+            kv_span.set_attr("injected", injected)
             logger.debug("injected %d/%d transferred blocks",
                          injected, total)
 
@@ -679,9 +763,14 @@ class PrefillFirstHandler:
         preq.request_id = f"{request.request_id}-pf"
         preq.prefill_only = True
         final: Optional[LLMEngineOutput] = None
-        async for out in self.engine.generate(preq):
-            if out.finish_reason is not None:
-                final = out
+        stitcher = StageStitcher(get_tracer(), skip_decode=True)
+        try:
+            async for out in self.engine.generate(preq):
+                stitcher.on_frame(out)
+                if out.finish_reason is not None:
+                    final = out
+        finally:
+            stitcher.close()
         if final is None or final.error or not final.token_ids:
             logger.warning("local prefill leg failed; serving fully local")
             async for out in self.engine.generate(request, ctx):
@@ -699,11 +788,20 @@ class PrefillFirstHandler:
         fwd.kv_transfer_params = params
         relayed = False
         try:
+            tracer = get_tracer()
             iid = self._router.select_instance()
             stream = await self._decode_client.direct(
-                fwd.to_dict(), iid, deadline_headers(fwd.deadline_unix))
+                fwd.to_dict(), iid,
+                request_headers(fwd.deadline_unix, fwd.request_id))
             async for payload in stream:
+                if isinstance(payload, dict) and SPANS_FRAME_KEY in payload:
+                    # decode worker's fragment: adopt so it ships upward
+                    # with THIS worker's hop spans
+                    tracer.adopt(payload.pop(SPANS_FRAME_KEY))
                 out = LLMEngineOutput.from_dict(payload)
+                # the decode worker already turned its timing stamps into
+                # spans; relaying them would double-stitch queue/prefill
+                out.timings = None
                 relayed = relayed or bool(out.token_ids)
                 yield out
             return

@@ -462,7 +462,18 @@ async def amain(args: argparse.Namespace) -> None:
     else:
         await register_llm(drt, endpoint, card)
     from dynamo_tpu.runtime.system_server import SystemServer
-    system = SystemServer.from_env()
+    from dynamo_tpu.utils.tracing import get_tracer
+    from dynamo_tpu.worker.metrics import get_worker_metrics
+    # worker-side observability: admission/replay/disagg-KV counters plus
+    # the per-stage latency histogram on this worker's /metrics, and the
+    # flight recorder on /v1/traces (runtime/system_server.py)
+    tracer = get_tracer()
+    if not tracer.service:
+        tracer.service = (f"worker-{args.disagg}" if args.disagg != "none"
+                          else "worker")
+    wm = get_worker_metrics()
+    wm.attach_tracer(tracer)
+    system = SystemServer.from_env(registry=wm.registry, tracer=tracer)
     if system is not None:
         system.health.register("engine", ready=True)
         await system.start()

@@ -1,0 +1,74 @@
+"""Worker-side Prometheus metrics, served on the worker's system server.
+
+Until now admission/migration/disagg signals existed only as frontend
+metrics (``http/metrics.py``); a worker's own ``/metrics``
+(``DYN_SYSTEM_ENABLED=1``, ``runtime/system_server.py``) showed nothing about
+the requests it actually absorbed.  This registry closes that gap:
+
+- ``dynamo_worker_requests_total{outcome}`` — requests by admission outcome:
+  ``admitted``, ``refused_expired`` (deadline already passed on arrival),
+  ``deadline_cancelled`` (expired mid-generation), ``error``.
+- ``dynamo_worker_migration_replays_total`` — migration replays this worker
+  ABSORBED (requests re-issued by a frontend after another worker dropped
+  the stream; stamped via ``PreprocessedRequest.migration_attempt``).
+- ``dynamo_worker_disagg_kv_bytes_total{direction,plane}`` — disagg KV block
+  bytes moved, by direction (``pulled``) and transport plane
+  (``direct``/``bulk``/``rpc``) — the FlowKV-dominant cost made visible.
+- ``dynamo_tpu_stage_duration_seconds{stage}`` — per-stage latency breakdown
+  (queue/prefill/kv_transfer/decode/...), observed from locally-finished
+  trace spans (``http/metrics.StageMetrics`` listener), the same series the
+  frontend registers so dashboards join on one name.
+
+A process-wide singleton (``get_worker_metrics``) because the handler
+factories (``llm/register.engine_handler``) and the disagg handlers have no
+shared construction point; the worker main passes its registry to the
+system server.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from prometheus_client import CollectorRegistry, Counter
+
+from dynamo_tpu.http.metrics import StageMetrics
+
+
+class WorkerMetrics:
+    def __init__(self, registry: Optional[CollectorRegistry] = None):
+        self.registry = registry or CollectorRegistry()
+        ns = "dynamo_worker"
+        self.requests_total = Counter(
+            f"{ns}_requests_total",
+            "Requests by admission outcome (admitted, refused_expired, "
+            "deadline_cancelled, error)",
+            ["outcome"], registry=self.registry)
+        self.migration_replays = Counter(
+            f"{ns}_migration_replays_total",
+            "Migration replays absorbed (streams re-issued by a frontend "
+            "after another worker dropped them)",
+            registry=self.registry)
+        self.disagg_kv_bytes = Counter(
+            f"{ns}_disagg_kv_bytes_total",
+            "Disaggregated-prefill KV block bytes transferred, by direction "
+            "and transport plane (direct/bulk/rpc)",
+            ["direction", "plane"], registry=self.registry)
+        self.stage = StageMetrics(self.registry)
+
+    def attach_tracer(self, tracer) -> None:
+        """Observe stage spans finished in this process into the stage
+        histogram (idempotent per tracer)."""
+        self.stage.attach(tracer)
+
+
+_metrics: Optional[WorkerMetrics] = None
+
+
+def get_worker_metrics() -> WorkerMetrics:
+    global _metrics
+    if _metrics is None:
+        _metrics = WorkerMetrics()
+    return _metrics
+
+
+__all__ = ["WorkerMetrics", "get_worker_metrics"]

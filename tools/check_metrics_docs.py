@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""Fail when a registered Prometheus metric is missing from the docs.
+
+Usage: check_metrics_docs.py [DOC_PATH]   (default: docs/observability.md)
+
+Instantiates the real metric registries (frontend, worker, coordinator
+collector) and collects every series name they register, then greps the
+observability doc for each — so the doc and the code cannot drift: a new
+metric without a doc entry fails this check, which runs in the tier-1 pass
+as a fast unit test (tests/test_tracing.py::test_metrics_documented).
+
+Names are checked at the family level (``_total``/``_bucket``/``_sum``/
+``_count``/``_created`` sample suffixes normalized away), but counters are
+reported with their ``_total`` suffix — the form an operator greps for.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+import types
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def registered_metric_names() -> "set[str]":
+    """Every series name the in-tree registries expose, in the form an
+    operator sees on /metrics (counters carry their _total suffix)."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from dynamo_tpu.http.metrics import CoordClientMetrics, FrontendMetrics
+    from dynamo_tpu.worker.metrics import WorkerMetrics
+
+    names: set = set()
+    fm = FrontendMetrics()
+    # coordinator-health collector samples a live client; a stub with the
+    # same surface lets collect() run
+    CoordClientMetrics(types.SimpleNamespace(
+        connected=True, reconnects_total=0, resyncs_total=0,
+        last_outage_s=0.0), registry=fm.registry)
+    for registry in (fm.registry, WorkerMetrics().registry):
+        for family in registry.collect():
+            if family.type == "counter":
+                names.add(f"{family.name}_total")
+            else:
+                names.add(family.name)
+    return names
+
+
+def main(argv) -> int:
+    doc_path = argv[1] if len(argv) > 1 else os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "docs", "observability.md")
+    try:
+        with open(doc_path) as f:
+            doc = f.read()
+    except OSError as e:
+        print(f"cannot read {doc_path}: {e}", file=sys.stderr)
+        return 1
+    # two+ segments after the prefix, so repo paths like ``dynamo_tpu/...``
+    # don't register as metric mentions
+    documented = set(re.findall(r"\bdynamo_[a-z0-9]+_[a-z0-9_]+\b", doc))
+    registered = registered_metric_names()
+    missing = sorted(n for n in registered if n not in documented)
+    stale = sorted(d for d in documented
+                   if d not in registered
+                   # family-name mentions of a counter (no _total) are fine
+                   and f"{d}_total" not in registered)
+    rc = 0
+    if missing:
+        print(f"metrics registered in code but missing from {doc_path}:",
+              file=sys.stderr)
+        for n in missing:
+            print(f"  {n}", file=sys.stderr)
+        rc = 1
+    if stale:
+        print(f"metrics documented in {doc_path} but not registered "
+              "(renamed or removed?):", file=sys.stderr)
+        for n in stale:
+            print(f"  {n}", file=sys.stderr)
+        rc = 1
+    if rc == 0:
+        print(f"ok: {len(registered)} metrics all documented in {doc_path}")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
