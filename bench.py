@@ -357,7 +357,7 @@ async def run_attempt(args) -> dict:
         wd.arm("transport:bulk", STAGE_BUDGETS["transport"])
         kv_bulk_gbps = await _measure_kv_bulk(engine)
         wd.arm("transport:e2e", STAGE_BUDGETS["transport"])
-        kv_e2e_gbps = await _measure_kv_bulk_inject(engine)
+        kv_e2e_gbps, kv_e2e_phases = await _measure_kv_bulk_inject(engine)
         wd.arm("transport:direct", STAGE_BUDGETS["transport"])
         kv_direct_gbps = await asyncio.to_thread(_measure_kv_direct, engine)
 
@@ -396,6 +396,9 @@ async def run_attempt(args) -> dict:
         "kv_wire_gbps": kv_wire_gbps,
         "kv_bulk_gbps": kv_bulk_gbps,
         "kv_e2e_gbps": kv_e2e_gbps,
+        # per-phase ms (last rep): localizes an e2e regression to the
+        # recv/stage/upload/scatter leg without rerunning anything
+        "kv_e2e_phase_ms": kv_e2e_phases,
         "kv_direct_gbps": kv_direct_gbps,
         "prefill_tok_s": round(m["prefill_tok_s"], 1),
         "ttft_p50_s": round(m["ttft_p50"], 3),
@@ -590,12 +593,15 @@ def _bench_frames(engine, target_bytes: int = TRANSPORT_TARGET_BYTES):
     L = (len(engine.pages) if isinstance(engine.pages, list)
          else engine.pages.shape[0])
     blk_shape = (L,) + tuple(ref.shape[-4:])  # [L, 2, Hkv, ps, Dh]
-    blk_bytes = int(np.prod(blk_shape)) * 2   # uint16 payload
+    # payload in the CACHE dtype — what a real export ships (the inject
+    # half otherwise pays a synthetic dtype conversion no deployment pays)
+    page_dtype = np.dtype(ref.dtype)
+    blk_bytes = int(np.prod(blk_shape)) * page_dtype.itemsize
     n_frames = 8
     per_frame = max(4, -(-target_bytes // (n_frames * blk_bytes)))
-    chunk = np.ones((per_frame,) + blk_shape, np.uint16)
+    chunk = np.ones((per_frame,) + blk_shape, page_dtype)
     meta = {"blocks": [[i, i, None] for i in range(per_frame)],
-            "dtype": "uint16", "block_shape": list(blk_shape)}
+            "dtype": str(chunk.dtype), "block_shape": list(blk_shape)}
     return meta, chunk, n_frames
 
 
@@ -763,26 +769,27 @@ def _measure_kv_direct(engine):
         return None
 
 
-async def _measure_kv_bulk_inject(engine) -> float:
+async def _measure_kv_bulk_inject(engine):
     """END-TO-END disagg KV handoff bandwidth (GB/s): the prefill->decode
     path a real disagg deployment takes — bulk-socket fetch of
-    serving-geometry block frames AND host->device scatter of every frame
-    into the live page table, timed as one pipeline (VERDICT r4 item 3:
-    decide on-chip whether the host bounce is the bottleneck; compare
-    against ``kv_bulk_gbps``/``kv_inject_gbps`` which time the halves).
-    The per-frame receive work mirrors ``engine/transfer.inject_frame``:
-    zero-copy dtype reinterpret, block-major -> layer-major owning copy,
-    donated jitted scatter. Each rep runs inside an exclusive window (the
-    scatter reassigns ``engine.pages``)."""
+    serving-geometry LAYER-MAJOR frames driven through the REAL staged
+    inject pipeline (``engine/transfer.InjectPipeline``): stage into the
+    preallocated host buffer, async upload onto the cache sharding, and
+    batched donated scatters into the live page table, overlapped with
+    the remaining wire transfer. Returns ``(gbps, phases_ms)`` where
+    ``phases_ms`` localizes the time to recv/stage/upload/scatter (last
+    rep) so a BENCH_r*.json regression points at a phase, not a number."""
     import jax
-    import numpy as np
 
-    from dynamo_tpu.runtime.bulk import BulkServer, bulk_fetch, release_buffer
+    from dynamo_tpu.engine.transfer import InjectPipeline, pump_bulk_frames
+    from dynamo_tpu.runtime.bulk import BulkServer
 
-    # scatter targets: a fixed window of real page ids, reused per frame.
-    # On the tiny smoke config (few pages, tiny blocks) a 128 MB stream
-    # would mean thousands of windowed scatter dispatches per rep — scale
-    # the payload down there; the 3B tiers keep the full-size stream.
+    # scatter targets: a fixed window of real page ids, reused per commit
+    # (the commit override below bypasses the allocator — the bench reuses
+    # the same synthetic hashes every rep). On the tiny smoke config (few
+    # pages, tiny blocks) a 128 MB stream would mean thousands of windowed
+    # commits per rep — scale the payload down there; the 3B tiers keep
+    # the full-size stream.
     n_ids = min(64, engine.allocator.num_pages - 2)
     target = (TRANSPORT_TARGET_BYTES if n_ids >= 64
               else 16 * 1024 * 1024)
@@ -790,55 +797,77 @@ async def _measure_kv_bulk_inject(engine) -> float:
     per_frame = chunk.shape[0]
     n_ids = min(per_frame, n_ids)
     ids = list(range(1, n_ids + 1))
-    blk_shape = tuple(meta["block_shape"])
-    ref = engine.pages[0] if isinstance(engine.pages, list) else engine.pages
-    page_dtype = ref.dtype  # same itemsize as the uint16 wire payload
+    # layer-major wire frames (schema v3): [L, per_frame, 2, Hkv, ps, Dh]
+    import numpy as np
+    chunk = np.ascontiguousarray(np.moveaxis(chunk, 0, 1))
+    meta = dict(meta)
+    meta["layout"] = "layer"
+    # commit window sized in BYTES, not blocks: the serving tiers have
+    # ~MB blocks (64-block windows land in the tens of MB), but the tiny
+    # smoke config has ~KB blocks — a block-count window there would mean
+    # thousands of per-window upload/commit round trips per rep, and the
+    # e2e number would measure event-loop overhead instead of the pipeline
+    blk_bytes = chunk.nbytes // per_frame
+    win_blocks = max(n_ids, min(per_frame,
+                                (32 * 1024 * 1024) // blk_bytes))
 
     server = BulkServer(
         unix_path=f"/tmp/dynamo_bench_e2e_{os.getpid()}.sock").start()
     server.register("kv", lambda payload: (
         (meta, chunk) for _ in range(n_frames)))
 
-    def fetch_and_inject() -> int:
+    # fixed-id commit targets, CYCLED over the real page-id range (the
+    # tiny tier streams far more blocks than the cache has pages): every
+    # received block pays the scatter in ONE batched dispatch per window,
+    # without consuming the page pool on a synthetic stream
+    ids_cycle = np.asarray(
+        (ids * ((win_blocks + n_ids - 1) // n_ids))[:win_blocks], np.int32)
+
+    def commit(eng, metas, data):
+        w = ids_cycle[:len(metas)]
+        if isinstance(data, jax.Array):
+            eng.scatter_pages_device(w, data)
+        else:
+            eng.scatter_pages_host(w, data)
+        return len(metas)
+
+    phases = {}
+
+    async def fetch_once() -> int:
         got = 0
+        pipe = InjectPipeline(engine, window=win_blocks, commit=commit)
 
-        def on_frame(_m, raw):
+        def on_meta(_m, nbytes):
             nonlocal got
-            got += len(raw)
-            if np.dtype(page_dtype).itemsize == 2:
-                # bf16 cache (the TPU tiers): zero-copy reinterpret of the
-                # uint16 wire payload, exactly like inject_frame
-                arr = np.frombuffer(raw, page_dtype).reshape(
-                    (per_frame,) + blk_shape)
-            else:  # float32 tiny tier: parse, widen below
-                arr = np.frombuffer(raw, np.uint16).reshape(
-                    (per_frame,) + blk_shape)
-            # EVERY received block pays the layer-major copy + scatter
-            # (windowed over the page-id range when the frame holds more
-            # blocks than the cache has pages — the tiny tier — else the
-            # e2e number silently degrades into the bulk-fetch number)
-            for off in range(0, per_frame, n_ids):
-                sl = arr[off:off + n_ids]
-                vals = np.moveaxis(sl, 0, 1)
-                vals = (vals.copy() if vals.dtype == page_dtype
-                        else vals.astype(page_dtype))
-                engine.scatter_pages_host(ids[:sl.shape[0]], vals)
-            release_buffer(raw)
+            got += nbytes
 
-        bulk_fetch(server.address, "kv", {}, on_frame=on_frame)
-        # the scatters are dispatched async; make the rep time include the
-        # device actually finishing the writes
+        # the REAL stream-and-stage machinery disagg uses (backpressure,
+        # abort, zero-copy buffer ownership all included)
+        recv_s = await pump_bulk_frames(pipe, server.address, "kv", {},
+                                        "", 60.0, on_meta)
+        await pipe.finish()
+        # commits dispatch async; the rep time includes the device
+        # actually finishing the writes
         pages = (engine.pages[0] if isinstance(engine.pages, list)
                  else engine.pages)
         jax.block_until_ready(pages)
+        phases.clear()
+        phases.update(pipe.timings)
+        phases["recv_s"] = recv_s
         return got
 
-    async def fetch_once() -> int:
-        return await engine.run_exclusive(fetch_and_inject)
-
     try:
-        return await _time_transport("e2e (bulk+inject)", fetch_once,
+        gbps = await _time_transport("e2e (bulk+inject)", fetch_once,
                                      n_frames * chunk.nbytes)
+        phases_ms = {k[:-2]: round(v * 1e3, 1)
+                     for k, v in sorted(phases.items())}
+        print(f"bench: kv e2e phases (last rep, ms): "
+              f"recv {phases_ms.get('recv', 0)} "
+              f"stage {phases_ms.get('stage', 0)} "
+              f"upload {phases_ms.get('upload', 0)} "
+              f"scatter {phases_ms.get('scatter', 0)}",
+              file=sys.stderr, flush=True)
+        return gbps, phases_ms
     finally:
         server.stop()
 

@@ -211,7 +211,8 @@ class TieredEngine(EngineBase):
 
     async def _onboard_from_peers(self, token_ids: List[int]) -> int:
         """Fetch the first-missing chain suffix from any live peer."""
-        from dynamo_tpu.engine.transfer import inject_frame
+        from dynamo_tpu.engine.transfer import (
+            FRAME_WIRE_VERSION, InjectPipeline)
 
         page_size = self.engine.allocator.page_size
         hashes = compute_block_hash_for_seq(token_ids, page_size)
@@ -230,18 +231,30 @@ class TieredEngine(EngineBase):
         for iid in self._peer_client.instance_ids():
             if iid == self._self_instance_id:
                 continue
+            pipe = None
             try:
+                from dynamo_tpu.runtime.codec import release_buffer
                 stream = await self._peer_client.direct(
-                    {"block_hashes": want, "wire": 2}, iid)
+                    {"block_hashes": want, "wire": FRAME_WIRE_VERSION},
+                    iid)
+                # staged pipeline: frames batch into bounded donated
+                # scatters, so a big onboard doesn't stall decode steps
+                pipe = InjectPipeline(self.engine)
                 async for frame in stream:
                     if "_raw" not in frame:
                         continue
-                    injected += await self.engine.run_exclusive(
-                        inject_frame, self.engine, frame)
-                    # inject_frame copies; recycle the pooled trailer
-                    from dynamo_tpu.runtime.codec import release_buffer
-                    release_buffer(frame["_raw"])
-            except Exception as e:  # noqa: BLE001 — peers are best-effort
+                    # pipeline recycles the pooled trailer once consumed
+                    await pipe.add_frame(frame, release=release_buffer)
+                injected += await pipe.finish()
+            except BaseException as e:  # including CancelledError — the
+                # pipeline's in-flight commits must be reaped either way
+                if pipe is not None:
+                    # reap in-flight commits (no leaked task exceptions)
+                    # and keep what landed: content-addressed blocks from
+                    # a broken stream are still good prefix
+                    injected += await pipe.drain()
+                if not isinstance(e, Exception):
+                    raise  # cancellation propagates after the reap
                 logger.debug("G4 peer %x fetch failed: %s", iid, e)
                 continue
             if injected:
@@ -311,26 +324,37 @@ def collect_tiered_blocks(tiered: TieredEngine,
     return blocks
 
 
-def tiered_export_frames(tiered: TieredEngine, hashes: List[int]):
+def tiered_export_frames(tiered: TieredEngine, hashes: List[int],
+                         layout: str = "layer",
+                         frame_blocks: Optional[int] = None):
     """Batched Raw wire frames spanning HBM + tiers (the tier-aware
     counterpart of ``transfer.export_frames``; shared by the RPC and bulk
-    planes so neither silently misses tier-resident blocks). Runs under
-    ``run_exclusive``."""
-    from dynamo_tpu.engine.transfer import BLOCKS_PER_FRAME
+    planes so neither silently misses tier-resident blocks). ``layout``
+    follows the same wire schema: layer-major v3 for new pullers,
+    block-major v2 compat otherwise. Runs under ``run_exclusive``."""
+    from dynamo_tpu.engine.transfer import kv_transfer_defaults
     from dynamo_tpu.runtime.codec import Raw
 
+    # handlers resolve the knob outside the exclusive window and pass it
+    per = (int(frame_blocks) if frame_blocks
+           else kv_transfer_defaults()[0])
     blocks = collect_tiered_blocks(tiered, hashes)
     frames = []
-    for i in range(0, len(blocks), BLOCKS_PER_FRAME):
-        chunk = blocks[i:i + BLOCKS_PER_FRAME]
-        data = np.ascontiguousarray(
-            np.stack([b.data for b in chunk], axis=0))
-        frames.append(Raw({
-            "blocks": [[b.block_hash, b.local_hash, b.parent_hash]
-                       for b in chunk],
-            "dtype": str(data.dtype),
-            "block_shape": list(data.shape[1:]),
-        }, data))
+    for i in range(0, len(blocks), per):
+        chunk = blocks[i:i + per]
+        meta = {"blocks": [[b.block_hash, b.local_hash, b.parent_hash]
+                           for b in chunk]}
+        if layout == "layer":
+            data = np.ascontiguousarray(
+                np.stack([b.data for b in chunk], axis=1))
+            meta["block_shape"] = [data.shape[0]] + list(data.shape[2:])
+            meta["layout"] = "layer"
+        else:
+            data = np.ascontiguousarray(
+                np.stack([b.data for b in chunk], axis=0))
+            meta["block_shape"] = list(data.shape[1:])
+        meta["dtype"] = str(data.dtype)
+        frames.append(Raw(meta, data))
     return frames
 
 
@@ -338,12 +362,15 @@ def serve_tiered_kv_export(tiered: TieredEngine):
     """RPC handler: like ``transfer.serve_kv_export`` but also serves
     blocks held only in this worker's G2/G3 tiers — the provider side of
     the G4 remote tier (peers fetch what fell out of our HBM)."""
+    from dynamo_tpu.engine.transfer import resolve_wire
 
     async def handler(payload, ctx):
-        hashes = list((payload or {}).get("block_hashes", []))
-        if int((payload or {}).get("wire", 1)) >= 2:
+        payload = payload or {}
+        hashes = list(payload.get("block_hashes", []))
+        if int(payload.get("wire", 1)) >= 2:
+            layout, per = resolve_wire(payload, 1)
             frames = await tiered.engine.run_exclusive(
-                tiered_export_frames, tiered, hashes)
+                tiered_export_frames, tiered, hashes, layout, per)
             for f in frames:
                 yield f
         else:
@@ -362,11 +389,15 @@ def serve_tiered_kv_export_bulk(tiered: TieredEngine, loop):
     block."""
     import asyncio as _aio
 
+    from dynamo_tpu.engine.transfer import resolve_wire
+
     def handler(payload):
-        hashes = list((payload or {}).get("block_hashes", []))
+        payload = payload or {}
+        hashes = list(payload.get("block_hashes", []))
+        layout, per = resolve_wire(payload, 2)
         fut = _aio.run_coroutine_threadsafe(
             tiered.engine.run_exclusive(tiered_export_frames, tiered,
-                                        hashes), loop)
+                                        hashes, layout, per), loop)
         for f in fut.result(timeout=120.0):
             yield f.obj, f.raw
 

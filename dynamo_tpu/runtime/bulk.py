@@ -47,6 +47,29 @@ _U32 = struct.Struct(">I")
 
 BulkHandler = Callable[[Any], Iterable[Tuple[Dict[str, Any], Optional[Any]]]]
 
+# Explicit socket buffer sizing, both ends: kernel autotuning starts tiny
+# and takes tens of MB to ramp (the cold-connection penalty measured
+# below); asking for generous buffers up front starts the connection near
+# its steady rate. Best-effort — a kernel may clamp (rmem_max/wmem_max).
+_SOCK_BUF_BYTES = 8 * 1024 * 1024
+
+
+def _tune_socket(s: socket.socket) -> None:
+    try:
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, _SOCK_BUF_BYTES)
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, _SOCK_BUF_BYTES)
+    except OSError:
+        pass
+    if s.family != socket.AF_UNIX:  # TCP of either family
+        try:
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            # pooled connections sit idle between fetches: OS keepalive
+            # probes keep NAT/conntrack state alive and surface a dead
+            # peer as a pool-eviction instead of a stalled fetch
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_KEEPALIVE, 1)
+        except OSError:
+            pass
+
 
 def _recv_exact_into(sock: socket.socket, view: memoryview) -> None:
     got = 0
@@ -93,7 +116,9 @@ class BulkServer:
         # ~6 GB/s (measured here) — and colocated prefill/decode workers
         # are the common single-host disagg topology
         self.unix_path = unix_path
-        self._handlers: Dict[str, BulkHandler] = {}
+        # built-in warmup endpoint: streams zeros so clients can ramp a
+        # fresh connection's kernel buffers before the first real fetch
+        self._handlers: Dict[str, BulkHandler] = {"_warm": _warm_handler}
         self._socks: List[socket.socket] = []
         self._threads: List[threading.Thread] = []
         self._stopping = threading.Event()
@@ -161,8 +186,7 @@ class BulkServer:
                 conn, _addr = listen_sock.accept()
             except OSError:
                 return  # socket closed
-            if conn.family == socket.AF_INET:
-                conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            _tune_socket(conn)
             self._conns.add(conn)
             threading.Thread(target=self._serve_conn, args=(conn,),
                              name="bulk-conn", daemon=True).start()
@@ -237,10 +261,11 @@ def _connect(address: str, timeout: float) -> socket.socket:
                 s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
                 s.settimeout(timeout)
                 s.connect(ep[len("unix:"):])
+                _tune_socket(s)
                 return s
             host, port = ep.rsplit(":", 1)
             s = socket.create_connection((host, int(port)), timeout=timeout)
-            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            _tune_socket(s)
             return s
         except OSError as e:
             last_err = e
@@ -251,6 +276,90 @@ def _connect(address: str, timeout: float) -> socket.socket:
 # The receive-buffer freelist lives in runtime/codec.py (shared with the
 # RPC plane's large two-part trailers); ``release_buffer`` is re-exported
 # here because bulk consumers import it from this module.
+
+
+# warmup stream: enough bytes to ramp the kernel's per-connection buffer
+# autotuning (the first tens of MB through a fresh socket move at ~1/3 of
+# the steady rate on this host class), capped so a misbehaving client
+# can't turn the endpoint into a bandwidth sink
+_WARM_CHUNK = None  # lazily-built 4 MiB zero buffer, shared by all conns
+PREWARM_BYTES = 32 * 1024 * 1024
+_WARM_MAX_BYTES = 256 * 1024 * 1024
+
+
+def _warm_handler(payload):
+    global _WARM_CHUNK
+    if _WARM_CHUNK is None:
+        import numpy as _np
+        _WARM_CHUNK = _np.zeros(4 * 1024 * 1024, _np.uint8)
+    want = min(int((payload or {}).get("nbytes", PREWARM_BYTES)),
+               _WARM_MAX_BYTES)
+    sent = 0
+    while sent < want:
+        n = min(want - sent, _WARM_CHUNK.nbytes)
+        yield {"warm": True}, _WARM_CHUNK[:n]
+        sent += n
+
+
+def prewarm(address: str, ident: str = "", nbytes: int = PREWARM_BYTES,
+            conns: int = 1, timeout: float = 30.0) -> int:
+    """Open ``conns`` fresh connections to ``address``, stream ``nbytes``
+    of warmup traffic through each (ramping the kernel's per-connection
+    buffer autotuning), and PARK them in the client pool — subsequent
+    ``bulk_fetch`` calls to the address skip both the connection setup and
+    the cold-buffer penalty. Synchronous (run via a thread from async
+    code; see ``prewarm_async``). Returns connections successfully warmed
+    and pooled.
+
+    A server without the ``_warm`` endpoint (pre-knob builds) answers with
+    a clean error frame: the connection is still pooled — connection reuse
+    alone is most of the win."""
+    ok = 0
+    for _ in range(conns):
+        try:
+            s = _connect(address, timeout)
+        except (ConnectionError, OSError):
+            break  # peer unreachable: later fetches will report properly
+        try:
+            def sink(meta, raw):
+                if raw is not None and hasattr(raw, "nbytes"):
+                    release_buffer(raw)
+            _fetch_on(s, "_warm", {"nbytes": int(nbytes)}, ident, sink,
+                      None)
+        except RuntimeError:
+            pass  # old server: error frame arrived on a clean boundary
+        except (ConnectionError, OSError, ValueError):
+            try:
+                s.close()
+            except OSError:
+                pass
+            continue
+        _pool_put(address, s)
+        ok += 1
+    return ok
+
+
+def prewarm_async(address: str, ident: str = "",
+                  nbytes: int = PREWARM_BYTES, conns: int = 1,
+                  on_fail: Optional[Callable[[], None]] = None) -> None:
+    """Fire-and-forget ``prewarm`` in a daemon thread (callable from any
+    context, including the event loop). ``on_fail`` runs (in the thread)
+    when not a single connection warmed — callers use it to mark the
+    address un-warmed so a later attempt retries."""
+
+    def run():
+        ok = 0
+        try:
+            ok = prewarm(address, ident, nbytes, conns)
+        except Exception:  # noqa: BLE001 — warmup must never surface
+            logger.debug("bulk prewarm of %s failed", address, exc_info=True)
+        if not ok and on_fail is not None:
+            try:
+                on_fail()
+            except Exception:  # noqa: BLE001 — callback best-effort
+                pass
+
+    threading.Thread(target=run, name="bulk-prewarm", daemon=True).start()
 
 
 def _fetch_on(s: socket.socket, endpoint: str, payload: Any, ident: str,
@@ -377,4 +486,5 @@ def bulk_fetch(address: str, endpoint: str, payload: Any,
     return out
 
 
-__all__ = ["BulkServer", "bulk_fetch", "release_buffer", "BulkHandler"]
+__all__ = ["BulkServer", "bulk_fetch", "release_buffer", "BulkHandler",
+           "prewarm", "prewarm_async", "PREWARM_BYTES"]

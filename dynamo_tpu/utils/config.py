@@ -51,6 +51,16 @@ class RuntimeConfig:
     http_max_inflight: int = 0
     http_max_model_inflight: int = 0
     http_shed_retry_after_s: float = 1.0
+    # -- disagg KV-transfer tuning --------------------------------------
+    # blocks per wire frame on the batched KV export path (short-form env
+    # DYN_KV_FRAME_BLOCKS wins; see engine/transfer.py): big enough that
+    # per-frame overhead is noise, small enough to pipeline recv/inject
+    kv_frame_blocks: int = 16
+    # max blocks committed per exclusive-window donated scatter on the
+    # inject side (short-form env DYN_KV_SCATTER_BLOCKS wins): larger
+    # windows amortize jit dispatch, smaller windows bound how long a
+    # decode step can stall behind one KV commit
+    kv_scatter_blocks: int = 64
 
     @classmethod
     def load(cls, path: Optional[str] = None,

@@ -25,10 +25,12 @@ from typing import Any, AsyncIterator, Optional
 
 from dynamo_tpu.engine.jax_engine import JaxEngine
 from dynamo_tpu.engine.transfer import (
+    FRAME_WIRE_VERSION,
     KV_EXPORT_DIRECT_ENDPOINT,
     BlockPayload,
-    inject_blocks,
-    inject_frame,
+    InjectPipeline,
+    inject_device_windowed,
+    pump_bulk_frames,
 )
 from dynamo_tpu.protocols.common import (
     FinishReason,
@@ -48,6 +50,8 @@ from dynamo_tpu.utils.tracing import (
 logger = logging.getLogger(__name__)
 
 KV_EXPORT_ENDPOINT = "kv_export"
+
+
 
 
 def make_device_transfer_plane(engine: JaxEngine):
@@ -254,6 +258,10 @@ class DisaggDecodeHandler:
         # and wedge even the bulk fallback's to_thread calls)
         self.direct_down_window = 300.0
         self._direct_down_until: dict = {}
+        # bulk addresses already pre-warmed (one background warmup per
+        # peer: later fetches find pooled connections with ramped kernel
+        # buffers instead of paying the cold-socket penalty)
+        self._bulk_warmed: set = set()
 
     async def start(self) -> "DisaggDecodeHandler":
         ns = self.drt.namespace(self.namespace)
@@ -460,19 +468,27 @@ class DisaggDecodeHandler:
             except Exception:  # noqa: BLE001 — accounting must not fail IO
                 logger.exception("kv byte accounting failed")
 
+        # per-phase wall time (recv = socket/pull wait, stage = host copy
+        # into the scatter buffer, upload = host->device transfer, scatter
+        # = exclusive-window commits): the bulk-vs-e2e gap lives in these
+        phases = {"recv_s": 0.0, "stage_s": 0.0, "upload_s": 0.0,
+                  "scatter_s": 0.0}
         try:
             await self._pull_blocks_inner(hashes, iid, bulk_address,
                                           direct_address, _count_bytes,
-                                          kv_span)
+                                          kv_span, phases)
         except BaseException as e:
             kv_span.set_error(repr(e))
             raise
         finally:
+            for k, v in phases.items():
+                if v:
+                    kv_span.set_attr(k[:-2] + "_ms", round(v * 1e3, 3))
             kv_span.finish()
 
     async def _pull_blocks_inner(self, hashes: list, iid: int,
                                  bulk_address: str, direct_address: str,
-                                 _count_bytes, kv_span) -> None:
+                                 _count_bytes, kv_span, phases) -> None:
         injected = total = 0
         bulk_done = False
         now = time.monotonic()
@@ -497,13 +513,21 @@ class DisaggDecodeHandler:
                     # exclusive. A timed-out pull abandons its thread,
                     # evicts the connection, opens the circuit breaker for
                     # the address, and falls down the ladder.
+                    t0 = time.perf_counter()
                     data = await asyncio.wait_for(
                         asyncio.to_thread(self._direct_plane.pull, offer),
                         timeout=self.direct_pull_timeout)
+                    phases["recv_s"] += time.perf_counter() - t0
                     _count_bytes(getattr(data, "nbytes", 0), "direct")
-                    injected = await self.engine.run_exclusive(
-                        self._direct_plane.inject, self.engine, offer,
-                        data)
+                    # commit in bounded windows, one minimal exclusive
+                    # scatter each: decode steps interleave with a large
+                    # direct-plane inject instead of stalling behind it
+                    metas = [(b[0], b[1], b[2])
+                             for b in offer["blocks"]]
+                    t0 = time.perf_counter()
+                    injected = await inject_device_windowed(
+                        self.engine, metas, data[:, :len(metas)])
+                    phases["scatter_s"] += time.perf_counter() - t0
                     kv_span.set_attr("injected", injected)
                     logger.debug("device-direct pull injected %d blocks "
                                  "from %x", injected, iid)
@@ -532,107 +556,82 @@ class DisaggDecodeHandler:
                 logger.warning("device-direct KV pull from %s failed (%s); "
                                "trying the bulk plane", direct_address, e)
         if bulk_address:
-            from dynamo_tpu.runtime.bulk import bulk_fetch, release_buffer
-            # stream-and-inject: frames hop from the fetch thread into an
-            # asyncio queue; frame k injects while k+1 is still on the
-            # wire — same pipelining the RPC branch gets from its async
-            # iterator. A small in-flight window gives BACKPRESSURE (a slow
-            # injector must not buffer the whole prefix in RAM) and lets
-            # each injected frame's receive buffer go back to the bulk
-            # freelist, so steady-state fetches land in warm pages.
-            import threading
-            loop = asyncio.get_running_loop()
-            frame_q: asyncio.Queue = asyncio.Queue()
-            abort = threading.Event()
-            window = threading.Semaphore(4)  # frames in flight
+            from dynamo_tpu.runtime.bulk import prewarm_async
+            if bulk_address not in self._bulk_warmed:
+                # background warmup: THIS fetch still rides a cold socket,
+                # but every later fetch to the peer finds a pooled, ramped
+                # connection (and concurrent pulls find extra capacity).
+                # A warmup that fails outright un-marks the address so a
+                # later pull retries (peer briefly unreachable).
+                self._bulk_warmed.add(bulk_address)
+                prewarm_async(
+                    bulk_address, f"{iid:x}",
+                    on_fail=lambda a=bulk_address:
+                        self._bulk_warmed.discard(a))
+            pipe = InjectPipeline(self.engine)
 
-            def on_frame(meta, raw):
-                while not window.acquire(timeout=0.5):
-                    if abort.is_set():
-                        raise ConnectionError("bulk fetch aborted")
-                loop.call_soon_threadsafe(frame_q.put_nowait, (meta, raw))
-
-            async def inject_one(meta, raw):
-                nonlocal injected, total
-                meta = dict(meta)
-                meta["_raw"] = raw
-                _count_bytes(len(raw), "bulk")
+            def on_meta(meta, nbytes):
+                nonlocal total
+                _count_bytes(nbytes, "bulk")
                 total += len(meta["blocks"])
-                try:
-                    injected += await self.engine.run_exclusive(
-                        inject_frame, self.engine, meta)
-                finally:
-                    release_buffer(raw)
-                    window.release()
 
-            fetch = asyncio.create_task(asyncio.to_thread(
-                bulk_fetch, bulk_address, KV_EXPORT_ENDPOINT,
-                {"block_hashes": hashes}, f"{iid:x}", 60.0, on_frame,
-                abort))
             try:
-                while True:
-                    get = asyncio.ensure_future(frame_q.get())
-                    done, _ = await asyncio.wait(
-                        {get, fetch}, return_when=asyncio.FIRST_COMPLETED)
-                    if get in done:
-                        meta, raw = get.result()
-                        await inject_one(meta, raw)
-                        continue
-                    get.cancel()
-                    await fetch  # raises on transport/handler error
-                    while not frame_q.empty():  # drain the tail
-                        meta, raw = frame_q.get_nowait()
-                        await inject_one(meta, raw)
-                    bulk_done = True
-                    break
+                # stream-and-stage (engine/transfer.pump_bulk_frames):
+                # frames stage/commit while later frames are still on the
+                # wire, wire buffers recycle through the pipeline
+                phases["recv_s"] += await pump_bulk_frames(
+                    pipe, bulk_address, KV_EXPORT_ENDPOINT,
+                    {"block_hashes": hashes, "wire": FRAME_WIRE_VERSION},
+                    f"{iid:x}", 60.0, on_meta)
+                injected += await pipe.finish()
+                bulk_done = True
             except Exception as e:  # noqa: BLE001 — bulk plane unreachable
                 # (e.g. worker bound to 127.0.0.1 across hosts): the RPC
                 # export path below still works — never waste the completed
-                # remote prefill over a transport problem. abort BEFORE
-                # awaiting: a to_thread task only completes when its thread
-                # exits, and the thread exits via the abort check. Then reap
-                # the task so it neither streams frames into the void nor
-                # logs an unretrieved exception.
-                abort.set()
-                if not fetch.done():
-                    fetch.cancel()
-                try:
-                    await fetch
-                except (Exception, asyncio.CancelledError):  # noqa: BLE001
-                    pass
+                # remote prefill over a transport problem. pump already
+                # reaped its fetch thread and in-flight commits; whatever
+                # committed cleanly stays (content-addressed blocks are
+                # never wasted, the RPC retry dedups against them).
+                injected += pipe.injected
                 logger.warning("bulk KV fetch from %s failed (%s); falling "
                                "back to the RPC export path",
                                bulk_address, e)
             finally:
-                # ALWAYS tell the fetch thread to stop — including on task
-                # CancellationError (client disconnect), which `except
-                # Exception` does not catch: a cancelled to_thread keeps
-                # its worker thread alive, and without abort the on_frame
-                # backpressure loop would spin on window.acquire forever
-                abort.set()
+                for k, v in pipe.timings.items():
+                    phases[k] += v
         if not bulk_done:
             from dynamo_tpu.runtime.codec import release_buffer
 
             kv_stream = await self._kv_client.direct(
-                {"block_hashes": hashes, "wire": 2}, iid)
-            # batched two-part frames: inject frame k while frame k+1
-            # is still in flight (pipelined, zero msgpack re-copies)
-            legacy: list = []
-            async for frame in kv_stream:
-                if "_raw" in frame:
-                    _count_bytes(len(frame["_raw"]), "rpc")
-                    total += len(frame["blocks"])
-                    injected += await self.engine.run_exclusive(
-                        inject_frame, self.engine, frame)
-                    # inject_frame made its owning copy; recycle the
-                    # pooled trailer buffer for the next frame
-                    release_buffer(frame["_raw"])
-                else:  # pre-batched single-block schema
-                    legacy.append(BlockPayload.from_wire(frame))
-            if legacy:
-                total += len(legacy)
-                injected += await self.engine.run_exclusive(
-                    inject_blocks, self.engine, legacy)
+                {"block_hashes": hashes, "wire": FRAME_WIRE_VERSION}, iid)
+            # batched two-part frames through the staged pipeline: frame k
+            # stages/commits while frame k+1 is still in flight (zero
+            # msgpack re-copies). Old exporters answering with the
+            # per-block schema ride the same pipeline via add_blocks.
+            pipe = InjectPipeline(self.engine)
+            try:
+                t0 = time.perf_counter()
+                async for frame in kv_stream:
+                    phases["recv_s"] += time.perf_counter() - t0
+                    if "_raw" in frame:
+                        _count_bytes(len(frame["_raw"]), "rpc")
+                        total += len(frame["blocks"])
+                        # pipeline recycles the pooled trailer buffer
+                        # once its bytes are consumed
+                        await pipe.add_frame(frame,
+                                             release=release_buffer)
+                    else:  # pre-batched single-block schema
+                        total += 1
+                        await pipe.add_blocks(
+                            [BlockPayload.from_wire(frame)])
+                    t0 = time.perf_counter()
+                injected += await pipe.finish()
+            except BaseException:
+                await pipe.drain()
+                raise
+            finally:
+                for k, v in pipe.timings.items():
+                    phases[k] += v
         if total:
             kv_span.set_attr("injected", injected)
             logger.debug("injected %d/%d transferred blocks",
