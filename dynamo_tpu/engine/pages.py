@@ -136,6 +136,23 @@ class PageAllocator:
             match.block_hashes.append(h)
         return match
 
+    def claim_blocks(self, block_hashes: List[int]) -> List[int]:
+        """Incref every resident page of the leading chain of
+        ``block_hashes`` (stops at the first miss — later blocks are
+        useless without their parents). Returns the claimed page ids; the
+        caller owns one reference per page and must ``release`` them.
+        This is the pin primitive for KV export leases
+        (``engine/transfer.ExportLeaseManager``): a pinned page can be
+        neither evicted nor reused until the lease is acked or reclaimed."""
+        pages: List[int] = []
+        for h in block_hashes:
+            page = self._by_hash.get(h)
+            if page is None:
+                break
+            self.incref(page)
+            pages.append(page)
+        return pages
+
     def peek_prefix(self, block_hashes: List[int]) -> int:
         """How many leading blocks are resident — no claim, no state change."""
         n = 0

@@ -22,7 +22,9 @@ from __future__ import annotations
 import asyncio
 import logging
 import os
+import threading
 import time
+import zlib
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -219,9 +221,19 @@ SCATTER_WINDOW_BLOCKS = 64
 # wire schema: 1 = per-block msgpack dicts (``BlockPayload``), 2 = batched
 # block-major two-part frames, 3 = batched LAYER-major frames (the staged
 # inject path stages them with a straight strided copy — no per-frame
-# transpose). Pullers advertise the highest version they speak; exporters
-# serve the min of that and their own, so mixed-version pulls keep working.
-FRAME_WIRE_VERSION = 3
+# transpose), 4 = v3 frames carrying a per-frame ``crc32`` of the raw
+# bytes (the inject side verifies BEFORE staging — a truncated/corrupted
+# frame is rejected, never silently injected as garbage KV). Pullers
+# advertise the highest version they speak; exporters serve the min of
+# that and their own, so mixed-version pulls keep working (a v3 puller
+# just gets frames without the checksum key; a v4 puller talking to a v3
+# exporter sees no ``crc32`` and skips verification).
+FRAME_WIRE_VERSION = 4
+
+
+class FrameIntegrityError(ValueError):
+    """A wire frame's bytes do not match its advertised crc32 — the frame
+    was truncated or corrupted in transit and must not be injected."""
 
 
 # TOML-layer cache for kv_transfer_defaults: with DYN_CONFIG_PATH set,
@@ -278,16 +290,25 @@ def kv_transfer_defaults() -> Tuple[int, int]:
     return max(1, frame), max(1, window)
 
 
-def resolve_wire(payload: Any, default_wire: int) -> Tuple[str, int]:
-    """(frame layout, frame blocks) for an export request's advertised
-    wire version — the one place the version -> layout mapping lives, and
-    resolved OUTSIDE the exclusive window (``kv_transfer_defaults`` can
-    touch the config file). ``default_wire`` encodes what a client that
-    omits the key speaks: 1 on the RPC plane (per-block era), 2 on the
-    bulk plane (which never carried the per-block schema)."""
+def frame_crc_enabled() -> bool:
+    """Per-frame crc32 on wire-v4 exports (``DYN_KV_FRAME_CRC=0``
+    disables — the inject side simply sees no ``crc32`` key)."""
+    return os.environ.get("DYN_KV_FRAME_CRC", "1") not in ("0", "false", "")
+
+
+def resolve_wire(payload: Any, default_wire: int) -> Tuple[str, int, bool]:
+    """(frame layout, frame blocks, checksum) for an export request's
+    advertised wire version — the one place the version -> layout mapping
+    lives, and resolved OUTSIDE the exclusive window
+    (``kv_transfer_defaults`` can touch the config file). ``default_wire``
+    encodes what a client that omits the key speaks: 1 on the RPC plane
+    (per-block era), 2 on the bulk plane (which never carried the
+    per-block schema). ``checksum`` is True when the puller speaks wire
+    v4+ (and the exporter hasn't disabled crc)."""
     wire = int((payload or {}).get("wire", default_wire))
-    layout = "layer" if wire >= FRAME_WIRE_VERSION else "block"
-    return layout, kv_transfer_defaults()[0]
+    layout = "layer" if wire >= 3 else "block"
+    checksum = wire >= 4 and frame_crc_enabled()
+    return layout, kv_transfer_defaults()[0], checksum
 
 
 def export_frames(engine: JaxEngine, block_hashes: List[int],
@@ -336,7 +357,36 @@ def export_frames(engine: JaxEngine, block_hashes: List[int],
             meta = {"blocks": blocks, "dtype": str(chunk.dtype),
                     "block_shape": list(chunk.shape[1:])}
         frames.append(Raw(meta, chunk))
+    # wire-v4 checksums are stamped by the serving handlers AFTERWARD via
+    # ``stamp_frame_crcs`` — outside the exclusive window this runs under
     return frames
+
+
+def stamp_frame_crcs(frames: List[Raw]) -> List[Raw]:
+    """Stamp the wire-v4 per-frame crc32 onto already-exported frames.
+    Serving handlers call this OUTSIDE the engine's exclusive window (the
+    checksum is a per-byte pass over host memory — it must not stall the
+    decode loop the way work inside ``run_exclusive`` would)."""
+    for f in frames:
+        f.obj["crc32"] = zlib.crc32(byte_view(f.raw)) & 0xFFFFFFFF
+    return frames
+
+
+def verify_frame(meta: Dict[str, Any], raw: Any) -> None:
+    """Check a wire frame's bytes against its advertised ``crc32`` (wire
+    v4); frames from older exporters carry no checksum and pass. Raises
+    ``FrameIntegrityError`` on mismatch — the one gate between the wire
+    and the cache, shared by every inject path via ``frame_arrays``."""
+    want = meta.get("crc32")
+    if want is None:
+        return
+    got = zlib.crc32(byte_view(raw)) & 0xFFFFFFFF
+    if got != int(want):
+        raise FrameIntegrityError(
+            f"KV frame checksum mismatch: crc32 {got:#010x} != advertised "
+            f"{int(want):#010x} over {len(memoryview(byte_view(raw)))} "
+            f"bytes ({len(meta.get('blocks', []))} blocks) — frame "
+            f"corrupted or truncated in transit")
 
 
 def frame_arrays(meta: Dict[str, Any]
@@ -346,8 +396,12 @@ def frame_arrays(meta: Dict[str, Any]
     layer-major ``[L, n, 2, Hkv, ps, Dh]`` ndarray VIEW aliasing
     ``meta["_raw"]`` — callers must copy (stage) before releasing the wire
     buffer. Handles both the v3 layer-major and v2 block-major layouts
-    (``block_shape`` is the per-block ``[L, 2, Hkv, ps, Dh]`` in both)."""
+    (``block_shape`` is the per-block ``[L, 2, Hkv, ps, Dh]`` in both).
+    Wire-v4 frames are checksum-verified here — every inject path decodes
+    through this function, so a corrupted frame can never reach the
+    cache (raises ``FrameIntegrityError``)."""
     raw = meta["_raw"]
+    verify_frame(meta, raw)
     bs = list(meta["block_shape"])
     n = len(meta["blocks"])
     arr = np.frombuffer(raw, dtype=np.dtype(meta["dtype"]))
@@ -801,12 +855,16 @@ def serve_kv_export_bulk(engine: JaxEngine, loop):
         hashes = list(payload.get("block_hashes", []))
         # clients that predate wire v3 omit the key and get the block-major
         # v2 frames they expect (mixed-version pulls keep working)
-        layout, per = resolve_wire(payload, 2)
+        layout, per, crc = resolve_wire(payload, 2)
         fut = asyncio.run_coroutine_threadsafe(
             engine.run_exclusive(export_frames, engine, hashes, layout,
                                  per),
             loop)
-        for f in fut.result(timeout=120.0):
+        frames = fut.result(timeout=120.0)
+        if crc:  # checksummed in THIS (bulk connection) thread — never
+            # inside the exclusive window, never on the event loop
+            stamp_frame_crcs(frames)
+        for f in frames:
             yield f.obj, f.raw
 
     return handler
@@ -825,12 +883,22 @@ def serve_kv_export(engine: JaxEngine):
 
     async def handler(payload: Any, ctx):
         payload = payload or {}
+        if payload.get("ack_lease") is not None:
+            # puller committed (or abandoned) its pull: release the export
+            # lease so the pinned pages go back to the LRU now instead of
+            # waiting out the TTL
+            ok = await release_export_lease(engine,
+                                            int(payload["ack_lease"]))
+            yield {"acked": bool(ok)}
+            return
         hashes = list(payload.get("block_hashes", []))
         wire = int(payload.get("wire", 1))
         if wire >= 2:
-            layout, per = resolve_wire(payload, 1)
+            layout, per, crc = resolve_wire(payload, 1)
             frames = await engine.run_exclusive(export_frames, engine,
                                                 hashes, layout, per)
+            if crc:  # outside the exclusive window
+                stamp_frame_crcs(frames)
             for f in frames:
                 yield f
         else:
@@ -840,6 +908,257 @@ def serve_kv_export(engine: JaxEngine):
                 yield b.to_wire()
 
     return handler
+
+
+# ---------------------------------------------------------------------------
+# Export leases: TTL-bounded pinning of advertised KV blocks
+# ---------------------------------------------------------------------------
+
+# default lease lifetime; env DYN_KV_EXPORT_TTL_S overrides per grant
+EXPORT_TTL_S = 120.0
+
+
+def export_ttl_s() -> float:
+    raw = os.environ.get("DYN_KV_EXPORT_TTL_S")
+    if raw is None:
+        return EXPORT_TTL_S
+    try:
+        return max(0.1, float(raw))
+    except (TypeError, ValueError):
+        logger.warning("malformed DYN_KV_EXPORT_TTL_S %r; using %.0f",
+                       raw, EXPORT_TTL_S)
+        return EXPORT_TTL_S
+
+
+class ExportLeaseManager:
+    """TTL'd pins on KV pages a prefill worker has advertised for pull.
+
+    Without leases the handoff window is fragile both ways: the advertised
+    blocks sit refcount-0 in the LRU and can be EVICTED before the decode
+    side pulls them (wasting the remote prefill), while naive permanent
+    pinning would let a decode worker that crashes after prefill strand
+    pages forever. A lease pins the pages (``PageAllocator.claim_blocks``)
+    until the puller acks (``{"ack_lease": id}`` on the kv_export
+    endpoint) or the TTL (``DYN_KV_EXPORT_TTL_S``) expires and a GC sweep
+    reclaims them — so orphaned KV from crashed decoders is bounded AND
+    observable (``dynamo_worker_kv_exports_active`` /
+    ``_reclaimed_total``).
+
+    Allocator mutations run under ``run_exclusive`` (grant/release/sweep
+    are host-metadata-only but the allocator is also touched from
+    exclusive worker threads); sweeps are armed per grant with
+    ``loop.call_later`` — no long-lived GC task to leak across engine
+    lifetimes. Pinned pages are capped at half the allocator so a flood
+    of un-acked exports can never starve prefill admission."""
+
+    def __init__(self, engine: JaxEngine):
+        self._engine = engine
+        self._leases: Dict[int, Tuple[float, List[int]]] = {}
+        self._next_id = 1
+        self._lock = threading.Lock()
+        self._sweep_tasks: set = set()
+        self.granted_total = 0
+        self.reclaimed_total = 0
+        self.max_pinned_pages = max(1,
+                                    (engine.allocator.num_pages - 1) // 2)
+
+    # -- observers ---------------------------------------------------------
+
+    @property
+    def active(self) -> int:
+        with self._lock:
+            return len(self._leases)
+
+    @property
+    def pinned_pages(self) -> int:
+        with self._lock:
+            return sum(len(p) for _dl, p in self._leases.values())
+
+    def _gauge(self) -> None:
+        try:
+            from dynamo_tpu.worker.metrics import get_worker_metrics
+            get_worker_metrics().kv_exports_active.set(self.active)
+        except Exception:  # noqa: BLE001 — metrics must not fail the grant
+            pass
+
+    # -- allocator-side halves (run under run_exclusive) -------------------
+
+    def _grant_sync(self, hashes: List[int], ttl: float) -> Optional[int]:
+        self._sweep_sync()  # reclaim expired pins before the cap check
+        alloc = self._engine.allocator
+        with self._lock:
+            pinned = sum(len(p) for _dl, p in self._leases.values())
+            budget = self.max_pinned_pages - pinned
+            if budget <= 0:
+                logger.warning(
+                    "export lease refused: %d pages already pinned "
+                    "(cap %d) — decode pulls failing or not acking?",
+                    pinned, self.max_pinned_pages)
+                return None
+            pages = alloc.claim_blocks(hashes)
+            if len(pages) > budget:
+                # the cap is a hard bound, not a pre-check: trim the claim
+                # so ONE big grant can never push pinned pages past it and
+                # starve prefill admission — a head-of-chain pin is still
+                # worth having (the tail stays ordinary LRU)
+                alloc.release(pages[budget:])
+                pages = pages[:budget]
+            if not pages:
+                return None
+            lease_id = self._next_id
+            self._next_id += 1
+            self._leases[lease_id] = (time.monotonic() + ttl, pages)
+            self.granted_total += 1
+        self._gauge()
+        return lease_id
+
+    def _release_sync(self, lease_id: int) -> bool:
+        with self._lock:
+            ent = self._leases.pop(lease_id, None)
+        if ent is None:
+            return False
+        self._engine.allocator.release(ent[1])
+        self._gauge()
+        return True
+
+    def _sweep_sync(self) -> int:
+        now = time.monotonic()
+        with self._lock:
+            expired = [(i, self._leases[i])
+                       for i, (dl, _p) in list(self._leases.items())
+                       if dl <= now]
+            for i, _e in expired:
+                del self._leases[i]
+            self.reclaimed_total += len(expired)
+        for _i, (_dl, pages) in expired:
+            self._engine.allocator.release(pages)
+        if expired:
+            logger.warning("reclaimed %d orphaned KV export lease(s) "
+                           "(%d pages) past TTL", len(expired),
+                           sum(len(p) for _i, (_d, p) in expired))
+            self._gauge()
+            try:
+                from dynamo_tpu.worker.metrics import get_worker_metrics
+                get_worker_metrics().kv_exports_reclaimed.inc(len(expired))
+            except Exception:  # noqa: BLE001
+                pass
+        return len(expired)
+
+    # -- async surface (event loop) ----------------------------------------
+
+    async def grant(self, hashes: List[int],
+                    ttl: Optional[float] = None) -> Optional[int]:
+        """Pin the resident chain of ``hashes`` for one pull; returns the
+        lease id (wire-safe) or None when nothing is resident / the pin
+        cap is hit (the export still works, it just isn't protected)."""
+        ttl = export_ttl_s() if ttl is None else float(ttl)
+        lease = await self._engine.run_exclusive(self._grant_sync,
+                                                 list(hashes), ttl)
+        if lease is not None:
+            self._arm_sweep(ttl)
+        return lease
+
+    async def release(self, lease_id: int) -> bool:
+        return await self._engine.run_exclusive(self._release_sync,
+                                                int(lease_id))
+
+    def _arm_sweep(self, ttl: float) -> None:
+        # one timer per grant, firing just past that lease's deadline: a
+        # sweep reclaims EVERY expired lease, and a dropped timer (loop
+        # closed) costs nothing — no persistent GC task to leak
+        loop = asyncio.get_running_loop()
+        loop.call_later(ttl + 0.02, self._sweep_soon, loop)
+
+    def _sweep_soon(self, loop) -> None:
+        with self._lock:
+            if not self._leases:
+                return
+        task = loop.create_task(self._sweep_async())
+        self._sweep_tasks.add(task)
+        task.add_done_callback(self._sweep_tasks.discard)
+
+    async def _sweep_async(self) -> None:
+        eng = self._engine
+        try:
+            if (getattr(eng, "_stopping", False)
+                    or eng._loop_task is None or eng._loop_task.done()):
+                # engine loop is gone: nothing races the allocator anymore
+                # (and run_exclusive would restart the loop) — sweep inline
+                self._sweep_sync()
+            else:
+                await eng.run_exclusive(self._sweep_sync)
+        except Exception:  # noqa: BLE001 — GC is best-effort
+            logger.debug("export lease sweep failed", exc_info=True)
+
+
+def _lease_engine(engine) -> Optional[JaxEngine]:
+    """The JaxEngine whose allocator holds the advertised blocks, or None
+    when ``engine`` has no page allocator (Echo/Mocker engines, disagg
+    handlers). Unwraps one wrapper layer (``TieredEngine.engine``)."""
+    for cand in (engine, getattr(engine, "engine", None)):
+        if (cand is not None and hasattr(cand, "allocator")
+                and hasattr(cand, "run_exclusive")):
+            return cand
+    return None
+
+
+def get_export_leases(engine) -> Optional[ExportLeaseManager]:
+    """The per-engine lease manager (created on first use), or None when
+    the engine cannot pin pages."""
+    eng = _lease_engine(engine)
+    if eng is None:
+        return None
+    mgr = getattr(eng, "_export_leases", None)
+    if mgr is None:
+        mgr = ExportLeaseManager(eng)
+        eng._export_leases = mgr
+    return mgr
+
+
+async def grant_export_lease(engine, hashes: List[int],
+                             ttl: Optional[float] = None) -> Optional[int]:
+    """Pin ``hashes`` on ``engine`` under a TTL'd export lease; returns
+    the lease id for the puller to ack, or None (no-op engines, nothing
+    resident, pin cap). Never raises — an unprotected export beats a
+    failed prefill."""
+    mgr = get_export_leases(engine)
+    if mgr is None or not hashes:
+        return None
+    try:
+        return await mgr.grant(hashes, ttl)
+    except Exception:  # noqa: BLE001 — lease is protection, not a gate
+        logger.exception("export lease grant failed")
+        return None
+
+
+async def stamp_export_lease(engine, params: Optional[Dict[str, Any]],
+                             span=None) -> Optional[int]:
+    """Grant an export lease for ``params["blocks"]`` and stamp the id
+    into ``params["lease"]`` (+ a ``kv_export_lease`` span attr) — the
+    one protocol shared by every export-advertising site (direct prefill
+    handler, queue worker, prefill-first forward)."""
+    blocks = (params or {}).get("blocks")
+    if not blocks:
+        return None
+    lease = await grant_export_lease(engine, [b[0] for b in blocks])
+    if lease is not None:
+        params["lease"] = lease
+        if span is not None:
+            span.set_attr("kv_export_lease", lease)
+    return lease
+
+
+async def release_export_lease(engine, lease_id: int) -> bool:
+    """Ack one export lease (puller-side commit/abandon signal)."""
+    eng = _lease_engine(engine)
+    mgr = getattr(eng, "_export_leases", None) if eng is not None else None
+    if mgr is None:
+        return False
+    try:
+        return await mgr.release(lease_id)
+    except Exception:  # noqa: BLE001 — TTL covers a failed release
+        logger.debug("export lease release failed", exc_info=True)
+        return False
 
 
 # ---------------------------------------------------------------------------
@@ -985,6 +1304,17 @@ class DeviceTransferPlane:
             self._offers.pop(uuid, None)
             self._prune_offers_locked(time.time())
 
+    def evict_expired_offers(self) -> int:
+        """Drop every offer past ``OFFER_TTL_S`` (the decode side never
+        pulled/acked — crashed or wedged); returns how many were
+        reclaimed. The same pruning runs inline on offer/ack, so this is
+        the explicit GC entry for sweeps and tests."""
+        now = time.time()
+        with self._lock:
+            before = len(self._offers)
+            self._prune_offers_locked(now)
+            return before - len(self._offers)
+
     # -- destination (decode) side -----------------------------------------
 
     def pull(self, offer: Dict[str, Any]):
@@ -1075,9 +1405,14 @@ KV_EXPORT_DIRECT_ENDPOINT = "kv_export_direct"
 
 __all__ = ["BlockPayload", "export_blocks", "inject_blocks",
            "export_frames", "inject_frame", "frame_arrays",
+           "verify_frame", "FrameIntegrityError",
            "InjectPipeline", "inject_device_windowed", "pump_bulk_frames",
            "transfer_blocks_ici", "serve_kv_export",
            "serve_kv_export_bulk", "BLOCKS_PER_FRAME",
            "SCATTER_WINDOW_BLOCKS", "FRAME_WIRE_VERSION",
-           "kv_transfer_defaults", "resolve_wire", "DeviceTransferPlane",
-           "serve_kv_export_direct", "KV_EXPORT_DIRECT_ENDPOINT"]
+           "kv_transfer_defaults", "resolve_wire", "frame_crc_enabled",
+           "ExportLeaseManager", "get_export_leases", "grant_export_lease",
+           "release_export_lease", "stamp_export_lease",
+           "stamp_frame_crcs", "export_ttl_s", "EXPORT_TTL_S",
+           "DeviceTransferPlane", "serve_kv_export_direct",
+           "KV_EXPORT_DIRECT_ENDPOINT"]

@@ -231,6 +231,12 @@ class TieredEngine(EngineBase):
         for iid in self._peer_client.instance_ids():
             if iid == self._self_instance_id:
                 continue
+            # resume across peers: blocks a previous (partially failed)
+            # peer fetch already committed are content-addressed resident
+            # — the next peer only serves what is still missing
+            want = [h for h in want if h not in resident]
+            if not want:
+                break
             pipe = None
             try:
                 from dynamo_tpu.runtime.codec import release_buffer
@@ -257,8 +263,10 @@ class TieredEngine(EngineBase):
                     raise  # cancellation propagates after the reap
                 logger.debug("G4 peer %x fetch failed: %s", iid, e)
                 continue
-            if injected:
-                break  # content-addressed: any one peer's copy suffices
+            # no break on success: a peer that cleanly served only part of
+            # the chain (the rest fell out of its tiers) is not the end —
+            # the top-of-loop want-filter stops the walk once nothing is
+            # missing, and otherwise the next peer serves the remainder
         self.peer_onboarded += injected
         return injected
 
@@ -331,7 +339,9 @@ def tiered_export_frames(tiered: TieredEngine, hashes: List[int],
     counterpart of ``transfer.export_frames``; shared by the RPC and bulk
     planes so neither silently misses tier-resident blocks). ``layout``
     follows the same wire schema: layer-major v3 for new pullers,
-    block-major v2 compat otherwise. Runs under ``run_exclusive``."""
+    block-major v2 compat otherwise; wire-v4 checksums are stamped by the
+    handlers afterward (``transfer.stamp_frame_crcs``, outside the
+    exclusive window). Runs under ``run_exclusive``."""
     from dynamo_tpu.engine.transfer import kv_transfer_defaults
     from dynamo_tpu.runtime.codec import Raw
 
@@ -362,15 +372,25 @@ def serve_tiered_kv_export(tiered: TieredEngine):
     """RPC handler: like ``transfer.serve_kv_export`` but also serves
     blocks held only in this worker's G2/G3 tiers — the provider side of
     the G4 remote tier (peers fetch what fell out of our HBM)."""
-    from dynamo_tpu.engine.transfer import resolve_wire
+    from dynamo_tpu.engine.transfer import release_export_lease, resolve_wire
 
     async def handler(payload, ctx):
         payload = payload or {}
+        if payload.get("ack_lease") is not None:
+            # puller committed its pull: unpin the export lease now
+            # instead of waiting out the TTL GC
+            ok = await release_export_lease(tiered.engine,
+                                            int(payload["ack_lease"]))
+            yield {"acked": bool(ok)}
+            return
         hashes = list(payload.get("block_hashes", []))
         if int(payload.get("wire", 1)) >= 2:
-            layout, per = resolve_wire(payload, 1)
+            layout, per, crc = resolve_wire(payload, 1)
             frames = await tiered.engine.run_exclusive(
                 tiered_export_frames, tiered, hashes, layout, per)
+            if crc:  # outside the exclusive window
+                from dynamo_tpu.engine.transfer import stamp_frame_crcs
+                stamp_frame_crcs(frames)
             for f in frames:
                 yield f
         else:
@@ -394,11 +414,16 @@ def serve_tiered_kv_export_bulk(tiered: TieredEngine, loop):
     def handler(payload):
         payload = payload or {}
         hashes = list(payload.get("block_hashes", []))
-        layout, per = resolve_wire(payload, 2)
+        layout, per, crc = resolve_wire(payload, 2)
         fut = _aio.run_coroutine_threadsafe(
             tiered.engine.run_exclusive(tiered_export_frames, tiered,
                                         hashes, layout, per), loop)
-        for f in fut.result(timeout=120.0):
+        frames = fut.result(timeout=120.0)
+        if crc:  # checksummed in the bulk connection's thread, outside
+            # the exclusive window
+            from dynamo_tpu.engine.transfer import stamp_frame_crcs
+            stamp_frame_crcs(frames)
+        for f in frames:
             yield f.obj, f.raw
 
     return handler

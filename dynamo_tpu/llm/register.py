@@ -101,6 +101,16 @@ def engine_handler(engine: EngineBase) -> Callable:
                     if out.error:
                         metrics.requests_total.labels("error").inc()
                         hop.set_error(out.error)
+                    elif request.prefill_only and out.kv_transfer_params:
+                        # disagg prefill leg: pin the advertised blocks
+                        # under a TTL'd export lease so they can neither be
+                        # evicted before the decode side pulls them nor
+                        # stay pinned forever if that decoder crashes —
+                        # the puller acks via the kv_export endpoint
+                        from dynamo_tpu.engine.transfer import (
+                            stamp_export_lease)
+                        await stamp_export_lease(
+                            engine, out.kv_transfer_params, span=hop)
                     stitcher.close()
                     final = out.to_dict()
                     final[SPANS_FRAME_KEY] = tracer.finish_hop(hop)

@@ -87,10 +87,17 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return bytes(buf)
 
 
-def _read_u32(sock: socket.socket) -> int:
+# sanity cap on msgpack meta parts: metas are block lists + a few scalars
+# (well under a MB even at thousands of blocks); a corrupted length byte
+# must not provoke a multi-hundred-MB allocation before the desync is
+# noticed
+_MAX_META = 16 * 1024 * 1024
+
+
+def _read_u32(sock: socket.socket, limit: int = MAX_FRAME) -> int:
     (v,) = _U32.unpack(_recv_exact(sock, 4))
-    if v > MAX_FRAME:
-        raise ValueError(f"bulk frame length {v} exceeds cap {MAX_FRAME}")
+    if v > limit:
+        raise ValueError(f"bulk frame length {v} exceeds cap {limit}")
     return v
 
 
@@ -369,8 +376,27 @@ def _fetch_on(s: socket.socket, endpoint: str, payload: Any, ident: str,
     body = pack({"endpoint": endpoint, "payload": payload, "ident": ident})
     s.sendall(_U32.pack(len(body)) + body)
     while True:
-        meta = unpack(_recv_exact(s, _read_u32(s)))
-        raw_len = _read_u32(s)
+        try:
+            mb = _recv_exact(s, _read_u32(s, _MAX_META))
+            meta = unpack(mb)
+        except ValueError as e:
+            # an over-cap length prefix is a desynced/corrupted stream,
+            # not a protocol-level error: classify as a transport fault so
+            # the caller's retry/resume ladder treats it like a reset
+            raise ConnectionError(f"bulk frame desync (bad length): {e}")
+        except ConnectionError:
+            raise
+        except Exception as e:  # noqa: BLE001 — a corrupted byte stream
+            # desyncs the framing; same classification as above instead of
+            # surfacing a raw msgpack error
+            raise ConnectionError(f"bulk frame desync (corrupt meta): {e}")
+        if not isinstance(meta, dict):
+            raise ConnectionError("bulk frame desync (meta not a map)")
+        try:
+            raw_len = _read_u32(s)
+        except ValueError as e:
+            raise ConnectionError(f"bulk frame desync (bad raw length): "
+                                  f"{e}")
         raw: Any = b""
         if raw_len:
             raw = _buf_get(raw_len)

@@ -10,6 +10,17 @@ provoke on a real socket pair:
   keepalive probing or request deadlines can catch it.
 - ``set_delay(s)``: add latency to every forwarded chunk (slow network).
 - ``heal()``: resume forwarding (bytes held during the blackhole flow again).
+- ``corrupt(after_bytes, nbytes)``: flip (XOR 0xFF) ``nbytes`` of the
+  forwarded byte stream starting at absolute offset ``after_bytes`` — the
+  silently-corrupting link/NIC that only end-to-end checksums can catch.
+  One-shot; disarms once the window has been applied.
+- ``truncate(after_bytes)``: forward exactly ``after_bytes`` then hard-cut
+  both sides of the connection — the mid-transfer socket reset. One-shot.
+
+``corrupt``/``truncate`` take a ``direction`` (``"down"`` = server→client
+bytes, the default — where KV frames flow — or ``"up"``) and count bytes
+cumulatively per direction across the proxy's lifetime; they work against
+any TCP service (the bulk data plane and the RPC plane alike).
 
 Scenarios become deterministic: point the client at ``proxy.address`` instead
 of the worker's own, then flip faults mid-stream.  Parity in intent with the
@@ -52,6 +63,13 @@ class ChaosProxy:
         self._tasks: Set[asyncio.Task] = set()
         self._writers: Set[asyncio.StreamWriter] = set()
         self.bytes_forwarded = 0
+        # byte-stream faults, armed per direction ("up" client->server,
+        # "down" server->client); offsets are cumulative per direction
+        self._dir_bytes = {"up": 0, "down": 0}
+        self._corrupt: dict = {}   # direction -> (start, nbytes)
+        self._truncate: dict = {}  # direction -> cut offset
+        self.corruptions = 0
+        self.truncations = 0
 
     @property
     def address(self) -> str:
@@ -94,6 +112,24 @@ class ChaosProxy:
         """Add per-chunk forwarding latency (0 restores full speed)."""
         self._delay_s = max(0.0, seconds)
 
+    def corrupt(self, after_bytes: int = 0, nbytes: int = 1,
+                direction: str = "down") -> None:
+        """Flip ``nbytes`` of the ``direction`` byte stream starting at
+        cumulative offset ``after_bytes`` (XOR 0xFF — the bytes still
+        arrive, just wrong). One-shot: disarms once fully applied."""
+        self._corrupt[direction] = (int(after_bytes), max(1, int(nbytes)))
+
+    def truncate(self, after_bytes: int, direction: str = "down") -> None:
+        """Forward exactly ``after_bytes`` cumulative bytes in
+        ``direction`` then hard-close both sides of that connection (a
+        mid-transfer reset). One-shot."""
+        self._truncate[direction] = int(after_bytes)
+
+    def clear_faults(self) -> None:
+        """Disarm any pending corrupt/truncate faults."""
+        self._corrupt.clear()
+        self._truncate.clear()
+
     # -- plumbing ----------------------------------------------------------
 
     async def _handle(self, creader: asyncio.StreamReader,
@@ -106,8 +142,9 @@ class ChaosProxy:
             cwriter.close()
             return
         self._writers.update((cwriter, uwriter))
-        up = asyncio.create_task(self._pump(creader, uwriter))
-        down = asyncio.create_task(self._pump(ureader, cwriter))
+        up = asyncio.create_task(self._pump(creader, uwriter, "up", cwriter))
+        down = asyncio.create_task(self._pump(ureader, cwriter, "down",
+                                              uwriter))
         for t in (up, down):
             self._tasks.add(t)
             t.add_done_callback(self._tasks.discard)
@@ -121,8 +158,37 @@ class ChaosProxy:
                 except Exception:
                     pass
 
+    def _apply_faults(self, direction: str, data: bytes):
+        """Apply any armed corrupt/truncate fault to one chunk; returns
+        (data, cut) where ``cut`` means: write what remains, then hard-
+        close the connection."""
+        pos = self._dir_bytes[direction]
+        armed = self._corrupt.get(direction)
+        if armed is not None:
+            start, n = armed
+            lo, hi = max(start, pos), min(start + n, pos + len(data))
+            if lo < hi:
+                b = bytearray(data)
+                for i in range(lo - pos, hi - pos):
+                    b[i] ^= 0xFF
+                data = bytes(b)
+                self.corruptions += 1
+            if start + n <= pos + len(data):  # window fully applied
+                self._corrupt.pop(direction, None)
+        cut = False
+        trunc = self._truncate.get(direction)
+        if trunc is not None and pos + len(data) >= trunc:
+            data = data[:max(0, trunc - pos)]
+            self._truncate.pop(direction, None)
+            self.truncations += 1
+            cut = True
+        self._dir_bytes[direction] += len(data)
+        return data, cut
+
     async def _pump(self, reader: asyncio.StreamReader,
-                    writer: asyncio.StreamWriter) -> None:
+                    writer: asyncio.StreamWriter,
+                    direction: str = "down",
+                    peer_writer: "asyncio.StreamWriter" = None) -> None:
         try:
             while True:
                 data = await reader.read(64 * 1024)
@@ -133,9 +199,20 @@ class ChaosProxy:
                 # blackhole: hold the chunk here — the connection stays
                 # open and silent, exactly like a frozen remote
                 await self._forwarding.wait()
-                writer.write(data)
-                await writer.drain()
-                self.bytes_forwarded += len(data)
+                data, cut = self._apply_faults(direction, data)
+                if data:
+                    writer.write(data)
+                    await writer.drain()
+                    self.bytes_forwarded += len(data)
+                if cut:
+                    # hard-cut BOTH halves: the peer sees a mid-frame
+                    # close, exactly like a socket reset under transfer
+                    if peer_writer is not None:
+                        try:
+                            peer_writer.close()
+                        except Exception:
+                            pass
+                    break
         except (ConnectionError, OSError):
             pass
         finally:

@@ -20,6 +20,7 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import os
 import time
 from typing import Any, AsyncIterator, Optional
 
@@ -28,9 +29,11 @@ from dynamo_tpu.engine.transfer import (
     FRAME_WIRE_VERSION,
     KV_EXPORT_DIRECT_ENDPOINT,
     BlockPayload,
+    FrameIntegrityError,
     InjectPipeline,
     inject_device_windowed,
     pump_bulk_frames,
+    stamp_export_lease,
 )
 from dynamo_tpu.protocols.common import (
     FinishReason,
@@ -146,22 +149,20 @@ class PrefillQueueWorker:
             job = None
             try:
                 job = codec.unpack(raw)
-                await self._run_job(job, age_s)
+                outcome = await self._run_job(job, age_s)
                 self.jobs_done += 1
             except Exception:  # noqa: BLE001 — one bad job must not kill
+                outcome = "failed"
                 logger.exception("prefill queue job failed")
                 if job is None and isinstance(raw, (bytes, bytearray)):
                     logger.warning("undecodable prefill job dropped")
+            from dynamo_tpu.worker.metrics import count_metric
+            count_metric("prefill_jobs", outcome)
 
-    async def _run_job(self, job: dict, age_s: float = 0.0) -> None:
+    async def _run_job(self, job: dict, age_s: float = 0.0) -> str:
+        """Run one queued prefill job; returns its outcome label
+        (``ok``/``failed``/``stale`` — ``dynamo_worker_prefill_jobs_total``)."""
         from dynamo_tpu.runtime import codec
-        # staleness by TIME QUEUED (measured on the coordinator's single
-        # clock — immune to cross-host wall-clock skew): past the decode
-        # side's reply timeout, nobody is waiting for this job
-        if age_s > job.get("ttl", float("inf")):
-            logger.info("dropping stale prefill job %s (queued %.1fs)",
-                        job.get("req", {}).get("request_id"), age_s)
-            return
         tracer = get_tracer()
         # the decode side packed its trace context into the job (the queue
         # rides the coordinator, not RPC headers): this worker's fragment
@@ -172,10 +173,22 @@ class PrefillQueueWorker:
                                       job.get("req", {}).get("request_id",
                                                              ""),
                                       "queued_s": round(age_s, 6)})
+        # staleness by TIME QUEUED (measured on the coordinator's single
+        # clock — immune to cross-host wall-clock skew): past the decode
+        # side's reply timeout, nobody is waiting for this job
+        if age_s > job.get("ttl", float("inf")):
+            logger.info("dropping stale prefill job %s (queued %.1fs)",
+                        job.get("req", {}).get("request_id"), age_s)
+            hop.set_attr("outcome", "stale")
+            hop.add_event("stale_drop", queued_s=round(age_s, 3),
+                          ttl=job.get("ttl"))
+            tracer.finish_hop(hop)  # fragment stays in this recorder
+            return "stale"
         stitcher = StageStitcher(tracer, parent=hop, skip_decode=True)
         # pre-set so the finally's publish can never NameError, even on a
         # BaseException (cancellation) out of the engine stream
         reply = {"out": None, "instance_id": self.instance_id}
+        outcome = "failed"
         try:
             req = PreprocessedRequest.from_dict(job["req"])
             req.prefill_only = True
@@ -186,6 +199,14 @@ class PrefillQueueWorker:
                     final = out
             if final is not None and final.error:
                 hop.set_error(final.error)
+            elif final is not None and final.kv_transfer_params:
+                # pin the advertised blocks until the decode side acks the
+                # pull (or the TTL GC reclaims them — crashed decoder)
+                await stamp_export_lease(self.engine,
+                                         final.kv_transfer_params,
+                                         span=hop)
+            if final is not None and not final.error:
+                outcome = "ok"
             reply = {
                 "out": final.to_dict() if final is not None else None,
                 "instance_id": self.instance_id,
@@ -200,8 +221,10 @@ class PrefillQueueWorker:
             raise
         finally:
             stitcher.close()
+            hop.set_attr("outcome", outcome)
             reply[SPANS_FRAME_KEY] = tracer.finish_hop(hop)
             await self.drt.coord.publish(job["reply"], codec.pack(reply))
+        return outcome
 
 
 class DisaggConfig:
@@ -262,6 +285,17 @@ class DisaggDecodeHandler:
         # peer: later fetches find pooled connections with ramped kernel
         # buffers instead of paying the cold-socket penalty)
         self._bulk_warmed: set = set()
+        # resume attempts per host plane after a mid-pull failure: each
+        # re-pulls only the blocks not yet committed (DYN_KV_PULL_RETRIES)
+        try:
+            self.pull_resume_attempts = max(0, int(os.environ.get(
+                "DYN_KV_PULL_RETRIES", "1")))
+        except (TypeError, ValueError):
+            logger.warning("malformed DYN_KV_PULL_RETRIES %r; using 1",
+                           os.environ.get("DYN_KV_PULL_RETRIES"))
+            self.pull_resume_attempts = 1
+        # diagnostics of the most recent block pull (tests, debugging)
+        self.last_pull_stats: dict = {}
 
     async def start(self) -> "DisaggDecodeHandler":
         ns = self.drt.namespace(self.namespace)
@@ -367,7 +401,8 @@ class DisaggDecodeHandler:
                 await self._pull_blocks(
                     hashes, reply["instance_id"],
                     bulk_address=reply.get("bulk_address", ""),
-                    direct_address=reply.get("direct_address", ""))
+                    direct_address=reply.get("direct_address", ""),
+                    lease=params.get("lease"))
             return final
         finally:
             try:
@@ -375,12 +410,43 @@ class DisaggDecodeHandler:
             except Exception:  # noqa: BLE001 — teardown best-effort
                 pass
 
+    def _pick_prefill_instance(self, exclude: set) -> Optional[int]:
+        """Round-robin the next prefill instance, skipping ``exclude``
+        (failed legs of this request); None when no other instance is
+        live."""
+        ids = [i for i in sorted(self._gen_client.instance_ids())
+               if i not in exclude]
+        if not ids:
+            return None
+        try:
+            iid = self._router.select_instance()
+        except ConnectionError:
+            return None
+        return iid if iid not in exclude else ids[0]
+
+    def _resumable_blocks(self, request: PreprocessedRequest) -> int:
+        """Leading prompt blocks ALREADY committed locally (a partially
+        successful pull) — the local-prefill fallback resumes from them
+        via normal prefix-match admission instead of recomputing."""
+        try:
+            from dynamo_tpu.tokens import compute_block_hash_for_seq
+            alloc = self.engine.allocator
+            return alloc.peek_prefix(compute_block_hash_for_seq(
+                request.token_ids, alloc.page_size))
+        except Exception:  # noqa: BLE001 — accounting only
+            return 0
+
     async def _remote_prefill(self, request: PreprocessedRequest
                               ) -> Optional[LLMEngineOutput]:
         """Run the prefill leg; returns the final prefill frame (first token +
         kv_transfer_params) or None on any failure (-> local fallback).
         Tries the prefill queue first (workers pull when free — reference
-        PrefillQueue role), then the direct round-robin leg."""
+        PrefillQueue role), then the direct round-robin leg — retried ONCE
+        on an alternate instance (deadline budget allowing) before giving
+        up, so a single crashed prefill worker doesn't cost the whole
+        prompt a local re-prefill. The fallback itself resumes from
+        whatever blocks a partial pull already committed (prefix-match
+        admission picks them up)."""
         preq = PreprocessedRequest.from_dict(request.to_dict())
         preq.prefill_only = True
         if self.use_queue:
@@ -392,54 +458,106 @@ class DisaggDecodeHandler:
                 final = None
             if final is not None:
                 return final
-        try:
-            tracer = get_tracer()
-            iid = self._router.select_instance()
-            final: Optional[LLMEngineOutput] = None
-            # the end-to-end deadline and request id ride the internal hop
-            # too (trace context auto-injected by the connection), so a
-            # stuck prefill worker can't hold the decode worker past it
-            with tracer.span("prefill",
-                             attrs={"remote": True, "leg": "direct",
-                                    "instance": f"{iid:x}"}) as psp:
-                stream = await self._gen_client.direct(
-                    preq.to_dict(), iid,
-                    request_headers(preq.deadline_unix, preq.request_id))
-                async for payload in stream:
-                    if isinstance(payload, dict) and SPANS_FRAME_KEY in payload:
-                        tracer.adopt(payload.pop(SPANS_FRAME_KEY))
-                    out = LLMEngineOutput.from_dict(payload)
-                    if out.finish_reason is not None:
-                        final = out
-                if final is None or final.error:
-                    psp.set_error((final.error if final is not None
-                                   else None) or "no final prefill frame")
-                    return None
-            params = final.kv_transfer_params or {}
-            hashes = [b[0] for b in params.get("blocks", [])]
-            if hashes:
-                await self._pull_blocks(hashes, iid)
-            return final
-        except DeadlineExceededError:
-            # the request is already expired: a local-prefill fallback would
-            # burn the longest class of prompts for a caller that's gone
-            raise
-        except Exception as e:  # noqa: BLE001 — disagg must never fail a
-            # request: any remote-leg error (connection, malformed frame,
-            # inject failure) falls back to local prefill
-            logger.warning("remote prefill failed (%s); falling back local", e,
-                           exc_info=not isinstance(e, ConnectionError))
-            return None
+        tracer = get_tracer()
+        tried: set = set()
+        for attempt in range(2):
+            iid = self._pick_prefill_instance(tried)
+            if iid is None:
+                break
+            if attempt and preq.deadline_unix is not None \
+                    and preq.deadline_unix - time.time() <= 0:
+                # out of deadline budget: a failover leg would prefill for
+                # a caller whose request already expired
+                logger.warning("skipping prefill failover: deadline spent")
+                break
+            try:
+                final: Optional[LLMEngineOutput] = None
+                # the end-to-end deadline and request id ride the internal
+                # hop too (trace context auto-injected by the connection),
+                # so a stuck prefill worker can't hold the decode worker
+                # past it
+                with tracer.span("prefill",
+                                 attrs={"remote": True, "leg": "direct",
+                                        "instance": f"{iid:x}",
+                                        "retries": attempt}) as psp:
+                    stream = await self._gen_client.direct(
+                        preq.to_dict(), iid,
+                        request_headers(preq.deadline_unix,
+                                        preq.request_id))
+                    async for payload in stream:
+                        if isinstance(payload, dict) \
+                                and SPANS_FRAME_KEY in payload:
+                            tracer.adopt(payload.pop(SPANS_FRAME_KEY))
+                        out = LLMEngineOutput.from_dict(payload)
+                        if out.finish_reason is not None:
+                            final = out
+                    if final is None or final.error:
+                        psp.set_error((final.error if final is not None
+                                       else None)
+                                      or "no final prefill frame")
+                        raise RuntimeError(
+                            (final.error if final is not None else None)
+                            or "no final prefill frame")
+                params = final.kv_transfer_params or {}
+                hashes = [b[0] for b in params.get("blocks", [])]
+                if hashes:
+                    await self._pull_blocks(hashes, iid,
+                                            lease=params.get("lease"))
+                if attempt:
+                    self._count_failover("ok")
+                return final
+            except DeadlineExceededError:
+                # the request is already expired: a local-prefill fallback
+                # would burn the longest class of prompts for a caller
+                # that's gone
+                raise
+            except Exception as e:  # noqa: BLE001 — disagg must never fail
+                # a request: any remote-leg error (connection, malformed
+                # frame, inject failure) retries an alternate instance,
+                # then falls back to local prefill
+                tried.add(iid)
+                if attempt:
+                    self._count_failover("failed")
+                retry = (attempt == 0
+                         and self._pick_prefill_instance(tried) is not None)
+                logger.warning(
+                    "remote prefill on %x failed (%s); %s", iid, e,
+                    "retrying an alternate instance" if retry
+                    else "falling back local",
+                    exc_info=not isinstance(e, ConnectionError))
+                if not retry and attempt == 0:
+                    break
+        resumed = self._resumable_blocks(request)
+        if resumed:
+            logger.info("local prefill fallback resumes from %d committed "
+                        "block(s)", resumed)
+        return None
+
+    @staticmethod
+    def _count_failover(outcome: str) -> None:
+        from dynamo_tpu.worker.metrics import count_metric
+        count_metric("prefill_failovers", outcome)
 
     async def _pull_blocks(self, hashes: list, iid: int,
                            bulk_address: str = "",
-                           direct_address: str = "") -> None:
+                           direct_address: str = "",
+                           lease: Optional[int] = None) -> None:
         """Fetch + inject the prefix blocks from prefill worker ``iid``.
 
         Transport ladder: DEVICE-DIRECT (jax transfer server — blocks move
         chip-to-chip with no host bounce, the NIXL RDMA role) when both
         sides run it, else the bulk data plane (raw sockets, unix-first),
-        else batched two-part frames on the RPC plane."""
+        else batched two-part frames on the RPC plane.
+
+        Fault tolerance: per-block commit state is the allocator's
+        content-addressed registry itself, so a mid-pull failure (socket
+        reset, corrupt frame, peer death) resumes by re-pulling ONLY the
+        blocks not yet committed — first on the same plane, then down the
+        ladder — instead of discarding committed work. Wire-v4 frames are
+        checksum-verified before staging; a bad frame NACKs (aborts the
+        stream) and is re-pulled, never injected. On the way out the
+        export ``lease`` is acked (best-effort; the prefill side's TTL GC
+        covers a lost ack)."""
         inst = self._kv_client.get_instance(iid)
         if not bulk_address and inst is not None:
             bulk_address = inst.bulk_address
@@ -484,13 +602,76 @@ class DisaggDecodeHandler:
             for k, v in phases.items():
                 if v:
                     kv_span.set_attr(k[:-2] + "_ms", round(v * 1e3, 3))
-            kv_span.finish()
+            try:
+                if lease is not None:
+                    # ack whatever the outcome: this decode worker never
+                    # comes back for more of THIS pull (a failed tail
+                    # recomputes locally), so the prefill side can unpin
+                    # now instead of waiting out the TTL
+                    acked = await self._ack_export_lease(iid, lease)
+                    kv_span.set_attr("lease_acked", acked)
+            finally:
+                # a cancellation landing on the ack await must not leave
+                # the span unfinished
+                kv_span.finish()
+
+    async def _ack_export_lease(self, iid: int, lease: int) -> bool:
+        try:
+            stream = await self._kv_client.direct(
+                {"ack_lease": int(lease)}, iid)
+            async for _ in stream:
+                pass
+            return True
+        except Exception as e:  # noqa: BLE001 — the TTL GC covers it
+            logger.debug("export lease %s ack to %x failed (%s); TTL "
+                         "covers", lease, iid, e)
+            return False
+
+    def _missing_blocks(self, hashes: list) -> list:
+        """The per-block commit state IS the allocator's content-addressed
+        registry: a block that committed (this pull, an earlier attempt,
+        or any other request) is resident and never re-pulled."""
+        resident = self.engine.allocator._by_hash
+        return [h for h in hashes if h not in resident]
+
+    def _note_resume(self, kv_span, plane: str, committed: int,
+                     remaining: int) -> None:
+        kv_span.add_event("pull_resumed", plane=plane, committed=committed,
+                          remaining=remaining)
+        from dynamo_tpu.worker.metrics import count_metric
+        count_metric("kv_pull_resumes")
+
+    @staticmethod
+    def _note_corrupt(kv_span, plane: str, err) -> None:
+        kv_span.add_event("frame_corrupt", plane=plane, error=str(err))
+        from dynamo_tpu.worker.metrics import count_metric
+        count_metric("kv_frames_corrupt")
 
     async def _pull_blocks_inner(self, hashes: list, iid: int,
                                  bulk_address: str, direct_address: str,
                                  _count_bytes, kv_span, phases) -> None:
         injected = total = 0
+        retries = 0
+        resumed_blocks = 0  # blocks NOT re-pulled thanks to commit state
         bulk_done = False
+        want = self._missing_blocks(hashes)
+        if len(want) < len(hashes):
+            kv_span.set_attr("resident_blocks", len(hashes) - len(want))
+        self.last_pull_stats = {"retries": 0, "resumed_blocks": 0,
+                                "injected": 0, "corrupt": 0}
+
+        def finish_stats():
+            kv_span.set_attr("injected", injected)
+            if retries:
+                kv_span.set_attr("retries", retries)
+                kv_span.set_attr("resumed_blocks", resumed_blocks)
+            self.last_pull_stats.update(retries=retries,
+                                        resumed_blocks=resumed_blocks,
+                                        injected=injected)
+
+        if not want:
+            finish_stats()
+            return
         now = time.monotonic()
         # prune expired breaker entries: prefill restarts advertise fresh
         # ephemeral ports, so per-address state must not grow unbounded
@@ -502,7 +683,7 @@ class DisaggDecodeHandler:
             offer = None
             try:
                 offer_stream = await self._kv_direct_client.direct(
-                    {"block_hashes": hashes}, iid)
+                    {"block_hashes": want}, iid)
                 async for o in offer_stream:
                     offer = o
                 if offer and offer.get("uuid") is not None:
@@ -528,16 +709,10 @@ class DisaggDecodeHandler:
                     injected = await inject_device_windowed(
                         self.engine, metas, data[:, :len(metas)])
                     phases["scatter_s"] += time.perf_counter() - t0
-                    kv_span.set_attr("injected", injected)
                     logger.debug("device-direct pull injected %d blocks "
                                  "from %x", injected, iid)
-                    try:  # release the peer's pinned offer promptly
-                        ack = await self._kv_direct_client.direct(
-                            {"ack": offer["uuid"]}, iid)
-                        async for _ in ack:
-                            pass
-                    except Exception:  # noqa: BLE001 — TTL covers it
-                        pass
+                    await self._ack_offer(iid, offer["uuid"])
+                    finish_stats()
                     return
                 # empty offer: blocks evicted remotely OR the peer's offer
                 # table is full — fall through to the host planes (the
@@ -555,6 +730,9 @@ class DisaggDecodeHandler:
             except Exception as e:  # noqa: BLE001 — fall down the ladder
                 logger.warning("device-direct KV pull from %s failed (%s); "
                                "trying the bulk plane", direct_address, e)
+        # resume budget per host plane: a failed attempt re-pulls only the
+        # still-missing blocks before falling down the ladder
+        attempts_per_plane = 1 + self.pull_resume_attempts
         if bulk_address:
             from dynamo_tpu.runtime.bulk import prewarm_async
             if bulk_address not in self._bulk_warmed:
@@ -568,74 +746,172 @@ class DisaggDecodeHandler:
                     bulk_address, f"{iid:x}",
                     on_fail=lambda a=bulk_address:
                         self._bulk_warmed.discard(a))
-            pipe = InjectPipeline(self.engine)
+            for attempt in range(attempts_per_plane):
+                want = self._missing_blocks(hashes)
+                if not want:
+                    bulk_done = True
+                    break
+                if attempt:
+                    retries += 1
+                    resumed_blocks = len(hashes) - len(want)
+                    self._note_resume(kv_span, "bulk", resumed_blocks,
+                                      len(want))
+                pipe = InjectPipeline(self.engine)
 
-            def on_meta(meta, nbytes):
-                nonlocal total
-                _count_bytes(nbytes, "bulk")
-                total += len(meta["blocks"])
+                def on_meta(meta, nbytes):
+                    nonlocal total
+                    _count_bytes(nbytes, "bulk")
+                    total += len(meta["blocks"])
 
-            try:
-                # stream-and-stage (engine/transfer.pump_bulk_frames):
-                # frames stage/commit while later frames are still on the
-                # wire, wire buffers recycle through the pipeline
-                phases["recv_s"] += await pump_bulk_frames(
-                    pipe, bulk_address, KV_EXPORT_ENDPOINT,
-                    {"block_hashes": hashes, "wire": FRAME_WIRE_VERSION},
-                    f"{iid:x}", 60.0, on_meta)
-                injected += await pipe.finish()
-                bulk_done = True
-            except Exception as e:  # noqa: BLE001 — bulk plane unreachable
-                # (e.g. worker bound to 127.0.0.1 across hosts): the RPC
-                # export path below still works — never waste the completed
-                # remote prefill over a transport problem. pump already
-                # reaped its fetch thread and in-flight commits; whatever
-                # committed cleanly stays (content-addressed blocks are
-                # never wasted, the RPC retry dedups against them).
-                injected += pipe.injected
-                logger.warning("bulk KV fetch from %s failed (%s); falling "
-                               "back to the RPC export path",
-                               bulk_address, e)
-            finally:
-                for k, v in pipe.timings.items():
-                    phases[k] += v
+                try:
+                    # stream-and-stage (engine/transfer.pump_bulk_frames):
+                    # frames stage/commit while later frames are still on
+                    # the wire, wire buffers recycle through the pipeline
+                    phases["recv_s"] += await pump_bulk_frames(
+                        pipe, bulk_address, KV_EXPORT_ENDPOINT,
+                        {"block_hashes": want,
+                         "wire": FRAME_WIRE_VERSION},
+                        f"{iid:x}", 60.0, on_meta)
+                    injected += await pipe.finish()
+                    bulk_done = True
+                    break
+                except FrameIntegrityError as e:
+                    # checksum NACK: the corrupted frame was rejected
+                    # before staging (never injected) and the stream
+                    # aborted; committed frames stay, the resume re-pulls
+                    # the rest
+                    injected += pipe.injected
+                    self.last_pull_stats["corrupt"] += 1
+                    self._note_corrupt(kv_span, "bulk", e)
+                    logger.warning("bulk KV frame from %s failed checksum "
+                                   "(%s); re-pulling missing blocks",
+                                   bulk_address, e)
+                except Exception as e:  # noqa: BLE001 — bulk plane broke
+                    # mid-pull (socket reset, worker bound to 127.0.0.1
+                    # across hosts, peer death): resume on this plane,
+                    # then the RPC export path below — never waste the
+                    # completed remote prefill over a transport problem.
+                    # pump already reaped its fetch thread and in-flight
+                    # commits; whatever committed cleanly stays (content-
+                    # addressed blocks are never wasted, every retry
+                    # dedups against them).
+                    injected += pipe.injected
+                    logger.warning("bulk KV fetch from %s failed (%s); %s",
+                                   bulk_address, e,
+                                   "resuming missing blocks"
+                                   if attempt + 1 < attempts_per_plane
+                                   else "falling back to the RPC export "
+                                        "path")
+                finally:
+                    for k, v in pipe.timings.items():
+                        phases[k] += v
         if not bulk_done:
-            from dynamo_tpu.runtime.codec import release_buffer
+            last_err = None
+            for attempt in range(attempts_per_plane):
+                want = self._missing_blocks(hashes)
+                if not want:
+                    last_err = None
+                    break
+                if attempt or (bulk_address and injected):
+                    # count a ladder/same-plane resume whenever committed
+                    # work is being carried over into a new attempt
+                    retries += 1
+                    resumed_blocks = len(hashes) - len(want)
+                    self._note_resume(kv_span, "rpc", resumed_blocks,
+                                      len(want))
+                def note_blocks(n: int) -> None:
+                    nonlocal total
+                    total += n
 
-            kv_stream = await self._kv_client.direct(
-                {"block_hashes": hashes, "wire": FRAME_WIRE_VERSION}, iid)
-            # batched two-part frames through the staged pipeline: frame k
-            # stages/commits while frame k+1 is still in flight (zero
-            # msgpack re-copies). Old exporters answering with the
-            # per-block schema ride the same pipeline via add_blocks.
-            pipe = InjectPipeline(self.engine)
-            try:
-                t0 = time.perf_counter()
-                async for frame in kv_stream:
-                    phases["recv_s"] += time.perf_counter() - t0
-                    if "_raw" in frame:
-                        _count_bytes(len(frame["_raw"]), "rpc")
-                        total += len(frame["blocks"])
-                        # pipeline recycles the pooled trailer buffer
-                        # once its bytes are consumed
-                        await pipe.add_frame(frame,
-                                             release=release_buffer)
-                    else:  # pre-batched single-block schema
-                        total += 1
-                        await pipe.add_blocks(
-                            [BlockPayload.from_wire(frame)])
-                    t0 = time.perf_counter()
-                injected += await pipe.finish()
-            except BaseException:
-                await pipe.drain()
-                raise
-            finally:
-                for k, v in pipe.timings.items():
-                    phases[k] += v
+                def note_injected(n: int) -> None:
+                    nonlocal injected
+                    injected += n
+
+                try:
+                    await self._pull_rpc(want, iid, _count_bytes, phases,
+                                         note_blocks, note_injected)
+                    last_err = None
+                    break
+                except FrameIntegrityError as e:
+                    last_err = e
+                    self.last_pull_stats["corrupt"] += 1
+                    self._note_corrupt(kv_span, "rpc", e)
+                    logger.warning("RPC KV frame from %x failed checksum "
+                                   "(%s); re-pulling missing blocks",
+                                   iid, e)
+                except Exception as e:  # noqa: BLE001 — retried below
+                    last_err = e
+                    logger.warning("RPC KV fetch from %x failed (%s)",
+                                   iid, e)
+            if last_err is not None:
+                finish_stats()
+                raise last_err
         if total:
-            kv_span.set_attr("injected", injected)
             logger.debug("injected %d/%d transferred blocks",
                          injected, total)
+        finish_stats()
+
+    async def _pull_rpc(self, want: list, iid: int, _count_bytes,
+                        phases, note_blocks, note_injected) -> None:
+        """One RPC-plane pull attempt of ``want`` through the staged
+        pipeline. Blocks injected are reported through ``note_injected``
+        — on the failure path too, so partial commits reaped by the drain
+        still count (the caller's resume dedups against them)."""
+        from dynamo_tpu.runtime.codec import release_buffer
+
+        kv_stream = await self._kv_client.direct(
+            {"block_hashes": want, "wire": FRAME_WIRE_VERSION}, iid)
+        # batched two-part frames through the staged pipeline: frame k
+        # stages/commits while frame k+1 is still in flight (zero
+        # msgpack re-copies). Old exporters answering with the
+        # per-block schema ride the same pipeline via add_blocks.
+        pipe = InjectPipeline(self.engine)
+        try:
+            t0 = time.perf_counter()
+            async for frame in kv_stream:
+                phases["recv_s"] += time.perf_counter() - t0
+                if "_raw" in frame:
+                    _count_bytes(len(frame["_raw"]), "rpc")
+                    note_blocks(len(frame["blocks"]))
+                    # pipeline recycles the pooled trailer buffer
+                    # once its bytes are consumed
+                    await pipe.add_frame(frame, release=release_buffer)
+                else:  # pre-batched single-block schema
+                    note_blocks(1)
+                    await pipe.add_blocks(
+                        [BlockPayload.from_wire(frame)])
+                t0 = time.perf_counter()
+            note_injected(await pipe.finish())
+        except BaseException:
+            note_injected(await pipe.drain())
+            raise
+        finally:
+            for k, v in pipe.timings.items():
+                phases[k] += v
+
+    async def _ack_offer(self, iid: int, uuid: int) -> None:
+        """Release the peer's pinned device-direct offer. Retried once —
+        a lost ack leaves the gathered array pinned in the peer's HBM
+        until its offer TTL — and counted
+        (``dynamo_worker_kv_offer_acks_total``)."""
+        acked = False
+        for attempt in range(2):
+            try:
+                ack = await self._kv_direct_client.direct(
+                    {"ack": int(uuid)}, iid)
+                async for _ in ack:
+                    pass
+                acked = True
+                break
+            except Exception as e:  # noqa: BLE001 — retry once, then TTL
+                logger.debug("device-direct offer ack to %x failed "
+                             "(attempt %d: %s)", iid, attempt + 1, e)
+        if not acked:
+            logger.warning("device-direct offer %s ack to %x failed "
+                           "twice; peer unpins at its offer TTL",
+                           uuid, iid)
+        from dynamo_tpu.worker.metrics import count_metric
+        count_metric("kv_offer_acks", "ok" if acked else "failed")
 
     async def _inbound_prefill(self, request: PreprocessedRequest
                                ) -> Optional[LLMEngineOutput]:
@@ -658,7 +934,8 @@ class DisaggDecodeHandler:
                                     bulk_address=params.get("bulk_address",
                                                             ""),
                                     direct_address=params.get(
-                                        "direct_address", ""))
+                                        "direct_address", ""),
+                                    lease=params.get("lease"))
         except Exception as e:  # noqa: BLE001 — prefix pull is best-effort
             logger.warning("inbound prefill block pull failed (%s); "
                            "decoding with local prefill", e)
@@ -777,6 +1054,9 @@ class PrefillFirstHandler:
             return
         fwd = PreprocessedRequest.from_dict(request.to_dict())
         params = dict(final.kv_transfer_params or {})
+        # pin the advertised blocks until the decode side acks its pull
+        # (or the TTL GC reclaims — decode worker crashed)
+        lease = await stamp_export_lease(self.engine, params)
         params["first_token"] = final.token_ids[0]
         if final.log_probs:
             params["logprob"] = final.log_probs[0]
@@ -818,6 +1098,11 @@ class PrefillFirstHandler:
                                       error=f"decode worker lost: {e}")
                 return
             logger.warning("decode forward failed (%s); continuing local", e)
+            if lease is not None:
+                # nobody will ever pull this export: unpin now rather than
+                # waiting out the TTL
+                from dynamo_tpu.engine.transfer import release_export_lease
+                await release_export_lease(self.engine, lease)
             async for out in _continue_after_first(self.engine, request,
                                                    final, ctx):
                 yield out

@@ -29,7 +29,7 @@ from __future__ import annotations
 
 from typing import Optional
 
-from prometheus_client import CollectorRegistry, Counter
+from prometheus_client import CollectorRegistry, Counter, Gauge
 
 from dynamo_tpu.http.metrics import StageMetrics
 
@@ -53,6 +53,42 @@ class WorkerMetrics:
             "Disaggregated-prefill KV block bytes transferred, by direction "
             "and transport plane (direct/bulk/rpc)",
             ["direction", "plane"], registry=self.registry)
+        # -- data-plane fault tolerance ---------------------------------
+        self.kv_exports_active = Gauge(
+            f"{ns}_kv_exports_active",
+            "KV export leases currently pinning pages for a pending pull "
+            "(returns to 0 once pullers ack or the TTL GC reclaims)",
+            registry=self.registry)
+        self.kv_exports_reclaimed = Counter(
+            f"{ns}_kv_exports_reclaimed_total",
+            "Export leases reclaimed by the TTL GC sweep (the puller "
+            "crashed or never acked — orphaned KV bounded, not leaked)",
+            registry=self.registry)
+        self.prefill_jobs = Counter(
+            f"{ns}_prefill_jobs_total",
+            "Prefill queue jobs by outcome (ok, failed, stale — dropped "
+            "because the job outlived the decode side's reply timeout)",
+            ["outcome"], registry=self.registry)
+        self.kv_offer_acks = Counter(
+            f"{ns}_kv_offer_acks_total",
+            "Device-direct offer acks by outcome (ok, failed — a failed "
+            "ack leaves the peer's pinned HBM to its offer TTL)",
+            ["outcome"], registry=self.registry)
+        self.kv_frames_corrupt = Counter(
+            f"{ns}_kv_frames_corrupt_total",
+            "Wire-v4 KV frames rejected by checksum verification before "
+            "staging (corrupted/truncated in transit; never injected)",
+            registry=self.registry)
+        self.kv_pull_resumes = Counter(
+            f"{ns}_kv_pull_resumes_total",
+            "KV block pulls resumed after a mid-pull failure, re-pulling "
+            "only the blocks not yet committed",
+            registry=self.registry)
+        self.prefill_failovers = Counter(
+            f"{ns}_prefill_failovers_total",
+            "Remote-prefill retries on an alternate prefill instance "
+            "after the first one failed, by outcome (ok, failed)",
+            ["outcome"], registry=self.registry)
         self.stage = StageMetrics(self.registry)
 
     def attach_tracer(self, tracer) -> None:
@@ -71,4 +107,21 @@ def get_worker_metrics() -> WorkerMetrics:
     return _metrics
 
 
-__all__ = ["WorkerMetrics", "get_worker_metrics"]
+def count_metric(name: str, *labels: str, inc: float = 1) -> None:
+    """Best-effort increment of a ``WorkerMetrics`` counter by attribute
+    name — accounting must never fail serving, so lookup/label errors are
+    swallowed (logged at debug). The one place the try/inc/except shape
+    lives, instead of a copy per call site."""
+    import logging
+    try:
+        c = getattr(get_worker_metrics(), name)
+        if labels:
+            c = c.labels(*labels)
+        c.inc(inc)
+    except Exception:  # noqa: BLE001 — accounting is never load-bearing
+        logging.getLogger(__name__).debug(
+            "worker metric %s%r increment failed", name, labels,
+            exc_info=True)
+
+
+__all__ = ["WorkerMetrics", "get_worker_metrics", "count_metric"]

@@ -893,6 +893,220 @@ class TestStagedInjectPipeline:
             await b.stop()
 
 
+class TestWireV4Integrity:
+    """Wire v4: per-frame crc32, verified before staging — and full
+    interop with v1-v3 peers in both directions."""
+
+    async def _prefill(self, engine, prompt):
+        req = make_req(prompt, "p")
+        req.prefill_only = True
+        frames = await collect(engine.generate(req))
+        return [blk[0] for blk in frames[-1].kv_transfer_params["blocks"]]
+
+    def test_resolve_wire_version_map(self):
+        from dynamo_tpu.engine.transfer import resolve_wire
+
+        assert resolve_wire({"wire": 1}, 1)[::2] == ("block", False)
+        assert resolve_wire({"wire": 2}, 1)[::2] == ("block", False)
+        assert resolve_wire({"wire": 3}, 1)[::2] == ("layer", False)
+        assert resolve_wire({"wire": 4}, 1)[::2] == ("layer", True)
+        assert resolve_wire({"wire": 5}, 1)[::2] == ("layer", True)
+        # omitted key -> the plane's legacy default, never checksummed
+        assert resolve_wire({}, 2)[::2] == ("block", False)
+
+    def test_crc_knob_disables(self, monkeypatch):
+        from dynamo_tpu.engine.transfer import resolve_wire
+
+        monkeypatch.setenv("DYN_KV_FRAME_CRC", "0")
+        assert resolve_wire({"wire": 4}, 1)[2] is False
+
+    async def test_checksummed_frames_roundtrip_and_reject_corruption(self):
+        from dynamo_tpu.engine.transfer import (
+            FrameIntegrityError, InjectPipeline, export_frames,
+            stamp_frame_crcs)
+
+        a = JaxEngine.random_init(ModelConfig.tiny(), engine_cfg())
+        b = JaxEngine.random_init(ModelConfig.tiny(), engine_cfg())
+        c = JaxEngine.random_init(ModelConfig.tiny(), engine_cfg())
+        try:
+            prompt = list(range(1, 14))
+            hashes = await self._prefill(a, prompt)
+            wire = stamp_frame_crcs(
+                await a.run_exclusive(export_frames, a, hashes, "layer"))
+            assert wire and "crc32" in wire[0].obj
+
+            # clean frame injects
+            pipe = InjectPipeline(b)
+            meta = dict(wire[0].obj)
+            meta["_raw"] = bytes(memoryview(wire[0].raw).cast("B"))
+            await pipe.add_frame(meta)
+            assert await pipe.finish() == 3
+            out = await collect(b.generate(make_req(prompt, "d")))
+            assert out[-1].cached_tokens == 12
+
+            # a flipped byte is rejected BEFORE staging — never injected
+            bad = dict(wire[0].obj)
+            raw = bytearray(memoryview(wire[0].raw).cast("B"))
+            raw[len(raw) // 2] ^= 0xFF
+            bad["_raw"] = bytes(raw)
+            pipe = InjectPipeline(c)
+            with pytest.raises(FrameIntegrityError):
+                await pipe.add_frame(bad)
+            assert await pipe.finish() == 0
+            assert not c.allocator._by_hash  # nothing reached the cache
+        finally:
+            await a.stop()
+            await b.stop()
+            await c.stop()
+
+    async def test_v3_puller_gets_no_crc_v4_gets_crc(self):
+        """Mixed-version pulls: the exporter serves exactly what the
+        puller's advertised wire version expects, both directions."""
+        from dynamo_tpu.engine.transfer import serve_kv_export
+        from dynamo_tpu.runtime.rpc import RpcConnection, RpcServer
+
+        a = JaxEngine.random_init(ModelConfig.tiny(), engine_cfg())
+        server = await RpcServer().start()
+        client = None
+        try:
+            hashes = await self._prefill(a, list(range(1, 14)))
+            server.register("kv_export", serve_kv_export(a))
+            client = await RpcConnection(server.address).connect()
+            # old v3 puller: layer-major frames, NO checksum key
+            stream = await client.request(
+                "kv_export", {"block_hashes": hashes, "wire": 3})
+            v3 = [f async for f in stream]
+            assert v3 and all("crc32" not in f for f in v3)
+            assert v3[0]["layout"] == "layer"
+            # v4 puller: same frames plus the verified checksum
+            stream = await client.request(
+                "kv_export", {"block_hashes": hashes, "wire": 4})
+            v4 = [f async for f in stream]
+            assert v4 and all("crc32" in f for f in v4)
+            # and the advertised crc matches the bytes on the wire
+            import zlib
+            got = zlib.crc32(memoryview(v4[0]["_raw"])
+                             if isinstance(v4[0]["_raw"], (bytes, bytearray))
+                             else memoryview(v4[0]["_raw"]).cast("B"))
+            assert got & 0xFFFFFFFF == v4[0]["crc32"]
+        finally:
+            if client is not None:
+                await client.close()
+            await server.stop()
+            await a.stop()
+
+
+class TestExportLeases:
+    """TTL'd export leases: advertised blocks are pinned until the puller
+    acks or the GC sweep reclaims them (crashed decoder)."""
+
+    async def _prefill_via_handler(self, engine, prompt):
+        """Run a prefill_only request through the real serving handler —
+        the path that grants the lease."""
+        from dynamo_tpu.llm.register import engine_handler
+        req = make_req(prompt, f"p-{id(prompt):x}-{prompt[0]}")
+        req.prefill_only = True
+        frames = [f async for f in engine_handler(engine)(req.to_dict(),
+                                                          None)]
+        return frames[-1]["kv_transfer_params"]
+
+    async def test_lease_pins_blocks_and_ack_releases(self):
+        from dynamo_tpu.engine.transfer import (
+            get_export_leases, serve_kv_export)
+
+        a = JaxEngine.random_init(ModelConfig.tiny(), engine_cfg())
+        try:
+            params = await self._prefill_via_handler(a, list(range(1, 14)))
+            lease = params.get("lease")
+            assert lease is not None
+            mgr = get_export_leases(a)
+            assert mgr.active == 1 and mgr.pinned_pages == 3
+            # every advertised page is pinned: refcount > 0, out of the LRU
+            for blk in params["blocks"]:
+                page = a.allocator._by_hash[blk[0]]
+                assert a.allocator._info[page].refcount >= 1
+                assert blk[0] not in a.allocator._lru
+            # the puller's ack (kv_export endpoint) releases the pin
+            handler = serve_kv_export(a)
+            out = [f async for f in handler({"ack_lease": lease}, None)]
+            assert out == [{"acked": True}]
+            assert mgr.active == 0 and mgr.pinned_pages == 0
+            assert mgr.reclaimed_total == 0  # acked, not GC'd
+            for blk in params["blocks"]:
+                page = a.allocator._by_hash[blk[0]]
+                assert a.allocator._info[page].refcount == 0
+                assert blk[0] in a.allocator._lru  # evictable again
+            # double-ack is a clean no-op
+            out = [f async for f in handler({"ack_lease": lease}, None)]
+            assert out == [{"acked": False}]
+        finally:
+            await a.stop()
+
+    async def test_unacked_lease_reclaimed_within_ttl(self, monkeypatch):
+        """Decode worker crashes after prefill: nobody pulls, nobody acks
+        — the GC sweep reclaims the pinned pages within the TTL."""
+        monkeypatch.setenv("DYN_KV_EXPORT_TTL_S", "0.4")
+        from dynamo_tpu.engine.transfer import get_export_leases
+
+        a = JaxEngine.random_init(ModelConfig.tiny(), engine_cfg())
+        try:
+            params = await self._prefill_via_handler(a, list(range(1, 14)))
+            assert params.get("lease") is not None
+            mgr = get_export_leases(a)
+            assert mgr.active == 1
+            for _ in range(100):  # sweep timer fires just past the TTL
+                if mgr.active == 0:
+                    break
+                await asyncio.sleep(0.05)
+            assert mgr.active == 0
+            assert mgr.reclaimed_total == 1
+            for blk in params["blocks"]:
+                page = a.allocator._by_hash[blk[0]]
+                assert a.allocator._info[page].refcount == 0
+        finally:
+            await a.stop()
+
+    async def test_pin_cap_refuses_not_breaks(self, monkeypatch):
+        """Past the pinned-page cap a grant is refused (no lease key) but
+        the export itself still works — leases protect, never gate."""
+        from dynamo_tpu.engine.transfer import get_export_leases
+
+        a = JaxEngine.random_init(ModelConfig.tiny(), engine_cfg())
+        try:
+            mgr = get_export_leases(a)
+            mgr.max_pinned_pages = 1
+            params = await self._prefill_via_handler(a, list(range(1, 14)))
+            # the cap is a HARD bound: the first grant is trimmed to the
+            # budget (head-of-chain pin only), the second refused outright
+            assert params.get("lease") is not None
+            assert mgr.pinned_pages == 1  # 3 blocks advertised, 1 pinned
+            params2 = await self._prefill_via_handler(a,
+                                                      list(range(2, 15)))
+            assert params2.get("lease") is None
+            assert params2["blocks"]  # export still advertised
+        finally:
+            await a.stop()
+
+
+def test_evict_expired_offers():
+    """Expired device-direct offers (decode never pulled/acked) are
+    reclaimed by the explicit sweep — no jax transfer API needed, the
+    offer table is plain host state."""
+    import time as _time
+
+    from dynamo_tpu.engine.transfer import OFFER_TTL_S, DeviceTransferPlane
+
+    plane = DeviceTransferPlane()
+    plane._offers[1] = (_time.time() - OFFER_TTL_S - 1.0, object())
+    plane._offers[2] = (_time.time(), object())
+    assert plane.evict_expired_offers() == 1
+    assert set(plane._offers) == {2}
+    # ack() prunes expired entries too (the inline GC path)
+    plane._offers[3] = (_time.time() - OFFER_TTL_S - 1.0, object())
+    plane.ack(2)
+    assert not plane._offers
+
+
 def test_kv_transfer_knobs_resolve_env(monkeypatch):
     """DYN_KV_FRAME_BLOCKS / DYN_KV_SCATTER_BLOCKS coerce like the PR 2
     knobs: env wins over defaults, malformed values fall back per-knob."""

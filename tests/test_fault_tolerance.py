@@ -623,3 +623,406 @@ class TestCoordinatorOutageMidServe:
                 await d.close()
         finally:
             await coord.stop()
+
+
+# ---------------------------------------------------------------------------
+# Data-plane fault tolerance: export leases + orphan GC on the prefill side,
+# checksummed resumable pulls on the decode side, prefill failover.  Faults
+# injected at the byte level (ChaosProxy corrupt/truncate against the bulk
+# plane) so every scenario is deterministic.
+# ---------------------------------------------------------------------------
+
+
+def _tiny_block_bytes():
+    """Bytes of one tiny-model KV block on the wire:
+    [L, 2, Hkv, page_size, Dh] * itemsize."""
+    import numpy as np
+
+    from dynamo_tpu.models.config import ModelConfig
+    cfg = ModelConfig.tiny()
+    return (cfg.num_layers * 2 * cfg.num_kv_heads * 4 * cfg.head_dim
+            * np.dtype(cfg.dtype).itemsize)
+
+
+async def _start_bulk_disagg_pair(coord_address, proxy_bulk=True,
+                                  num_pages=96):
+    """Prefill worker serving the bulk KV plane (optionally behind a
+    ChaosProxy) + decode handler. Returns a dict of the moving parts."""
+    import asyncio as aio
+
+    from dynamo_tpu.engine.jax_engine import JaxEngine, JaxEngineConfig
+    from dynamo_tpu.engine.transfer import (
+        serve_kv_export, serve_kv_export_bulk)
+    from dynamo_tpu.llm.register import serve_engine
+    from dynamo_tpu.models.config import ModelConfig
+    from dynamo_tpu.runtime.bulk import BulkServer
+    from dynamo_tpu.utils.faults import ChaosProxy
+    from dynamo_tpu.worker.disagg import (
+        KV_EXPORT_ENDPOINT, DisaggDecodeHandler)
+
+    def cfg():
+        return JaxEngineConfig(num_pages=num_pages, page_size=4,
+                               max_num_seqs=4, max_prefill_chunk=128,
+                               max_context=512, min_prefill_bucket=4)
+
+    parts = {"drts": [], "proxy": None, "bulk": None, "handler": None}
+    pre_drt = await DistributedRuntime.create(coordinator=coord_address)
+    parts["drts"].append(pre_drt)
+    pre_engine = JaxEngine.random_init(ModelConfig.tiny(), cfg())
+    parts["pre_engine"] = pre_engine
+    comp = pre_drt.namespace("ns").component("prefill")
+    await serve_engine(comp.endpoint("generate"), pre_engine)
+    bulk = BulkServer().start()  # TCP only: proxyable
+    parts["bulk"] = bulk
+    bulk.register(KV_EXPORT_ENDPOINT,
+                  serve_kv_export_bulk(pre_engine, aio.get_running_loop()))
+    bulk_address = bulk.address
+    if proxy_bulk:
+        proxy = await ChaosProxy(bulk.address).start()
+        parts["proxy"] = proxy
+        bulk_address = proxy.address
+    await comp.endpoint(KV_EXPORT_ENDPOINT).serve(
+        serve_kv_export(pre_engine), bulk_address=bulk_address)
+
+    dec_drt = await DistributedRuntime.create(coordinator=coord_address)
+    parts["drts"].append(dec_drt)
+    dec_engine = JaxEngine.random_init(ModelConfig.tiny(), cfg())
+    parts["dec_engine"] = dec_engine
+    handler = await DisaggDecodeHandler(
+        dec_engine, dec_drt, "ns", "prefill").start()
+    parts["handler"] = handler
+    # suppress the background bulk prewarm: its 32 MB warmup stream would
+    # consume the proxy's byte-offset faults before the real pull
+    handler._bulk_warmed.add(bulk_address)
+    await handler._gen_client.wait_for_instances(1, timeout=10)
+    await handler._kv_client.wait_for_instances(1, timeout=10)
+    return parts
+
+
+async def _stop_parts(parts):
+    if parts["handler"] is not None:
+        await parts["handler"].stop()
+    if parts["proxy"] is not None:
+        await parts["proxy"].stop()
+    if parts["bulk"] is not None:
+        parts["bulk"].stop()
+    for eng_key in ("pre_engine", "dec_engine"):
+        if eng_key in parts:
+            await parts[eng_key].stop()
+    for d in parts["drts"]:
+        try:
+            await d.close()
+        except Exception:
+            pass
+
+
+async def _solo_tokens(prompt, max_tokens=6, num_pages=96):
+    from dynamo_tpu.engine.jax_engine import JaxEngine, JaxEngineConfig
+    from dynamo_tpu.models.config import ModelConfig
+    solo = JaxEngine.random_init(ModelConfig.tiny(), JaxEngineConfig(
+        num_pages=num_pages, page_size=4, max_num_seqs=4,
+        max_prefill_chunk=128, max_context=512, min_prefill_bucket=4))
+    try:
+        return [t async for f in solo.generate(
+            make_req(prompt, "solo", max_tokens=max_tokens))
+            for t in f.token_ids]
+    finally:
+        await solo.stop()
+
+
+@pytest.mark.chaos
+class TestDataPlaneFaultTolerance:
+    async def test_decode_crash_after_prefill_lease_gc_within_ttl(
+            self, monkeypatch):
+        """Decode worker 'crashes' right after remote prefill (pull never
+        happens, ack never sent): the prefill side's export lease pins the
+        blocks, the TTL GC reclaims them, and the active-exports gauge
+        returns to 0 within the TTL."""
+        monkeypatch.setenv("DYN_KV_EXPORT_TTL_S", "1.5")
+        from dynamo_tpu.engine.transfer import get_export_leases
+        from dynamo_tpu.worker.metrics import get_worker_metrics
+
+        coord = await Coordinator(port=0).start()
+        parts = None
+        try:
+            parts = await _start_bulk_disagg_pair(coord.address,
+                                                  proxy_bulk=False)
+            handler, pre_engine = parts["handler"], parts["pre_engine"]
+            # warm the decode engine's jits with the SAME shapes as the
+            # fallback request: post-'crash' local serving must not eat
+            # the TTL in bucket compilation
+            async for _ in parts["dec_engine"].generate(
+                    make_req(list(range(200, 213)), "warm", max_tokens=6)):
+                pass
+
+            async def crash_pull(*a, **kw):
+                raise RuntimeError("decode worker crashed before pull")
+
+            handler._pull_blocks = crash_pull
+            reclaimed0 = get_worker_metrics().kv_exports_reclaimed._value.get()
+            prompt = list(range(1, 14))
+            frames = [f async for f in handler.generate(
+                make_req(prompt, "r1", max_tokens=6))]
+            assert frames[-1].finish_reason is not None  # served locally
+            mgr = get_export_leases(pre_engine)
+            assert mgr.active == 1  # orphaned export, pinned
+            for _ in range(60):  # GC sweep fires just past the TTL
+                if mgr.active == 0:
+                    break
+                await asyncio.sleep(0.05)
+            assert mgr.active == 0
+            assert mgr.reclaimed_total >= 1
+            m = get_worker_metrics()
+            assert m.kv_exports_active._value.get() == 0
+            assert m.kv_exports_reclaimed._value.get() >= reclaimed0 + 1
+        finally:
+            if parts is not None:
+                await _stop_parts(parts)
+            await coord.stop()
+
+    async def test_bulk_reset_mid_pull_resumes_missing_blocks(
+            self, monkeypatch):
+        """Socket reset mid-pull on the bulk plane: the pull resumes and
+        re-pulls ONLY the blocks not yet committed (commit state = the
+        content-addressed allocator), commits stay batched (PR 5 scatter
+        tap), and the request's tokens match aggregated serving."""
+        monkeypatch.setenv("DYN_KV_FRAME_BLOCKS", "2")
+        prompt = list(range(1, 98))  # 24 full blocks
+        want = await _solo_tokens(prompt)
+
+        coord = await Coordinator(port=0).start()
+        parts = None
+        try:
+            parts = await _start_bulk_disagg_pair(coord.address)
+            handler, proxy = parts["handler"], parts["proxy"]
+            dec_engine = parts["dec_engine"]
+            # cut the response stream mid-transfer: ~3.5 frames of the
+            # 12-frame prefix make it through before the hard close
+            frame_raw = 2 * _tiny_block_bytes()
+            proxy.truncate(after_bytes=int(3.5 * frame_raw))
+            base = dec_engine.page_scatter_dispatches
+
+            frames = [f async for f in handler.generate(
+                make_req(prompt, "r1", max_tokens=6))]
+            got = [t for f in frames for t in f.token_ids]
+            assert got == want
+            assert proxy.truncations == 1  # the fault really fired
+            stats = handler.last_pull_stats
+            assert stats["retries"] >= 1
+            # the resume skipped the already-committed head of the chain
+            # and re-pulled only the missing tail
+            assert 0 < stats["resumed_blocks"] < 24
+            assert stats["injected"] == 24
+            # PR 5 scatter-dispatch tap: both attempts committed in
+            # batched windows (no per-block or duplicate scatters)
+            assert dec_engine.page_scatter_dispatches - base <= 4
+            # decode really ran off the injected prefix
+            assert dec_engine.allocator.hits >= 24
+        finally:
+            if parts is not None:
+                await _stop_parts(parts)
+            await coord.stop()
+
+    async def test_corrupt_frame_nacked_and_repulled_never_injected(
+            self, monkeypatch):
+        """A corrupted frame (flipped bytes on the wire) fails the wire-v4
+        checksum BEFORE staging: it is never injected, the stream NACKs,
+        and the resumed pull re-fetches the missing blocks — tokens still
+        match aggregated serving bit-for-bit."""
+        monkeypatch.setenv("DYN_KV_FRAME_BLOCKS", "2")
+        from dynamo_tpu.runtime import codec
+        from dynamo_tpu.runtime.bulk import bulk_fetch
+        from dynamo_tpu.worker.disagg import KV_EXPORT_ENDPOINT
+        from dynamo_tpu.worker.metrics import get_worker_metrics
+
+        prompt = list(range(1, 98))  # 24 blocks, 12 two-block frames
+        want = await _solo_tokens(prompt)
+
+        coord = await Coordinator(port=0).start()
+        parts = None
+        try:
+            parts = await _start_bulk_disagg_pair(coord.address)
+            handler, proxy, bulk = (parts["handler"], parts["proxy"],
+                                    parts["bulk"])
+            pre_engine = parts["pre_engine"]
+            dec_engine = parts["dec_engine"]
+
+            # prefill once directly so the exact wire geometry can be
+            # measured (bypassing the proxy; its byte counters stay 0)
+            req = make_req(prompt, "measure", max_tokens=1)
+            req.prefill_only = True
+            pf = [f async for f in pre_engine.generate(req)]
+            hashes = [b[0] for b in pf[-1].kv_transfer_params["blocks"]]
+            assert len(hashes) == 24
+            measured = await asyncio.to_thread(
+                bulk_fetch, bulk.address, KV_EXPORT_ENDPOINT,
+                {"block_hashes": hashes, "wire": 4})
+            sizes = []
+            for meta, raw in measured:
+                sizes.append((len(codec.pack(meta)), raw.nbytes))
+                codec.release_buffer(raw)
+            assert len(sizes) == 12 and all("crc32" in m
+                                            for m, _r in measured)
+            # flip 64 bytes in the MIDDLE of frame 2's raw payload
+            frame1_total = 4 + sizes[0][0] + 4 + sizes[0][1]
+            offset = (frame1_total + 4 + sizes[1][0] + 4
+                      + sizes[1][1] // 2)
+            proxy.corrupt(after_bytes=offset, nbytes=64)
+
+            corrupt0 = get_worker_metrics().kv_frames_corrupt._value.get()
+            frames = [f async for f in handler.generate(
+                make_req(prompt, "r1", max_tokens=6))]
+            got = [t for f in frames for t in f.token_ids]
+            assert got == want  # no garbage KV ever influenced decode
+            assert proxy.corruptions >= 1  # the flip really happened
+            stats = handler.last_pull_stats
+            assert stats["corrupt"] >= 1   # checksum caught it (NACK)
+            assert stats["retries"] >= 1   # and the pull resumed
+            assert stats["injected"] == 24
+            assert (get_worker_metrics().kv_frames_corrupt._value.get()
+                    >= corrupt0 + 1)
+            assert dec_engine.allocator.hits >= 24
+        finally:
+            if parts is not None:
+                await _stop_parts(parts)
+            await coord.stop()
+
+    async def test_prefill_failover_to_alternate_instance(self):
+        """First prefill instance is broken: the decode worker retries the
+        direct leg ONCE on the alternate instance instead of paying a full
+        local re-prefill."""
+        from dynamo_tpu.engine.jax_engine import JaxEngine, JaxEngineConfig
+        from dynamo_tpu.engine.transfer import serve_kv_export
+        from dynamo_tpu.llm.register import serve_engine
+        from dynamo_tpu.models.config import ModelConfig
+        from dynamo_tpu.worker.disagg import (
+            KV_EXPORT_ENDPOINT, DisaggDecodeHandler)
+        from dynamo_tpu.worker.metrics import get_worker_metrics
+
+        def cfg():
+            return JaxEngineConfig(num_pages=64, page_size=4,
+                                   max_num_seqs=4, max_prefill_chunk=16,
+                                   max_context=128, min_prefill_bucket=4)
+
+        prompt = list(range(1, 14))
+        want = await _solo_tokens(prompt, num_pages=64)
+        coord = await Coordinator(port=0).start()
+        drts, handler, good_engine = [], None, None
+        try:
+            # broken prefill worker FIRST (lower lease id -> round-robin
+            # hits it on the first attempt)
+            bad_drt = await DistributedRuntime.create(
+                coordinator=coord.address)
+            drts.append(bad_drt)
+            bad_comp = bad_drt.namespace("ns").component("prefill")
+
+            async def broken(payload, ctx):
+                yield LLMEngineOutput(
+                    error="prefill worker crashed",
+                    finish_reason=FinishReason.ERROR).to_dict()
+
+            await bad_comp.endpoint("generate").serve(broken)
+
+            good_drt = await DistributedRuntime.create(
+                coordinator=coord.address)
+            drts.append(good_drt)
+            good_engine = JaxEngine.random_init(ModelConfig.tiny(), cfg())
+            good_comp = good_drt.namespace("ns").component("prefill")
+            await serve_engine(good_comp.endpoint("generate"), good_engine)
+            await good_comp.endpoint(KV_EXPORT_ENDPOINT).serve(
+                serve_kv_export(good_engine))
+
+            dec_drt = await DistributedRuntime.create(
+                coordinator=coord.address)
+            drts.append(dec_drt)
+            dec_engine = JaxEngine.random_init(ModelConfig.tiny(), cfg())
+            handler = await DisaggDecodeHandler(
+                dec_engine, dec_drt, "ns", "prefill",
+                use_queue=False).start()
+            await handler._gen_client.wait_for_instances(2, timeout=10)
+            failover0 = get_worker_metrics().prefill_failovers.labels(
+                "ok")._value.get()
+
+            frames = [f async for f in handler.generate(
+                make_req(prompt, "r1", max_tokens=6))]
+            got = [t for f in frames for t in f.token_ids]
+            assert got == want
+            # the GOOD instance served the prefill (failover, not local):
+            # its engine computed the prefix and the decode side pulled it
+            assert good_engine.allocator.misses >= 3
+            assert dec_engine.allocator.hits >= 3
+            assert (get_worker_metrics().prefill_failovers.labels(
+                "ok")._value.get() >= failover0 + 1)
+        finally:
+            if handler is not None:
+                await handler.stop()
+            if good_engine is not None:
+                await good_engine.stop()
+            for d in drts:
+                try:
+                    await d.close()
+                except Exception:
+                    pass
+            await coord.stop()
+
+
+@pytest.mark.chaos
+class TestChaosProxyRpcPlane:
+    async def test_corrupt_rpc_frame_rejected_by_checksum(self,
+                                                          monkeypatch):
+        """ChaosProxy's corrupt mode works against RPC sockets too: a
+        wire-v4 frame pulled over the RPC plane with flipped bytes fails
+        checksum verification before staging — never injected — and a
+        clean re-request through the healed proxy succeeds."""
+        monkeypatch.setenv("DYN_KV_FRAME_BLOCKS", "24")  # one big frame
+        from dynamo_tpu.engine.jax_engine import JaxEngine, JaxEngineConfig
+        from dynamo_tpu.engine.transfer import (
+            FrameIntegrityError, InjectPipeline, serve_kv_export)
+        from dynamo_tpu.models.config import ModelConfig
+        from dynamo_tpu.runtime.rpc import RpcConnection, RpcServer
+        from dynamo_tpu.utils.faults import ChaosProxy
+
+        cfg = JaxEngineConfig(num_pages=96, page_size=4, max_num_seqs=4,
+                              max_prefill_chunk=128, max_context=512,
+                              min_prefill_bucket=4)
+        a = JaxEngine.random_init(ModelConfig.tiny(), cfg)
+        b = JaxEngine.random_init(ModelConfig.tiny(), cfg)
+        server = await RpcServer().start()
+        proxy = await ChaosProxy(server.address).start()
+        client = None
+        try:
+            req = make_req(list(range(1, 98)), "p", max_tokens=1)
+            req.prefill_only = True
+            frames = [f async for f in a.generate(req)]
+            hashes = [blk[0] for blk in
+                      frames[-1].kv_transfer_params["blocks"]]
+            assert len(hashes) == 24
+            server.register("kv_export", serve_kv_export(a))
+            client = await RpcConnection(proxy.address).connect()
+            # flip 16 bytes well inside the single ~48 KB raw trailer
+            # (24 blocks x 2048 B; the pre-trailer header/meta bytes are
+            # only a few hundred)
+            proxy.corrupt(after_bytes=25_000, nbytes=16)
+            stream = await client.request(
+                "kv_export", {"block_hashes": hashes, "wire": 4})
+            pipe = InjectPipeline(b)
+            with pytest.raises(FrameIntegrityError):
+                async for frame in stream:
+                    await pipe.add_frame(frame)
+            await pipe.drain()
+            assert not b.allocator._by_hash  # nothing injected
+            assert proxy.corruptions >= 1
+            # healed proxy: the re-pull (same connection) injects cleanly
+            stream = await client.request(
+                "kv_export", {"block_hashes": hashes, "wire": 4})
+            pipe = InjectPipeline(b)
+            async for frame in stream:
+                await pipe.add_frame(frame)
+            assert await pipe.finish() == 24
+        finally:
+            if client is not None:
+                await client.close()
+            await proxy.stop()
+            await server.stop()
+            await a.stop()
+            await b.stop()
