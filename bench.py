@@ -417,6 +417,15 @@ async def run_attempt(args) -> dict:
         wd.disarm()
         return result
 
+    # long-context tiering leg (tiny model, every tier/backend — it
+    # measures the KVBM packing-prefetch machinery, not model compute):
+    # ttft_vs_context + prefetch_hit_rate land in the result JSON
+    try:
+        result["longctx"] = await _measure_long_context(wd)
+    except Exception as e:  # noqa: BLE001 — best-effort extra data
+        result["longctx"] = {"error": str(e)[:300]}
+    print(json.dumps(result), flush=True)
+
     # attn-impl A/B in the SAME process (round-4 open question:
     # scan+pallas vs pallas_unrolled on chip) — another engine, same init.
     ab_impl = args.ab
@@ -571,6 +580,128 @@ def _time_step_kind(engine, kind: str, B: int, S: int, wd: Watchdog,
         times.append(time.perf_counter() - t0)
     times.sort()
     return times[len(times) // 2]
+
+
+# long-context leg: tier-resident context lengths measured for TTFT
+# scaling (override with BENCH_LONGCTX="4096,32768"; the smoke test in
+# tests/test_bench.py shortens it to stay inside the CI budget)
+LONGCTX_CONTEXTS = (4096, 16384, 32768, 65536)
+
+
+async def _measure_long_context(wd: Watchdog) -> dict:
+    """Long-context serving leg (ROADMAP item 3, the packing-prefetch
+    scheduler): TTFT vs context length with the prompt's KV resident in
+    the HOST TIER, not HBM — the tier-resident re-serve a long-context
+    deployment lives on.
+
+    Builds its own tiny-model tiered engine (the leg measures the
+    tiering/prefetch machinery, not model compute), seeds the host tier
+    with synthesized content-addressed blocks for each prompt, and times
+    ``generate()``: TTFT = first-chunk onboard + lookahead promotion
+    racing the chunked-prefill cursor (adopted blocks skip compute) + the
+    final chunk. Records ``ttft_vs_context`` and ``prefetch_hit_rate``;
+    TTFT growing SUB-linearly vs the context growth is the acceptance
+    signal (``sublinear``), and the scatter-dispatch tap per point shows
+    promotion landed in bounded windows, not one admission stall."""
+    import numpy as np
+
+    from dynamo_tpu.engine.jax_engine import JaxEngine, JaxEngineConfig
+    from dynamo_tpu.engine.transfer import BlockPayload
+    from dynamo_tpu.kvbm import TieredEngine, TieredKvConfig
+    from dynamo_tpu.models.config import ModelConfig
+    from dynamo_tpu.protocols.common import (
+        PreprocessedRequest, SamplingOptions, StopConditions)
+    from dynamo_tpu.tokens import compute_block_hash_for_seq
+
+    raw = os.environ.get("BENCH_LONGCTX")
+    contexts = ([int(x) for x in raw.split(",") if x.strip()]
+                if raw else list(LONGCTX_CONTEXTS))
+    page = 4
+    max_ctx = contexts[-1] + 128
+    cfg = ModelConfig.tiny(dtype="float32",
+                           max_position_embeddings=max_ctx)
+    eng = JaxEngine.random_init(cfg, JaxEngineConfig(
+        num_pages=max_ctx // page + 512, page_size=page, max_num_seqs=2,
+        max_prefill_chunk=512, max_context=max_ctx,
+        min_prefill_bucket=512))
+    tiered = TieredEngine(eng, TieredKvConfig(host_budget_bytes=1 << 30))
+    if tiered.prefetch is None:
+        raise RuntimeError("prefetch disabled (DYN_KV_PREFETCH_DEPTH=0); "
+                           "long-context leg needs it")
+    rng = np.random.default_rng(7)
+    ref = eng.pages[0] if isinstance(eng.pages, list) else eng.pages
+    L = (len(eng.pages) if isinstance(eng.pages, list)
+         else eng.pages.shape[0])
+    # one shared zero block: the leg measures promotion bandwidth and
+    # scheduling, not KV content (decode over it is still a real step)
+    blk = np.zeros((L,) + tuple(ref.shape[-4:]), np.dtype(ref.dtype))
+
+    def req(toks, rid):
+        return PreprocessedRequest(
+            token_ids=toks, request_id=rid,
+            stop_conditions=StopConditions(max_tokens=4, ignore_eos=True),
+            sampling_options=SamplingOptions(temperature=0.0))
+
+    points = []
+    try:
+        # compile the prefill/decode shapes outside the timed points
+        wd.arm("longctx:warm", STAGE_BUDGETS["transport"])
+        warm = rng.integers(1, cfg.vocab_size, size=600).tolist()
+        async for _ in tiered.generate(req(warm, "lc-warm")):
+            pass
+        for ctx in contexts:
+            wd.arm(f"longctx:{ctx}", STAGE_BUDGETS["transport"])
+            toks = rng.integers(1, cfg.vocab_size, size=ctx).tolist()
+            hashes = compute_block_hash_for_seq(toks, page)
+            parent = None
+            for h in hashes:
+                tiered.host.put(BlockPayload(
+                    block_hash=h, local_hash=h, parent_hash=parent,
+                    data=blk))
+                parent = h
+            s0 = tiered.kvbm_stats()
+            d0 = eng.page_scatter_dispatches
+            t0 = time.perf_counter()
+            first = None
+            async for out in tiered.generate(req(toks, f"lc{ctx}")):
+                if out.token_ids and first is None:
+                    first = time.perf_counter() - t0
+            s1 = tiered.kvbm_stats()
+            hits = s1["kvbm_prefetch_hits"] - s0["kvbm_prefetch_hits"]
+            late = s1["kvbm_prefetch_late"] - s0["kvbm_prefetch_late"]
+            point = {
+                "tokens": ctx,
+                "ttft_s": round(first, 3) if first is not None else None,
+                "prefetch_hits": int(hits),
+                "prefetch_late": int(late),
+                "adopted": int(s1["kvbm_prefetch_adopted_blocks"]
+                               - s0["kvbm_prefetch_adopted_blocks"]),
+                "scatter_dispatches": eng.page_scatter_dispatches - d0,
+            }
+            points.append(point)
+            _ckpt("longctx_point", **point)
+    finally:
+        await tiered.stop()
+
+    stats = tiered.kvbm_stats()
+    promoted = stats["kvbm_prefetch_hits"] + stats["kvbm_prefetch_late"]
+    hit_rate = (stats["kvbm_prefetch_hits"] / promoted) if promoted else 0.0
+    timed = [p for p in points if p["ttft_s"]]
+    sub = None
+    if len(timed) >= 2 and timed[0]["ttft_s"] > 0:
+        ttft_ratio = timed[-1]["ttft_s"] / timed[0]["ttft_s"]
+        ctx_ratio = timed[-1]["tokens"] / timed[0]["tokens"]
+        # <1.0 means TTFT grew slower than the context did
+        sub = round(ttft_ratio / ctx_ratio, 3)
+    return {
+        "tier": "host",
+        "page_size": page,
+        "ttft_vs_context": points,
+        "prefetch_hit_rate": round(hit_rate, 3),
+        # ttft-growth / context-growth; sublinear iff < 1.0
+        "ttft_scaling": sub,
+        "sublinear": bool(sub is not None and sub < 1.0),
+    }
 
 
 # target bytes per transport measurement: small samples measure framing

@@ -201,6 +201,10 @@ class Scheduler:
         # consecutive chained steps since the last schedule() (the
         # spec_chain_break counter)
         self._chain_run = 0
+        # blocks adopted mid-prefill from the prefix cache (injected by the
+        # KVBM prefetch scheduler or a concurrent request after THIS
+        # sequence was admitted) instead of being recomputed
+        self.adopted_blocks = 0
 
     def drain_reaped(self) -> List[Sequence]:
         out, self.reaped = self.reaped, []
@@ -329,12 +333,60 @@ class Scheduler:
                     return False
         return True
 
+    def _adopt_resident(self, seq: Sequence) -> int:
+        """Mid-prefill prefix adoption: swap upcoming fresh pages for blocks
+        that became resident AFTER this sequence was admitted.
+
+        Admission prefix-matches once; blocks injected later (the KVBM
+        prefetch scheduler streaming tier promotions ahead of the chunked
+        prefill cursor, a disagg pull, or a concurrent request committing
+        the same prefix) would be recomputed without this. At each prefill
+        planning pass, walk the chain from the cursor: while the next
+        block's hash is resident, claim the resident page, release the
+        fresh page allocated for that position, and advance
+        ``num_computed`` past it — the prefill chunk then starts where
+        residency ends. Committed pages are immutable, so sharing one with
+        its owner is the ordinary prefix-cache aliasing.
+
+        Only runs at page-aligned cursors (a partially computed page can't
+        be spliced) and always leaves >=1 token to compute so the final
+        chunk's logits exist (the admission rule)."""
+        if seq.num_computed % self.page_size:
+            return 0
+        blocks = seq.tokens.blocks
+        limit = min((len(seq) - 1) // self.page_size, len(seq.page_ids))
+        i = seq.num_computed // self.page_size
+        adopted = 0
+        while i < limit and i < len(blocks):
+            page = self.alloc._by_hash.get(blocks[i].block_hash)
+            if page is None or page == seq.page_ids[i]:
+                break
+            self.alloc.incref(page)
+            old = seq.page_ids[i]
+            seq.page_ids[i] = page
+            self.alloc.release([old])  # fresh + uncommitted: frees
+            seq.num_computed += self.page_size
+            seq.committed_pages = max(seq.committed_pages, i + 1)
+            adopted += 1
+            i += 1
+        if adopted:
+            self.adopted_blocks += adopted
+            if not seq.generated:  # still reporting the prefix hit
+                seq.cached_tokens += adopted * self.page_size
+        return adopted
+
     # -- the step ----------------------------------------------------------
 
     def _prefill_plan(self) -> Optional[PrefillBatch]:
         """Admit waiting sequences (bounded by slots, pages, and batch
         width), then pack up to ``max_prefill_seqs`` chunks into one step
         under the ``max_prefill_chunk`` token budget, oldest first."""
+        # adopt blocks that became resident since admission (prefetch or
+        # disagg injects, concurrent requests committing a shared prefix)
+        # so each chunk starts where residency ends
+        for s in self.active.values():
+            if s.phase == Phase.PREFILL:
+                self._adopt_resident(s)
         rt = self.cfg.ring_threshold
 
         def ring_eligible(s: Sequence) -> bool:

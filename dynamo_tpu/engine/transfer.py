@@ -953,7 +953,12 @@ class ExportLeaseManager:
 
     def __init__(self, engine: JaxEngine):
         self._engine = engine
-        self._leases: Dict[int, Tuple[float, List[int]]] = {}
+        # lease_id -> (deadline, pages, kind); kind "export" = a disagg
+        # pull's advertised prefix, "prefetch" = tier blocks the KVBM
+        # prefetch scheduler promoted ahead of a request's prefill cursor
+        # (kvbm/prefetch.py) — same pin primitive, same half-allocator
+        # hard cap, separate observability
+        self._leases: Dict[int, Tuple[float, List[int], str]] = {}
         self._next_id = 1
         self._lock = threading.Lock()
         self._sweep_tasks: set = set()
@@ -972,29 +977,61 @@ class ExportLeaseManager:
     @property
     def pinned_pages(self) -> int:
         with self._lock:
-            return sum(len(p) for _dl, p in self._leases.values())
+            return sum(len(p) for _dl, p, _k in self._leases.values())
+
+    def active_kind(self, kind: str) -> int:
+        with self._lock:
+            return sum(1 for _dl, _p, k in self._leases.values()
+                       if k == kind)
+
+    def pinned_pages_kind(self, kind: str) -> int:
+        with self._lock:
+            return sum(len(p) for _dl, p, k in self._leases.values()
+                       if k == kind)
+
+    def holds(self, lease_id: int) -> bool:
+        """Whether a lease is still live (not released, not TTL-swept)."""
+        with self._lock:
+            return lease_id in self._leases
 
     def _gauge(self) -> None:
         try:
             from dynamo_tpu.worker.metrics import get_worker_metrics
-            get_worker_metrics().kv_exports_active.set(self.active)
+            get_worker_metrics().kv_exports_active.set(
+                self.active_kind("export"))
         except Exception:  # noqa: BLE001 — metrics must not fail the grant
             pass
 
     # -- allocator-side halves (run under run_exclusive) -------------------
 
-    def _grant_sync(self, hashes: List[int], ttl: float) -> Optional[int]:
+    def grant_sync(self, hashes: List[int], ttl: Optional[float] = None,
+                   kind: str = "export") -> Tuple[Optional[int], int]:
+        """Synchronous grant for callers ALREADY inside an exclusive
+        window (e.g. an ``InjectPipeline`` commit callback pinning blocks
+        in the same window that committed them, so eviction pressure can
+        never snatch a block between commit and pin). Returns
+        ``(lease_id, pages_pinned)``; the caller must ``arm_sweep(ttl)``
+        from the event loop afterwards (a later-armed timer still fires
+        past the deadline, and every sweep reclaims ALL expired leases)."""
+        ttl = export_ttl_s() if ttl is None else float(ttl)
         self._sweep_sync()  # reclaim expired pins before the cap check
         alloc = self._engine.allocator
         with self._lock:
-            pinned = sum(len(p) for _dl, p in self._leases.values())
+            pinned = sum(len(p) for _dl, p, _k in self._leases.values())
             budget = self.max_pinned_pages - pinned
             if budget <= 0:
-                logger.warning(
-                    "export lease refused: %d pages already pinned "
-                    "(cap %d) — decode pulls failing or not acking?",
-                    pinned, self.max_pinned_pages)
-                return None
+                if kind == "export":
+                    logger.warning(
+                        "export lease refused: %d pages already pinned "
+                        "(cap %d) — decode pulls failing or not acking?",
+                        pinned, self.max_pinned_pages)
+                else:
+                    # a long prompt hitting the cap is NORMAL for prefetch
+                    # pins (the overflow stays ordinary LRU); not a fault
+                    logger.debug(
+                        "%s lease refused: %d pages pinned (cap %d)",
+                        kind, pinned, self.max_pinned_pages)
+                return None, 0
             pages = alloc.claim_blocks(hashes)
             if len(pages) > budget:
                 # the cap is a hard bound, not a pre-check: trim the claim
@@ -1004,13 +1041,18 @@ class ExportLeaseManager:
                 alloc.release(pages[budget:])
                 pages = pages[:budget]
             if not pages:
-                return None
+                return None, 0
             lease_id = self._next_id
             self._next_id += 1
-            self._leases[lease_id] = (time.monotonic() + ttl, pages)
+            self._leases[lease_id] = (time.monotonic() + ttl, pages, kind)
             self.granted_total += 1
+            n = len(pages)
         self._gauge()
-        return lease_id
+        return lease_id, n
+
+    def _grant_sync(self, hashes: List[int], ttl: float,
+                    kind: str = "export") -> Optional[int]:
+        return self.grant_sync(hashes, ttl, kind)[0]
 
     def _release_sync(self, lease_id: int) -> bool:
         with self._lock:
@@ -1025,17 +1067,17 @@ class ExportLeaseManager:
         now = time.monotonic()
         with self._lock:
             expired = [(i, self._leases[i])
-                       for i, (dl, _p) in list(self._leases.items())
+                       for i, (dl, _p, _k) in list(self._leases.items())
                        if dl <= now]
             for i, _e in expired:
                 del self._leases[i]
             self.reclaimed_total += len(expired)
-        for _i, (_dl, pages) in expired:
+        for _i, (_dl, pages, _k) in expired:
             self._engine.allocator.release(pages)
         if expired:
-            logger.warning("reclaimed %d orphaned KV export lease(s) "
+            logger.warning("reclaimed %d orphaned KV lease(s) "
                            "(%d pages) past TTL", len(expired),
-                           sum(len(p) for _i, (_d, p) in expired))
+                           sum(len(p) for _i, (_d, p, _k) in expired))
             self._gauge()
             try:
                 from dynamo_tpu.worker.metrics import get_worker_metrics
@@ -1047,22 +1089,33 @@ class ExportLeaseManager:
     # -- async surface (event loop) ----------------------------------------
 
     async def grant(self, hashes: List[int],
-                    ttl: Optional[float] = None) -> Optional[int]:
+                    ttl: Optional[float] = None,
+                    kind: str = "export") -> Optional[int]:
         """Pin the resident chain of ``hashes`` for one pull; returns the
         lease id (wire-safe) or None when nothing is resident / the pin
         cap is hit (the export still works, it just isn't protected)."""
         ttl = export_ttl_s() if ttl is None else float(ttl)
         lease = await self._engine.run_exclusive(self._grant_sync,
-                                                 list(hashes), ttl)
+                                                 list(hashes), ttl, kind)
         if lease is not None:
-            self._arm_sweep(ttl)
+            self.arm_sweep(ttl)
         return lease
 
     async def release(self, lease_id: int) -> bool:
         return await self._engine.run_exclusive(self._release_sync,
                                                 int(lease_id))
 
-    def _arm_sweep(self, ttl: float) -> None:
+    def release_detached(self, lease_id: int) -> bool:
+        """Release without touching the engine loop: for teardown paths
+        where the loop is stopped/dead (``run_exclusive`` would restart
+        it). Safe there because nothing races the allocator anymore."""
+        try:
+            return self._release_sync(int(lease_id))
+        except Exception:  # noqa: BLE001 — TTL covers a failed release
+            logger.debug("detached lease release failed", exc_info=True)
+            return False
+
+    def arm_sweep(self, ttl: float) -> None:
         # one timer per grant, firing just past that lease's deadline: a
         # sweep reclaims EVERY expired lease, and a dropped timer (loop
         # closed) costs nothing — no persistent GC task to leak

@@ -12,10 +12,12 @@ framework's content-addressed blocks:
   page is reused; the manager snapshots the block device->host (the jax
   array is an immutable snapshot, so this is race-free against in-flight
   steps). Host-pool overflow demotes G2 -> G3.
-- **Onboard** happens at request admission: prompt blocks missing from HBM
-  but resident in G2/G3 are injected back through the same content-addressed
-  path disaggregation uses (``engine/transfer.py``), after which the normal
-  prefix-match admission revives them — no scheduler changes.
+- **Onboard** is pipelined lookahead (``prefetch.py``, the packing-prefetch
+  scheduler): the first prefill chunk's blocks inject synchronously so
+  admission's prefix match sees them, and the rest stream in pinned ahead
+  of the chunked-prefill cursor — adopted mid-prefill by the engine
+  scheduler instead of recomputed. ``DYN_KV_PREFETCH_DEPTH=0`` restores
+  the bounded synchronous onboard.
 - **G4 (remote)** is the disagg block-transfer plane itself
   (``worker/disagg.py``): remote workers' caches are reachable by the same
   hashes over the RPC plane.
@@ -25,6 +27,8 @@ device_get/device_put gathers (XLA handles batching/overlap).
 """
 
 from dynamo_tpu.kvbm.manager import TieredEngine, TieredKvConfig
+from dynamo_tpu.kvbm.prefetch import PrefetchScheduler, prefetch_depth_bytes
 from dynamo_tpu.kvbm.tiers import DiskTier, HostTier
 
-__all__ = ["TieredEngine", "TieredKvConfig", "HostTier", "DiskTier"]
+__all__ = ["TieredEngine", "TieredKvConfig", "HostTier", "DiskTier",
+           "PrefetchScheduler", "prefetch_depth_bytes"]

@@ -49,10 +49,17 @@ class TieredKvConfig:
     host_budget_bytes: int = 1 << 30          # G2: 1 GiB default
     disk_budget_bytes: int = 0                # G3: 0 = disabled
     disk_path: str = "/tmp/dynamo_tpu_kvbm"
-    # cap on blocks onboarded per request (bound admission latency)
+    # cap on blocks onboarded SYNCHRONOUSLY per request. With the prefetch
+    # scheduler on (the default) only the first prefill chunk's blocks
+    # onboard synchronously (min of that and this cap) — the rest stream
+    # in ahead of the chunked-prefill cursor; with lookahead disabled
+    # (depth 0) this is the old hard cap on the whole onboard.
     max_onboard_blocks: int = 256
     # bounded background spill queue (eviction batches in flight)
     max_pending_spills: int = 8
+    # packing-prefetch lookahead depth in bytes; None = resolve
+    # DYN_KV_PREFETCH_DEPTH / RuntimeConfig.kv_prefetch_depth, 0 disables
+    prefetch_depth_bytes: Optional[int] = None
 
 
 class TieredEngine(EngineBase):
@@ -60,6 +67,9 @@ class TieredEngine(EngineBase):
 
     def __init__(self, engine: JaxEngine,
                  config: Optional[TieredKvConfig] = None):
+        from dynamo_tpu.kvbm.prefetch import (
+            PrefetchScheduler, prefetch_depth_bytes)
+
         self.engine = engine
         self.cfg = config or TieredKvConfig()
         self.host = HostTier(self.cfg.host_budget_bytes)
@@ -68,8 +78,17 @@ class TieredEngine(EngineBase):
         self.offloaded = 0
         self.onboarded = 0
         self.dropped_spills = 0
-        self._tier_lock = threading.Lock()
+        # RLock: _lookup acquires it internally and is also called from
+        # sections that already hold it (collect_tiered_blocks)
+        self._tier_lock = threading.RLock()
         self._pending_lock = threading.Lock()
+        depth = (prefetch_depth_bytes()
+                 if self.cfg.prefetch_depth_bytes is None
+                 else int(self.cfg.prefetch_depth_bytes))
+        # the lookahead promotion scheduler (kvbm/prefetch.py); None =
+        # legacy synchronous onboarding
+        self.prefetch = (PrefetchScheduler(self, depth)
+                         if depth > 0 else None)
         self._pending_hashes: set = set()
         self._spills: "queue.Queue" = queue.Queue(
             maxsize=self.cfg.max_pending_spills)
@@ -132,15 +151,20 @@ class TieredEngine(EngineBase):
             metas, data_dev = self._spills.get()
             try:
                 host = np.asarray(data_dev)  # the device->host copy
+                to_disk: List[BlockPayload] = []
                 with self._tier_lock:
                     for i, (h, local, parent) in enumerate(metas):
                         blk = BlockPayload(block_hash=h, local_hash=local,
                                            parent_hash=parent,
                                            data=host[:, i].copy())
                         self.offloaded += 1
-                        for demoted in self.host.put(blk):
-                            if self.disk is not None:
-                                self.disk.put(demoted)
+                        to_disk.extend(self.host.put(blk))
+                if self.disk is not None:
+                    # G2->G3 demotion writes OUTSIDE the tier lock: a slow
+                    # disk must only stall this spill thread, never an
+                    # onboard/prefetch probe waiting on the lock
+                    for demoted in to_disk:
+                        self.disk.put(demoted)
             except Exception:
                 logger.exception("kvbm spill batch failed; blocks dropped")
             finally:
@@ -160,34 +184,65 @@ class TieredEngine(EngineBase):
     # -- onboard (G2/G3 -> G1) --------------------------------------------
 
     def _lookup(self, block_hash: int) -> Optional[BlockPayload]:
-        blk = self.host.get(block_hash)
-        if blk is None and self.disk is not None:
-            blk = self.disk.get(block_hash)
-            if blk is not None:
-                for demoted in self.host.put(blk):  # promote on use
-                    self.disk.put(demoted)
+        """One tier lookup with disk->host promotion on use. Acquires the
+        tier lock internally (RLock — callers may already hold it); when
+        called WITHOUT it held (the prefetch worker thread), the disk file
+        read and the G2->G3 demotion write-back run outside the host-tier
+        lock, so slow disk IO never serializes other tier operations."""
+        with self._tier_lock:
+            blk = self.host.get(block_hash)
+        if blk is not None or self.disk is None:
+            return blk
+        blk = self.disk.get(block_hash)  # file IO under the disk's own lock
+        if blk is None:
+            return None
+        with self._tier_lock:
+            demoted = self.host.put(blk)  # promote on use
+        for d in demoted:
+            self.disk.put(d)
         return blk
 
-    def _onboard_for(self, token_ids: List[int]) -> int:
-        """Inject tier-resident prompt blocks missing from HBM."""
+    def _onboard_for(self, token_ids: List[int],
+                     cap: Optional[int] = None,
+                     host_only: bool = False,
+                     hashes: Optional[List[int]] = None) -> int:
+        """Inject tier-resident prompt blocks missing from HBM — the
+        bounded SYNCHRONOUS path: the prefetch scheduler's first-chunk
+        fast path (``cap`` = the first prefill chunk's blocks), or the
+        whole legacy onboard when lookahead is disabled.
+
+        ``host_only`` keeps this path off the disk tier (and the spill
+        flush) entirely: it runs inside the engine's exclusive window,
+        and a wedged disk must never stall the step loop — disk-resident
+        blocks are promoted asynchronously by the prefetcher (or
+        recomputed). ``hashes`` lets the caller pass the already-computed
+        chain so a 100k-token prompt isn't re-hashed inside the window."""
         page_size = self.engine.allocator.page_size
-        hashes = compute_block_hash_for_seq(token_ids, page_size)
+        if hashes is None:
+            hashes = compute_block_hash_for_seq(token_ids, page_size)
+        cap = self.cfg.max_onboard_blocks if cap is None else int(cap)
         # onboarding must observe completed offloads — but only wait when a
         # NEEDED block is actually still in the spill queue; flushing every
         # pending batch here would re-serialize slow tier writes onto the
-        # step loop at every admission
-        with self._pending_lock:
-            overlap = bool(self._pending_hashes.intersection(
-                h for h in hashes[:self.cfg.max_onboard_blocks]))
-        if overlap:
-            self.flush_spills()
+        # step loop at every admission. NEVER on the host_only fast path:
+        # flush_spills waits out the spill thread's G2->G3 disk writes,
+        # and a wedged disk must not stall the exclusive window this runs
+        # in — a pending block simply misses here and the async
+        # prefetcher (which flushes on ITS thread) promotes it instead.
+        if not host_only:
+            with self._pending_lock:
+                overlap = bool(self._pending_hashes.intersection(
+                    h for h in hashes[:cap]))
+            if overlap:
+                self.flush_spills()
         resident = self.engine.allocator._by_hash
         needed: List[BlockPayload] = []
         with self._tier_lock:
-            for h in hashes[:self.cfg.max_onboard_blocks]:
+            for h in hashes[:cap]:
                 if h in resident:
                     continue
-                blk = self._lookup(h)
+                blk = (self.host.get(h) if host_only
+                       else self._lookup(h))
                 if blk is None:
                     break  # chain broken: further blocks can't be used
                 needed.append(blk)
@@ -274,18 +329,38 @@ class TieredEngine(EngineBase):
 
     async def generate(self, request: PreprocessedRequest,
                        ctx=None) -> AsyncIterator[LLMEngineOutput]:
+        handle = None
         if request.token_ids:
-            # serialized with the step loop: onboarding reassigns
-            # engine.pages, which is donated through every step
-            await self.engine.run_exclusive(
-                self._onboard_for, request.token_ids)
+            if not request.request_id:
+                # the engine assigns this same fallback id later; the
+                # prefetch cursor needs it NOW to track the sequence
+                request.request_id = f"req-{id(request):x}"
+            if self.prefetch is not None:
+                # admission lookahead: the first prefill chunk's blocks
+                # onboard synchronously so admission's prefix match sees
+                # them; later chunks' blocks stream in pinned ahead of the
+                # chunked-prefill cursor and are adopted mid-prefill
+                # (Scheduler._adopt_resident) instead of recomputed
+                handle = await self.prefetch.admit(request)
+            else:
+                # legacy path (DYN_KV_PREFETCH_DEPTH=0): serialized with
+                # the step loop — onboarding reassigns engine.pages, which
+                # is donated through every step
+                await self.engine.run_exclusive(
+                    self._onboard_for, request.token_ids)
             if self._peer_client is not None:
                 try:
                     await self._onboard_from_peers(request.token_ids)
                 except Exception:  # noqa: BLE001 — G4 must never fail a req
                     logger.exception("G4 peer onboard failed")
-        async for out in self.engine.generate(request, ctx):
-            yield out
+        try:
+            async for out in self.engine.generate(request, ctx):
+                yield out
+        finally:
+            if handle is not None:
+                # commit or abort: release the promotion pins (the
+                # sequence's own page refs — or the LRU — own them now)
+                await handle.close()
 
     async def start(self) -> None:
         await self.engine.start()
@@ -312,6 +387,13 @@ class TieredEngine(EngineBase):
             if self.disk is not None:
                 out["kvbm_disk_blocks"] = len(self.disk)
                 out["kvbm_disk_bytes"] = self.disk.used
+                out["kvbm_disk_corrupt_dropped"] = self.disk.corrupt_dropped
+        # mid-prefill prefix adoptions (the consumer half of the prefetch
+        # pipeline) live on the engine scheduler
+        out["kvbm_prefetch_adopted_blocks"] = \
+            self.engine.scheduler.adopted_blocks
+        if self.prefetch is not None:
+            out.update(self.prefetch.stats())
         return out
 
 

@@ -61,6 +61,12 @@ class RuntimeConfig:
     # windows amortize jit dispatch, smaller windows bound how long a
     # decode step can stall behind one KV commit
     kv_scatter_blocks: int = 64
+    # KVBM packing-prefetch lookahead depth in BYTES (short-form env
+    # DYN_KV_PREFETCH_DEPTH wins): how far ahead of a request's chunked-
+    # prefill cursor the tier promotion scheduler stages cold KV blocks.
+    # 0 disables lookahead (tier onboarding falls back to the bounded
+    # synchronous path)
+    kv_prefetch_depth: int = 64 * 1024 * 1024
 
     @classmethod
     def load(cls, path: Optional[str] = None,

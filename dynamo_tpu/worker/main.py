@@ -473,6 +473,10 @@ async def amain(args: argparse.Namespace) -> None:
                           else "worker")
     wm = get_worker_metrics()
     wm.attach_tracer(tracer)
+    if tiered is not None:
+        # dynamo_worker_kvbm_* tier/prefetch series sample the live tiers
+        # at scrape time (zero-valued otherwise)
+        wm.kvbm.attach(tiered.kvbm_stats)
     system = SystemServer.from_env(registry=wm.registry, tracer=tracer)
     if system is not None:
         system.health.register("engine", ready=True)

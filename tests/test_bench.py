@@ -24,6 +24,9 @@ BENCH = os.path.join(os.path.dirname(__file__), "..", "bench.py")
 def test_single_child_attempt_chain():
     env = dict(os.environ)
     env["BENCH_TEST_CPU_CHAIN"] = "1"
+    # short long-context leg so the smoke chain stays inside its budget
+    # (the default 4k/16k/32k curve is the real bench's)
+    env["BENCH_LONGCTX"] = "4096,8192"
     env.pop("JAX_PLATFORMS", None)
     r = subprocess.run(
         [sys.executable, BENCH, "--budget", "420", "--tier", "tiny"],
@@ -56,6 +59,18 @@ def test_single_child_attempt_chain():
     # forced-CPU children are honest about validity
     assert result["valid"] is False
     assert result["tier"] == "tiny"
+    # long-context tiering leg: ttft_vs_context + prefetch_hit_rate land
+    # in the result JSON (tier-resident prompts through the packing-
+    # prefetch scheduler; the sublinear flag is the acceptance signal)
+    lc = result["longctx"]
+    assert "error" not in lc, lc
+    assert [p["tokens"] for p in lc["ttft_vs_context"]] == [4096, 8192]
+    assert all(p["ttft_s"] > 0 for p in lc["ttft_vs_context"])
+    # hit rate is a RACE against the compute cursor — deterministic
+    # promotion assertions live in tests/test_kvbm.py; the smoke only
+    # pins the recording contract (a loaded CI box can lose the race)
+    assert 0.0 <= lc["prefetch_hit_rate"] <= 1.0
+    assert "sublinear" in lc and "ttft_scaling" in lc
 
 
 def test_cpu_fallback_when_attempts_fail(tmp_path):
