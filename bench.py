@@ -179,8 +179,11 @@ def _build_engine(tier: str, attn_impl: str, quantize: str = "",
     on_tpu = jax.devices()[0].platform in TPU_PLATFORMS
     if tier == "tiny" or not on_tpu:
         cfg = ModelConfig.tiny(dtype="float32")
-        seqs, prompt, gen = 4, 32, 16
-        page_size, max_ctx = 4, 64
+        # gen long enough that steady-state decode dominates the timed
+        # window (the fused-vs-per-step A/B is measured here; a 16-token
+        # tail was mostly prefill + ramp)
+        seqs, prompt, gen = 4, 32, 64
+        page_size, max_ctx = 4, 128
     else:
         cfg = ModelConfig.llama32_3b()
         seqs, prompt, gen = TIERS[tier]
@@ -242,6 +245,16 @@ def _prime_programs(engine, seqs: int, prompt: int, prefill_seqs: int,
         _ckpt("primed", program=name, label=label,
               shape=[int(a["toks"].shape[0]), int(a["toks"].shape[1])],
               s=round(time.perf_counter() - t0, 1))
+    if getattr(engine, "supports_multistep", False):
+        # the fused-decode scan programs: the full width plus the pow2
+        # ladder the scheduler narrows budget tails to, so the timed
+        # phase never pays a compile mid-block
+        wd.arm("prime:multistep", STAGE_BUDGETS["prime"])
+        t0 = time.perf_counter()
+        jax.block_until_ready(engine.prime_multistep(seqs))
+        _ckpt("primed", program="multistep", label=label,
+              shape=[seqs, engine.multistep],
+              s=round(time.perf_counter() - t0, 1))
 
 
 async def _measure_engine(engine, cfg, geometry, wd: Watchdog,
@@ -288,8 +301,11 @@ async def _measure_engine(engine, cfg, geometry, wd: Watchdog,
     # (pipelined) program also runs.
     wd.arm(f"warmup:{label}", STAGE_BUDGETS["warmup"])
     t_setup = time.perf_counter()
+    # label-scoped request ids: the fused-vs-per-step A/B re-measures on
+    # the SAME engine, and a reused request_id on one engine wedges the
+    # second generate
     await asyncio.gather(
-        *[drive(f"warm{i}", prompt, 8) for i in range(seqs)])
+        *[drive(f"warm{label[:2]}{i}", prompt, 8) for i in range(seqs)])
     ttfts.clear()
     warmup_s = time.perf_counter() - t_setup
     _ckpt("warmup_done", label=label, s=round(warmup_s, 1))
@@ -298,10 +314,12 @@ async def _measure_engine(engine, cfg, geometry, wd: Watchdog,
     print(f"bench: {seqs} seqs x ({prompt} prompt + {gen} gen)",
           file=sys.stderr, flush=True)
     arrivals.clear()
+    d0 = getattr(engine, "decode_dispatches", 0)
     t0 = time.perf_counter()
     results = await asyncio.gather(
-        *[drive(f"r{i}", prompt, gen) for i in range(seqs)])
+        *[drive(f"{label[:2]}{i}", prompt, gen) for i in range(seqs)])
     wall = time.perf_counter() - t0
+    decode_dispatches = getattr(engine, "decode_dispatches", 0) - d0
 
     total_generated = sum(c for _f, c in results)
     # the metric is DECODE throughput: measure the steady-state phase, from
@@ -322,10 +340,12 @@ async def _measure_engine(engine, cfg, geometry, wd: Watchdog,
     ttft_p50 = statistics.median(ttfts)
     _ckpt("measured", label=label, tokens=total_generated,
           decode_tok_s=round(tok_per_s, 1),
-          prefill_tok_s=round(prefill_tok_s, 1))
+          prefill_tok_s=round(prefill_tok_s, 1),
+          decode_dispatches=decode_dispatches)
     return dict(tok_per_s=tok_per_s, prefill_tok_s=prefill_tok_s,
                 ttft_p50=ttft_p50, warmup_s=warmup_s,
-                total_generated=total_generated, wall=wall)
+                total_generated=total_generated, wall=wall,
+                decode_dispatches=decode_dispatches)
 
 
 async def run_attempt(args) -> dict:
@@ -348,6 +368,23 @@ async def run_attempt(args) -> dict:
 
     try:
         m = await _measure_engine(engine, cfg, geometry, wd, "main")
+        # fused-vs-per-step decode A/B on the SAME engine (decode/chained
+        # programs are already primed, so the per-step leg pays no
+        # compile): the headline stays the fused number, the A/B proves
+        # the fusion speedup in the same run. On-chip it costs one more
+        # measurement, so it needs the budget headroom.
+        m_ps = None
+        if getattr(engine, "supports_multistep", False) and (
+                not on_tpu or deadline - time.monotonic()
+                >= 2 * STAGE_BUDGETS["measure"]):
+            wd.arm("measure:perstep", STAGE_BUDGETS["measure"])
+            ms_saved = engine.multistep
+            engine.multistep = 1   # supports_multistep -> False
+            try:
+                m_ps = await _measure_engine(engine, cfg, geometry, wd,
+                                             "perstep")
+            finally:
+                engine.multistep = ms_saved
         # transport measurements, serialized with the step loop per the
         # engine.pages contract
         wd.arm("transport:inject", STAGE_BUDGETS["transport"])
@@ -403,7 +440,30 @@ async def run_attempt(args) -> dict:
         "prefill_tok_s": round(m["prefill_tok_s"], 1),
         "ttft_p50_s": round(m["ttft_p50"], 3),
         "warmup_s": round(m["warmup_s"], 1),
+        # decode dispatch fusion: the configured width, the measured
+        # dispatches-per-token of the main (fused) run (~1/width when
+        # fusion engages; 1.0 when everything fell back), and the
+        # same-run fused-vs-per-step A/B
+        "decode_multistep": int(getattr(engine, "multistep", 1)),
+        "decode_dispatches_per_token": round(
+            m["decode_dispatches"] / max(1, m["total_generated"]), 4),
     }
+    if m_ps is not None:
+        result["decode_ab"] = {
+            "fused_tok_s": round(m["tok_per_s"], 1),
+            "perstep_tok_s": round(m_ps["tok_per_s"], 1),
+            "fused_speedup": (round(m["tok_per_s"] / m_ps["tok_per_s"], 3)
+                              if m_ps["tok_per_s"] > 0 else None),
+            "perstep_dispatches_per_token": round(
+                m_ps["decode_dispatches"]
+                / max(1, m_ps["total_generated"]), 4),
+            "perstep_ttft_p50_s": round(m_ps["ttft_p50"], 3),
+        }
+    else:
+        result["decode_ab"] = {
+            "error": ("skipped (fusion off)"
+                      if not getattr(engine, "supports_multistep", False)
+                      else "skipped (budget)")}
 
     # EARLY main-result line: the extras below (attn A/B, int8 leg) may
     # outlive the tunnel window; the child's watchdog exit still leaves

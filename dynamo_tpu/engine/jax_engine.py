@@ -26,10 +26,12 @@ load-metric publication.
 
 from __future__ import annotations
 
+import functools
 import logging
+import os
 import threading
 from dataclasses import dataclass
-from typing import Callable, Optional, Tuple
+from typing import Callable, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -99,6 +101,13 @@ class JaxEngineConfig:
     # dominant per-step cost at small batch). Disable for strict
     # step-at-a-time debugging.
     pipeline_decode: bool = True
+    # fused decode: max decode steps run inside ONE jitted dispatch
+    # (lax.scan over the step body with on-device sampling and stop
+    # checks — engine/scheduler.py narrows the width per batch). None
+    # resolves DYN_DECODE_MULTISTEP / RuntimeConfig.decode_multistep
+    # (default 8); 1 disables the fused path (per-step/chained decode
+    # still applies under pipeline_decode).
+    decode_multistep: Optional[int] = None
     # speculative decoding (engine/spec.py): n-gram prompt-lookup drafts
     # verified K at a time in one [B, K+1] step (0 = off), yielding up to
     # K+1 tokens per step. Composes with pipelined decode: verify steps
@@ -133,6 +142,31 @@ class JaxEngineConfig:
 # prompt-scoring LM-head chunk: the ONE constant both the host padding
 # (_score_batch) and the traced reshape (family score()) must share
 _SCORE_CHUNK = 256
+
+# default fused-decode width (decode steps per jitted dispatch)
+DECODE_MULTISTEP = 8
+
+
+def decode_multistep_default() -> int:
+    """Defaults layer for the fused-decode width (the shape of
+    ``transfer.kv_transfer_defaults``): ``RuntimeConfig.decode_multistep``
+    (dataclass -> TOML -> ``DYN_RUNTIME_*`` env), then the short-form
+    ``DYN_DECODE_MULTISTEP`` env wins. Resolved at engine build, not at
+    import, so monkeypatched env changes take effect."""
+    val = DECODE_MULTISTEP
+    try:
+        from dynamo_tpu.utils.config import RuntimeConfig
+        val = RuntimeConfig.load().decode_multistep
+    except Exception:  # a bad TOML/env must not break an engine build
+        logger.warning("bad runtime config; decode multistep falls back "
+                       "to %d", val, exc_info=True)
+    raw = os.environ.get("DYN_DECODE_MULTISTEP")
+    try:
+        val = int(raw) if raw is not None else val
+    except (TypeError, ValueError):
+        logger.warning("malformed DYN_DECODE_MULTISTEP %r; using %d",
+                       raw, val)
+    return max(1, int(val))
 
 
 def _bucket(n: int, lo: int, hi: int) -> int:
@@ -175,6 +209,9 @@ class JaxEngine(ScheduledEngineBase):
             ring_threshold = (self.cfg.ring_threshold
                               if self.cfg.ring_threshold is not None
                               else self.cfg.max_prefill_chunk)
+        self.multistep = (max(1, int(self.cfg.decode_multistep))
+                          if self.cfg.decode_multistep is not None
+                          else decode_multistep_default())
         super().__init__(
             num_pages=self.cfg.num_pages, page_size=self.cfg.page_size,
             max_num_seqs=self.cfg.max_num_seqs,
@@ -185,7 +222,8 @@ class JaxEngine(ScheduledEngineBase):
             spec_tokens=int(self.cfg.spec_tokens or 0),
             spec_ngram_max=self.cfg.spec_ngram_max,
             spec_ngram_min=self.cfg.spec_ngram_min,
-            spec_chain_break=self.cfg.spec_chain_break)
+            spec_chain_break=self.cfg.spec_chain_break,
+            decode_multistep=self.multistep)
         self.params = params
         from dynamo_tpu.models import get_family
         family = get_family(model_cfg)
@@ -306,6 +344,16 @@ class JaxEngine(ScheduledEngineBase):
         # inject commits). The batched inject pipeline's regression guard
         # counts these instead of timing walls.
         self.page_scatter_dispatches = 0
+        # fused decode: per-width jits (lax.scan length is static) and the
+        # dispatch tap the M-tokens-cost-<=M/N+c regression guard counts
+        # (dynamo_worker_decode_dispatches_total samples these at scrape)
+        self._jit_ms: Dict[int, Callable] = {}
+        self.decode_dispatches = 0   # decode-family jitted dispatches
+        self.multistep_blocks = 0    # of which fused multi-step blocks
+        # device-resident decode sampling/stop arrays, rebuilt only when
+        # the decode batch composition changes (not ~10 jnp.asarray
+        # uploads per step): (key, arrays)
+        self._samp_cache: Optional[Tuple] = None
         # MoE dispatch overflow accounting (VERDICT r4 weak 5): per-step
         # device scalars queue here; stats() drains them into the total.
         # Only the dispatch backend can drop — dense configs emit a
@@ -503,6 +551,108 @@ class JaxEngine(ScheduledEngineBase):
         return self._step_impl(params, pages, tokens, positions, page_table,
                                total_lens, new_lens, rng, step, temperature,
                                top_k, top_p, pen)
+
+    def _decode_forward(self, params, pages, tok, pos, table, total, new):
+        """One S==1 decode forward (the scan body of the fused block);
+        mirrors ``_step_impl``'s attn selection for tokens.shape[1] == 1.
+        Returns (logits [B, V], pages, aux)."""
+        if self.attn_impl in ("scan", "pallas"):
+            if self.attn_impl == "pallas":
+                from dynamo_tpu.ops.pallas.decode import (
+                    paged_decode_attention_stacked as attn)
+                out = self._forward(params, self.model_cfg, tok, pos, pages,
+                                    table, total, new, attn_impl=attn)
+            else:
+                out = self._forward(params, self.model_cfg, tok, pos, pages,
+                                    table, total, new)
+        else:
+            attn = None
+            if self.attn_impl == "pallas_unrolled":
+                from dynamo_tpu.ops.pallas import paged_decode_attention
+                attn = paged_decode_attention
+            out = self._forward_unrolled(params, self.model_cfg, tok, pos,
+                                         pages, table, total, new,
+                                         attn_impl=attn)
+        return out[0], out[1], (out[2] if len(out) > 2 else {})
+
+    def _multistep_impl(self, params, pages, tok, pos, table, total, alive,
+                        budget, min_gate, rng, step0, temperature, top_k,
+                        top_p, stop_ids, pen=None, n_steps=1):
+        """FUSED decode: ``n_steps`` decode steps in one jitted program —
+        a ``lax.scan`` over the step body with donated ``pages`` carry,
+        on-device sampling (``ops/sampling.sample_tokens``, the same
+        epilogue as ``_sample_tail``), on-device position/total increment,
+        and per-row stop detection. The host pays ONE dispatch and ONE
+        fetch per block instead of per token.
+
+        Carry per row: current input token, its position, total context
+        length, liveness, and the remaining max-token budget / min_tokens
+        gate. A row whose sampled token hits its stop set (EOS +
+        stop_token_ids, ``min_tokens``-gated — ``stop_ids`` is the padded
+        merge, -1 never matches) or exhausts its budget is masked to a
+        no-op for the rest of the block: ``new_lens`` goes to 0 (finished
+        sequences stop writing KV), position/total freeze, and its later
+        sampled slots are garbage the host never reads (it re-derives the
+        identical stop point from the same rules).
+
+        Returns (pages, packed [B, n_steps, 2+2K] — per-step rows in the
+        exact ``_sample_tail`` column layout so the host unpack is shared
+        — the carry dict for chaining block k+1, and the summed MoE drop
+        aux). ``step0 + j`` feeds the rng fold so a fused run consumes the
+        same per-step key sequence as ``n_steps`` per-step dispatches.
+        """
+
+        def body(carry, j):
+            pages, tok, pos, total, alive = carry
+            new = alive.astype(jnp.int32)
+            logits, pages, aux = self._decode_forward(
+                params, pages, tok, pos, table, total, new)
+            logits = logits.astype(jnp.float32)
+            key = jax.random.fold_in(rng, step0 + j)
+            if pen is not None:
+                sampled, logprobs = sample_tokens(
+                    logits, key, temperature, top_k, top_p,
+                    seeds=pen["seeds"], seed_rng=rng, seed_pos=total,
+                    min_p=pen["min_p"])
+            else:
+                sampled, logprobs = sample_tokens(logits, key, temperature,
+                                                  top_k, top_p)
+            cols = [sampled[:, None],
+                    jax.lax.bitcast_convert_type(logprobs,
+                                                 jnp.int32)[:, None]]
+            if self.cfg.num_top_logprobs > 0:
+                ids, lp_bits = self._topk_cols(logits)
+                cols.append(ids)
+                cols.append(lp_bits)
+            packed = jnp.concatenate(cols, axis=1)
+            hit = jnp.any(stop_ids == sampled[:, None], axis=1)
+            min_ok = (j + 1) >= min_gate
+            stopped = (hit & min_ok) | ((j + 1) >= budget)
+            new_alive = alive & ~stopped
+            tok = jnp.where(alive[:, None], sampled[:, None], tok)
+            pos = pos + new[:, None]
+            total = total + new
+            drops = aux.get("moe_dropped_assignments",
+                            jnp.zeros((), jnp.int32))
+            return (pages, tok, pos, total, new_alive), (packed, drops)
+
+        (pages, tok, pos, total, alive), (steps, drops) = jax.lax.scan(
+            body, (pages, tok, pos, total, alive),
+            jnp.arange(n_steps, dtype=jnp.int32))
+        carry = {"tok": tok, "pos": pos, "total": total, "alive": alive,
+                 "budget": budget - n_steps,
+                 "min_gate": min_gate - n_steps}
+        return (pages, jnp.moveaxis(steps, 0, 1), carry,
+                jnp.sum(drops.astype(jnp.int32)))
+
+    def _get_jit_multistep(self, w: int):
+        fn = self._jit_ms.get(w)
+        if fn is None:
+            # scan length is static: one jit per (pow2-floored) width
+            fn = jax.jit(functools.partial(self._multistep_impl, n_steps=w),
+                         donate_argnums=(1,))
+            self._jit_ms[w] = fn
+        return fn
 
     def _topk_cols(self, lf):
         """Top-K alternative (ids, logprob-bit) columns for the OpenAI
@@ -768,7 +918,9 @@ class JaxEngine(ScheduledEngineBase):
                 self.step_tap("spec", arrays, self._step_counter)
             packed = self._invoke_step("spec", arrays, self._step_counter)
             self._step_counter += 1
+            self.decode_dispatches += 1
             host = np.asarray(packed)
+            hostf = host.view(np.float32)   # one reinterpret, no copies
             B = host.shape[0]
             K, S = self.spec_K, self.spec_K + 1
             # mirror _topk_cols' vocab clamp or the unpack misaligns on
@@ -776,16 +928,15 @@ class JaxEngine(ScheduledEngineBase):
             kt = min(self.cfg.num_top_logprobs,
                      self.model_cfg.vocab_size)
             sampled = host[:, 0]
-            logprobs = host[:, 1].copy().view(np.float32)
+            logprobs = hostf[:, 1]
             extras = {"spec_acc": host[:, 2],
-                      "spec_lps": host[:, 3:3 + K].copy().view(np.float32)}
+                      "spec_lps": hostf[:, 3:3 + K]}
             if kt > 0:
                 base = 3 + K
                 extras["spec_top_ids"] = host[
                     :, base:base + S * kt].reshape(B, S, kt)
-                extras["spec_top_lps"] = host[
-                    :, base + S * kt:base + 2 * S * kt].copy().view(
-                    np.float32).reshape(B, S, kt)
+                extras["spec_top_lps"] = hostf[
+                    :, base + S * kt:base + 2 * S * kt].reshape(B, S, kt)
             return sampled, logprobs, extras
         P = self.table_width
         if isinstance(plan, PrefillBatch):
@@ -1010,8 +1161,10 @@ class JaxEngine(ScheduledEngineBase):
         plan._step_id = self._step_counter
         if self.step_tap is not None:
             self.step_tap("step", arrays, self._step_counter)
-        packed = self._invoke_step("step", arrays, self._step_counter)
+        packed = self._invoke_step("step", arrays, self._step_counter,
+                                   seqs=plan.seqs)
         self._step_counter += 1
+        self.decode_dispatches += 1
         return packed
 
     def dispatch_chained(self, plan, prev_packed):
@@ -1021,21 +1174,191 @@ class JaxEngine(ScheduledEngineBase):
         if self.step_tap is not None:
             self.step_tap("chained", arrays, self._step_counter)
         packed = self._invoke_step("chained", arrays, self._step_counter,
-                                   prev_packed=prev_packed)
+                                   prev_packed=prev_packed, seqs=plan.seqs)
         self._step_counter += 1
         self.chained_steps += 1
+        self.decode_dispatches += 1
         return packed
 
     def fetch_packed(self, packed):
-        """Blocking device->host fetch + unpack of one step's results."""
+        """Blocking device->host fetch + unpack of one step's results —
+        ONE device->host copy and ONE same-itemsize dtype reinterpret of
+        the whole buffer (no per-column ``.copy().view()``)."""
         host = np.asarray(packed)
+        hostf = host.view(np.float32)
         sampled = host[:, 0]
-        logprobs = host[:, 1].copy().view(np.float32)
+        logprobs = hostf[:, 1]
         extras = None
         if host.shape[1] > 2:
             K = (host.shape[1] - 2) // 2
             extras = {"top_ids": host[:, 2:2 + K],
-                      "top_lps": host[:, 2 + K:].copy().view(np.float32)}
+                      "top_lps": hostf[:, 2 + K:]}
+        return sampled, logprobs, extras
+
+    # -- fused multi-step decode (loop.py hooks) ---------------------------
+
+    @property
+    def supports_multistep(self) -> bool:
+        # fused decode COMPOSES with pipelined decode (the per-step chain
+        # serves batches the planner refuses to fuse); it does not yet
+        # compose with multi-host lockstep (step_tap broadcasts host
+        # arrays, but the block carry is device-resident), mesh sharding,
+        # or spec mode (its own [B, K+1] verify path). pipeline_decode
+        # False means strict step-at-a-time debugging — fusion off too.
+        return (self.multistep > 1 and self.cfg.pipeline_decode
+                and self.step_tap is None
+                and self.cfg.mesh is None and not self.spec_K)
+
+    def _device_sampling(self, seqs, B: int) -> dict:
+        """Device-resident per-row sampling + stop arrays for the decode
+        batch, rebuilt only when the batch COMPOSITION changes (the cache
+        key) instead of re-uploaded every step: temperature/top_k/top_p,
+        the padded EOS+stop_token_ids set (-1 pads never match), and —
+        when any row uses them — the static seeds/min_p pen pytree. All
+        of these are constant for a request's lifetime; per-token penalty
+        state is NOT cacheable and keeps the per-step path."""
+        key = (B, tuple((s.request.request_id, id(s)) for s in seqs))
+        cached = self._samp_cache
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        temp = np.zeros(B, np.float32)
+        top_k = np.zeros(B, np.int32)
+        top_p = np.ones(B, np.float32)
+        seeds = np.zeros(B, np.int32)
+        min_p = np.zeros(B, np.float32)
+        pen_active = False
+        stop_lists = []
+        for i, seq in enumerate(seqs):
+            so = seq.request.sampling_options
+            if so.temperature is not None:
+                temp[i] = so.temperature
+            top_k[i] = so.top_k or 0
+            if so.top_p is not None:
+                top_p[i] = so.top_p
+            if so.seed is not None:
+                # the _sampling_extras seed mapping: [1, 2^31-1], 0 = off
+                seeds[i] = (int(so.seed) % 0x7FFFFFFF) + 1
+                pen_active = True
+            if so.min_p:
+                min_p[i] = so.min_p
+                pen_active = True
+            sc = seq.request.stop_conditions
+            ids = list(sc.stop_token_ids or [])
+            if not sc.ignore_eos:
+                ids += list(seq.request.eos_token_ids or [])
+            stop_lists.append(ids)
+        E = max([len(x) for x in stop_lists] + [1])
+        E = 1 << (E - 1).bit_length()   # pow2 pad: bounded trace count
+        stop_ids = np.full((B, E), -1, np.int32)
+        for i, ids in enumerate(stop_lists):
+            stop_ids[i, :len(ids)] = ids
+        out = {
+            "temp": jnp.asarray(temp), "top_k": jnp.asarray(top_k),
+            "top_p": jnp.asarray(top_p), "stop_ids": jnp.asarray(stop_ids),
+            "pen": ({"seeds": jnp.asarray(seeds),
+                     "min_p": jnp.asarray(min_p)} if pen_active else None),
+        }
+        self._samp_cache = (key, out)
+        return out
+
+    def dispatch_multistep(self, plan, prev_handle=None):
+        """Dispatch one fused block of ``plan.width`` decode steps;
+        returns the opaque (packed block, device carry) handle without
+        blocking. A chained block takes its first token / position /
+        liveness / budgets from the previous block's on-device carry —
+        only the (possibly grown) page table re-uploads."""
+        seqs = plan.seqs
+        w = plan.width
+        B = _bucket(len(seqs), self.cfg.min_decode_bucket,
+                    self.cfg.max_num_seqs)
+        P = self.table_width
+        table = np.zeros((B, P), np.int32)
+        for i, seq in enumerate(seqs):
+            table[i, :len(seq.page_ids)] = seq.page_ids
+        samp = self._device_sampling(seqs, B)
+        if prev_handle is not None:
+            c = prev_handle[1]
+            tok, pos, total, alive = c["tok"], c["pos"], c["total"], c["alive"]
+            budget, min_gate = c["budget"], c["min_gate"]
+        else:
+            tok = np.zeros((B, 1), np.int32)
+            pos = np.zeros((B, 1), np.int32)
+            total = np.ones(B, np.int32)    # pad rows: 1 garbage-page token
+            alive = np.zeros(B, bool)       # pad rows: never write
+            budget = np.zeros(B, np.int32)
+            min_gate = np.zeros(B, np.int32)
+            for i, (seq, sl) in enumerate(zip(seqs, plan.start_lens)):
+                tok[i, 0] = seq.tokens.last_token()
+                pos[i, 0] = sl - 1
+                total[i] = sl
+                alive[i] = True
+                budget[i] = plan.budgets[i]
+                min_gate[i] = plan.min_gates[i]
+        plan._step_id = self._step_counter
+        fn = self._get_jit_multistep(w)
+        self.pages, packed_block, carry, drops = fn(
+            self.params, self.pages, jnp.asarray(tok), jnp.asarray(pos),
+            jnp.asarray(table), jnp.asarray(total), jnp.asarray(alive),
+            jnp.asarray(budget), jnp.asarray(min_gate), self._rng,
+            np.int32(self._step_counter), samp["temp"], samp["top_k"],
+            samp["top_p"], samp["stop_ids"], samp["pen"])
+        if self._moe_dispatch_active:
+            with self._moe_drops_lock:
+                self._pending_moe_drops.append(drops)
+                overflow = len(self._pending_moe_drops) > 512
+            if overflow:
+                self._drain_moe_drops(keep_last=8)
+        # one rng-fold key per fused step: the counter advances by the
+        # block width so fused and per-step runs consume the same keys
+        self._step_counter += w
+        self.decode_dispatches += 1
+        self.multistep_blocks += 1
+        return (packed_block, carry)
+
+    def prime_multistep(self, B: int, widths=None):
+        """Compile the fused block program(s) for padded batch ``B``
+        outside serving (bench priming): garbage-page no-op dispatches —
+        every row dead (``alive`` all False) writes nothing. Defaults to
+        the pow2 ladder the scheduler narrows to (cap, cap/2, .., 2).
+        Returns the last packed block for ``block_until_ready``."""
+        if widths is None:
+            # pow2-floor the cap first: the scheduler floors every block
+            # width, so a non-pow2 cap (DYN_DECODE_MULTISTEP=6) never
+            # dispatches its raw value — priming it would compile unused
+            # programs and MISS the ones serving actually runs
+            widths, w = [], 1 << (max(1, self.multistep).bit_length() - 1)
+            while w >= 2:
+                widths.append(w)
+                w //= 2
+        P = self.table_width
+        out = None
+        for w in widths:
+            fn = self._get_jit_multistep(w)
+            self.pages, out, _carry, _drops = fn(
+                self.params, self.pages,
+                jnp.zeros((B, 1), jnp.int32), jnp.zeros((B, 1), jnp.int32),
+                jnp.zeros((B, P), jnp.int32), jnp.ones(B, jnp.int32),
+                jnp.zeros(B, bool), jnp.zeros(B, jnp.int32),
+                jnp.zeros(B, jnp.int32), self._rng, np.int32(0),
+                jnp.zeros(B, jnp.float32), jnp.zeros(B, jnp.int32),
+                jnp.ones(B, jnp.float32),
+                jnp.full((B, 1), -1, jnp.int32), None)
+        return out
+
+    def fetch_packed_block(self, handle):
+        """Blocking fetch + unpack of one fused block: ONE device->host
+        copy of the packed [B, w, C] buffer and ONE dtype reinterpret for
+        every float column (the block-path fix for the per-fetch
+        ``.copy().view(np.float32)``)."""
+        host = np.asarray(handle[0])
+        hostf = host.view(np.float32)
+        sampled = host[:, :, 0]
+        logprobs = hostf[:, :, 1]
+        extras = None
+        if host.shape[2] > 2:
+            K = (host.shape[2] - 2) // 2
+            extras = {"top_ids": host[:, :, 2:2 + K],
+                      "top_lps": hostf[:, :, 2 + K:]}
         return sampled, logprobs, extras
 
     def execute_arrays(self, kind: str, a: dict, step: int):
@@ -1051,14 +1374,21 @@ class JaxEngine(ScheduledEngineBase):
             return None  # follower-side page IO (gather/scatter): no packed
         return self.fetch_packed(out)
 
-    def _invoke_step(self, kind: str, a: dict, step: int, prev_packed=None):
+    def _invoke_step(self, kind: str, a: dict, step: int, prev_packed=None,
+                     seqs=None):
         """Dispatch ONE jitted step of any family; returns the on-device
         packed output (jax dispatch is async — no host sync here). The
         single place the 12-argument step signature is spelled out.
 
         kind "chained" substitutes the previous step's on-device sampled
         tokens for ``a["toks"]``; ``prev_packed`` defaults to this rank's
-        last packed output (the follower case — leaders pass it)."""
+        last packed output (the follower case — leaders pass it).
+
+        ``seqs`` (decode dispatch paths only) enables the device-resident
+        sampling-array cache: temperature/top_k/top_p upload once per
+        batch composition instead of once per step. Multi-host followers
+        and raw-array callers (``execute_arrays``) leave it None and keep
+        the per-step uploads."""
         if kind == "embed":
             self._embed_batch_raw(a["toks"], a["mask"])
             return None
@@ -1092,21 +1422,21 @@ class JaxEngine(ScheduledEngineBase):
         elif kind == "chained":
             prev = prev_packed if prev_packed is not None else self._last_packed
             pen = self._pen_arg(a, a["pos"].shape[0])
+            temp, top_k, top_p = self._step_sampling(a, kind, seqs)
             self.pages, packed, aux = self._jit_chained(
                 self.params, self.pages, prev,
                 jnp.asarray(a["pos"]), jnp.asarray(a["table"]),
                 jnp.asarray(a["total"]), jnp.asarray(a["new"]),
-                self._rng, np.int32(step), jnp.asarray(a["temp"]),
-                jnp.asarray(a["top_k"]), jnp.asarray(a["top_p"]), pen)
+                self._rng, np.int32(step), temp, top_k, top_p, pen)
         else:
             step_fn = self._jit_ring_step if kind == "ring" else self._jit_step
             pen = self._pen_arg(a, a["toks"].shape[0])
+            temp, top_k, top_p = self._step_sampling(a, kind, seqs)
             self.pages, packed, aux = step_fn(
                 self.params, self.pages, jnp.asarray(a["toks"]),
                 jnp.asarray(a["pos"]), jnp.asarray(a["table"]),
                 jnp.asarray(a["total"]), jnp.asarray(a["new"]),
-                self._rng, np.int32(step), jnp.asarray(a["temp"]),
-                jnp.asarray(a["top_k"]), jnp.asarray(a["top_p"]), pen)
+                self._rng, np.int32(step), temp, top_k, top_p, pen)
         if self._moe_dispatch_active and "moe_dropped_assignments" in aux:
             # device scalar; fetched lazily at stats-scrape time so the hot
             # loop never pays an extra host round trip
@@ -1120,6 +1450,17 @@ class JaxEngine(ScheduledEngineBase):
                 self._drain_moe_drops(keep_last=8)
         self._last_packed = packed
         return packed
+
+    def _step_sampling(self, a: dict, kind: str, seqs):
+        """temperature/top_k/top_p device arrays for one step: the
+        composition-keyed cache on decode dispatch paths (``seqs`` given),
+        the per-step upload everywhere else (prefill compositions change
+        every chunk; followers replay raw arrays)."""
+        if seqs is not None and kind in ("step", "chained"):
+            samp = self._device_sampling(seqs, a["pos"].shape[0])
+            return samp["temp"], samp["top_k"], samp["top_p"]
+        return (jnp.asarray(a["temp"]), jnp.asarray(a["top_k"]),
+                jnp.asarray(a["top_p"]))
 
     def _drain_moe_drops(self, keep_last: int = 0) -> None:
         # swap the list out under the lock (appends race from the step
@@ -1498,4 +1839,5 @@ class JaxEngine(ScheduledEngineBase):
         return cls(model_cfg, params, config)
 
 
-__all__ = ["JaxEngine", "JaxEngineConfig"]
+__all__ = ["JaxEngine", "JaxEngineConfig", "decode_multistep_default",
+           "DECODE_MULTISTEP"]

@@ -34,6 +34,7 @@ from dynamo_tpu.engine.base import EngineBase
 from dynamo_tpu.engine.pages import PageAllocator
 from dynamo_tpu.engine.scheduler import (
     DecodeBatch,
+    MultiStepBatch,
     Phase,
     PrefillBatch,
     Scheduler,
@@ -60,7 +61,8 @@ class ScheduledEngineBase(EngineBase):
                  max_prefill_seqs: int = 8,
                  ring_threshold: Optional[int] = None,
                  spec_tokens: int = 0, spec_ngram_max: int = 4,
-                 spec_ngram_min: int = 2, spec_chain_break: int = 8):
+                 spec_ngram_min: int = 2, spec_chain_break: int = 8,
+                 decode_multistep: int = 1):
         if max_context % page_size:
             raise ValueError("max_context must be a multiple of page_size")
         self.max_context = max_context
@@ -71,7 +73,8 @@ class ScheduledEngineBase(EngineBase):
             ring_threshold=ring_threshold,
             spec_tokens=spec_tokens, spec_ngram_max=spec_ngram_max,
             spec_ngram_min=spec_ngram_min,
-            spec_chain_break=spec_chain_break))
+            spec_chain_break=spec_chain_break,
+            decode_multistep=decode_multistep))
         self.scheduler.max_context_hint = max_context
         self._queues: Dict[str, asyncio.Queue] = {}
         self._work = asyncio.Event()
@@ -128,6 +131,20 @@ class ScheduledEngineBase(EngineBase):
     def fetch_packed(self, handle):                 # pragma: no cover - hook
         raise NotImplementedError
 
+    # Optional FUSED decode hooks (JaxEngine and the mocker implement):
+    # dispatch_multistep runs ``plan.width`` decode steps in one dispatch
+    # (on-device sampling + stop checks) and returns an opaque handle;
+    # ``prev_handle`` chains the block from the previous block's on-device
+    # carry. fetch_packed_block blocks on a handle and returns
+    # (sampled [B, w], logprobs [B, w], extras) aligned with plan.seqs.
+    supports_multistep = False
+
+    def dispatch_multistep(self, plan, prev_handle=None):  # pragma: no cover
+        raise NotImplementedError
+
+    def fetch_packed_block(self, handle):           # pragma: no cover - hook
+        raise NotImplementedError
+
     # -- frame emission ----------------------------------------------------
 
     def _emit(self, seq: Sequence, out: LLMEngineOutput) -> None:
@@ -143,6 +160,10 @@ class ScheduledEngineBase(EngineBase):
                 t["admitted_unix"] = seq.admitted_unix
             if seq.cached_tokens:
                 t["cached_tokens"] = float(seq.cached_tokens)
+            if out.timings:
+                # a final frame that is ALSO the first (1-token streams)
+                # carries both the stage stamps and the decode counters
+                t.update(out.timings)
             out.timings = t
         q = self._queues.get(seq.request.request_id)
         if q is not None:
@@ -154,7 +175,7 @@ class ScheduledEngineBase(EngineBase):
                 kv_transfer_params: Optional[dict] = None,
                 top: Optional[Dict[int, float]] = None) -> None:
         self.scheduler.finish(seq)
-        self._emit(seq, LLMEngineOutput(
+        out = LLMEngineOutput(
             token_ids=[token] if token is not None else [],
             log_probs=[logprob] if logprob is not None else None,
             top_logprobs=[top] if top is not None else None,
@@ -163,7 +184,15 @@ class ScheduledEngineBase(EngineBase):
             completion_tokens=len(seq.generated),
             cached_tokens=seq.cached_tokens,
             kv_transfer_params=kv_transfer_params,
-        ))
+        )
+        if seq.decode_dispatches:
+            # decode-stage accounting for the tracing layer: how many
+            # tokens the decode tail produced and how many jitted
+            # dispatches they cost (a fused block is ONE dispatch) —
+            # StageStitcher turns these into decode-span attrs
+            out.timings = {"decode_steps": float(seq.decode_steps),
+                           "decode_dispatches": float(seq.decode_dispatches)}
+        self._emit(seq, out)
 
     def _accept_token(self, seq: Sequence, token: int, logprob: float,
                       top: Optional[Dict[int, float]] = None) -> None:
@@ -263,11 +292,72 @@ class ScheduledEngineBase(EngineBase):
                 if seq.cancelled and seq.phase is Phase.RUNNING:
                     self._finish(seq, FinishReason.CANCELLED)
                 continue
+            seq.decode_dispatches += 1
             for tok, lp, pos in toks:
+                seq.decode_steps += 1
                 self._accept_token(seq, tok, lp, top_for(i, pos, seq))
                 if seq.phase is not Phase.RUNNING:
                     break
         self.scheduler.commit_spec(plan)
+        events = self.allocator.drain_events()
+        if events and self.kv_event_cb is not None:
+            self.kv_event_cb(events)
+        if self.step_outcome_cb is not None:
+            self.step_outcome_cb(getattr(plan, "_step_id", None), True)
+
+    def _process_multistep(self, plan: MultiStepBatch, sampled: np.ndarray,
+                           logprobs: np.ndarray,
+                           extras: Optional[dict] = None) -> None:
+        """Resolve one fused block: re-derive each row's stop point from
+        the SAME rules the device applied (``_plan_spec_appends`` mirrors
+        ``_accept_token`` exactly), advance KV accounting over the written
+        prefix, then stream the tokens out — one frame per token per row,
+        so a token never waits on the rest of its block being processed."""
+        top_ids = extras.get("top_ids") if extras else None  # [B, w, K]
+
+        def top_for(i: int, j: int, seq: Sequence
+                    ) -> Optional[Dict[int, float]]:
+            if (top_ids is None
+                    or seq.request.sampling_options.logprobs is None):
+                return None
+            return {int(t): float(l) for t, l in
+                    zip(top_ids[i, j], extras["top_lps"][i, j])}
+
+        advances: List[int] = []
+        appends: List[Optional[List[Tuple[int, float, int]]]] = []
+        for i, seq in enumerate(plan.seqs):
+            if seq.phase is not Phase.RUNNING:
+                # finished before this (chained) block ran: the device
+                # carry had the row dead from block start — nothing written
+                advances.append(0)
+                appends.append(None)
+                continue
+            if seq.cancelled:
+                # the device doesn't know about cancellation: it kept
+                # writing, but only slot 0 (the fed real token) lands on a
+                # position with a host-side token — later slots stay
+                # uncommitted garbage (the on_multistep_done safety rule)
+                advances.append(1)
+                appends.append(None)
+                continue
+            cand = [(int(sampled[i, j]), float(logprobs[i, j]), j)
+                    for j in range(plan.width)]
+            toks, _ = self._plan_spec_appends(seq, cand)
+            advances.append(len(toks))
+            appends.append(toks)
+        self.scheduler.on_multistep_done(plan, advances)
+        for i, (seq, toks) in enumerate(zip(plan.seqs, appends)):
+            if toks is None:
+                if seq.cancelled and seq.phase is Phase.RUNNING:
+                    self._finish(seq, FinishReason.CANCELLED)
+                continue
+            seq.decode_dispatches += 1
+            for tok, lp, j in toks:
+                seq.decode_steps += 1
+                self._accept_token(seq, tok, lp, top_for(i, j, seq))
+                if seq.phase is not Phase.RUNNING:
+                    break
+        self.scheduler.commit_block(plan)
         events = self.allocator.drain_events()
         if events and self.kv_event_cb is not None:
             self.kv_event_cb(events)
@@ -325,6 +415,8 @@ class ScheduledEngineBase(EngineBase):
                 if seq.cancelled:
                     self._finish(seq, FinishReason.CANCELLED)
                     continue
+                seq.decode_dispatches += 1
+                seq.decode_steps += 1
                 self._accept_token(seq, int(sampled[i]), float(logprobs[i]),
                                    top_for(i, seq))
         # always drain (unbounded growth otherwise); publish if anyone listens
@@ -445,6 +537,14 @@ class ScheduledEngineBase(EngineBase):
         # steady-state decode (VERDICT r2 item 2).
         pending: Optional[Tuple[StepPlan, Any]] = None
 
+        def fetch_fn(plan):
+            return (self.fetch_packed_block
+                    if isinstance(plan, MultiStepBatch) else self.fetch_packed)
+
+        def process_fn(plan):
+            return (self._process_multistep
+                    if isinstance(plan, MultiStepBatch) else self._process)
+
         async def flush() -> None:
             nonlocal pending
             if pending is None:
@@ -452,45 +552,54 @@ class ScheduledEngineBase(EngineBase):
             plan, handle = pending
             pending = None
             try:
-                result = await asyncio.to_thread(self.fetch_packed, handle)
+                result = await asyncio.to_thread(fetch_fn(plan), handle)
             except Exception as e:  # noqa: BLE001
                 self._fail_plan(plan, e)
                 return
-            self._process(plan, *result)
+            process_fn(plan)(plan, *result)
 
         while not self._stopping:
             if self._exclusive:
                 await flush()
                 await self._drain_exclusive()
             if pending is not None:
-                chained = (self.scheduler.plan_chained(pending[0])
-                           if self.supports_pipelining else None)
+                prev_plan, prev_handle = pending
+                if isinstance(prev_plan, MultiStepBatch):
+                    chained = (self.scheduler.plan_multistep_chained(prev_plan)
+                               if self.supports_multistep else None)
+                else:
+                    chained = (self.scheduler.plan_chained(prev_plan)
+                               if self.supports_pipelining else None)
                 if chained is not None:
-                    prev_plan, prev_handle = pending
                     pending = None
                     try:
-                        handle = await asyncio.to_thread(
-                            self.dispatch_chained, chained, prev_handle)
+                        if isinstance(chained, MultiStepBatch):
+                            handle = await asyncio.to_thread(
+                                self.dispatch_multistep, chained, prev_handle)
+                        else:
+                            handle = await asyncio.to_thread(
+                                self.dispatch_chained, chained, prev_handle)
                     except Exception as e:  # noqa: BLE001
-                        # finish step N first so survivors' state is
+                        # finish step/block N first so survivors' state is
                         # consistent, then fail the chained victims
                         try:
                             result = await asyncio.to_thread(
-                                self.fetch_packed, prev_handle)
-                            self._process(prev_plan, *result)
+                                fetch_fn(prev_plan), prev_handle)
+                            process_fn(prev_plan)(prev_plan, *result)
                         except Exception as e2:  # noqa: BLE001
                             self._fail_plan(prev_plan, e2)
                         self._fail_plan(chained, e)
                         continue
                     pending = (chained, handle)
-                    # overlap: fetch step N while step N+1 runs on device
+                    # overlap: unpack step/block N (streaming its tokens
+                    # out) while N+1 runs on device
                     try:
                         result = await asyncio.to_thread(
-                            self.fetch_packed, prev_handle)
+                            fetch_fn(prev_plan), prev_handle)
                     except Exception as e:  # noqa: BLE001
                         self._fail_plan(prev_plan, e)
                         continue
-                    self._process(prev_plan, *result)
+                    process_fn(prev_plan)(prev_plan, *result)
                     continue
                 await flush()
             plan = self.scheduler.schedule()
@@ -511,15 +620,27 @@ class ScheduledEngineBase(EngineBase):
                     continue
                 await self._work.wait()
                 continue
-            if (isinstance(plan, DecodeBatch) and self.supports_pipelining):
-                try:
-                    handle = await asyncio.to_thread(self.dispatch_decode,
-                                                     plan)
-                except Exception as e:  # noqa: BLE001
-                    self._fail_plan(plan, e)
+            if isinstance(plan, DecodeBatch):
+                ms = (self.scheduler.plan_multistep(plan)
+                      if self.supports_multistep else None)
+                if ms is not None:
+                    try:
+                        handle = await asyncio.to_thread(
+                            self.dispatch_multistep, ms, None)
+                    except Exception as e:  # noqa: BLE001
+                        self._fail_plan(ms, e)
+                        continue
+                    pending = (ms, handle)
                     continue
-                pending = (plan, handle)
-                continue
+                if self.supports_pipelining:
+                    try:
+                        handle = await asyncio.to_thread(
+                            self.dispatch_decode, plan)
+                    except Exception as e:  # noqa: BLE001
+                        self._fail_plan(plan, e)
+                        continue
+                    pending = (plan, handle)
+                    continue
             try:
                 result = await asyncio.to_thread(self._execute_plan, plan)
             except Exception as e:  # noqa: BLE001 — engine must not die silently

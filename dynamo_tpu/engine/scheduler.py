@@ -62,7 +62,8 @@ class Sequence:
     __slots__ = ("request", "tokens", "page_ids", "committed_pages",
                  "num_computed", "cached_tokens", "num_prompt", "generated",
                  "phase", "cancelled", "arrival", "salt_hash",
-                 "enqueued_unix", "admitted_unix", "timings_sent")
+                 "enqueued_unix", "admitted_unix", "timings_sent",
+                 "decode_steps", "decode_dispatches")
 
     def __init__(self, request: PreprocessedRequest, page_size: int,
                  salt_hash: int = 0):
@@ -86,6 +87,12 @@ class Sequence:
         self.enqueued_unix = time.time()
         self.admitted_unix: Optional[float] = None
         self.timings_sent = False
+        # decode-stage accounting for the trace layer: tokens produced by
+        # decode-family steps and the number of jitted dispatches that
+        # produced them (a fused multi-step block is ONE dispatch) — shipped
+        # on the final frame so the decode span carries steps/dispatches
+        self.decode_steps = 0
+        self.decode_dispatches = 0
 
     def __len__(self) -> int:
         return len(self.tokens)
@@ -141,7 +148,40 @@ class SpecDecodeBatch:
     has_draft: List[bool] = field(default_factory=list)  # real match per row
 
 
-StepPlan = Union[PrefillBatch, DecodeBatch, SpecDecodeBatch]
+@dataclass
+class MultiStepBatch:
+    """One FUSED decode dispatch: ``width`` decode steps for every row run
+    inside a single jitted program (``JaxEngine._multistep_impl``'s
+    ``lax.scan``) with on-device sampling and stop detection — one Python
+    round trip, one dispatch, one device->host fetch for ``width`` tokens.
+
+    ``start_lens[i]`` is row i's effective token count at BLOCK START
+    (``len(seq)`` plus the tokens of any still-in-flight previous block the
+    host has not appended yet): the block feeds the row's last token at
+    position ``start_lens[i] - 1`` and writes KV for positions
+    ``start_lens[i]-1 .. start_lens[i]+width-2``. Pages covering every
+    written position are allocated AT PLAN TIME, so the fused program never
+    needs mid-block page allocation.
+
+    ``budgets[i]``/``min_gates[i]`` are the remaining max-token budget and
+    the outstanding ``min_tokens`` requirement at block start — the device
+    stop check consumes them (rows past their stop are masked to no-ops so
+    finished sequences stop writing KV). ``chained`` marks a block whose
+    first input token/position/liveness come from the previous block's
+    on-device carry instead of host arrays."""
+
+    seqs: List[Sequence]
+    width: int
+    chained: bool = False
+    start_lens: List[int] = field(default_factory=list)
+    budgets: List[int] = field(default_factory=list)
+    min_gates: List[int] = field(default_factory=list)
+
+    # mirrors the other plan kinds' diagnostic slot (set by the engine)
+    _step_id: Optional[int] = None
+
+
+StepPlan = Union[PrefillBatch, DecodeBatch, SpecDecodeBatch, MultiStepBatch]
 
 
 @dataclass
@@ -171,6 +211,15 @@ class SchedulerConfig:
     # to give fresh context a chance to draft. 0 disables chaining while
     # speculation is on.
     spec_chain_break: int = 8
+    # fused decode: max decode steps per jitted dispatch (DYN_DECODE_MULTISTEP
+    # resolved by the engine; <=1 disables the fused path). The planner may
+    # narrow the width per batch — see plan_multistep.
+    decode_multistep: int = 1
+    # rows with detokenizer-level stop STRINGS cap the fuse width here: the
+    # host only learns of a string match after detokenizing, so a wide block
+    # can overshoot the stop by up to width-1 tokens per in-flight block.
+    # Small lookback bounds that waste while still amortizing the dispatch.
+    stop_str_lookback: int = 2
 
 
 class Scheduler:
@@ -672,6 +721,145 @@ class Scheduler:
         self._chain_run += 1
         return DecodeBatch(seqs=list(prev.seqs))
 
+    # -- fused multi-step decode --------------------------------------------
+
+    @staticmethod
+    def _fuse_eligible(seq: Sequence) -> bool:
+        """Rows the fused block reproduces exactly. Penalties / logit_bias
+        rewrite logits from host bookkeeping that goes stale within a
+        multi-token dispatch, and guided masks need the automaton walked
+        per token on the host — any such row sends the whole batch down
+        the per-step path (same rule family as ``plan_chained``). Seeds
+        and ``min_p`` ARE eligible: both are static per request and ship
+        to the device (seeded draws key on token position, not step)."""
+        so = seq.request.sampling_options
+        rep_on = (so.repetition_penalty is not None
+                  and so.repetition_penalty > 0
+                  and so.repetition_penalty != 1.0)
+        return not (so.frequency_penalty or so.presence_penalty or rep_on
+                    or so.logit_bias or so.guided)
+
+    def _grow_for_block(self, seqs: List[Sequence], start_lens: List[int],
+                        width: int) -> bool:
+        """Allocate every page a ``width``-step block will write
+        (positions ``sl-1 .. sl+width-2`` per row) up front. No preemption
+        on this path — the caller narrows the width instead; pages
+        allocated before a failure stay with their sequences (they are the
+        very next pages those rows use anyway, as ``_spec_plan``)."""
+        for seq, sl in zip(seqs, start_lens):
+            need = self._pages_needed(sl + width - 1) - len(seq.page_ids)
+            if need > 0:
+                try:
+                    seq.page_ids.extend(self.alloc.allocate(need))
+                except OutOfPages:
+                    return False
+        return True
+
+    def _plan_block(self, seqs: List[Sequence], start_lens: List[int],
+                    chained: bool) -> Optional[MultiStepBatch]:
+        """Compute the safe fuse width for one block over ``seqs`` and
+        allocate its pages, or None to fall back to the per-step path.
+
+        The width is the min over rows of: the configured cap
+        (``decode_multistep``), the row's remaining token budget
+        (max_tokens / max_context — a row that deterministically finishes
+        in <2 steps isn't worth a block), and the stop-string lookback for
+        rows with detokenizer-level stop strings; then rounded DOWN to a
+        power of two (bounded compile count), then narrowed further if
+        page pressure refuses the up-front allocation — so the fused
+        program never needs mid-block page allocation. Spec-decode mode
+        and ineligible sampling (penalties/bias/guided) refuse entirely.
+        """
+        cap = self.cfg.decode_multistep
+        if cap < 2 or self.cfg.spec_tokens > 0:
+            return None
+        w = cap
+        budgets: List[int] = []
+        min_gates: List[int] = []
+        for seq, sl in zip(seqs, start_lens):
+            if not self._fuse_eligible(seq):
+                return None
+            sc = seq.request.stop_conditions
+            gen_eff = len(seq.generated) + (sl - len(seq))
+            max_new = sc.max_tokens if sc.max_tokens is not None else (
+                self.max_context_hint - seq.num_prompt
+                if self.max_context_hint else None)
+            rem = (max_new - gen_eff) if max_new is not None else 1 << 20
+            if self.max_context_hint is not None:
+                rem = min(rem, self.max_context_hint - sl)
+            if rem < 2:
+                return None
+            w = min(w, rem)
+            if sc.stop:
+                w = min(w, max(1, self.cfg.stop_str_lookback))
+            budgets.append(min(rem, 1 << 20))  # int32-safe device budget
+            min_gates.append(max(0, (sc.min_tokens or 0) - gen_eff))
+        w = 1 << (w.bit_length() - 1)
+        while w >= 2 and not self._grow_for_block(seqs, start_lens, w):
+            w //= 2
+        if w < 2:
+            return None
+        return MultiStepBatch(seqs=list(seqs), width=w, chained=chained,
+                              start_lens=list(start_lens), budgets=budgets,
+                              min_gates=min_gates)
+
+    def plan_multistep(self, batch: DecodeBatch) -> Optional[MultiStepBatch]:
+        """Try to upgrade a planned decode step into a fused block.
+
+        Refused when anything is waiting or prefilling: a fused block
+        holds the engine for ``width`` steps, and head-of-line blocking a
+        new prompt's admission behind it would regress TTFT — the very
+        tradeoff ``plan_chained`` already refuses one step at a time."""
+        if self.waiting:
+            return None
+        if any(s.phase is Phase.PREFILL for s in self.active.values()):
+            return None
+        return self._plan_block(batch.seqs, [len(s) for s in batch.seqs],
+                                chained=False)
+
+    def plan_multistep_chained(self, prev: MultiStepBatch
+                               ) -> Optional[MultiStepBatch]:
+        """Plan block k+1 while block k's results are still on device.
+
+        Host sequence state excludes block k's (unfetched) tokens, so the
+        effective row length is ``len(seq) + prev.width`` — positions and
+        budgets are computed from that offset, and the device carry
+        supplies the actual first token / liveness. Refused when the batch
+        may change (waiting/prefilling arrivals, any row finished or
+        cancelled per host knowledge)."""
+        if self.waiting:
+            return None
+        for seq in prev.seqs:
+            if seq.phase is not Phase.RUNNING or seq.cancelled:
+                return None
+        if any(s.phase is Phase.PREFILL for s in self.active.values()):
+            return None
+        return self._plan_block(prev.seqs,
+                                [len(s) + prev.width for s in prev.seqs],
+                                chained=True)
+
+    def on_multistep_done(self, plan: MultiStepBatch,
+                          advances: List[int]) -> None:
+        """Advance accounting after a fused block resolved host-side.
+
+        ``advances[i]`` = KV positions the block actually wrote for row i
+        (== tokens appended): the device masks rows to no-ops after their
+        stop, and the host re-derives the same stop point from the same
+        rules. Slots past the advance hold dead-row KV — never committed,
+        overwritten by the next step that reaches those positions, masked
+        from attention by true context length in between (the ``on_spec_
+        done`` safety argument). Commits wait for :meth:`commit_block`
+        AFTER the engine appended the tokens (token blocks must exist)."""
+        for seq, adv in zip(plan.seqs, advances):
+            if adv:
+                seq.num_computed += adv
+
+    def commit_block(self, plan: MultiStepBatch) -> None:
+        """Commit full pages once the block's tokens are appended (rows
+        that finished are no-ops: ``finish`` already released them)."""
+        for seq in plan.seqs:
+            self._commit_full_pages(seq)
+
     def on_step_done(self, plan: StepPlan) -> None:
         """Advance accounting after the engine ran the planned step."""
         if isinstance(plan, PrefillBatch):
@@ -711,4 +899,5 @@ class Scheduler:
 
 
 __all__ = ["Scheduler", "SchedulerConfig", "Sequence", "Phase",
-           "PrefillChunk", "PrefillBatch", "DecodeBatch", "SpecDecodeBatch"]
+           "PrefillChunk", "PrefillBatch", "DecodeBatch", "SpecDecodeBatch",
+           "MultiStepBatch"]

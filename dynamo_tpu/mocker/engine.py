@@ -47,6 +47,12 @@ class MockEngineArgs:
     decode_base_s: float = 0.006
     decode_per_seq_s: float = 120e-6
     dp_size: int = 1               # metadata only (reported in stats)
+    # fused decode: the mocker honors the same dispatch_multistep /
+    # fetch_packed_block hook pair as the real engine, so pipeline tests
+    # exercise the block path end to end. One block pays ONE decode_base_s
+    # for ``width`` tokens — exactly the amortization the fused dispatch
+    # models. 1 disables.
+    decode_multistep: int = 8
 
 
 class MockerEngine(ScheduledEngineBase):
@@ -57,8 +63,11 @@ class MockerEngine(ScheduledEngineBase):
                          max_num_seqs=a.max_num_seqs,
                          max_prefill_chunk=a.max_prefill_chunk,
                          max_context=a.max_context,
-                         max_prefill_seqs=a.max_prefill_seqs)
+                         max_prefill_seqs=a.max_prefill_seqs,
+                         decode_multistep=a.decode_multistep)
         self._rng = np.random.default_rng(0)
+        self.decode_dispatches = 0
+        self.multistep_blocks = 0
 
     def _simulate(self, seconds: float) -> None:
         if self.args.speedup_ratio > 0:
@@ -91,12 +100,45 @@ class MockerEngine(ScheduledEngineBase):
             return toks, np.full(len(plan.chunks), -1.0, np.float32), None
         b = len(plan.seqs)
         self._simulate(a.decode_base_s + b * a.decode_per_seq_s)
+        self.decode_dispatches += 1
         toks = np.empty(b, np.int64)
         for i, seq in enumerate(plan.seqs):
             so = seq.request.sampling_options
             toks[i] = self._token_for(seq.request.request_id, len(seq),
                                       so.temperature or 0.0)
         return toks, np.full(b, -1.0, np.float32), None
+
+    # -- fused decode hooks (loop.py) --------------------------------------
+    # The mocker's "device" is the host, so the block's tokens are computed
+    # at dispatch time and the handle just carries them; the SINGLE
+    # decode_base_s per block (vs per step) is the amortization the fused
+    # dispatch exists to model. Token values match the per-step path:
+    # _token_for keys on (request_id, position) and a block's row j sits at
+    # position start_lens[i] + j — start_lens already carries the chained
+    # offset, so chained blocks stay position-exact while host appends lag.
+
+    @property
+    def supports_multistep(self) -> bool:
+        return self.args.decode_multistep > 1
+
+    def dispatch_multistep(self, plan, prev_handle=None):
+        a = self.args
+        b, w = len(plan.seqs), plan.width
+        self._simulate(a.decode_base_s + w * b * a.decode_per_seq_s)
+        self.decode_dispatches += 1
+        self.multistep_blocks += 1
+        toks = np.empty((b, w), np.int64)
+        for i, seq in enumerate(plan.seqs):
+            so = seq.request.sampling_options
+            for j in range(w):
+                toks[i, j] = self._token_for(seq.request.request_id,
+                                             plan.start_lens[i] + j,
+                                             so.temperature or 0.0)
+        return (toks, np.full((b, w), -1.0, np.float32))
+
+    def fetch_packed_block(self, handle):
+        toks, lps = handle
+        return toks, lps, None
 
 
 __all__ = ["MockerEngine", "MockEngineArgs"]

@@ -78,6 +78,10 @@ async def amain(args: argparse.Namespace) -> None:
         tracer.service = "mocker"
     wm = get_worker_metrics()
     wm.attach_tracer(tracer)
+    from functools import partial
+
+    from dynamo_tpu.worker.metrics import engine_dispatch_stats
+    wm.engine.attach(partial(engine_dispatch_stats, engine))
     system = SystemServer.from_env(registry=wm.registry, tracer=tracer)
     if system is not None:
         system.health.register("engine", ready=True)

@@ -67,6 +67,13 @@ class RuntimeConfig:
     # 0 disables lookahead (tier onboarding falls back to the bounded
     # synchronous path)
     kv_prefetch_depth: int = 64 * 1024 * 1024
+    # -- fused decode ----------------------------------------------------
+    # max decode steps fused into one jitted dispatch with on-device
+    # sampling and stop checks (short-form env DYN_DECODE_MULTISTEP wins;
+    # see engine/jax_engine.py). The scheduler narrows the width per batch
+    # (token budgets, stop-string lookback, page pressure); 1 disables the
+    # fused path entirely (per-step pipelined decode still applies)
+    decode_multistep: int = 8
 
     @classmethod
     def load(cls, path: Optional[str] = None,

@@ -514,12 +514,21 @@ class StageStitcher:
         self.skip_decode = skip_decode
         self.first_unix: Optional[float] = None
         self._done = False
+        self.decode_attrs: Optional[dict] = None
 
     def on_frame(self, out) -> None:
         """Feed every engine frame (duck-typed: .timings/.token_ids)."""
+        timings = getattr(out, "timings", None)
+        if timings and "decode_steps" in timings:
+            # final-frame decode accounting (engine loop): tokens the
+            # decode tail produced and the jitted dispatches they cost —
+            # a fused multi-step block is ONE dispatch, so
+            # steps/dispatches ~= the configured fuse width
+            self.decode_attrs = {
+                "steps": int(timings["decode_steps"]),
+                "dispatches": int(timings["decode_dispatches"])}
         if self.first_unix is not None:
             return
-        timings = getattr(out, "timings", None)
         if not timings:
             return
         now = time.time()
@@ -544,7 +553,8 @@ class StageStitcher:
         self._done = True
         if self.first_unix is not None and not self.skip_decode:
             self.tracer.record("decode", self.first_unix, time.time(),
-                               parent=self.parent)
+                               parent=self.parent,
+                               attrs=self.decode_attrs)
 
 
 __all__ = [

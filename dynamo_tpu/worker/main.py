@@ -105,6 +105,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="with speculation on, break a pipelined decode "
                         "chain after this many steps so fresh context "
                         "gets a chance to draft (0 disables chaining)")
+    p.add_argument("--decode-multistep", type=int, default=None,
+                   help="decode steps fused into one jitted dispatch with "
+                        "on-device sampling/stop checks (default: "
+                        "DYN_DECODE_MULTISTEP or 8; 1 disables fusion)")
     p.add_argument("--no-kv-events", action="store_true")
     p.add_argument("--num-nodes", type=int, default=1,
                    help="multi-host: total processes in the jax world")
@@ -179,7 +183,8 @@ def build_engine(args: argparse.Namespace) -> JaxEngine:
         spec_tokens=args.speculative_num_tokens,
         spec_ngram_max=args.speculative_ngram_max,
         spec_ngram_min=args.speculative_ngram_min,
-        spec_chain_break=args.speculative_chain_break)
+        spec_chain_break=args.speculative_chain_break,
+        decode_multistep=args.decode_multistep)
     forward_fn = None
     pp = args.pipeline_parallel_size
     if pp > 1:
@@ -477,6 +482,9 @@ async def amain(args: argparse.Namespace) -> None:
         # dynamo_worker_kvbm_* tier/prefetch series sample the live tiers
         # at scrape time (zero-valued otherwise)
         wm.kvbm.attach(tiered.kvbm_stats)
+    from dynamo_tpu.worker.metrics import engine_dispatch_stats
+    import functools as _functools
+    wm.engine.attach(_functools.partial(engine_dispatch_stats, engine))
     system = SystemServer.from_env(registry=wm.registry, tracer=tracer)
     if system is not None:
         system.health.register("engine", ready=True)

@@ -39,7 +39,7 @@ def test_single_child_attempt_chain():
     # programs primed, then the measurement — no separate probe/prime
     # processes (the r4 design burned three TPU inits per attempt)
     assert '"stage": "init_ok"' in stderr
-    for prog in ("prefill", "decode", "chained"):
+    for prog in ("prefill", "decode", "chained", "multistep"):
         assert f'"program": "{prog}"' in stderr, stderr[-2000:]
     assert '"stage": "measured"' in stderr
     assert result["attempts"] == 1
@@ -47,8 +47,19 @@ def test_single_child_attempt_chain():
     assert result["value"] > 0
     # the orchestrator recorded the furthest stage the attempt reached
     assert result["best_progress"]["stage"] == "measured"
-    assert result["best_progress"]["programs_primed"] == 3
+    assert result["best_progress"]["programs_primed"] == 4
     assert result["best_progress"]["platform"] == "cpu"
+    # decode dispatch fusion: the width, the fused run's dispatches per
+    # token (must beat one-dispatch-per-token), and the same-run
+    # fused-vs-per-step A/B all land in the result JSON
+    assert result["decode_multistep"] >= 2
+    assert 0 < result["decode_dispatches_per_token"] < 1.0
+    ab = result["decode_ab"]
+    assert "error" not in ab, ab
+    assert ab["fused_tok_s"] > 0 and ab["perstep_tok_s"] > 0
+    assert ab["fused_speedup"] > 0
+    assert ab["perstep_dispatches_per_token"] > \
+        result["decode_dispatches_per_token"]
     # all four host transport planes measured (bulk, wire, inject, e2e);
     # the device-direct plane is best-effort (None when the backend's
     # client lacks the transfer server) but the key must be present

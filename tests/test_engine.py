@@ -450,7 +450,10 @@ class TestPipelinedDecode:
     sampling, across staggered stream ends and prefix-cache revives."""
 
     async def _run(self, pipeline: bool):
-        eng = tiny_engine(pipeline_decode=pipeline)
+        # decode_multistep=1: this class tests the per-step CHAIN machinery
+        # specifically (the fused block path would supersede it; it has its
+        # own suite in tests/test_multistep.py)
+        eng = tiny_engine(pipeline_decode=pipeline, decode_multistep=1)
         try:
             reqs = []
             for i, n in enumerate((3, 7, 12)):
@@ -476,7 +479,8 @@ class TestPipelinedDecode:
     async def test_chained_page_growth_across_boundary(self):
         # page_size=4: decode crosses page boundaries repeatedly while
         # chained, exercising the +1 lookahead growth in plan_chained
-        eng = tiny_engine(pipeline_decode=True, num_pages=32)
+        eng = tiny_engine(pipeline_decode=True, num_pages=32,
+                          decode_multistep=1)
         try:
             r = make_req([1, 2, 3], "g", max_tokens=21)
             r.eos_token_ids = []
@@ -512,26 +516,35 @@ class TestPrefillFetchSkipping:
         device->host round trip (their sampled values are never read)."""
         eng = tiny_engine(max_prefill_chunk=4, min_prefill_bucket=4,
                           num_pages=32, max_context=64)
-        fetches = {"n": 0}
+        fetches = {"n": 0, "blocks": 0}
         orig = eng.fetch_packed
+        orig_block = eng.fetch_packed_block
 
         def counting(packed):
             fetches["n"] += 1
             return orig(packed)
 
+        def counting_block(handle):
+            fetches["blocks"] += 1
+            return orig_block(handle)
+
         eng.fetch_packed = counting
+        eng.fetch_packed_block = counting_block
         try:
             # 14-token prompt / 4-token chunks -> 4 prefill steps, only the
-            # final one needs a fetch; 3 decode steps follow
+            # final one needs a fetch; the 2 remaining decode tokens ride
+            # one fused block (or 2 per-step fetches when fusion narrows)
             r = make_req(list(range(1, 15)), "long", max_tokens=3)
             r.eos_token_ids = []
             frames = await collect(eng, r)
             toks = [t for f in frames for t in f.token_ids]
             assert len(toks) == 3
-            # fetches: 1 (last prefill chunk, samples token 1) + 2 decode
-            # steps (tokens 2 and 3) = 3; the three intermediate prefill
-            # chunks fetched nothing
-            assert fetches["n"] == 3, fetches
+            # per-step fetches: exactly 1 — the last prefill chunk (which
+            # samples token 1); the three intermediate prefill chunks
+            # fetched nothing. Tokens 2+3 (remaining budget 2) ride ONE
+            # fused block fetch.
+            assert fetches["n"] == 1, fetches
+            assert fetches["blocks"] == 1, fetches
         finally:
             await eng.stop()
 

@@ -47,12 +47,17 @@ def make_req(tokens, rid, max_tokens=30):
 
 
 async def start_slow_worker(coordinator, name="m", decode_s=0.05):
-    """Mocker worker with real-time decode pacing so we can kill mid-stream."""
+    """Mocker worker with real-time decode pacing so we can kill mid-stream.
+
+    decode_multistep=1: the pacing is PER TOKEN by design (a fused block
+    would deliver 8 tokens per decode_base_s and the mid-stream kill
+    races stream completion)."""
     drt = await DistributedRuntime.create(coordinator=coordinator)
     engine = MockerEngine(MockEngineArgs(
         num_pages=64, page_size=4, max_num_seqs=8, max_prefill_chunk=32,
         max_context=256, speedup_ratio=1.0, prefill_base_s=0.001,
-        prefill_per_token_s=0.0, decode_base_s=decode_s, decode_per_seq_s=0.0))
+        prefill_per_token_s=0.0, decode_base_s=decode_s, decode_per_seq_s=0.0,
+        decode_multistep=1))
     card = make_test_card(name=name, kv_cache_block_size=4)
     ep = drt.namespace("ns").component("w").endpoint("generate")
     await serve_engine(ep, engine)

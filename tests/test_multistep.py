@@ -1,0 +1,416 @@
+"""Fused multi-step decode: N decode steps per jitted dispatch with
+on-device sampling and stop checks (engine/jax_engine._multistep_impl +
+engine/scheduler.plan_multistep).
+
+The contract under test: the fused path is BIT-IDENTICAL to per-step
+decode — greedy and fixed-seed sampling, EOS / max_tokens / stop-token
+stops landing mid-block, cancellation mid-block — while costing ~M/width
+dispatches for M tokens (the dispatch-count regression guard), and the
+scheduler narrows the fuse width wherever the device could not honor the
+semantics (stop strings, budgets, page pressure, penalties/guided).
+"""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.engine.jax_engine import JaxEngine, JaxEngineConfig
+from dynamo_tpu.engine.pages import PageAllocator
+from dynamo_tpu.engine.scheduler import (
+    DecodeBatch,
+    MultiStepBatch,
+    Phase,
+    PrefillBatch,
+    Scheduler,
+    SchedulerConfig,
+)
+from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.protocols.common import (
+    FinishReason,
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+
+
+def make_req(tokens, rid="r1", max_tokens=8, eos=(), samp=None, **stop_kw):
+    return PreprocessedRequest(
+        token_ids=list(tokens), request_id=rid,
+        stop_conditions=StopConditions(max_tokens=max_tokens, **stop_kw),
+        sampling_options=samp or SamplingOptions(temperature=0.0),
+        eos_token_ids=list(eos))
+
+
+def tiny_engine(**kw):
+    cfg = ModelConfig.tiny()
+    defaults = dict(num_pages=64, page_size=4, max_num_seqs=4,
+                    max_prefill_chunk=16, max_context=64,
+                    min_prefill_bucket=4)
+    defaults.update(kw)
+    return JaxEngine.random_init(cfg, JaxEngineConfig(**defaults))
+
+
+async def collect(engine, req, ctx=None):
+    frames = []
+    async for out in engine.generate(req, ctx=ctx):
+        frames.append(out)
+    return frames
+
+
+def toks_of(frames):
+    return [t for f in frames for t in f.token_ids]
+
+
+async def run_many(reqs, **engine_kw):
+    """Run requests concurrently on a fresh engine; returns
+    ([tokens per req], [finish reason per req], engine counters)."""
+    eng = tiny_engine(**engine_kw)
+    try:
+        results = await asyncio.gather(*[collect(eng, r) for r in reqs])
+        return ([toks_of(f) for f in results],
+                [f[-1].finish_reason for f in results],
+                {"dispatches": eng.decode_dispatches,
+                 "blocks": eng.multistep_blocks})
+    finally:
+        await eng.stop()
+
+
+def reqs_staggered(samp=None, lens=(5, 11, 18), eos=(), **stop_kw):
+    out = []
+    for i, n in enumerate(lens):
+        out.append(make_req([i + 1, i + 2, i + 3, i + 4, i + 5], f"m{i}",
+                            max_tokens=n, eos=eos,
+                            samp=samp() if samp else None, **stop_kw))
+    return out
+
+
+class TestTokenParity:
+    """Fused vs per-step must be token-for-token identical."""
+
+    async def _both(self, mk_reqs, **kw):
+        fused_t, fused_r, c = await run_many(mk_reqs(), decode_multistep=8,
+                                             **kw)
+        step_t, step_r, c0 = await run_many(mk_reqs(), decode_multistep=1,
+                                            **kw)
+        assert c["blocks"] > 0          # the fused path actually ran
+        assert c0["blocks"] == 0
+        assert fused_t == step_t
+        assert fused_r == step_r
+        return fused_t, fused_r, c
+
+    async def test_greedy_staggered_lengths(self):
+        toks, reasons, c = await self._both(reqs_staggered)
+        assert [len(t) for t in toks] == [5, 11, 18]
+
+    async def test_seeded_sampling_parity(self):
+        def samp():
+            return SamplingOptions(temperature=1.0, seed=4242)
+
+        toks, _r, _c = await self._both(
+            lambda: reqs_staggered(samp=samp))
+        assert [len(t) for t in toks] == [5, 11, 18]
+
+    async def test_seed_replay_matches_solo_run(self):
+        # a seeded request must produce the same tokens fused-batched as
+        # per-step solo: seeded draws key on token position, not on step
+        # counters or fuse width
+        def one():
+            return [make_req([7, 8, 9], "solo", max_tokens=12,
+                             samp=SamplingOptions(temperature=0.9,
+                                                  seed=77))]
+
+        fused, _, c = await run_many(one(), decode_multistep=8)
+        solo, _, _ = await run_many(one(), decode_multistep=1)
+        assert c["blocks"] > 0
+        assert fused == solo
+
+    async def test_eos_mid_block(self):
+        # probe the greedy trajectory, then declare the token produced at
+        # a mid-block index to be EOS: both paths must cut at the same
+        # place with FinishReason.EOS
+        probe, _, _ = await run_many(reqs_staggered(lens=(16, 16, 16)),
+                                     decode_multistep=1)
+        eos_tok = probe[0][4]   # 5th token: mid-block for width 8
+
+        def mk():
+            return reqs_staggered(lens=(16, 16, 16), eos=[eos_tok])
+
+        toks, reasons, _ = await self._both(mk)
+        assert len(toks[0]) <= 16
+        assert toks[0][-1] == eos_tok
+        assert reasons[0] == FinishReason.EOS
+
+    async def test_stop_token_mid_block_with_min_tokens(self):
+        probe, _, _ = await run_many(reqs_staggered(lens=(16,)),
+                                     decode_multistep=1)
+        stop_tok = probe[0][2]   # appears early; min_tokens must gate it
+        early = probe[0].index(stop_tok)
+
+        def mk():
+            return reqs_staggered(lens=(16,), stop_token_ids=[stop_tok],
+                                  min_tokens=early + 2)
+
+        toks, reasons, _ = await self._both(mk)
+        assert len(toks[0]) >= early + 2
+        if reasons[0] == FinishReason.STOP:
+            assert toks[0][-1] == stop_tok
+
+    async def test_max_tokens_mid_block(self):
+        # budgets that are not multiples of the width stop mid-block
+        toks, reasons, _ = await self._both(
+            lambda: reqs_staggered(lens=(3, 9, 13)))
+        assert [len(t) for t in toks] == [3, 9, 13]
+        assert all(r == FinishReason.LENGTH for r in reasons)
+
+    async def test_stop_string_block_boundary(self):
+        """A row with detokenizer-level stop strings narrows the width to
+        the lookback; the host-side 'string matched' signal (the backend
+        closing the stream) arriving at a block boundary must terminate
+        cleanly and reclaim pages — the engine-side half of StopJail."""
+        eng = tiny_engine(decode_multistep=8)
+        free0 = eng.allocator.num_free
+        widths = []
+        orig_dm = eng.dispatch_multistep
+
+        def recording(plan, prev_handle=None):
+            widths.append(plan.width)
+            return orig_dm(plan, prev_handle)
+
+        eng.dispatch_multistep = recording
+        try:
+            r = make_req([1, 2, 3], "ss", max_tokens=40, stop=["XYZ"])
+            got = []
+            # consume 5 tokens (an odd count: with lookback width 2 the
+            # 'match' lands spanning a block boundary), then close — the
+            # backend's StopJail does exactly this on a string match
+            async for out in eng.generate(r):
+                got.extend(out.token_ids)
+                if len(got) >= 5:
+                    break
+            assert len(got) >= 5
+            # narrowed: no wide block ran while the stop-string row was in
+            # the batch (stop_str_lookback caps the fuse width at 2)
+            assert widths and all(w <= 2 for w in widths), widths
+            # pages reclaimed on the next plan pass
+            for _ in range(100):
+                if eng.allocator.num_free == free0:
+                    break
+                await asyncio.sleep(0.02)
+            assert eng.allocator.num_free == free0
+        finally:
+            await eng.stop()
+
+    async def test_cancel_mid_block_reclaims_pages(self):
+        class Ctx:
+            cancelled = False
+
+        eng = tiny_engine(decode_multistep=8)
+        free0 = eng.allocator.num_free
+        try:
+            ctx = Ctx()
+            r = make_req([1, 2, 3], "cx", max_tokens=1000)
+            frames = []
+            async for out in eng.generate(r, ctx=ctx):
+                frames.append(out)
+                ctx.cancelled = True   # cancel after the first frame
+            assert frames[-1].finish_reason == FinishReason.CANCELLED
+            # pages for the dead row reclaimed by the next plan pass
+            for _ in range(100):
+                if eng.allocator.num_free == free0:
+                    break
+                await asyncio.sleep(0.02)
+            assert eng.allocator.num_free == free0
+            # the engine still serves after the mid-block cancellation
+            ok = await collect(eng, make_req([4, 5, 6], "after",
+                                             max_tokens=6))
+            assert len(toks_of(ok)) == 6
+        finally:
+            await eng.stop()
+
+
+class TestDispatchCount:
+    async def test_m_tokens_cost_m_over_n_plus_c_dispatches(self):
+        """The regression guard of the fused path: M decoded tokens must
+        cost <= M/N + c dispatches (N = fuse width; c covers the budget-
+        narrowed tail blocks and the final per-step remainder)."""
+        M, N = 32, 8
+        eng = tiny_engine(decode_multistep=N, max_context=64)
+        try:
+            r = make_req([1, 2, 3], "g", max_tokens=M)
+            frames = await collect(eng, r)
+            toks = toks_of(frames)
+            assert len(toks) == M
+            # token 1 comes from prefill; M-1 from decode dispatches
+            assert eng.decode_dispatches <= M // N + 3, (
+                eng.decode_dispatches, eng.multistep_blocks)
+            assert eng.multistep_blocks >= 3
+        finally:
+            await eng.stop()
+
+    async def test_dispatch_tap_feeds_worker_metric(self):
+        from dynamo_tpu.worker.metrics import engine_dispatch_stats
+        eng = tiny_engine(decode_multistep=8)
+        try:
+            await collect(eng, make_req([1, 2, 3], "t", max_tokens=16))
+            stats = engine_dispatch_stats(eng)
+            assert stats["decode_dispatches"] >= 1
+            assert stats["decode_multistep_blocks"] >= 1
+            assert stats["decode_dispatches"] == eng.decode_dispatches
+        finally:
+            await eng.stop()
+
+    async def test_decode_span_attrs_on_final_frame(self):
+        eng = tiny_engine(decode_multistep=8)
+        try:
+            frames = await collect(eng, make_req([1, 2, 3], "a",
+                                                 max_tokens=16))
+            last = frames[-1]
+            assert last.timings is not None
+            # 16 tokens: 1 from prefill + 15 decode; fused blocks keep
+            # dispatches well under steps
+            assert last.timings["decode_steps"] == 15
+            assert last.timings["decode_dispatches"] < 15
+        finally:
+            await eng.stop()
+
+
+class TestSchedulerWidth:
+    """Unit tests of the fuse-width computation (no device involved)."""
+
+    def make(self, num_pages=33, page_size=4, **cfg):
+        alloc = PageAllocator(num_pages, page_size)
+        base = dict(max_num_seqs=4, max_prefill_chunk=32,
+                    decode_multistep=8)
+        base.update(cfg)
+        s = Scheduler(alloc, SchedulerConfig(**base))
+        s.max_context_hint = 128
+        return s, alloc
+
+    def to_running(self, sched, req):
+        sched.add_request(req)
+        plan = sched.schedule()
+        assert isinstance(plan, PrefillBatch)
+        sched.on_step_done(plan)
+        seq = plan.chunks[-1].seq
+        assert seq.phase == Phase.RUNNING
+        seq.tokens.append(9)
+        seq.generated.append(9)
+        return seq
+
+    def test_full_width_and_page_preallocation(self):
+        sched, _ = self.make()
+        seq = self.to_running(sched, make_req(range(1, 6), "a",
+                                              max_tokens=32))
+        d = sched.schedule()
+        assert isinstance(d, DecodeBatch)
+        ms = sched.plan_multistep(d)
+        assert isinstance(ms, MultiStepBatch)
+        assert ms.width == 8
+        assert ms.start_lens == [len(seq)]
+        # pages for every written position (sl-1 .. sl+6) pre-allocated
+        assert len(seq.page_ids) * sched.page_size >= len(seq) + ms.width - 1
+
+    def test_budget_narrows_and_pow2_floors(self):
+        sched, _ = self.make()
+        self.to_running(sched, make_req(range(1, 6), "a", max_tokens=7))
+        ms = sched.plan_multistep(sched.schedule())
+        # remaining budget 6 -> pow2 floor 4
+        assert ms is not None and ms.width == 4
+        assert ms.budgets == [6]
+
+    def test_budget_too_small_falls_back(self):
+        sched, _ = self.make()
+        self.to_running(sched, make_req(range(1, 6), "a", max_tokens=2))
+        assert sched.plan_multistep(sched.schedule()) is None
+
+    def test_stop_string_lookback_caps_width(self):
+        sched, _ = self.make()
+        self.to_running(sched, make_req(range(1, 6), "a", max_tokens=32,
+                                        stop=["foo"]))
+        ms = sched.plan_multistep(sched.schedule())
+        assert ms is not None and ms.width == 2
+
+    def test_penalties_and_guided_fall_back(self):
+        sched, _ = self.make()
+        r = make_req(range(1, 6), "a", max_tokens=32,
+                     samp=SamplingOptions(temperature=0.0,
+                                          frequency_penalty=1.0))
+        self.to_running(sched, r)
+        assert sched.plan_multistep(sched.schedule()) is None
+
+        sched2, _ = self.make()
+        r2 = make_req(range(1, 6), "g", max_tokens=32,
+                      samp=SamplingOptions(temperature=0.0,
+                                           guided={"mode": "json"}))
+        self.to_running(sched2, r2)
+        assert sched2.plan_multistep(sched2.schedule()) is None
+
+    def test_seeds_and_min_p_stay_eligible(self):
+        sched, _ = self.make()
+        r = make_req(range(1, 6), "s", max_tokens=32,
+                     samp=SamplingOptions(temperature=1.0, seed=3,
+                                          min_p=0.05))
+        self.to_running(sched, r)
+        ms = sched.plan_multistep(sched.schedule())
+        assert ms is not None and ms.width == 8
+
+    def test_page_pressure_narrows_width(self):
+        # 3 usable pages, page_size 4: a 6-token running seq holds 2;
+        # width 8 needs pages through position len+6 — more than remain;
+        # the planner narrows instead of preempting
+        sched, alloc = self.make(num_pages=4)
+        seq = self.to_running(sched, make_req(range(1, 6), "a",
+                                              max_tokens=32))
+        ms = sched.plan_multistep(sched.schedule())
+        if ms is not None:
+            assert ms.width < 8
+            need = (seq.page_ids and len(seq.page_ids)
+                    * sched.page_size >= len(seq) + ms.width - 1)
+            assert need
+        # and per-step decode still possible either way
+        assert sched.schedule() is not None
+
+    def test_spec_mode_refuses(self):
+        sched, _ = self.make(spec_tokens=4)
+        self.to_running(sched, make_req(range(1, 6), "a", max_tokens=32))
+        d = DecodeBatch(seqs=[s for s in sched.active.values()])
+        assert sched.plan_multistep(d) is None
+
+    def test_waiting_request_blocks_fusion(self):
+        # a fused block must not head-of-line block a new prompt's
+        # admission: anything waiting refuses the fuse
+        sched, _ = self.make(max_num_seqs=1)
+        self.to_running(sched, make_req(range(1, 6), "a", max_tokens=32))
+        sched.add_request(make_req(range(1, 6), "b", max_tokens=8))
+        d = sched.schedule()
+        if isinstance(d, DecodeBatch):
+            assert sched.plan_multistep(d) is None
+
+
+class TestMockerBlockPath:
+    async def test_mocker_fused_tokens_match_per_step(self):
+        from dynamo_tpu.mocker.engine import MockEngineArgs, MockerEngine
+
+        async def run(ms):
+            eng = MockerEngine(MockEngineArgs(
+                speedup_ratio=100.0, decode_multistep=ms))
+            try:
+                reqs = [make_req([i + 1, i + 2, i + 3], f"k{i}",
+                                 max_tokens=n)
+                        for i, n in enumerate((4, 9, 14))]
+                results = await asyncio.gather(
+                    *[collect(eng, r) for r in reqs])
+                return ([toks_of(f) for f in results],
+                        eng.multistep_blocks)
+            finally:
+                await eng.stop()
+
+        fused, blocks = await run(8)
+        per_step, blocks0 = await run(1)
+        assert blocks > 0 and blocks0 == 0
+        assert fused == per_step
+        assert [len(t) for t in fused] == [4, 9, 14]
+
+
+if __name__ == "__main__":
+    raise SystemExit(pytest.main([__file__, "-v"]))
