@@ -348,6 +348,122 @@ async def _measure_engine(engine, cfg, geometry, wd: Watchdog,
                 decode_dispatches=decode_dispatches)
 
 
+# requests / arrival rate of the continuous-arrival (mixed-batch) leg;
+# the rate must SATURATE the engine (prefills arriving while decode rows
+# run) or the leg measures the arrival schedule instead of the engine —
+# sized for the tiny tier's ~ms step times, overridable for on-chip runs
+MIXED_ARRIVAL_REQS = int(os.environ.get("BENCH_MIXED_REQS", "32"))
+MIXED_ARRIVAL_RPS = float(os.environ.get("BENCH_MIXED_RPS", "120"))
+
+
+async def _measure_mixed_arrivals(engine, vocab_size: int) -> dict:
+    """Continuous-arrival leg: Poisson onboarding (``trace_gen``) against
+    one engine, measured with the legacy prefill-XOR-decode alternation
+    and with mixed dispatch ON in the same run. This is the regime the
+    steady-state legs cannot see: prefill and decode contending, fused
+    blocks either gated off (legacy) or running through the arrivals
+    (mixed). Reports tok/s over the whole arrival window, p99 TTFT, and
+    decode dispatches per generated token per leg.
+
+    Run against BOTH the live jax engine and the mocker
+    (``run_attempt``): the jax sub-leg measures real compute on whatever
+    platform the attempt runs on — on an in-process CPU backend the
+    dispatch/round-trip overhead that mixed dispatch amortizes is ~free,
+    so its A/B is expected ~flat there and only separates on a real
+    (tunneled) chip; the mocker sub-leg prices each dispatch with the
+    calibrated v5e cost model, so the scheduling-policy effect is visible
+    on any host (the reference benchmarks its schedulers on its mocker
+    the same way)."""
+    import numpy as np
+
+    from dynamo_tpu.protocols.common import (
+        PreprocessedRequest, SamplingOptions, StopConditions)
+    from dynamo_tpu.trace_gen import TraceConfig, generate
+
+    sched_cfg = engine.scheduler.cfg
+    # prompts span SEVERAL prefill chunks (that is the contended regime:
+    # legacy gates fusion off while any row is prefilling, mixed rides
+    # decode rows through those same steps), bounded by the context
+    max_prompt = max(2 * sched_cfg.max_prefill_chunk,
+                     min(3 * sched_cfg.max_prefill_chunk,
+                         engine.max_context - 48))
+    max_prompt = min(max_prompt, engine.max_context - 40)
+    trace = list(generate(TraceConfig(
+        num_requests=MIXED_ARRIVAL_REQS, requests_per_s=MIXED_ARRIVAL_RPS,
+        block_size=max(16, engine.allocator.page_size), shared_blocks=2,
+        unique_blocks_mean=4.0, output_len_mean=64.0, seed=7)))
+    rng = np.random.default_rng(7)
+    prompts = [rng.integers(1, vocab_size,
+                            size=max(2, min(r["input_length"],
+                                            max_prompt))).tolist()
+               for r in trace]
+
+    async def leg(label: str, mixed: bool) -> dict:
+        sched_cfg.mixed_batch = mixed
+        ttfts: list = []
+        counts: list = []
+        d0 = getattr(engine, "decode_dispatches", 0)
+        b0 = getattr(engine, "multistep_blocks", 0)
+        x0 = getattr(engine, "mixed_steps", 0)
+        t_start = time.perf_counter()
+
+        async def drive(i: int, req: dict):
+            # the SAME Poisson arrival schedule for both legs
+            await asyncio.sleep(max(
+                0.0, t_start + req["timestamp"] / 1000.0
+                - time.perf_counter()))
+            gen_cap = max(8, min(128, engine.max_context
+                                 - len(prompts[i]) - 8))
+            p = PreprocessedRequest(
+                token_ids=prompts[i], request_id=f"mx{label}{i}",
+                stop_conditions=StopConditions(
+                    max_tokens=max(8, min(req["output_length"], gen_cap)),
+                    ignore_eos=True),
+                sampling_options=SamplingOptions(temperature=0.0))
+            t0 = time.perf_counter()
+            first = None
+            n = 0
+            async for out in engine.generate(p):
+                if out.token_ids and first is None:
+                    first = time.perf_counter() - t0
+                n += len(out.token_ids)
+            if first is not None:
+                ttfts.append(first)
+            counts.append(n)
+
+        await asyncio.gather(*[drive(i, r) for i, r in enumerate(trace)])
+        wall = time.perf_counter() - t_start
+        total = sum(counts)
+        dispatches = getattr(engine, "decode_dispatches", 0) - d0
+        ttfts.sort()
+        p99 = (ttfts[min(len(ttfts) - 1, int(0.99 * len(ttfts)))]
+               if ttfts else None)
+        return {
+            "tok_s": round(total / wall, 1) if wall > 0 else 0.0,
+            "ttft_p99_s": round(p99, 4) if p99 is not None else None,
+            "decode_dispatches_per_token": round(
+                dispatches / max(1, total), 4),
+            "fused_blocks": getattr(engine, "multistep_blocks", 0) - b0,
+            "mixed_dispatches": getattr(engine, "mixed_steps", 0) - x0,
+            "total_tokens": total,
+        }
+
+    saved = sched_cfg.mixed_batch
+    try:
+        await leg("w", True)    # warmup: compiles any mixed-only shapes
+        legacy = await leg("l", False)
+        mixed = await leg("m", True)
+    finally:
+        sched_cfg.mixed_batch = saved
+    _ckpt("mixed_arrivals", legacy_tok_s=legacy["tok_s"],
+          mixed_tok_s=mixed["tok_s"],
+          legacy_dpt=legacy["decode_dispatches_per_token"],
+          mixed_dpt=mixed["decode_dispatches_per_token"])
+    return {"legacy": legacy, "mixed": mixed,
+            "speedup": (round(mixed["tok_s"] / legacy["tok_s"], 3)
+                        if legacy["tok_s"] > 0 else None)}
+
+
 async def run_attempt(args) -> dict:
     """The whole attempt, one process: build -> prime -> measure ->
     transports -> optional attn-impl A/B. ``jax_init`` already happened in
@@ -385,6 +501,29 @@ async def run_attempt(args) -> dict:
                                              "perstep")
             finally:
                 engine.multistep = ms_saved
+        # continuous-arrival mixed-batch leg: Poisson onboarding with a
+        # same-run mixed-vs-legacy A/B (the regime the steady-state
+        # measurement cannot see)
+        mixed_arrivals = None
+        if not on_tpu or deadline - time.monotonic() \
+                >= STAGE_BUDGETS["measure"]:
+            wd.arm("measure:mixed_arrivals", STAGE_BUDGETS["measure"])
+            mixed_arrivals = {
+                "jax": await _measure_mixed_arrivals(
+                    engine, cfg.vocab_size)}
+            # mocker sub-leg: the calibrated v5e dispatch-cost model
+            # exposes the scheduling-policy effect on any host (an
+            # in-process CPU backend pays ~nothing per dispatch, so the
+            # jax sub-leg only separates on a real chip)
+            from dynamo_tpu.mocker.engine import MockEngineArgs, MockerEngine
+            mock = MockerEngine(MockEngineArgs(
+                max_prefill_chunk=64, max_prefill_seqs=4, max_num_seqs=8,
+                num_pages=1024, page_size=16))
+            try:
+                mixed_arrivals["mocker"] = await _measure_mixed_arrivals(
+                    mock, 32000)
+            finally:
+                await mock.stop()
         # transport measurements, serialized with the step loop per the
         # engine.pages contract
         wd.arm("transport:inject", STAGE_BUDGETS["transport"])
@@ -447,6 +586,10 @@ async def run_attempt(args) -> dict:
         "decode_multistep": int(getattr(engine, "multistep", 1)),
         "decode_dispatches_per_token": round(
             m["decode_dispatches"] / max(1, m["total_generated"]), 4),
+        # continuous-arrival mixed-vs-legacy A/B (tok/s, p99 TTFT,
+        # dispatches/token under Poisson onboarding)
+        "mixed_arrivals": (mixed_arrivals
+                           or {"error": "skipped (budget)"}),
     }
     if m_ps is not None:
         result["decode_ab"] = {

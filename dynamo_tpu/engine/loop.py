@@ -34,6 +34,7 @@ from dynamo_tpu.engine.base import EngineBase
 from dynamo_tpu.engine.pages import PageAllocator
 from dynamo_tpu.engine.scheduler import (
     DecodeBatch,
+    MixedStepBatch,
     MultiStepBatch,
     Phase,
     PrefillBatch,
@@ -62,7 +63,8 @@ class ScheduledEngineBase(EngineBase):
                  ring_threshold: Optional[int] = None,
                  spec_tokens: int = 0, spec_ngram_max: int = 4,
                  spec_ngram_min: int = 2, spec_chain_break: int = 8,
-                 decode_multistep: int = 1):
+                 decode_multistep: int = 1, mixed_batch: bool = True,
+                 decode_progress_every: int = 2):
         if max_context % page_size:
             raise ValueError("max_context must be a multiple of page_size")
         self.max_context = max_context
@@ -74,7 +76,8 @@ class ScheduledEngineBase(EngineBase):
             spec_tokens=spec_tokens, spec_ngram_max=spec_ngram_max,
             spec_ngram_min=spec_ngram_min,
             spec_chain_break=spec_chain_break,
-            decode_multistep=decode_multistep))
+            decode_multistep=decode_multistep, mixed_batch=mixed_batch,
+            decode_progress_every=decode_progress_every))
         self.scheduler.max_context_hint = max_context
         self._queues: Dict[str, asyncio.Queue] = {}
         self._work = asyncio.Event()
@@ -139,6 +142,14 @@ class ScheduledEngineBase(EngineBase):
     # (sampled [B, w], logprobs [B, w], extras) aligned with plan.seqs.
     supports_multistep = False
 
+    @property
+    def multistep_unsupported_reason(self) -> Optional[str]:
+        """Why ``supports_multistep`` is False on an engine whose config
+        ASKED for fusion (mesh/spec/multihost...), or None when it is off
+        by configuration / actually supported — feeds the
+        ``dynamo_worker_multistep_fallback_total{reason}`` counter."""
+        return None
+
     def dispatch_multistep(self, plan, prev_handle=None):  # pragma: no cover
         raise NotImplementedError
 
@@ -192,6 +203,13 @@ class ScheduledEngineBase(EngineBase):
             # StageStitcher turns these into decode-span attrs
             out.timings = {"decode_steps": float(seq.decode_steps),
                            "decode_dispatches": float(seq.decode_dispatches)}
+            if seq.multistep_fallbacks:
+                # fused-path refusals that touched this sequence: the
+                # decode span carries the count so a slow stream is
+                # attributable to fallbacks without cross-referencing
+                # the worker counter
+                out.timings["multistep_fallbacks"] = float(
+                    seq.multistep_fallbacks)
         self._emit(seq, out)
 
     def _accept_token(self, seq: Sequence, token: int, logprob: float,
@@ -380,7 +398,7 @@ class ScheduledEngineBase(EngineBase):
                     zip(extras["top_ids"][i], extras["top_lps"][i])}
 
         self.scheduler.on_step_done(plan)
-        if isinstance(plan, PrefillBatch):
+        if isinstance(plan, (PrefillBatch, MixedStepBatch)):
             for i, chunk in enumerate(plan.chunks):
                 seq = chunk.seq
                 if seq.cancelled:
@@ -408,6 +426,19 @@ class ScheduledEngineBase(EngineBase):
                         self._accept_token(seq, int(sampled[i]),
                                            float(logprobs[i]),
                                            top_for(i, seq))
+            # mixed step: the tail rows are decode rows riding the same
+            # dispatch — resolve them with the plain decode semantics
+            for j, seq in enumerate(getattr(plan, "decode_seqs", ()),
+                                    start=len(plan.chunks)):
+                if seq.phase is not Phase.RUNNING:
+                    continue  # finished/preempted during this step
+                if seq.cancelled:
+                    self._finish(seq, FinishReason.CANCELLED)
+                    continue
+                seq.decode_dispatches += 1
+                seq.decode_steps += 1
+                self._accept_token(seq, int(sampled[j]), float(logprobs[j]),
+                                   top_for(j, seq))
         else:
             for i, seq in enumerate(plan.seqs):
                 if seq.phase is not Phase.RUNNING:
@@ -621,8 +652,13 @@ class ScheduledEngineBase(EngineBase):
                 await self._work.wait()
                 continue
             if isinstance(plan, DecodeBatch):
-                ms = (self.scheduler.plan_multistep(plan)
-                      if self.supports_multistep else None)
+                ms = None
+                if self.supports_multistep:
+                    ms = self.scheduler.plan_multistep(plan)
+                else:
+                    reason = self.multistep_unsupported_reason
+                    if reason is not None:
+                        self.scheduler.record_fallback(reason, plan.seqs)
                 if ms is not None:
                     try:
                         handle = await asyncio.to_thread(

@@ -12,11 +12,16 @@ engine):
            prefix cache usually revives the computed prefix)
 
 The scheduler is pure host-side bookkeeping: it never touches device arrays.
-Each call to :meth:`schedule` returns ONE step plan — either a prefill batch
+Each call to :meth:`schedule` returns ONE step plan — a prefill batch
 (up to ``max_prefill_seqs`` sequences sharing the ``max_prefill_chunk`` token
-budget, one [B, S] step) or a decode batch over all running sequences — and
-the engine turns the plan into padded/bucketed device arrays. Prefill and
-decode alternate when both are runnable so neither starves.
+budget, one [B, S] step), a decode batch over all running sequences, or —
+with ``mixed_batch`` on (the default) — a :class:`MixedStepBatch` packing
+the prefill chunks AND the decode rows into that same [B, S] step (each
+decode row is a ragged length-1 chunk) — and the engine turns the plan
+into padded/bucketed device arrays. Mixed steps alternate with pure decode
+plans (the half the engine fuses into multi-step blocks); with
+``mixed_batch`` off, prefill and decode alternate when both are runnable,
+bounded by the ``decode_progress_every`` guarantee.
 
 Token accounting: ``num_computed`` counts positions whose KV is written to the
 cache. A decode step feeds the single newest token (position ``len-1``),
@@ -63,7 +68,8 @@ class Sequence:
                  "num_computed", "cached_tokens", "num_prompt", "generated",
                  "phase", "cancelled", "arrival", "salt_hash",
                  "enqueued_unix", "admitted_unix", "timings_sent",
-                 "decode_steps", "decode_dispatches")
+                 "decode_steps", "decode_dispatches", "table_version",
+                 "multistep_fallbacks")
 
     def __init__(self, request: PreprocessedRequest, page_size: int,
                  salt_hash: int = 0):
@@ -93,6 +99,17 @@ class Sequence:
         # on the final frame so the decode span carries steps/dispatches
         self.decode_steps = 0
         self.decode_dispatches = 0
+        # bumped whenever ``page_ids`` changes (allocation, growth, adopt,
+        # preemption, release): the engine's device-resident page-table
+        # cache keys on it instead of hashing/rebuilding the padded table
+        # host-side every step
+        self.table_version = 0
+        # fused-decode refusals that touched this sequence (the trace
+        # layer ships the count as a decode-span attr)
+        self.multistep_fallbacks = 0
+
+    def pages_changed(self) -> None:
+        self.table_version += 1
 
     def __len__(self) -> int:
         return len(self.tokens)
@@ -181,7 +198,35 @@ class MultiStepBatch:
     _step_id: Optional[int] = None
 
 
-StepPlan = Union[PrefillBatch, DecodeBatch, SpecDecodeBatch, MultiStepBatch]
+@dataclass
+class MixedStepBatch:
+    """ONE token-budgeted dispatch advancing prefill chunks AND decode
+    rows together — continuous batching at real occupancy instead of the
+    strict prefill-XOR-decode alternation (the Ragged Paged Attention
+    batch shape, PAPERS.md).
+
+    Rows 0..len(chunks)-1 are prefill chunks (the ``_prefill_plan``
+    packing, same token budget); the remaining rows are RUNNING sequences
+    each feeding their newest token at position ``len-1`` — a decode row
+    is just a ragged chunk of length 1 (``start == num_computed``,
+    ``is_last``), so the engine's [B, S] step program serves the whole
+    batch: per-row ``new_lens`` carries the raggedness, each row samples
+    at its last real token, and decode rows' sampling (seeds included:
+    they key on token position) matches the plain decode step exactly.
+    """
+
+    chunks: List[PrefillChunk]
+    decode_seqs: List[Sequence] = field(default_factory=list)
+
+    _step_id: Optional[int] = None
+
+    @property
+    def seqs(self) -> List[Sequence]:
+        return [c.seq for c in self.chunks] + list(self.decode_seqs)
+
+
+StepPlan = Union[PrefillBatch, DecodeBatch, SpecDecodeBatch, MultiStepBatch,
+                 MixedStepBatch]
 
 
 @dataclass
@@ -220,6 +265,22 @@ class SchedulerConfig:
     # can overshoot the stop by up to width-1 tokens per in-flight block.
     # Small lookback bounds that waste while still amortizing the dispatch.
     stop_str_lookback: int = 2
+    # mixed prefill+decode dispatch (DYN_MIXED_BATCH): pack decode rows
+    # into every prefill step as length-1 ragged chunks AND lift the fused
+    # multi-step gate so blocks keep running while arrivals onboard
+    # (plan_multistep no longer refuses on waiters/prefills; chained
+    # blocks still break at boundaries so admission proceeds). False
+    # restores the strict prefill-XOR-decode alternation and the PR 8
+    # "no waiters/prefills" fuse gate.
+    mixed_batch: bool = True
+    # decode-progress guarantee under sustained arrivals: while the
+    # waiting queue never drains, prefill-only steps may run at most
+    # K-1 in a row before a step that advances decode rows is forced
+    # (DYN_DECODE_PROGRESS). With mixed batching on, decode rows ride
+    # every prefill step and the guarantee is trivially met; it binds on
+    # the legacy alternation path, where bursts may prefer prefill for
+    # TTFT. 0 disables the guarantee (strict alternation).
+    decode_progress_every: int = 2
 
 
 class Scheduler:
@@ -254,6 +315,25 @@ class Scheduler:
         # KVBM prefetch scheduler or a concurrent request after THIS
         # sequence was admitted) instead of being recomputed
         self.adopted_blocks = 0
+        # why the fused multi-step path was refused, by reason (waiters,
+        # prefill, penalties, guided, spec, budget, pages, mesh,
+        # multihost): the worker metrics layer surfaces these as
+        # dynamo_worker_multistep_fallback_total{reason=...} so the
+        # "fallback-reason near zero" roadmap criterion is measurable
+        self.multistep_fallbacks: Dict[str, int] = {}
+        # consecutive scheduled steps that advanced NO decode row (the
+        # decode-progress guarantee counter)
+        self._steps_since_decode = 0
+        # mixed-dispatch diagnostics (the engine also counts dispatches)
+        self.mixed_plans = 0
+
+    def record_fallback(self, reason: str, seqs=()) -> None:
+        """Count one fused-path refusal; also stamp the sequences it
+        touched so the trace layer can attribute it."""
+        self.multistep_fallbacks[reason] = (
+            self.multistep_fallbacks.get(reason, 0) + 1)
+        for seq in seqs:
+            seq.multistep_fallbacks += 1
 
     def drain_reaped(self) -> List[Sequence]:
         out, self.reaped = self.reaped, []
@@ -318,6 +398,7 @@ class Scheduler:
                                 misses=len(hashes) - full_cached_pages)
         self.waiting.popleft()
         seq.page_ids = match.page_ids + fresh
+        seq.pages_changed()
         seq.committed_pages = len(match.page_ids)
         seq.num_computed = cached
         if seq.admitted_unix is None:  # keep the FIRST admission (a
@@ -350,6 +431,7 @@ class Scheduler:
         self._commit_full_pages(seq)
         self.alloc.release(seq.page_ids)
         seq.page_ids = []
+        seq.pages_changed()
         seq.phase = Phase.FINISHED
         self.active.pop(seq.request.request_id, None)
 
@@ -362,6 +444,7 @@ class Scheduler:
         self._commit_full_pages(victim)
         self.alloc.release(victim.page_ids)
         victim.page_ids = []
+        victim.pages_changed()
         victim.committed_pages = 0
         victim.num_computed = 0
         victim.phase = Phase.WAITING
@@ -376,6 +459,7 @@ class Scheduler:
         while need > 0:
             try:
                 seq.page_ids.extend(self.alloc.allocate(need))
+                seq.pages_changed()
                 return True
             except OutOfPages:
                 if not self._preempt_one() or seq.phase != Phase.RUNNING:
@@ -413,6 +497,7 @@ class Scheduler:
             self.alloc.incref(page)
             old = seq.page_ids[i]
             seq.page_ids[i] = page
+            seq.pages_changed()
             self.alloc.release([old])  # fresh + uncommitted: frees
             seq.num_computed += self.page_size
             seq.committed_pages = max(seq.committed_pages, i + 1)
@@ -510,8 +595,31 @@ class Scheduler:
             budget -= length
         return PrefillBatch(chunks=chunks) if chunks else None
 
+    def _grow_ready(self, decodable: List[Sequence]) -> List[Sequence]:
+        """Grow pages for the decode rows (may preempt newest RUNNING
+        sequences); returns the rows that survived with pages in place."""
+        ready: List[Sequence] = []
+        for seq in sorted(decodable, key=lambda s: s.arrival):
+            if seq.phase != Phase.RUNNING:
+                continue  # preempted by an earlier grow
+            if self._grow_for_decode(seq):
+                ready.append(seq)
+        return [s for s in ready if s.phase == Phase.RUNNING]
+
     def schedule(self) -> Optional[StepPlan]:
-        """Pick the next engine step, or None if there is nothing to run."""
+        """Pick the next engine step, or None if there is nothing to run.
+
+        With ``mixed_batch`` on (the default), prefill steps carry the
+        decode rows along as length-1 ragged chunks (MixedStepBatch) and
+        the ``_prefer_prefill`` alternation becomes mixed-vs-pure-decode —
+        the pure-decode half is what the loop upgrades to a fused
+        multi-step block, so fused decode stays active while arrivals
+        onboard. With it off, the legacy prefill-XOR-decode alternation
+        applies, except that a deep waiting queue may take up to
+        ``decode_progress_every - 1`` consecutive prefill steps (burst
+        TTFT) before a decode step is forced — the decode-progress
+        guarantee that bounds decode tail latency under sustained
+        arrivals."""
         self._chain_run = 0
         # drop cancelled active sequences
         for seq in [s for s in self.active.values() if s.cancelled]:
@@ -520,24 +628,48 @@ class Scheduler:
 
         decodable = [s for s in self.active.values() if s.phase == Phase.RUNNING]
 
-        if self._prefer_prefill or not decodable:
+        K = self.cfg.decode_progress_every
+        force_decode = bool(decodable and K > 0
+                            and self._steps_since_decode >= K - 1)
+        if not force_decode and (self._prefer_prefill or not decodable):
             batch = self._prefill_plan()
             if batch is not None:
-                self._prefer_prefill = False
-                return batch
+                if (self.cfg.mixed_batch and not batch.ring
+                        and self.cfg.spec_tokens == 0 and decodable):
+                    ready = self._grow_ready(decodable)
+                    # re-filter: growth may have preempted a planned chunk's
+                    # sequence back to WAITING — drop its chunk
+                    chunks = [c for c in batch.chunks
+                              if c.seq.phase is Phase.PREFILL]
+                    if ready and chunks:
+                        self._prefer_prefill = False
+                        self._steps_since_decode = 0
+                        self.mixed_plans += 1
+                        return MixedStepBatch(chunks=chunks,
+                                              decode_seqs=ready)
+                    if not chunks and not ready:
+                        return None
+                    if not chunks:
+                        batch = None  # fall through to the decode plan
+                    else:
+                        batch = PrefillBatch(chunks=chunks)
+                if batch is not None:
+                    # legacy (or decode-less) prefill step; under a deep
+                    # waiting queue keep preferring prefill up to the
+                    # decode-progress bound
+                    self._prefer_prefill = bool(
+                        self.waiting and K > 0
+                        and self._steps_since_decode + 1 < K - 1)
+                    if decodable:
+                        self._steps_since_decode += 1
+                    return batch
         self._prefer_prefill = True
         if not decodable:
             return None
-        # decode: grow pages first (may preempt newest sequences)
-        ready: List[Sequence] = []
-        for seq in sorted(decodable, key=lambda s: s.arrival):
-            if seq.phase != Phase.RUNNING:
-                continue  # preempted by an earlier grow
-            if self._grow_for_decode(seq):
-                ready.append(seq)
-        ready = [s for s in ready if s.phase == Phase.RUNNING]
+        ready = self._grow_ready(decodable)
         if not ready:
             return None
+        self._steps_since_decode = 0
         if self.cfg.spec_tokens > 0:
             spec = self._spec_plan(ready)
             if spec is not None:
@@ -606,6 +738,7 @@ class Scheduler:
             if need > 0:
                 try:
                     seq.page_ids.extend(self.alloc.allocate(need))
+                    seq.pages_changed()
                 except OutOfPages:
                     return None
         return SpecDecodeBatch(seqs=list(ready), drafts=drafts, has_draft=has)
@@ -716,6 +849,7 @@ class Scheduler:
             if need > 0:
                 try:
                     seq.page_ids.extend(self.alloc.allocate(need))
+                    seq.pages_changed()
                 except OutOfPages:
                     return None
         self._chain_run += 1
@@ -751,6 +885,7 @@ class Scheduler:
             if need > 0:
                 try:
                     seq.page_ids.extend(self.alloc.allocate(need))
+                    seq.pages_changed()
                 except OutOfPages:
                     return False
         return True
@@ -771,13 +906,19 @@ class Scheduler:
         and ineligible sampling (penalties/bias/guided) refuse entirely.
         """
         cap = self.cfg.decode_multistep
-        if cap < 2 or self.cfg.spec_tokens > 0:
+        if cap < 2:
+            return None
+        if self.cfg.spec_tokens > 0:
+            self.record_fallback("spec", seqs)
             return None
         w = cap
         budgets: List[int] = []
         min_gates: List[int] = []
         for seq, sl in zip(seqs, start_lens):
             if not self._fuse_eligible(seq):
+                self.record_fallback(
+                    "guided" if seq.request.sampling_options.guided
+                    else "penalties", seqs)
                 return None
             sc = seq.request.stop_conditions
             gen_eff = len(seq.generated) + (sl - len(seq))
@@ -788,6 +929,7 @@ class Scheduler:
             if self.max_context_hint is not None:
                 rem = min(rem, self.max_context_hint - sl)
             if rem < 2:
+                self.record_fallback("budget", seqs)
                 return None
             w = min(w, rem)
             if sc.stop:
@@ -798,6 +940,7 @@ class Scheduler:
         while w >= 2 and not self._grow_for_block(seqs, start_lens, w):
             w //= 2
         if w < 2:
+            self.record_fallback("pages", seqs)
             return None
         return MultiStepBatch(seqs=list(seqs), width=w, chained=chained,
                               start_lens=list(start_lens), budgets=budgets,
@@ -806,14 +949,21 @@ class Scheduler:
     def plan_multistep(self, batch: DecodeBatch) -> Optional[MultiStepBatch]:
         """Try to upgrade a planned decode step into a fused block.
 
-        Refused when anything is waiting or prefilling: a fused block
-        holds the engine for ``width`` steps, and head-of-line blocking a
-        new prompt's admission behind it would regress TTFT — the very
-        tradeoff ``plan_chained`` already refuses one step at a time."""
-        if self.waiting:
-            return None
-        if any(s.phase is Phase.PREFILL for s in self.active.values()):
-            return None
+        With ``mixed_batch`` on (the default), the PR 8 "no waiters /
+        prefills" gate is LIFTED: arrivals onboard through the mixed
+        steps that alternate with the fused blocks, so fusing while they
+        wait no longer head-of-line blocks admission for more than one
+        block (chained blocks still break at boundaries —
+        ``plan_multistep_chained`` keeps the refusal). With it off, the
+        legacy gate applies and the refusal is recorded as a fallback
+        reason."""
+        if not self.cfg.mixed_batch:
+            if self.waiting:
+                self.record_fallback("waiters", batch.seqs)
+                return None
+            if any(s.phase is Phase.PREFILL for s in self.active.values()):
+                self.record_fallback("prefill", batch.seqs)
+                return None
         return self._plan_block(batch.seqs, [len(s) for s in batch.seqs],
                                 chained=False)
 
@@ -826,7 +976,11 @@ class Scheduler:
         budgets are computed from that offset, and the device carry
         supplies the actual first token / liveness. Refused when the batch
         may change (waiting/prefilling arrivals, any row finished or
-        cancelled per host knowledge)."""
+        cancelled per host knowledge). Unlike ``plan_multistep``, the
+        waiting/prefilling refusals survive the mixed-batch gate lift ON
+        PURPOSE: a chain break here is the block boundary where arrivals
+        get their admission/prefill (mixed) step — it is not a fallback
+        to per-step decode and is not counted as one."""
         if self.waiting:
             return None
         for seq in prev.seqs:
@@ -862,12 +1016,15 @@ class Scheduler:
 
     def on_step_done(self, plan: StepPlan) -> None:
         """Advance accounting after the engine ran the planned step."""
-        if isinstance(plan, PrefillBatch):
+        if isinstance(plan, (PrefillBatch, MixedStepBatch)):
             for chunk in plan.chunks:
                 seq = chunk.seq
                 seq.num_computed += chunk.length
                 if chunk.is_last:
                     seq.phase = Phase.RUNNING
+                self._commit_full_pages(seq)
+            for seq in getattr(plan, "decode_seqs", ()):
+                seq.num_computed += 1
                 self._commit_full_pages(seq)
         else:
             for seq in plan.seqs:
@@ -900,4 +1057,4 @@ class Scheduler:
 
 __all__ = ["Scheduler", "SchedulerConfig", "Sequence", "Phase",
            "PrefillChunk", "PrefillBatch", "DecodeBatch", "SpecDecodeBatch",
-           "MultiStepBatch"]
+           "MultiStepBatch", "MixedStepBatch"]

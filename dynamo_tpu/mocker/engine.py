@@ -25,7 +25,11 @@ from typing import Tuple
 import numpy as np
 
 from dynamo_tpu.engine.loop import ScheduledEngineBase
-from dynamo_tpu.engine.scheduler import PrefillBatch, StepPlan
+from dynamo_tpu.engine.scheduler import (
+    MixedStepBatch,
+    PrefillBatch,
+    StepPlan,
+)
 
 
 @dataclass
@@ -53,6 +57,12 @@ class MockEngineArgs:
     # for ``width`` tokens — exactly the amortization the fused dispatch
     # models. 1 disables.
     decode_multistep: int = 8
+    # mixed prefill+decode dispatch: the mocker executes MixedStepBatch
+    # plans (prefill chunks + decode rows in ONE step paying one shared
+    # base cost) so pipeline/disagg tests exercise the mixed path without
+    # a TPU, matching the real engine's scheduling
+    mixed_batch: bool = True
+    decode_progress_every: int = 2
 
 
 class MockerEngine(ScheduledEngineBase):
@@ -64,10 +74,13 @@ class MockerEngine(ScheduledEngineBase):
                          max_prefill_chunk=a.max_prefill_chunk,
                          max_context=a.max_context,
                          max_prefill_seqs=a.max_prefill_seqs,
-                         decode_multistep=a.decode_multistep)
+                         decode_multistep=a.decode_multistep,
+                         mixed_batch=a.mixed_batch,
+                         decode_progress_every=a.decode_progress_every)
         self._rng = np.random.default_rng(0)
         self.decode_dispatches = 0
         self.multistep_blocks = 0
+        self.mixed_steps = 0
 
     def _simulate(self, seconds: float) -> None:
         if self.args.speedup_ratio > 0:
@@ -83,12 +96,17 @@ class MockerEngine(ScheduledEngineBase):
 
     def _execute_plan(self, plan: StepPlan) -> Tuple[np.ndarray, np.ndarray]:
         a = self.args
-        if isinstance(plan, PrefillBatch):
+        if isinstance(plan, (PrefillBatch, MixedStepBatch)):
             # one shared step base + per-chunk token/attention costs: chunks
             # batched into one step amortize the launch overhead, which is
-            # exactly the benefit batched prefill exists to model
-            cost = a.prefill_base_s
-            toks = np.empty(len(plan.chunks), np.int64)
+            # exactly the benefit batched prefill exists to model. A mixed
+            # plan's decode rows ride the SAME base (that amortization is
+            # what the mixed dispatch exists to model) and pay only their
+            # per-sequence decode cost.
+            decode_seqs = list(getattr(plan, "decode_seqs", ()))
+            cost = a.prefill_base_s + len(decode_seqs) * a.decode_per_seq_s
+            n = len(plan.chunks) + len(decode_seqs)
+            toks = np.empty(n, np.int64)
             for i, c in enumerate(plan.chunks):
                 cost += (c.length * a.prefill_per_token_s
                          + c.length * c.start * a.prefill_attn_quadratic_s)
@@ -96,8 +114,15 @@ class MockerEngine(ScheduledEngineBase):
                 so = seq.request.sampling_options
                 toks[i] = self._token_for(seq.request.request_id, len(seq),
                                           so.temperature or 0.0)
+            for j, seq in enumerate(decode_seqs, start=len(plan.chunks)):
+                so = seq.request.sampling_options
+                toks[j] = self._token_for(seq.request.request_id, len(seq),
+                                          so.temperature or 0.0)
             self._simulate(cost)
-            return toks, np.full(len(plan.chunks), -1.0, np.float32), None
+            if decode_seqs:
+                self.decode_dispatches += 1
+                self.mixed_steps += 1
+            return toks, np.full(n, -1.0, np.float32), None
         b = len(plan.seqs)
         self._simulate(a.decode_base_s + b * a.decode_per_seq_s)
         self.decode_dispatches += 1

@@ -229,6 +229,69 @@ def _gathered_to_bhtd(g: jnp.ndarray) -> jnp.ndarray:
     return g.transpose(0, 2, 1, 3, 4).reshape(B, Hkv, P * ps, Dh)
 
 
+def ragged_paged_attention(q: jnp.ndarray, pages: jnp.ndarray, layer_idx,
+                           page_table: jnp.ndarray, q_starts: jnp.ndarray,
+                           q_lens: jnp.ndarray, kv_lens: jnp.ndarray,
+                           sm_scale: float, window=None,
+                           softcap=None) -> jnp.ndarray:
+    """Ragged paged attention over a FLATTENED mixed batch — the reference
+    lowering of the kernel shape continuous batching needs (Ragged Paged
+    Attention, PAPERS.md): one dispatch where each row contributes an
+    arbitrary number of query tokens (a prefill chunk, or a single decode
+    token) against its own paged KV context.
+
+    q:          [T, Hq, Dh] — every row's query tokens packed back to back
+                (row i occupies ``q_starts[i] .. q_starts[i]+q_lens[i]``);
+                slots past the last row's end are pad.
+    pages:      [L, N, 2, Hkv, page_size, Dh] stacked cache
+    page_table: [B, P] per-ROW page table
+    q_starts:   [B] row offsets into the flat axis (ascending, packed)
+    q_lens:     [B] real query tokens per row (a decode row is 1)
+    kv_lens:    [B] total context per row INCLUDING its new tokens — row
+                i's token j sits at absolute position
+                ``kv_lens[i] - q_lens[i] + j``
+    returns     [T, Hq, Dh]; pad slots are zeroed.
+
+    Built on the same blockwise online-softmax machinery as the chunked
+    paths (``_attend_blockwise``): each flat token attends to its row's
+    pages as a [T, 1]-query batch, so peak intermediates stay bounded by
+    the chunk span regardless of context length. The Pallas kernel
+    (``ops/pallas/ragged.py``) fuses the per-token gather away on TPU;
+    this is the portable reference and the CPU-test oracle.
+    """
+    T, Hq, Dh = q.shape
+    B, P = page_table.shape
+    Hkv = pages.shape[3]
+    ps = pages.shape[4]
+    t_idx = jnp.arange(T)
+    ends = q_starts + q_lens
+    # packed rows: token t belongs to the first row whose end exceeds t
+    row = jnp.sum(t_idx[:, None] >= ends[None, :], axis=1)
+    row = jnp.minimum(row, B - 1)
+    valid = (t_idx >= q_starts[row]) & (t_idx < ends[row])
+    pos = kv_lens[row] - q_lens[row] + (t_idx - q_starts[row])
+    pos = jnp.where(valid, pos, 0)
+    # pad tokens attend the garbage page with a 1-token context: finite
+    # work, masked result discarded below
+    tok_table = jnp.where(valid[:, None], page_table[row], 0)
+    tok_total = jnp.where(valid, kv_lens[row], 1)
+    qg = q.reshape(T, 1, Hkv, Hq // Hkv, Dh)
+    chunk_pages = min(PAGES_PER_CHUNK, P)
+    table = _pad_table(tok_table, chunk_pages)
+
+    def gather_chunk(c):
+        tbl = jax.lax.dynamic_slice(
+            table, (0, c * chunk_pages), (T, chunk_pages))
+        g = pages[layer_idx, tbl]          # [T, C, 2, Hkv, ps, Dh]
+        return _gathered_to_bhtd(g[:, :, 0]), _gathered_to_bhtd(g[:, :, 1])
+
+    out = _attend_blockwise(qg, gather_chunk, P, ps, chunk_pages,
+                            pos[:, None], tok_total, sm_scale,
+                            window=window, softcap=softcap)
+    out = out.reshape(T, Hq, Dh)
+    return jnp.where(valid[:, None, None], out, 0.0).astype(q.dtype)
+
+
 def paged_attention_layer(q: jnp.ndarray, kv_layer: jnp.ndarray,
                           page_table: jnp.ndarray, positions: jnp.ndarray,
                           total_lens: jnp.ndarray, sm_scale: float,
@@ -309,5 +372,6 @@ def paged_attention(q: jnp.ndarray, pages: jnp.ndarray, layer_idx,
 
 
 __all__ = ["write_kv", "write_kv_layer", "paged_attention",
-           "paged_attention_layer", "merge_softmax_partials",
-           "normalize_softmax_partials", "NEG_INF"]
+           "paged_attention_layer", "ragged_paged_attention",
+           "merge_softmax_partials", "normalize_softmax_partials",
+           "NEG_INF"]

@@ -75,6 +75,19 @@ class RuntimeConfig:
     # fused path entirely (per-step pipelined decode still applies)
     decode_multistep: int = 8
 
+    # -- mixed prefill+decode dispatch -----------------------------------
+    # pack decode rows into prefill steps as length-1 ragged chunks (one
+    # token-budgeted [B, S] dispatch) and lift the fused-multistep
+    # "no waiters/prefills" gate so blocks keep running while arrivals
+    # onboard (short-form env DYN_MIXED_BATCH wins; see
+    # engine/jax_engine.py). False restores the strict prefill-XOR-decode
+    # alternation and the old fuse gate.
+    mixed_batch: bool = True
+    # decode-progress guarantee on the legacy alternation path: at most
+    # K-1 consecutive prefill-only steps while decode rows exist
+    # (short-form env DYN_DECODE_PROGRESS wins); 0 disables
+    decode_progress_every: int = 2
+
     @classmethod
     def load(cls, path: Optional[str] = None,
              env: Optional[Dict[str, str]] = None) -> "RuntimeConfig":

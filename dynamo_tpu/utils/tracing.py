@@ -527,6 +527,12 @@ class StageStitcher:
             self.decode_attrs = {
                 "steps": int(timings["decode_steps"]),
                 "dispatches": int(timings["decode_dispatches"])}
+            if "multistep_fallbacks" in timings:
+                # fused-decode refusals that touched this request (the
+                # per-reason breakdown lives on the worker counter
+                # dynamo_worker_multistep_fallback_total{reason})
+                self.decode_attrs["multistep_fallbacks"] = int(
+                    timings["multistep_fallbacks"])
         if self.first_unix is not None:
             return
         if not timings:

@@ -121,13 +121,23 @@ class EngineDispatchCollector:
 
     COUNTERS: Dict[str, str] = {
         "decode_dispatches": "Decode-family jitted dispatches (per-step, "
-                             "chained, spec-verify, and fused multi-step "
-                             "blocks each count ONE) — with fusion on, M "
-                             "decoded tokens cost ~M/width dispatches",
+                             "chained, spec-verify, mixed, and fused "
+                             "multi-step blocks each count ONE) — with "
+                             "fusion on, M decoded tokens cost ~M/width "
+                             "dispatches",
         "decode_multistep_blocks": "Fused multi-step decode blocks "
                                    "dispatched (DYN_DECODE_MULTISTEP steps "
                                    "per block before scheduler narrowing)",
+        "mixed_dispatches": "Mixed prefill+decode dispatches (prefill "
+                            "chunks and decode rows advanced in ONE "
+                            "ragged [B, S] step, DYN_MIXED_BATCH)",
     }
+
+    # the known fallback reasons, pre-seeded so every label shows on the
+    # scrape at 0 and dashboards/alerts can reference them before the
+    # first refusal happens
+    FALLBACK_REASONS = ("waiters", "prefill", "penalties", "guided",
+                        "spec", "budget", "pages", "mesh", "multihost")
 
     def __init__(self, registry: CollectorRegistry):
         self._source: Optional[Callable[[], Dict[str, float]]] = None
@@ -149,16 +159,34 @@ class EngineDispatchCollector:
         for key, help_text in self.COUNTERS.items():
             yield CounterMetricFamily(f"dynamo_worker_{key}", help_text,
                                       value=float(stats.get(key, 0)))
+        # why the fused multi-step path was refused, by reason — the
+        # ROADMAP "fallback-reason near zero" criterion, measurable
+        fb = CounterMetricFamily(
+            "dynamo_worker_multistep_fallback",
+            "Fused multi-step decode refusals by reason (waiters/prefill "
+            "only with DYN_MIXED_BATCH=0; penalties/guided/spec/budget/"
+            "pages from the block planner; mesh/multihost from the "
+            "engine mode)", labels=["reason"])
+        reasons = dict.fromkeys(self.FALLBACK_REASONS, 0.0)
+        reasons.update(stats.get("multistep_fallbacks") or {})
+        for reason, value in sorted(reasons.items()):
+            fb.add_metric([str(reason)], float(value))
+        yield fb
 
 
-def engine_dispatch_stats(engine) -> Dict[str, float]:
+def engine_dispatch_stats(engine) -> Dict[str, object]:
     """The ``EngineDispatchCollector.attach`` source for a
     ``ScheduledEngineBase`` engine (JaxEngine and the mocker both carry
-    the counters)."""
+    the counters). Values are floats, except ``multistep_fallbacks``:
+    a per-reason count dict the collector renders as a labeled family."""
+    sched = getattr(engine, "scheduler", None)
     return {
         "decode_dispatches": float(getattr(engine, "decode_dispatches", 0)),
         "decode_multistep_blocks": float(
             getattr(engine, "multistep_blocks", 0)),
+        "mixed_dispatches": float(getattr(engine, "mixed_steps", 0)),
+        "multistep_fallbacks": dict(
+            getattr(sched, "multistep_fallbacks", None) or {}),
     }
 
 

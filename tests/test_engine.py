@@ -125,11 +125,14 @@ def make_req(tokens, rid="r1", max_tokens=8, **kw):
 def advance(sched, plan):
     """on_step_done + the token append the engine would do for last chunks."""
     sched.on_step_done(plan)
-    if isinstance(plan, PrefillBatch):
+    if hasattr(plan, "chunks"):
         for c in plan.chunks:
             if c.is_last:
                 c.seq.tokens.append(9)
                 c.seq.generated.append(9)
+        for s in getattr(plan, "decode_seqs", ()):
+            s.tokens.append(9)
+            s.generated.append(9)
     else:
         for s in plan.seqs:
             s.tokens.append(9)
@@ -162,7 +165,9 @@ class TestScheduler:
         assert isinstance(d, DecodeBatch) and d.seqs == [seq]
 
     def test_prefill_decode_alternation(self):
-        sched, _ = self.make()
+        # the legacy split path (mixed_batch=False): strict alternation.
+        # Mixed-dispatch scheduling is covered in test_mixed_batch.py.
+        sched, _ = self.make(mixed_batch=False)
         sched.add_request(make_req(range(1, 5), "a"))
         advance(sched, sched.schedule())
         sched.add_request(make_req(range(1, 5), "b"))
@@ -188,14 +193,18 @@ class TestScheduler:
         d = sched.schedule()
         assert isinstance(d, DecodeBatch) and len(d.seqs) == 2
         advance(sched, d)
+        # with mixed dispatch (default) the remaining two prefill chunks
+        # ride ONE step together with the running decode rows
         p2 = sched.schedule()
-        assert isinstance(p2, PrefillBatch) and len(p2.chunks) == 2
+        assert len(p2.chunks) == 2
         assert {c.seq.request.request_id for c in p2.chunks} == {"s2", "s3"}
 
     def test_decode_cadence_bounded_during_long_prefill(self):
-        """A long prompt arriving must not starve running decodes: prefill
-        chunks and decode steps alternate one-for-one."""
-        sched, _ = self.make()
+        """A long prompt arriving must not starve running decodes: on the
+        legacy split path, prefill chunks and decode steps alternate
+        one-for-one (mixed dispatch advances both per step instead —
+        test_mixed_batch.py)."""
+        sched, _ = self.make(mixed_batch=False)
         sched.add_request(make_req(range(1, 5), "short"))
         advance(sched, sched.schedule())  # short is RUNNING
         sched.add_request(make_req(range(100, 124), "long"))  # 24 tok = 3 chunks

@@ -1,0 +1,538 @@
+"""Ragged mixed-batch attention + mixed prefill/decode dispatch (ISSUE 11).
+
+The contract under test: with DYN_MIXED_BATCH on, prefill chunks and
+decode rows advance in ONE token-budgeted dispatch
+(``engine/scheduler.MixedStepBatch``), fused multi-step decode keeps
+running while arrivals onboard (the PR 8 "no waiters/prefills" gate is
+lifted), and the token streams stay BIT-IDENTICAL to the legacy
+prefill-XOR-decode alternation under greedy and fixed-seed sampling.
+The ragged attention op (``ops.attention.ragged_paged_attention`` flat
+reference + ``ops/pallas/ragged.py`` kernel) matches the dense per-row
+oracle on ragged row shapes.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine.jax_engine import JaxEngine, JaxEngineConfig
+from dynamo_tpu.engine.pages import PageAllocator
+from dynamo_tpu.engine.scheduler import (
+    DecodeBatch,
+    MixedStepBatch,
+    Phase,
+    PrefillBatch,
+    Scheduler,
+    SchedulerConfig,
+)
+from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.protocols.common import (
+    FinishReason,
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+
+
+def make_req(tokens, rid="r1", max_tokens=8, eos=(), samp=None, **stop_kw):
+    return PreprocessedRequest(
+        token_ids=list(tokens), request_id=rid,
+        stop_conditions=StopConditions(max_tokens=max_tokens, **stop_kw),
+        sampling_options=samp or SamplingOptions(temperature=0.0),
+        eos_token_ids=list(eos))
+
+
+def tiny_engine(**kw):
+    cfg = ModelConfig.tiny()
+    defaults = dict(num_pages=64, page_size=4, max_num_seqs=4,
+                    max_prefill_chunk=16, max_context=64,
+                    min_prefill_bucket=4, decode_multistep=8)
+    defaults.update(kw)
+    return JaxEngine.random_init(cfg, JaxEngineConfig(**defaults))
+
+
+async def collect(engine, req, ctx=None):
+    frames = []
+    async for out in engine.generate(req, ctx=ctx):
+        frames.append(out)
+    return frames
+
+
+def toks_of(frames):
+    return [t for f in frames for t in f.token_ids]
+
+
+# -- ragged attention numerics -------------------------------------------
+
+
+class TestRaggedOp:
+    """The flat-layout reference op vs the dense per-row oracle."""
+
+    def _setup(self, seed=0):
+        import jax.numpy as jnp
+        rng = np.random.default_rng(seed)
+        L, N, Hkv, ps, Dh, Hq, P = 2, 32, 2, 8, 128, 4, 12
+        pages = jnp.asarray(
+            rng.normal(size=(L, N, 2, Hkv, ps, Dh)).astype(np.float32))
+        table = jnp.asarray(rng.integers(1, N, size=(3, P)).astype(np.int32))
+        # ragged rows: a mid-prompt chunk, a decode step, a fresh chunk
+        q_lens = np.array([7, 1, 5], np.int32)
+        kv_lens = np.array([23, 9, 5], np.int32)
+        q_starts = np.concatenate([[0], np.cumsum(q_lens)[:-1]]) \
+            .astype(np.int32)
+        T = int(q_lens.sum()) + 3       # tail padding
+        q = jnp.asarray(rng.normal(size=(T, Hq, Dh)).astype(np.float32))
+        return pages, table, q, q_starts, q_lens, kv_lens
+
+    def test_flat_ragged_matches_dense_per_row(self):
+        import jax.numpy as jnp
+
+        from dynamo_tpu.ops.attention import (paged_attention,
+                                              ragged_paged_attention)
+        pages, table, q, q_starts, q_lens, kv_lens = self._setup()
+        out = ragged_paged_attention(
+            q, pages, 1, table, jnp.asarray(q_starts), jnp.asarray(q_lens),
+            jnp.asarray(kv_lens), 0.09)
+        for i in range(3):
+            s, ln, kv = int(q_starts[i]), int(q_lens[i]), int(kv_lens[i])
+            pos = jnp.arange(kv - ln, kv)[None]
+            ref = paged_attention(q[s:s + ln][None], pages, 1,
+                                  table[i:i + 1], pos,
+                                  jnp.asarray([kv], jnp.int32), 0.09)[0]
+            assert float(jnp.max(jnp.abs(out[s:s + ln] - ref))) < 2e-5
+        # pad slots are zeroed, not garbage
+        assert float(jnp.max(jnp.abs(out[int(q_lens.sum()):]))) == 0.0
+
+    def test_pallas_ragged_kernel_matches_xla_reference(self):
+        import jax.numpy as jnp
+
+        from dynamo_tpu.ops.attention import paged_attention
+        from dynamo_tpu.ops.pallas.ragged import (
+            ragged_mixed_attention_stacked)
+        pages, table, q, q_starts, q_lens, kv_lens = self._setup()
+        pages = pages.astype(jnp.bfloat16)
+        # S wider than the 128-row query block so the decode row's tail
+        # blocks are genuinely SKIPPED (the ragged win under test)
+        B, S = 3, 256
+        Hq, Dh = q.shape[1], q.shape[2]
+        qb = jnp.zeros((B, S, Hq, Dh), jnp.bfloat16)
+        positions = np.zeros((B, S), np.int32)
+        for i in range(B):
+            s, ln, kv = int(q_starts[i]), int(q_lens[i]), int(kv_lens[i])
+            qb = qb.at[i, :ln].set(q[s:s + ln].astype(jnp.bfloat16))
+            positions[i, :ln] = np.arange(kv - ln, kv)
+        out = ragged_mixed_attention_stacked(
+            qb, pages, 1, table, jnp.asarray(positions),
+            jnp.asarray(kv_lens), 0.09, interpret=True)
+        ref = paged_attention(qb, pages, 1, table, jnp.asarray(positions),
+                              jnp.asarray(kv_lens), 0.09)
+        for i in range(B):
+            ln = int(q_lens[i])
+            err = float(jnp.max(jnp.abs(
+                out[i, :ln].astype(jnp.float32)
+                - ref[i, :ln].astype(jnp.float32))))
+            assert err < 0.05, (i, err)
+        # blocks wholly past a row's q_len are skipped and write zeros
+        # (within-block pad slots compute masked garbage — never read)
+        assert float(jnp.max(jnp.abs(
+            out[1, 128:].astype(jnp.float32)))) == 0.0
+
+
+# -- engine parity: mixed dispatch vs legacy alternation ------------------
+
+
+class TestMixedParity:
+    """Mixed-dispatch token streams must be bit-identical to the legacy
+    split path: tokens depend on each row's own context, greedy argmax
+    and position-keyed seeded draws see identical logits either way."""
+
+    async def _run(self, mixed: bool, samp=None):
+        eng = tiny_engine(mixed_batch=mixed)
+        try:
+            first_started = asyncio.Event()
+
+            async def staggered(req):
+                # deterministic overlap: the second/third requests arrive
+                # once the first has tokens flowing (decode + prefill
+                # genuinely contend, without wall-clock sleeps)
+                await first_started.wait()
+                return await collect(eng, req)
+
+            async def leader(req):
+                frames = []
+                async for out in eng.generate(req):
+                    frames.append(out)
+                    if sum(len(f.token_ids) for f in frames) >= 2:
+                        first_started.set()
+                first_started.set()
+                return frames
+
+            reqs = [make_req([1, 2, 3, 4, 5], "m0", max_tokens=18,
+                             samp=samp() if samp else None),
+                    make_req([9, 8, 7, 6, 5, 4, 3, 2, 1] * 2, "m1",
+                             max_tokens=11, samp=samp() if samp else None),
+                    make_req([5, 5, 5, 5], "m2", max_tokens=6,
+                             samp=samp() if samp else None)]
+            results = await asyncio.gather(
+                leader(reqs[0]), staggered(reqs[1]), staggered(reqs[2]))
+            return ([toks_of(f) for f in results],
+                    [f[-1].finish_reason for f in results],
+                    {"mixed_steps": eng.mixed_steps,
+                     "blocks": eng.multistep_blocks})
+        finally:
+            await eng.stop()
+
+    async def test_greedy_parity(self):
+        m_toks, m_r, mc = await self._run(True)
+        l_toks, l_r, lc = await self._run(False)
+        assert mc["mixed_steps"] > 0       # the mixed path actually ran
+        assert lc["mixed_steps"] == 0
+        assert m_toks == l_toks
+        assert m_r == l_r
+        assert [len(t) for t in m_toks] == [18, 11, 6]
+
+    async def test_seeded_parity(self):
+        def samp():
+            return SamplingOptions(temperature=0.9, seed=1234)
+
+        m_toks, _mr, mc = await self._run(True, samp)
+        l_toks, _lr, _lc = await self._run(False, samp)
+        assert mc["mixed_steps"] > 0
+        assert m_toks == l_toks
+
+    async def test_fused_blocks_active_while_arrivals_onboard(self):
+        # the acceptance gate of the lifted multistep gate: fused blocks
+        # AND mixed dispatches both run in one overlapping-arrival session
+        _toks, _r, c = await self._run(True)
+        assert c["blocks"] > 0 and c["mixed_steps"] > 0
+
+    async def test_prefill_finishes_mid_mixed_step_emits_first_token(self):
+        eng = tiny_engine(mixed_batch=True)
+        try:
+            started = asyncio.Event()
+
+            async def leader():
+                frames = []
+                async for out in eng.generate(
+                        make_req([1, 2, 3], "lead", max_tokens=30)):
+                    frames.append(out)
+                    started.set()
+                return frames
+
+            async def follower():
+                await started.wait()
+                # one-chunk prompt: its final (only) chunk lands inside a
+                # mixed step while "lead" decodes — the first token must
+                # be emitted from that same dispatch
+                frames = await collect(
+                    eng, make_req([4, 5, 6, 7], "foll", max_tokens=5))
+                return frames
+
+            lead, foll = await asyncio.gather(leader(), follower())
+            assert len(toks_of(foll)) == 5
+            assert len(toks_of(lead)) == 30
+            assert eng.mixed_steps > 0
+        finally:
+            await eng.stop()
+
+    async def test_cancel_mid_prefill_reclaims_pages(self):
+        class Ctx:
+            cancelled = False
+
+        eng = tiny_engine(mixed_batch=True, max_prefill_chunk=4,
+                          max_context=64)
+        free0 = eng.allocator.num_free
+        try:
+            started = asyncio.Event()
+
+            async def leader():
+                frames = []
+                async for out in eng.generate(
+                        make_req([1, 2, 3], "ld", max_tokens=24)):
+                    frames.append(out)
+                    started.set()
+                return frames
+
+            async def victim():
+                await started.wait()
+                ctx = Ctx()
+                ctx.cancelled = True    # cancelled while chunks in flight
+                return await collect(
+                    eng, make_req(list(range(1, 30)), "vt", max_tokens=8),
+                    ctx=ctx)
+
+            lead, vic = await asyncio.gather(leader(), victim())
+            assert vic[-1].finish_reason == FinishReason.CANCELLED
+            assert len(toks_of(lead)) == 24
+            for _ in range(100):
+                if eng.allocator.num_free == free0:
+                    break
+                await asyncio.sleep(0.02)
+            assert eng.allocator.num_free == free0
+        finally:
+            await eng.stop()
+
+
+# -- scheduler unit tests -------------------------------------------------
+
+
+class TestMixedScheduling:
+    def make(self, num_pages=33, page_size=4, **cfg):
+        alloc = PageAllocator(num_pages, page_size)
+        base = dict(max_num_seqs=4, max_prefill_chunk=8,
+                    decode_multistep=8)
+        base.update(cfg)
+        s = Scheduler(alloc, SchedulerConfig(**base))
+        s.max_context_hint = 128
+        return s, alloc
+
+    def to_running(self, sched, req):
+        sched.add_request(req)
+        while True:
+            plan = sched.schedule()
+            assert plan is not None
+            sched.on_step_done(plan)
+            seqs = plan.seqs
+            seq = seqs[-1]
+            for s in seqs:
+                if s.phase is Phase.RUNNING and not s.generated:
+                    s.tokens.append(9)
+                    s.generated.append(9)
+            if all(s.phase is Phase.RUNNING for s in sched.active.values()):
+                return seq
+
+    def _advance(self, sched, plan):
+        """Resolve one plan the way the engine loop would: accounting,
+        then append a token for every row that sampled one."""
+        sched.on_step_done(plan)
+        sampled = []
+        if isinstance(plan, (PrefillBatch, MixedStepBatch)):
+            sampled += [c.seq for c in plan.chunks if c.is_last]
+            sampled += list(getattr(plan, "decode_seqs", ()))
+        elif isinstance(plan, DecodeBatch):
+            sampled += plan.seqs
+        for s in sampled:
+            if s.phase is Phase.RUNNING:
+                s.tokens.append(9)
+                s.generated.append(9)
+
+    def test_mixed_plan_packs_chunks_and_decode_rows(self):
+        sched, _ = self.make()
+        running = self.to_running(sched, make_req(range(1, 6), "a",
+                                                  max_tokens=32))
+        sched.add_request(make_req(range(20, 31), "b", max_tokens=8))
+        # the alternation's decode half comes first after to_running's
+        # prefill step; the NEXT plan must be the mixed step
+        plan = sched.schedule()
+        if isinstance(plan, DecodeBatch):
+            self._advance(sched, plan)
+            plan = sched.schedule()
+        assert isinstance(plan, MixedStepBatch)
+        assert [c.seq.request.request_id for c in plan.chunks] == ["b"]
+        assert plan.decode_seqs == [running]
+        # token budget honored by the chunk packing
+        assert sum(c.length for c in plan.chunks) <= 8
+        n0 = running.num_computed
+        sched.on_step_done(plan)
+        assert running.num_computed == n0 + 1        # decode row advanced
+        assert plan.chunks[0].seq.num_computed == 8  # chunk advanced
+
+    def test_mixed_alternates_with_pure_decode(self):
+        # while a multi-chunk prefill is in flight, plans alternate
+        # mixed / pure-decode — the pure half is what fuses
+        sched, _ = self.make()
+        self.to_running(sched, make_req(range(1, 6), "a", max_tokens=64))
+        sched.add_request(make_req(range(1, 30), "b", max_tokens=8))
+        kinds = []
+        for _ in range(4):
+            plan = sched.schedule()
+            kinds.append(type(plan).__name__)
+            self._advance(sched, plan)
+        assert "MixedStepBatch" in kinds[:2]
+        assert "DecodeBatch" in kinds[:2]
+
+    def test_spec_mode_disables_mixed(self):
+        sched, _ = self.make(spec_tokens=4)
+        self.to_running(sched, make_req(range(1, 6), "a", max_tokens=32))
+        sched.add_request(make_req(range(1, 6), "b", max_tokens=8))
+        plan = sched.schedule()
+        assert not isinstance(plan, MixedStepBatch)
+
+    def test_decode_progress_guarantee_legacy(self):
+        # legacy alternation + deep waiting queue + K=3: at most 2
+        # consecutive decode-free plans while decode rows exist
+        sched, _ = self.make(mixed_batch=False, decode_progress_every=3,
+                             max_prefill_seqs=1, max_num_seqs=8,
+                             num_pages=257)
+        self.to_running(sched, make_req(range(1, 6), "a", max_tokens=1000))
+        for i in range(8):
+            sched.add_request(make_req(range(1, 20), f"w{i}",
+                                       max_tokens=1000))
+        streak, max_streak = 0, 0
+        for _ in range(24):
+            plan = sched.schedule()
+            if plan is None:
+                break
+            self._advance(sched, plan)
+            if isinstance(plan, (DecodeBatch, MixedStepBatch)):
+                streak = 0
+            else:
+                streak += 1
+                max_streak = max(max_streak, streak)
+        assert max_streak == 2          # the K-1 bound held, AND
+        #                                 consecutive prefills DID happen
+        #                                 (burst TTFT preference)
+
+    def test_decode_progress_default_keeps_alternation(self):
+        sched, _ = self.make(mixed_batch=False, max_prefill_seqs=1,
+                             max_num_seqs=8, num_pages=257)
+        self.to_running(sched, make_req(range(1, 6), "a", max_tokens=1000))
+        for i in range(6):
+            sched.add_request(make_req(range(1, 20), f"w{i}",
+                                       max_tokens=1000))
+        kinds = []
+        for _ in range(6):
+            plan = sched.schedule()
+            assert plan is not None
+            self._advance(sched, plan)
+            kinds.append("D" if isinstance(plan, DecodeBatch) else "P")
+        assert "".join(kinds).count("PP") == 0   # strict alternation
+
+    def test_fallback_reasons_recorded(self):
+        sched, _ = self.make()
+        r = make_req(range(1, 6), "p", max_tokens=32,
+                     samp=SamplingOptions(temperature=0.0,
+                                          frequency_penalty=1.0))
+        seq = self.to_running(sched, r)
+        d = sched.schedule()
+        assert isinstance(d, DecodeBatch)
+        assert sched.plan_multistep(d) is None
+        assert sched.multistep_fallbacks == {"penalties": 1}
+        assert seq.multistep_fallbacks == 1
+
+        sched2, _ = self.make()
+        r2 = make_req(range(1, 6), "g", max_tokens=32,
+                      samp=SamplingOptions(temperature=0.0,
+                                           guided={"mode": "json"}))
+        self.to_running(sched2, r2)
+        assert sched2.plan_multistep(sched2.schedule()) is None
+        assert sched2.multistep_fallbacks == {"guided": 1}
+
+
+# -- metrics surface ------------------------------------------------------
+
+
+class TestMetricsSurface:
+    async def test_engine_dispatch_stats_carry_mixed_and_fallbacks(self):
+        from dynamo_tpu.worker.metrics import engine_dispatch_stats
+        eng = tiny_engine(mixed_batch=True)
+        try:
+            started = asyncio.Event()
+
+            async def leader():
+                async for out in eng.generate(
+                        make_req([1, 2, 3], "a", max_tokens=20,
+                                 samp=SamplingOptions(
+                                     temperature=0.0,
+                                     presence_penalty=0.5))):
+                    started.set()
+
+            async def follower():
+                await started.wait()
+                await collect(eng, make_req([4, 5, 6], "b", max_tokens=6))
+
+            await asyncio.gather(leader(), follower())
+            stats = engine_dispatch_stats(eng)
+            assert stats["mixed_dispatches"] == eng.mixed_steps
+            assert stats["mixed_dispatches"] > 0
+            # the penalized row refused fusion with a recorded reason
+            assert stats["multistep_fallbacks"].get("penalties", 0) >= 1
+        finally:
+            await eng.stop()
+
+    def test_worker_registry_renders_fallback_family(self):
+        from prometheus_client import CollectorRegistry
+
+        from dynamo_tpu.worker.metrics import WorkerMetrics
+        wm = WorkerMetrics(CollectorRegistry())
+        wm.engine.attach(lambda: {
+            "decode_dispatches": 5, "mixed_dispatches": 2,
+            "multistep_fallbacks": {"penalties": 3}})
+        families = {f.name: f for f in wm.registry.collect()}
+        assert "dynamo_worker_mixed_dispatches" in families
+        fb = families["dynamo_worker_multistep_fallback"]
+        by_reason = {s.labels["reason"]: s.value for s in fb.samples
+                     if s.name.endswith("_total")}
+        assert by_reason["penalties"] == 3.0
+        # pre-seeded labels show at zero before any refusal
+        assert by_reason["waiters"] == 0.0 and by_reason["mesh"] == 0.0
+
+
+# -- engine-internal caches ----------------------------------------------
+
+
+class TestTableCache:
+    def test_device_table_reused_until_pages_change(self):
+        eng = tiny_engine()
+        from dynamo_tpu.engine.scheduler import Sequence
+        seqs = [Sequence(make_req([1, 2, 3], f"s{i}"), page_size=4)
+                for i in range(2)]
+        for i, s in enumerate(seqs):
+            s.page_ids = [i + 1]
+            s.pages_changed()
+        t1, d1 = eng._table_arrays(seqs, 2)
+        t2, d2 = eng._table_arrays(seqs, 2)
+        assert t1 is t2 and d1 is d2           # no rebuild, no re-upload
+        seqs[0].page_ids.append(5)
+        seqs[0].pages_changed()
+        t3, d3 = eng._table_arrays(seqs, 2)
+        assert d3 is not d1
+        assert list(t3[0][:2]) == [1, 5]       # stale row rewritten
+        assert list(t3[1][:1]) == [2]
+        # the previously returned host table was not mutated in place
+        assert list(t1[0][:2]) == [1, 0]
+
+
+# -- mocker ---------------------------------------------------------------
+
+
+class TestMockerMixed:
+    async def test_mocker_mixed_parity_and_hooks(self):
+        from dynamo_tpu.mocker.engine import MockEngineArgs, MockerEngine
+
+        async def run(mixed):
+            eng = MockerEngine(MockEngineArgs(
+                speedup_ratio=200.0, mixed_batch=mixed))
+            try:
+                started = asyncio.Event()
+
+                async def leader():
+                    frames = []
+                    async for out in eng.generate(
+                            make_req([1, 2, 3], "k0", max_tokens=16)):
+                        frames.append(out)
+                        started.set()
+                    return frames
+
+                async def follower(i):
+                    await started.wait()
+                    return await collect(
+                        eng, make_req(list(range(1, 40)), f"k{i}",
+                                      max_tokens=6))
+
+                results = await asyncio.gather(leader(), follower(1),
+                                               follower(2))
+                return ([toks_of(f) for f in results], eng.mixed_steps)
+            finally:
+                await eng.stop()
+
+        mixed_toks, mixed_steps = await run(True)
+        legacy_toks, legacy_steps = await run(False)
+        assert mixed_steps > 0 and legacy_steps == 0
+        assert mixed_toks == legacy_toks
+        assert [len(t) for t in mixed_toks] == [16, 6, 6]
+
+
+if __name__ == "__main__":
+    raise SystemExit(pytest.main([__file__, "-v"]))

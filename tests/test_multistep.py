@@ -376,15 +376,31 @@ class TestSchedulerWidth:
         d = DecodeBatch(seqs=[s for s in sched.active.values()])
         assert sched.plan_multistep(d) is None
 
-    def test_waiting_request_blocks_fusion(self):
-        # a fused block must not head-of-line block a new prompt's
-        # admission: anything waiting refuses the fuse
-        sched, _ = self.make(max_num_seqs=1)
+    def test_waiting_request_blocks_fusion_legacy_only(self):
+        # LEGACY mode (mixed_batch=False): anything waiting refuses the
+        # fuse (the PR 8 gate) and the refusal is recorded by reason
+        sched, _ = self.make(max_num_seqs=1, mixed_batch=False)
         self.to_running(sched, make_req(range(1, 6), "a", max_tokens=32))
         sched.add_request(make_req(range(1, 6), "b", max_tokens=8))
         d = sched.schedule()
         if isinstance(d, DecodeBatch):
             assert sched.plan_multistep(d) is None
+            assert sched.multistep_fallbacks.get("waiters", 0) >= 1
+
+    def test_waiting_request_no_longer_blocks_fusion_mixed(self):
+        # with mixed dispatch on (default) the gate is LIFTED: a waiter
+        # that cannot be admitted (no free slot) no longer forces the
+        # running batch down the per-step path — arrivals onboard through
+        # the mixed steps between blocks instead
+        sched, _ = self.make(max_num_seqs=1)
+        seq = self.to_running(sched, make_req(range(1, 6), "a",
+                                              max_tokens=32))
+        sched.add_request(make_req(range(1, 6), "b", max_tokens=8))
+        d = sched.schedule()
+        assert isinstance(d, DecodeBatch)  # "b" has no slot: pure decode
+        ms = sched.plan_multistep(d)
+        assert ms is not None and ms.width == 8
+        assert ms.seqs == [seq]
 
 
 class TestMockerBlockPath:

@@ -23,9 +23,10 @@ def _native_kernels(monkeypatch):
     """Pin interpret OFF during export: ``_resolve_interpret(None)`` keys
     off ``jax.default_backend()`` (cpu here), but these tests lower for
     the TPU platform — the kernels must take their native path."""
-    from dynamo_tpu.ops.pallas import decode, mla_decode, mla_prefill, prefill
+    from dynamo_tpu.ops.pallas import (decode, mla_decode, mla_prefill,
+                                       prefill, ragged)
 
-    for mod in (decode, prefill, mla_decode, mla_prefill):
+    for mod in (decode, prefill, mla_decode, mla_prefill, ragged):
         monkeypatch.setattr(mod, "_resolve_interpret",
                             lambda interpret: False)
 
@@ -90,6 +91,29 @@ def test_gqa_prefill_kernel_lowers(window, softcap):
         return paged_prefill_attention_stacked(
             q, pages, 1, table, positions, total, 0.088,
             window=window, softcap=softcap, interpret=False)
+
+    exp = _export_tpu(
+        fn,
+        jax.ShapeDtypeStruct((B, S, Hq, Dh), jnp.bfloat16),
+        jax.ShapeDtypeStruct((L, N, 2, Hkv, PS, Dh), jnp.bfloat16),
+        jax.ShapeDtypeStruct((B, P * 4), jnp.int32),
+        jax.ShapeDtypeStruct((B, S), jnp.int32),
+        jax.ShapeDtypeStruct((B,), jnp.int32))
+    _assert_mosaic(exp)
+
+
+def test_ragged_mixed_kernel_lowers():
+    """The ragged mixed-batch kernel (one dispatch for prefill chunks +
+    decode rows, `ops/pallas/ragged.py`) lowers at the same Llama-3-class
+    geometry as the prefill kernel it extends — the program the engine's
+    mixed step runs on chip with DYN_MIXED_BATCH on."""
+    from dynamo_tpu.ops.pallas.ragged import ragged_mixed_attention_stacked
+
+    Hq, Hkv, Dh, S = 24, 8, 128, 512
+
+    def fn(q, pages, table, positions, total):
+        return ragged_mixed_attention_stacked(
+            q, pages, 1, table, positions, total, 0.088, interpret=False)
 
     exp = _export_tpu(
         fn,
