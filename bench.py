@@ -464,6 +464,189 @@ async def _measure_mixed_arrivals(engine, vocab_size: int) -> dict:
                         if legacy["tok_s"] > 0 else None)}
 
 
+# sharded-tier geometry (tiny model over a tp=2 mesh; override for
+# on-chip runs): sequences x (prompt + gen) per leg
+MESH_SEQS = int(os.environ.get("BENCH_MESH_SEQS", "4"))
+MESH_PROMPT = int(os.environ.get("BENCH_MESH_PROMPT", "32"))
+MESH_GEN = int(os.environ.get("BENCH_MESH_GEN", "48"))
+
+
+async def _measure_mesh_sharded(wd=None) -> dict:
+    """Mesh-sharded serving leg (ROADMAP item 2): the fused-multistep +
+    mixed-dispatch fast path measured ON A SHARDED ENGINE — the regime
+    every earlier bench tier gated off (``supports_multistep`` used to
+    refuse the moment ``cfg.mesh`` was set).
+
+    Builds a tiny-model engine tensor-parallel over 2 devices
+    (``--xla_force_host_platform_device_count`` on CPU; real chips on a
+    slice), runs a same-run fused-vs-per-step A/B asserting token parity,
+    then a shard-aware disagg KV handoff between two sharded engines over
+    the wire-v5 per-shard frame schema, recording per-shard bytes.
+    Results land in the attempt JSON (``mesh_sharded``) and — when
+    ``BENCH_MESH_OUT`` names a path — in a standalone artifact
+    (``BENCH_mesh_r07.json``)."""
+    import jax
+    import numpy as np
+
+    if len(jax.devices()) < 2:
+        return {"error": "needs >=2 devices (set XLA_FLAGS="
+                         "--xla_force_host_platform_device_count)"}
+    from dynamo_tpu.engine.jax_engine import JaxEngine, JaxEngineConfig
+    from dynamo_tpu.engine.transfer import (
+        InjectPipeline, cache_shard_layout, export_frames, kv_shard_payload,
+        resolve_wire, stamp_frame_crcs)
+    from dynamo_tpu.models import llama
+    from dynamo_tpu.models.config import ModelConfig
+    from dynamo_tpu.parallel import tp_sharding
+    from dynamo_tpu.protocols.common import (
+        PreprocessedRequest, SamplingOptions, StopConditions)
+
+    if wd is not None:
+        wd.arm("measure:mesh_sharded", STAGE_BUDGETS["measure"])
+    seqs, prompt, gen = MESH_SEQS, MESH_PROMPT, MESH_GEN
+    cfg = ModelConfig.tiny(dtype="float32")
+    shard = tp_sharding(cfg, 2)
+    page = 4
+    kw = dict(
+        num_pages=seqs * ((prompt + gen) // page + 2) + 16, page_size=page,
+        max_num_seqs=seqs, max_prefill_chunk=min(64, prompt),
+        max_prefill_seqs=seqs, max_context=prompt + gen + 32,
+        min_prefill_bucket=min(64, prompt), min_decode_bucket=seqs,
+        mesh=shard.mesh, shard_params_fn=shard.shard_params,
+        shard_pages_fn=shard.shard_pages)
+
+    def build():
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        return JaxEngine(cfg, params, JaxEngineConfig(**kw))
+
+    engine = build()
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(1, cfg.vocab_size, size=prompt).tolist()
+               for _ in range(seqs)]
+
+    async def leg(label: str) -> dict:
+        tokens: dict = {}
+
+        async def drive(i: int):
+            req = PreprocessedRequest(
+                token_ids=prompts[i], request_id=f"mesh{label}{i}",
+                stop_conditions=StopConditions(max_tokens=gen,
+                                               ignore_eos=True),
+                sampling_options=SamplingOptions(temperature=0.0))
+            out = []
+            async for f in engine.generate(req):
+                out.extend(f.token_ids)
+            tokens[i] = out
+
+        d0 = engine.decode_dispatches
+        b0 = engine.multistep_blocks
+        x0 = engine.mixed_steps
+        t0 = time.perf_counter()
+        await asyncio.gather(*[drive(i) for i in range(seqs)])
+        wall = time.perf_counter() - t0
+        total = sum(len(t) for t in tokens.values())
+        return {
+            "tok_s": round(total / wall, 1),
+            "decode_dispatches_per_token": round(
+                (engine.decode_dispatches - d0) / max(1, total), 4),
+            "fused_blocks": engine.multistep_blocks - b0,
+            "mixed_dispatches": engine.mixed_steps - x0,
+            "tokens": tokens,
+        }
+
+    try:
+        assert engine.supports_multistep, \
+            engine.multistep_unsupported_reason
+        await leg("w")                    # warmup/compile
+        fused = await leg("f")
+        ms_saved = engine.multistep
+        engine.multistep = 1              # supports_multistep -> False
+        try:
+            perstep = await leg("p")
+        finally:
+            engine.multistep = ms_saved
+        parity = all(fused["tokens"][i] == perstep["tokens"][i]
+                     for i in range(seqs))
+        fallbacks = dict(engine.scheduler.multistep_fallbacks)
+
+        # shard-aware KV handoff: prefill on this engine, per-shard wire
+        # frames into a second sharded engine's cache (the wire-v5 path
+        # disagg decode workers negotiate)
+        decode_eng = build()
+        try:
+            hand_prompt = list(range(1, 4 * page * 6))
+            req = PreprocessedRequest(
+                token_ids=hand_prompt, request_id="mesh-handoff",
+                stop_conditions=StopConditions(max_tokens=2,
+                                               ignore_eos=True),
+                sampling_options=SamplingOptions(temperature=0.0))
+            req.prefill_only = True
+            final = None
+            async for f in engine.generate(req):
+                if f.finish_reason is not None:
+                    final = f
+            hashes = [b[0] for b in final.kv_transfer_params["blocks"]]
+            layout, per, _crc, shards = resolve_wire(
+                {"wire": 5, **kv_shard_payload(decode_eng)}, 1)
+            t0 = time.perf_counter()
+            frames = await engine.run_exclusive(export_frames, engine,
+                                                hashes, layout, per, shards)
+            stamp_frame_crcs(frames)
+            per_shard_bytes: dict = {}
+            for f in frames:
+                sh = f.obj.get("shard") or {"index": "merged"}
+                k = str(sh["index"])
+                per_shard_bytes[k] = (per_shard_bytes.get(k, 0)
+                                      + int(np.asarray(f.raw).nbytes))
+            pipe = InjectPipeline(decode_eng)
+            for f in frames:
+                meta = dict(f.obj)
+                meta["_raw"] = f.raw
+                await pipe.add_frame(meta)
+            injected = await pipe.finish()
+            handoff_s = time.perf_counter() - t0
+            handoff = {
+                "blocks": len(hashes), "injected": injected,
+                "sharded_frames": all(f.obj.get("shard") is not None
+                                      for f in frames),
+                "shard_layout": list(cache_shard_layout(decode_eng)),
+                "per_shard_bytes": per_shard_bytes,
+                "wall_s": round(handoff_s, 4),
+            }
+        finally:
+            await decode_eng.stop()
+    finally:
+        await engine.stop()
+
+    for d in (fused, perstep):
+        d.pop("tokens")
+    result = {
+        "devices": len(jax.devices()),
+        "tp": 2,
+        "geometry": [seqs, prompt, gen],
+        "decode_multistep": int(ms_saved),
+        "fused": fused,
+        "perstep": perstep,
+        "fused_speedup": (round(fused["tok_s"] / perstep["tok_s"], 3)
+                          if perstep["tok_s"] > 0 else None),
+        "token_parity": parity,
+        "multistep_fallbacks": fallbacks,
+        "mesh_fallbacks": int(fallbacks.get("mesh", 0)),
+        "handoff": handoff,
+    }
+    _ckpt("mesh_sharded", fused_tok_s=fused["tok_s"],
+          perstep_tok_s=perstep["tok_s"],
+          fused_dpt=fused["decode_dispatches_per_token"],
+          perstep_dpt=perstep["decode_dispatches_per_token"],
+          parity=parity, handoff_blocks=handoff["blocks"])
+    out_path = os.environ.get("BENCH_MESH_OUT")
+    if out_path:
+        with open(out_path, "w") as fh:
+            json.dump(result, fh, indent=2, sort_keys=True)
+            fh.write("\n")
+    return result
+
+
 async def run_attempt(args) -> dict:
     """The whole attempt, one process: build -> prime -> measure ->
     transports -> optional attn-impl A/B. ``jax_init`` already happened in
@@ -627,6 +810,14 @@ async def run_attempt(args) -> dict:
         result["longctx"] = await _measure_long_context(wd)
     except Exception as e:  # noqa: BLE001 — best-effort extra data
         result["longctx"] = {"error": str(e)[:300]}
+    print(json.dumps(result), flush=True)
+
+    # mesh-sharded tier (tp=2 over whatever devices this attempt has):
+    # fused-vs-per-step A/B on a sharded engine + per-shard KV handoff
+    try:
+        result["mesh_sharded"] = await _measure_mesh_sharded(wd)
+    except Exception as e:  # noqa: BLE001 — best-effort extra data
+        result["mesh_sharded"] = {"error": str(e)[:300]}
     print(json.dumps(result), flush=True)
 
     # attn-impl A/B in the SAME process (round-4 open question:
@@ -1221,6 +1412,10 @@ def _parse_args(argv=None):
     p.add_argument("--_attempt", action="store_true",
                    help="internal: run probe->prime->measure in this "
                         "process")
+    p.add_argument("--mesh-only", action="store_true",
+                   help="run ONLY the mesh-sharded tier (forces a 2+ "
+                        "device CPU backend when no accelerator answers; "
+                        "BENCH_MESH_OUT writes the standalone artifact)")
     p.add_argument("--skip-extras", action="store_true",
                    help="internal: main measurement only (no A/B, int8, "
                         "or spec legs) — the BANKING attempt uses this so "
@@ -1488,8 +1683,28 @@ def _last_json_line(out: bytes) -> dict | None:
     return None
 
 
+def _mesh_only_main() -> None:
+    """Standalone sharded-tier run (``--mesh-only``): pin jax to a 2+
+    device CPU mesh unless a real multi-device backend answers, run the
+    leg, print its JSON (and write BENCH_MESH_OUT when set)."""
+    if os.environ.get("BENCH_FORCE_CPU") or os.environ.get(
+            "JAX_PLATFORMS", "") == "cpu":
+        from dynamo_tpu.utils.platform import force_cpu_platform
+        force_cpu_platform(n_devices=2)
+    import jax
+
+    if len(jax.devices()) < 2:
+        from dynamo_tpu.utils.platform import force_cpu_platform
+        force_cpu_platform(n_devices=2)
+    result = asyncio.run(_measure_mesh_sharded())
+    print(json.dumps({"mesh_sharded": result}), flush=True)
+
+
 def main() -> None:
     args = _parse_args()
+    if args.mesh_only:
+        _mesh_only_main()
+        return
     if args._attempt:
         _attempt_main(args)
         return

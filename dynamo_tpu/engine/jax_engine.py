@@ -701,6 +701,22 @@ class JaxEngine(ScheduledEngineBase):
         aux). ``step0 + j`` feeds the rng fold so a fused run consumes the
         same per-step key sequence as ``n_steps`` per-step dispatches.
         """
+        # the block's row-aligned inputs take the SAME dp partitioning as
+        # the per-step dispatch it must stay bit-identical to: reuse
+        # _shard_batch for the shared operands (``alive`` rides the
+        # row-vector slot ``new_lens`` occupies there — the constraint
+        # only cares about the [B] shape), then constrain the fused-path
+        # extras under the identical divisibility gate
+        (tok, pos, table, total, alive, temperature, top_k,
+         top_p) = self._shard_batch(tok, pos, table, total, alive,
+                                    temperature, top_k, top_p)
+        if self._dp > 1 and tok.shape[0] % self._dp == 0:
+            from jax.sharding import NamedSharding, PartitionSpec
+            row = NamedSharding(self.cfg.mesh, PartitionSpec("dp"))
+            mat = NamedSharding(self.cfg.mesh, PartitionSpec("dp", None))
+            c = jax.lax.with_sharding_constraint
+            stop_ids = c(stop_ids, mat)
+            budget, min_gate = c(budget, row), c(min_gate, row)
 
         def body(carry, j):
             pages, tok, pos, total, alive = carry
@@ -748,9 +764,31 @@ class JaxEngine(ScheduledEngineBase):
     def _get_jit_multistep(self, w: int):
         fn = self._jit_ms.get(w)
         if fn is None:
-            # scan length is static: one jit per (pow2-floored) width
+            # scan length is static: one jit per (pow2-floored) width.
+            # On a mesh-sharded engine the block program takes EXPLICIT
+            # out-shardings (the SNIPPETS pjit shape): the donated pages
+            # carry keeps the cache's NamedSharding (donation needs
+            # out == in), while the packed block, the scalar carry
+            # (tok/pos/total/alive/budget/min_gate) and the MoE drop
+            # count come back fully REPLICATED so the host fetch and the
+            # next chained block read whole rows locally — a silent
+            # resharding here would either break donation or ship a
+            # sharded packed buffer the host cannot np.asarray.
+            kw = {}
+            if self.cfg.mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec
+                ref = (self.pages[0] if isinstance(self.pages, list)
+                       else self.pages)
+                if isinstance(ref.sharding, NamedSharding):
+                    rep = NamedSharding(self.cfg.mesh, PartitionSpec())
+                    pages_sh = jax.tree_util.tree_map(
+                        lambda x: x.sharding, self.pages)
+                    carry_sh = {k: rep for k in ("tok", "pos", "total",
+                                                 "alive", "budget",
+                                                 "min_gate")}
+                    kw["out_shardings"] = (pages_sh, rep, carry_sh, rep)
             fn = jax.jit(functools.partial(self._multistep_impl, n_steps=w),
-                         donate_argnums=(1,))
+                         donate_argnums=(1,), **kw)
             self._jit_ms[w] = fn
         return fn
 
@@ -1321,26 +1359,28 @@ class JaxEngine(ScheduledEngineBase):
     @property
     def supports_multistep(self) -> bool:
         # fused decode COMPOSES with pipelined decode (the per-step chain
-        # serves batches the planner refuses to fuse); it does not yet
-        # compose with multi-host lockstep (step_tap broadcasts host
-        # arrays, but the block carry is device-resident), mesh sharding,
-        # or spec mode (its own [B, K+1] verify path). pipeline_decode
-        # False means strict step-at-a-time debugging — fusion off too.
+        # serves batches the planner refuses to fuse) AND with mesh
+        # sharding (the block program jits with explicit out-shardings:
+        # donated sharded pages carry, replicated scalar carry — see
+        # _get_jit_multistep); it does not yet compose with multi-host
+        # lockstep (step_tap broadcasts host arrays, but the block carry
+        # is device-resident) or spec mode (its own [B, K+1] verify
+        # path). pipeline_decode False means strict step-at-a-time
+        # debugging — fusion off too.
         return (self.multistep > 1 and self.cfg.pipeline_decode
-                and self.step_tap is None
-                and self.cfg.mesh is None and not self.spec_K)
+                and self.step_tap is None and not self.spec_K)
 
     @property
     def multistep_unsupported_reason(self) -> Optional[str]:
         """Why fusion is off on an engine whose config ASKED for it
         (feeds ``dynamo_worker_multistep_fallback_total{reason}``); None
-        when fusion is supported or disabled by configuration."""
+        when fusion is supported or disabled by configuration. ``mesh``
+        is no longer a reason — sharded engines run the fused block
+        program with explicit shardings."""
         if self.multistep <= 1 or not self.cfg.pipeline_decode:
             return None
         if self.spec_K:
             return "spec"
-        if self.cfg.mesh is not None:
-            return "mesh"
         if self.step_tap is not None:
             return "multihost"
         return None
@@ -1694,6 +1734,20 @@ class JaxEngine(ScheduledEngineBase):
                 vals.astype(pages.dtype))
         self._jit_gather_pages = jax.jit(
             gather, out_shardings=rep) if rep is not None else jax.jit(gather)
+        # sharded gather: the transport array KEEPS the cache's placement
+        # (no all-gather — page indexing is along the unsharded block
+        # axis, so every device reads only its own head slice). The
+        # per-shard KV export path reads each addressable shard straight
+        # off its device; single-device/replicated caches alias the
+        # plain gather.
+        self._jit_gather_pages_sharded = self._jit_gather_pages
+        if rep is not None:
+            from dynamo_tpu.parallel.sharding import (shard_layout,
+                                                      transport_sharding)
+            ts = transport_sharding(self.pages)
+            if shard_layout(ts)[0] >= 2:
+                self._jit_gather_pages_sharded = jax.jit(
+                    gather, out_shardings=ts)
         self._jit_scatter_pages = jax.jit(scatter, donate_argnums=(0,))
 
     @staticmethod
@@ -1706,9 +1760,15 @@ class JaxEngine(ScheduledEngineBase):
         return np.asarray(list(page_ids) + [0] * (n - len(page_ids)),
                           np.int32)
 
-    def dispatch_gather_pages(self, page_ids):
+    def dispatch_gather_pages(self, page_ids, replicate: bool = True):
         """Gather cache pages -> device array [L, n_pad, 2, Hkv, ps, Dh]
-        (replicated on a mesh). Non-blocking; broadcast to followers."""
+        (replicated on a mesh). Non-blocking; broadcast to followers.
+
+        ``replicate=False`` keeps the gathered array on the CACHE's
+        sharding instead (no all-gather; each device reads only its own
+        slice) — the per-shard KV export path. Single-host only: on a
+        multi-host engine (step_tap set) the broadcast gather must stay
+        replicated, so the flag is ignored there."""
         self._ensure_page_io_jits()
         ids = self._pad_page_ids(page_ids)
         if self.step_tap is not None:
@@ -1717,7 +1777,10 @@ class JaxEngine(ScheduledEngineBase):
             # failure bookkeeping with the leader's outcome cross-check
             self.step_tap("gather", {"ids": ids}, self._step_counter)
             self._step_counter += 1
-        return self._jit_gather_pages(self.pages, jnp.asarray(ids))
+            replicate = True
+        fn = (self._jit_gather_pages if replicate
+              else self._jit_gather_pages_sharded)
+        return fn(self.pages, jnp.asarray(ids))
 
     def gather_pages_host(self, page_ids) -> np.ndarray:
         """Gather + host fetch, trimmed to the real page count."""

@@ -145,9 +145,10 @@ class ScheduledEngineBase(EngineBase):
     @property
     def multistep_unsupported_reason(self) -> Optional[str]:
         """Why ``supports_multistep`` is False on an engine whose config
-        ASKED for fusion (mesh/spec/multihost...), or None when it is off
-        by configuration / actually supported — feeds the
-        ``dynamo_worker_multistep_fallback_total{reason}`` counter."""
+        ASKED for fusion (spec/multihost — mesh sharding is NOT a reason:
+        sharded engines run the fused block with explicit shardings), or
+        None when it is off by configuration / actually supported — feeds
+        the ``dynamo_worker_multistep_fallback_total{reason}`` counter."""
         return None
 
     def dispatch_multistep(self, plan, prev_handle=None):  # pragma: no cover

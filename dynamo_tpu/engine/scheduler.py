@@ -316,7 +316,7 @@ class Scheduler:
         # sequence was admitted) instead of being recomputed
         self.adopted_blocks = 0
         # why the fused multi-step path was refused, by reason (waiters,
-        # prefill, penalties, guided, spec, budget, pages, mesh,
+        # prefill, penalties, guided, spec, budget, pages,
         # multihost): the worker metrics layer surfaces these as
         # dynamo_worker_multistep_fallback_total{reason=...} so the
         # "fallback-reason near zero" roadmap criterion is measurable

@@ -139,12 +139,15 @@ def inject_blocks(engine: JaxEngine, blocks: List[BlockPayload]) -> int:
     return _inject_data(engine, metas, data)
 
 
-def _export_device(engine: JaxEngine, block_hashes: List[int]):
+def _export_device(engine: JaxEngine, block_hashes: List[int],
+                   sharded: bool = False):
     """Extract resident blocks by hash as (metas, device array) — no host
     round trip. Missing hashes break the chain (later blocks are useless
     without their parents). The gather goes through
     ``engine.dispatch_gather_pages`` so a multi-host engine broadcasts it
-    to followers (every rank must join ops on the sharded cache)."""
+    to followers (every rank must join ops on the sharded cache).
+    ``sharded=True`` keeps the gathered array on the cache's placement
+    (no all-gather) for the per-shard export path."""
     alloc = engine.allocator
     claimed: List[Tuple[int, int]] = []
     try:
@@ -156,7 +159,8 @@ def _export_device(engine: JaxEngine, block_hashes: List[int]):
             claimed.append((h, page))
         if not claimed:
             return [], None
-        data = engine.dispatch_gather_pages([p for _h, p in claimed])
+        data = engine.dispatch_gather_pages([p for _h, p in claimed],
+                                            replicate=not sharded)
         metas = []
         for h, page in claimed:
             info = alloc._info[page]
@@ -169,15 +173,35 @@ def _export_device(engine: JaxEngine, block_hashes: List[int]):
 def _put_like(vals, pages) -> "jax.Array":
     """Move a stacked [L, n, 2, Hkv, ps, Dh] array onto the sharding of the
     destination cache (device-to-device on a real mesh — ICI, not host)."""
-    from jax.sharding import NamedSharding, PartitionSpec
+    from dynamo_tpu.parallel.sharding import transport_sharding
 
-    ref = pages[0] if isinstance(pages, list) else pages
-    sharding = ref.sharding
-    if isinstance(pages, list) and isinstance(sharding, NamedSharding):
-        # per-layer refs are rank 5; the stacked transport array is rank 6
-        sharding = NamedSharding(sharding.mesh,
-                                 PartitionSpec(None, *sharding.spec))
-    return jax.device_put(vals, sharding)
+    return jax.device_put(vals, transport_sharding(pages))
+
+
+def cache_shard_layout(engine) -> Tuple[int, int]:
+    """``(shard_count, axis)`` of the engine cache's stacked transport
+    array ``[L, n, 2, Hkv, ps, Dh]``; ``(1, -1)`` for single-device or
+    replicated caches (and on any error — shard negotiation is an
+    optimization, never load-bearing)."""
+    from dynamo_tpu.parallel.sharding import shard_layout, transport_sharding
+
+    try:
+        return shard_layout(transport_sharding(engine.pages))
+    except Exception:  # noqa: BLE001 — fall back to merged frames
+        logger.debug("cache shard layout probe failed", exc_info=True)
+        return (1, -1)
+
+
+def kv_shard_payload(engine) -> Dict[str, int]:
+    """The shard-negotiation keys a puller merges into its wire-v5 pull
+    payload: its own cache's shard layout, so an exporter with the SAME
+    layout streams each shard's slice straight to its destination device.
+    Empty for single-device caches and multi-host engines (per-shard
+    frames carry no broadcast — followers could not join)."""
+    n, ax = cache_shard_layout(engine)
+    if n < 2 or engine.step_tap is not None:
+        return {}
+    return {"shards": n, "shard_axis": ax}
 
 
 async def transfer_blocks_ici(src: JaxEngine, dst: JaxEngine,
@@ -223,12 +247,20 @@ SCATTER_WINDOW_BLOCKS = 64
 # inject path stages them with a straight strided copy — no per-frame
 # transpose), 4 = v3 frames carrying a per-frame ``crc32`` of the raw
 # bytes (the inject side verifies BEFORE staging — a truncated/corrupted
-# frame is rejected, never silently injected as garbage KV). Pullers
-# advertise the highest version they speak; exporters serve the min of
-# that and their own, so mixed-version pulls keep working (a v3 puller
-# just gets frames without the checksum key; a v4 puller talking to a v3
-# exporter sees no ``crc32`` and skips verification).
-FRAME_WIRE_VERSION = 4
+# frame is rejected, never silently injected as garbage KV), 5 = v4
+# frames that may additionally be SHARD-SLICED: when the puller
+# advertises a cache shard layout matching the exporter's
+# (``{"shards": n, "shard_axis": a}`` in the pull payload), each block
+# window ships as ``n`` frames each carrying ONE shard's slice of the
+# transport array (``meta["shard"] = {index, count, axis, start, size}``)
+# read straight off its source device — no all-gather, no full-size host
+# buffer — and the inject side ``device_put``s each slice onto the
+# destination shard's device. Pullers advertise the highest version they
+# speak; exporters serve the min of that and their own, so mixed-version
+# pulls keep working (a v3 puller just gets frames without the checksum
+# key; a v4-or-below puller — or a v5 puller whose shard layout doesn't
+# match — gets the merged host-gathered frames).
+FRAME_WIRE_VERSION = 5
 
 
 class FrameIntegrityError(ValueError):
@@ -296,24 +328,39 @@ def frame_crc_enabled() -> bool:
     return os.environ.get("DYN_KV_FRAME_CRC", "1") not in ("0", "false", "")
 
 
-def resolve_wire(payload: Any, default_wire: int) -> Tuple[str, int, bool]:
-    """(frame layout, frame blocks, checksum) for an export request's
-    advertised wire version — the one place the version -> layout mapping
-    lives, and resolved OUTSIDE the exclusive window
+def resolve_wire(payload: Any, default_wire: int
+                 ) -> Tuple[str, int, bool, Optional[Tuple[int, int]]]:
+    """(frame layout, frame blocks, checksum, shards) for an export
+    request's advertised wire version — the one place the version ->
+    layout mapping lives, and resolved OUTSIDE the exclusive window
     (``kv_transfer_defaults`` can touch the config file). ``default_wire``
     encodes what a client that omits the key speaks: 1 on the RPC plane
     (per-block era), 2 on the bulk plane (which never carried the
     per-block schema). ``checksum`` is True when the puller speaks wire
-    v4+ (and the exporter hasn't disabled crc)."""
-    wire = int((payload or {}).get("wire", default_wire))
+    v4+ (and the exporter hasn't disabled crc). ``shards`` is the
+    puller's advertised cache shard layout ``(count, axis)`` when it
+    speaks wire v5+ and negotiated one, else None — ``export_frames``
+    serves per-shard frames only when it matches the exporter's own
+    layout (host-tier exports ignore it: their data is unsharded)."""
+    payload = payload or {}
+    wire = int(payload.get("wire", default_wire))
     layout = "layer" if wire >= 3 else "block"
     checksum = wire >= 4 and frame_crc_enabled()
-    return layout, kv_transfer_defaults()[0], checksum
+    shards = None
+    if wire >= 5:
+        try:
+            n = int(payload.get("shards", 0) or 0)
+            if n >= 2:
+                shards = (n, int(payload.get("shard_axis", -1)))
+        except (TypeError, ValueError):
+            shards = None
+    return layout, kv_transfer_defaults()[0], checksum, shards
 
 
 def export_frames(engine: JaxEngine, block_hashes: List[int],
                   layout: str = "layer",
-                  frame_blocks: Optional[int] = None) -> List[Raw]:
+                  frame_blocks: Optional[int] = None,
+                  shards: Optional[Tuple[int, int]] = None) -> List[Raw]:
     """Extract resident blocks as batched two-part wire frames.
 
     ``layout="layer"`` (wire v3) keeps the device gather's layer-major
@@ -328,7 +375,30 @@ def export_frames(engine: JaxEngine, block_hashes: List[int],
     descriptor-list transfers,
     ``lib/llm/src/block_manager/block/transfer/nixl.rs``).
     Runs under ``run_exclusive``.
+
+    ``shards`` (wire v5, from ``resolve_wire``) is the puller's cache
+    shard layout ``(count, axis)``: when it matches THIS engine's layout
+    the gather skips the all-gather (the transport array keeps the cache
+    placement) and each block window ships as ``count`` frames, one per
+    shard slice read straight off its device — the host never
+    materializes the merged array. A mismatched/unsupported layout logs
+    the reason once per export and serves the merged single-frame-per-
+    window schema every puller understands.
     """
+    if shards is not None:
+        mine = cache_shard_layout(engine)
+        if (engine.step_tap is not None or layout != "layer"
+                or mine != tuple(shards)):
+            logger.info(
+                "per-shard KV export unavailable (engine layout %s vs "
+                "puller %s%s%s); serving merged frames", mine,
+                tuple(shards),
+                "; multihost" if engine.step_tap is not None else "",
+                "; block-major" if layout != "layer" else "")
+            shards = None
+    if shards is not None:
+        return _export_frames_sharded(engine, block_hashes, frame_blocks,
+                                      mine)
     metas, data = _export_device(engine, block_hashes)
     if not metas:
         return []
@@ -359,6 +429,53 @@ def export_frames(engine: JaxEngine, block_hashes: List[int],
         frames.append(Raw(meta, chunk))
     # wire-v4 checksums are stamped by the serving handlers AFTERWARD via
     # ``stamp_frame_crcs`` — outside the exclusive window this runs under
+    return frames
+
+
+def _export_frames_sharded(engine: JaxEngine, block_hashes: List[int],
+                           frame_blocks: Optional[int],
+                           layout: Tuple[int, int]) -> List[Raw]:
+    """Per-shard wire frames (wire v5): one frame per (block window,
+    cache shard). The sharded gather keeps the transport array on the
+    cache's placement, so each ``addressable_shards`` entry is that
+    device's own slice — reading it is a device-local D2H copy of 1/n of
+    the bytes, with no collective and no merged host buffer. Frames for
+    one window are emitted consecutively (shard 0..n-1, identical
+    ``blocks`` list) so the inject pipeline assembles them windowful by
+    windowful. ``layout`` is the caller's already-negotiated
+    (shard count, axis) — verified against the puller's advert. Runs
+    under ``run_exclusive``."""
+    metas, data = _export_device(engine, block_hashes, sharded=True)
+    if not metas:
+        return []
+    n = len(metas)
+    per = int(frame_blocks) if frame_blocks else kv_transfer_defaults()[0]
+    count, axis = layout
+    parts: List[Tuple[int, np.ndarray]] = []
+    for sh in data.addressable_shards:
+        if sh.replica_id != 0:
+            continue  # axes the cache replicates over (e.g. sp) repeat
+            # the same slice on several devices — ship each slice once
+        start = sh.index[axis].start or 0
+        parts.append((int(start), np.asarray(sh.data)[:, :n]))
+    parts.sort(key=lambda p: p[0])
+    if len(parts) != count:
+        raise RuntimeError(
+            f"sharded export found {len(parts)} distinct shard slices, "
+            f"expected {count} — cache sharding changed mid-negotiation?")
+    frames: List[Raw] = []
+    for i in range(0, n, per):
+        blocks = [[h, local, parent]
+                  for h, local, parent in metas[i:i + per]]
+        for si, (start, host) in enumerate(parts):
+            chunk = np.ascontiguousarray(host[:, i:i + per])
+            meta = {"blocks": blocks, "dtype": str(chunk.dtype),
+                    "block_shape": [chunk.shape[0]] + list(chunk.shape[2:]),
+                    "layout": "layer",
+                    "shard": {"index": si, "count": count, "axis": axis,
+                              "start": start,
+                              "size": int(chunk.shape[axis])}}
+            frames.append(Raw(meta, chunk))
     return frames
 
 
@@ -424,6 +541,11 @@ def inject_frame(engine: JaxEngine, meta: Dict[str, Any]) -> int:
     itself is async). The streaming pull path uses ``InjectPipeline``
     instead, which stages into a reusable buffer and batches the scatter.
     """
+    if meta.get("shard") is not None:
+        # a wire-v5 shard frame carries one slice of a block window —
+        # standalone injection of partial data would commit garbage KV
+        raise ValueError("per-shard wire frames require the inject "
+                        "pipeline (InjectPipeline.add_frame)")
     metas, arr = frame_arrays(meta)
     return _inject_data(engine, metas, arr.copy())
 
@@ -494,6 +616,11 @@ class InjectPipeline:
         self._pending: List[Optional[asyncio.Task]] = [None, None]
         self._direct: Optional[asyncio.Task] = None
         self._sharding = None
+        # wire-v5 per-shard frames: parts of the block window currently
+        # being assembled ({"key", "metas", "axis", "count", "parts"})
+        # and the in-flight shard-window commit task
+        self._shard_win: Optional[Dict[str, Any]] = None
+        self._shard_task: Optional[asyncio.Task] = None
         # commit-order chain: uploads overlap freely, but windows COMMIT
         # in arrival order — under a near-full allocator, _inject_data
         # truncates to the free-page budget, and out-of-order commits
@@ -522,6 +649,13 @@ class InjectPipeline:
             if release is not None:
                 release(meta["_raw"])
             raise
+        if meta.get("shard") is not None:
+            try:
+                await self._stage_shard(meta["shard"], metas, arr)
+            finally:
+                if release is not None:
+                    release(meta["_raw"])
+            return
         if (release is not None and self.engine.step_tap is None
                 and meta.get("layout") == "layer"
                 and len(metas) >= self.window and self._fill == 0):
@@ -547,12 +681,26 @@ class InjectPipeline:
         tasks = [t for t in self._pending if t is not None]
         if self._direct is not None:
             tasks.append(self._direct)
+        if self._shard_task is not None:
+            tasks.append(self._shard_task)
         self._pending = [None, None]
         self._direct = None
+        self._shard_task = None
         results = await asyncio.gather(*tasks, return_exceptions=True)
         for r in results:
             if isinstance(r, BaseException):
                 raise r
+        if self._shard_win is not None:
+            # the stream ended mid-window: some shard slices of the last
+            # block window never arrived — treat as a transport fault so
+            # the puller's resume ladder re-pulls the missing blocks
+            # (committed windows stay, content-addressed)
+            missing = (self._shard_win["count"]
+                       - len(self._shard_win["parts"]))
+            self._shard_win = None
+            raise ConnectionError(
+                f"sharded KV frame stream truncated: {missing} shard "
+                "slice(s) of the final block window never arrived")
         return self.injected
 
     async def drain(self) -> int:
@@ -713,21 +861,114 @@ class InjectPipeline:
         if prev is not None:  # bound in-flight commits (backpressure)
             await prev
 
+    async def _stage_shard(self, shard: Dict[str, Any], metas, arr) -> None:
+        """Wire-v5 per-shard frame path: accumulate the ``count`` shard
+        slices of one block window, then assemble them into ONE sharded
+        device array (``jax.make_array_from_single_device_arrays`` — each
+        slice lands on exactly the destination device(s) holding it, no
+        merged host buffer, no resharding) and commit it through the
+        device-values scatter. The exporter emits a window's shard frames
+        consecutively with identical ``blocks`` lists."""
+        count, axis = int(shard["count"]), int(shard["axis"])
+        sharding = await self._target_sharding()
+        from dynamo_tpu.parallel.sharding import shard_layout
+        if shard_layout(sharding) != (count, axis):
+            # negotiation happened against a different engine/layout —
+            # committing a mis-sliced window would be silent KV corruption
+            raise ValueError(
+                f"shard frame layout ({count}, {axis}) does not match the "
+                f"destination cache {shard_layout(sharding)}")
+        key = tuple(m[0] for m in metas)
+        win = self._shard_win
+        if win is None:
+            win = self._shard_win = {"key": key, "metas": list(metas),
+                                     "axis": axis, "count": count,
+                                     "parts": {}}
+        elif win["key"] != key or win["count"] != count:
+            raise ValueError("sharded frame stream interleaved two block "
+                             "windows; expected consecutive shard slices")
+        start = int(shard["start"])
+        if start in win["parts"]:
+            raise ValueError(f"duplicate shard slice at offset {start}")
+        t0 = time.perf_counter()
+        # OWNING copy off the wire buffer: the view aliases meta["_raw"],
+        # which the caller releases (back to the bulk/RPC buffer pool) the
+        # moment we return, while this part waits for the window's other
+        # slices. Must be .copy() — ascontiguousarray would alias the
+        # already-contiguous view and the pool's next same-sized frame
+        # would overwrite the staged bytes before commit.
+        win["parts"][start] = arr.copy()
+        self.timings["stage_s"] += time.perf_counter() - t0
+        if len(win["parts"]) < count:
+            return
+        self._shard_win = None
+        self.blocks_staged += len(win["metas"])
+        await self._commit_shard_window(win, sharding)
+
+    async def _commit_shard_window(self, win: Dict[str, Any],
+                                   sharding) -> None:
+        """Upload each assembled shard slice onto its destination
+        device(s) and commit the window; ordered with every other window
+        via the commit chain, overlapped with the next window's recv via
+        a background task (the ``_direct_frame`` pattern)."""
+        axis, parts = win["axis"], win["parts"]
+        first = next(iter(parts.values()))
+        gshape = list(first.shape)
+        gshape[axis] = sum(p.shape[axis] for p in parts.values())
+        gshape = tuple(gshape)
+        t0 = time.perf_counter()
+        arrays = []
+        # one device_put per destination device; an axis the cache
+        # REPLICATES over (e.g. sp) maps several devices to one slice —
+        # each replica gets its own copy
+        for dev, idx in sharding.devices_indices_map(gshape).items():
+            start = idx[axis].start or 0
+            stop = idx[axis].stop if idx[axis].stop is not None \
+                else gshape[axis]
+            part = parts.get(int(start))
+            if part is None or part.shape[axis] != stop - start:
+                raise ValueError(
+                    f"shard slice [{start}:{stop}) missing or mis-sized "
+                    "for the destination placement")
+            arrays.append(jax.device_put(part, dev))
+        vals = jax.make_array_from_single_device_arrays(
+            gshape, sharding, arrays)
+        self.timings["upload_s"] += time.perf_counter() - t0
+        order_prev, order_done = self._order_ticket()
+        metas = win["metas"]
+
+        async def commit():
+            try:
+                if not vals.is_ready():
+                    t1 = time.perf_counter()
+                    await asyncio.to_thread(jax.block_until_ready, vals)
+                    self.timings["upload_s"] += time.perf_counter() - t1
+                if order_prev is not None:
+                    await order_prev  # commit in window order
+                if len(metas) <= self.window:
+                    await self._commit_vals(metas, vals)
+                    return
+                for i in range(0, len(metas), self.window):
+                    chunk = metas[i:i + self.window]
+                    await self._commit_vals(chunk,
+                                            vals[:, i:i + len(chunk)])
+            finally:
+                if not order_done.done():
+                    order_done.set_result(None)
+
+        prev, self._shard_task = (self._shard_task,
+                                  asyncio.create_task(commit()))
+        if prev is not None:  # bound in-flight commits (backpressure)
+            await prev
+
     async def _target_sharding(self):
         if self._sharding is None:
             # pages is donated through every step: read its sharding inside
             # an exclusive window once, reuse for every upload
             def grab(engine):
-                from jax.sharding import NamedSharding, PartitionSpec
+                from dynamo_tpu.parallel.sharding import transport_sharding
 
-                ref = _pages_ref(engine)
-                s = ref.sharding
-                if isinstance(engine.pages, list) \
-                        and isinstance(s, NamedSharding):
-                    # per-layer refs are rank 5; the stacked transport
-                    # array is rank 6
-                    s = NamedSharding(s.mesh, PartitionSpec(None, *s.spec))
-                return s
+                return transport_sharding(engine.pages)
             self._sharding = await self.engine.run_exclusive(grab,
                                                              self.engine)
         return self._sharding
@@ -855,10 +1096,10 @@ def serve_kv_export_bulk(engine: JaxEngine, loop):
         hashes = list(payload.get("block_hashes", []))
         # clients that predate wire v3 omit the key and get the block-major
         # v2 frames they expect (mixed-version pulls keep working)
-        layout, per, crc = resolve_wire(payload, 2)
+        layout, per, crc, shards = resolve_wire(payload, 2)
         fut = asyncio.run_coroutine_threadsafe(
             engine.run_exclusive(export_frames, engine, hashes, layout,
-                                 per),
+                                 per, shards),
             loop)
         frames = fut.result(timeout=120.0)
         if crc:  # checksummed in THIS (bulk connection) thread — never
@@ -894,9 +1135,10 @@ def serve_kv_export(engine: JaxEngine):
         hashes = list(payload.get("block_hashes", []))
         wire = int(payload.get("wire", 1))
         if wire >= 2:
-            layout, per, crc = resolve_wire(payload, 1)
+            layout, per, crc, shards = resolve_wire(payload, 1)
             frames = await engine.run_exclusive(export_frames, engine,
-                                                hashes, layout, per)
+                                                hashes, layout, per,
+                                                shards)
             if crc:  # outside the exclusive window
                 stamp_frame_crcs(frames)
             for f in frames:
@@ -1464,6 +1706,7 @@ __all__ = ["BlockPayload", "export_blocks", "inject_blocks",
            "serve_kv_export_bulk", "BLOCKS_PER_FRAME",
            "SCATTER_WINDOW_BLOCKS", "FRAME_WIRE_VERSION",
            "kv_transfer_defaults", "resolve_wire", "frame_crc_enabled",
+           "cache_shard_layout", "kv_shard_payload",
            "ExportLeaseManager", "get_export_leases", "grant_export_lease",
            "release_export_lease", "stamp_export_lease",
            "stamp_frame_crcs", "export_ttl_s", "EXPORT_TTL_S",

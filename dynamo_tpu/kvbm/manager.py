@@ -467,7 +467,10 @@ def serve_tiered_kv_export(tiered: TieredEngine):
             return
         hashes = list(payload.get("block_hashes", []))
         if int(payload.get("wire", 1)) >= 2:
-            layout, per, crc = resolve_wire(payload, 1)
+            # tiered exports serve merged frames regardless of the shard
+            # negotiation: tier-resident blocks live as unsharded host
+            # bytes, so there is no per-shard slice to stream
+            layout, per, crc, _shards = resolve_wire(payload, 1)
             frames = await tiered.engine.run_exclusive(
                 tiered_export_frames, tiered, hashes, layout, per)
             if crc:  # outside the exclusive window
@@ -496,7 +499,9 @@ def serve_tiered_kv_export_bulk(tiered: TieredEngine, loop):
     def handler(payload):
         payload = payload or {}
         hashes = list(payload.get("block_hashes", []))
-        layout, per, crc = resolve_wire(payload, 2)
+        # merged frames always — tier-resident blocks are unsharded host
+        # bytes (see serve_tiered_kv_export)
+        layout, per, crc, _shards = resolve_wire(payload, 2)
         fut = _aio.run_coroutine_threadsafe(
             tiered.engine.run_exclusive(tiered_export_frames, tiered,
                                         hashes, layout, per), loop)

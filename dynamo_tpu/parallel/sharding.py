@@ -231,4 +231,51 @@ def tp_sharding(cfg: ModelConfig, tp_size: int,
     return ModelSharding(cfg, mesh)
 
 
-__all__ = ["ModelSharding", "tp_sharding"]
+# -- transport-array sharding helpers ---------------------------------------
+# The KV transfer paths move blocks as a STACKED rank-6 array
+# [L, n, 2, Hkv, ps, Dh] regardless of whether the cache itself is the
+# stacked array or a per-layer list; these helpers are the one place the
+# cache placement -> transport placement mapping lives (engine/transfer.py
+# and the engine's sharded gather both use them).
+
+
+def transport_sharding(pages):
+    """Sharding of the stacked ``[L, n, ...]`` transport array matching the
+    cache's placement. For a per-layer list cache (rank-5 refs) the layer
+    axis is prepended to the spec; any non-Named sharding (single device)
+    passes through unchanged."""
+    ref = pages[0] if isinstance(pages, list) else pages
+    sharding = ref.sharding
+    if isinstance(pages, list) and isinstance(sharding, NamedSharding):
+        sharding = NamedSharding(sharding.mesh, P(None, *sharding.spec))
+    return sharding
+
+
+def shard_layout(sharding) -> tuple:
+    """``(shard_count, axis)`` a sharding partitions its array over:
+    ``(1, -1)`` for unpartitioned/single-device placements, ``(0, -1)``
+    when more than one axis is partitioned (the per-shard KV wire carries
+    exactly one sharded axis — multi-axis caches fall back to merged
+    frames)."""
+    if not isinstance(sharding, NamedSharding):
+        return (1, -1)
+    mesh_shape = dict(sharding.mesh.shape)
+    parted = []
+    for i, entry in enumerate(sharding.spec):
+        if entry is None:
+            continue
+        names = entry if isinstance(entry, tuple) else (entry,)
+        n = 1
+        for nm in names:
+            n *= int(mesh_shape.get(nm, 1))
+        if n > 1:
+            parted.append((n, i))
+    if not parted:
+        return (1, -1)
+    if len(parted) > 1:
+        return (0, -1)
+    return parted[0]
+
+
+__all__ = ["ModelSharding", "tp_sharding", "transport_sharding",
+           "shard_layout"]

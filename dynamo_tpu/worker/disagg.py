@@ -32,6 +32,7 @@ from dynamo_tpu.engine.transfer import (
     FrameIntegrityError,
     InjectPipeline,
     inject_device_windowed,
+    kv_shard_payload,
     pump_bulk_frames,
     stamp_export_lease,
 )
@@ -61,8 +62,11 @@ def make_device_transfer_plane(engine: JaxEngine):
     """A ``DeviceTransferPlane`` for this engine, or None when the
     device-direct path does not apply: the jax transfer API is missing,
     or the engine's cache is sharded over a mesh (a cross-process pull
-    onto a NamedSharding needs a shared global mesh — those deployments
-    keep the bulk/RPC planes)."""
+    onto a NamedSharding needs a shared global mesh). Mesh-sharded
+    deployments are NOT stuck on a host gather though: their bulk/RPC
+    pulls negotiate the wire-v5 per-shard frame schema
+    (``transfer.kv_shard_payload``), so each prefill shard's slice
+    streams straight to its decode shard's device."""
     from jax.sharding import SingleDeviceSharding
 
     try:
@@ -72,6 +76,9 @@ def make_device_transfer_plane(engine: JaxEngine):
     ref = engine.pages[0] if isinstance(engine.pages, list) else engine.pages
     if not isinstance(ref.sharding, SingleDeviceSharding) \
             and len(ref.sharding.device_set) > 1:
+        logger.info("device-direct KV plane disabled for the mesh-sharded "
+                    "cache; shard-to-shard pulls ride the wire-v5 "
+                    "per-shard frames on the bulk/RPC planes")
         return None
     from dynamo_tpu.engine.transfer import DeviceTransferPlane
     return DeviceTransferPlane()
@@ -647,6 +654,23 @@ class DisaggDecodeHandler:
         from dynamo_tpu.worker.metrics import count_metric
         count_metric("kv_frames_corrupt")
 
+    @staticmethod
+    def _note_shard_bytes(kv_span, meta, nbytes: int) -> None:
+        """Per-shard byte attrs on the kv_transfer span (wire-v5 frames
+        carry their shard index): ``bytes_shard{i}`` sums each shard's
+        wire bytes next to the per-plane totals, so an imbalanced or
+        stalled shard stream is attributable without a rerun."""
+        sh = (meta or {}).get("shard")
+        if sh is None:
+            return
+        try:
+            kv_span.set_attr("shards", int(sh["count"]))
+            key = f"bytes_shard{int(sh['index'])}"
+            kv_span.set_attr(
+                key, int(kv_span.attrs.get(key, 0)) + int(nbytes))
+        except Exception:  # noqa: BLE001 — accounting must not fail IO
+            logger.debug("shard byte accounting failed", exc_info=True)
+
     async def _pull_blocks_inner(self, hashes: list, iid: int,
                                  bulk_address: str, direct_address: str,
                                  _count_bytes, kv_span, phases) -> None:
@@ -757,20 +781,32 @@ class DisaggDecodeHandler:
                     self._note_resume(kv_span, "bulk", resumed_blocks,
                                       len(want))
                 pipe = InjectPipeline(self.engine)
+                seen_windows: set = set()
 
                 def on_meta(meta, nbytes):
                     nonlocal total
                     _count_bytes(nbytes, "bulk")
+                    self._note_shard_bytes(kv_span, meta, nbytes)
+                    if meta.get("shard") is not None:
+                        # count each block window once, not per shard slice
+                        key = tuple(b[0] for b in meta["blocks"])
+                        if key in seen_windows:
+                            return
+                        seen_windows.add(key)
                     total += len(meta["blocks"])
 
                 try:
                     # stream-and-stage (engine/transfer.pump_bulk_frames):
                     # frames stage/commit while later frames are still on
-                    # the wire, wire buffers recycle through the pipeline
+                    # the wire, wire buffers recycle through the pipeline.
+                    # A sharded cache advertises its shard layout so a
+                    # same-layout exporter streams per-shard frames
+                    # (wire v5) instead of host-gathered merged frames.
                     phases["recv_s"] += await pump_bulk_frames(
                         pipe, bulk_address, KV_EXPORT_ENDPOINT,
                         {"block_hashes": want,
-                         "wire": FRAME_WIRE_VERSION},
+                         "wire": FRAME_WIRE_VERSION,
+                         **kv_shard_payload(self.engine)},
                         f"{iid:x}", 60.0, on_meta)
                     injected += await pipe.finish()
                     bulk_done = True
@@ -829,7 +865,8 @@ class DisaggDecodeHandler:
 
                 try:
                     await self._pull_rpc(want, iid, _count_bytes, phases,
-                                         note_blocks, note_injected)
+                                         note_blocks, note_injected,
+                                         kv_span)
                     last_err = None
                     break
                 except FrameIntegrityError as e:
@@ -852,7 +889,8 @@ class DisaggDecodeHandler:
         finish_stats()
 
     async def _pull_rpc(self, want: list, iid: int, _count_bytes,
-                        phases, note_blocks, note_injected) -> None:
+                        phases, note_blocks, note_injected,
+                        kv_span=None) -> None:
         """One RPC-plane pull attempt of ``want`` through the staged
         pipeline. Blocks injected are reported through ``note_injected``
         — on the failure path too, so partial commits reaped by the drain
@@ -860,19 +898,31 @@ class DisaggDecodeHandler:
         from dynamo_tpu.runtime.codec import release_buffer
 
         kv_stream = await self._kv_client.direct(
-            {"block_hashes": want, "wire": FRAME_WIRE_VERSION}, iid)
+            {"block_hashes": want, "wire": FRAME_WIRE_VERSION,
+             **kv_shard_payload(self.engine)}, iid)
         # batched two-part frames through the staged pipeline: frame k
         # stages/commits while frame k+1 is still in flight (zero
         # msgpack re-copies). Old exporters answering with the
         # per-block schema ride the same pipeline via add_blocks.
         pipe = InjectPipeline(self.engine)
+        seen_windows: set = set()
         try:
             t0 = time.perf_counter()
             async for frame in kv_stream:
                 phases["recv_s"] += time.perf_counter() - t0
                 if "_raw" in frame:
                     _count_bytes(len(frame["_raw"]), "rpc")
-                    note_blocks(len(frame["blocks"]))
+                    if kv_span is not None:
+                        self._note_shard_bytes(kv_span, frame,
+                                               len(frame["_raw"]))
+                    if frame.get("shard") is not None:
+                        key = tuple(b[0] for b in frame["blocks"])
+                        if key not in seen_windows:
+                            seen_windows.add(key)
+                            note_blocks(len(frame["blocks"]))
+                        # fall through to staging either way
+                    else:
+                        note_blocks(len(frame["blocks"]))
                     # pipeline recycles the pooled trailer buffer
                     # once its bytes are consumed
                     await pipe.add_frame(frame, release=release_buffer)

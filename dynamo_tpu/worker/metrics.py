@@ -135,9 +135,12 @@ class EngineDispatchCollector:
 
     # the known fallback reasons, pre-seeded so every label shows on the
     # scrape at 0 and dashboards/alerts can reference them before the
-    # first refusal happens
+    # first refusal happens. "mesh" is GONE on purpose: sharded engines
+    # run the fused block program (explicit in/out shardings) — a mesh
+    # engine reporting fallbacks again would be a regression, and the
+    # parity suite asserts the counter stays 0 there.
     FALLBACK_REASONS = ("waiters", "prefill", "penalties", "guided",
-                        "spec", "budget", "pages", "mesh", "multihost")
+                        "spec", "budget", "pages", "multihost")
 
     def __init__(self, registry: CollectorRegistry):
         self._source: Optional[Callable[[], Dict[str, float]]] = None
@@ -165,8 +168,9 @@ class EngineDispatchCollector:
             "dynamo_worker_multistep_fallback",
             "Fused multi-step decode refusals by reason (waiters/prefill "
             "only with DYN_MIXED_BATCH=0; penalties/guided/spec/budget/"
-            "pages from the block planner; mesh/multihost from the "
-            "engine mode)", labels=["reason"])
+            "pages from the block planner; multihost from the engine "
+            "mode — mesh-sharded engines fuse and never fall back)",
+            labels=["reason"])
         reasons = dict.fromkeys(self.FALLBACK_REASONS, 0.0)
         reasons.update(stats.get("multistep_fallbacks") or {})
         for reason, value in sorted(reasons.items()):

@@ -465,8 +465,10 @@ class TestMetricsSurface:
         by_reason = {s.labels["reason"]: s.value for s in fb.samples
                      if s.name.endswith("_total")}
         assert by_reason["penalties"] == 3.0
-        # pre-seeded labels show at zero before any refusal
-        assert by_reason["waiters"] == 0.0 and by_reason["mesh"] == 0.0
+        # pre-seeded labels show at zero before any refusal; "mesh" is no
+        # longer a reason at all — sharded engines fuse (PR 10)
+        assert by_reason["waiters"] == 0.0 and by_reason["multihost"] == 0.0
+        assert "mesh" not in by_reason
 
 
 # -- engine-internal caches ----------------------------------------------
