@@ -647,6 +647,222 @@ async def _measure_mesh_sharded(wd=None) -> dict:
     return result
 
 
+# drain-leg geometry: streams in flight when the scale-down lands, and
+# tokens per stream (long enough that every stream straddles the handoff)
+DRAIN_STREAMS = int(os.environ.get("BENCH_DRAIN_STREAMS", "6"))
+DRAIN_TOKENS = int(os.environ.get("BENCH_DRAIN_TOKENS", "24"))
+
+
+async def _measure_drain(wd=None) -> dict:
+    """Graceful-drain leg (ROADMAP item 4, the scale-down half of "zero
+    lost streams"): a real coordinator + two decode workers + a routed
+    frontend pipeline, with one worker SIGTERM'd while every stream is
+    mid-decode.  The drained worker freezes its in-flight sequences into
+    pinned-KV resume tokens; survivors pull and continue from the next
+    token.  Records streams lost (must be 0), resume-vs-replay handoff
+    counts, how many resumed rows admitted with their full prefix cached
+    (zero recomputed prefill tokens), and the inter-token gap
+    distribution — ``itg_p99_ms`` prices the handoff stall the user sees
+    against ``itg_p50_ms``, the undisturbed decode cadence."""
+    from dynamo_tpu.engine.jax_engine import JaxEngine, JaxEngineConfig
+    from dynamo_tpu.engine.transfer import serve_kv_export
+    from dynamo_tpu.llm.pipeline import RemotePipeline
+    from dynamo_tpu.llm.register import register_llm, serve_engine
+    from dynamo_tpu.models.config import ModelConfig
+    from dynamo_tpu.protocols.common import (
+        PreprocessedRequest, SamplingOptions, StopConditions)
+    from dynamo_tpu.runtime.coordinator import Coordinator
+    from dynamo_tpu.runtime.push_router import PushRouter
+    from dynamo_tpu.runtime.runtime import DistributedRuntime
+    from dynamo_tpu.utils.faults import WorkerDrain
+    from dynamo_tpu.utils.testing import make_test_card
+    from dynamo_tpu.worker.disagg import KV_EXPORT_ENDPOINT
+    from dynamo_tpu.worker.drain import ResumeAdmission
+    from dynamo_tpu.worker.metrics import get_worker_metrics
+
+    if wd is not None:
+        wd.arm("measure:drain", STAGE_BUDGETS["measure"])
+    eng_cfg = JaxEngineConfig(num_pages=256, page_size=4, max_num_seqs=8,
+                              max_prefill_chunk=64, max_context=512,
+                              min_prefill_bucket=4, decode_multistep=1)
+
+    def paced(engine, seconds=0.01):
+        # slow each step so the drain deterministically lands mid-stream
+        orig = engine._execute_plan
+        engine._execute_plan = lambda plan: (time.sleep(seconds),
+                                             orig(plan))[1]
+        return engine
+
+    async def start_worker(address):
+        import jax
+
+        drt = await DistributedRuntime.create(coordinator=address)
+        engine = paced(JaxEngine.random_init(ModelConfig.tiny(), eng_cfg))
+        # commit the page pool to its device NOW: the first KV inject
+        # commits it anyway (explicit device_put), and the jit cache keys
+        # on committedness — left uncommitted, the survivor would
+        # recompile its whole program set right after the first resume
+        # pull lands, burying the handoff gap under XLA compiles
+        pg = engine.pages
+        engine.pages = ([jax.device_put(p, next(iter(p.devices())))
+                         for p in pg] if isinstance(pg, list)
+                        else jax.device_put(pg, next(iter(pg.devices()))))
+        comp = drt.namespace("bench").component("decode")
+        await comp.endpoint(KV_EXPORT_ENDPOINT).serve(serve_kv_export(engine))
+        ra = ResumeAdmission(
+            engine, kv_client=await comp.endpoint(KV_EXPORT_ENDPOINT)
+            .client())
+        served = await serve_engine(comp.endpoint("generate"), engine,
+                                    resume_admission=ra)
+        await register_llm(drt, comp.endpoint("generate"),
+                           make_test_card(name="bench-drain",
+                                          kv_cache_block_size=4))
+        lease = await drt.primary_lease()
+        return WorkerDrain(drt, engine, served=[served],
+                           resume_extras={"instance_id": lease.lease_id})
+
+    wm = get_worker_metrics()
+    resumes0 = wm.migration_replays.labels("resume")._value.get()
+    replays0 = wm.migration_replays.labels("replay")._value.get()
+    coord = await Coordinator(port=0).start()
+    workers, fe = [], None
+    try:
+        workers = [await start_worker(coord.address) for _ in range(2)]
+        fe = await DistributedRuntime.create(coordinator=coord.address)
+        client = await (fe.namespace("bench").component("decode")
+                        .endpoint("generate").client())
+        await client.wait_for_instances(2, timeout=10)
+        pipeline = RemotePipeline(
+            make_test_card(name="bench-drain", kv_cache_block_size=4),
+            PushRouter(client), migration_limit=3)
+
+        def prime_grid(engine):
+            """Compile the full (kind x batch-bucket x width-bucket)
+            program grid this engine can hit while absorbing a handoff,
+            via direct synthetic dispatches (no requests).  A survivor's
+            batch composition after adopting resumed rows is
+            timing-dependent, so request-level warmup cannot cover the
+            space — and any shape missed shows up as a multi-second XLA
+            compile right where the gap metric is measured."""
+            import jax
+
+            P = engine.table_width
+            B = 1
+            while B <= eng_cfg.max_num_seqs:
+                for S in (4, 8, 16):
+                    jax.block_until_ready(engine._invoke_step(
+                        "step", _step_arrays(P, B, S), 0))
+                    jax.block_until_ready(engine._invoke_step(
+                        "mixed", _step_arrays(P, B, S), 0))
+                jax.block_until_ready(engine._invoke_step(
+                    "step", _step_arrays(P, B, 1), 0))
+                jax.block_until_ready(engine._invoke_step(
+                    "chained", _step_arrays(P, B, 1), 0))
+                B *= 2
+
+        # prime off the event loop (each compile blocks ~1s; lease
+        # renewal and keepalive must keep running underneath)
+        for w in workers:
+            await asyncio.to_thread(prime_grid, w.engine)
+
+        async def warm(i: int, tokens):
+            req = PreprocessedRequest(
+                token_ids=list(tokens), request_id=f"warm{i}",
+                stop_conditions=StopConditions(max_tokens=4,
+                                               ignore_eos=True),
+                sampling_options=SamplingOptions(temperature=0.0))
+            async for _ in pipeline.engine_stream(req):
+                pass
+
+        # a light request-level pass compiles the non-step glue (embed,
+        # sampling upload) on both workers
+        base = list(range(1, 14))
+        await asyncio.gather(*[warm(4 * i + j, (base, base[:4])[j % 2])
+                               for j in range(4) for i in range(2)])
+
+        stamps: list[list[float]] = [[] for _ in range(DRAIN_STREAMS)]
+        finals: list = [None] * DRAIN_STREAMS
+        started = [asyncio.Event() for _ in range(DRAIN_STREAMS)]
+
+        async def drive(i: int):
+            req = PreprocessedRequest(
+                token_ids=list(range(1 + i, 14 + i)),
+                request_id=f"drain{i}",
+                stop_conditions=StopConditions(max_tokens=DRAIN_TOKENS,
+                                               ignore_eos=True),
+                sampling_options=SamplingOptions(temperature=0.0))
+            async for out in pipeline.engine_stream(req):
+                stamps[i].extend([time.perf_counter()] * len(out.token_ids))
+                if len(stamps[i]) >= 3:
+                    started[i].set()
+                if out.finish_reason is not None:
+                    finals[i] = out
+            started[i].set()
+
+        tasks = [asyncio.ensure_future(drive(i))
+                 for i in range(DRAIN_STREAMS)]
+        await asyncio.gather(*[asyncio.wait_for(ev.wait(), 60)
+                               for ev in started])
+        # scale down whichever worker holds streams right now
+        busy = next((w for w in workers if w.engine.scheduler.active),
+                    workers[0])
+        t0 = time.perf_counter()
+        counts = await busy.sigterm()
+        drain_s = time.perf_counter() - t0
+        await asyncio.gather(*tasks)
+
+        lost = sum(1 for i, f in enumerate(finals)
+                   if f is None or len(stamps[i]) < DRAIN_TOKENS)
+        # resumed rows that admitted with their whole computed prefix
+        # cached — i.e. zero prefill tokens recomputed by the survivor
+        # (every prompt above is exactly 13 tokens long)
+        full_cache = sum(1 for f in finals
+                         if f is not None and (f.cached_tokens or 0) >= 13)
+        if os.environ.get("BENCH_DRAIN_DEBUG"):
+            for i, s in enumerate(stamps):
+                worst = max((b - a, k) for k, (a, b)
+                            in enumerate(zip(s, s[1:])))
+                print(f"drain-debug stream {i}: {len(s)} tokens, worst "
+                      f"gap {worst[0] * 1e3:.0f}ms at token {worst[1] + 1}"
+                      f" (t={s[worst[1] + 1] - t0:+.2f}s vs drain)",
+                      file=sys.stderr, flush=True)
+        gaps = sorted(b - a for s in stamps if len(s) > 1
+                      for a, b in zip(s, s[1:]))
+        pick = lambda q: (gaps[min(len(gaps) - 1, int(q * len(gaps)))]  # noqa: E731
+                          if gaps else None)
+        result = {
+            "streams": DRAIN_STREAMS,
+            "streams_lost": lost,
+            "migrated_resume": int(counts.get("resume", 0)),
+            "migrated_replay": int(counts.get("replay", 0)),
+            "absorbed_resume": int(
+                wm.migration_replays.labels("resume")._value.get()
+                - resumes0),
+            "absorbed_replay": int(
+                wm.migration_replays.labels("replay")._value.get()
+                - replays0),
+            "resumed_full_cache": full_cache,
+            "drain_s": round(drain_s, 3),
+            "itg_p50_ms": (round(pick(0.50) * 1e3, 2)
+                           if gaps else None),
+            "itg_p99_ms": (round(pick(0.99) * 1e3, 2)
+                           if gaps else None),
+            "itg_max_ms": round(gaps[-1] * 1e3, 2) if gaps else None,
+        }
+        _ckpt("drain", **{k: v for k, v in result.items()
+                          if k != "streams"})
+        return result
+    finally:
+        for w in workers:
+            try:
+                await w._close()
+            except Exception:  # noqa: BLE001 — already closed by sigterm
+                pass
+        if fe is not None:
+            await fe.close()
+        await coord.stop()
+
+
 async def run_attempt(args) -> dict:
     """The whole attempt, one process: build -> prime -> measure ->
     transports -> optional attn-impl A/B. ``jax_init`` already happened in
@@ -818,6 +1034,15 @@ async def run_attempt(args) -> dict:
         result["mesh_sharded"] = await _measure_mesh_sharded(wd)
     except Exception as e:  # noqa: BLE001 — best-effort extra data
         result["mesh_sharded"] = {"error": str(e)[:300]}
+    print(json.dumps(result), flush=True)
+
+    # graceful-drain leg: SIGTERM one of two decode workers mid-trace —
+    # streams_lost must be 0, resumed rows admit with their full prefix
+    # cached, and itg_p99 prices the handoff stall
+    try:
+        result["drain"] = await _measure_drain(wd)
+    except Exception as e:  # noqa: BLE001 — best-effort extra data
+        result["drain"] = {"error": str(e)[:300]}
     print(json.dumps(result), flush=True)
 
     # attn-impl A/B in the SAME process (round-4 open question:

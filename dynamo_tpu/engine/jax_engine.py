@@ -974,7 +974,15 @@ class JaxEngine(ScheduledEngineBase):
                 rp[i] = r
             from collections import Counter
             counts = Counter(seq.generated)
-            prompt_set = (set(seq.tokens.tokens()[:seq.num_prompt])
+            # migration replay/resume: the trailing ``resumed_tokens`` of
+            # the prompt were GENERATED by earlier legs of this stream —
+            # frequency/presence penalties must keep counting them, not
+            # reclassify them as prompt after the hop
+            n_prompt = seq.num_prompt - min(
+                seq.request.resumed_tokens or 0, seq.num_prompt)
+            if n_prompt < seq.num_prompt:
+                counts.update(seq.tokens.tokens()[n_prompt:seq.num_prompt])
+            prompt_set = (set(seq.tokens.tokens()[:n_prompt])
                           if rep_on else set())
             # entry = (token, generated-count, in-context). logit_bias
             # entries come FIRST (explicit client asks win the window),

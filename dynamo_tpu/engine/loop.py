@@ -53,6 +53,23 @@ from dynamo_tpu.protocols.events import ForwardPassMetrics, KvCacheEvent
 
 logger = logging.getLogger(__name__)
 
+# kv_transfer_params key carrying a stream's migration/resume token: a
+# frame with this key is the LAST frame of a gracefully-drained stream.
+# An empty token ({}) means "replay from scratch on a survivor"; a
+# populated one carries the pinned-KV resume state (blocks under an
+# export lease + sampling budgets) the survivor admits against.
+MIGRATION_KEY = "migration"
+
+
+def migration_token(out: "LLMEngineOutput") -> Optional[dict]:
+    """The migration/resume token on a frame, or None for ordinary
+    frames — the one place the frame shape is interpreted (engine loop,
+    serving handler, and migration operator all key on it)."""
+    if out.kv_transfer_params is None:
+        return None
+    tok = out.kv_transfer_params.get(MIGRATION_KEY)
+    return tok if isinstance(tok, dict) else None
+
 
 class ScheduledEngineBase(EngineBase):
     """Continuous batching over a PageAllocator; subclasses do the math."""
@@ -100,6 +117,15 @@ class ScheduledEngineBase(EngineBase):
         # drained between steps so nothing else ever touches pages/allocator
         # while a (pages-donating) jitted step is in flight
         self._exclusive: Deque[Tuple[Callable, tuple, asyncio.Future]] = deque()
+        # graceful drain: once set, new requests are refused with a replay
+        # marker (the router is already routing around this worker) and
+        # ``drain_migrate`` freezes the in-flight ones. The loop itself
+        # keeps running — it still serves the exclusive-window KV exports
+        # survivors pull the frozen sequences' pinned pages through.
+        # ``_drain_leases`` holds the lease ids the freeze granted, so the
+        # drain controller waits on exactly those (not unrelated exports).
+        self.draining = False
+        self._drain_leases: List[int] = []
 
     # -- subclass hook -----------------------------------------------------
 
@@ -703,6 +729,144 @@ class ScheduledEngineBase(EngineBase):
             self._loop_task = None
         self._fail_exclusive("engine stopped")
 
+    # -- graceful drain ----------------------------------------------------
+
+    async def drain_migrate(self, resume_extras: Optional[dict] = None
+                            ) -> Dict[str, int]:
+        """Freeze every in-flight sequence at a step boundary and hand its
+        stream to the migration layer.
+
+        Runs serialized with the step loop (``run_exclusive``), so no step
+        is in flight while sequences are frozen: each active sequence's
+        full pages are committed to the prefix cache, pinned under a TTL'd
+        export lease, and a resume token (block chain + lease + sampling
+        budgets + ``resume_extras`` — the worker's pull coordinates) is
+        emitted as the stream's last frame. The serving layer relays the
+        token and ends the stream through the failover path, so the
+        frontend's MigrationOperator turns it into a *resume* on a
+        survivor. Sequences with nothing committed (still queued, early
+        prefill) get an empty token — a plain replay. Engines that cannot
+        export KV (the mocker) always emit empty tokens.
+
+        Idempotent; returns ``{"resume": n, "replay": m}`` counts."""
+        self.draining = True
+        self._work.set()
+        extras = dict(resume_extras or {})
+        # only engines whose pages hold real, exportable KV can offer a
+        # resume (the export handlers gather through this same hook)
+        can_export = hasattr(self, "dispatch_gather_pages")
+        try:
+            frames, ttl = await self.run_exclusive(
+                self._freeze_sync, extras, can_export)
+        except RuntimeError:
+            # loop dead or stopped: _fail_all_requests already terminated
+            # every stream — nothing left to migrate
+            return {"resume": 0, "replay": 0}
+        counts = {"resume": 0, "replay": 0}
+        for rid, out in frames:
+            tok = migration_token(out)
+            if tok is not None:
+                counts["resume" if tok.get("blocks") else "replay"] += 1
+                if tok.get("lease") is not None:
+                    self._drain_leases.append(tok["lease"])
+            q = self._queues.get(rid)
+            if q is not None:
+                q.put_nowait(out)
+        if ttl is not None:
+            from dynamo_tpu.engine.transfer import get_export_leases
+            mgr = get_export_leases(self)
+            if mgr is not None:
+                mgr.arm_sweep(ttl)
+        if counts["resume"] or counts["replay"]:
+            logger.info("drain froze %d stream(s): %d resumable, %d replay",
+                        counts["resume"] + counts["replay"],
+                        counts["resume"], counts["replay"])
+        return counts
+
+    def _freeze_sync(self, extras: dict, can_export: bool):
+        """Exclusive-window half of ``drain_migrate``: commit, pin, build
+        the per-stream migration frames. Returns (frames, lease_ttl)."""
+        from dynamo_tpu.engine.transfer import export_ttl_s, get_export_leases
+        sched = self.scheduler
+        frames: List[Tuple[str, LLMEngineOutput]] = []
+        mgr = get_export_leases(self) if can_export else None
+        ttl = None
+        # queued-but-unadmitted requests: nothing computed — replay markers
+        while sched.waiting:
+            seq = sched.waiting.popleft()
+            seq.phase = Phase.FINISHED
+            if seq.cancelled:
+                frames.append((seq.request.request_id, LLMEngineOutput(
+                    finish_reason=FinishReason.CANCELLED,
+                    prompt_tokens=seq.num_prompt, completion_tokens=0)))
+                continue
+            frames.append((seq.request.request_id,
+                           LLMEngineOutput(kv_transfer_params={
+                               MIGRATION_KEY: {}})))
+        for seq in list(sched.active.values()):
+            rid = seq.request.request_id
+            if seq.cancelled:
+                sched.finish(seq)
+                frames.append((rid, LLMEngineOutput(
+                    finish_reason=FinishReason.CANCELLED,
+                    prompt_tokens=seq.num_prompt,
+                    completion_tokens=len(seq.generated))))
+                continue
+            sched._commit_full_pages(seq)
+            resume: dict = {}
+            blocks = seq.tokens.blocks[:seq.committed_pages]
+            if mgr is not None and blocks and not seq.request.prefill_only:
+                ttl = export_ttl_s() if ttl is None else ttl
+                lease, pinned = mgr.grant_sync(
+                    [b.block_hash for b in blocks], ttl)
+                sc = seq.request.stop_conditions
+                n = len(seq.generated)
+                # tokens the STREAM generated across all legs: an earlier
+                # migration's output rides the rebuilt prompt's tail
+                # (request.resumed_tokens), this leg's is seq.generated —
+                # tokens_done and the stop tail must be cumulative or a
+                # SECOND drain of the same stream would always fail the
+                # operator's desync check and degrade to a full replay
+                resumed0 = seq.request.resumed_tokens or 0
+                toks = list(seq.request.token_ids)
+                stream_gen = toks[len(toks) - resumed0:] + \
+                    list(seq.generated)
+                resume = {
+                    "blocks": [[b.block_hash, b.local_hash,
+                                b.parent_hash if b.position else None]
+                               for b in blocks],
+                    "page_size": self.allocator.page_size,
+                    "num_tokens_cached": len(blocks)
+                    * self.allocator.page_size,
+                    "tokens_done": resumed0 + n,
+                    # sampling state for the survivor: remaining budgets
+                    # (leg-relative; diagnostic), the rng step position,
+                    # and the stream's generated tail — the migration
+                    # operator verifies the tail against the client-side
+                    # stream before trusting the token (content-level
+                    # desync check on top of the tokens_done count)
+                    "sampling": {
+                        "rng_step": seq.decode_steps,
+                        "max_tokens_left": (sc.max_tokens - n
+                                            if sc.max_tokens is not None
+                                            else None),
+                        "min_tokens_left": max(0, (sc.min_tokens or 0) - n),
+                        "stop_tail": stream_gen[-4:],
+                    },
+                    **extras,
+                }
+                if lease is not None:
+                    resume["lease"] = lease
+                if pinned < len(blocks):
+                    logger.warning(
+                        "drain pinned %d/%d pages of %s (lease cap); the "
+                        "unpinned tail may be evicted before the pull",
+                        pinned, len(blocks), rid)
+            sched.finish(seq)  # releases the seq's refs; leased pages stay
+            frames.append((rid, LLMEngineOutput(
+                kv_transfer_params={MIGRATION_KEY: resume})))
+        return frames, ttl
+
     # -- public API --------------------------------------------------------
 
     async def generate(self, request: PreprocessedRequest,
@@ -718,6 +882,23 @@ class ScheduledEngineBase(EngineBase):
             return
         rid = request.request_id or f"req-{id(request):x}"
         request.request_id = rid
+        if self.draining:
+            # the router is already routing around this worker; a request
+            # that raced the announcement is handed straight back to the
+            # migration layer (empty token = replay on a survivor) instead
+            # of being admitted onto an engine that is shutting down
+            yield LLMEngineOutput(kv_transfer_params={MIGRATION_KEY: {}})
+            return
+        if rid in self._queues:
+            # a reused request id would silently clobber the first stream's
+            # queue (its finally would then pop THIS stream's queue and the
+            # second caller hangs forever) — refuse loudly instead; replay
+            # and resume admissions derive unique ids for this reason
+            yield LLMEngineOutput(
+                finish_reason=FinishReason.ERROR,
+                error=(f"duplicate request_id {rid!r}: a request with this "
+                       "id is already in flight on this engine"))
+            return
         if len(request.token_ids) >= self.max_context:
             yield LLMEngineOutput(
                 finish_reason=FinishReason.ERROR,
@@ -757,6 +938,11 @@ class ScheduledEngineBase(EngineBase):
                 yield out
                 if out.finish_reason is not None:
                     return
+                if migration_token(out) is not None:
+                    # drain froze this sequence: the token is the stream's
+                    # last frame — the serving layer relays it and breaks
+                    # the stream through the failover path
+                    return
         finally:
             self.scheduler.cancel(rid)
             self._queues.pop(rid, None)
@@ -766,4 +952,4 @@ class ScheduledEngineBase(EngineBase):
         return self.scheduler.metrics()
 
 
-__all__ = ["ScheduledEngineBase"]
+__all__ = ["ScheduledEngineBase", "MIGRATION_KEY", "migration_token"]

@@ -22,7 +22,7 @@ auditing, shadow traffic, ...) without forking the pipeline classes —
 from __future__ import annotations
 
 import logging
-from typing import AsyncIterator, Callable, List, Sequence
+from typing import AsyncIterator, Callable, List, Optional, Sequence
 
 from dynamo_tpu.protocols.common import (
     FinishReason,
@@ -63,25 +63,47 @@ def link(operators: Sequence[Operator], sink: Source) -> Source:
 
 
 class MigrationOperator(Operator):
-    """Retry-on-stream-drop with token continuation.
+    """Retry-on-stream-drop with token continuation — and, when the
+    dropped worker shipped a resume token, live resumption.
 
     On a mid-stream drop the request is rebuilt with the tokens generated
     so far appended and re-issued to the downstream source — the request
     migrates to another worker (reference ``migration.rs:38-131``; the
     drop signal is the missing ``final`` sentinel, surfaced as
-    ``StreamEndedError``)."""
+    ``StreamEndedError``).
+
+    A gracefully DRAINING worker ends each stream with a migration frame
+    (``kv_transfer_params["migration"]``, never yielded downstream)
+    carrying a resume token: the committed KV block chain pinned under an
+    export lease plus the sampling budgets already consumed. The rebuild
+    then attaches the token, so the survivor pulls the pinned pages and
+    admits with the full prefix cached (``mode="resume"``) — from the
+    client's point of view the stream just keeps emitting, with no
+    recomputed prefill. A token whose ``tokens_done`` disagrees with what
+    this operator actually yielded is discarded (safe replay beats a
+    desynced resume)."""
 
     def __init__(self, migration_limit: int = 3):
         self.migration_limit = migration_limit
 
     async def call(self, request: PreprocessedRequest,
                    next_source: Source) -> AsyncIterator[LLMEngineOutput]:
+        from dynamo_tpu.engine.loop import migration_token
+
         generated: List[int] = []  # tokens already yielded downstream
         attempt = 0
         req = request
+        resume = None  # resume token from a draining worker, if any
         while True:
             try:
                 async for out in next_source(req):
+                    tok = migration_token(out)
+                    if tok is not None:
+                        # internal frame: stash the token, never yield it —
+                        # the stream is about to break through the
+                        # failover path
+                        resume = tok
+                        continue
                     generated.extend(out.token_ids)
                     yield out
                     if out.finish_reason is not None:
@@ -97,30 +119,77 @@ class MigrationOperator(Operator):
                               f"(after {attempt - 1} migrations)",
                         finish_reason=FinishReason.ERROR)
                     return
-                req = self._rebuild(request, generated, attempt)
+                if resume is not None and not resume.get("blocks"):
+                    resume = None  # empty token = explicit replay marker
+                if (resume is not None and
+                        resume.get("tokens_done") != len(generated)):
+                    # the worker froze a different stream state than the
+                    # client saw — resume would desync; replay is safe
+                    logger.warning(
+                        "request %s resume token desynced (worker froze "
+                        "%s tokens, client saw %d); replaying",
+                        request.request_id, resume.get("tokens_done"),
+                        len(generated))
+                    resume = None
+                if resume is not None:
+                    # content-level cross-check on top of the count: the
+                    # token carries the stream's generated tail — if it
+                    # differs from what the client actually received, the
+                    # pinned KV belongs to a different stream state
+                    tail = (resume.get("sampling") or {}).get("stop_tail")
+                    if tail and list(tail) != generated[-len(tail):]:
+                        logger.warning(
+                            "request %s resume token tail mismatch; "
+                            "replaying", request.request_id)
+                        resume = None
+                mode = "resume" if resume is not None else "replay"
+                req = self._rebuild(request, generated, attempt, resume)
                 span = get_tracer().current_span()
                 if span is not None:
-                    # the replay keeps the SAME trace: the event marks where
-                    # the first worker's spans stop and the survivor's begin
+                    # the migration keeps the SAME trace: the event marks
+                    # where the first worker's spans stop and the
+                    # survivor's begin, and whether the survivor resumes
+                    # the pinned KV or replays from scratch
                     span.add_event("migration", attempt=attempt,
-                                   tokens_done=len(generated), error=str(e))
+                                   tokens_done=len(generated),
+                                   mode=mode,
+                                   resumed_tokens=(len(generated)
+                                                   if mode == "resume"
+                                                   else 0),
+                                   error=str(e))
                 logger.warning(
-                    "migrating request %s (attempt %d/%d, %d tokens done)",
-                    request.request_id, attempt, self.migration_limit,
-                    len(generated))
+                    "migrating request %s (attempt %d/%d, %d tokens done, "
+                    "mode=%s)", request.request_id, attempt,
+                    self.migration_limit, len(generated), mode)
+                resume = None  # consumed; the next leg ships its own
 
     @staticmethod
     def _rebuild(original: PreprocessedRequest,
                  generated: List[int],
-                 attempt: int = 0) -> PreprocessedRequest:
+                 attempt: int = 0,
+                 resume: Optional[dict] = None) -> PreprocessedRequest:
         req = PreprocessedRequest.from_dict(original.to_dict())
         req.token_ids = list(original.token_ids) + list(generated)
-        # the receiving worker counts replays it absorbs
-        # (dynamo_worker_migration_replays_total)
+        # the receiving worker counts replays/resumes it absorbs
+        # (dynamo_worker_migration_replays_total{mode})
         req.migration_attempt = attempt
+        # a derived id per attempt: an engine that sees a reused
+        # request_id refuses it (the PR 6 wedge), and a replay CAN land
+        # back on the worker that still holds the original stream's state
+        if original.request_id:
+            req.request_id = f"{original.request_id}~m{attempt}"
+        # the appended tail is generated output, not prompt: the engine
+        # reconstructs penalty windows (and budget accounting) from this
+        req.resumed_tokens = len(generated)
         sc = req.stop_conditions
         if sc.max_tokens is not None:
             sc.max_tokens = max(1, sc.max_tokens - len(generated))
+        if sc.min_tokens is not None:
+            # the survivor counts generated tokens from zero again
+            sc.min_tokens = max(0, sc.min_tokens - len(generated))
+        if resume is not None:
+            from dynamo_tpu.engine.loop import MIGRATION_KEY
+            req.kv_transfer_params = {MIGRATION_KEY: dict(resume)}
         return req
 
 

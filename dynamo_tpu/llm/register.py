@@ -21,7 +21,8 @@ from dynamo_tpu.runtime.runtime import DistributedRuntime
 logger = logging.getLogger(__name__)
 
 
-def engine_handler(engine: EngineBase) -> Callable:
+def engine_handler(engine: EngineBase,
+                   resume_admission: Optional[Any] = None) -> Callable:
     """Bridge an EngineBase into an RPC endpoint handler (dict payloads).
 
     Deadline enforcement: a request that arrives already expired is refused
@@ -36,16 +37,34 @@ def engine_handler(engine: EngineBase) -> Callable:
     (including adopted disagg sub-hops) ships back to the caller on the
     final frame (``trace_spans``) so the frontend's flight recorder holds
     one stitched tree.  Admission outcomes feed the worker-side counters
-    (``dynamo_worker_requests_total``)."""
+    (``dynamo_worker_requests_total``).
+
+    Migration: an inbound resume token (``kv_transfer_params["migration"]``
+    on a migration re-issue from the frontend) is handed to
+    ``resume_admission`` (``worker/drain.ResumeAdmission``), which pulls
+    the draining worker's pinned KV so admission resumes instead of
+    recomputing; without one the token is stripped and the request replays.
+    An OUTBOUND migration frame (this engine is draining) is relayed with
+    this worker's trace fragment attached and the stream is ended through
+    the failover path (``StreamMigrationSignal`` -> ``drop``), so the
+    frontend's MigrationOperator fires immediately."""
 
     async def handler(payload: Any, ctx) -> AsyncIterator[Any]:
+        from dynamo_tpu.engine.loop import MIGRATION_KEY, migration_token
         from dynamo_tpu.protocols.common import FinishReason
+        from dynamo_tpu.runtime.rpc import StreamMigrationSignal
         from dynamo_tpu.utils.tracing import (
             SPANS_FRAME_KEY, StageStitcher, get_tracer)
         from dynamo_tpu.worker.metrics import get_worker_metrics
         tracer = get_tracer()
         metrics = get_worker_metrics()
         request = PreprocessedRequest.from_dict(payload)
+        # same dict guard migration_token() applies to frames: a
+        # malformed token is stripped-and-replayed, never forwarded
+        inbound_resume = (request.kv_transfer_params or {}).get(
+            MIGRATION_KEY)
+        if not isinstance(inbound_resume, dict):
+            inbound_resume = None if inbound_resume is None else {}
         hop = tracer.start_hop(
             "worker.generate",
             headers=getattr(ctx, "headers", None),
@@ -53,8 +72,13 @@ def engine_handler(engine: EngineBase) -> Callable:
                    "endpoint": getattr(ctx, "endpoint", ""),
                    "prompt_tokens": len(request.token_ids)})
         if request.migration_attempt:
-            metrics.migration_replays.inc()
+            mode = ("resume" if (inbound_resume or {}).get("blocks")
+                    else "replay")
+            metrics.migration_replays.labels(mode).inc()
             hop.set_attr("migration_attempt", request.migration_attempt)
+            hop.set_attr("migration_mode", mode)
+            if request.resumed_tokens:
+                hop.set_attr("resumed_tokens", request.resumed_tokens)
         if ctx is not None and getattr(ctx, "deadline_expired", False):
             logger.warning("request %s arrived with its deadline already "
                            "expired; dropping", request.request_id)
@@ -66,6 +90,21 @@ def engine_handler(engine: EngineBase) -> Callable:
             final[SPANS_FRAME_KEY] = tracer.finish_hop(hop)
             yield final
             return
+        if inbound_resume is not None:
+            # consume the token NOW: downstream (engine, disagg handler)
+            # must never mistake it for a prefill-first KV handoff. Runs
+            # AFTER the deadline refusal — an already-expired migrated
+            # request must not trigger a pointless cross-worker KV pull —
+            # and skips the pull when THIS engine is draining too
+            # (rolling restart overlap): generate() is about to bounce
+            # the request with a replay marker anyway
+            request.kv_transfer_params = None
+            draining = (getattr(engine, "draining", False)
+                        or getattr(getattr(engine, "engine", None),
+                                   "draining", False))
+            if resume_admission is not None and not draining:
+                await resume_admission.admit(request, inbound_resume,
+                                             span=hop)
         metrics.requests_total.labels("admitted").inc()
         stitcher = StageStitcher(tracer, parent=hop,
                                  skip_decode=request.prefill_only)
@@ -97,6 +136,19 @@ def engine_handler(engine: EngineBase) -> Callable:
                     final[SPANS_FRAME_KEY] = tracer.finish_hop(hop)
                     yield final
                     return
+                if (out.finish_reason is None
+                        and migration_token(out) is not None):
+                    # this engine is draining: ship the resume token as the
+                    # stream's last data frame (with this worker's trace
+                    # fragment, so the handoff is attributable), then end
+                    # the stream through the failover path — the caller's
+                    # MigrationOperator resumes it on a survivor
+                    stitcher.close()
+                    hop.set_attr("migrated_out", True)
+                    final = out.to_dict()
+                    final[SPANS_FRAME_KEY] = tracer.finish_hop(hop)
+                    yield final
+                    raise StreamMigrationSignal(request.request_id)
                 if out.finish_reason is not None:
                     if out.error:
                         metrics.requests_total.labels("error").inc()
@@ -130,11 +182,12 @@ def engine_handler(engine: EngineBase) -> Callable:
 
 
 async def serve_engine(endpoint: Endpoint, engine: EngineBase,
-                       stats_provider: Optional[Callable[[], Any]] = None
+                       stats_provider: Optional[Callable[[], Any]] = None,
+                       resume_admission: Optional[Any] = None
                        ) -> ServedEndpoint:
     """Serve an engine's generate loop on a runtime endpoint."""
     await engine.start()
-    return await endpoint.serve(engine_handler(engine),
+    return await endpoint.serve(engine_handler(engine, resume_admission),
                                 stats_provider=stats_provider)
 
 

@@ -65,8 +65,9 @@ async def amain(args: argparse.Namespace) -> None:
         engine.kv_event_cb, event_pump = ordered_kv_publisher(
             drt, kv_events_subject(args.namespace, args.component),
             lease.lease_id)
-    await serve_engine(endpoint, engine,
-                       stats_provider=lambda: engine.stats().to_dict())
+    served = await serve_engine(endpoint, engine,
+                                stats_provider=lambda:
+                                engine.stats().to_dict())
     await register_llm(drt, endpoint, card)
     # same observability surface as the real worker (worker/main.py):
     # counters + stage histogram + flight recorder on the system server
@@ -86,6 +87,15 @@ async def amain(args: argparse.Namespace) -> None:
     if system is not None:
         system.health.register("engine", ready=True)
         await system.start()
+    # graceful drain parity with the real worker: the mocker cannot
+    # export KV, so every frozen stream ships an empty (replay) token —
+    # fleet tests exercise the announcement/refusal/failover machinery
+    from dynamo_tpu.worker.drain import DrainController, install_signal_drain
+    drain = DrainController(engine, served=[served],
+                            on_drained=drt.runtime.shutdown)
+    install_signal_drain(drain)
+    if system is not None:
+        system.register_drain(drain)
     print(f"mocker worker serving model {card.name}", flush=True)
     try:
         await drt.runtime.wait_shutdown()

@@ -138,6 +138,12 @@ class PreprocessedRequest:
     # attempt number when it re-issues a dropped stream, so the receiving
     # worker can count replays it absorbs
     migration_attempt: int = 0
+    # >0 on a migration replay/resume: how many TRAILING tokens of
+    # ``token_ids`` were GENERATED by earlier legs of this stream (the
+    # rebuild appends them to the prompt). The engine uses it to
+    # reconstruct penalty windows — frequency/presence penalties count
+    # generated tokens, which would otherwise read as prompt after a hop
+    resumed_tokens: int = 0
     # end-to-end request deadline, absolute unix seconds (None = none).
     # Set by the HTTP frontend (config default or per-request override) and
     # propagated to the worker in the RPC ``req`` frame headers; expired
@@ -161,6 +167,7 @@ class PreprocessedRequest:
             "kv_transfer_params": self.kv_transfer_params,
             "prefill_only": self.prefill_only,
             "migration_attempt": self.migration_attempt,
+            "resumed_tokens": self.resumed_tokens,
             "deadline_unix": self.deadline_unix,
         }
 
@@ -179,6 +186,7 @@ class PreprocessedRequest:
             kv_transfer_params=d.get("kv_transfer_params"),
             prefill_only=bool(d.get("prefill_only", False)),
             migration_attempt=int(d.get("migration_attempt", 0)),
+            resumed_tokens=int(d.get("resumed_tokens", 0)),
             deadline_unix=d.get("deadline_unix"),
         )
 

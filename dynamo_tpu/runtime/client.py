@@ -89,10 +89,16 @@ class Client:
     # -- instance visibility ----------------------------------------------
 
     def instance_ids(self) -> List[int]:
-        return [i for i in self._instances if i not in self._down]
+        # draining instances are excluded from SELECTION (routers stop
+        # sending new work the moment the drain announcement lands) but
+        # stay directly addressable via get_instance/direct — in-flight
+        # migrations still pull their pinned KV from them
+        return [i for i, v in self._instances.items()
+                if i not in self._down and not v.draining]
 
     def instances(self) -> List[Instance]:
-        return [v for k, v in self._instances.items() if k not in self._down]
+        return [v for k, v in self._instances.items()
+                if k not in self._down and not v.draining]
 
     def get_instance(self, instance_id: int) -> Optional[Instance]:
         if instance_id in self._down:

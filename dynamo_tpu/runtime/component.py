@@ -46,6 +46,11 @@ class Instance:
     # when the worker serves device-direct KV pulls — blocks move
     # device-to-device with no host bounce (the NIXL RDMA role proper)
     direct_address: str = ""
+    # graceful drain: a draining instance stops receiving NEW requests
+    # (routers exclude it from selection the moment their watch delivers
+    # the re-put) but stays directly addressable — its in-flight streams
+    # are migrating out and survivors still pull its pinned KV from it
+    draining: bool = False
 
     @property
     def etcd_key(self) -> str:
@@ -68,6 +73,8 @@ class Instance:
             d["bulk_address"] = self.bulk_address
         if self.direct_address:
             d["direct_address"] = self.direct_address
+        if self.draining:
+            d["draining"] = True
         return json.dumps(d).encode()
 
     @classmethod
@@ -77,7 +84,8 @@ class Instance:
             namespace=d["namespace"], component=d["component"],
             endpoint=d["endpoint"], instance_id=d["instance_id"],
             address=d["address"], bulk_address=d.get("bulk_address", ""),
-            direct_address=d.get("direct_address", ""))
+            direct_address=d.get("direct_address", ""),
+            draining=bool(d.get("draining", False)))
 
 
 class Namespace:
@@ -234,6 +242,28 @@ class ServedEndpoint:
         if self.instance.instance_id != lease_id:
             self.instance = dataclasses.replace(self.instance,
                                                 instance_id=lease_id)
+
+    async def announce_draining(self) -> None:
+        """Re-put the instance record with ``draining`` set so routers
+        route around it. The flag lives on ``self.instance``, so a
+        coordinator resync racing the drain re-announces it draining too —
+        the announcement survives a control-plane blip. Idempotent; a
+        put failure is swallowed (the drain proceeds regardless — in the
+        worst case racing requests are refused and replayed)."""
+        if self.instance.draining:
+            return
+        self.instance = dataclasses.replace(self.instance, draining=True)
+        drt = self.endpoint._drt
+        try:
+            await drt.coord.put(self.instance.etcd_key,
+                                self.instance.to_json(),
+                                lease_id=self.instance.instance_id)
+            logger.info("instance %x of %s announced draining",
+                        self.instance.instance_id, self.endpoint.path)
+        except Exception:  # noqa: BLE001 — drain must proceed regardless
+            logger.warning("drain announcement for %s failed; routers "
+                           "fall back to refusal-and-replay",
+                           self.endpoint.path, exc_info=True)
 
     async def shutdown(self) -> None:
         drt = self.endpoint._drt

@@ -113,6 +113,17 @@ class StreamEndedError(ConnectionError):
     "Stream ended before generation completed")."""
 
 
+class StreamMigrationSignal(Exception):
+    """Raised by a handler to end its stream WITHOUT a final sentinel.
+
+    The server answers with an explicit ``drop`` frame, so the caller's
+    ``ResponseStream`` raises ``StreamEndedError`` immediately — the same
+    stream-failover path a crashed worker triggers via connection teardown,
+    minus the keepalive detection delay. A draining worker uses this to
+    hand its in-flight streams to the migration operator on purpose (after
+    shipping a resume token as the last data frame)."""
+
+
 class DeadlineExceededError(TimeoutError):
     """The request's end-to-end deadline passed before the stream finished.
 
@@ -345,6 +356,15 @@ class RpcServer:
                 else:
                     await send({"op": "data", "sid": sid, "payload": item})
             await send({"op": "final", "sid": sid})
+        except StreamMigrationSignal:
+            # deliberate graceful handoff: every data frame (including the
+            # migration/resume token) is already on the wire — end the
+            # stream abnormally so the caller's migration operator fires
+            # NOW instead of waiting out keepalive detection
+            try:
+                await send({"op": "drop", "sid": sid})
+            except Exception:  # noqa: BLE001 — conn gone: drop path anyway
+                pass
         except asyncio.CancelledError:
             # caller cancelled (or server stopping): nothing more to send; the
             # client side tears its stream down locally on cancel
@@ -610,6 +630,12 @@ class RpcConnection:
                     stream.queue.put_nowait(("final", None))
                 elif op == "err":
                     stream.queue.put_nowait(("err", frame.get("error")))
+                elif op == "drop":
+                    # server-initiated graceful stream handoff (worker
+                    # drain): same terminal state as a dropped connection,
+                    # scoped to one stream
+                    stream.queue.put_nowait(("drop", None))
+                    self._streams.pop(sid, None)
         except ConnectionError:
             pass  # CancelledError must propagate (see utils/aio.reap_task)
         finally:
@@ -773,6 +799,7 @@ __all__ = [
     "ResponseStream",
     "RequestContext",
     "StreamEndedError",
+    "StreamMigrationSignal",
     "DeadlineExceededError",
     "EndpointStats",
     "Handler",

@@ -62,7 +62,16 @@ class SystemServer:
         self.app.router.add_get("/metrics", self.handle_metrics)
         self.app.router.add_get("/v1/traces", self.handle_traces)
         self.app.router.add_get("/v1/traces/{trace_id}", self.handle_trace)
+        self.app.router.add_post("/drain", self.handle_drain)
+        # graceful-drain hook (worker/drain.DrainController): POST /drain
+        # triggers it; absent on processes with nothing to drain
+        self._drain = None
         self._runner: Optional[web.AppRunner] = None
+
+    def register_drain(self, controller) -> None:
+        """Expose a ``DrainController`` on ``POST /drain`` (the operator/
+        planner-facing trigger next to SIGTERM)."""
+        self._drain = controller
 
     @classmethod
     def from_env(cls, **kwargs) -> Optional["SystemServer"]:
@@ -105,6 +114,18 @@ class SystemServer:
         if self.extra_metrics is not None:
             body += self.extra_metrics()
         return web.Response(body=body, content_type="text/plain")
+
+    async def handle_drain(self, request: web.Request) -> web.Response:
+        if self._drain is None:
+            return web.json_response(
+                {"error": "this process has no drainable endpoint"},
+                status=404)
+        # fire-and-return: the drain (freeze + lease-ack wait) can take up
+        # to DYN_DRAIN_TIMEOUT_S — the caller polls state via repeat POSTs
+        # or the dynamo_worker_drain_state gauge
+        self._drain.trigger("POST /drain")
+        return web.json_response({"state": self._drain.state,
+                                  "counts": self._drain.counts})
 
     async def handle_traces(self, request: web.Request) -> web.Response:
         return trace_list_response(self.tracer, request)

@@ -279,4 +279,73 @@ class CoordinatorOutage:
         await self.restart(wipe_state=wipe_state)
 
 
-__all__ = ["ChaosProxy", "CoordinatorOutage"]
+class WorkerDrain:
+    """Lifecycle fault harness around one in-process worker: drives the
+    SAME staged drain protocol the production worker runs
+    (``worker/drain.DrainController``), plus the abrupt deaths chaos
+    tests pit it against.
+
+    Scenarios:
+
+    - ``sigterm()`` — the graceful path: announce draining, freeze the
+      in-flight streams into resume/replay tokens, wait (bounded) for
+      survivors to ack the pinned-KV leases, then tear the worker down.
+      What the real worker's SIGTERM handler / ``POST /drain`` does.
+    - ``kill9()`` — no drain at all: the runtime closes abruptly; callers
+      see connection teardown and the migration operator replays (the
+      PR 2 path).
+    - ``kill9_mid_drain()`` — the race: announce + freeze complete (resume
+      tokens shipped, KV pinned), then the process dies BEFORE survivors
+      pull — their resume pulls fail and admission falls back to
+      recompute; no stream may be lost and no lease may leak on the
+      survivors.
+    - ``drain(timeout_s=0)`` — the drain-timeout scenario: exit without
+      waiting for lease acks.
+    """
+
+    def __init__(self, drt, engine, served=(), resume_extras=None):
+        from dynamo_tpu.worker.drain import DrainController
+
+        self.drt = drt
+        self.engine = engine
+        self.controller = DrainController(engine, served=served,
+                                          resume_extras=resume_extras)
+        self.dead = False
+
+    async def drain(self, timeout_s: Optional[float] = None) -> dict:
+        """Graceful drain WITHOUT tearing the worker down (the post-drain
+        window where survivors pull pinned KV); returns the
+        resume/replay counts."""
+        self.controller.timeout_s = timeout_s
+        return await self.controller.drain("WorkerDrain")
+
+    async def sigterm(self, timeout_s: Optional[float] = None) -> dict:
+        """Full graceful shutdown: drain, then close the runtime."""
+        counts = await self.drain(timeout_s)
+        await self._close()
+        return counts
+
+    async def kill9(self) -> None:
+        """Abrupt death — no announcement, no freeze, streams drop."""
+        await self._close()
+
+    async def kill9_mid_drain(self) -> dict:
+        """Announce + freeze, then die before any survivor pulls."""
+        await self.controller.announce()
+        counts = await self.controller.freeze()
+        await self._close()
+        return counts
+
+    async def _close(self) -> None:
+        if self.dead:
+            return
+        self.dead = True
+        try:
+            await self.drt.close()
+        finally:
+            stop = getattr(self.engine, "stop", None)
+            if stop is not None:
+                await stop()
+
+
+__all__ = ["ChaosProxy", "CoordinatorOutage", "WorkerDrain"]

@@ -248,6 +248,471 @@ class DisaggConfig:
             d.get("max_local_prefill_length", 0)))
 
 
+class KvBlockPuller:
+    """Transport-ladder KV block pull: device-direct -> bulk -> RPC, with
+    per-block resumability, wire-v4 checksum NACKs, per-plane byte/trace
+    accounting, and export-lease acks.
+
+    Extracted from ``DisaggDecodeHandler`` so the graceful-drain resume
+    path (``worker/drain.ResumeAdmission``) pulls a draining worker's
+    pinned sequence KV through the exact machinery the disagg prefill
+    handoff uses — one pull implementation, two callers. The clients are
+    attached by the owner (they need a started runtime); a missing
+    direct client/plane simply skips that rung of the ladder."""
+
+    def __init__(self, engine: JaxEngine, kv_client=None,
+                 kv_direct_client=None, direct_plane=None):
+        self.engine = engine
+        self.kv_client = kv_client
+        self.kv_direct_client = kv_direct_client
+        # device-direct pull plane (engine/transfer.DeviceTransferPlane):
+        # built by the owner when the jax transfer API is available and
+        # the engine is single-device (mesh engines keep the host planes)
+        self.direct_plane = direct_plane
+        # bound on one device-direct pull; past it the (abandoned) pull
+        # thread is left behind and the transport ladder falls to bulk
+        self.direct_pull_timeout = 60.0
+        # circuit breaker: a timed-out address is skipped for this long
+        # (each timeout strands a 60s executor thread — without the
+        # breaker a black-holed peer would saturate the default executor
+        # and wedge even the bulk fallback's to_thread calls)
+        self.direct_down_window = 300.0
+        self.direct_down_until: dict = {}
+        # bulk addresses already pre-warmed (one background warmup per
+        # peer: later fetches find pooled connections with ramped kernel
+        # buffers instead of paying the cold-socket penalty)
+        self.bulk_warmed: set = set()
+        # resume attempts per host plane after a mid-pull failure: each
+        # re-pulls only the blocks not yet committed (DYN_KV_PULL_RETRIES)
+        try:
+            self.pull_resume_attempts = max(0, int(os.environ.get(
+                "DYN_KV_PULL_RETRIES", "1")))
+        except (TypeError, ValueError):
+            logger.warning("malformed DYN_KV_PULL_RETRIES %r; using 1",
+                           os.environ.get("DYN_KV_PULL_RETRIES"))
+            self.pull_resume_attempts = 1
+        # diagnostics of the most recent block pull (tests, debugging)
+        self.last_pull_stats: dict = {}
+
+    async def pull_blocks(self, hashes: list, iid: int,
+                           bulk_address: str = "",
+                           direct_address: str = "",
+                           lease: Optional[int] = None) -> None:
+        """Fetch + inject the prefix blocks from prefill worker ``iid``.
+
+        Transport ladder: DEVICE-DIRECT (jax transfer server — blocks move
+        chip-to-chip with no host bounce, the NIXL RDMA role) when both
+        sides run it, else the bulk data plane (raw sockets, unix-first),
+        else batched two-part frames on the RPC plane.
+
+        Fault tolerance: per-block commit state is the allocator's
+        content-addressed registry itself, so a mid-pull failure (socket
+        reset, corrupt frame, peer death) resumes by re-pulling ONLY the
+        blocks not yet committed — first on the same plane, then down the
+        ladder — instead of discarding committed work. Wire-v4 frames are
+        checksum-verified before staging; a bad frame NACKs (aborts the
+        stream) and is re-pulled, never injected. On the way out the
+        export ``lease`` is acked (best-effort; the prefill side's TTL GC
+        covers a lost ack)."""
+        inst = self.kv_client.get_instance(iid)
+        if not bulk_address and inst is not None:
+            bulk_address = inst.bulk_address
+        if not direct_address and inst is not None:
+            direct_address = inst.direct_address
+        tracer = get_tracer()
+        kv_span = tracer.start_span(
+            "kv_transfer", attrs={"blocks": len(hashes),
+                                  "instance": f"{iid:x}"})
+
+        def _count_bytes(n: int, plane: str) -> None:
+            # per-plane attrs: a ladder fall-through (direct pull ok, inject
+            # failed, bulk finished the job) must not attribute one plane's
+            # bytes to another; "plane" records the plane that served the
+            # tail of the transfer
+            kv_span.set_attr("plane", plane)
+            kv_span.set_attr(
+                f"bytes_{plane}",
+                int(kv_span.attrs.get(f"bytes_{plane}", 0)) + int(n))
+            kv_span.set_attr(
+                "bytes", int(kv_span.attrs.get("bytes", 0)) + int(n))
+            try:
+                from dynamo_tpu.worker.metrics import get_worker_metrics
+                get_worker_metrics().disagg_kv_bytes.labels(
+                    "pulled", plane).inc(int(n))
+            except Exception:  # noqa: BLE001 — accounting must not fail IO
+                logger.exception("kv byte accounting failed")
+
+        # per-phase wall time (recv = socket/pull wait, stage = host copy
+        # into the scatter buffer, upload = host->device transfer, scatter
+        # = exclusive-window commits): the bulk-vs-e2e gap lives in these
+        phases = {"recv_s": 0.0, "stage_s": 0.0, "upload_s": 0.0,
+                  "scatter_s": 0.0}
+        try:
+            await self._pull_blocks_inner(hashes, iid, bulk_address,
+                                          direct_address, _count_bytes,
+                                          kv_span, phases)
+        except BaseException as e:
+            kv_span.set_error(repr(e))
+            raise
+        finally:
+            for k, v in phases.items():
+                if v:
+                    kv_span.set_attr(k[:-2] + "_ms", round(v * 1e3, 3))
+            try:
+                if lease is not None:
+                    # ack whatever the outcome: this decode worker never
+                    # comes back for more of THIS pull (a failed tail
+                    # recomputes locally), so the prefill side can unpin
+                    # now instead of waiting out the TTL
+                    acked = await self._ack_export_lease(iid, lease)
+                    kv_span.set_attr("lease_acked", acked)
+            finally:
+                # a cancellation landing on the ack await must not leave
+                # the span unfinished
+                kv_span.finish()
+
+    async def _ack_export_lease(self, iid: int, lease: int) -> bool:
+        try:
+            stream = await self.kv_client.direct(
+                {"ack_lease": int(lease)}, iid)
+            async for _ in stream:
+                pass
+            return True
+        except Exception as e:  # noqa: BLE001 — the TTL GC covers it
+            logger.debug("export lease %s ack to %x failed (%s); TTL "
+                         "covers", lease, iid, e)
+            return False
+
+    def missing(self, hashes: list) -> list:
+        """The per-block commit state IS the allocator's content-addressed
+        registry: a block that committed (this pull, an earlier attempt,
+        or any other request) is resident and never re-pulled."""
+        resident = self.engine.allocator._by_hash
+        return [h for h in hashes if h not in resident]
+
+    def _note_resume(self, kv_span, plane: str, committed: int,
+                     remaining: int) -> None:
+        kv_span.add_event("pull_resumed", plane=plane, committed=committed,
+                          remaining=remaining)
+        from dynamo_tpu.worker.metrics import count_metric
+        count_metric("kv_pull_resumes")
+
+    @staticmethod
+    def _note_corrupt(kv_span, plane: str, err) -> None:
+        kv_span.add_event("frame_corrupt", plane=plane, error=str(err))
+        from dynamo_tpu.worker.metrics import count_metric
+        count_metric("kv_frames_corrupt")
+
+    @staticmethod
+    def _note_shard_bytes(kv_span, meta, nbytes: int) -> None:
+        """Per-shard byte attrs on the kv_transfer span (wire-v5 frames
+        carry their shard index): ``bytes_shard{i}`` sums each shard's
+        wire bytes next to the per-plane totals, so an imbalanced or
+        stalled shard stream is attributable without a rerun."""
+        sh = (meta or {}).get("shard")
+        if sh is None:
+            return
+        try:
+            kv_span.set_attr("shards", int(sh["count"]))
+            key = f"bytes_shard{int(sh['index'])}"
+            kv_span.set_attr(
+                key, int(kv_span.attrs.get(key, 0)) + int(nbytes))
+        except Exception:  # noqa: BLE001 — accounting must not fail IO
+            logger.debug("shard byte accounting failed", exc_info=True)
+
+    async def _pull_blocks_inner(self, hashes: list, iid: int,
+                                 bulk_address: str, direct_address: str,
+                                 _count_bytes, kv_span, phases) -> None:
+        injected = total = 0
+        retries = 0
+        resumed_blocks = 0  # blocks NOT re-pulled thanks to commit state
+        bulk_done = False
+        want = self.missing(hashes)
+        if len(want) < len(hashes):
+            kv_span.set_attr("resident_blocks", len(hashes) - len(want))
+        self.last_pull_stats = {"retries": 0, "resumed_blocks": 0,
+                                "injected": 0, "corrupt": 0}
+
+        def finish_stats():
+            kv_span.set_attr("injected", injected)
+            if retries:
+                kv_span.set_attr("retries", retries)
+                kv_span.set_attr("resumed_blocks", resumed_blocks)
+            self.last_pull_stats.update(retries=retries,
+                                        resumed_blocks=resumed_blocks,
+                                        injected=injected)
+
+        if not want:
+            finish_stats()
+            return
+        now = time.monotonic()
+        # prune expired breaker entries: prefill restarts advertise fresh
+        # ephemeral ports, so per-address state must not grow unbounded
+        self.direct_down_until = {a: t for a, t in
+                                   self.direct_down_until.items()
+                                   if t > now}
+        if (direct_address and self.direct_plane is not None
+                and direct_address not in self.direct_down_until):
+            offer = None
+            try:
+                offer_stream = await self.kv_direct_client.direct(
+                    {"block_hashes": want}, iid)
+                async for o in offer_stream:
+                    offer = o
+                if offer and offer.get("uuid") is not None:
+                    # the network pull runs OUTSIDE the engine's exclusive
+                    # window (it touches no engine state) with a timeout —
+                    # a stalled transfer connection must never wedge the
+                    # decode loop; only the fast device scatter is
+                    # exclusive. A timed-out pull abandons its thread,
+                    # evicts the connection, opens the circuit breaker for
+                    # the address, and falls down the ladder.
+                    t0 = time.perf_counter()
+                    data = await asyncio.wait_for(
+                        asyncio.to_thread(self.direct_plane.pull, offer),
+                        timeout=self.direct_pull_timeout)
+                    phases["recv_s"] += time.perf_counter() - t0
+                    _count_bytes(getattr(data, "nbytes", 0), "direct")
+                    # commit in bounded windows, one minimal exclusive
+                    # scatter each: decode steps interleave with a large
+                    # direct-plane inject instead of stalling behind it
+                    metas = [(b[0], b[1], b[2])
+                             for b in offer["blocks"]]
+                    t0 = time.perf_counter()
+                    injected = await inject_device_windowed(
+                        self.engine, metas, data[:, :len(metas)])
+                    phases["scatter_s"] += time.perf_counter() - t0
+                    logger.debug("device-direct pull injected %d blocks "
+                                 "from %x", injected, iid)
+                    await self._ack_offer(iid, offer["uuid"])
+                    finish_stats()
+                    return
+                # empty offer: blocks evicted remotely OR the peer's offer
+                # table is full — fall through to the host planes (the
+                # bulk fetch serves the full-table case; the evicted case
+                # costs one empty round trip)
+            except asyncio.TimeoutError:
+                self.direct_plane.evict(offer["address"] if offer
+                                         else direct_address)
+                self.direct_down_until[direct_address] = (
+                    time.monotonic() + self.direct_down_window)
+                logger.warning(
+                    "device-direct KV pull from %s timed out after %.0fs; "
+                    "skipping the plane for %.0fs", direct_address,
+                    self.direct_pull_timeout, self.direct_down_window)
+            except Exception as e:  # noqa: BLE001 — fall down the ladder
+                logger.warning("device-direct KV pull from %s failed (%s); "
+                               "trying the bulk plane", direct_address, e)
+        # resume budget per host plane: a failed attempt re-pulls only the
+        # still-missing blocks before falling down the ladder
+        attempts_per_plane = 1 + self.pull_resume_attempts
+        if bulk_address:
+            from dynamo_tpu.runtime.bulk import prewarm_async
+            if bulk_address not in self.bulk_warmed:
+                # background warmup: THIS fetch still rides a cold socket,
+                # but every later fetch to the peer finds a pooled, ramped
+                # connection (and concurrent pulls find extra capacity).
+                # A warmup that fails outright un-marks the address so a
+                # later pull retries (peer briefly unreachable).
+                self.bulk_warmed.add(bulk_address)
+                prewarm_async(
+                    bulk_address, f"{iid:x}",
+                    on_fail=lambda a=bulk_address:
+                        self.bulk_warmed.discard(a))
+            for attempt in range(attempts_per_plane):
+                want = self.missing(hashes)
+                if not want:
+                    bulk_done = True
+                    break
+                if attempt:
+                    retries += 1
+                    resumed_blocks = len(hashes) - len(want)
+                    self._note_resume(kv_span, "bulk", resumed_blocks,
+                                      len(want))
+                pipe = InjectPipeline(self.engine)
+                seen_windows: set = set()
+
+                def on_meta(meta, nbytes):
+                    nonlocal total
+                    _count_bytes(nbytes, "bulk")
+                    self._note_shard_bytes(kv_span, meta, nbytes)
+                    if meta.get("shard") is not None:
+                        # count each block window once, not per shard slice
+                        key = tuple(b[0] for b in meta["blocks"])
+                        if key in seen_windows:
+                            return
+                        seen_windows.add(key)
+                    total += len(meta["blocks"])
+
+                try:
+                    # stream-and-stage (engine/transfer.pump_bulk_frames):
+                    # frames stage/commit while later frames are still on
+                    # the wire, wire buffers recycle through the pipeline.
+                    # A sharded cache advertises its shard layout so a
+                    # same-layout exporter streams per-shard frames
+                    # (wire v5) instead of host-gathered merged frames.
+                    phases["recv_s"] += await pump_bulk_frames(
+                        pipe, bulk_address, KV_EXPORT_ENDPOINT,
+                        {"block_hashes": want,
+                         "wire": FRAME_WIRE_VERSION,
+                         **kv_shard_payload(self.engine)},
+                        f"{iid:x}", 60.0, on_meta)
+                    injected += await pipe.finish()
+                    bulk_done = True
+                    break
+                except FrameIntegrityError as e:
+                    # checksum NACK: the corrupted frame was rejected
+                    # before staging (never injected) and the stream
+                    # aborted; committed frames stay, the resume re-pulls
+                    # the rest
+                    injected += pipe.injected
+                    self.last_pull_stats["corrupt"] += 1
+                    self._note_corrupt(kv_span, "bulk", e)
+                    logger.warning("bulk KV frame from %s failed checksum "
+                                   "(%s); re-pulling missing blocks",
+                                   bulk_address, e)
+                except Exception as e:  # noqa: BLE001 — bulk plane broke
+                    # mid-pull (socket reset, worker bound to 127.0.0.1
+                    # across hosts, peer death): resume on this plane,
+                    # then the RPC export path below — never waste the
+                    # completed remote prefill over a transport problem.
+                    # pump already reaped its fetch thread and in-flight
+                    # commits; whatever committed cleanly stays (content-
+                    # addressed blocks are never wasted, every retry
+                    # dedups against them).
+                    injected += pipe.injected
+                    logger.warning("bulk KV fetch from %s failed (%s); %s",
+                                   bulk_address, e,
+                                   "resuming missing blocks"
+                                   if attempt + 1 < attempts_per_plane
+                                   else "falling back to the RPC export "
+                                        "path")
+                finally:
+                    for k, v in pipe.timings.items():
+                        phases[k] += v
+        if not bulk_done:
+            last_err = None
+            for attempt in range(attempts_per_plane):
+                want = self.missing(hashes)
+                if not want:
+                    last_err = None
+                    break
+                if attempt or (bulk_address and injected):
+                    # count a ladder/same-plane resume whenever committed
+                    # work is being carried over into a new attempt
+                    retries += 1
+                    resumed_blocks = len(hashes) - len(want)
+                    self._note_resume(kv_span, "rpc", resumed_blocks,
+                                      len(want))
+                def note_blocks(n: int) -> None:
+                    nonlocal total
+                    total += n
+
+                def note_injected(n: int) -> None:
+                    nonlocal injected
+                    injected += n
+
+                try:
+                    await self._pull_rpc(want, iid, _count_bytes, phases,
+                                         note_blocks, note_injected,
+                                         kv_span)
+                    last_err = None
+                    break
+                except FrameIntegrityError as e:
+                    last_err = e
+                    self.last_pull_stats["corrupt"] += 1
+                    self._note_corrupt(kv_span, "rpc", e)
+                    logger.warning("RPC KV frame from %x failed checksum "
+                                   "(%s); re-pulling missing blocks",
+                                   iid, e)
+                except Exception as e:  # noqa: BLE001 — retried below
+                    last_err = e
+                    logger.warning("RPC KV fetch from %x failed (%s)",
+                                   iid, e)
+            if last_err is not None:
+                finish_stats()
+                raise last_err
+        if total:
+            logger.debug("injected %d/%d transferred blocks",
+                         injected, total)
+        finish_stats()
+
+    async def _pull_rpc(self, want: list, iid: int, _count_bytes,
+                        phases, note_blocks, note_injected,
+                        kv_span=None) -> None:
+        """One RPC-plane pull attempt of ``want`` through the staged
+        pipeline. Blocks injected are reported through ``note_injected``
+        — on the failure path too, so partial commits reaped by the drain
+        still count (the caller's resume dedups against them)."""
+        from dynamo_tpu.runtime.codec import release_buffer
+
+        kv_stream = await self.kv_client.direct(
+            {"block_hashes": want, "wire": FRAME_WIRE_VERSION,
+             **kv_shard_payload(self.engine)}, iid)
+        # batched two-part frames through the staged pipeline: frame k
+        # stages/commits while frame k+1 is still in flight (zero
+        # msgpack re-copies). Old exporters answering with the
+        # per-block schema ride the same pipeline via add_blocks.
+        pipe = InjectPipeline(self.engine)
+        seen_windows: set = set()
+        try:
+            t0 = time.perf_counter()
+            async for frame in kv_stream:
+                phases["recv_s"] += time.perf_counter() - t0
+                if "_raw" in frame:
+                    _count_bytes(len(frame["_raw"]), "rpc")
+                    if kv_span is not None:
+                        self._note_shard_bytes(kv_span, frame,
+                                               len(frame["_raw"]))
+                    if frame.get("shard") is not None:
+                        key = tuple(b[0] for b in frame["blocks"])
+                        if key not in seen_windows:
+                            seen_windows.add(key)
+                            note_blocks(len(frame["blocks"]))
+                        # fall through to staging either way
+                    else:
+                        note_blocks(len(frame["blocks"]))
+                    # pipeline recycles the pooled trailer buffer
+                    # once its bytes are consumed
+                    await pipe.add_frame(frame, release=release_buffer)
+                else:  # pre-batched single-block schema
+                    note_blocks(1)
+                    await pipe.add_blocks(
+                        [BlockPayload.from_wire(frame)])
+                t0 = time.perf_counter()
+            note_injected(await pipe.finish())
+        except BaseException:
+            note_injected(await pipe.drain())
+            raise
+        finally:
+            for k, v in pipe.timings.items():
+                phases[k] += v
+
+    async def _ack_offer(self, iid: int, uuid: int) -> None:
+        """Release the peer's pinned device-direct offer. Retried once —
+        a lost ack leaves the gathered array pinned in the peer's HBM
+        until its offer TTL — and counted
+        (``dynamo_worker_kv_offer_acks_total``)."""
+        acked = False
+        for attempt in range(2):
+            try:
+                ack = await self.kv_direct_client.direct(
+                    {"ack": int(uuid)}, iid)
+                async for _ in ack:
+                    pass
+                acked = True
+                break
+            except Exception as e:  # noqa: BLE001 — retry once, then TTL
+                logger.debug("device-direct offer ack to %x failed "
+                             "(attempt %d: %s)", iid, attempt + 1, e)
+        if not acked:
+            logger.warning("device-direct offer %s ack to %x failed "
+                           "twice; peer unpins at its offer TTL",
+                           uuid, iid)
+        from dynamo_tpu.worker.metrics import count_metric
+        count_metric("kv_offer_acks", "ok" if acked else "failed")
+
+
 class DisaggDecodeHandler:
     """Wraps a decode engine with the remote-prefill leg."""
 
@@ -270,48 +735,70 @@ class DisaggDecodeHandler:
         # remote-prefill leg itself
         self.strategy = strategy
         self._gen_client = None
-        self._kv_client = None
-        self._kv_direct_client = None
         self._router: Optional[PushRouter] = None
         self._conf_watch = None
         self._conf_task: Optional[asyncio.Task] = None
-        # device-direct pull plane (engine/transfer.DeviceTransferPlane):
-        # built lazily at start when the jax transfer API is available and
-        # the engine is single-device (mesh engines keep the host planes)
-        self._direct_plane = None
-        # bound on one device-direct pull; past it the (abandoned) pull
-        # thread is left behind and the transport ladder falls to bulk
-        self.direct_pull_timeout = 60.0
-        # circuit breaker: a timed-out address is skipped for this long
-        # (each timeout strands a 60s executor thread — without the
-        # breaker a black-holed peer would saturate the default executor
-        # and wedge even the bulk fallback's to_thread calls)
-        self.direct_down_window = 300.0
-        self._direct_down_until: dict = {}
-        # bulk addresses already pre-warmed (one background warmup per
-        # peer: later fetches find pooled connections with ramped kernel
-        # buffers instead of paying the cold-socket penalty)
-        self._bulk_warmed: set = set()
-        # resume attempts per host plane after a mid-pull failure: each
-        # re-pulls only the blocks not yet committed (DYN_KV_PULL_RETRIES)
-        try:
-            self.pull_resume_attempts = max(0, int(os.environ.get(
-                "DYN_KV_PULL_RETRIES", "1")))
-        except (TypeError, ValueError):
-            logger.warning("malformed DYN_KV_PULL_RETRIES %r; using 1",
-                           os.environ.get("DYN_KV_PULL_RETRIES"))
-            self.pull_resume_attempts = 1
-        # diagnostics of the most recent block pull (tests, debugging)
-        self.last_pull_stats: dict = {}
+        # the transport-ladder pull machinery (device-direct -> bulk ->
+        # RPC, resumable, checksum-NACKing) lives in KvBlockPuller so the
+        # drain/migration resume path (worker/drain.ResumeAdmission) can
+        # reuse it verbatim; clients are attached in start()
+        self._puller = KvBlockPuller(self.engine)
+
+    # -- puller surface (delegated; tests monkeypatch/inspect these) -------
+
+    @property
+    def _kv_client(self):
+        return self._puller.kv_client
+
+    @property
+    def _kv_direct_client(self):
+        return self._puller.kv_direct_client
+
+    @property
+    def _direct_plane(self):
+        return self._puller.direct_plane
+
+    @property
+    def direct_pull_timeout(self) -> float:
+        return self._puller.direct_pull_timeout
+
+    @direct_pull_timeout.setter
+    def direct_pull_timeout(self, v: float) -> None:
+        self._puller.direct_pull_timeout = v
+
+    @property
+    def _direct_down_until(self) -> dict:
+        return self._puller.direct_down_until
+
+    @property
+    def _bulk_warmed(self) -> set:
+        return self._puller.bulk_warmed
+
+    @property
+    def last_pull_stats(self) -> dict:
+        return self._puller.last_pull_stats
+
+    def _missing_blocks(self, hashes: list) -> list:
+        return self._puller.missing(hashes)
+
+    async def _pull_blocks(self, hashes: list, iid: int,
+                           bulk_address: str = "",
+                           direct_address: str = "",
+                           lease: Optional[int] = None) -> None:
+        await self._puller.pull_blocks(hashes, iid,
+                                       bulk_address=bulk_address,
+                                       direct_address=direct_address,
+                                       lease=lease)
 
     async def start(self) -> "DisaggDecodeHandler":
         ns = self.drt.namespace(self.namespace)
         comp = ns.component(self.prefill_component)
         self._gen_client = await comp.endpoint("generate").client()
-        self._kv_client = await comp.endpoint(KV_EXPORT_ENDPOINT).client()
-        self._kv_direct_client = await comp.endpoint(
+        self._puller.kv_client = await comp.endpoint(
+            KV_EXPORT_ENDPOINT).client()
+        self._puller.kv_direct_client = await comp.endpoint(
             KV_EXPORT_DIRECT_ENDPOINT).client()
-        self._direct_plane = make_device_transfer_plane(self.engine)
+        self._puller.direct_plane = make_device_transfer_plane(self.engine)
         self._router = PushRouter(self._gen_client, RouterMode.ROUND_ROBIN)
         self._conf_watch = await self.drt.coord.watch_prefix(
             disagg_conf_key(self.namespace))
@@ -353,7 +840,19 @@ class DisaggDecodeHandler:
         if not self._gen_client.instance_ids():
             return False
         n = len(request.token_ids)
-        return n > self.conf.max_local_prefill_length
+        if n <= self.conf.max_local_prefill_length:
+            return False
+        # migration re-issue: the prompt is already (mostly) resident
+        # locally — a resume just pulled its pinned KV, or a replay's
+        # prefix survives in the cache — so remote prefill would
+        # recompute what local admission adopts for free. Gated on
+        # resumed_tokens: ordinary requests skip the O(prompt) hash walk
+        # on this hot path (admission computes the chain anyway)
+        resident = 0
+        if request.resumed_tokens:
+            resident = self._resumable_blocks(request) \
+                * self.engine.allocator.page_size
+        return (n - resident) > self.conf.max_local_prefill_length
 
     async def _queue_prefill(self, preq: PreprocessedRequest
                              ) -> Optional[LLMEngineOutput]:
@@ -545,424 +1044,6 @@ class DisaggDecodeHandler:
         from dynamo_tpu.worker.metrics import count_metric
         count_metric("prefill_failovers", outcome)
 
-    async def _pull_blocks(self, hashes: list, iid: int,
-                           bulk_address: str = "",
-                           direct_address: str = "",
-                           lease: Optional[int] = None) -> None:
-        """Fetch + inject the prefix blocks from prefill worker ``iid``.
-
-        Transport ladder: DEVICE-DIRECT (jax transfer server — blocks move
-        chip-to-chip with no host bounce, the NIXL RDMA role) when both
-        sides run it, else the bulk data plane (raw sockets, unix-first),
-        else batched two-part frames on the RPC plane.
-
-        Fault tolerance: per-block commit state is the allocator's
-        content-addressed registry itself, so a mid-pull failure (socket
-        reset, corrupt frame, peer death) resumes by re-pulling ONLY the
-        blocks not yet committed — first on the same plane, then down the
-        ladder — instead of discarding committed work. Wire-v4 frames are
-        checksum-verified before staging; a bad frame NACKs (aborts the
-        stream) and is re-pulled, never injected. On the way out the
-        export ``lease`` is acked (best-effort; the prefill side's TTL GC
-        covers a lost ack)."""
-        inst = self._kv_client.get_instance(iid)
-        if not bulk_address and inst is not None:
-            bulk_address = inst.bulk_address
-        if not direct_address and inst is not None:
-            direct_address = inst.direct_address
-        tracer = get_tracer()
-        kv_span = tracer.start_span(
-            "kv_transfer", attrs={"blocks": len(hashes),
-                                  "instance": f"{iid:x}"})
-
-        def _count_bytes(n: int, plane: str) -> None:
-            # per-plane attrs: a ladder fall-through (direct pull ok, inject
-            # failed, bulk finished the job) must not attribute one plane's
-            # bytes to another; "plane" records the plane that served the
-            # tail of the transfer
-            kv_span.set_attr("plane", plane)
-            kv_span.set_attr(
-                f"bytes_{plane}",
-                int(kv_span.attrs.get(f"bytes_{plane}", 0)) + int(n))
-            kv_span.set_attr(
-                "bytes", int(kv_span.attrs.get("bytes", 0)) + int(n))
-            try:
-                from dynamo_tpu.worker.metrics import get_worker_metrics
-                get_worker_metrics().disagg_kv_bytes.labels(
-                    "pulled", plane).inc(int(n))
-            except Exception:  # noqa: BLE001 — accounting must not fail IO
-                logger.exception("kv byte accounting failed")
-
-        # per-phase wall time (recv = socket/pull wait, stage = host copy
-        # into the scatter buffer, upload = host->device transfer, scatter
-        # = exclusive-window commits): the bulk-vs-e2e gap lives in these
-        phases = {"recv_s": 0.0, "stage_s": 0.0, "upload_s": 0.0,
-                  "scatter_s": 0.0}
-        try:
-            await self._pull_blocks_inner(hashes, iid, bulk_address,
-                                          direct_address, _count_bytes,
-                                          kv_span, phases)
-        except BaseException as e:
-            kv_span.set_error(repr(e))
-            raise
-        finally:
-            for k, v in phases.items():
-                if v:
-                    kv_span.set_attr(k[:-2] + "_ms", round(v * 1e3, 3))
-            try:
-                if lease is not None:
-                    # ack whatever the outcome: this decode worker never
-                    # comes back for more of THIS pull (a failed tail
-                    # recomputes locally), so the prefill side can unpin
-                    # now instead of waiting out the TTL
-                    acked = await self._ack_export_lease(iid, lease)
-                    kv_span.set_attr("lease_acked", acked)
-            finally:
-                # a cancellation landing on the ack await must not leave
-                # the span unfinished
-                kv_span.finish()
-
-    async def _ack_export_lease(self, iid: int, lease: int) -> bool:
-        try:
-            stream = await self._kv_client.direct(
-                {"ack_lease": int(lease)}, iid)
-            async for _ in stream:
-                pass
-            return True
-        except Exception as e:  # noqa: BLE001 — the TTL GC covers it
-            logger.debug("export lease %s ack to %x failed (%s); TTL "
-                         "covers", lease, iid, e)
-            return False
-
-    def _missing_blocks(self, hashes: list) -> list:
-        """The per-block commit state IS the allocator's content-addressed
-        registry: a block that committed (this pull, an earlier attempt,
-        or any other request) is resident and never re-pulled."""
-        resident = self.engine.allocator._by_hash
-        return [h for h in hashes if h not in resident]
-
-    def _note_resume(self, kv_span, plane: str, committed: int,
-                     remaining: int) -> None:
-        kv_span.add_event("pull_resumed", plane=plane, committed=committed,
-                          remaining=remaining)
-        from dynamo_tpu.worker.metrics import count_metric
-        count_metric("kv_pull_resumes")
-
-    @staticmethod
-    def _note_corrupt(kv_span, plane: str, err) -> None:
-        kv_span.add_event("frame_corrupt", plane=plane, error=str(err))
-        from dynamo_tpu.worker.metrics import count_metric
-        count_metric("kv_frames_corrupt")
-
-    @staticmethod
-    def _note_shard_bytes(kv_span, meta, nbytes: int) -> None:
-        """Per-shard byte attrs on the kv_transfer span (wire-v5 frames
-        carry their shard index): ``bytes_shard{i}`` sums each shard's
-        wire bytes next to the per-plane totals, so an imbalanced or
-        stalled shard stream is attributable without a rerun."""
-        sh = (meta or {}).get("shard")
-        if sh is None:
-            return
-        try:
-            kv_span.set_attr("shards", int(sh["count"]))
-            key = f"bytes_shard{int(sh['index'])}"
-            kv_span.set_attr(
-                key, int(kv_span.attrs.get(key, 0)) + int(nbytes))
-        except Exception:  # noqa: BLE001 — accounting must not fail IO
-            logger.debug("shard byte accounting failed", exc_info=True)
-
-    async def _pull_blocks_inner(self, hashes: list, iid: int,
-                                 bulk_address: str, direct_address: str,
-                                 _count_bytes, kv_span, phases) -> None:
-        injected = total = 0
-        retries = 0
-        resumed_blocks = 0  # blocks NOT re-pulled thanks to commit state
-        bulk_done = False
-        want = self._missing_blocks(hashes)
-        if len(want) < len(hashes):
-            kv_span.set_attr("resident_blocks", len(hashes) - len(want))
-        self.last_pull_stats = {"retries": 0, "resumed_blocks": 0,
-                                "injected": 0, "corrupt": 0}
-
-        def finish_stats():
-            kv_span.set_attr("injected", injected)
-            if retries:
-                kv_span.set_attr("retries", retries)
-                kv_span.set_attr("resumed_blocks", resumed_blocks)
-            self.last_pull_stats.update(retries=retries,
-                                        resumed_blocks=resumed_blocks,
-                                        injected=injected)
-
-        if not want:
-            finish_stats()
-            return
-        now = time.monotonic()
-        # prune expired breaker entries: prefill restarts advertise fresh
-        # ephemeral ports, so per-address state must not grow unbounded
-        self._direct_down_until = {a: t for a, t in
-                                   self._direct_down_until.items()
-                                   if t > now}
-        if (direct_address and self._direct_plane is not None
-                and direct_address not in self._direct_down_until):
-            offer = None
-            try:
-                offer_stream = await self._kv_direct_client.direct(
-                    {"block_hashes": want}, iid)
-                async for o in offer_stream:
-                    offer = o
-                if offer and offer.get("uuid") is not None:
-                    # the network pull runs OUTSIDE the engine's exclusive
-                    # window (it touches no engine state) with a timeout —
-                    # a stalled transfer connection must never wedge the
-                    # decode loop; only the fast device scatter is
-                    # exclusive. A timed-out pull abandons its thread,
-                    # evicts the connection, opens the circuit breaker for
-                    # the address, and falls down the ladder.
-                    t0 = time.perf_counter()
-                    data = await asyncio.wait_for(
-                        asyncio.to_thread(self._direct_plane.pull, offer),
-                        timeout=self.direct_pull_timeout)
-                    phases["recv_s"] += time.perf_counter() - t0
-                    _count_bytes(getattr(data, "nbytes", 0), "direct")
-                    # commit in bounded windows, one minimal exclusive
-                    # scatter each: decode steps interleave with a large
-                    # direct-plane inject instead of stalling behind it
-                    metas = [(b[0], b[1], b[2])
-                             for b in offer["blocks"]]
-                    t0 = time.perf_counter()
-                    injected = await inject_device_windowed(
-                        self.engine, metas, data[:, :len(metas)])
-                    phases["scatter_s"] += time.perf_counter() - t0
-                    logger.debug("device-direct pull injected %d blocks "
-                                 "from %x", injected, iid)
-                    await self._ack_offer(iid, offer["uuid"])
-                    finish_stats()
-                    return
-                # empty offer: blocks evicted remotely OR the peer's offer
-                # table is full — fall through to the host planes (the
-                # bulk fetch serves the full-table case; the evicted case
-                # costs one empty round trip)
-            except asyncio.TimeoutError:
-                self._direct_plane.evict(offer["address"] if offer
-                                         else direct_address)
-                self._direct_down_until[direct_address] = (
-                    time.monotonic() + self.direct_down_window)
-                logger.warning(
-                    "device-direct KV pull from %s timed out after %.0fs; "
-                    "skipping the plane for %.0fs", direct_address,
-                    self.direct_pull_timeout, self.direct_down_window)
-            except Exception as e:  # noqa: BLE001 — fall down the ladder
-                logger.warning("device-direct KV pull from %s failed (%s); "
-                               "trying the bulk plane", direct_address, e)
-        # resume budget per host plane: a failed attempt re-pulls only the
-        # still-missing blocks before falling down the ladder
-        attempts_per_plane = 1 + self.pull_resume_attempts
-        if bulk_address:
-            from dynamo_tpu.runtime.bulk import prewarm_async
-            if bulk_address not in self._bulk_warmed:
-                # background warmup: THIS fetch still rides a cold socket,
-                # but every later fetch to the peer finds a pooled, ramped
-                # connection (and concurrent pulls find extra capacity).
-                # A warmup that fails outright un-marks the address so a
-                # later pull retries (peer briefly unreachable).
-                self._bulk_warmed.add(bulk_address)
-                prewarm_async(
-                    bulk_address, f"{iid:x}",
-                    on_fail=lambda a=bulk_address:
-                        self._bulk_warmed.discard(a))
-            for attempt in range(attempts_per_plane):
-                want = self._missing_blocks(hashes)
-                if not want:
-                    bulk_done = True
-                    break
-                if attempt:
-                    retries += 1
-                    resumed_blocks = len(hashes) - len(want)
-                    self._note_resume(kv_span, "bulk", resumed_blocks,
-                                      len(want))
-                pipe = InjectPipeline(self.engine)
-                seen_windows: set = set()
-
-                def on_meta(meta, nbytes):
-                    nonlocal total
-                    _count_bytes(nbytes, "bulk")
-                    self._note_shard_bytes(kv_span, meta, nbytes)
-                    if meta.get("shard") is not None:
-                        # count each block window once, not per shard slice
-                        key = tuple(b[0] for b in meta["blocks"])
-                        if key in seen_windows:
-                            return
-                        seen_windows.add(key)
-                    total += len(meta["blocks"])
-
-                try:
-                    # stream-and-stage (engine/transfer.pump_bulk_frames):
-                    # frames stage/commit while later frames are still on
-                    # the wire, wire buffers recycle through the pipeline.
-                    # A sharded cache advertises its shard layout so a
-                    # same-layout exporter streams per-shard frames
-                    # (wire v5) instead of host-gathered merged frames.
-                    phases["recv_s"] += await pump_bulk_frames(
-                        pipe, bulk_address, KV_EXPORT_ENDPOINT,
-                        {"block_hashes": want,
-                         "wire": FRAME_WIRE_VERSION,
-                         **kv_shard_payload(self.engine)},
-                        f"{iid:x}", 60.0, on_meta)
-                    injected += await pipe.finish()
-                    bulk_done = True
-                    break
-                except FrameIntegrityError as e:
-                    # checksum NACK: the corrupted frame was rejected
-                    # before staging (never injected) and the stream
-                    # aborted; committed frames stay, the resume re-pulls
-                    # the rest
-                    injected += pipe.injected
-                    self.last_pull_stats["corrupt"] += 1
-                    self._note_corrupt(kv_span, "bulk", e)
-                    logger.warning("bulk KV frame from %s failed checksum "
-                                   "(%s); re-pulling missing blocks",
-                                   bulk_address, e)
-                except Exception as e:  # noqa: BLE001 — bulk plane broke
-                    # mid-pull (socket reset, worker bound to 127.0.0.1
-                    # across hosts, peer death): resume on this plane,
-                    # then the RPC export path below — never waste the
-                    # completed remote prefill over a transport problem.
-                    # pump already reaped its fetch thread and in-flight
-                    # commits; whatever committed cleanly stays (content-
-                    # addressed blocks are never wasted, every retry
-                    # dedups against them).
-                    injected += pipe.injected
-                    logger.warning("bulk KV fetch from %s failed (%s); %s",
-                                   bulk_address, e,
-                                   "resuming missing blocks"
-                                   if attempt + 1 < attempts_per_plane
-                                   else "falling back to the RPC export "
-                                        "path")
-                finally:
-                    for k, v in pipe.timings.items():
-                        phases[k] += v
-        if not bulk_done:
-            last_err = None
-            for attempt in range(attempts_per_plane):
-                want = self._missing_blocks(hashes)
-                if not want:
-                    last_err = None
-                    break
-                if attempt or (bulk_address and injected):
-                    # count a ladder/same-plane resume whenever committed
-                    # work is being carried over into a new attempt
-                    retries += 1
-                    resumed_blocks = len(hashes) - len(want)
-                    self._note_resume(kv_span, "rpc", resumed_blocks,
-                                      len(want))
-                def note_blocks(n: int) -> None:
-                    nonlocal total
-                    total += n
-
-                def note_injected(n: int) -> None:
-                    nonlocal injected
-                    injected += n
-
-                try:
-                    await self._pull_rpc(want, iid, _count_bytes, phases,
-                                         note_blocks, note_injected,
-                                         kv_span)
-                    last_err = None
-                    break
-                except FrameIntegrityError as e:
-                    last_err = e
-                    self.last_pull_stats["corrupt"] += 1
-                    self._note_corrupt(kv_span, "rpc", e)
-                    logger.warning("RPC KV frame from %x failed checksum "
-                                   "(%s); re-pulling missing blocks",
-                                   iid, e)
-                except Exception as e:  # noqa: BLE001 — retried below
-                    last_err = e
-                    logger.warning("RPC KV fetch from %x failed (%s)",
-                                   iid, e)
-            if last_err is not None:
-                finish_stats()
-                raise last_err
-        if total:
-            logger.debug("injected %d/%d transferred blocks",
-                         injected, total)
-        finish_stats()
-
-    async def _pull_rpc(self, want: list, iid: int, _count_bytes,
-                        phases, note_blocks, note_injected,
-                        kv_span=None) -> None:
-        """One RPC-plane pull attempt of ``want`` through the staged
-        pipeline. Blocks injected are reported through ``note_injected``
-        — on the failure path too, so partial commits reaped by the drain
-        still count (the caller's resume dedups against them)."""
-        from dynamo_tpu.runtime.codec import release_buffer
-
-        kv_stream = await self._kv_client.direct(
-            {"block_hashes": want, "wire": FRAME_WIRE_VERSION,
-             **kv_shard_payload(self.engine)}, iid)
-        # batched two-part frames through the staged pipeline: frame k
-        # stages/commits while frame k+1 is still in flight (zero
-        # msgpack re-copies). Old exporters answering with the
-        # per-block schema ride the same pipeline via add_blocks.
-        pipe = InjectPipeline(self.engine)
-        seen_windows: set = set()
-        try:
-            t0 = time.perf_counter()
-            async for frame in kv_stream:
-                phases["recv_s"] += time.perf_counter() - t0
-                if "_raw" in frame:
-                    _count_bytes(len(frame["_raw"]), "rpc")
-                    if kv_span is not None:
-                        self._note_shard_bytes(kv_span, frame,
-                                               len(frame["_raw"]))
-                    if frame.get("shard") is not None:
-                        key = tuple(b[0] for b in frame["blocks"])
-                        if key not in seen_windows:
-                            seen_windows.add(key)
-                            note_blocks(len(frame["blocks"]))
-                        # fall through to staging either way
-                    else:
-                        note_blocks(len(frame["blocks"]))
-                    # pipeline recycles the pooled trailer buffer
-                    # once its bytes are consumed
-                    await pipe.add_frame(frame, release=release_buffer)
-                else:  # pre-batched single-block schema
-                    note_blocks(1)
-                    await pipe.add_blocks(
-                        [BlockPayload.from_wire(frame)])
-                t0 = time.perf_counter()
-            note_injected(await pipe.finish())
-        except BaseException:
-            note_injected(await pipe.drain())
-            raise
-        finally:
-            for k, v in pipe.timings.items():
-                phases[k] += v
-
-    async def _ack_offer(self, iid: int, uuid: int) -> None:
-        """Release the peer's pinned device-direct offer. Retried once —
-        a lost ack leaves the gathered array pinned in the peer's HBM
-        until its offer TTL — and counted
-        (``dynamo_worker_kv_offer_acks_total``)."""
-        acked = False
-        for attempt in range(2):
-            try:
-                ack = await self._kv_direct_client.direct(
-                    {"ack": int(uuid)}, iid)
-                async for _ in ack:
-                    pass
-                acked = True
-                break
-            except Exception as e:  # noqa: BLE001 — retry once, then TTL
-                logger.debug("device-direct offer ack to %x failed "
-                             "(attempt %d: %s)", iid, attempt + 1, e)
-        if not acked:
-            logger.warning("device-direct offer %s ack to %x failed "
-                           "twice; peer unpins at its offer TTL",
-                           uuid, iid)
-        from dynamo_tpu.worker.metrics import count_metric
-        count_metric("kv_offer_acks", "ok" if acked else "failed")
-
     async def _inbound_prefill(self, request: PreprocessedRequest
                                ) -> Optional[LLMEngineOutput]:
         """PREFILL-FIRST inbound leg: the request arrives WITH
@@ -998,7 +1079,13 @@ class DisaggDecodeHandler:
     async def generate(self, request: PreprocessedRequest,
                        ctx=None) -> AsyncIterator[LLMEngineOutput]:
         first: Optional[LLMEngineOutput] = None
-        if request.kv_transfer_params:
+        if getattr(self.engine, "draining", False):
+            # a request that raced the drain announcement: don't burn a
+            # remote prefill for an engine that will refuse it — the
+            # engine's replay marker sends it straight back to the
+            # frontend's migration layer
+            pass
+        elif request.kv_transfer_params:
             first = await self._inbound_prefill(request)
         elif self._use_remote_prefill(request):
             first = await self._remote_prefill(request)
@@ -1032,6 +1119,12 @@ async def _continue_after_first(engine: JaxEngine,
             return
         request = PreprocessedRequest.from_dict(request.to_dict())
         request.token_ids = list(request.token_ids) + [tok]
+        # the handed-off token is GENERATED output riding the prompt:
+        # penalties keep counting it, and a later graceful drain's
+        # resume token counts it in its cumulative tokens_done (the
+        # frontend's desync check compares against the client-side
+        # stream, which includes it)
+        request.resumed_tokens = (request.resumed_tokens or 0) + 1
         if request.stop_conditions.max_tokens is not None:
             request.stop_conditions.max_tokens -= 1
     async for out in engine.generate(request, ctx):
@@ -1159,4 +1252,5 @@ class PrefillFirstHandler:
 
 
 __all__ = ["DisaggDecodeHandler", "PrefillFirstHandler", "DisaggConfig",
+           "KvBlockPuller",
            "disagg_conf_key", "KV_EXPORT_ENDPOINT"]

@@ -354,6 +354,25 @@ async def amain(args: argparse.Namespace) -> None:
 
     handler = None
     prefill_first = args.disagg_strategy == "prefill_first"
+    # graceful drain & live migration (worker/drain.py): workers that hold
+    # decode streams serve their component's kv_export endpoint so a
+    # SURVIVOR can pull a draining peer's pinned sequence KV, and admit
+    # inbound resume tokens through ResumeAdmission. Tiered and
+    # disagg-prefill workers already serve the endpoint (G4 peer tier /
+    # prefill export) — registering again would clobber the richer handler.
+    resume_admission = None
+    served_main = None
+    comp = drt.namespace(args.namespace).component(args.component)
+    if args.disagg != "prefill":
+        from dynamo_tpu.engine.transfer import serve_kv_export
+        from dynamo_tpu.worker.disagg import KV_EXPORT_ENDPOINT
+        from dynamo_tpu.worker.drain import ResumeAdmission
+        if tiered is None:
+            await comp.endpoint(KV_EXPORT_ENDPOINT).serve(
+                serve_kv_export(engine))
+        resume_admission = ResumeAdmission(
+            engine, kv_client=await comp.endpoint(KV_EXPORT_ENDPOINT)
+            .client())
     if args.disagg == "decode":
         from dynamo_tpu.worker.disagg import DisaggDecodeHandler
         handler = await DisaggDecodeHandler(
@@ -364,8 +383,9 @@ async def amain(args: argparse.Namespace) -> None:
             strategy=args.disagg_strategy).start()
         from dynamo_tpu.llm.register import engine_handler
         await engine.start()
-        await endpoint.serve(engine_handler(handler),
-                             stats_provider=worker_stats)
+        served_main = await endpoint.serve(
+            engine_handler(handler, resume_admission),
+            stats_provider=worker_stats)
     elif args.disagg == "prefill" and prefill_first:
         from dynamo_tpu.llm.register import engine_handler
         from dynamo_tpu.worker.disagg import PrefillFirstHandler
@@ -374,11 +394,13 @@ async def amain(args: argparse.Namespace) -> None:
             engine, drt, args.namespace, args.decode_component,
             instance_id=pf_lease.lease_id).start()
         await engine.start()
-        await endpoint.serve(engine_handler(handler),
-                             stats_provider=worker_stats)
+        served_main = await endpoint.serve(engine_handler(handler),
+                                           stats_provider=worker_stats)
     else:
-        await serve_engine(endpoint, tiered if tiered is not None else engine,
-                           stats_provider=worker_stats)
+        served_main = await serve_engine(
+            endpoint, tiered if tiered is not None else engine,
+            stats_provider=worker_stats,
+            resume_admission=resume_admission)
     # the aux plane (embeddings + prompt scoring) rides every worker that
     # serves chat traffic, so DISTRIBUTED frontends can offer
     # /v1/embeddings and completions echo (RemotePipeline calls it)
@@ -489,6 +511,22 @@ async def amain(args: argparse.Namespace) -> None:
     if system is not None:
         system.health.register("engine", ready=True)
         await system.start()
+    # graceful drain: SIGTERM (and POST /drain on the system server) stops
+    # new work via the coordinator announcement, freezes in-flight streams
+    # into resume tokens survivors pull the pinned KV for, waits (bounded
+    # by DYN_DRAIN_TIMEOUT_S) for the lease acks, then shuts down. kill -9
+    # keeps the keepalive-detect + replay path — drain is strictly better.
+    from dynamo_tpu.worker.drain import DrainController, install_signal_drain
+    drain_lease = await drt.primary_lease()
+    resume_extras = {"instance_id": drain_lease.lease_id}
+    if bulk_server is not None:
+        resume_extras["bulk_address"] = bulk_server.address
+    drain = DrainController(
+        engine, served=[se for se in (served_main,) if se is not None],
+        resume_extras=resume_extras, on_drained=drt.runtime.shutdown)
+    install_signal_drain(drain)
+    if system is not None:
+        system.register_drain(drain)
     print(f"jax worker serving model {card.name} "
           f"on {len(jax.devices())} device(s) (disagg={args.disagg})",
           flush=True)

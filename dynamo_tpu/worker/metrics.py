@@ -8,9 +8,14 @@ the requests it actually absorbed.  This registry closes that gap:
 - ``dynamo_worker_requests_total{outcome}`` — requests by admission outcome:
   ``admitted``, ``refused_expired`` (deadline already passed on arrival),
   ``deadline_cancelled`` (expired mid-generation), ``error``.
-- ``dynamo_worker_migration_replays_total`` — migration replays this worker
-  ABSORBED (requests re-issued by a frontend after another worker dropped
-  the stream; stamped via ``PreprocessedRequest.migration_attempt``).
+- ``dynamo_worker_migration_replays_total{mode}`` — migrated streams this
+  worker ABSORBED (requests re-issued by a frontend after another worker
+  dropped or drained the stream; stamped via
+  ``PreprocessedRequest.migration_attempt``): ``resume`` rode a pinned-KV
+  resume token, ``replay`` recomputed from scratch.
+- ``dynamo_worker_drain_state`` / ``dynamo_worker_migrated_sequences_total``
+  — the graceful-drain lifecycle (``worker/drain.py``): drain progress and
+  how many in-flight sequences were handed off resumable vs replayed.
 - ``dynamo_worker_disagg_kv_bytes_total{direction,plane}`` — disagg KV block
   bytes moved, by direction (``pulled``) and transport plane
   (``direct``/``bulk``/``rpc``) — the FlowKV-dominant cost made visible.
@@ -205,9 +210,32 @@ class WorkerMetrics:
             ["outcome"], registry=self.registry)
         self.migration_replays = Counter(
             f"{ns}_migration_replays_total",
-            "Migration replays absorbed (streams re-issued by a frontend "
-            "after another worker dropped them)",
+            "Migrated streams absorbed (re-issued by a frontend after "
+            "another worker dropped or drained them), by mode: 'resume' "
+            "carries a pinned-KV resume token (no recomputed prefill), "
+            "'replay' recomputes from scratch",
+            ["mode"], registry=self.registry)
+        # -- graceful drain ---------------------------------------------
+        self.drain_state = Gauge(
+            f"{ns}_drain_state",
+            "Worker lifecycle state: 0 serving, 1 draining (in-flight "
+            "streams being frozen/handed off), 2 drained (migration "
+            "complete or timed out; about to exit)",
             registry=self.registry)
+        self.migrated_sequences = Counter(
+            f"{ns}_migrated_sequences_total",
+            "In-flight sequences this worker handed off during a graceful "
+            "drain, by outcome: 'ok' shipped a pinned-KV resume token, "
+            "'fallback' shipped a replay marker (nothing committed yet, "
+            "or the engine cannot export KV)",
+            ["outcome"], registry=self.registry)
+        # pre-seed the label sets so every mode/outcome shows on the
+        # scrape at 0 (dashboards/alerts can reference them before the
+        # first drain happens)
+        for mode in ("replay", "resume"):
+            self.migration_replays.labels(mode)
+        for outcome in ("ok", "fallback"):
+            self.migrated_sequences.labels(outcome)
         self.disagg_kv_bytes = Counter(
             f"{ns}_disagg_kv_bytes_total",
             "Disaggregated-prefill KV block bytes transferred, by direction "

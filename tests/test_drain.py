@@ -1,0 +1,581 @@
+"""Graceful drain & live decode migration (worker/drain.py).
+
+Three lifecycle paths, chaos-tested against real engines and runtime
+objects:
+
+- CLEAN DRAIN: SIGTERM / ``POST /drain`` freezes every in-flight stream
+  into a resume token; survivors pull the pinned KV and continue from the
+  next token — zero lost streams, zero recomputed prefill tokens,
+  bit-identical output for greedy/seeded rows.
+- ``kill -9`` MID-DRAIN: the worker dies after freezing (resume tokens
+  shipped, KV pinned) but before any survivor pulls — resume pulls fail
+  and admission falls back to recompute; every stream still completes and
+  no leases leak on the survivors.
+- DRAIN RACING A COORDINATOR BLIP: the drain announcement lives on the
+  served instance record, so a control-plane crash + state-wiped restart
+  re-announces it draining (resync re-put) — routers keep routing around
+  the drained worker.
+
+Plus the PR 6 gotcha regression: a reused ``request_id`` across two
+generates used to wedge the second forever; now it is refused loudly
+(migration rebuilds derive unique ids for exactly this reason).
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from dynamo_tpu.engine.jax_engine import JaxEngine, JaxEngineConfig
+from dynamo_tpu.engine.loop import MIGRATION_KEY, migration_token
+from dynamo_tpu.engine.transfer import get_export_leases, serve_kv_export
+from dynamo_tpu.llm.pipeline import RemotePipeline
+from dynamo_tpu.llm.register import register_llm, serve_engine
+from dynamo_tpu.mocker import MockEngineArgs, MockerEngine
+from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.protocols.common import (
+    FinishReason,
+    LLMEngineOutput,
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.runtime.coordinator import Coordinator
+from dynamo_tpu.runtime.push_router import PushRouter
+from dynamo_tpu.runtime.runtime import DistributedRuntime
+from dynamo_tpu.utils.faults import CoordinatorOutage, WorkerDrain
+from dynamo_tpu.utils.testing import make_test_card
+from dynamo_tpu.worker.disagg import KV_EXPORT_ENDPOINT
+from dynamo_tpu.worker.drain import DrainController, ResumeAdmission
+from dynamo_tpu.worker.metrics import get_worker_metrics
+
+
+def make_req(tokens, rid, max_tokens=20, seed=None, temperature=0.0):
+    return PreprocessedRequest(
+        token_ids=list(tokens), request_id=rid,
+        stop_conditions=StopConditions(max_tokens=max_tokens,
+                                       ignore_eos=True),
+        sampling_options=SamplingOptions(temperature=temperature, seed=seed))
+
+
+def _engine_cfg(num_pages=128):
+    return JaxEngineConfig(num_pages=num_pages, page_size=4, max_num_seqs=8,
+                           max_prefill_chunk=64, max_context=512,
+                           min_prefill_bucket=4, decode_multistep=1)
+
+
+def _pace(engine, seconds: float) -> None:
+    """Slow every engine step so drains land mid-stream deterministically
+    (decode_multistep=1 keeps the pacing per token)."""
+    orig = engine._execute_plan
+
+    def paced(plan):
+        time.sleep(seconds)
+        return orig(plan)
+
+    engine._execute_plan = paced
+
+
+async def _start_drain_worker(coordinator, name="m", component="w",
+                              pace=0.02, num_pages=128):
+    """One in-process jax worker with the full drain wiring worker/main
+    does: kv_export served, ResumeAdmission on the generate handler, and
+    a WorkerDrain harness driving the production DrainController."""
+    drt = await DistributedRuntime.create(coordinator=coordinator)
+    engine = JaxEngine.random_init(ModelConfig.tiny(), _engine_cfg(num_pages))
+    if pace:
+        _pace(engine, pace)
+    comp = drt.namespace("ns").component(component)
+    await comp.endpoint(KV_EXPORT_ENDPOINT).serve(serve_kv_export(engine))
+    ra = ResumeAdmission(
+        engine, kv_client=await comp.endpoint(KV_EXPORT_ENDPOINT).client())
+    served = await serve_engine(comp.endpoint("generate"), engine,
+                                resume_admission=ra)
+    await register_llm(drt, comp.endpoint("generate"),
+                       make_test_card(name=name, kv_cache_block_size=4))
+    lease = await drt.primary_lease()
+    wd = WorkerDrain(drt, engine, served=[served],
+                     resume_extras={"instance_id": lease.lease_id})
+    return wd
+
+
+async def _solo_tokens(reqs, num_pages=128):
+    """The undrained reference run: one fresh engine (same deterministic
+    random weights), the same requests, sequentially."""
+    solo = JaxEngine.random_init(ModelConfig.tiny(), _engine_cfg(num_pages))
+    try:
+        out = []
+        for req in reqs:
+            r = PreprocessedRequest.from_dict(req.to_dict())
+            r.request_id = f"{req.request_id}-solo"
+            out.append([t async for f in solo.generate(r)
+                        for t in f.token_ids])
+        return out
+    finally:
+        await solo.stop()
+
+
+async def _drive(pipeline, req, started: asyncio.Event, after=2):
+    frames = []
+    async for out in pipeline.engine_stream(req):
+        frames.append(out)
+        if sum(len(f.token_ids) for f in frames) >= after:
+            started.set()
+    started.set()
+    return frames
+
+
+class TestDuplicateRequestId:
+    """PR 6 gotcha, fixed for real: a reused request_id across two
+    generates on one engine used to clobber the first stream's queue and
+    wedge the second caller forever."""
+
+    async def test_duplicate_rid_refused_with_clear_error(self):
+        engine = MockerEngine(MockEngineArgs(
+            num_pages=64, page_size=4, max_num_seqs=8, max_prefill_chunk=32,
+            max_context=256, speedup_ratio=1.0, prefill_base_s=0.001,
+            decode_base_s=0.05, decode_multistep=1))
+        try:
+            first_frames = []
+
+            async def consume():
+                async for f in engine.generate(
+                        make_req(range(1, 8), "dup", max_tokens=30)):
+                    first_frames.append(f)
+
+            t1 = asyncio.ensure_future(consume())
+            for _ in range(100):
+                if first_frames:
+                    break
+                await asyncio.sleep(0.02)
+            assert first_frames, "first stream never started"
+
+            dup = [f async for f in engine.generate(
+                make_req(range(1, 8), "dup", max_tokens=30))]
+            assert dup[-1].finish_reason == FinishReason.ERROR
+            assert "duplicate request_id" in dup[-1].error
+            # the FIRST stream is unharmed by the refusal
+            await t1
+            assert sum(len(f.token_ids) for f in first_frames) == 30
+        finally:
+            await engine.stop()
+
+
+class TestDrainFreeze:
+    """Engine-level drain_migrate: freeze, pin, resume-token shape."""
+
+    async def test_freeze_ships_resume_token_and_pins_kv(self):
+        engine = JaxEngine.random_init(ModelConfig.tiny(), _engine_cfg())
+        try:
+            _pace(engine, 0.02)
+            frames = []
+
+            async def consume():
+                async for f in engine.generate(
+                        make_req(range(1, 14), "r1", max_tokens=40)):
+                    frames.append(f)
+
+            t = asyncio.ensure_future(consume())
+            for _ in range(200):
+                if sum(len(f.token_ids) for f in frames) >= 3:
+                    break
+                await asyncio.sleep(0.02)
+            counts = await engine.drain_migrate({"instance_id": 7})
+            await t
+            assert counts == {"resume": 1, "replay": 0}
+            tok = migration_token(frames[-1])
+            assert tok is not None and tok.get("blocks")
+            # the token freezes exactly the stream the client saw
+            n_seen = sum(len(f.token_ids) for f in frames)
+            assert tok["tokens_done"] == n_seen
+            assert tok["instance_id"] == 7
+            assert tok["num_tokens_cached"] == len(tok["blocks"]) * 4
+            assert tok["sampling"]["stop_tail"] == \
+                [t for f in frames for t in f.token_ids][-4:]
+            # pinned under a TTL'd export lease until the survivor acks
+            mgr = get_export_leases(engine)
+            assert tok.get("lease") is not None
+            assert mgr.active_kind("export") == 1
+            assert mgr.pinned_pages == len(tok["blocks"])
+            # a request racing the drain is refused with a replay marker
+            late = [f async for f in engine.generate(
+                make_req(range(1, 6), "late", max_tokens=5))]
+            assert migration_token(late[-1]) == {}
+            # survivor ack unpins
+            assert await mgr.release(tok["lease"])
+            assert mgr.active_kind("export") == 0
+        finally:
+            await engine.stop()
+
+    async def test_drain_timeout_exits_without_acks(self):
+        engine = JaxEngine.random_init(ModelConfig.tiny(), _engine_cfg())
+        try:
+            _pace(engine, 0.02)
+            frames = []
+
+            async def consume():
+                async for f in engine.generate(
+                        make_req(range(1, 10), "r1", max_tokens=40)):
+                    frames.append(f)
+
+            t = asyncio.ensure_future(consume())
+            for _ in range(200):
+                if sum(len(f.token_ids) for f in frames) >= 2:
+                    break
+                await asyncio.sleep(0.02)
+            ctl = DrainController(engine, timeout_s=0.2)
+            t0 = time.monotonic()
+            counts = await ctl.drain("test")
+            await t
+            assert counts["resume"] == 1
+            assert ctl.state == "drained"
+            assert time.monotonic() - t0 < 5.0
+            # nobody acked: the lease is still pinned (TTL GC covers it)
+            assert get_export_leases(engine).active_kind("export") == 1
+        finally:
+            await engine.stop()
+
+
+class TestMigrationOperatorResume:
+    """Frontend half: a resume token stashed from a draining worker's
+    last frame turns the rebuild into a resume, with a derived unique
+    request id and the generated tail marked via resumed_tokens."""
+
+    async def test_rebuild_attaches_token_and_derives_id(self):
+        from dynamo_tpu.llm.operators import MigrationOperator, link
+        from dynamo_tpu.runtime.rpc import StreamEndedError
+
+        seen = []
+
+        async def sink(req):
+            seen.append(req)
+            if len(seen) == 1:
+                for tok in (11, 12, 13):
+                    yield LLMEngineOutput(token_ids=[tok], log_probs=[0.0])
+                yield LLMEngineOutput(kv_transfer_params={MIGRATION_KEY: {
+                    "blocks": [[1, 2, None]], "tokens_done": 3,
+                    "lease": 9, "instance_id": 4}})
+                raise StreamEndedError("drained")
+            yield LLMEngineOutput(token_ids=[14], log_probs=[0.0],
+                                  finish_reason=FinishReason.LENGTH)
+
+        source = link([MigrationOperator(3)], sink)
+        req = make_req(range(1, 6), "rid-1", max_tokens=10)
+        req.stop_conditions.min_tokens = 4
+        frames = [f async for f in source(req)]
+        toks = [t for f in frames for t in f.token_ids]
+        assert toks == [11, 12, 13, 14]
+        # the migration frame itself is internal — never yielded upward
+        assert all(migration_token(f) is None for f in frames)
+        r2 = seen[1]
+        assert r2.request_id == "rid-1~m1"  # derived: engines refuse reuse
+        assert r2.kv_transfer_params[MIGRATION_KEY]["blocks"] == [[1, 2, None]]
+        assert r2.resumed_tokens == 3
+        assert list(r2.token_ids) == list(range(1, 6)) + [11, 12, 13]
+        assert r2.stop_conditions.max_tokens == 7
+        assert r2.stop_conditions.min_tokens == 1
+        assert r2.migration_attempt == 1
+
+    async def test_second_drain_resumes_with_cumulative_state(self):
+        """A stream drained TWICE: the second leg's resume token must
+        count tokens cumulatively (earlier legs ride the rebuilt prompt)
+        or the desync check would kill every multi-hop resume."""
+        from dynamo_tpu.llm.operators import MigrationOperator, link
+        from dynamo_tpu.runtime.rpc import StreamEndedError
+
+        seen = []
+
+        async def sink(req):
+            seen.append(req)
+            leg = len(seen)
+            if leg == 1:
+                for t in (11, 12, 13):
+                    yield LLMEngineOutput(token_ids=[t], log_probs=[0.0])
+                yield LLMEngineOutput(kv_transfer_params={MIGRATION_KEY: {
+                    "blocks": [[1, 1, None]], "tokens_done": 3,
+                    "sampling": {"stop_tail": [11, 12, 13]}}})
+                raise StreamEndedError("drained")
+            if leg == 2:
+                for t in (14, 15):
+                    yield LLMEngineOutput(token_ids=[t], log_probs=[0.0])
+                # the cumulative shape loop.py now ships: tokens_done =
+                # resumed_tokens + this leg, tail spans both legs
+                yield LLMEngineOutput(kv_transfer_params={MIGRATION_KEY: {
+                    "blocks": [[2, 2, None]], "tokens_done": 5,
+                    "sampling": {"stop_tail": [12, 13, 14, 15]}}})
+                raise StreamEndedError("drained again")
+            yield LLMEngineOutput(token_ids=[16], log_probs=[0.0],
+                                  finish_reason=FinishReason.LENGTH)
+
+        source = link([MigrationOperator(3)], sink)
+        frames = [f async for f in source(make_req(range(1, 6), "hop2",
+                                                   max_tokens=10))]
+        assert [t for f in frames for t in f.token_ids] \
+            == [11, 12, 13, 14, 15, 16]
+        r3 = seen[2]
+        assert r3.kv_transfer_params[MIGRATION_KEY]["blocks"] == [[2, 2,
+                                                                   None]]
+        assert r3.resumed_tokens == 5
+        assert r3.request_id == "hop2~m2"
+
+    async def test_tail_mismatch_discarded_replay_instead(self):
+        """tokens_done can coincide while the content desynced — the
+        operator cross-checks the token's generated tail too."""
+        from dynamo_tpu.llm.operators import MigrationOperator, link
+        from dynamo_tpu.runtime.rpc import StreamEndedError
+
+        seen = []
+
+        async def sink(req):
+            seen.append(req)
+            if len(seen) == 1:
+                for t in (11, 12, 13):
+                    yield LLMEngineOutput(token_ids=[t], log_probs=[0.0])
+                yield LLMEngineOutput(kv_transfer_params={MIGRATION_KEY: {
+                    "blocks": [[1, 1, None]], "tokens_done": 3,
+                    "sampling": {"stop_tail": [11, 12, 99]}}})
+                raise StreamEndedError("drained")
+            yield LLMEngineOutput(token_ids=[14], log_probs=[0.0],
+                                  finish_reason=FinishReason.LENGTH)
+
+        source = link([MigrationOperator(3)], sink)
+        frames = [f async for f in source(make_req(range(1, 6), "tm"))]
+        assert [t for f in frames for t in f.token_ids] == [11, 12, 13, 14]
+        assert seen[1].kv_transfer_params is None  # replay, not resume
+
+    async def test_desynced_token_discarded_replay_instead(self):
+        from dynamo_tpu.llm.operators import MigrationOperator, link
+        from dynamo_tpu.runtime.rpc import StreamEndedError
+
+        seen = []
+
+        async def sink(req):
+            seen.append(req)
+            if len(seen) == 1:
+                yield LLMEngineOutput(token_ids=[11], log_probs=[0.0])
+                # worker froze a DIFFERENT stream state than the client saw
+                yield LLMEngineOutput(kv_transfer_params={MIGRATION_KEY: {
+                    "blocks": [[1, 2, None]], "tokens_done": 99}})
+                raise StreamEndedError("drained")
+            yield LLMEngineOutput(token_ids=[12], log_probs=[0.0],
+                                  finish_reason=FinishReason.LENGTH)
+
+        source = link([MigrationOperator(3)], sink)
+        frames = [f async for f in source(make_req(range(1, 6), "r"))]
+        assert [t for f in frames for t in f.token_ids] == [11, 12]
+        assert seen[1].kv_transfer_params is None  # replay, not resume
+
+
+@pytest.mark.chaos
+class TestCleanDrain:
+    async def test_zero_lost_streams_bit_identical_no_recomputed_prefill(
+            self, monkeypatch):
+        monkeypatch.setenv("DYN_DRAIN_TIMEOUT_S", "10")
+        wm = get_worker_metrics()
+        resumes0 = wm.migration_replays.labels("resume")._value.get()
+        migrated0 = wm.migrated_sequences.labels("ok")._value.get()
+        coord = await Coordinator(port=0).start()
+        workers, fe = [], None
+        try:
+            w1 = await _start_drain_worker(coord.address, "m")
+            w2 = await _start_drain_worker(coord.address, "m")
+            workers = [w1, w2]
+            fe = await DistributedRuntime.create(coordinator=coord.address)
+            client = await (fe.namespace("ns").component("w")
+                            .endpoint("generate").client())
+            await client.wait_for_instances(2, timeout=10)
+            card = make_test_card(name="m", kv_cache_block_size=4)
+            pipeline = RemotePipeline(card, PushRouter(client),
+                                      migration_limit=3)
+            reqs = [make_req(range(1 + i, 14 + i), f"r{i}", max_tokens=16)
+                    for i in range(3)]
+            # one seeded sampled row: resume must be bit-identical for it
+            # too (sampling is position-keyed)
+            reqs.append(make_req(range(5, 18), "r-seed", max_tokens=16,
+                                 seed=1234, temperature=0.8))
+            events = [asyncio.Event() for _ in reqs]
+            tasks = [asyncio.ensure_future(_drive(pipeline, r, ev))
+                     for r, ev in zip(reqs, events)]
+            await asyncio.gather(*[asyncio.wait_for(ev.wait(), 30)
+                                   for ev in events])
+            busy = w1 if w1.engine.scheduler.active else w2
+            assert busy.engine.scheduler.active  # streams mid-decode
+            counts = await busy.sigterm()
+            all_frames = await asyncio.gather(*tasks)
+
+            # zero lost streams: every request completed at full length
+            for req, frames in zip(reqs, all_frames):
+                toks = [t for f in frames for t in f.token_ids]
+                assert len(toks) == 16, (req.request_id, len(toks))
+                assert frames[-1].finish_reason == FinishReason.LENGTH
+            # the drained worker handed off its in-flight streams as
+            # RESUMES (pinned KV), and the survivor absorbed them
+            assert counts["resume"] >= 1
+            assert wm.migrated_sequences.labels("ok")._value.get() \
+                >= migrated0 + counts["resume"]
+            assert wm.migration_replays.labels("resume")._value.get() \
+                >= resumes0 + 1
+            # drain completed: survivors pulled + acked every lease before
+            # the timeout (the controller waited, then we closed)
+            mgr = get_export_leases(busy.engine)
+            assert mgr.active_kind("export") == 0
+            # zero recomputed prefill tokens: resumed rows admitted with
+            # the FULL prefix cached (>= the original prompt — nothing of
+            # the prompt was prefilled again)
+            resumed_finals = [fr[-1] for r, fr in zip(reqs, all_frames)
+                              if (fr[-1].cached_tokens or 0)
+                              >= len(r.token_ids)]
+            assert len(resumed_finals) >= counts["resume"]
+            # bit-identical to an undrained run, greedy AND seeded
+            solo = await _solo_tokens(reqs)
+            for req, frames, ref in zip(reqs, all_frames, solo):
+                toks = [t for f in frames for t in f.token_ids]
+                assert toks == ref, req.request_id
+        finally:
+            for w in workers:
+                try:
+                    await w._close()
+                except Exception:
+                    pass
+            if fe is not None:
+                await fe.close()
+            await coord.stop()
+
+
+@pytest.mark.chaos
+class TestKill9MidDrain:
+    async def test_survivors_fall_back_to_replay_no_lost_streams(self):
+        """The worker dies AFTER freezing (resume tokens shipped, KV
+        pinned) but BEFORE any survivor pulls: resume pulls fail against
+        the dead instance and admission recomputes — every stream still
+        completes, bit-identical, with no leaked leases on the
+        survivor."""
+        coord = await Coordinator(port=0).start()
+        workers, fe = [], None
+        try:
+            w1 = await _start_drain_worker(coord.address, "m")
+            w2 = await _start_drain_worker(coord.address, "m")
+            workers = [w1, w2]
+            fe = await DistributedRuntime.create(coordinator=coord.address)
+            client = await (fe.namespace("ns").component("w")
+                            .endpoint("generate").client())
+            await client.wait_for_instances(2, timeout=10)
+            card = make_test_card(name="m", kv_cache_block_size=4)
+            pipeline = RemotePipeline(card, PushRouter(client),
+                                      migration_limit=3)
+            reqs = [make_req(range(1 + i, 12 + i), f"k{i}", max_tokens=14)
+                    for i in range(2)]
+            events = [asyncio.Event() for _ in reqs]
+            tasks = [asyncio.ensure_future(_drive(pipeline, r, ev))
+                     for r, ev in zip(reqs, events)]
+            await asyncio.gather(*[asyncio.wait_for(ev.wait(), 30)
+                                   for ev in events])
+            busy = w1 if w1.engine.scheduler.active else w2
+            survivor = w2 if busy is w1 else w1
+            counts = await busy.kill9_mid_drain()
+            assert counts["resume"] >= 1  # tokens DID ship before death
+            all_frames = await asyncio.gather(*tasks)
+            for req, frames in zip(reqs, all_frames):
+                toks = [t for f in frames for t in f.token_ids]
+                assert len(toks) == 14, (req.request_id, len(toks))
+            solo = await _solo_tokens(reqs)
+            for req, frames, ref in zip(reqs, all_frames, solo):
+                toks = [t for f in frames for t in f.token_ids]
+                assert toks == ref, req.request_id
+            # no leaked leases on the survivor (it never granted any; the
+            # dead worker's pins died with its process)
+            smgr = getattr(survivor.engine, "_export_leases", None)
+            assert smgr is None or smgr.active == 0
+        finally:
+            for w in workers:
+                try:
+                    await w._close()
+                except Exception:
+                    pass
+            if fe is not None:
+                await fe.close()
+            await coord.stop()
+
+
+@pytest.mark.chaos
+class TestDrainRacesCoordinatorBlip:
+    async def test_announcement_survives_wiped_restart(self):
+        """The drain flag lives on the served instance record, so the
+        resync re-put after a state-wiped coordinator restart re-announces
+        it — routers keep excluding the drained worker."""
+        coord = await Coordinator(port=0).start()
+        drt = None
+        fe = None
+        try:
+            drt = await DistributedRuntime.create(coordinator=coord.address)
+            engine = MockerEngine(MockEngineArgs(
+                num_pages=64, page_size=4, max_num_seqs=8,
+                max_prefill_chunk=32, max_context=256))
+            ep = drt.namespace("ns").component("w").endpoint("generate")
+            served = await serve_engine(ep, engine)
+            wd = WorkerDrain(drt, engine, served=[served])
+            await wd.controller.announce()
+            assert served.instance.draining
+            outage = CoordinatorOutage(coord)
+            await outage.blip(downtime_s=0.2, wipe_state=True)
+            # wait out the worker's reconnect + resync (the instance may
+            # come back under a re-granted lease id)
+            fe = await DistributedRuntime.create(coordinator=coord.address)
+            client = await (fe.namespace("ns").component("w")
+                            .endpoint("generate").client())
+            inst = None
+            for _ in range(200):
+                try:
+                    insts = await ep.list_instances()
+                except ConnectionError:
+                    insts = []  # worker runtime still reconnecting
+                if insts:
+                    inst = insts[0]
+                    break
+                await asyncio.sleep(0.05)
+            assert inst is not None, "instance never re-announced"
+            assert inst.draining  # the announcement survived the blip
+            # and a router built AFTER the blip still routes around it
+            assert client.instance_ids() == []
+            await wd.kill9()
+        finally:
+            if fe is not None:
+                await fe.close()
+            if drt is not None:
+                try:
+                    await drt.close()
+                except Exception:
+                    pass
+            await coord.stop()
+
+
+class TestDrainHttpTrigger:
+    async def test_post_drain_triggers_and_reports_state(self):
+        import aiohttp
+
+        from dynamo_tpu.runtime.system_server import SystemServer
+
+        engine = MockerEngine(MockEngineArgs(
+            num_pages=64, page_size=4, max_num_seqs=8, max_prefill_chunk=32,
+            max_context=256))
+        system = await SystemServer().start()
+        try:
+            base = f"http://127.0.0.1:{system.port}"
+            async with aiohttp.ClientSession() as s:
+                r = await s.post(f"{base}/drain")
+                assert r.status == 404  # nothing registered yet
+                system.register_drain(DrainController(engine, timeout_s=0))
+                r = await s.post(f"{base}/drain")
+                assert r.status == 200
+                body = await r.json()
+                assert body["state"] in ("draining", "drained")
+                for _ in range(100):
+                    r = await s.post(f"{base}/drain")
+                    if (await r.json())["state"] == "drained":
+                        break
+                    await asyncio.sleep(0.02)
+                assert (await r.json())["state"] == "drained"
+                assert engine.draining  # new work is being refused
+        finally:
+            await system.stop()
+            await engine.stop()

@@ -313,8 +313,8 @@ async def test_migration_trace_continuity(card, fresh_tracer):
                 break
             await asyncio.sleep(0.05)
 
-        replays_before = \
-            get_worker_metrics().migration_replays._value.get()
+        replays_before = get_worker_metrics().migration_replays.labels(
+            "replay")._value.get()
         base = f"http://127.0.0.1:{service.port}"
         migrated_rid = None
         async with aiohttp.ClientSession() as s:
@@ -345,8 +345,8 @@ async def test_migration_trace_continuity(card, fresh_tracer):
                                for h in hops)
                     break
         assert migrated_rid is not None, "no request hit the dying worker"
-        assert get_worker_metrics().migration_replays._value.get() \
-            > replays_before
+        assert get_worker_metrics().migration_replays.labels(
+            "replay")._value.get() > replays_before
     finally:
         if service:
             await service.stop()
