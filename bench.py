@@ -863,6 +863,159 @@ async def _measure_drain(wd=None) -> dict:
         await coord.stop()
 
 
+# coordinator-failover leg geometry: live streams mid-trace when the
+# primary dies, and tokens per stream (long enough to straddle the window)
+COORD_FAILOVER_STREAMS = int(os.environ.get("BENCH_COORD_STREAMS", "8"))
+COORD_FAILOVER_TOKENS = int(os.environ.get("BENCH_COORD_TOKENS", "60"))
+
+
+async def _measure_coord_failover(wd=None) -> dict:
+    """Coordinator-failover leg (ROADMAP item 4, the control-plane half of
+    "zero lost streams"): a replicated coordinator pair under a routed
+    2-worker topology, with the PRIMARY kill -9'd while every stream is
+    mid-flight.  Streams ride direct worker RPC connections, so none may
+    be lost; the leg prices what the control plane does cost — promotion
+    latency, failover-to-ready (every process reconnected AND discovery
+    answering from the new primary), resync count, and lease re-grants
+    (must be 0: the standby mirrors the boot epoch, so the resync takes
+    the probe path — no re-grant storm).  A same-run cold-restart sub-leg
+    (single coordinator, kill -9 + instant state-wiped respawn — the PR 3
+    path at its best) is the baseline the failover number must beat."""
+    from dynamo_tpu.runtime.coordinator import Coordinator
+    from dynamo_tpu.runtime.runtime import DistributedRuntime
+    from dynamo_tpu.utils.faults import CoordinatorOutage, CoordinatorPair
+
+    if wd is not None:
+        wd.arm("measure:coord_failover", STAGE_BUDGETS["measure"])
+
+    async def gen(payload, ctx):
+        # stand-in decode stream: the leg measures the control plane, so
+        # token compute is a paced counter, not an engine
+        for t in range(int(payload["n"])):
+            await asyncio.sleep(float(payload.get("delay_s", 0.02)))
+            yield {"tok": t}
+
+    async def topology(addresses, n_workers):
+        drts = []
+        for _ in range(n_workers):
+            drt = await DistributedRuntime.create(coordinator=addresses)
+            drts.append(drt)
+            ep = drt.namespace("bench").component("cf").endpoint("generate")
+            await ep.serve(gen)
+        fe = await DistributedRuntime.create(coordinator=addresses)
+        drts.append(fe)
+        ep = fe.namespace("bench").component("cf").endpoint("generate")
+        client = await ep.client()
+        insts = await client.wait_for_instances(n_workers, timeout=10)
+        return drts, fe, ep, client, insts
+
+    async def ready_after(drts, ep, n_workers, t0):
+        """Outage-to-ready: the frontend's first successful discovery scan
+        answering with the FULL fleet.  An in-flight call on the dead
+        connection fails (never answers stale), so a success here is by
+        construction served by the new/restarted primary — and seeing all
+        workers means their registrations survived or were resynced."""
+        fe_coord = drts[-1].coord
+        while True:
+            try:
+                items = await fe_coord.get_prefix(ep.instance_prefix)
+                if len(items) >= n_workers:
+                    return time.perf_counter() - t0
+            except ConnectionError:
+                pass
+            await asyncio.sleep(0.02)
+
+    # -- failover leg: replicated pair, kill -9 the primary mid-trace
+    pair = await CoordinatorPair(promote_after_s=0.6).start()
+    drts = []
+    try:
+        drts, fe, ep, client, insts = await topology(pair.addresses, 2)
+        relocations = []
+        for drt in drts:
+            lease = drt._primary_lease
+            if lease is not None:
+                lease.on_relocated(
+                    lambda o, n: relocations.append((o, n)))
+        got = [0] * COORD_FAILOVER_STREAMS
+        started = [asyncio.Event() for _ in range(COORD_FAILOVER_STREAMS)]
+
+        async def drive(i):
+            stream = await client.direct(
+                {"n": COORD_FAILOVER_TOKENS, "delay_s": 0.03},
+                insts[i % len(insts)].instance_id)
+            async for _f in stream:
+                got[i] += 1
+                if got[i] >= 2:
+                    started[i].set()
+            started[i].set()
+
+        tasks = [asyncio.ensure_future(drive(i))
+                 for i in range(COORD_FAILOVER_STREAMS)]
+        await asyncio.gather(*[asyncio.wait_for(ev.wait(), 30)
+                               for ev in started])
+        resyncs0 = sum(d.coord.resyncs_total for d in drts)
+        t0 = time.perf_counter()
+        await pair.kill9_primary()
+        await pair.wait_promoted(timeout=30)
+        promote_s = time.perf_counter() - t0
+        ready_s = await asyncio.wait_for(
+            ready_after(drts, ep, 2, t0), timeout=60)
+        await asyncio.gather(*tasks)
+        lost = sum(1 for g in got if g < COORD_FAILOVER_TOKENS)
+        failover = {
+            "streams": COORD_FAILOVER_STREAMS,
+            "streams_lost": lost,
+            "promote_s": round(promote_s, 3),
+            "ready_s": round(ready_s, 3),
+            "resyncs": sum(d.coord.resyncs_total for d in drts) - resyncs0,
+            "lease_regrants": len(relocations),
+        }
+    finally:
+        for drt in drts:
+            await drt.close()
+        await pair.stop()
+
+    # -- baseline: single coordinator, kill -9 + supervisor respawn (the
+    # PR 3 path).  The dwell models the supervisor restart delay — the
+    # irreducible cost replication removes: with no standby the control
+    # plane is down for the WHOLE dwell, then pays the wiped-state resync
+    # (fresh epoch -> lease re-grant storm + registration replay)
+    respawn_s = float(os.environ.get("BENCH_COORD_RESPAWN_S", "1.0"))
+    coord = await Coordinator(port=0).start()
+    outage = CoordinatorOutage(coord)
+    drts = []
+    try:
+        drts, fe, ep, client, insts = await topology(coord.address, 1)
+        cold_relocations = []
+        for drt in drts:
+            lease = drt._primary_lease
+            if lease is not None:
+                lease.on_relocated(
+                    lambda o, n: cold_relocations.append((o, n)))
+        t0 = time.perf_counter()
+        await outage.kill()
+        await asyncio.sleep(respawn_s)
+        await outage.restart(wipe_state=True)
+        cold_ready_s = await asyncio.wait_for(
+            ready_after(drts, ep, 1, t0), timeout=60)
+    finally:
+        for drt in drts:
+            await drt.close()
+        await coord.stop()
+
+    result = {
+        **failover,
+        "cold_restart_ready_s": round(cold_ready_s, 3),
+        "cold_restart_respawn_s": respawn_s,
+        "cold_restart_regrants": len(cold_relocations),
+        # PR 3's measured cold-restart resync at TTL 5s, for the trend line
+        "pr3_cold_restart_ref_s": 3.2,
+    }
+    _ckpt("coord_failover", **{k: v for k, v in result.items()
+                               if k != "streams"})
+    return result
+
+
 async def run_attempt(args) -> dict:
     """The whole attempt, one process: build -> prime -> measure ->
     transports -> optional attn-impl A/B. ``jax_init`` already happened in
@@ -1043,6 +1196,15 @@ async def run_attempt(args) -> dict:
         result["drain"] = await _measure_drain(wd)
     except Exception as e:  # noqa: BLE001 — best-effort extra data
         result["drain"] = {"error": str(e)[:300]}
+    print(json.dumps(result), flush=True)
+
+    # coordinator-failover leg: kill -9 the primary of a replicated pair
+    # mid-trace — streams_lost must be 0 with zero lease re-grants, and
+    # failover-to-ready must beat the same-run cold-restart baseline
+    try:
+        result["coord_failover"] = await _measure_coord_failover(wd)
+    except Exception as e:  # noqa: BLE001 — best-effort extra data
+        result["coord_failover"] = {"error": str(e)[:300]}
     print(json.dumps(result), flush=True)
 
     # attn-impl A/B in the SAME process (round-4 open question:

@@ -85,8 +85,10 @@ async def amain(args: argparse.Namespace) -> None:
         max_model_inflight=args.max_model_inflight,
         shed_retry_after_s=args.shed_retry_after_s)
     # control-plane health rides the same /metrics page as request metrics
-    # (dynamo_coord_connected, dynamo_coord_reconnects_total, ...)
-    service.metrics.attach_coord(drt.coord)
+    # (dynamo_coord_connected, dynamo_coord_reconnects_total, ...) and
+    # gates GET /healthz/ready (503 while disconnected, so load balancers
+    # route around a control-plane outage)
+    service.attach_coord(drt.coord)
     await service.start()
     if args.standalone:
         print(f"coordinator listening on {drt._embedded.address}", flush=True)

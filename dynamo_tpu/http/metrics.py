@@ -144,6 +144,50 @@ class CoordClientMetrics:
             value=float(self.coord.last_outage_s))
 
 
+class CoordinatorMetrics:
+    """Custom collector sampling a server-side ``Coordinator`` (the
+    replicated control-plane process itself, not a client of it).
+
+    Series: ``dynamo_coord_role`` (1 acting primary / 0 standby /
+    -1 deposed), ``dynamo_coord_failovers_total`` (promotions this process
+    performed), ``dynamo_coord_replication_lag_ops`` (log entries queued to
+    the slowest attached standby; 0 = caught up or none attached) and
+    ``dynamo_coord_standbys_attached``.  Exposed by the standalone
+    coordinator's system server (``DYN_SYSTEM_ENABLED=1``)."""
+
+    _ROLES = {"primary": 1.0, "standby": 0.0, "deposed": -1.0}
+
+    def __init__(self, coordinator, registry: Optional[CollectorRegistry] = None):
+        self.coordinator = coordinator
+        if registry is not None:
+            registry.register(self)
+
+    def collect(self):
+        from prometheus_client.core import (
+            CounterMetricFamily,
+            GaugeMetricFamily,
+        )
+        c = self.coordinator
+        yield GaugeMetricFamily(
+            "dynamo_coord_role",
+            "Replication role: 1 acting primary, 0 standby, -1 deposed",
+            value=self._ROLES.get(c.role, -1.0))
+        fo = CounterMetricFamily(
+            "dynamo_coord_failovers",
+            "Promotions to primary performed by this coordinator process")
+        fo.add_metric([], float(c.failovers_total))
+        yield fo
+        yield GaugeMetricFamily(
+            "dynamo_coord_replication_lag_ops",
+            "Replication-log entries queued to the slowest attached "
+            "standby (0 = fully caught up or no standby)",
+            value=float(c.replication_lag_ops))
+        yield GaugeMetricFamily(
+            "dynamo_coord_standbys_attached",
+            "Hot standbys currently attached to this coordinator",
+            value=float(c.standbys_attached))
+
+
 class RequestTimer:
     """Tracks one request's TTFT/ITL/duration and reports on completion."""
 
@@ -182,5 +226,5 @@ class RequestTimer:
             self.m.input_tokens.labels(self.model).inc(prompt_tokens)
 
 
-__all__ = ["FrontendMetrics", "CoordClientMetrics", "RequestTimer",
-           "StageMetrics"]
+__all__ = ["FrontendMetrics", "CoordClientMetrics", "CoordinatorMetrics",
+           "RequestTimer", "StageMetrics"]

@@ -125,12 +125,18 @@ class HttpService:
         self.app.router.add_get("/v1/models", self.handle_models)
         self.app.router.add_get("/health", self.handle_health)
         self.app.router.add_get("/live", self.handle_live)
+        self.app.router.add_get("/healthz", self.handle_live)
+        self.app.router.add_get("/healthz/ready", self.handle_ready)
         self.app.router.add_get("/metrics", self.handle_metrics)
         self.app.router.add_get("/v1/traces", self.handle_traces)
         self.app.router.add_get("/v1/traces/{trace_id}", self.handle_trace)
         self.app.router.add_post("/clear_kv_blocks", self.handle_clear_kv)
         self._runner: Optional[web.AppRunner] = None
         self._clear_kv_hook = None  # async () -> dict
+        # the process's CoordClient (attach_coord): /healthz/ready turns
+        # 503 while its supervised connection is down, so load balancers
+        # drain traffic away from a control-plane outage
+        self._coord = None
         # the process tracer: every request opens a root span here; the
         # flight recorder behind /v1/traces and the per-stage histogram
         # (metrics.stage) both hang off it
@@ -163,6 +169,28 @@ class HttpService:
 
     async def handle_live(self, request: web.Request) -> web.Response:
         return web.json_response({"live": True})
+
+    def attach_coord(self, coord) -> "object":
+        """Wire the process's ``CoordClient`` into this service: its
+        connection health gates ``GET /healthz/ready`` and its supervision
+        counters join /metrics (``dynamo_coord_*``).  Returns the metrics
+        collector for symmetry with ``FrontendMetrics.attach_coord``."""
+        self._coord = coord
+        return self.metrics.attach_coord(coord)
+
+    async def handle_ready(self, request: web.Request) -> web.Response:
+        """Readiness (vs. /healthz liveness, always 200): 503 while the
+        coordinator connection is down — discovery is frozen, so new
+        requests would only pile onto stale routing state."""
+        from dynamo_tpu.runtime.system_server import coord_ready_reasons
+        reasons = coord_ready_reasons(self._coord)
+        if not self.manager.names():
+            reasons.append("no models registered")
+        ready = not reasons
+        return web.json_response(
+            {"ready": ready, "reasons": reasons,
+             "models": self.manager.names()},
+            status=200 if ready else 503)
 
     async def handle_metrics(self, request: web.Request) -> web.Response:
         return web.Response(body=self.metrics.render(),

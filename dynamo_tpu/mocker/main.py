@@ -86,6 +86,7 @@ async def amain(args: argparse.Namespace) -> None:
     system = SystemServer.from_env(registry=wm.registry, tracer=tracer)
     if system is not None:
         system.health.register("engine", ready=True)
+        system.attach_coord(drt.coord)  # 503 /healthz/ready in an outage
         await system.start()
     # graceful drain parity with the real worker: the mocker cannot
     # export KV, so every frozen stream ships an empty (replay) token —

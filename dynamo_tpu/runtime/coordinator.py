@@ -22,6 +22,35 @@ initiated traffic (watch events, pub/sub messages) carries ``evt`` instead.
 The coordinator is deliberately a single-threaded asyncio process: control
 plane operations are low-rate (registrations, watches, metrics) while the hot
 request path rides direct worker TCP connections and never touches it.
+
+**Replication & failover** (parity in intent with etcd's Raft replication,
+scaled down to a primary + hot-standby pair): a standby coordinator
+(``--standby-of host:port``) attaches to the primary over the SAME wire
+protocol (``repl_attach``), receives a full state snapshot (KV, leases with
+remaining TTLs, queues, boot epoch, id counter, fencing term), then applies
+the primary's ordered replication log (put/delete, lease grant/keepalive/
+revoke, queue push/pop) streamed as ``evt: "repl"`` frames.  Because the
+standby mirrors the primary's *boot epoch and id counter*, promotion looks to
+a resyncing ``CoordClient`` like a blip of the same server: the resync takes
+the cheap probe path (keepalive each lease — it exists, same id) instead of
+the re-grant storm a fresh process forces.  Lease deadlines are rebased by a
+grace window at promotion so the fleet doesn't mass-expire mid-failover.
+
+Split-brain safety rides a **monotonic fencing term**: bumped at every
+promotion, echoed on ping, stamped on writes by term-aware clients.  A write
+stamped with a term the server doesn't hold bounces (``fenced: True`` + the
+highest term known) and the client re-points along its address list; a
+primary that observes a higher term — via a stamped write or its peer probe
+of a lost standby — knows it is deposed, fences its writers, and (when it
+knows the new primary's address) demotes itself into a hot standby of it,
+restoring redundancy automatically.  Requests without a term field (PR 3-era
+clients) are served exactly as before: fencing is opt-in at the wire level.
+
+``CoordClient`` accepts a comma-separated address list
+(``"host:6650,host:6651"``); connect and the PR 3 reconnect loop walk the
+list, skipping standbys/deposed primaries, so failover needs no client
+reconfiguration.  With a single address and a non-replicated server the
+behavior is bit-for-bit the PR 3 protocol.
 """
 
 from __future__ import annotations
@@ -44,6 +73,17 @@ from dynamo_tpu.utils.aio import decorrelated_jitter, reap_task
 logger = logging.getLogger(__name__)
 
 LEASE_SCAN_INTERVAL = 0.5  # seconds between lease-expiry scans
+
+# replication / failover knobs (constructor args override)
+DEFAULT_PROMOTE_AFTER_S = 2.0       # standby self-promotes after this silence
+DEFAULT_PROMOTE_LEASE_GRACE_S = 1.0  # extra lease headroom added at promotion
+
+# ops that mutate replicated state: term-fenced on the server, term-stamped
+# by term-aware clients. queue_pull consumes a job, queue_cancel unparks a
+# pull — both are state changes a deposed primary must not serve.
+_WRITE_OPS = frozenset({
+    "put", "put_if_absent", "delete", "delete_prefix", "grant_lease",
+    "keepalive", "revoke", "queue_push", "queue_pull", "queue_cancel"})
 
 
 def _subject_matches(pattern: str, subject: str) -> bool:
@@ -87,6 +127,31 @@ class _Subscription:
     queue_group: Optional[str] = None
 
 
+class _StandbyPeer:
+    """Server-side handle for one attached standby: an ordered outbound
+    queue drained by a pump task, so log entries are emitted synchronously
+    at the mutation point (no await between state change and emit) yet sent
+    without blocking the dispatcher.  Queue depth is the standby's
+    replication lag in ops."""
+
+    def __init__(self, conn: "_Conn", addr: str):
+        self.conn = conn
+        self.addr = addr
+        self.q: "asyncio.Queue" = asyncio.Queue()
+        self.task: Optional[asyncio.Task] = None
+        # stamped on every frame the standby sends (it pings through the
+        # replication connection): a silent-but-open connection — the
+        # partitioned link — must not count as a healthy standby
+        self.last_contact = time.monotonic()
+
+    async def _pump(self) -> None:
+        while True:
+            frame = await self.q.get()
+            await self.conn.send(frame)
+            if not self.conn.alive:
+                return
+
+
 class _Conn:
     """Server-side state for one client connection."""
 
@@ -114,9 +179,23 @@ class _Conn:
 class Coordinator:
     """The control-plane server.  ``async with Coordinator(port=0) as c: ...``"""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 standby_of: Optional[str] = None,
+                 promote_after_s: Optional[float] = None,
+                 lease_grace_s: Optional[float] = None):
         self.host = host
         self.port = port
+        env = os.environ.get
+        # replication role: None = (acting) primary; "host:port" = hot
+        # standby mirroring that primary's state until promotion
+        self.standby_of = standby_of
+        self.promote_after_s = (float(env("DYN_COORD_PROMOTE_AFTER_S",
+                                          str(DEFAULT_PROMOTE_AFTER_S)))
+                                if promote_after_s is None
+                                else promote_after_s)
+        self.lease_grace_s = (float(env("DYN_COORD_PROMOTE_LEASE_GRACE_S",
+                                        str(DEFAULT_PROMOTE_LEASE_GRACE_S)))
+                              if lease_grace_s is None else lease_grace_s)
         self._kv: Dict[str, _KvEntry] = {}
         self._leases: Dict[int, _Lease] = {}
         self._watches: Dict[int, _Watch] = {}
@@ -132,27 +211,89 @@ class Coordinator:
         # FIFO per name, pulls park until an item arrives
         self._queues: Dict[str, "deque[bytes]"] = {}
         self._queue_pulls: Dict[str, "deque[Tuple[_Conn, Any]]"] = {}
-        self._ids = itertools.count(1)
+        # id counter as a plain int (not itertools.count): a standby must
+        # mirror it from the snapshot/log so ids it grants post-promotion
+        # never collide with replicated lease ids
+        self._next_id = 1
         # boot epoch: lets a resyncing client tell "same server, state
         # intact" from "fresh/wiped server" — the id counter restarts on a
         # real process restart, so a probed lease id may EXIST yet belong
-        # to another client's re-grant; epoch mismatch forces re-grants
+        # to another client's re-grant; epoch mismatch forces re-grants.
+        # A standby MIRRORS the primary's epoch, so promotion presents as
+        # a blip of the same server (probe path, no re-grant storm).
         self._epoch = random.getrandbits(63)
+        # fencing term: bumped at every promotion, echoed on ping, checked
+        # against the term stamped on writes by term-aware clients
+        self._term = 0
+        self._deposed_term: Optional[int] = None  # > _term once deposed
+        self._repl_seq = 0
+        self._standbys: Dict["_Conn", _StandbyPeer] = {}
+        self._peer_addrs: set = set()  # standby listen addrs (for probing)
+        self._primary_last_contact = 0.0
+        # has this standby EVER installed a snapshot? Auto-promotion is
+        # gated on it: a standby that never reached its primary (started
+        # during a blip, partitioned at boot) promoting with EMPTY state
+        # and a fresh epoch would split the fleet while the real primary
+        # is alive. Manual promotion (admin op / SIGUSR1) stays available
+        # for the operator who knows the primary is really gone.
+        self._ever_attached = False
+        self.failovers_total = 0  # promotions performed by this process
         self._server: Optional[asyncio.base_events.Server] = None
         self._lease_task: Optional[asyncio.Task] = None
+        self._standby_task: Optional[asyncio.Task] = None
+        self._peer_probe_task: Optional[asyncio.Task] = None
         self._conns: set = set()
+
+    def _next(self) -> int:
+        i = self._next_id
+        self._next_id += 1
+        return i
+
+    @property
+    def role(self) -> str:
+        if self.standby_of is not None:
+            return "standby"
+        return "deposed" if self._deposed_term is not None else "primary"
+
+    @property
+    def replication_lag_ops(self) -> int:
+        """Ops queued to the slowest attached standby (0 = fully caught up
+        or no standby)."""
+        return max((p.q.qsize() for p in self._standbys.values()), default=0)
+
+    @property
+    def standbys_attached(self) -> int:
+        return len(self._standbys)
 
     # -- lifecycle ---------------------------------------------------------
 
     async def start(self) -> "Coordinator":
         self._server = await asyncio.start_server(self._handle_conn, self.host, self.port)
         self.port = self._server.sockets[0].getsockname()[1]
-        self._lease_task = asyncio.create_task(self._lease_scanner())
-        logger.info("coordinator listening on %s:%d", self.host, self.port)
+        if self.standby_of is None:
+            # lease expiry is a PRIMARY duty: a standby expiring replicated
+            # leases on its own clock would diverge from the source of truth
+            self._lease_task = asyncio.create_task(self._lease_scanner())
+            if self._peer_addrs:
+                # a restarted ex-primary still knows its standbys: probe
+                # them so a promotion that happened while we were down
+                # deposes (and demotes) us instead of splitting the brain
+                self._ensure_peer_probe()
+        else:
+            self._primary_last_contact = time.monotonic()
+            self._standby_task = asyncio.create_task(self._standby_loop())
+        logger.info("coordinator listening on %s:%d (%s)",
+                    self.host, self.port, self.role)
         return self
 
     async def stop(self) -> None:
         await reap_task(self._lease_task)
+        await reap_task(self._standby_task)
+        await reap_task(self._peer_probe_task)
+        self._lease_task = self._standby_task = self._peer_probe_task = None
+        for peer in list(self._standbys.values()):
+            await reap_task(peer.task)
+        self._standbys.clear()
         if self._server:
             self._server.close()
         # close live connections BEFORE wait_closed(): on py3.12 wait_closed
@@ -189,6 +330,9 @@ class Coordinator:
                 frame = await read_frame(reader)
                 if frame is None:
                     break
+                peer = self._standbys.get(conn)
+                if peer is not None:
+                    peer.last_contact = time.monotonic()
                 try:
                     await self._dispatch(conn, frame)
                 except Exception as e:  # protocol error -> report, keep conn
@@ -199,6 +343,7 @@ class Coordinator:
         finally:
             conn.alive = False
             self._conns.discard(conn)
+            self._drop_standby(conn)
             for w in list(conn.watches.values()):
                 self._watches.pop(w.watch_id, None)
             self._drop_conn_subs(conn)
@@ -216,6 +361,37 @@ class Coordinator:
     async def _dispatch(self, conn: _Conn, f: Dict[str, Any]) -> None:
         op = f.get("op")
         rid = f.get("rid")
+        if self.standby_of is not None and op not in ("ping", "promote"):
+            # a standby serves nothing: clients walk their address list to
+            # the primary (the hint), an attaching standby re-points too
+            await conn.send({"rid": rid, "ok": False, "standby": True,
+                             "term": self._term, "primary": self.standby_of,
+                             "error": f"standby; primary at "
+                                      f"{self.standby_of}"})
+            return
+        if op in _WRITE_OPS:
+            ft = f.get("term")
+            if self._deposed_term is not None:
+                # deposed: reads still answer (stale-tolerant, like any
+                # outage window) but writes bounce so no divergent state
+                # accrues; the term re-points term-aware clients
+                await conn.send({
+                    "rid": rid, "ok": False, "fenced": True,
+                    "term": self._deposed_term,
+                    "error": f"deposed: a newer primary holds term "
+                             f"{self._deposed_term}"})
+                return
+            if ft is not None and int(ft) != self._term:
+                if int(ft) > self._term:
+                    # the client has seen a newer primary than us: we are
+                    # the deposed half of a split brain — fence ourselves
+                    self._depose(int(ft))
+                await conn.send({
+                    "rid": rid, "ok": False, "fenced": True,
+                    "term": max(int(ft), self._term),
+                    "error": f"term mismatch: yours {int(ft)}, "
+                             f"server {self._term}"})
+                return
         if op == "put":
             await self._op_put(f["key"], f["value"], f.get("lease", 0))
             await conn.send({"rid": rid, "ok": True})
@@ -255,12 +431,13 @@ class Coordinator:
                 await conn.send({"rid": rid, "ok": False, "error": "lease not found"})
             else:
                 lease.expires_at = time.monotonic() + lease.ttl
+                self._emit("keepalive", lease.lease_id)
                 await conn.send({"rid": rid, "ok": True})
         elif op == "revoke":
             await self._revoke_lease(f["lease"])
             await conn.send({"rid": rid, "ok": True})
         elif op == "watch_prefix":
-            watch_id = next(self._ids)
+            watch_id = self._next()
             w = _Watch(watch_id=watch_id, prefix=f["prefix"], conn=conn)
             self._watches[watch_id] = w
             conn.watches[watch_id] = w
@@ -280,7 +457,7 @@ class Coordinator:
             n = await self._op_publish(f["subject"], f["payload"])
             await conn.send({"rid": rid, "ok": True, "delivered": n})
         elif op == "subscribe":
-            sub_id = next(self._ids)
+            sub_id = self._next()
             sub = _Subscription(sub_id=sub_id, pattern=f["subject"], conn=conn,
                                 queue_group=f.get("queue_group"))
             self._add_sub(sub)
@@ -311,8 +488,41 @@ class Coordinator:
                              "pullers": len(self._queue_pulls.get(
                                  f["queue"], ()))})
         elif op == "ping":
-            await conn.send({"rid": rid, "ok": True, "time": time.time(),
-                             "epoch": self._epoch})
+            resp = {"rid": rid, "ok": True, "time": time.time(),
+                    "epoch": self._epoch, "term": self._term,
+                    "role": self.role}
+            if self.standby_of is not None:
+                resp["standby"] = True
+            if self._deposed_term is not None:
+                resp["deposed"] = True
+                resp["deposed_by"] = self._deposed_term
+            await conn.send(resp)
+        elif op == "promote":
+            # manual promotion (admin op; also reachable via SIGUSR1 on a
+            # standalone process) — idempotent on an acting primary
+            self.promote(reason=str(f.get("reason") or "admin op"))
+            await conn.send({"rid": rid, "ok": True, "term": self._term,
+                             "role": self.role})
+        elif op == "repl_attach":
+            if self._deposed_term is not None:
+                await conn.send({"rid": rid, "ok": False, "fenced": True,
+                                 "term": self._deposed_term,
+                                 "error": "deposed; attach to the primary"})
+                return
+            peer = _StandbyPeer(conn, str(f.get("addr") or ""))
+            # snapshot + register with NO await in between: every entry
+            # emitted after this point queues behind the snapshot, so the
+            # standby's log has no gap and no overlap
+            peer.q.put_nowait({"rid": rid, "ok": True,
+                               "snapshot": self._snapshot()})
+            self._standbys[conn] = peer
+            if peer.addr:
+                self._peer_addrs.add(peer.addr)
+            peer.task = asyncio.create_task(peer._pump())
+            self._ensure_peer_probe()
+            logger.info("standby %s attached (%d key(s), %d lease(s), "
+                        "seq %d)", peer.addr or "<unknown>", len(self._kv),
+                        len(self._leases), self._repl_seq)
         else:
             await conn.send({"rid": rid, "ok": False, "error": f"unknown op {op!r}"})
 
@@ -327,6 +537,7 @@ class Coordinator:
         prev = self._kv.get(key)
         self._kv[key] = _KvEntry(value=value, lease_id=lease_id,
                                  version=(prev.version + 1) if prev else 1)
+        self._emit("put", key, value, lease_id)
         await self._notify_watchers("put", key, value, lease_id)
 
     async def _op_delete(self, key: str) -> int:
@@ -335,6 +546,7 @@ class Coordinator:
             return 0
         if e.lease_id and e.lease_id in self._leases:
             self._leases[e.lease_id].keys.discard(key)
+        self._emit("delete", key)
         await self._notify_watchers("delete", key, None, e.lease_id)
         return 1
 
@@ -349,22 +561,32 @@ class Coordinator:
     # -- leases ------------------------------------------------------------
 
     def _grant_lease(self, ttl: float) -> _Lease:
-        lease_id = next(self._ids)
+        lease_id = self._next()
         lease = _Lease(lease_id=lease_id, ttl=ttl,
                        expires_at=time.monotonic() + ttl)
         self._leases[lease_id] = lease
+        self._emit("lease", lease_id, ttl)
         return lease
 
     async def _revoke_lease(self, lease_id: int) -> None:
         lease = self._leases.pop(lease_id, None)
         if lease is None:
             return
+        # unlease first, then the per-key deletes replicate themselves —
+        # the standby applies the same sequence
+        self._emit("unlease", lease_id)
         for key in list(lease.keys):
             await self._op_delete(key)
 
     async def _lease_scanner(self) -> None:
         while True:
             await asyncio.sleep(LEASE_SCAN_INTERVAL)
+            if self._deposed_term is not None:
+                # deposed: expiry is the new primary's duty now. Keepalives
+                # bounce here (fenced), so expiring on our clock would mass-
+                # revoke every lease within one TTL and stream spurious
+                # delete events to watchers still attached to this half.
+                continue
             now = time.monotonic()
             expired = [lid for lid, l in self._leases.items() if l.expires_at < now]
             for lid in expired:
@@ -393,6 +615,7 @@ class Coordinator:
                 return 0
         q = self._queues.setdefault(queue, deque())
         q.append((payload, time.monotonic()))
+        self._emit("qpush", queue, payload)
         return len(q)
 
     async def _op_queue_pull(self, conn: _Conn, rid: Any, queue: str) -> None:
@@ -401,6 +624,7 @@ class Coordinator:
         q = self._queues.get(queue)
         if q:
             payload, t_in = q.popleft()
+            self._emit("qpop", queue)
             await conn.send({"rid": rid, "ok": True, "payload": payload,
                              "age_s": time.monotonic() - t_in,
                              "depth": len(q)})
@@ -466,10 +690,359 @@ class Coordinator:
             delivered += 1
         return delivered
 
+    # -- replication (primary side) ----------------------------------------
+
+    def _emit(self, *entry: Any) -> None:
+        """Append one ordered log entry to every attached standby's queue.
+        Called synchronously AT the mutation point — never after an await —
+        so the log order is exactly the apply order."""
+        if not self._standbys:
+            return
+        self._repl_seq += 1
+        frame = {"evt": "repl", "seq": self._repl_seq, "term": self._term,
+                 "nid": self._next_id, "entry": list(entry)}
+        for peer in self._standbys.values():
+            peer.q.put_nowait(frame)
+
+    def _snapshot(self) -> Dict[str, Any]:
+        """Full state for a freshly attached standby (sync — must be built
+        atomically with registering the standby)."""
+        now = time.monotonic()
+        return {
+            "epoch": self._epoch,
+            "term": self._term,
+            "next_id": self._next_id,
+            "seq": self._repl_seq,
+            "kv": [[k, e.value, e.lease_id, e.version]
+                   for k, e in self._kv.items()],
+            # deadlines travel as REMAINING ttl: monotonic clocks don't
+            # compare across hosts
+            "leases": [[l.lease_id, l.ttl, max(0.0, l.expires_at - now)]
+                       for l in self._leases.values()],
+            "queues": [[name, [[p, now - t] for (p, t) in q]]
+                       for name, q in self._queues.items() if q],
+        }
+
+    def _drop_standby(self, conn: "_Conn") -> None:
+        peer = self._standbys.pop(conn, None)
+        if peer is not None and peer.task is not None:
+            peer.task.cancel()
+            # addr stays in _peer_addrs: the probe loop needs it to detect
+            # (and join) a standby that promoted while detached from us
+
+    def _ensure_peer_probe(self) -> None:
+        if self._peer_probe_task is None or self._peer_probe_task.done():
+            self._peer_probe_task = asyncio.create_task(
+                self._peer_probe_loop())
+
+    async def _peer_probe_loop(self) -> None:
+        """Primary-side split-brain detector: ping known standby addresses
+        that are NOT currently attached.  A peer answering as a primary
+        with a higher term means a promotion happened behind a partition —
+        this process is deposed and demotes itself into a standby of the
+        winner, restoring redundancy without an operator."""
+        interval = max(min((self.promote_after_s or 2.0) / 2.0, 1.0), 0.1)
+        while True:
+            await asyncio.sleep(interval)
+            if self.standby_of is not None:
+                return  # demoted: the standby loop owns liveness now
+            # a standby counts as healthy only while it keeps TALKING: an
+            # open-but-silent replication connection (partitioned link,
+            # blackhole) is exactly the case that splits the brain
+            now = time.monotonic()
+            stale_after = max(self.promote_after_s or 2.0, 3 * interval)
+            attached = {p.addr for p in self._standbys.values()
+                        if now - p.last_contact < stale_after}
+            for addr in list(self._peer_addrs):
+                if addr in attached or addr == self.address:
+                    continue
+                resp = await self._probe_peer(addr)
+                if not resp or resp.get("standby"):
+                    continue
+                t = int(resp.get("term", 0) or 0)
+                if t > self._term or (self._deposed_term is not None
+                                      and t >= self._deposed_term):
+                    self._depose(t, peer_addr=addr)
+                    if self.standby_of is not None:
+                        return
+
+    async def _probe_peer(self, addr: str) -> Optional[Dict[str, Any]]:
+        host, _, port = addr.rpartition(":")
+        writer = None
+        try:
+            reader, writer = await asyncio.wait_for(
+                asyncio.open_connection(host or "127.0.0.1", int(port)),
+                timeout=0.5)
+            await send_frame(writer, {"op": "ping", "rid": 1})
+            return await asyncio.wait_for(read_frame(reader), timeout=0.5)
+        except (OSError, asyncio.TimeoutError, ValueError):
+            return None
+        finally:
+            if writer is not None:
+                try:
+                    writer.close()
+                except Exception:
+                    pass
+
+    def _depose(self, new_term: int,
+                peer_addr: Optional[str] = None) -> None:
+        """Another coordinator holds a newer term: fence our writers; when
+        the winner's address is known, rejoin as its hot standby."""
+        if self.standby_of is not None:
+            return
+        if self._deposed_term is None or new_term > self._deposed_term:
+            logger.warning(
+                "coordinator %s deposed: observed term %d > ours %d%s",
+                self.address, new_term, self._term,
+                f" (new primary at {peer_addr})" if peer_addr else "")
+            self._deposed_term = new_term
+        # attached standbys must re-point to the new primary, not us
+        for c in list(self._standbys):
+            self._drop_standby(c)
+            try:
+                c.writer.close()
+            except Exception:
+                pass
+        if peer_addr is not None:
+            # demote: primary duties off, mirror the winner (the attach
+            # replaces our — possibly divergent — state with its snapshot;
+            # until it lands, auto-promotion must not trust this state)
+            self._deposed_term = None
+            self._ever_attached = False
+            self.standby_of = peer_addr
+            if self._lease_task is not None:
+                self._lease_task.cancel()
+            self._primary_last_contact = time.monotonic()
+            self._standby_task = asyncio.create_task(self._standby_loop())
+            logger.warning("coordinator %s demoted to standby of %s",
+                           self.address, peer_addr)
+
+    # -- replication (standby side) ----------------------------------------
+
+    async def _standby_loop(self) -> None:
+        """Attach to the primary, mirror its state, promote when it has
+        been silent past ``promote_after_s`` (<=0 = manual-only)."""
+        sleep_s = 0.05
+        while self.standby_of is not None:
+            self._maybe_promote()
+            if self.standby_of is None:
+                return
+            phost, _, pport = self.standby_of.rpartition(":")
+            writer = None
+            try:
+                reader, writer = await asyncio.wait_for(
+                    asyncio.open_connection(phost or "127.0.0.1",
+                                            int(pport)),
+                    timeout=max(min(self.promote_after_s or 1.0, 1.0), 0.1))
+                sleep_s = 0.05
+                await self._standby_attach(reader, writer)
+            except asyncio.CancelledError:
+                raise
+            except (ConnectionError, OSError, asyncio.TimeoutError):
+                pass  # primary down/unreachable: retry or promote
+            except Exception:
+                logger.exception("standby replication error")
+            finally:
+                if writer is not None:
+                    try:
+                        writer.close()
+                    except Exception:
+                        pass
+            if self.standby_of is None:
+                return
+            await asyncio.sleep(sleep_s)
+            sleep_s = min(sleep_s * 2, 0.5)
+
+    async def _standby_attach(self, reader: asyncio.StreamReader,
+                              writer: asyncio.StreamWriter) -> None:
+        # advertise an address the PRIMARY can actually dial back: bound to
+        # a wildcard, self.address would be "0.0.0.0:port" — the primary's
+        # peer probe would dial its own host and fencing would silently
+        # never fire. The replication socket's local endpoint is our IP on
+        # the route to the primary, which is exactly reachable from it.
+        addr = self.address
+        if self.host in ("", "0.0.0.0", "::"):
+            local = writer.get_extra_info("sockname")
+            if local:
+                addr = f"{local[0]}:{self.port}"
+        await send_frame(writer, {"op": "repl_attach", "rid": 1,
+                                  "addr": addr})
+        ping_interval = (max(min(self.promote_after_s / 3.0, 1.0), 0.05)
+                         if self.promote_after_s and self.promote_after_s > 0
+                         else 1.0)
+        rids = itertools.count(2)
+        last_ping = time.monotonic()
+        attached = False
+        while True:
+            self._maybe_promote()
+            if self.standby_of is None:
+                return  # promoted mid-stream
+            now = time.monotonic()
+            if now - last_ping >= ping_interval:
+                last_ping = now
+                # liveness probe on the SAME connection the log rides: a
+                # blackholed link (open TCP, no bytes) parks the reads and
+                # the missing ping replies trip the promotion deadline
+                await send_frame(writer, {"op": "ping", "rid": next(rids)})
+            try:
+                frame = await asyncio.wait_for(read_frame(reader),
+                                               timeout=ping_interval)
+            except asyncio.TimeoutError:
+                continue
+            if frame is None:
+                raise ConnectionError("primary closed replication stream")
+            self._primary_last_contact = time.monotonic()
+            if frame.get("evt") == "repl":
+                if attached:
+                    await self._apply_repl(frame)
+            elif frame.get("rid") == 1:
+                if not frame.get("ok"):
+                    hint = frame.get("primary")
+                    if frame.get("standby") and hint:
+                        # our primary demoted: follow it to the winner
+                        logger.warning(
+                            "replication target %s is itself a standby; "
+                            "re-pointing to %s", self.standby_of, hint)
+                        self.standby_of = hint
+                    raise ConnectionError(
+                        f"repl_attach refused: {frame.get('error')}")
+                self._install_snapshot(frame["snapshot"])
+                attached = True
+            # other rids are ping replies: contact stamp above is enough
+
+    def _install_snapshot(self, snap: Dict[str, Any]) -> None:
+        now = time.monotonic()
+        self._kv = {k: _KvEntry(value=v, lease_id=int(lid),
+                                version=int(ver))
+                    for k, v, lid, ver in snap.get("kv", [])}
+        self._leases = {}
+        for lid, ttl, remaining in snap.get("leases", []):
+            self._leases[int(lid)] = _Lease(
+                lease_id=int(lid), ttl=float(ttl),
+                expires_at=now + float(remaining))
+        for key, e in self._kv.items():
+            if e.lease_id and e.lease_id in self._leases:
+                self._leases[e.lease_id].keys.add(key)
+        self._queues = {
+            name: deque((p, now - float(age)) for p, age in items)
+            for name, items in snap.get("queues", [])}
+        self._epoch = int(snap["epoch"])
+        self._term = int(snap.get("term", 0))
+        self._next_id = int(snap.get("next_id", 1))
+        self._repl_seq = int(snap.get("seq", 0))
+        self._ever_attached = True
+        logger.info(
+            "standby installed snapshot from %s: %d key(s), %d lease(s), "
+            "%d queue(s), seq %d, term %d", self.standby_of, len(self._kv),
+            len(self._leases), len(self._queues), self._repl_seq, self._term)
+
+    async def _apply_repl(self, frame: Dict[str, Any]) -> None:
+        """Apply one primary log entry.  The mirrored ``nid`` keeps our id
+        counter at least the primary's, so ids granted after promotion
+        never collide with replicated lease ids."""
+        self._term = int(frame.get("term", self._term))
+        self._next_id = max(self._next_id, int(frame.get("nid", 0)))
+        self._repl_seq = int(frame.get("seq", self._repl_seq))
+        e = frame.get("entry") or []
+        try:
+            kind = e[0]
+            if kind == "put":
+                await self._op_put(e[1], e[2], int(e[3]))
+            elif kind == "delete":
+                await self._op_delete(e[1])
+            elif kind == "lease":
+                lid, ttl = int(e[1]), float(e[2])
+                self._leases[lid] = _Lease(
+                    lease_id=lid, ttl=ttl,
+                    expires_at=time.monotonic() + ttl)
+                self._next_id = max(self._next_id, lid + 1)
+            elif kind == "keepalive":
+                lease = self._leases.get(int(e[1]))
+                if lease is not None:
+                    lease.expires_at = time.monotonic() + lease.ttl
+            elif kind == "unlease":
+                # key deletes follow as their own entries
+                self._leases.pop(int(e[1]), None)
+            elif kind == "qpush":
+                self._queues.setdefault(e[1], deque()).append(
+                    (e[2], time.monotonic()))
+            elif kind == "qpop":
+                q = self._queues.get(e[1])
+                if q:
+                    q.popleft()
+            else:
+                logger.warning("unknown replication entry %r", kind)
+        except Exception:  # noqa: BLE001 — one bad entry must not kill
+            # the mirror; the next full-snapshot re-attach repairs drift
+            logger.exception("failed to apply replication entry %r", e)
+
+    def _maybe_promote(self) -> None:
+        if (self.standby_of is None
+                or not self.promote_after_s or self.promote_after_s <= 0
+                or time.monotonic() - self._primary_last_contact
+                < self.promote_after_s):
+            return
+        if not self._ever_attached:
+            # nothing mirrored: promoting would bring up an EMPTY primary
+            # with a fresh epoch next to a possibly-alive real one
+            logger.warning(
+                "standby %s: primary %s silent past %.1fs but no snapshot "
+                "was ever installed; NOT auto-promoting (use the promote "
+                "admin op / SIGUSR1 to force)", self.address,
+                self.standby_of, self.promote_after_s)
+            self._primary_last_contact = time.monotonic()  # re-arm, no spam
+            return
+        self.promote(reason=f"primary silent "
+                            f">= {self.promote_after_s:.1f}s")
+
+    def promote(self, reason: str = "manual") -> None:
+        """Become the acting primary: bump the fencing term, rebase lease
+        deadlines by the grace window (no mass-expiry mid-failover), start
+        primary duties.  Idempotent on an acting primary."""
+        if self.standby_of is None and self._deposed_term is None:
+            return
+        self.standby_of = None
+        self._term = max(self._term, self._deposed_term or 0) + 1
+        self._deposed_term = None
+        self.failovers_total += 1
+        # skip the id counter past anything the dead primary may have
+        # issued in the replication-lag window before it died: a lease
+        # granted there is unknown to us, and re-issuing its NUMBER to a
+        # new client would make the victim's same-epoch resync probe adopt
+        # the foreign lease (the exact hazard the boot-epoch check exists
+        # to prevent — async replication re-opens it under a matching
+        # epoch unless the id spaces are kept disjoint)
+        self._next_id += 1000
+        now = time.monotonic()
+        for lease in self._leases.values():
+            lease.expires_at = max(lease.expires_at,
+                                   now + lease.ttl + self.lease_grace_s)
+        if self._lease_task is None or self._lease_task.done():
+            self._lease_task = asyncio.create_task(self._lease_scanner())
+        logger.warning(
+            "coordinator %s promoted to primary (%s): term %d, %d key(s), "
+            "%d lease(s) rebased +%.1fs grace, %d queued job(s)",
+            self.address, reason, self._term, len(self._kv),
+            len(self._leases), self.lease_grace_s,
+            sum(len(q) for q in self._queues.values()))
+
 
 # ---------------------------------------------------------------------------
 # Client
 # ---------------------------------------------------------------------------
+
+
+class NotPrimaryError(ConnectionError):
+    """The reached coordinator is alive but not the acting primary (a
+    standby awaiting promotion, a deposed/stale primary).  The reconnect
+    loop walks on with a SHORT retry cap instead of growing the outage
+    backoff: the pair is up, the failover completes within the promote
+    window, and waiting out a full backoff cycle would dominate the
+    failover-to-ready latency."""
+
+
+# retry ceiling while bouncing off a live-but-not-primary server
+_NOT_PRIMARY_RETRY_CAP_S = 0.25
 
 
 class WatchEvent:
@@ -727,8 +1300,21 @@ class CoordClient:
                  reconnect_max_s: Optional[float] = None,
                  resync_grace_s: Optional[float] = None,
                  resync_timeout_s: Optional[float] = None):
-        host, _, port = address.rpartition(":")
-        self.host, self.port = host or "127.0.0.1", int(port)
+        # comma-separated multi-address: "host:6650,host:6651" names a
+        # replicated pair; connect and the reconnect loop walk the list,
+        # skipping standbys, so failover needs no reconfiguration. A list
+        # of one is exactly the single-coordinator behavior.
+        self.addresses: List[Tuple[str, int]] = []
+        for part in address.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            host, _, port = part.rpartition(":")
+            self.addresses.append((host or "127.0.0.1", int(port)))
+        if not self.addresses:
+            raise ValueError(f"no coordinator address in {address!r}")
+        self._addr_idx = 0
+        self.host, self.port = self.addresses[0]
         env = os.environ.get
         self.reconnect = (env("DYN_COORD_RECONNECT", "1").lower()
                           not in ("0", "false", "no")
@@ -774,6 +1360,10 @@ class CoordClient:
         self._closing = False
         self._disconnected_at: Optional[float] = None
         self._server_epoch: Optional[int] = None
+        # highest fencing term seen (ping echo / fenced bounce); stamped on
+        # writes. None until a term-aware server is seen — so against a
+        # pre-replication server nothing is stamped (fencing disabled)
+        self._term: Optional[int] = None
         self._conn_lost_flag = False  # current connection died (see below)
         self.closed = asyncio.Event()
         # observability (exported via http/metrics.CoordClientMetrics)
@@ -826,25 +1416,83 @@ class CoordClient:
             pass
 
     async def connect(self) -> "CoordClient":
+        last: Optional[BaseException] = None
+        for _ in range(len(self.addresses)):
+            self.host, self.port = self.addresses[self._addr_idx]
+            try:
+                await self._connect_one()
+                return self
+            except asyncio.CancelledError:
+                await self.close()
+                raise
+            except BaseException as e:
+                # this address failed (dead, standby, deposed): tear the
+                # attempt down WITHOUT closing the client and walk on
+                last = e
+                await self._abort_conn_attempt()
+                self._addr_idx = (self._addr_idx + 1) % len(self.addresses)
+        # a half-opened connection (server died mid-handshake) must not
+        # leave a background reconnect loop running on an object the
+        # caller is about to abandon — connect() either works or is void
+        await self.close()
+        raise last if last is not None else ConnectionError(
+            "no coordinator reachable")
+
+    async def _connect_one(self) -> None:
         self._reader, self._writer = await asyncio.open_connection(self.host, self.port)
         self._wlock = asyncio.Lock()
         self._connected.set()
         self._reader_task = asyncio.create_task(self._read_loop(self._reader))
         # baseline boot epoch: resync compares against it to tell a blipped
-        # server (state intact, probe leases) from a fresh one (re-grant)
-        try:
-            # bounded like resync: a server that accepts TCP but never
-            # answers must not hang startup forever
-            self._server_epoch = (await asyncio.wait_for(
-                self._call("ping"),
-                timeout=self.resync_timeout_s or None)).get("epoch")
-        except BaseException:
-            # a half-opened connection (server died mid-handshake) must not
-            # leave a background reconnect loop running on an object the
-            # caller is about to abandon — connect() either works or is void
-            await self.close()
-            raise
-        return self
+        # server (state intact, probe leases) from a fresh one (re-grant).
+        # bounded like resync: a server that accepts TCP but never
+        # answers must not hang startup forever
+        resp = await asyncio.wait_for(self._call("ping"),
+                                      timeout=self.resync_timeout_s or None)
+        if resp.get("standby"):
+            raise NotPrimaryError(
+                f"{self.host}:{self.port} is a standby coordinator")
+        if resp.get("deposed"):
+            raise NotPrimaryError(
+                f"{self.host}:{self.port} is a deposed coordinator")
+        self._server_epoch = resp.get("epoch")
+        term = resp.get("term")
+        self._term = int(term) if term is not None else None
+
+    async def _abort_conn_attempt(self) -> None:
+        """Undo one failed connect() attempt: kill the socket and reader
+        task without flipping ``closed`` (the walk continues)."""
+        self._connected.clear()
+        # null the reader FIRST (sync): any read loop dying from here on
+        # sees a superseded connection in _on_conn_lost and cannot start
+        # reconnect supervision behind the walk's back
+        task, self._reader_task = self._reader_task, None
+        self._reader = None
+        if self._writer is not None:
+            try:
+                self._writer.close()
+            except Exception:
+                pass
+            self._writer = None
+        # a read loop that died DURING the attempt (server hung up) may
+        # already have started supervision — and that loop may have opened
+        # a fresh connection meanwhile: reap it, then sweep again
+        if self._reconnect_task is not None:
+            reconnect, self._reconnect_task = self._reconnect_task, None
+            await reap_task(reconnect)
+        if task is not None:
+            await reap_task(task)
+        # anything the reaped supervision installed before dying
+        extra, self._reader_task = self._reader_task, None
+        self._reader = None
+        if self._writer is not None:
+            try:
+                self._writer.close()
+            except Exception:
+                pass
+            self._writer = None
+        if extra is not None:
+            await reap_task(extra)
 
     async def close(self) -> None:
         self._closing = True
@@ -977,6 +1625,9 @@ class CoordClient:
                     self.host, self.port, down_for)
                 self._finalize_closed()
                 return
+            # walk the address list: each failed attempt advances to the
+            # next candidate (a single address degenerates to retry-same)
+            self.host, self.port = self.addresses[self._addr_idx]
             try:
                 # bounded attempt: a blackholed address must not park the
                 # loop for the kernel connect timeout (minutes) — backoff
@@ -985,6 +1636,7 @@ class CoordClient:
                     asyncio.open_connection(self.host, self.port),
                     timeout=max(self.reconnect_cap_s, 1.0))
             except (OSError, asyncio.TimeoutError):
+                self._addr_idx = (self._addr_idx + 1) % len(self.addresses)
                 sleep_s = backoff()
                 await asyncio.sleep(sleep_s)
                 continue
@@ -1019,13 +1671,30 @@ class CoordClient:
                     # this (still-running) task, so the retry is on us:
                     # declaring success would wedge the client forever
                     raise ConnectionError("connection lost during resync")
+            except NotPrimaryError as e:
+                # a live server that just isn't the primary (yet): walk on
+                # with a short retry cap — promotion completes within the
+                # promote window and a full outage backoff would dominate
+                # the failover-to-ready latency
+                logger.info("coordinator resync walked on (%s)", e)
+                try:
+                    writer.close()
+                except Exception:
+                    pass
+                self._addr_idx = (self._addr_idx + 1) % len(self.addresses)
+                sleep_s = min(backoff(), _NOT_PRIMARY_RETRY_CAP_S)
+                await asyncio.sleep(sleep_s)
+                continue
             except Exception as e:  # noqa: BLE001 — any resync failure
-                # (connection died again, server error) restarts supervision
+                # (connection died again, server error, landed on a
+                # standby/deposed/stale primary) restarts supervision on
+                # the next address
                 logger.warning("coordinator resync failed (%s); retrying", e)
                 try:
                     writer.close()
                 except Exception:
                     pass
+                self._addr_idx = (self._addr_idx + 1) % len(self.addresses)
                 sleep_s = backoff()
                 await asyncio.sleep(sleep_s)
                 continue
@@ -1077,9 +1746,33 @@ class CoordClient:
         # restarted id counter may have RE-ISSUED our old lease ids to other
         # clients — an existence probe would then adopt a foreign lease
         # (and die with it when its real owner revokes). Same epoch means
-        # the server's state survived and probing is trustworthy.
-        epoch = (await self._call("ping")).get("epoch")
+        # the server's state survived and probing is trustworthy. A hot
+        # standby MIRRORS its primary's epoch, so a failover lands here as
+        # the cheap probe path: every replicated lease keeps its id.
+        ping = await self._call("ping")
+        if ping.get("standby"):
+            raise NotPrimaryError("reached a standby coordinator; "
+                                  "walking the address list")
+        if ping.get("deposed"):
+            self._term = max(self._term or 0,
+                             int(ping.get("deposed_by", 0) or 0))
+            raise NotPrimaryError("reached a deposed coordinator; "
+                                  "walking the address list")
+        epoch = ping.get("epoch")
         fresh_server = epoch != self._server_epoch
+        term = ping.get("term")
+        if term is None:
+            self._term = None  # pre-replication server: fencing disabled
+        elif fresh_server:
+            self._term = int(term)  # new lineage, new term sequence
+        elif self._term is not None and int(term) < self._term:
+            # same lineage but an OLDER term than we've already seen: this
+            # is the deposed half of a split brain that hasn't noticed yet
+            raise NotPrimaryError(
+                f"stale primary: term {int(term)} < {self._term} seen; "
+                "walking the address list")
+        else:
+            self._term = int(term)
         # 1. leases: probe-or-regrant. A lease that survived the outage
         # (connection blip, or restart without state wipe within TTL) keeps
         # its id — zero churn; one the server lost is re-granted under a
@@ -1220,6 +1913,8 @@ class CoordClient:
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[rid] = fut
         frame = {"op": op, "rid": rid, **kw}
+        if self._term is not None and op in _WRITE_OPS:
+            frame["term"] = self._term  # fencing stamp (see module doc)
         async with self._wlock:
             await send_frame(self._writer, frame)
         # A dead connection may accept the write (TCP buffering) while the
@@ -1242,8 +1937,31 @@ class CoordClient:
                 # attempt; the read loop tolerates replies to unknown rids
                 self._pending.pop(rid, None)
         if not resp.get("ok"):
-            raise RuntimeError(f"coordinator {op} failed: {resp.get('error')}")
+            self._raise_rejection(op, resp)
         return resp
+
+    def _raise_rejection(self, op: str, resp: Dict[str, Any]) -> None:
+        """Turn a not-ok response into the right exception.  A fenced or
+        standby bounce means this server is no longer the primary: adopt
+        the newer term, drop the connection so supervision walks the
+        address list, and surface a ConnectionError (callers already treat
+        those as a survivable outage)."""
+        if resp.get("fenced") or resp.get("standby"):
+            t = resp.get("term")
+            if t is not None:
+                self._term = max(self._term or 0, int(t))
+            kind = "fenced" if resp.get("fenced") else "standby"
+            logger.warning(
+                "coordinator %s:%d bounced %s (%s, term %s); re-pointing",
+                self.host, self.port, op, kind, t)
+            if self._writer is not None:
+                try:
+                    self._writer.close()  # read loop EOF -> reconnect walk
+                except Exception:
+                    pass
+            raise ConnectionError(
+                f"coordinator re-pointed ({kind}): {resp.get('error')}")
+        raise RuntimeError(f"coordinator {op} failed: {resp.get('error')}")
 
     # -- KV API ------------------------------------------------------------
 
@@ -1409,9 +2127,11 @@ class CoordClient:
         rid = next(self._rids)
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[rid] = fut
+        frame = {"op": "queue_pull", "rid": rid, "queue": queue}
+        if self._term is not None:
+            frame["term"] = self._term
         async with self._wlock:
-            await send_frame(self._writer,
-                             {"op": "queue_pull", "rid": rid, "queue": queue})
+            await send_frame(self._writer, frame)
         closed_wait = asyncio.ensure_future(self.closed.wait())
         try:
             done, _ = await asyncio.wait(
@@ -1419,6 +2139,8 @@ class CoordClient:
                 return_when=asyncio.FIRST_COMPLETED)
             if fut in done:
                 resp = fut.result()
+                if not resp.get("ok"):
+                    self._raise_rejection("queue_pull", resp)
                 return resp["payload"], float(resp.get("age_s", 0.0))
             if closed_wait in done:
                 self._pending.pop(rid, None)
@@ -1466,23 +2188,65 @@ def main() -> None:
     Running the control plane as its own process is what makes the
     crash/restart drills in docs/deployment.md ("Control-plane outages")
     real: kill -9 this and start a fresh one on the same port — every
-    supervised ``CoordClient`` reconnects and resyncs its state."""
+    supervised ``CoordClient`` reconnects and resyncs its state.
+
+    Replication: run a second process with ``--standby-of host:6650`` (its
+    own ``--port``) and give clients both addresses; the standby
+    self-promotes after ``--promote-after`` seconds of primary silence
+    (SIGUSR1 promotes immediately — the manual-failover path).  With
+    ``DYN_SYSTEM_ENABLED=1`` a system server exposes ``dynamo_coord_role``/
+    ``dynamo_coord_failovers_total``/``dynamo_coord_replication_lag_ops``
+    on /metrics (port ``DYN_SYSTEM_PORT``)."""
     import argparse
+    import contextlib
+    import signal
 
     from dynamo_tpu.utils.logging import configure_logging
 
     parser = argparse.ArgumentParser(description="dynamo_tpu coordinator")
     parser.add_argument("--host", default="0.0.0.0")
     parser.add_argument("--port", type=int, default=6650)
+    parser.add_argument("--standby-of", default=None, metavar="HOST:PORT",
+                        help="run as a hot standby replicating this "
+                             "primary; promotes on its failure")
+    parser.add_argument("--promote-after", type=float, default=None,
+                        help="standby self-promotes after this many "
+                             "seconds of primary silence (default "
+                             "DYN_COORD_PROMOTE_AFTER_S or "
+                             f"{DEFAULT_PROMOTE_AFTER_S}; <=0 = manual "
+                             "promotion only)")
     args = parser.parse_args()
     configure_logging()
 
     async def _run() -> None:
-        coord = await Coordinator(host=args.host, port=args.port).start()
-        print(f"coordinator listening on {coord.address}", flush=True)
+        coord = await Coordinator(host=args.host, port=args.port,
+                                  standby_of=args.standby_of,
+                                  promote_after_s=args.promote_after).start()
+        loop = asyncio.get_running_loop()
+        with contextlib.suppress(NotImplementedError, AttributeError):
+            loop.add_signal_handler(
+                signal.SIGUSR1, lambda: coord.promote("SIGUSR1"))
+        system = None
+        try:
+            from prometheus_client import CollectorRegistry
+
+            from dynamo_tpu.http.metrics import CoordinatorMetrics
+            from dynamo_tpu.runtime.system_server import SystemServer
+            registry = CollectorRegistry()
+            CoordinatorMetrics(coord, registry=registry)
+            system = SystemServer.from_env(registry=registry)
+            if system is not None:
+                system.attach_coord(coord)
+                await system.start()
+        except Exception:  # noqa: BLE001 — observability never gates serving
+            logger.exception("coordinator system server unavailable")
+        print(f"coordinator listening on {coord.address} ({coord.role})",
+              flush=True)
         try:
             await asyncio.Event().wait()  # serve until killed
         finally:
+            if system is not None:
+                await system.stop()
             await coord.stop()
 
     try:
@@ -1496,4 +2260,4 @@ if __name__ == "__main__":
 
 
 __all__ = ["Coordinator", "CoordClient", "Watch", "WatchEvent", "Subscription",
-           "Lease"]
+           "Lease", "NotPrimaryError"]

@@ -111,8 +111,10 @@ class DistributedRuntime:
         embedded = None
         if standalone:
             # honor the requested address so other processes can join with
-            # the same --coordinator value
-            host, _, port = coordinator.rpartition(":")
+            # the same --coordinator value; a replicated address list embeds
+            # the FIRST entry (the primary slot)
+            first = coordinator.split(",")[0].strip()
+            host, _, port = first.rpartition(":")
             embedded = await Coordinator(host=host or "127.0.0.1",
                                          port=int(port)).start()
             coordinator = embedded.address

@@ -24,6 +24,22 @@ from prometheus_client import CollectorRegistry, generate_latest
 logger = logging.getLogger(__name__)
 
 
+def coord_ready_reasons(coord) -> list:
+    """Readiness reasons from a control-plane handle — shared between the
+    system server and the HTTP frontend so the LB-facing contract cannot
+    drift.  ``coord`` is a ``CoordClient`` (ready while its supervised
+    connection is up and resynced) or a server-side ``Coordinator`` (ready
+    while it is the acting primary); returns [] when ready."""
+    if coord is None:
+        return []
+    connected = getattr(coord, "connected", None)
+    if connected is not None:
+        return [] if connected else ["coordinator disconnected"]
+    if getattr(coord, "role", "primary") != "primary":
+        return [f"coordinator role: {coord.role}"]
+    return []
+
+
 class SystemHealth:
     """Named readiness flags; unhealthy until every flag is set."""
 
@@ -59,6 +75,8 @@ class SystemServer:
         self.app = web.Application()
         self.app.router.add_get("/health", self.handle_health)
         self.app.router.add_get("/live", self.handle_live)
+        self.app.router.add_get("/healthz", self.handle_live)
+        self.app.router.add_get("/healthz/ready", self.handle_ready)
         self.app.router.add_get("/metrics", self.handle_metrics)
         self.app.router.add_get("/v1/traces", self.handle_traces)
         self.app.router.add_get("/v1/traces/{trace_id}", self.handle_trace)
@@ -66,12 +84,23 @@ class SystemServer:
         # graceful-drain hook (worker/drain.DrainController): POST /drain
         # triggers it; absent on processes with nothing to drain
         self._drain = None
+        # control-plane readiness hook: a CoordClient (readiness follows
+        # its supervised connection) or an in-process Coordinator
+        # (readiness == acting primary)
+        self._coord = None
         self._runner: Optional[web.AppRunner] = None
 
     def register_drain(self, controller) -> None:
         """Expose a ``DrainController`` on ``POST /drain`` (the operator/
         planner-facing trigger next to SIGTERM)."""
         self._drain = controller
+
+    def attach_coord(self, coord) -> None:
+        """Gate ``GET /healthz/ready`` on control-plane state: a
+        ``CoordClient`` (ready while its supervised connection is up and
+        resynced) or a server-side ``Coordinator`` (ready while it is the
+        acting primary)."""
+        self._coord = coord
 
     @classmethod
     def from_env(cls, **kwargs) -> Optional["SystemServer"]:
@@ -106,6 +135,24 @@ class SystemServer:
 
     async def handle_live(self, request: web.Request) -> web.Response:
         return web.json_response({"status": "live"})
+
+    async def handle_ready(self, request: web.Request) -> web.Response:
+        """Readiness (vs. /healthz liveness): 503 while the control-plane
+        connection is down, during a drain, or while a registered
+        subsystem is not ready — so load balancers stop routing new work
+        into an outage instead of eating 5xx storms.  The process stays
+        LIVE (200 on /healthz) the whole time: killing it would only turn
+        a reconnect into a cold start."""
+        reasons = coord_ready_reasons(self._coord)
+        if self._drain is not None and self._drain.draining:
+            reasons.append(f"draining ({self._drain.state})")
+        if not self.health.healthy:
+            reasons.append("subsystems not ready")
+        ready = not reasons
+        return web.json_response(
+            {"ready": ready, "reasons": reasons,
+             "subsystems": self.health.snapshot()},
+            status=200 if ready else 503)
 
     async def handle_metrics(self, request: web.Request) -> web.Response:
         body = b""
@@ -162,5 +209,5 @@ def trace_get_response(tracer, trace_id: str) -> web.Response:
     return web.json_response(record)
 
 
-__all__ = ["SystemServer", "SystemHealth", "trace_list_response",
-           "trace_get_response"]
+__all__ = ["SystemServer", "SystemHealth", "coord_ready_reasons",
+           "trace_list_response", "trace_get_response"]

@@ -32,12 +32,16 @@ processes; this adds the fault class process-kills can't express.
 relisten on the SAME port, with or without a state wipe — so chaos tests can
 exercise both a blipped connection (state intact, leases still ticking) and a
 fresh empty coordinator (the real crash/restart, everything to resync).
+
+``CoordinatorPair`` composes both with a replicated primary + hot-standby
+pair whose replication link runs through a ``ChaosProxy``: primary kill -9,
+replication-link partition while both halves stay client-reachable (the
+dual-primary fencing drill), and standby blips during catch-up.
 """
 
 from __future__ import annotations
 
 import asyncio
-import itertools
 import logging
 import random
 from typing import Optional, Set
@@ -264,8 +268,14 @@ class CoordinatorOutage:
             # a genuinely fresh process restarts the id counter at 1, so
             # fresh watch/sub/lease ids COLLIDE with pre-outage ids —
             # resync code must survive that, so the drill reproduces it
-            c._ids = itertools.count(1)
+            c._next_id = 1
             c._epoch = random.getrandbits(63)  # new process, new boot epoch
+            c._term = 0                 # fresh lineage: term restarts too
+            c._deposed_term = None
+            c._repl_seq = 0
+            # a wiped standby has no mirrored state: it must re-attach
+            # before auto-promotion trusts it again
+            c._ever_attached = False
         await c.start()
         logger.info("coordinator restarted on %s (state %s)", c.address,
                     "wiped" if wipe_state else "kept")
@@ -277,6 +287,112 @@ class CoordinatorOutage:
         if downtime_s > 0:
             await asyncio.sleep(downtime_s)
         await self.restart(wipe_state=wipe_state)
+
+
+class CoordinatorPair:
+    """Chaos harness for a replicated coordinator pair (primary + hot
+    standby) with a controllable replication link.
+
+    The standby attaches to the primary THROUGH a ``ChaosProxy``, so the
+    replication link can be partitioned while both processes stay
+    client-reachable — the dual-primary drill.  The primary learns the
+    standby's REAL listen address from the attach, so its split-brain peer
+    probe bypasses the proxy: when the standby promotes behind the
+    partition, the deposed primary observes the higher fencing term,
+    bounces its writers, and demotes itself into a standby of the winner.
+
+    Drills:
+
+    - ``kill9_primary()`` — abrupt primary death (clients see a hard TCP
+      close, like ``kill -9``); the standby self-promotes after its
+      promote window and clients walk their address list onto it.
+    - ``partition()`` / ``heal()`` — blackhole the replication link (open
+      TCP, no bytes) while both coordinators keep serving clients.
+    - ``blip_standby()`` — kill the standby mid-catch-up and bring it
+      back; it re-attaches with a fresh full snapshot.
+    - ``promote()`` — manual promotion (the operator/SIGUSR1 path).
+    """
+
+    def __init__(self, promote_after_s: float = 0.6,
+                 lease_grace_s: float = 0.5):
+        self.promote_after_s = promote_after_s
+        self.lease_grace_s = lease_grace_s
+        self.primary = None
+        self.standby = None
+        self.repl_proxy: Optional[ChaosProxy] = None
+        self.primary_outage: Optional[CoordinatorOutage] = None
+        self.standby_outage: Optional[CoordinatorOutage] = None
+
+    async def start(self) -> "CoordinatorPair":
+        from dynamo_tpu.runtime.coordinator import Coordinator
+
+        self.primary = await Coordinator(
+            port=0, promote_after_s=self.promote_after_s,
+            lease_grace_s=self.lease_grace_s).start()
+        self.repl_proxy = await ChaosProxy(self.primary.address).start()
+        self.standby = await Coordinator(
+            port=0, standby_of=self.repl_proxy.address,
+            promote_after_s=self.promote_after_s,
+            lease_grace_s=self.lease_grace_s).start()
+        self.primary_outage = CoordinatorOutage(self.primary)
+        self.standby_outage = CoordinatorOutage(self.standby)
+        await self.wait_attached()
+        return self
+
+    @property
+    def addresses(self) -> str:
+        """The multi-address string clients take (primary first)."""
+        return f"{self.primary.address},{self.standby.address}"
+
+    async def wait_attached(self, timeout: float = 5.0) -> None:
+        """Until the standby has installed the primary's snapshot (mirrored
+        boot epoch) and applied the log to the primary's sequence."""
+        deadline = asyncio.get_running_loop().time() + timeout
+        while (self.standby._epoch != self.primary._epoch
+               or self.standby._repl_seq < self.primary._repl_seq):
+            if asyncio.get_running_loop().time() >= deadline:
+                raise TimeoutError("standby never caught up")
+            await asyncio.sleep(0.02)
+
+    wait_caught_up = wait_attached
+
+    async def wait_promoted(self, timeout: float = 10.0) -> None:
+        deadline = asyncio.get_running_loop().time() + timeout
+        while self.standby.role != "primary":
+            if asyncio.get_running_loop().time() >= deadline:
+                raise TimeoutError("standby never promoted")
+            await asyncio.sleep(0.02)
+
+    async def kill9_primary(self) -> None:
+        """Abrupt primary death; the port stays available for
+        ``primary_outage.restart()`` (which rejoins via the peer probe)."""
+        await self.primary_outage.kill()
+
+    def partition(self) -> None:
+        """Cut primary<->standby replication while both stay
+        client-reachable (the dual-primary drill)."""
+        self.repl_proxy.blackhole()
+
+    def heal(self) -> None:
+        self.repl_proxy.heal()
+
+    async def blip_standby(self, downtime_s: float = 0.1) -> None:
+        """Kill the standby during replication catch-up and bring it back;
+        the fresh attach re-snapshots, repairing any missed tail."""
+        await self.standby_outage.blip(downtime_s=downtime_s,
+                                       wipe_state=True)
+
+    def promote(self, reason: str = "harness") -> None:
+        self.standby.promote(reason)
+
+    async def stop(self) -> None:
+        for part in (self.standby, self.repl_proxy, self.primary):
+            if part is None:
+                continue
+            try:
+                await part.stop()
+            except Exception:  # noqa: BLE001 — already-dead halves are fine
+                pass
 
 
 class WorkerDrain:
@@ -348,4 +464,5 @@ class WorkerDrain:
                 await stop()
 
 
-__all__ = ["ChaosProxy", "CoordinatorOutage", "WorkerDrain"]
+__all__ = ["ChaosProxy", "CoordinatorOutage", "CoordinatorPair",
+           "WorkerDrain"]

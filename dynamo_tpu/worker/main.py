@@ -510,6 +510,9 @@ async def amain(args: argparse.Namespace) -> None:
     system = SystemServer.from_env(registry=wm.registry, tracer=tracer)
     if system is not None:
         system.health.register("engine", ready=True)
+        # /healthz/ready turns 503 while the coordinator connection is
+        # down (and later during drain, via register_drain below)
+        system.attach_coord(drt.coord)
         await system.start()
     # graceful drain: SIGTERM (and POST /drain on the system server) stops
     # new work via the coordinator announcement, freezes in-flight streams

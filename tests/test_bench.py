@@ -58,6 +58,13 @@ def test_single_child_attempt_chain():
     assert "error" not in ab, ab
     assert ab["fused_tok_s"] > 0 and ab["perstep_tok_s"] > 0
     assert ab["fused_speedup"] > 0
+    # coordinator-failover leg: primary kill -9 mid-trace must lose no
+    # streams and re-grant no leases (same-epoch probe path)
+    cf = result["coord_failover"]
+    assert "error" not in cf, cf
+    assert cf["streams_lost"] == 0
+    assert cf["lease_regrants"] == 0
+    assert 0 < cf["ready_s"] < cf["pr3_cold_restart_ref_s"]
     # the continuous-arrival mixed-vs-legacy A/B ran on both engines.
     # jax sub-leg: CPU dispatch overhead is ~0, so only liveness is
     # asserted (the throughput separation is the on-chip/mocker story).

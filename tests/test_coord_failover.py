@@ -1,0 +1,453 @@
+"""Chaos suite for the replicated coordinator pair (ISSUE 15).
+
+Covers what tests/test_coordinator.py's failover-invariant tests don't:
+the dual-primary partition drill (fencing terms, deposed-primary demotion,
+state convergence), standby blips during replication catch-up, manual
+promotion over the wire, queue survival, wire-protocol back-compat (a
+PR 3-era client with no term field against the new server; the new client
+against a single non-replicated coordinator), and the /healthz readiness
+surface on the frontend and the worker system server.
+"""
+
+import asyncio
+import types
+
+import aiohttp
+import pytest
+
+from dynamo_tpu.runtime.codec import read_frame, send_frame
+from dynamo_tpu.runtime.coordinator import Coordinator, CoordClient
+from dynamo_tpu.utils.faults import CoordinatorOutage, CoordinatorPair
+
+
+async def _await_disconnect(client, timeout=5.0):
+    deadline = asyncio.get_running_loop().time() + timeout
+    while client.connected:
+        assert asyncio.get_running_loop().time() < deadline
+        await asyncio.sleep(0.01)
+
+
+async def _poll(cond, timeout=5.0, what="condition"):
+    deadline = asyncio.get_running_loop().time() + timeout
+    while not cond():
+        if asyncio.get_running_loop().time() >= deadline:
+            raise AssertionError(f"timed out waiting for {what}")
+        await asyncio.sleep(0.02)
+
+
+# -- replication basics -------------------------------------------------------
+
+
+async def test_pair_mirrors_kv_leases_and_queues():
+    """The standby's applied log matches the primary: KV (with lease
+    attachment), lease records, queued jobs — and the mirrored boot epoch
+    is what makes promotion look like a blip of the same server."""
+    pair = await CoordinatorPair().start()
+    try:
+        async with CoordClient(pair.addresses) as c:
+            lease = await c.grant_lease(ttl=5.0)
+            await c.put("a/k", b"v", lease_id=lease.lease_id)
+            await c.put("b/k", b"w")
+            await c.delete("b/k")
+            await c.queue_push("jobs", b"j1")
+            await pair.wait_caught_up()
+            s = pair.standby
+            assert s._epoch == pair.primary._epoch
+            assert s._kv["a/k"].value == b"v"
+            assert s._kv["a/k"].lease_id == lease.lease_id
+            assert "b/k" not in s._kv
+            assert lease.lease_id in s._leases
+            assert "a/k" in s._leases[lease.lease_id].keys
+            assert [p for p, _t in s._queues["jobs"]] == [b"j1"]
+            # the standby mirrors the id counter: ids it grants after
+            # promotion can never collide with replicated lease ids
+            assert s._next_id >= pair.primary._next_id
+    finally:
+        await pair.stop()
+
+
+async def test_queue_jobs_survive_failover():
+    pair = await CoordinatorPair(promote_after_s=0.4).start()
+    c = None
+    try:
+        c = await CoordClient(pair.addresses, reconnect_base_s=0.02).connect()
+        await c.queue_push("q", b"one")
+        await c.queue_push("q", b"two")
+        await pair.wait_caught_up()
+        await pair.kill9_primary()
+        await _await_disconnect(c)
+        await c.wait_connected(timeout=10)
+        assert (await c.queue_pull("q", timeout=5))[0] == b"one"
+        assert (await c.queue_pull("q", timeout=5))[0] == b"two"
+    finally:
+        if c is not None:
+            await c.close()
+        await pair.stop()
+
+
+async def test_standby_blip_during_catchup_reattaches():
+    """Kill the standby mid-replication and bring it back: the fresh
+    attach re-snapshots (repairing the missed tail), and a later primary
+    death still fails over with the full state."""
+    pair = await CoordinatorPair(promote_after_s=0.4).start()
+    c = None
+    try:
+        c = await CoordClient(pair.addresses, reconnect_base_s=0.02).connect()
+        await c.put("k1", b"v1")
+        await pair.wait_caught_up()
+        await pair.blip_standby(downtime_s=0.1)
+        # writes during the standby's outage are in the re-attach snapshot
+        await c.put("k2", b"v2")
+        await pair.wait_attached(timeout=10)
+        assert pair.standby._kv["k1"].value == b"v1"
+        assert pair.standby._kv["k2"].value == b"v2"
+        await pair.kill9_primary()
+        await _await_disconnect(c)
+        await c.wait_connected(timeout=10)
+        assert await c.get("k1") == b"v1"
+        assert await c.get("k2") == b"v2"
+    finally:
+        if c is not None:
+            await c.close()
+        await pair.stop()
+
+
+# -- the dual-primary drill ---------------------------------------------------
+
+
+async def test_partition_fences_deposed_primary_writers():
+    """Partition the replication link while both halves stay
+    client-reachable: the standby promotes (term+1); the deposed primary
+    discovers the higher term via its peer probe, BOUNCES its writers
+    (term bounce -> ConnectionError -> the client walks its address list
+    onto the new primary) and demotes itself into a standby of the winner
+    — converging, not diverging."""
+    pair = await CoordinatorPair(promote_after_s=0.4).start()
+    a = b = None
+    try:
+        a = await CoordClient(pair.addresses, reconnect_base_s=0.02).connect()
+        await a.put("k", b"v1")
+        await pair.wait_caught_up()
+        pair.partition()
+        await pair.wait_promoted()
+        assert pair.standby._term == pair.primary._term + 1
+        # a client of the NEW primary carries the new term
+        b = await CoordClient(pair.standby.address).connect()
+        await b.put("k", b"v2")
+        # the deposed primary notices (peer probe bypasses the partition)
+        await _poll(lambda: pair.primary.role != "primary", timeout=10,
+                    what="old primary deposed")
+        # client a was pinned to the old primary with no outage: its next
+        # write bounces there and lands on the new primary after re-point
+        async def write_through():
+            try:
+                await a.put("k2", b"from-a")
+                return True
+            except (ConnectionError, RuntimeError):
+                return False
+
+        deadline = asyncio.get_running_loop().time() + 10
+        while not await write_through():
+            assert asyncio.get_running_loop().time() < deadline
+            await asyncio.sleep(0.05)
+        assert (a.host, a.port) != (pair.primary.host, pair.primary.port)
+        assert await b.get("k2") == b"from-a"
+        assert await b.get("k") == b"v2"
+        # no divergent state: the demoted ex-primary mirrors the winner
+        await _poll(lambda: (pair.primary.standby_of is not None
+                             and pair.primary._kv.get("k2") is not None
+                             and pair.primary._kv["k"].value == b"v2"),
+                    timeout=10, what="ex-primary to converge")
+    finally:
+        for cl in (a, b):
+            if cl is not None:
+                await cl.close()
+        await pair.stop()
+
+
+# -- manual promotion (wire admin op) ----------------------------------------
+
+
+async def test_manual_promotion_via_wire_op():
+    """The ``promote`` admin op (the programmatic face of SIGUSR1) flips a
+    standby to primary immediately — the operator path when auto-promotion
+    is disabled or too slow to trust."""
+    pair = await CoordinatorPair(promote_after_s=0).start()  # manual only
+    try:
+        async with CoordClient(pair.addresses) as c:
+            await c.put("k", b"v")
+            await pair.wait_caught_up()
+        reader, writer = await asyncio.open_connection(
+            pair.standby.host, pair.standby.port)
+        try:
+            await send_frame(writer, {"op": "promote", "rid": 1,
+                                      "reason": "test"})
+            resp = await asyncio.wait_for(read_frame(reader), 5)
+            assert resp["ok"] and resp["role"] == "primary"
+            assert resp["term"] == 1
+        finally:
+            writer.close()
+        assert pair.standby.role == "primary"
+        # promoted standby serves with the replicated state
+        async with CoordClient(pair.standby.address) as c2:
+            assert await c2.get("k") == b"v"
+    finally:
+        await pair.stop()
+
+
+# -- wire-protocol back-compat -----------------------------------------------
+
+
+async def test_pr3_era_client_raw_frames_against_new_server():
+    """A client speaking the PR 3 wire protocol — no term field, no
+    replication ops — works unchanged against the new server: terms
+    absent means fencing is disabled for that client."""
+    async with Coordinator() as coord:
+        reader, writer = await asyncio.open_connection(coord.host,
+                                                       coord.port)
+        rid = iter(range(1, 100))
+
+        async def call(frame):
+            frame["rid"] = next(rid)
+            await send_frame(writer, frame)
+            while True:  # skip server-initiated evt frames (watch events)
+                resp = await asyncio.wait_for(read_frame(reader), 5)
+                if resp.get("rid") is not None:
+                    break
+            assert resp["rid"] == frame["rid"], resp
+            return resp
+
+        try:
+            r = await call({"op": "ping"})
+            assert r["ok"] and "epoch" in r  # PR 3 fields still present
+            assert (await call({"op": "put", "key": "k",
+                                "value": b"v"}))["ok"]
+            assert (await call({"op": "get", "key": "k"}))["value"] == b"v"
+            lease = await call({"op": "grant_lease", "ttl": 5.0})
+            assert lease["ok"]
+            assert (await call({"op": "keepalive",
+                                "lease": lease["lease"]}))["ok"]
+            assert (await call({"op": "put", "key": "l", "value": b"x",
+                                "lease": lease["lease"]}))["ok"]
+            w = await call({"op": "watch_prefix", "prefix": "k"})
+            assert w["ok"] and w["items"][0]["key"] == "k"
+            assert (await call({"op": "queue_push", "queue": "q",
+                                "payload": b"j"}))["depth"] == 1
+            pull = await call({"op": "queue_pull", "queue": "q"})
+            assert pull["payload"] == b"j"
+            assert (await call({"op": "delete", "key": "k"}))["deleted"] == 1
+        finally:
+            writer.close()
+
+
+async def test_new_client_single_coordinator_is_pr3_behavior():
+    """Address list of one + non-replicated server == exact PR 3 behavior:
+    blip-with-state-kept keeps the lease id, wiped restart re-grants, and
+    the term the client stamps (0, never bumped) fences nothing."""
+    coord = await Coordinator(port=0).start()
+    outage = CoordinatorOutage(coord)
+    try:
+        async with CoordClient(coord.address,
+                               reconnect_base_s=0.02) as c:
+            assert c._term == 0  # learned from ping; never changes here
+            lease = await c.grant_lease(ttl=5.0)
+            before = lease.lease_id
+            await c.put("k", b"v", lease_id=lease.lease_id)
+            await outage.blip(downtime_s=0.2, wipe_state=False)
+            await c.wait_connected(timeout=10)
+            assert lease.lease_id == before and not lease.lost.is_set()
+            assert await c.get("k") == b"v"
+            moves = []
+            lease.on_relocated(lambda o, n: moves.append((o, n)))
+            await outage.blip(downtime_s=0.1, wipe_state=True)
+            await c.wait_connected(timeout=10)
+            assert moves, "wiped restart must re-grant"
+            assert c._term == 0
+    finally:
+        await coord.stop()
+
+
+# -- readiness surface --------------------------------------------------------
+
+
+async def test_frontend_healthz_ready_tracks_coordinator():
+    from dynamo_tpu.http.service import HttpService
+    from dynamo_tpu.llm.model_manager import ModelManager
+
+    coord = await Coordinator(port=0).start()
+    outage = CoordinatorOutage(coord)
+    service = client = None
+    try:
+        client = await CoordClient(coord.address,
+                                   reconnect_base_s=0.02).connect()
+        manager = ModelManager()
+        manager.add("m", object())  # readiness only consults names()
+        service = await HttpService(manager, host="127.0.0.1",
+                                    port=0).start()
+        service.attach_coord(client)
+        base = f"http://127.0.0.1:{service.port}"
+        async with aiohttp.ClientSession() as s:
+            r = await s.get(f"{base}/healthz/ready")
+            assert r.status == 200
+            await outage.kill()
+            await _await_disconnect(client)
+            # liveness stays 200 (restart would only slow recovery);
+            # readiness flips 503 so the LB drains traffic away
+            r = await s.get(f"{base}/healthz")
+            assert r.status == 200
+            r = await s.get(f"{base}/healthz/ready")
+            assert r.status == 503
+            assert "coordinator disconnected" in (await r.json())["reasons"]
+            await outage.restart(wipe_state=False)
+            await client.wait_connected(timeout=10)
+            r = await s.get(f"{base}/healthz/ready")
+            assert r.status == 200
+    finally:
+        if service is not None:
+            await service.stop()
+        if client is not None:
+            await client.close()
+        await coord.stop()
+
+
+async def test_system_server_healthz_ready_coordinator_and_drain():
+    from dynamo_tpu.runtime.system_server import SystemServer
+
+    coord = await Coordinator(port=0).start()
+    outage = CoordinatorOutage(coord)
+    server = client = None
+    try:
+        client = await CoordClient(coord.address,
+                                   reconnect_base_s=0.02).connect()
+        server = await SystemServer(host="127.0.0.1").start()
+        server.attach_coord(client)
+        drain = types.SimpleNamespace(draining=False, state="serving")
+        server.register_drain(drain)
+        base = f"http://127.0.0.1:{server.port}"
+        async with aiohttp.ClientSession() as s:
+            assert (await s.get(f"{base}/healthz/ready")).status == 200
+            await outage.kill()
+            await _await_disconnect(client)
+            assert (await s.get(f"{base}/healthz")).status == 200
+            r = await s.get(f"{base}/healthz/ready")
+            assert r.status == 503
+            await outage.restart(wipe_state=False)
+            await client.wait_connected(timeout=10)
+            assert (await s.get(f"{base}/healthz/ready")).status == 200
+            # PR 14's drain state gates readiness too: a draining worker
+            # is alive but must stop receiving new work
+            drain.draining, drain.state = True, "draining"
+            r = await s.get(f"{base}/healthz/ready")
+            assert r.status == 503
+            assert "draining (draining)" in (await r.json())["reasons"]
+    finally:
+        if server is not None:
+            await server.stop()
+        if client is not None:
+            await client.close()
+        await coord.stop()
+
+
+# -- review-hardening regressions --------------------------------------------
+
+
+async def test_never_attached_standby_does_not_auto_promote():
+    """A standby that never installed a snapshot must NOT self-promote: it
+    would come up as an EMPTY primary with a fresh epoch next to a
+    possibly-alive real one.  Manual promotion stays available for the
+    operator who knows better."""
+    # point at a port nothing listens on: attach can never succeed
+    dead = Coordinator(port=0)
+    s = await Coordinator(port=0, standby_of="127.0.0.1:1",
+                          promote_after_s=0.2).start()
+    try:
+        await asyncio.sleep(1.0)  # several promote windows
+        assert s.role == "standby"
+        s.promote("operator knows the primary is gone")
+        assert s.role == "primary"
+    finally:
+        await s.stop()
+        del dead
+
+
+async def test_unreplicated_lease_id_never_reissued_after_promotion():
+    """A lease granted in the replication-lag window dies with the
+    primary; the promoted standby must re-grant it under a FRESH id (the
+    probe path correctly fails) and must never hand that NUMBER to another
+    client — a same-epoch probe would adopt the foreign lease."""
+    pair = await CoordinatorPair(promote_after_s=0.4).start()
+    a = b = None
+    try:
+        a = await CoordClient(pair.addresses, reconnect_base_s=0.02).connect()
+        await a.put("seed", b"x")
+        await pair.wait_caught_up()
+        pair.partition()  # the next grant never reaches the standby
+        lease = await a.grant_lease(ttl=5.0)
+        lost_id = lease.lease_id
+        assert lost_id not in pair.standby._leases
+        moves = []
+        lease.on_relocated(lambda o, n: moves.append((o, n)))
+        await pair.kill9_primary()
+        await _await_disconnect(a)
+        await a.wait_connected(timeout=10)
+        # the probe found no such lease on the new primary -> re-granted
+        assert moves and lease.lease_id != lost_id
+        # and no later grant may collide with the lost number
+        b = await CoordClient(pair.standby.address).connect()
+        lb = await b.grant_lease(ttl=5.0)
+        assert lb.lease_id != lost_id
+        assert pair.standby._next_id > lost_id
+    finally:
+        for cl in (a, b):
+            if cl is not None:
+                await cl.close()
+        await pair.stop()
+
+
+async def test_wildcard_bound_standby_advertises_reachable_addr():
+    """A standby bound to 0.0.0.0 must not advertise '0.0.0.0:port' to the
+    primary — the peer probe would dial the primary's own host and fencing
+    would silently never fire."""
+    p = await Coordinator(port=0).start()
+    s = await Coordinator(host="0.0.0.0", port=0,
+                          standby_of=p.address,
+                          promote_after_s=0.5).start()
+    try:
+        deadline = asyncio.get_running_loop().time() + 5
+        while not p._peer_addrs:
+            assert asyncio.get_running_loop().time() < deadline
+            await asyncio.sleep(0.02)
+        (addr,) = p._peer_addrs
+        assert not addr.startswith("0.0.0.0"), addr
+        assert addr.endswith(f":{s.port}")
+    finally:
+        await s.stop()
+        await p.stop()
+
+
+# -- metrics collector --------------------------------------------------------
+
+
+async def test_coordinator_metrics_collector():
+    from prometheus_client import CollectorRegistry, generate_latest
+
+    from dynamo_tpu.http.metrics import CoordinatorMetrics
+
+    pair = await CoordinatorPair(promote_after_s=0.4).start()
+    try:
+        reg_p = CollectorRegistry()
+        reg_s = CollectorRegistry()
+        CoordinatorMetrics(pair.primary, registry=reg_p)
+        CoordinatorMetrics(pair.standby, registry=reg_s)
+        text_p = generate_latest(reg_p).decode()
+        text_s = generate_latest(reg_s).decode()
+        assert "dynamo_coord_role 1.0" in text_p
+        assert "dynamo_coord_role 0.0" in text_s
+        assert "dynamo_coord_standbys_attached 1.0" in text_p
+        await pair.kill9_primary()
+        await pair.wait_promoted()
+        text_s = generate_latest(reg_s).decode()
+        assert "dynamo_coord_role 1.0" in text_s
+        assert "dynamo_coord_failovers_total 1.0" in text_s
+    finally:
+        await pair.stop()

@@ -463,6 +463,146 @@ async def test_wiped_restart_reuses_ids_without_clobbering_watches():
     finally:
         await coord.stop()
 
+# -- replicated pair: failover invariants ------------------------------------
+# (the wider chaos suite — partition/fencing drills, wire back-compat,
+# readiness — lives in tests/test_coord_failover.py)
+
+from dynamo_tpu.utils.faults import CoordinatorPair  # noqa: E402
+
+
+async def _await_disconnect(client, timeout=5.0):
+    """The kill is abrupt: wait until the client's read loop has noticed,
+    or wait_connected() below would return on the DEAD connection."""
+    deadline = asyncio.get_running_loop().time() + timeout
+    while client.connected:
+        assert asyncio.get_running_loop().time() < deadline
+        await asyncio.sleep(0.01)
+
+
+async def test_failover_lease_survives_keepalive_on_new_primary():
+    """A lease granted on the old primary keeps its ID across the
+    failover: the standby mirrors the boot epoch, so the resync takes the
+    probe path (keepalive) — no relocation, no re-grant storm — and the
+    attached keys survive."""
+    pair = await CoordinatorPair(promote_after_s=0.4).start()
+    c = None
+    try:
+        c = await CoordClient(pair.addresses, reconnect_base_s=0.02).connect()
+        lease = await c.grant_lease(ttl=5.0)
+        old_id = lease.lease_id
+        moves = []
+        lease.on_relocated(lambda o, n: moves.append((o, n)))
+        await c.put("inst/w", b"v", lease_id=lease.lease_id)
+        await pair.wait_caught_up()
+        await pair.kill9_primary()
+        await _await_disconnect(c)
+        await c.wait_connected(timeout=10)
+        assert pair.standby.role == "primary"
+        assert lease.lease_id == old_id and moves == []
+        assert not lease.lost.is_set()
+        assert await c.get("inst/w") == b"v"
+        # keepalive against the NEW primary sustains the SAME lease id
+        await c.keepalive(old_id)
+        await asyncio.sleep(1.2)  # several keepalive intervals
+        assert await c.get("inst/w") == b"v"
+        assert not lease.lost.is_set()
+    finally:
+        if c is not None:
+            await c.close()
+        await pair.stop()
+
+
+async def test_failover_watch_delta_continuity():
+    """Across a promotion a watcher sees NO missed and NO duplicated
+    events: the resync re-scan against the standby's applied log matches
+    the watcher's last-known state exactly (the PR 3 identity-stamped
+    diff), and later puts stream through the re-registered watch once."""
+    pair = await CoordinatorPair(promote_after_s=0.4).start()
+    owner = watcher = None
+    try:
+        owner = await CoordClient(pair.addresses,
+                                  reconnect_base_s=0.02).connect()
+        watcher = await CoordClient(pair.addresses, reconnect_base_s=0.02,
+                                    resync_grace_s=0.2).connect()
+        await owner.put("w/a", b"1")
+        await owner.put("w/b", b"2")
+        w = await watcher.watch_prefix("w/")
+        assert w.snapshot == [("w/a", b"1"), ("w/b", b"2")]
+        await pair.wait_caught_up()
+        await pair.kill9_primary()
+        await _await_disconnect(owner)
+        await _await_disconnect(watcher)
+        await owner.wait_connected(timeout=10)
+        await watcher.wait_connected(timeout=10)
+        # replicated state matched the last-known view: nothing synthesized
+        await asyncio.sleep(0.5)  # past the grace window
+        assert w.queue.empty(), [w.queue.get_nowait()
+                                 for _ in range(w.queue.qsize())]
+        # the re-registered watch is live on the new primary: exactly one
+        # event per new put, no duplicates
+        await owner.put("w/c", b"3")
+        ev = await asyncio.wait_for(w.__anext__(), timeout=5)
+        assert (ev.type, ev.key, ev.value) == ("put", "w/c", b"3")
+        await owner.delete("w/a")
+        ev = await asyncio.wait_for(w.__anext__(), timeout=5)
+        assert (ev.type, ev.key) == ("delete", "w/a")
+        assert w.queue.empty()
+    finally:
+        for cl in (owner, watcher):
+            if cl is not None:
+                await cl.close()
+        await pair.stop()
+
+
+async def test_failover_barrier_rendezvous_spans_promotion():
+    """A 2-worker barrier rendezvous straddling the failover completes:
+    leader + worker1 check in on the old primary, the primary dies, and
+    worker2's check-in lands on the promoted standby."""
+    from dynamo_tpu.runtime.barrier import leader_barrier, worker_barrier
+    from dynamo_tpu.runtime.runtime import DistributedRuntime
+
+    pair = await CoordinatorPair(promote_after_s=0.4).start()
+    drts = []
+    try:
+        for _ in range(3):
+            drts.append(await DistributedRuntime.create(
+                coordinator=pair.addresses))
+        leader = asyncio.ensure_future(
+            leader_barrier(drts[0], "b1", {"cfg": 7}, num_workers=2,
+                           timeout=30))
+        w1 = asyncio.ensure_future(
+            worker_barrier(drts[1], "b1", "w1", timeout=30))
+        # wait until worker1's check-in is replicated, so the rendezvous
+        # genuinely straddles the outage
+        deadline = asyncio.get_running_loop().time() + 5
+        while not any(k.startswith("barrier/b1/workers/")
+                      for k in pair.standby._kv):
+            assert asyncio.get_running_loop().time() < deadline
+            await asyncio.sleep(0.02)
+        await pair.kill9_primary()
+        await pair.wait_promoted()
+        # the late worker joins on the NEW primary (calls fail fast while
+        # its client is mid-resync, so wait until a call goes through —
+        # the client may or may not have finished its walk already)
+        deadline = asyncio.get_running_loop().time() + 10
+        while True:
+            try:
+                await drts[2].coord.ping()
+                break
+            except ConnectionError:
+                assert asyncio.get_running_loop().time() < deadline
+                await asyncio.sleep(0.05)
+        w2 = asyncio.ensure_future(
+            worker_barrier(drts[2], "b1", "w2", timeout=30))
+        results = await asyncio.wait_for(
+            asyncio.gather(leader, w1, w2), timeout=30)
+        assert results[1] == {"cfg": 7} and results[2] == {"cfg": 7}
+    finally:
+        for drt in drts:
+            await drt.close()
+        await pair.stop()
+
+
 async def test_wiped_restart_does_not_adopt_foreign_lease():
     """After a wiped restart, the server's restarted id counter can hand a
     NEW client's lease the same number an old client held. The old client's

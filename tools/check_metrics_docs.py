@@ -29,16 +29,20 @@ def registered_metric_names() -> "set[str]":
     """Every series name the in-tree registries expose, in the form an
     operator sees on /metrics (counters carry their _total suffix)."""
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
-    from dynamo_tpu.http.metrics import CoordClientMetrics, FrontendMetrics
+    from dynamo_tpu.http.metrics import (CoordClientMetrics,
+                                         CoordinatorMetrics, FrontendMetrics)
     from dynamo_tpu.worker.metrics import WorkerMetrics
 
     names: set = set()
     fm = FrontendMetrics()
-    # coordinator-health collector samples a live client; a stub with the
-    # same surface lets collect() run
+    # coordinator-health collectors sample live objects; stubs with the
+    # same surface let collect() run
     CoordClientMetrics(types.SimpleNamespace(
         connected=True, reconnects_total=0, resyncs_total=0,
         last_outage_s=0.0), registry=fm.registry)
+    CoordinatorMetrics(types.SimpleNamespace(
+        role="primary", failovers_total=0, replication_lag_ops=0,
+        standbys_attached=0), registry=fm.registry)
     for registry in (fm.registry, WorkerMetrics().registry):
         for family in registry.collect():
             if family.type == "counter":
