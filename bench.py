@@ -647,6 +647,169 @@ async def _measure_mesh_sharded(wd=None) -> dict:
     return result
 
 
+CONSTR_SEQS = int(os.environ.get("BENCH_CONSTR_SEQS", "4"))
+CONSTR_PROMPT = int(os.environ.get("BENCH_CONSTR_PROMPT", "16"))
+CONSTR_GEN = int(os.environ.get("BENCH_CONSTR_GEN", "48"))
+
+
+async def _measure_constrained_decode(wd=None) -> dict:
+    """Constrained-decode leg: penalties, logit bias, and guided decoding
+    riding the fused multistep block, measured as a same-run
+    fused-vs-per-step A/B on a MIXED cohort (plain + penalized + biased +
+    guided rows in one batch) plus an unconstrained fused baseline.
+
+    Records tok/s, dispatches/token, and the per-reason fallback deltas;
+    the acceptance gate is {penalties, guided} == 0 on the fused
+    constrained leg with tok/s within ~1.3x of the unconstrained cohort.
+    ``BENCH_CONSTRAINED_OUT`` names a standalone artifact
+    (``BENCH_constrained_r08.json``)."""
+    import numpy as np
+
+    from dynamo_tpu.engine.jax_engine import JaxEngine, JaxEngineConfig
+    from dynamo_tpu.models.config import ModelConfig
+    from dynamo_tpu.preprocessor.tokenizer import HfTokenizer
+    from dynamo_tpu.protocols.common import (
+        PreprocessedRequest, SamplingOptions, StopConditions)
+    from dynamo_tpu.utils.testing import make_test_tokenizer
+
+    if wd is not None:
+        wd.arm("measure:constrained", STAGE_BUDGETS["measure"])
+    seqs, prompt, gen = CONSTR_SEQS, CONSTR_PROMPT, CONSTR_GEN
+    page = 4
+    tok = HfTokenizer(make_test_tokenizer())
+    eos = tok.token_to_id("<eos>")
+    cfg = ModelConfig.tiny(vocab_size=512, dtype="float32")
+    engine = JaxEngine.random_init(cfg, JaxEngineConfig(
+        num_pages=seqs * ((prompt + gen) // page + 2) + 16,
+        page_size=page, max_num_seqs=seqs,
+        max_prefill_chunk=min(64, prompt), max_prefill_seqs=seqs,
+        max_context=prompt + gen + 32,
+        min_prefill_bucket=min(16, prompt), min_decode_bucket=seqs,
+        # size the ring buffer for the cohort: every generated token is a
+        # distinct window entry in the worst case, so W < gen would
+        # exhaust mid-run and the row would degrade to per-step
+        penalty_window=2 * gen))
+    engine.enable_guided(tok.token_bytes(), [eos])
+
+    schema = {"type": "object",
+              "properties": {"mood": {"enum": ["up", "dn"]},
+                             "n": {"type": "integer"}},
+              "required": ["mood", "n"]}
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(1, 256, size=prompt).tolist()
+               for _ in range(seqs)]
+
+    MIXED = ("plain", "penalized", "biased", "guided")
+
+    def cohort(label: str, kinds):
+        rows = []
+        for i in range(seqs):
+            kind = kinds[i % len(kinds)]
+            sopts, eos_ids, ign = {}, [], True
+            if kind == "penalized":
+                sopts = dict(frequency_penalty=0.8,
+                             repetition_penalty=1.3)
+            elif kind == "biased":
+                sopts = dict(logit_bias={19: 2.5, 47: -100.0})
+            elif kind == "guided":
+                sopts = dict(guided={"mode": "json_schema",
+                                     "schema": schema})
+                eos_ids, ign = [eos], False
+            rows.append(PreprocessedRequest(
+                token_ids=prompts[i], request_id=f"c{label}{i}",
+                stop_conditions=StopConditions(max_tokens=gen,
+                                               ignore_eos=ign),
+                sampling_options=SamplingOptions(temperature=0.0,
+                                                 **sopts),
+                eos_token_ids=eos_ids))
+        return rows
+
+    async def leg(label: str, kinds) -> dict:
+        fb0 = dict(engine.scheduler.multistep_fallbacks)
+        tokens: dict = {}
+
+        async def drive(i: int, req) -> None:
+            out = []
+            async for f in engine.generate(req):
+                assert f.error is None, f.error
+                out.extend(f.token_ids)
+            tokens[i] = out
+
+        rows = cohort(label, kinds)
+        d0, b0 = engine.decode_dispatches, engine.multistep_blocks
+        t0 = time.perf_counter()
+        await asyncio.gather(*[drive(i, r) for i, r in enumerate(rows)])
+        wall = time.perf_counter() - t0
+        total = sum(len(t) for t in tokens.values())
+        fb1 = engine.scheduler.multistep_fallbacks
+        return {
+            "tok_s": round(total / wall, 1),
+            "decode_dispatches_per_token": round(
+                (engine.decode_dispatches - d0) / max(1, total), 4),
+            "fused_blocks": engine.multistep_blocks - b0,
+            "fallback_deltas": {
+                k: fb1.get(k, 0) - fb0.get(k, 0)
+                for k in set(fb0) | set(fb1)
+                if fb1.get(k, 0) != fb0.get(k, 0)},
+            "tokens": tokens,
+        }
+
+    PLAIN, GUIDED = ("plain",), ("plain", "plain", "plain", "guided")
+    try:
+        # two warm passes per cohort: some decode shapes (batch tails,
+        # chained-block restarts) only compile on the second pass
+        for lb, kinds in (("w", MIXED), ("w2", MIXED), ("wu", PLAIN),
+                          ("wu2", PLAIN), ("wg", GUIDED), ("wg2", GUIDED)):
+            await leg(lb, kinds)
+        fused = await leg("f", MIXED)
+        plain = await leg("u", PLAIN)
+        guided = await leg("g", GUIDED)
+        ms_saved = engine.multistep
+        engine.multistep = 1              # same-run per-step A/B
+        try:
+            await leg("wp", MIXED)        # warm the per-step programs
+            await leg("wp2", MIXED)
+            perstep = await leg("p", MIXED)
+        finally:
+            engine.multistep = ms_saved
+    finally:
+        await engine.stop()
+
+    parity = fused["tokens"] == perstep["tokens"]
+    for d in (fused, plain, guided, perstep):
+        d.pop("tokens")
+    result = {
+        "geometry": [seqs, prompt, gen],
+        "decode_multistep": int(ms_saved),
+        "fused_constrained": fused,
+        "fused_unconstrained": plain,
+        "fused_guided_cohort": guided,
+        "perstep_constrained": perstep,
+        "fused_speedup": (round(fused["tok_s"] / perstep["tok_s"], 3)
+                          if perstep["tok_s"] > 0 else None),
+        "constrained_vs_plain": (
+            round(plain["tok_s"] / fused["tok_s"], 3)
+            if fused["tok_s"] > 0 else None),
+        "guided_vs_plain": (
+            round(plain["tok_s"] / guided["tok_s"], 3)
+            if guided["tok_s"] > 0 else None),
+        "token_parity": parity,
+        "constrained_fallbacks": {
+            k: fused["fallback_deltas"].get(k, 0)
+            for k in ("penalties", "penalty_window", "guided",
+                      "guided_table")},
+    }
+    _ckpt("constrained_decode", fused_tok_s=fused["tok_s"],
+          plain_tok_s=plain["tok_s"], perstep_tok_s=perstep["tok_s"],
+          parity=parity)
+    out_path = os.environ.get("BENCH_CONSTRAINED_OUT")
+    if out_path:
+        with open(out_path, "w") as fh:
+            json.dump(result, fh, indent=2, sort_keys=True)
+            fh.write("\n")
+    return result
+
+
 # drain-leg geometry: streams in flight when the scale-down lands, and
 # tokens per stream (long enough that every stream straddles the handoff)
 DRAIN_STREAMS = int(os.environ.get("BENCH_DRAIN_STREAMS", "6"))
@@ -1187,6 +1350,14 @@ async def run_attempt(args) -> dict:
         result["mesh_sharded"] = await _measure_mesh_sharded(wd)
     except Exception as e:  # noqa: BLE001 — best-effort extra data
         result["mesh_sharded"] = {"error": str(e)[:300]}
+    print(json.dumps(result), flush=True)
+
+    # constrained-decode leg: penalties / logit bias / guided riding the
+    # fused block — mixed-cohort fused-vs-per-step A/B + fallback deltas
+    try:
+        result["constrained_decode"] = await _measure_constrained_decode(wd)
+    except Exception as e:  # noqa: BLE001 — best-effort extra data
+        result["constrained_decode"] = {"error": str(e)[:300]}
     print(json.dumps(result), flush=True)
 
     # graceful-drain leg: SIGTERM one of two decode workers mid-trace —

@@ -917,6 +917,116 @@ class GuidedRequest:
 
 
 # --------------------------------------------------------------------------
+# dense device table (fused multistep decoding)
+
+
+class GuidedTable:
+    """A grammar lowered to a dense token-granularity transition table.
+
+    The fused multistep block cannot call back into the host automaton
+    between scan steps, so a grammar whose TOKEN-level state machine is
+    small enough is compiled down to two arrays the device can index:
+
+    trans: [S, V] int32 — ``trans[s, t]`` is the state after sampling
+           token ``t`` in state ``s``. Disallowed tokens self-loop (the
+           mask makes them unsampleable, the entry is never read live);
+           EOS ids self-loop too, mirroring ``GuidedRequest.advance``'s
+           EOS no-op.
+    masks: [S, words] uint32 — packed allow-mask per state, bit-identical
+           to ``GuidedVocab.mask`` for the same automaton state (the
+           per-step path and the fused path must reject exactly the same
+           tokens or parity breaks).
+
+    State 0 is always the grammar's initial state. The engine batches
+    tables by concatenating them at offsets behind a shared all-ones
+    sentinel row, so unconstrained rows ride the same gather.
+    """
+
+    __slots__ = ("trans", "masks")
+
+    def __init__(self, trans: np.ndarray, masks: np.ndarray):
+        self.trans = trans
+        self.masks = masks
+
+    @property
+    def nbytes(self) -> int:
+        return self.trans.nbytes + self.masks.nbytes
+
+    @property
+    def num_states(self) -> int:
+        return self.trans.shape[0]
+
+
+def build_guided_table(g: Grammar, vocab: GuidedVocab,
+                       byte_cap: int) -> Optional[GuidedTable]:
+    """BFS the token-granularity state machine of ``g`` into a dense table.
+
+    Each automaton state costs one trie walk: the byte-automaton state at
+    the trie node where a token's bytes end IS the post-token state (the
+    walk resolves literal completions inline exactly as ``step`` does), so
+    allowed tokens and their successor states come out of the same pass
+    that ``GuidedVocab.mask`` uses — no per-token byte replay.
+
+    Returns ``None`` when the grammar is not tableable:
+
+    - the state count would exceed ``byte_cap`` worth of table (open-ended
+      grammars like ``{"mode": "json"}`` nest unboundedly and always trip
+      this) — the scheduler then falls back per-row with reason
+      ``guided_table``;
+    - some reachable state has an empty allow-mask (the per-step path
+      wedges and drops the constraint there; a device table has no wedge,
+      so such grammars stay on the host path).
+    """
+    V = vocab.trie.vocab_size
+    words = vocab.words
+    s_max = max(1, byte_cap // (4 * V + 4 * words))
+    init = initial_state(g)
+    ids: Dict[State, int] = {init: 0}
+    order: List[State] = [init]
+    trans_rows: List[np.ndarray] = []
+    mask_rows: List[np.ndarray] = []
+
+    sid = 0
+    while sid < len(order):
+        state = order[sid]
+        row = np.full(V, sid, np.int32)
+        mask = np.zeros(words, np.uint32)
+
+        def intern(st: State) -> int:
+            nid = ids.get(st)
+            if nid is None:
+                nid = len(order)
+                ids[st] = nid
+                order.append(st)
+            return nid
+
+        def walk(node, st: State) -> None:
+            for tid in node[1]:
+                mask[tid >> 5] |= np.uint32(1 << (tid & 31))
+                row[tid] = intern(st)
+            for b, child in node[0].items():
+                st2 = step(g, st, b)
+                if st2 is not None:
+                    walk(child, st2)
+
+        for b, child in vocab.trie.root[0].items():
+            st2 = step(g, state, b)
+            if st2 is not None:
+                walk(child, st2)
+        if eos_ok(g, state):
+            for e in vocab.eos_ids:
+                mask[e >> 5] |= np.uint32(1 << (e & 31))
+        if not mask.any():
+            return None
+        if len(order) > s_max:
+            return None
+        trans_rows.append(row)
+        mask_rows.append(mask)
+        sid += 1
+    return GuidedTable(np.stack(trans_rows), np.stack(mask_rows))
+
+
+# --------------------------------------------------------------------------
 # grammar construction / cache
 
 
@@ -931,4 +1041,5 @@ def compile_guided(spec: Dict[str, Any]) -> Grammar:
 
 
 __all__ = ["Grammar", "GuidedVocab", "GuidedRequest", "GuidedUnsupported",
-           "TokenTrie", "compile_guided", "initial_state", "step", "eos_ok"]
+           "GuidedTable", "build_guided_table", "TokenTrie",
+           "compile_guided", "initial_state", "step", "eos_ok"]

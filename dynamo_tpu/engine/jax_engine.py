@@ -74,6 +74,12 @@ class JaxEngineConfig:
     # beyond W distinct penalizable ids keep the most frequent W.
     # 0 disables the penalty inputs entirely.
     penalty_window: int = 32
+    # guided decoding on the FUSED multistep path: a grammar whose dense
+    # token-level transition table (engine/guided.build_guided_table)
+    # fits under this byte cap runs inside the fused block; larger (or
+    # unbounded — {"mode": "json"} nests forever) grammars fall back
+    # per-row to per-step decode with fallback reason "guided_table".
+    guided_table_bytes: int = 8 << 20
     seed: int = 0
     # attention implementation:
     #   "scan"     — lax.scan over layers, stacked cache, XLA attention
@@ -277,6 +283,12 @@ class JaxEngine(ScheduledEngineBase):
                 int(self.cfg.decode_progress_every)
                 if self.cfg.decode_progress_every is not None
                 else decode_progress_default()))
+        # fused-path gates for penalized/guided rows: the scheduler
+        # narrows block widths by the penalty window's remaining capacity
+        # and asks the engine whether a row's grammar lowered to a device
+        # table (engine-specific knowledge the raw Scheduler lacks)
+        self.scheduler.cfg.penalty_window = self.cfg.penalty_window
+        self.scheduler.cfg.guided_fuse_check = self._guided_fuse_check
         self.params = params
         from dynamo_tpu.models import get_family
         family = get_family(model_cfg)
@@ -445,6 +457,22 @@ class JaxEngine(ScheduledEngineBase):
         self._guided_reqs: dict = {}
         self._grammar_cache: dict = {}
         self._grammar_lock = threading.Lock()
+        # fused guided decoding: lowered device tables per grammar (None =
+        # not tableable), keyed like _grammar_cache and guarded by the
+        # same lock
+        self._guided_tables: dict = {}
+        # host-side automaton mirrors for the post-block parity
+        # cross-check — owned by the EVENT-LOOP thread only (the step
+        # thread owns _guided_reqs; GuidedRequest objects are never
+        # shared across the two)
+        self._guided_mirrors: dict = {}
+        self.guided_parity_mismatches = 0
+        # cancel/finish release: the event-loop thread records finished
+        # request ids; the step thread drains them before assembling the
+        # next device-sampling batch so a dead row's FSM/ring-buffer
+        # state cannot linger in the composition-keyed caches
+        self._released: set = set()
+        self._released_lock = threading.Lock()
 
     # -- guided decoding ---------------------------------------------------
 
@@ -480,6 +508,16 @@ class JaxEngine(ScheduledEngineBase):
             self._grammar_for(spec)
         except Exception as e:  # noqa: BLE001 — surface compile errors
             return f"response_format rejected: {e}"
+        try:
+            # pre-lower the fused-path table here (event-loop thread, per
+            # grammar, cached) so the step thread never pays the BFS; a
+            # non-tableable grammar is NOT an error — the row just decodes
+            # per-step (fallback reason "guided_table")
+            self._guided_table_for(spec)
+        except Exception:  # noqa: BLE001 — table lowering is best-effort
+            logger.warning("guided table lowering failed; request %s "
+                           "decodes per-step", request.request_id,
+                           exc_info=True)
         return None
 
     def _grammar_for(self, spec: dict):
@@ -500,6 +538,104 @@ class JaxEngine(ScheduledEngineBase):
                         next(iter(self._grammar_cache)), None)
                 g = self._grammar_cache.setdefault(key, g)
         return g
+
+    def _guided_table_for(self, spec: dict):
+        """Lowered device transition table for a grammar, or None when it
+        is not tableable (state count over ``guided_table_bytes``, or a
+        reachable empty-mask state). Cached beside the grammar cache under
+        the same lock; normally warmed by ``validate_request`` on the
+        event-loop thread so the step thread only ever reads."""
+        import json as _json
+
+        from dynamo_tpu.engine.guided import build_guided_table
+        key = _json.dumps(spec, sort_keys=True)
+        with self._grammar_lock:
+            if key in self._guided_tables:
+                return self._guided_tables[key]
+        table = build_guided_table(self._grammar_for(spec),
+                                   self._guided_vocab,
+                                   self.cfg.guided_table_bytes)
+        with self._grammar_lock:
+            if len(self._guided_tables) >= 64:
+                self._guided_tables.pop(
+                    next(iter(self._guided_tables)), None)
+            if key not in self._guided_tables:
+                self._guided_tables[key] = table
+            return self._guided_tables[key]
+
+    def _guided_fuse_check(self, seq) -> bool:
+        """Scheduler hook: may this guided row ride a fused multistep
+        block? True iff its grammar lowered to a device table."""
+        spec = seq.request.sampling_options.guided
+        if not spec or self._guided_vocab is None:
+            return False
+        try:
+            return self._guided_table_for(spec) is not None
+        except Exception:  # noqa: BLE001 — a lowering bug must not
+            return False   # break planning; the row decodes per-step
+
+    def release_request(self, rid) -> None:
+        """A request left the scheduler (finished or cancelled). Drop its
+        event-loop-side automaton mirror now and queue the step-thread
+        state (``_guided_reqs`` entry, composition-keyed sampling cache)
+        for release at the next batch assembly — the two threads never
+        touch each other's objects."""
+        self._guided_mirrors.pop(rid, None)
+        with self._released_lock:
+            self._released.add(rid)
+
+    def multistep_guided_check(self, seq) -> None:
+        """Post-block guided parity cross-check (event-loop thread).
+
+        The fused block enforces the grammar with the DEVICE table; this
+        re-derives the automaton on the host from the committed tokens and
+        verifies each one is byte-walk legal (EOS: ``eos_ok``). The mirror
+        set here is separate from the step thread's ``_guided_reqs`` and
+        legality runs on the pure ``step``/``eos_ok`` walkers, never
+        ``GuidedVocab.mask`` (its cache eviction is not thread-safe). A
+        mismatch means device/host state divergence: counted on
+        ``guided_parity_mismatches`` and logged, and the mirror wedges so
+        one divergence is reported once."""
+        spec = seq.request.sampling_options.guided
+        if not spec or self._guided_vocab is None:
+            return
+        from dynamo_tpu.engine.guided import GuidedRequest, eos_ok
+        rid = seq.request.request_id
+        gen = seq.generated
+        gr = self._guided_mirrors.get(rid)
+        if gr is None or gr.n_seen > len(gen):
+            try:
+                gr = GuidedRequest(self._grammar_for(spec),
+                                   self._guided_vocab, self._guided_bytes)
+            except Exception:  # noqa: BLE001 — mirror is best-effort
+                return
+            self._guided_mirrors[rid] = gr
+        new = gen[gr.n_seen:]
+        gr.n_seen = len(gen)
+        ok = True
+        for t in new:
+            if gr.wedged:
+                return
+            t = int(t)
+            if t in self._guided_vocab.eos_ids:
+                if not eos_ok(gr.grammar, gr.state):
+                    ok = False
+                    break
+                continue          # host advance no-ops EOS
+            gr.advance(t)
+            if gr.wedged:
+                ok = False
+                break
+        if not ok:
+            self.guided_parity_mismatches += 1
+            gr.wedged = True
+            logger.warning(
+                "fused guided block committed a grammar-illegal token for "
+                "%s: device table and host automaton diverged", rid)
+        if len(self._guided_mirrors) > 4 * self.cfg.max_num_seqs:
+            stale = sorted(self._guided_mirrors)
+            for k in stale[:len(stale) // 2]:
+                self._guided_mirrors.pop(k, None)
 
     def _guided_req_for(self, seq, spec: dict):
         """Get-or-(re)build the per-request automaton and sync it to the
@@ -677,7 +813,7 @@ class JaxEngine(ScheduledEngineBase):
 
     def _multistep_impl(self, params, pages, tok, pos, table, total, alive,
                         budget, min_gate, rng, step0, temperature, top_k,
-                        top_p, stop_ids, pen=None, n_steps=1):
+                        top_p, stop_ids, pen=None, pcarry=None, n_steps=1):
         """FUSED decode: ``n_steps`` decode steps in one jitted program —
         a ``lax.scan`` over the step body with donated ``pages`` carry,
         on-device sampling (``ops/sampling.sample_tokens``, the same
@@ -694,6 +830,19 @@ class JaxEngine(ScheduledEngineBase):
         sequences stop writing KV), position/total freeze, and its later
         sampled slots are garbage the host never reads (it re-derives the
         identical stop point from the same rules).
+
+        Penalized/biased/guided rows ride the same block (no per-batch
+        fallback): ``pcarry`` carries each row's penalty ring-buffer
+        window (ids/cnt/ctx/bias/n — preloaded host-side on a fresh
+        block, chained on device afterwards) and its guided automaton
+        state id; ``pen`` carries the batch-static pieces (per-row knobs,
+        the 2W prompt-reproduction list under ``pw``, the batched
+        grammar transition table/masks under ``gt``). Per step the body
+        applies penalties + bias over the window ∪ prompt entries, the
+        grammar allow-mask LAST (same order as ``_sample_tail``), then
+        absorbs the sampled token into the window and steps the
+        automaton. The per-step path rebuilds the identical entry SET
+        host-side each step, so fused vs per-step stays bit-identical.
 
         Returns (pages, packed [B, n_steps, 2+2K] — per-step rows in the
         exact ``_sample_tail`` column layout so the host unpack is shared
@@ -717,14 +866,58 @@ class JaxEngine(ScheduledEngineBase):
             c = jax.lax.with_sharding_constraint
             stop_ids = c(stop_ids, mat)
             budget, min_gate = c(budget, row), c(min_gate, row)
+            if pcarry is not None:
+                pcarry = {k: c(v, mat if v.ndim == 2 else row)
+                          for k, v in pcarry.items()}
+        B = tok.shape[0]
+        pw = pen.get("pw") if pen is not None else None
+        gt = pen.get("gt") if pen is not None else None
+        if pcarry is not None:
+            pids0 = pcarry["pids"]
+            pcnt0, pctx0 = pcarry["pcnt"], pcarry["pctx"]
+            pbias0, pn0 = pcarry["pbias"], pcarry["pn"]
+            gstate0 = pcarry["gstate"]
+        else:
+            # unconstrained trace: zero-filled window/state so every
+            # width's carry output keeps ONE fixed pytree structure (and
+            # one set of out_shardings)
+            W = self.cfg.penalty_window
+            pids0 = jnp.zeros((B, W), jnp.int32)
+            pcnt0 = jnp.zeros((B, W), jnp.float32)
+            pctx0 = jnp.zeros((B, W), jnp.float32)
+            pbias0 = jnp.zeros((B, W), jnp.float32)
+            pn0 = jnp.zeros(B, jnp.int32)
+            gstate0 = jnp.zeros(B, jnp.int32)
 
         def body(carry, j):
-            pages, tok, pos, total, alive = carry
+            (pages, tok, pos, total, alive,
+             pids, pcnt, pctx, pbias, pn, gstate) = carry
             new = alive.astype(jnp.int32)
             logits, pages, aux = self._decode_forward(
                 params, pages, tok, pos, table, total, new)
             logits = logits.astype(jnp.float32)
             key = jax.random.fold_in(rng, step0 + j)
+            if pw is not None:
+                # dynamic window ∪ prompt-reproduction entries, one
+                # scatter-add (excluded/pad entries carry a zero delta)
+                from dynamo_tpu.ops.sampling import (apply_penalties,
+                                                     penalty_window_entries)
+                inc = penalty_window_entries(
+                    pw["prompt_ids"], pw["prompt_valid"], pids, pn)
+                zs = jnp.zeros(inc.shape, jnp.float32)
+                logits = apply_penalties(
+                    logits,
+                    jnp.concatenate([pids, pw["prompt_ids"]], axis=1),
+                    jnp.concatenate([pcnt, zs], axis=1),
+                    jnp.concatenate([pctx, inc.astype(jnp.float32)],
+                                    axis=1),
+                    pw["fp"], pw["pp"], pw["rp"],
+                    pen_bias=jnp.concatenate([pbias, zs], axis=1))
+            if gt is not None:
+                # grammar allow-mask LAST: a penalty/bias can reweight
+                # inside the grammar but never resurrect an illegal token
+                from dynamo_tpu.ops.sampling import apply_vocab_mask
+                logits = apply_vocab_mask(logits, gt["masks"][gstate])
             if pen is not None:
                 sampled, logprobs = sample_tokens(
                     logits, key, temperature, top_k, top_p,
@@ -737,6 +930,8 @@ class JaxEngine(ScheduledEngineBase):
                     jax.lax.bitcast_convert_type(logprobs,
                                                  jnp.int32)[:, None]]
             if self.cfg.num_top_logprobs > 0:
+                # from the PENALIZED/MASKED logits — the distribution
+                # actually sampled from, as _sample_tail reports
                 ids, lp_bits = self._topk_cols(logits)
                 cols.append(ids)
                 cols.append(lp_bits)
@@ -748,16 +943,34 @@ class JaxEngine(ScheduledEngineBase):
             tok = jnp.where(alive[:, None], sampled[:, None], tok)
             pos = pos + new[:, None]
             total = total + new
+            if pw is not None:
+                # the sampled token joins the row's penalized set for the
+                # NEXT step (the per-step path recounts generated tokens
+                # including it next dispatch)
+                from dynamo_tpu.ops.sampling import update_penalty_window
+                pids, pcnt, pctx, pn = update_penalty_window(
+                    pids, pcnt, pctx, pn, sampled,
+                    alive & pw["active"])
+            if gt is not None:
+                # EOS rows self-loop in the table (the host advance
+                # no-ops EOS); dead rows freeze
+                gstate = jnp.where(alive, gt["trans"][gstate, sampled],
+                                   gstate)
             drops = aux.get("moe_dropped_assignments",
                             jnp.zeros((), jnp.int32))
-            return (pages, tok, pos, total, new_alive), (packed, drops)
+            return ((pages, tok, pos, total, new_alive,
+                     pids, pcnt, pctx, pbias, pn, gstate), (packed, drops))
 
-        (pages, tok, pos, total, alive), (steps, drops) = jax.lax.scan(
-            body, (pages, tok, pos, total, alive),
+        (pages, tok, pos, total, alive, pids, pcnt, pctx, pbias, pn,
+         gstate), (steps, drops) = jax.lax.scan(
+            body, (pages, tok, pos, total, alive,
+                   pids0, pcnt0, pctx0, pbias0, pn0, gstate0),
             jnp.arange(n_steps, dtype=jnp.int32))
         carry = {"tok": tok, "pos": pos, "total": total, "alive": alive,
                  "budget": budget - n_steps,
-                 "min_gate": min_gate - n_steps}
+                 "min_gate": min_gate - n_steps,
+                 "pids": pids, "pcnt": pcnt, "pctx": pctx, "pbias": pbias,
+                 "pn": pn, "gstate": gstate}
         return (pages, jnp.moveaxis(steps, 0, 1), carry,
                 jnp.sum(drops.astype(jnp.int32)))
 
@@ -785,7 +998,9 @@ class JaxEngine(ScheduledEngineBase):
                         lambda x: x.sharding, self.pages)
                     carry_sh = {k: rep for k in ("tok", "pos", "total",
                                                  "alive", "budget",
-                                                 "min_gate")}
+                                                 "min_gate", "pids",
+                                                 "pcnt", "pctx", "pbias",
+                                                 "pn", "gstate")}
                     kw["out_shardings"] = (pages_sh, rep, carry_sh, rep)
             fn = jax.jit(functools.partial(self._multistep_impl, n_steps=w),
                          donate_argnums=(1,), **kw)
@@ -934,6 +1149,70 @@ class JaxEngine(ScheduledEngineBase):
 
     # -- plan -> device arrays --------------------------------------------
 
+    def _penalty_row(self, seq, W: int):
+        """One row's penalty/bias window material — the ONE builder both
+        the per-step host path and the fused block's fresh-dispatch
+        preload derive from, so the two paths always hold the same entry
+        set (``apply_penalties`` is entry-ORDER independent: equal sets
+        give bit-identical logits).
+
+        Returns None for rows without penalties/bias, else a dict:
+
+        entries:   [(token, generated-count, in-context)] — logit_bias
+                   tokens first (explicit client asks win the window),
+                   then every distinct generated token by frequency. NOT
+                   truncated to W here; per-step callers truncate after
+                   prompt backfill, the fused planner's width gate
+                   guarantees the block never outgrows W.
+        prestatic: deduped reversed-prompt token list capped at 2W (at
+                   most W of the first 2W distinct prompt tokens can
+                   collide with a W-sized window, so W always survive
+                   the ``have`` filter) — the repetition-penalty prompt
+                   backfill source; empty unless rep_on.
+        lb/fp/pp/rp/rep_on: the row's raw knobs.
+
+        Migration replay/resume: the trailing ``resumed_tokens`` of the
+        prompt were GENERATED by earlier legs of this stream —
+        frequency/presence penalties must keep counting them, not
+        reclassify them as prompt after the hop."""
+        so = seq.request.sampling_options
+        f = so.frequency_penalty or 0.0
+        p = so.presence_penalty or 0.0
+        r = so.repetition_penalty
+        rep_on = r is not None and r > 0 and r != 1.0
+        lb = so.logit_bias or {}
+        if W <= 0 or not (f or p or rep_on or lb):
+            return None
+        from collections import Counter
+        counts = Counter(seq.generated)
+        n_prompt = seq.num_prompt - min(
+            seq.request.resumed_tokens or 0, seq.num_prompt)
+        if n_prompt < seq.num_prompt:
+            counts.update(seq.tokens.tokens()[n_prompt:seq.num_prompt])
+        prompt_set = (set(seq.tokens.tokens()[:n_prompt])
+                      if rep_on else set())
+        # entry = (token, generated-count, in-context). A token in
+        # several roles gets ONE entry carrying its count, context flag,
+        # and bias.
+        entries = [(t, counts.get(t, 0), t in counts or t in prompt_set)
+                   for t in list(lb)[:W]]
+        have = {t for t, _c, _x in entries}
+        for t, c in counts.most_common(W):
+            if t not in have:
+                entries.append((t, c, True))
+                have.add(t)
+        prestatic: list = []
+        if rep_on:
+            seen: set = set()
+            for t in reversed(seq.tokens.tokens()[:seq.num_prompt]):
+                if t not in seen:
+                    seen.add(t)
+                    prestatic.append(t)
+                    if len(prestatic) >= 2 * W:
+                        break
+        return dict(entries=entries, prestatic=prestatic, lb=lb, fp=f,
+                    pp=p, rp=(r if rep_on else 1.0), rep_on=rep_on)
+
     def _sampling_extras(self, rows, B: int) -> dict:
         """Per-row penalty/bias windows + seeds (numpy, merged into the
         step's host arrays). ``rows[i]`` is the Sequence for batch row i
@@ -961,49 +1240,25 @@ class JaxEngine(ScheduledEngineBase):
             if so.min_p:
                 min_p[i] = so.min_p
                 any_active = True
-            f = so.frequency_penalty or 0.0
-            p = so.presence_penalty or 0.0
-            r = so.repetition_penalty
-            rep_on = r is not None and r > 0 and r != 1.0
-            lb = so.logit_bias or {}
-            if W <= 0 or not (f or p or rep_on or lb):
+            row = self._penalty_row(seq, W)
+            if row is None:
                 continue
             any_active = True
-            fp[i], pp[i] = f, p
-            if rep_on:
-                rp[i] = r
-            from collections import Counter
-            counts = Counter(seq.generated)
-            # migration replay/resume: the trailing ``resumed_tokens`` of
-            # the prompt were GENERATED by earlier legs of this stream —
-            # frequency/presence penalties must keep counting them, not
-            # reclassify them as prompt after the hop
-            n_prompt = seq.num_prompt - min(
-                seq.request.resumed_tokens or 0, seq.num_prompt)
-            if n_prompt < seq.num_prompt:
-                counts.update(seq.tokens.tokens()[n_prompt:seq.num_prompt])
-            prompt_set = (set(seq.tokens.tokens()[:n_prompt])
-                          if rep_on else set())
-            # entry = (token, generated-count, in-context). logit_bias
-            # entries come FIRST (explicit client asks win the window),
-            # then penalized tokens by frequency, then — for repetition —
-            # prompt tokens (most recent first). A token in several roles
-            # gets ONE entry carrying its count, context flag, and bias.
-            entries = [(t, counts.get(t, 0),
-                        t in counts or t in prompt_set)
-                       for t in list(lb)[:W]]
+            fp[i], pp[i] = row["fp"], row["pp"]
+            rp[i] = row["rp"]
+            # bias + generated entries first, then — for repetition —
+            # prompt backfill (most recent first) from the shared
+            # prestatic list, to capacity
+            entries = list(row["entries"])
             have = {t for t, _c, _x in entries}
-            for t, c in counts.most_common(W):
-                if t not in have:
-                    entries.append((t, c, True))
-                    have.add(t)
-            if rep_on and len(entries) < W:
-                for t in reversed(seq.tokens.tokens()[:seq.num_prompt]):
+            if row["rep_on"] and len(entries) < W:
+                for t in row["prestatic"]:
                     if t not in have:
                         entries.append((t, 0, True))
                         have.add(t)
                         if len(entries) >= W:
                             break
+            lb = row["lb"]
             for j, (t, c, x) in enumerate(entries[:W]):
                 ids[i, j] = t
                 cnt[i, j] = c
@@ -1398,9 +1653,28 @@ class JaxEngine(ScheduledEngineBase):
         batch, rebuilt only when the batch COMPOSITION changes (the cache
         key) instead of re-uploaded every step: temperature/top_k/top_p,
         the padded EOS+stop_token_ids set (-1 pads never match), and —
-        when any row uses them — the static seeds/min_p pen pytree. All
-        of these are constant for a request's lifetime; per-token penalty
-        state is NOT cacheable and keeps the per-step path."""
+        when any row uses them — the pen pytree: seeds/min_p, the
+        batch-static penalty knobs + 2W prompt-reproduction arrays
+        (``pw``), and the batched guided transition table (``gt``). The
+        PER-TOKEN pieces (the dynamic window, the automaton state id)
+        ride the block carry instead — fresh blocks preload them in
+        ``dispatch_multistep``, chained blocks pass them straight
+        through on device."""
+        with self._released_lock:
+            released = self._released
+            if released:
+                self._released = set()
+        if released:
+            # finished/cancelled rows: drop step-thread automata and any
+            # composition cache that still references them, so a dead
+            # guided/penalized row's table and window slots free up even
+            # if an identical-looking batch never re-forms
+            for rid in released:
+                self._guided_reqs.pop(rid, None)
+            cached = self._samp_cache
+            if cached is not None and any(
+                    rid in released for rid, _s in cached[0][1]):
+                self._samp_cache = None
         key = (B, tuple((s.request.request_id, id(s)) for s in seqs))
         cached = self._samp_cache
         if cached is not None and cached[0] == key:
@@ -1412,6 +1686,15 @@ class JaxEngine(ScheduledEngineBase):
         min_p = np.zeros(B, np.float32)
         pen_active = False
         stop_lists = []
+        W = self.cfg.penalty_window
+        pfp = np.zeros(B, np.float32)
+        ppp = np.zeros(B, np.float32)
+        prp = np.ones(B, np.float32)
+        pact = np.zeros(B, bool)
+        prompt_ids = np.zeros((B, 2 * max(W, 1)), np.int32)
+        prompt_valid = np.zeros((B, 2 * max(W, 1)), bool)
+        pw_active = False
+        guided_specs: dict = {}
         for i, seq in enumerate(seqs):
             so = seq.request.sampling_options
             if so.temperature is not None:
@@ -1426,6 +1709,26 @@ class JaxEngine(ScheduledEngineBase):
             if so.min_p:
                 min_p[i] = so.min_p
                 pen_active = True
+            f = so.frequency_penalty or 0.0
+            p = so.presence_penalty or 0.0
+            r = so.repetition_penalty
+            rep_on = r is not None and r > 0 and r != 1.0
+            if W > 0 and (f or p or rep_on or so.logit_bias):
+                pw_active = pen_active = True
+                pact[i] = True
+                pfp[i], ppp[i] = f, p
+                if rep_on:
+                    prp[i] = r
+                    row = self._penalty_row(seq, W)
+                    ps = row["prestatic"]
+                    prompt_ids[i, :len(ps)] = ps
+                    prompt_valid[i, :len(ps)] = True
+            spec = so.guided
+            if spec and self._guided_vocab is not None:
+                table = self._guided_table_for(spec)
+                gr = self._guided_req_for(seq, spec)
+                if table is not None and not gr.wedged:
+                    guided_specs[i] = (spec, table)
             sc = seq.request.stop_conditions
             ids = list(sc.stop_token_ids or [])
             if not sc.ignore_eos:
@@ -1436,14 +1739,98 @@ class JaxEngine(ScheduledEngineBase):
         stop_ids = np.full((B, E), -1, np.int32)
         for i, ids in enumerate(stop_lists):
             stop_ids[i, :len(ids)] = ids
+        pen = None
+        gt_host = None
+        if pen_active or guided_specs:
+            pen = {"seeds": jnp.asarray(seeds), "min_p": jnp.asarray(min_p)}
+            if pw_active:
+                pen["pw"] = {
+                    "fp": jnp.asarray(pfp), "pp": jnp.asarray(ppp),
+                    "rp": jnp.asarray(prp), "active": jnp.asarray(pact),
+                    "prompt_ids": jnp.asarray(prompt_ids),
+                    "prompt_valid": jnp.asarray(prompt_valid),
+                }
+            if guided_specs:
+                # batch the distinct tables behind sentinel state 0
+                # (all-ones mask, self-loop): unguided/wedged rows sit at
+                # state 0 and ride the same gather as guided ones
+                gv = self._guided_vocab
+                V = self.model_cfg.vocab_size
+                by_key: dict = {}
+                offsets: dict = {}
+                S = 1
+                for i, (spec, table) in guided_specs.items():
+                    import json as _json
+                    k = _json.dumps(spec, sort_keys=True)
+                    if k not in by_key:
+                        by_key[k] = table
+                        offsets[k] = S
+                        S += table.num_states
+                    offsets[i] = offsets[k]
+                S_pad = 1 << (S - 1).bit_length()
+                trans = np.zeros((S_pad, V), np.int32)
+                masks = np.full((S_pad, gv.words), 0xFFFFFFFF, np.uint32)
+                trans[0] = 0
+                for k, table in by_key.items():
+                    o = offsets[k]
+                    n = table.num_states
+                    trans[o:o + n] = table.trans + o
+                    masks[o:o + n] = table.masks
+                # pad states: unreachable; all-ones masks + self-loops so
+                # an off-by-one could never -inf a whole row
+                for s in range(S, S_pad):
+                    trans[s] = s
+                pen["gt"] = {"trans": jnp.asarray(trans),
+                             "masks": jnp.asarray(masks)}
+                gt_host = {"trans": trans,
+                           "offsets": {i: offsets[i] for i in guided_specs}}
         out = {
             "temp": jnp.asarray(temp), "top_k": jnp.asarray(top_k),
             "top_p": jnp.asarray(top_p), "stop_ids": jnp.asarray(stop_ids),
-            "pen": ({"seeds": jnp.asarray(seeds),
-                     "min_p": jnp.asarray(min_p)} if pen_active else None),
+            "pen": pen,
+            "needs_pcarry": pw_active or bool(guided_specs),
+            "gt_host": gt_host,
         }
         self._samp_cache = (key, out)
         return out
+
+    def _fresh_pcarry(self, seqs, B: int, samp: dict) -> dict:
+        """Preload the per-token block carry for a FRESH constrained
+        block: each penalized/biased row's window (bias + every distinct
+        generated token, from the same ``_penalty_row`` builder the
+        per-step path uses — the width gate guarantees it fits W), and
+        each guided row's automaton state id (the host walks the batched
+        transition table over the row's generated tokens from its
+        grammar's offset; wedged rows were already dropped to sentinel
+        state 0 at composition time)."""
+        W = self.cfg.penalty_window
+        pids = np.zeros((B, W), np.int32)
+        pcnt = np.zeros((B, W), np.float32)
+        pctx = np.zeros((B, W), np.float32)
+        pbias = np.zeros((B, W), np.float32)
+        pn = np.zeros(B, np.int32)
+        gstate = np.zeros(B, np.int32)
+        gt_host = samp.get("gt_host")
+        for i, seq in enumerate(seqs):
+            row = self._penalty_row(seq, W)
+            if row is not None:
+                lb = row["lb"]
+                entries = row["entries"][:W]
+                for j, (t, c, x) in enumerate(entries):
+                    pids[i, j] = t
+                    pcnt[i, j] = c
+                    pctx[i, j] = 1.0 if x else 0.0
+                    pbias[i, j] = lb.get(t, 0.0)
+                pn[i] = len(entries)
+            if gt_host is not None and i in gt_host["offsets"]:
+                s = gt_host["offsets"][i]
+                trans = gt_host["trans"]
+                for t in seq.generated:
+                    s = int(trans[s, int(t)])
+                gstate[i] = s
+        return {"pids": jnp.asarray(pids), "pcnt": jnp.asarray(pcnt),
+                "pctx": jnp.asarray(pctx), "pbias": jnp.asarray(pbias),
+                "pn": jnp.asarray(pn), "gstate": jnp.asarray(gstate)}
 
     def dispatch_multistep(self, plan, prev_handle=None):
         """Dispatch one fused block of ``plan.width`` decode steps;
@@ -1457,10 +1844,17 @@ class JaxEngine(ScheduledEngineBase):
                     self.cfg.max_num_seqs)
         _table_np, table = self._table_arrays(seqs, B)
         samp = self._device_sampling(seqs, B)
+        pcarry = None
         if prev_handle is not None:
             c = prev_handle[1]
             tok, pos, total, alive = c["tok"], c["pos"], c["total"], c["alive"]
             budget, min_gate = c["budget"], c["min_gate"]
+            if samp["needs_pcarry"]:
+                # chained constrained block: window + automaton state stay
+                # on device, straight from the previous block's carry
+                pcarry = {"pids": c["pids"], "pcnt": c["pcnt"],
+                          "pctx": c["pctx"], "pbias": c["pbias"],
+                          "pn": c["pn"], "gstate": c["gstate"]}
         else:
             tok = np.zeros((B, 1), np.int32)
             pos = np.zeros((B, 1), np.int32)
@@ -1475,6 +1869,8 @@ class JaxEngine(ScheduledEngineBase):
                 alive[i] = True
                 budget[i] = plan.budgets[i]
                 min_gate[i] = plan.min_gates[i]
+            if samp["needs_pcarry"]:
+                pcarry = self._fresh_pcarry(seqs, B, samp)
         plan._step_id = self._step_counter
         fn = self._get_jit_multistep(w)
         self.pages, packed_block, carry, drops = fn(
@@ -1482,7 +1878,7 @@ class JaxEngine(ScheduledEngineBase):
             jnp.asarray(table), jnp.asarray(total), jnp.asarray(alive),
             jnp.asarray(budget), jnp.asarray(min_gate), self._rng,
             np.int32(self._step_counter), samp["temp"], samp["top_k"],
-            samp["top_p"], samp["stop_ids"], samp["pen"])
+            samp["top_p"], samp["stop_ids"], samp["pen"], pcarry)
         if self._moe_dispatch_active:
             with self._moe_drops_lock:
                 self._pending_moe_drops.append(drops)
@@ -1523,7 +1919,7 @@ class JaxEngine(ScheduledEngineBase):
                 jnp.zeros(B, jnp.int32), self._rng, np.int32(0),
                 jnp.zeros(B, jnp.float32), jnp.zeros(B, jnp.int32),
                 jnp.ones(B, jnp.float32),
-                jnp.full((B, 1), -1, jnp.int32), None)
+                jnp.full((B, 1), -1, jnp.int32), None, None)
         return out
 
     def fetch_packed_block(self, handle):

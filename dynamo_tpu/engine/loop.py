@@ -213,6 +213,7 @@ class ScheduledEngineBase(EngineBase):
                 kv_transfer_params: Optional[dict] = None,
                 top: Optional[Dict[int, float]] = None) -> None:
         self.scheduler.finish(seq)
+        self.release_request(seq.request.request_id)
         out = LLMEngineOutput(
             token_ids=[token] if token is not None else [],
             log_probs=[logprob] if logprob is not None else None,
@@ -238,6 +239,21 @@ class ScheduledEngineBase(EngineBase):
                 out.timings["multistep_fallbacks"] = float(
                     seq.multistep_fallbacks)
         self._emit(seq, out)
+
+    def release_request(self, request_id: str) -> None:
+        """Per-request device-sampling state teardown hook. Called for
+        every finished/cancelled sequence; the jit engine overrides it to
+        drop the row's guided-FSM / penalty bookkeeping from the device
+        sampling cache (its batch-composition key must change so the next
+        block is not built over a dead row's slot). Base engines keep no
+        such state."""
+
+    def multistep_guided_check(self, seq: Sequence) -> None:
+        """Cross-check hook after a fused block appended tokens to a
+        GUIDED row. The jit engine overrides it to re-derive the row's
+        automaton state on the host (a mirror walk over ``seq.generated``)
+        and flag divergence from the device transition table. Base
+        engines run guided rows per-step only — nothing to check."""
 
     def _accept_token(self, seq: Sequence, token: int, logprob: float,
                       top: Optional[Dict[int, float]] = None) -> None:
@@ -402,6 +418,11 @@ class ScheduledEngineBase(EngineBase):
                 self._accept_token(seq, tok, lp, top_for(i, j, seq))
                 if seq.phase is not Phase.RUNNING:
                     break
+            if seq.request.sampling_options.guided:
+                # host-side automaton walk over what the block actually
+                # appended: catches device/host transition-table drift
+                # before the next block samples from a wrong state
+                self.multistep_guided_check(seq)
         self.scheduler.commit_block(plan)
         events = self.allocator.drain_events()
         if events and self.kv_event_cb is not None:

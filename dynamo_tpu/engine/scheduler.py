@@ -38,7 +38,7 @@ import time
 from collections import deque
 from dataclasses import dataclass, field
 from enum import Enum
-from typing import Deque, Dict, List, Optional, Union
+from typing import Callable, Deque, Dict, List, Optional, Union
 
 import numpy as np
 
@@ -281,6 +281,19 @@ class SchedulerConfig:
     # the legacy alternation path, where bursts may prefer prefill for
     # TTFT. 0 disables the guarantee (strict alternation).
     decode_progress_every: int = 2
+    # device-side penalty ring buffer width per row (tokens tracked for
+    # repetition/presence/frequency penalties inside a fused block).
+    # 0 = no device window: penalized rows refuse fusion ("penalties"
+    # reason), as before. The serving engine sets this from its own
+    # config; the raw Scheduler default keeps host-only behavior.
+    penalty_window: int = 0
+    # set by the engine: returns True when a guided row's grammar has a
+    # device transition table (engine/guided.build_guided_table) so the
+    # row can ride the fused block. None = no device lowering available:
+    # guided rows refuse fusion ("guided" reason, as before); a False
+    # return means the grammar's table exceeded the byte cap and only
+    # that batch falls back, under the "guided_table" reason.
+    guided_fuse_check: Optional[Callable] = None
 
 
 class Scheduler:
@@ -316,8 +329,8 @@ class Scheduler:
         # sequence was admitted) instead of being recomputed
         self.adopted_blocks = 0
         # why the fused multi-step path was refused, by reason (waiters,
-        # prefill, penalties, guided, spec, budget, pages,
-        # multihost): the worker metrics layer surfaces these as
+        # prefill, penalties, penalty_window, guided, guided_table, spec,
+        # budget, pages, multihost): the worker metrics layer surfaces these as
         # dynamo_worker_multistep_fallback_total{reason=...} so the
         # "fallback-reason near zero" roadmap criterion is measurable
         self.multistep_fallbacks: Dict[str, int] = {}
@@ -857,21 +870,57 @@ class Scheduler:
 
     # -- fused multi-step decode --------------------------------------------
 
-    @staticmethod
-    def _fuse_eligible(seq: Sequence) -> bool:
-        """Rows the fused block reproduces exactly. Penalties / logit_bias
-        rewrite logits from host bookkeeping that goes stale within a
-        multi-token dispatch, and guided masks need the automaton walked
-        per token on the host — any such row sends the whole batch down
-        the per-step path (same rule family as ``plan_chained``). Seeds
-        and ``min_p`` ARE eligible: both are static per request and ship
-        to the device (seeded draws key on token position, not step)."""
+    def _fuse_gate(self, seq: Sequence, sl: int):
+        """Admit one row to the fused block, or name the refusal.
+
+        Returns ``(reason, width_cap)``: ``reason`` is a fallback-reason
+        string when the row cannot ride a block (None when it can), and
+        ``width_cap`` bounds the block width for rows whose device-side
+        penalty ring buffer could overflow mid-block.
+
+        Penalized / biased rows ride the block via the device penalty
+        window (``cfg.penalty_window`` slots per row): the fresh-block
+        carry seeds the window with the row's bias ids and distinct
+        generated tokens, and each scanned step may insert at most one
+        NEW distinct token — so a block of width w is exact iff
+        ``distinct + inflight + w <= W`` (``inflight`` = device-sampled
+        tokens a chained block hasn't fetched yet, each conservatively a
+        new distinct insert). Guided rows ride iff the engine lowered
+        their grammar to a device transition table
+        (``cfg.guided_fuse_check``); an oversized grammar refuses as
+        ``guided_table`` (per-batch, not per-deployment). Seeds and
+        ``min_p`` remain always eligible: both are static per request
+        and ship to the device (seeded draws key on token position, not
+        step)."""
         so = seq.request.sampling_options
         rep_on = (so.repetition_penalty is not None
                   and so.repetition_penalty > 0
                   and so.repetition_penalty != 1.0)
-        return not (so.frequency_penalty or so.presence_penalty or rep_on
-                    or so.logit_bias or so.guided)
+        cap = 1 << 20
+        if so.frequency_penalty or so.presence_penalty or rep_on \
+                or so.logit_bias:
+            W = self.cfg.penalty_window
+            if W <= 0:
+                return "penalties", cap
+            distinct = set(so.logit_bias or ()) | set(seq.generated)
+            if (seq.request.resumed_tokens or 0) > 0:
+                # migration resume: the trailing resumed_tokens of the
+                # "prompt" are really prior-hop generations and count
+                # toward the window (JaxEngine._penalty_row)
+                toks = seq.tokens.tokens()
+                n_prompt = seq.num_prompt - min(
+                    seq.request.resumed_tokens, seq.num_prompt)
+                distinct |= set(toks[n_prompt:seq.num_prompt])
+            inflight = sl - len(seq)
+            cap = W - len(distinct) - inflight
+            if cap < 2:
+                return "penalty_window", cap
+        if so.guided:
+            if self.cfg.guided_fuse_check is None:
+                return "guided", cap
+            if not self.cfg.guided_fuse_check(seq):
+                return "guided_table", cap
+        return None, cap
 
     def _grow_for_block(self, seqs: List[Sequence], start_lens: List[int],
                         width: int) -> bool:
@@ -902,8 +951,11 @@ class Scheduler:
         rows with detokenizer-level stop strings; then rounded DOWN to a
         power of two (bounded compile count), then narrowed further if
         page pressure refuses the up-front allocation — so the fused
-        program never needs mid-block page allocation. Spec-decode mode
-        and ineligible sampling (penalties/bias/guided) refuse entirely.
+        program never needs mid-block page allocation. Penalized / biased
+        rows additionally cap the width by their remaining device
+        penalty-window capacity (``_fuse_gate``); spec-decode mode and
+        rows the gate cannot admit (no penalty window configured,
+        grammar without a device table) refuse entirely.
         """
         cap = self.cfg.decode_multistep
         if cap < 2:
@@ -915,11 +967,11 @@ class Scheduler:
         budgets: List[int] = []
         min_gates: List[int] = []
         for seq, sl in zip(seqs, start_lens):
-            if not self._fuse_eligible(seq):
-                self.record_fallback(
-                    "guided" if seq.request.sampling_options.guided
-                    else "penalties", seqs)
+            reason, row_cap = self._fuse_gate(seq, sl)
+            if reason is not None:
+                self.record_fallback(reason, seqs)
                 return None
+            w = min(w, row_cap)
             sc = seq.request.stop_conditions
             gen_eff = len(seq.generated) + (sl - len(seq))
             max_new = sc.max_tokens if sc.max_tokens is not None else (

@@ -88,6 +88,80 @@ def apply_penalties(logits: jnp.ndarray, pen_ids: jnp.ndarray,
     return logits.at[rows, pen_ids].add(delta)
 
 
+def update_penalty_window(pen_ids: jnp.ndarray, pen_counts: jnp.ndarray,
+                          pen_in_ctx: jnp.ndarray, pen_n: jnp.ndarray,
+                          tokens: jnp.ndarray, active: jnp.ndarray):
+    """One fused-decode step of the device-resident penalty window.
+
+    The fused multistep block keeps each row's penalty entries as a
+    fixed-capacity window riding the scan carry; after a token is
+    sampled this folds it in without leaving the device:
+
+      - a token already in the row's window (first ``pen_n`` slots) gets
+        its count bumped and is marked in-context;
+      - a new token is appended at slot ``pen_n`` (count 1, in-context)
+        when capacity remains — the scheduler's width gate guarantees a
+        fused block never sees the window fill mid-block, so the
+        saturation branch is unreachable on planned traffic.
+
+    Inserts never touch the bias column: new slots keep the zero pad,
+    and all logit-bias entries are preloaded before the block starts, so
+    an insert can never collide with a biased slot.
+
+    pen_ids/pen_counts/pen_in_ctx: [B, W] as ``apply_penalties``
+    pen_n:  [B] i32 occupied slots per row
+    tokens: [B] i32 tokens just sampled
+    active: [B] bool rows whose window should absorb the token
+            (alive AND carrying penalties/bias)
+    Returns the four updated window arrays.
+    """
+    W = pen_ids.shape[1]
+    if W == 0:
+        return pen_ids, pen_counts, pen_in_ctx, pen_n
+    occ = jnp.arange(W)[None, :] < pen_n[:, None]            # [B, W]
+    match = (pen_ids == tokens[:, None]) & occ
+    bump = match & active[:, None]
+    pen_counts = pen_counts + bump.astype(pen_counts.dtype)
+    pen_in_ctx = jnp.maximum(pen_in_ctx, bump.astype(pen_in_ctx.dtype))
+    can_ins = active & ~jnp.any(match, axis=1) & (pen_n < W)
+    slot = (jnp.arange(W)[None, :] == pen_n[:, None]) & can_ins[:, None]
+    pen_ids = jnp.where(slot, tokens[:, None], pen_ids)
+    pen_counts = jnp.where(slot, jnp.ones_like(pen_counts), pen_counts)
+    pen_in_ctx = jnp.where(slot, jnp.ones_like(pen_in_ctx), pen_in_ctx)
+    pen_n = pen_n + can_ins.astype(pen_n.dtype)
+    return pen_ids, pen_counts, pen_in_ctx, pen_n
+
+
+def penalty_window_entries(prompt_ids: jnp.ndarray, prompt_valid: jnp.ndarray,
+                           pen_ids: jnp.ndarray,
+                           pen_n: jnp.ndarray) -> jnp.ndarray:
+    """Which static prompt entries the fused penalty step should include.
+
+    The per-step host builder backfills a penalized row's window with
+    distinct prompt tokens (repetition-penalty context) after the
+    generated/bias entries, up to capacity ``W``. On device the prompt
+    side is a STATIC list shipped once per batch composition
+    (``prompt_ids``/``prompt_valid``, deduped reverse-prompt order, 2W
+    entries — enough that at least W survive any overlap with the
+    dynamic window); each step this recomputes which of them the host
+    would have kept: not already in the dynamic window's first ``pen_n``
+    slots, and within the ``W - pen_n`` remaining capacity, first come
+    first served.
+
+    Returns an [B, S] bool include mask; included entries are applied
+    with count 0 / in-context 1 / bias 0, excluded ones pad to a zero
+    delta under ``apply_penalties``.
+    """
+    W = pen_ids.shape[1]
+    occ = jnp.arange(W)[None, None, :] < pen_n[:, None, None]
+    in_dyn = jnp.any(
+        (prompt_ids[:, :, None] == pen_ids[:, None, :]) & occ, axis=2)
+    eligible = prompt_valid & ~in_dyn                        # [B, S]
+    rank = jnp.cumsum(eligible.astype(jnp.int32), axis=1) \
+        - eligible.astype(jnp.int32)                         # exclusive
+    return eligible & (pen_n[:, None] + rank < W)
+
+
 def _masked_candidates(logits: jnp.ndarray, temperature: jnp.ndarray,
                        top_k: jnp.ndarray, top_p: jnp.ndarray,
                        min_p: Optional[jnp.ndarray] = None):
@@ -293,4 +367,5 @@ def spec_verify(logits: jnp.ndarray, tokens: jnp.ndarray, rng: jax.Array,
 
 
 __all__ = ["SamplingParamsBatch", "sample_tokens", "apply_penalties",
-           "apply_vocab_mask", "spec_verify", "TOPK_MAX"]
+           "apply_vocab_mask", "update_penalty_window",
+           "penalty_window_entries", "spec_verify", "TOPK_MAX"]

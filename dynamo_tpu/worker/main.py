@@ -109,6 +109,17 @@ def build_parser() -> argparse.ArgumentParser:
                    help="decode steps fused into one jitted dispatch with "
                         "on-device sampling/stop checks (default: "
                         "DYN_DECODE_MULTISTEP or 8; 1 disables fusion)")
+    p.add_argument("--penalty-window", type=int, default=32,
+                   help="device ring-buffer slots per penalized/logit_bias "
+                        "row — such rows ride the fused decode block while "
+                        "their distinct penalizable ids fit (raise for "
+                        "long penalized generations; 0 disables the "
+                        "device path and such rows decode per-step)")
+    p.add_argument("--guided-table-bytes", type=int, default=8 << 20,
+                   help="byte cap for a guided grammar's dense device "
+                        "transition table; grammars over the cap degrade "
+                        "per-row to per-step decode (fallback reason "
+                        "guided_table)")
     p.add_argument("--no-kv-events", action="store_true")
     p.add_argument("--num-nodes", type=int, default=1,
                    help="multi-host: total processes in the jax world")
@@ -184,7 +195,9 @@ def build_engine(args: argparse.Namespace) -> JaxEngine:
         spec_ngram_max=args.speculative_ngram_max,
         spec_ngram_min=args.speculative_ngram_min,
         spec_chain_break=args.speculative_chain_break,
-        decode_multistep=args.decode_multistep)
+        decode_multistep=args.decode_multistep,
+        penalty_window=args.penalty_window,
+        guided_table_bytes=args.guided_table_bytes)
     forward_fn = None
     pp = args.pipeline_parallel_size
     if pp > 1:

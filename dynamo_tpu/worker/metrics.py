@@ -136,6 +136,11 @@ class EngineDispatchCollector:
         "mixed_dispatches": "Mixed prefill+decode dispatches (prefill "
                             "chunks and decode rows advanced in ONE "
                             "ragged [B, S] step, DYN_MIXED_BATCH)",
+        "guided_parity_mismatches": "Guided rows whose host-side automaton "
+                                    "re-walk disagreed with the device "
+                                    "transition table after a fused block "
+                                    "(logged once per row; any nonzero "
+                                    "value is a device/host lowering bug)",
     }
 
     # the known fallback reasons, pre-seeded so every label shows on the
@@ -143,8 +148,15 @@ class EngineDispatchCollector:
     # first refusal happens. "mesh" is GONE on purpose: sharded engines
     # run the fused block program (explicit in/out shardings) — a mesh
     # engine reporting fallbacks again would be a regression, and the
-    # parity suite asserts the counter stays 0 there.
-    FALLBACK_REASONS = ("waiters", "prefill", "penalties", "guided",
+    # parity suite asserts the counter stays 0 there. "penalties" and
+    # "guided" now only fire when the device path is unavailable
+    # (penalty_window=0 / no grammar lowering); "penalty_window" counts
+    # rows whose distinct-token set outgrew the configured ring buffer,
+    # "guided_table" grammars whose transition table exceeded the byte
+    # cap (JaxEngineConfig.guided_table_bytes) — both per-batch, not
+    # per-deployment.
+    FALLBACK_REASONS = ("waiters", "prefill", "penalties",
+                        "penalty_window", "guided", "guided_table",
                         "spec", "budget", "pages", "multihost")
 
     def __init__(self, registry: CollectorRegistry):
@@ -194,6 +206,8 @@ def engine_dispatch_stats(engine) -> Dict[str, object]:
         "decode_multistep_blocks": float(
             getattr(engine, "multistep_blocks", 0)),
         "mixed_dispatches": float(getattr(engine, "mixed_steps", 0)),
+        "guided_parity_mismatches": float(
+            getattr(engine, "guided_parity_mismatches", 0)),
         "multistep_fallbacks": dict(
             getattr(sched, "multistep_fallbacks", None) or {}),
     }

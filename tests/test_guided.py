@@ -754,3 +754,145 @@ class TestEngineGuided:
             assert "response_format rejected" in frames[-1].error
         finally:
             await eng.stop()
+
+
+# ------------------------------------------------- fused guided decoding
+
+FUSED_SCHEMA = {"type": "object",
+                "properties": {"mood": {"enum": ["up", "dn"]},
+                               "n": {"type": "integer"}},
+                "required": ["mood", "n"]}
+FUSED_SPEC = {"mode": "json_schema", "schema": FUSED_SCHEMA}
+
+
+def _req(rid, guided=None, eos=None, max_tokens=64, temperature=0.0,
+         seed=None, tokens=(40, 41, 42), **sopts):
+    return PreprocessedRequest(
+        token_ids=list(tokens), request_id=rid,
+        stop_conditions=StopConditions(max_tokens=max_tokens),
+        sampling_options=SamplingOptions(temperature=temperature,
+                                         seed=seed, guided=guided,
+                                         **sopts),
+        eos_token_ids=[eos] if eos is not None else [])
+
+
+class TestGuidedFused:
+    """Tableable grammars ride the fused multistep block: transition
+    table + per-state masks on device, automaton state in the scan carry,
+    host cross-check after each block."""
+
+    async def _run_cohort(self, ms):
+        eng, tok, eos, tb = guided_engine(decode_multistep=ms)
+        try:
+            reqs = [
+                _req("g-greedy", guided=FUSED_SPEC, eos=eos),
+                _req("g-seeded", guided=FUSED_SPEC, eos=eos,
+                     temperature=0.9, seed=123, tokens=(41, 42, 43)),
+                _req("pen", max_tokens=16, frequency_penalty=0.7,
+                     tokens=(44, 45)),
+            ]
+            outs = await asyncio.gather(
+                *[run_req(eng, r) for r in reqs])
+            toks = [[t for f in frames for t in f.token_ids]
+                    for frames in outs]
+            fb = dict(eng.scheduler.multistep_fallbacks)
+            stats = (eng.multistep_blocks, fb,
+                     eng.guided_parity_mismatches)
+            return toks, stats, (tb, eos)
+        finally:
+            await eng.stop()
+
+    async def test_fused_parity_and_conformance(self):
+        fused, (blocks, fb, mism), (tb, eos) = await self._run_cohort(8)
+        step, (blocks0, _, _), _ = await self._run_cohort(1)
+        assert blocks > 0 and blocks0 == 0
+        assert fused == step          # bit-identical, greedy AND seeded
+        # no guided / penalty refusals: every row rode the blocks
+        assert fb.get("guided", 0) == 0, fb
+        assert fb.get("guided_table", 0) == 0, fb
+        assert fb.get("penalties", 0) == 0, fb
+        assert mism == 0              # host automaton agreed every block
+        for ids in fused[:2]:
+            doc = b"".join(tb[t] or b"" for t in ids
+                           if t != eos and tb[t] is not None
+                           ).decode("utf-8", "replace")
+            if eos in ids:            # doc completed before the budget
+                json.loads(doc)       # conforming JSON, not just parity
+        assert eos in fused[0]        # greedy must reach EOS at this len
+
+    async def test_stop_string_row_shares_batch_with_guided(self):
+        # a stop-string row caps the fuse width at the lookback (2); the
+        # guided row must still ride those narrow blocks with zero
+        # refusals, and both paths stay bit-identical
+        async def run(ms):
+            eng, tok, eos, tb = guided_engine(decode_multistep=ms)
+            try:
+                g = _req("g", guided=FUSED_SPEC, eos=eos)
+                ss = PreprocessedRequest(
+                    token_ids=[44, 45], request_id="ss",
+                    stop_conditions=StopConditions(max_tokens=12,
+                                                   stop=["XYZ"]),
+                    sampling_options=SamplingOptions(temperature=0.0),
+                    eos_token_ids=[])
+                outs = await asyncio.gather(run_req(eng, g),
+                                            run_req(eng, ss))
+                toks = [[t for f in frames for t in f.token_ids]
+                        for frames in outs]
+                return toks, eng.multistep_blocks, dict(
+                    eng.scheduler.multistep_fallbacks)
+            finally:
+                await eng.stop()
+
+        fused, blocks, fb = await run(8)
+        step, blocks0, _ = await run(1)
+        assert blocks > 0 and blocks0 == 0
+        assert fused == step
+        assert fb.get("guided", 0) == 0 and fb.get("guided_table", 0) == 0
+
+    async def test_cancel_guided_mid_block_releases_fsm_slot(self):
+        class Ctx:
+            cancelled = False
+
+        eng, tok, eos, tb = guided_engine(decode_multistep=8)
+        free0 = eng.allocator.num_free
+        try:
+            ctx = Ctx()
+            r = _req("gx", guided=FUSED_SPEC, eos=eos, max_tokens=96)
+            async for out in eng.generate(r, ctx=ctx):
+                ctx.cancelled = True   # cancel after the first frame
+            for _ in range(100):
+                if eng.allocator.num_free == free0:
+                    break
+                await asyncio.sleep(0.02)
+            assert eng.allocator.num_free == free0
+            # a fresh guided request still serves, and its dispatch drains
+            # the release marker: the dead row's FSM slot is gone from the
+            # step thread's caches
+            frames = await run_req(eng, _req("g2", guided=FUSED_SPEC,
+                                             eos=eos))
+            assert frames[-1].finish_reason == FinishReason.EOS
+            assert "gx" not in eng._guided_reqs
+            with eng._released_lock:
+                assert "gx" not in eng._released
+            if eng._samp_cache is not None:
+                assert all(rid != "gx"
+                           for rid, _ in eng._samp_cache[0][1])
+        finally:
+            await eng.stop()
+
+    async def test_untableable_grammar_falls_back_per_step(self):
+        # squeeze the transition-table byte cap below what even the tiny
+        # schema needs: the row must degrade to the per-step masked path
+        # under the "guided_table" reason — and still emit legal JSON
+        eng, tok, eos, tb = guided_engine(decode_multistep=8,
+                                          guided_table_bytes=1024)
+        try:
+            frames = await run_req(eng, _req("j", guided=FUSED_SPEC,
+                                             eos=eos))
+            fb = dict(eng.scheduler.multistep_fallbacks)
+            assert fb.get("guided_table", 0) >= 1, fb
+            assert frames[-1].finish_reason == FinishReason.EOS
+            doc = text_of(frames, tb, eos)
+            json.loads(doc)
+        finally:
+            await eng.stop()

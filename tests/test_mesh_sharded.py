@@ -139,6 +139,39 @@ class TestShardedFusedParity:
             await perstep.stop()
         assert got_fused == got_perstep == want
 
+    async def test_constrained_parity_fused_perstep_single(self, tp2):
+        """Penalties + logit bias ride the fused block ON THE MESH: the
+        ring-buffer carry keys stay replicated (no implicit reshard
+        changes numerics) and tokens match per-step and single-device
+        bit-for-bit, seeded sampling included."""
+        cfg, shard = tp2
+        prompt = list(range(2, 11))
+        kw = dict(seed=77, temp=0.9, max_tokens=20,
+                  frequency_penalty=0.6, repetition_penalty=1.3,
+                  logit_bias={19: 2.5, 47: -100.0})
+
+        single = JaxEngine.random_init(cfg, JaxEngineConfig(**ENGINE_KW))
+        try:
+            want = await run_tokens(single, prompt, "cs", **kw)
+        finally:
+            await single.stop()
+        fused = build_tp2(cfg, shard)
+        try:
+            got_fused = await run_tokens(fused, prompt, "cf", **kw)
+            assert fused.multistep_blocks > 0, \
+                "constrained row refused the fused path on the mesh"
+            fb = dict(fused.scheduler.multistep_fallbacks)
+            assert fb.get("penalties", 0) == 0, fb
+            assert fb.get("penalty_window", 0) == 0, fb
+        finally:
+            await fused.stop()
+        perstep = build_tp2(cfg, shard, decode_multistep=1)
+        try:
+            got_perstep = await run_tokens(perstep, prompt, "cp", **kw)
+        finally:
+            await perstep.stop()
+        assert got_fused == got_perstep == want
+
     async def test_no_mesh_fallback_reason_on_sharded_engine(self, tp2):
         """The satellite regression guard: a sharded engine with fusion
         configured refuses NOTHING for being sharded — the ``mesh``

@@ -426,7 +426,10 @@ class TestMixedScheduling:
 class TestMetricsSurface:
     async def test_engine_dispatch_stats_carry_mixed_and_fallbacks(self):
         from dynamo_tpu.worker.metrics import engine_dispatch_stats
-        eng = tiny_engine(mixed_batch=True)
+        # penalty_window=0 disables the device-resident penalty path so
+        # the penalized row still refuses fusion — this test is about the
+        # fallback *counter* surface, not the fused penalty path
+        eng = tiny_engine(mixed_batch=True, penalty_window=0)
         try:
             started = asyncio.Event()
 

@@ -402,6 +402,190 @@ class TestSchedulerWidth:
         assert ms is not None and ms.width == 8
         assert ms.seqs == [seq]
 
+    def test_penalty_window_admits_and_narrows(self):
+        # W=8; distinct entries = logit_bias {1,2,3} + generated {9} = 4,
+        # nothing in flight -> 4 free ring-buffer slots: the block narrows
+        # to width 4 instead of refusing
+        sched, _ = self.make(penalty_window=8)
+        r = make_req(range(1, 6), "p", max_tokens=32,
+                     samp=SamplingOptions(temperature=0.0,
+                                          frequency_penalty=1.0,
+                                          logit_bias={1: 1.0, 2: 1.0,
+                                                      3: 1.0}))
+        self.to_running(sched, r)
+        ms = sched.plan_multistep(sched.schedule())
+        assert ms is not None and ms.width == 4
+        assert sched.multistep_fallbacks == {}
+
+    def test_penalty_window_exhausted_refuses(self):
+        # W=4 fully consumed by 3 bias entries + 1 generated token: fewer
+        # than 2 free slots left, so the row cannot ride even the
+        # narrowest block — refused under its own reason, not "penalties"
+        sched, _ = self.make(penalty_window=4)
+        r = make_req(range(1, 6), "p", max_tokens=32,
+                     samp=SamplingOptions(temperature=0.0,
+                                          presence_penalty=0.5,
+                                          logit_bias={1: 1.0, 2: 1.0,
+                                                      3: 1.0}))
+        seq = self.to_running(sched, r)
+        assert sched.plan_multistep(sched.schedule()) is None
+        assert sched.multistep_fallbacks == {"penalty_window": 1}
+        assert seq.multistep_fallbacks == 1
+
+    def test_guided_fuse_check_routes_reasons(self):
+        def mk(check):
+            sched, _ = self.make(guided_fuse_check=check)
+            r = make_req(range(1, 6), "g", max_tokens=32,
+                         samp=SamplingOptions(temperature=0.0,
+                                              guided={"mode": "json"}))
+            self.to_running(sched, r)
+            return sched
+
+        # no device-lowering hook wired at all: the legacy "guided" refusal
+        s = mk(None)
+        assert s.plan_multistep(s.schedule()) is None
+        assert s.multistep_fallbacks == {"guided": 1}
+        # hook reports the grammar's transition table blew the byte cap
+        s = mk(lambda seq: False)
+        assert s.plan_multistep(s.schedule()) is None
+        assert s.multistep_fallbacks == {"guided_table": 1}
+        # hook vouches for a device table: the row fuses at full width
+        s = mk(lambda seq: True)
+        ms = s.plan_multistep(s.schedule())
+        assert ms is not None and ms.width == 8
+
+
+def mk_constrained(seeded=False):
+    t = 0.9 if seeded else 0.0
+    kw = dict(seed=11) if seeded else {}
+    return [
+        make_req([1, 2, 3, 4, 5], "plain", max_tokens=14,
+                 samp=SamplingOptions(temperature=t, **kw)),
+        make_req([2, 3, 4, 5, 6], "freq", max_tokens=14,
+                 samp=SamplingOptions(temperature=t,
+                                      frequency_penalty=0.9, **kw)),
+        make_req([3, 4, 5, 6, 7], "rep", max_tokens=14,
+                 samp=SamplingOptions(temperature=t,
+                                      repetition_penalty=1.4, **kw)),
+        make_req([4, 5, 6, 7, 8], "bias", max_tokens=14,
+                 samp=SamplingOptions(temperature=t,
+                                      logit_bias={17: 3.5, 41: -100.0},
+                                      **kw)),
+    ]
+
+
+async def run_many_fb(reqs, **engine_kw):
+    """run_many plus the scheduler's per-reason fallback counters."""
+    eng = tiny_engine(**engine_kw)
+    try:
+        results = await asyncio.gather(*[collect(eng, r) for r in reqs])
+        return ([toks_of(f) for f in results],
+                dict(eng.scheduler.multistep_fallbacks),
+                eng.multistep_blocks)
+    finally:
+        await eng.stop()
+
+
+class TestConstrainedParity:
+    """Penalties and logit bias ride the fused block (device ring buffer
+    in the scan carry) bit-identically to the per-step path — no
+    "penalties" refusals on the trace."""
+
+    async def _both(self, mk):
+        fused, fb, blocks = await run_many_fb(mk(), decode_multistep=8)
+        step, _fb0, blocks0 = await run_many_fb(mk(), decode_multistep=1)
+        assert blocks > 0 and blocks0 == 0
+        assert fused == step
+        assert fb.get("penalties", 0) == 0, fb
+        assert fb.get("penalty_window", 0) == 0, fb
+        return fused
+
+    async def test_mixed_cohort_greedy(self):
+        toks = await self._both(lambda: mk_constrained(False))
+        assert all(len(t) == 14 for t in toks)
+
+    async def test_mixed_cohort_seeded(self):
+        await self._both(lambda: mk_constrained(True))
+
+    async def test_penalty_bites_inside_the_block(self):
+        # deterministic semantics check, not just parity: a +100 bias
+        # forces the first greedy pick, then a huge presence penalty must
+        # ban that token for the REST OF THE BLOCK — proving the window
+        # update happens inside the scan, not once per dispatch
+        toks, fb, blocks = await run_many_fb(
+            [make_req([1, 2, 3], "b", max_tokens=12,
+                      samp=SamplingOptions(temperature=0.0,
+                                           presence_penalty=200.0,
+                                           logit_bias={7: 100.0}))],
+            decode_multistep=8)
+        assert blocks > 0
+        assert fb.get("penalties", 0) == 0, fb
+        assert toks[0][0] == 7
+        assert 7 not in toks[0][1:]
+
+    async def test_migration_resume_preserves_window(self):
+        # per-step reference trajectory, uninterrupted
+        def samp():
+            return SamplingOptions(temperature=0.0, frequency_penalty=0.9)
+
+        full, _, _ = await run_many_fb(
+            [make_req([1, 2, 3, 4, 5], "m", max_tokens=16, samp=samp())],
+            decode_multistep=1)
+        assert len(full[0]) == 16
+
+        # resume after 6 generated tokens: the migration hop folds them
+        # into the prompt and marks the count (llm/operators.py) — the
+        # penalty window must still count them
+        def resumed():
+            r = make_req([1, 2, 3, 4, 5] + full[0][:6], "m", max_tokens=10,
+                         samp=samp())
+            r.resumed_tokens = 6
+            return [r]
+
+        fused, fb, blocks = await run_many_fb(resumed(),
+                                              decode_multistep=8)
+        step, _, blocks0 = await run_many_fb(resumed(), decode_multistep=1)
+        assert blocks > 0 and blocks0 == 0
+        assert fb.get("penalties", 0) == 0, fb
+        assert fb.get("penalty_window", 0) == 0, fb
+        assert fused == step
+        # the hop is seamless: resumed continuation == uninterrupted tail
+        assert fused[0] == full[0][6:]
+
+    async def test_cancel_penalized_mid_block_releases_slot(self):
+        class Ctx:
+            cancelled = False
+
+        eng = tiny_engine(decode_multistep=8)
+        free0 = eng.allocator.num_free
+        try:
+            ctx = Ctx()
+            r = make_req([1, 2, 3], "cx", max_tokens=1000,
+                         samp=SamplingOptions(temperature=0.0,
+                                              frequency_penalty=0.9))
+            async for out in eng.generate(r, ctx=ctx):
+                ctx.cancelled = True   # cancel after the first frame
+            for _ in range(100):
+                if eng.allocator.num_free == free0:
+                    break
+                await asyncio.sleep(0.02)
+            assert eng.allocator.num_free == free0
+            # the engine still serves penalized rows afterwards, and the
+            # next dispatch drains the release marker: no cached sampling
+            # composition may still reference the dead row
+            ok = await collect(eng, make_req(
+                [4, 5, 6], "after", max_tokens=6,
+                samp=SamplingOptions(temperature=0.0,
+                                     presence_penalty=0.3)))
+            assert len(toks_of(ok)) == 6
+            with eng._released_lock:
+                assert "cx" not in eng._released
+            if eng._samp_cache is not None:
+                assert all(rid != "cx" for rid, _ in eng._samp_cache[0][1])
+            assert "cx" not in eng._guided_reqs
+        finally:
+            await eng.stop()
+
 
 class TestMockerBlockPath:
     async def test_mocker_fused_tokens_match_per_step(self):

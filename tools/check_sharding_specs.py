@@ -82,6 +82,24 @@ def main() -> int:
 
     B, P = 2, eng.table_width
     pages_ndim = eng.pages.ndim
+    W = eng.cfg.penalty_window
+    CARRY_2D = ("tok", "pos", "pids", "pcnt", "pctx", "pbias")
+
+    def check_multistep(tag: str, ms) -> None:
+        out_pages, out_packed, out_carry, out_drops = ms.output_shardings
+        check(f"multistep{tag}.pages(out)", out_pages, pages_sharding,
+              pages_ndim)
+        check(f"multistep{tag}.packed(out)", out_packed, rep, 3)
+        for key, s in out_carry.items():
+            nd = 2 if key in CARRY_2D else 1
+            check(f"multistep{tag}.carry[{key}](out)", s, rep, nd)
+        check(f"multistep{tag}.drops(out)", out_drops, rep, 0)
+        in_shardings, _in_kw = ms.input_shardings
+        # donated pages: argument 1 must come in on the sharding it goes
+        # out with, or XLA falls back to copy-and-reshard and the
+        # donation is lost
+        check(f"multistep{tag}.pages(in,donated)", in_shardings[1],
+              pages_sharding, pages_ndim)
 
     # -- fused multi-step block (explicit out_shardings) -------------------
     fn = eng._get_jit_multistep(2)
@@ -91,20 +109,42 @@ def main() -> int:
         jnp.ones(B, jnp.int32), jnp.zeros(B, bool),
         jnp.zeros(B, jnp.int32), jnp.zeros(B, jnp.int32), eng._rng,
         np.int32(0), jnp.zeros(B, jnp.float32), jnp.zeros(B, jnp.int32),
-        jnp.ones(B, jnp.float32), jnp.full((B, 1), -1, jnp.int32), None)
-    ms = fn.lower(*ms_args).compile()
-    out_pages, out_packed, out_carry, out_drops = ms.output_shardings
-    check("multistep.pages(out)", out_pages, pages_sharding, pages_ndim)
-    check("multistep.packed(out)", out_packed, rep, 3)
-    for key, s in out_carry.items():
-        nd = 2 if key in ("tok", "pos") else 1
-        check(f"multistep.carry[{key}](out)", s, rep, nd)
-    check("multistep.drops(out)", out_drops, rep, 0)
-    in_shardings, _in_kw = ms.input_shardings
-    # donated pages: argument 1 must come in on the sharding it goes out
-    # with, or XLA falls back to copy-and-reshard and the donation is lost
-    check("multistep.pages(in,donated)", in_shardings[1], pages_sharding,
-          pages_ndim)
+        jnp.ones(B, jnp.float32), jnp.full((B, 1), -1, jnp.int32), None,
+        None)
+    check_multistep("", fn.lower(*ms_args).compile())
+
+    # -- CONSTRAINED fused block (penalty window + guided table riding the
+    # carry): the same explicit out_shardings must hold for the trace that
+    # carries the ring-buffer / automaton-state buffers, and the batched
+    # grammar table must not force a reshard of the carry
+    V = eng.model_cfg.vocab_size
+    words = (V + 31) // 32
+    pen = {
+        "seeds": jnp.zeros(B, jnp.int32),
+        "min_p": jnp.zeros(B, jnp.float32),
+        "pw": {
+            "fp": jnp.full(B, 0.5, jnp.float32),
+            "pp": jnp.zeros(B, jnp.float32),
+            "rp": jnp.full(B, 1.2, jnp.float32),
+            "active": jnp.ones(B, bool),
+            "prompt_ids": jnp.zeros((B, 2 * max(W, 1)), jnp.int32),
+            "prompt_valid": jnp.zeros((B, 2 * max(W, 1)), bool),
+        },
+        "gt": {
+            "trans": jnp.zeros((4, V), jnp.int32),
+            "masks": jnp.full((4, words), 0xFFFFFFFF, jnp.uint32),
+        },
+    }
+    pcarry = {
+        "pids": jnp.zeros((B, W), jnp.int32),
+        "pcnt": jnp.zeros((B, W), jnp.float32),
+        "pctx": jnp.zeros((B, W), jnp.float32),
+        "pbias": jnp.zeros((B, W), jnp.float32),
+        "pn": jnp.zeros(B, jnp.int32),
+        "gstate": jnp.zeros(B, jnp.int32),
+    }
+    ms_args_con = ms_args[:15] + (pen, pcarry)
+    check_multistep(".constrained", fn.lower(*ms_args_con).compile())
 
     # -- per-step decode program (propagated shardings) --------------------
     def step_args(S: int):
@@ -133,8 +173,9 @@ def main() -> int:
         for e in errors:
             print(f"  FAIL {e}", file=sys.stderr)
         return 1
-    print("sharding specs OK: multistep (pages donated sharded, packed/"
-          "carry replicated), decode/mixed (pages stay on the cache "
+    print("sharding specs OK: multistep plain+constrained (pages donated "
+          "sharded, packed/carry incl. penalty-window + guided-state "
+          "buffers replicated), decode/mixed (pages stay on the cache "
           "sharding), transport placement")
     return 0
 
