@@ -50,6 +50,7 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import contextlib
 import json
 import math
 import os
@@ -1179,6 +1180,319 @@ async def _measure_coord_failover(wd=None) -> dict:
     return result
 
 
+# fleet-supervisor leg geometry: phased cohort trace (low -> burst -> low)
+# and the per-stream token cap (keeps mocker streams ~hundreds of ms so
+# every scale event lands with live streams in flight)
+FLEET_PHASES = os.environ.get("BENCH_FLEET_PHASES",
+                              "3rps:6s,12rps:14s,3rps:8s")
+FLEET_TOKEN_CAP = int(os.environ.get("BENCH_FLEET_TOKENS", "48"))
+FLEET_MAX_DECODE = int(os.environ.get("BENCH_FLEET_MAX_DECODE", "4"))
+FLEET_INFLIGHT_CAP = int(os.environ.get("BENCH_FLEET_INFLIGHT", "96"))
+
+
+async def _measure_fleet(wd=None) -> dict:
+    """Fleet-supervisor leg (ROADMAP item 4, the closing proof): the
+    planner's LocalConnector drives a REAL multi-worker mocker fleet
+    through every lifecycle event PRs 14-16 built, in one continuous
+    phased cohort trace — planner scale-up on the burst (readiness-
+    gated), a worker kill -9 mid-burst auto-healed by the supervisor, a
+    coordinator-primary kill -9 absorbed by the hot standby, and a
+    planner-driven drain scale-down when the burst subsides.  The
+    headline number is ``streams_lost`` and it must be 0 for EVERY
+    event: drain takes the migration path, kill -9 takes the replay
+    path.  Cohorts carry real sampling shapes (penalties, guided-json)
+    so migrated requests exercise the no-fallback decode surface."""
+    import aiohttp
+
+    from dynamo_tpu.llm.pipeline import RemotePipeline
+    from dynamo_tpu.planner.connectors import LocalConnector
+    from dynamo_tpu.planner.metrics import get_planner_metrics
+    from dynamo_tpu.planner.perf_interpolation import PerfInterpolator
+    from dynamo_tpu.planner.planner_core import (
+        Planner, PlannerConfig, SloSpec, TrafficSample)
+    from dynamo_tpu.protocols.common import (
+        PreprocessedRequest, SamplingOptions, StopConditions)
+    from dynamo_tpu.runtime.push_router import PushRouter
+    from dynamo_tpu.runtime.runtime import DistributedRuntime
+    from dynamo_tpu.runtime.system_server import SystemServer
+    from dynamo_tpu.trace_gen import (
+        TraceConfig, default_cohorts, generate, parse_phases)
+    from dynamo_tpu.utils.faults import CoordinatorPair, stub_worker_cmd
+    from dynamo_tpu.utils.testing import make_test_card
+
+    if wd is not None:
+        wd.arm("measure:fleet", STAGE_BUDGETS["measure"])
+
+    pm = get_planner_metrics()
+    crashes0 = pm.worker_crashes_total.labels("decode")._value.get()
+    holds0 = pm.crash_loop_holds_total._value.get()
+    ups0 = pm.decisions_total.labels("up")._value.get()
+    downs0 = pm.decisions_total.labels("down")._value.get()
+
+    phases = parse_phases(FLEET_PHASES)
+    trace = list(generate(TraceConfig(
+        num_requests=100_000, block_size=4, seed=7,
+        phases=phases, cohorts=default_cohorts())))
+    low_end = phases[0][1]
+    high_end = low_end + phases[1][1]
+
+    pair = await CoordinatorPair(promote_after_s=0.6).start()
+    mocker_cmd = [
+        sys.executable, "-m", "dynamo_tpu.mocker.main",
+        "--coordinator", pair.addresses, "--component", "fleet",
+        "--speedup-ratio", "1", "--page-size", "4",
+        "--num-pages", "8192", "--max-num-seqs", "64",
+        "--max-context", "16384",
+    ]
+    conn = LocalConnector(
+        stub_worker_cmd(), mocker_cmd,
+        extra_env={"JAX_PLATFORMS": "cpu"},
+        supervise_interval_s=0.1, probe_interval_s=0.05,
+        backoff_base_s=0.2, backoff_cap_s=1.0)
+
+    # synthetic decode surface calibrated to the phase rates: at the itl
+    # SLO the per-replica concurrency budget is 8, so the low phase needs
+    # 1 replica and the 12 rps burst needs 4 (with 1.15x headroom)
+    interp = PerfInterpolator({
+        "prefill": [{"isl": 64, "ttft_s": 0.01, "tokens_per_s": 1e6},
+                    {"isl": 4096, "ttft_s": 0.02, "tokens_per_s": 1e6}],
+        "decode": [{"concurrency": 1, "itl_s": 0.04, "tokens_per_s": 25},
+                   {"concurrency": 8, "itl_s": 0.05, "tokens_per_s": 160},
+                   {"concurrency": 32, "itl_s": 0.2, "tokens_per_s": 160}],
+    })
+
+    class DriverSource:
+        """Planner MetricsSource fed by the driver's own issue counters —
+        the bench process IS the frontend here."""
+
+        def __init__(self):
+            self.n = 0
+            self.isl = 0.0
+            self.osl = 0.0
+            self._t = time.monotonic()
+
+        def record(self, isl: int, osl: int) -> None:
+            self.n += 1
+            self.isl += isl
+            self.osl += osl
+
+        async def sample(self) -> TrafficSample:
+            now = time.monotonic()
+            dt = max(1e-6, now - self._t)
+            self._t = now
+            n, isl, osl = self.n, self.isl, self.osl
+            self.n, self.isl, self.osl = 0, 0.0, 0.0
+            if n == 0:
+                return TrafficSample(0.0, 0.0, 0.0)
+            return TrafficSample(n / dt, isl / n, osl / n)
+
+    source = DriverSource()
+    planner = Planner(
+        PlannerConfig(interval_s=1.5, predictor="constant",
+                      min_prefill=0, max_prefill=0,
+                      min_decode=1, max_decode=FLEET_MAX_DECODE),
+        SloSpec(ttft_s=0.5, itl_s=0.05), interp, source, conn)
+
+    # planner metrics served the production way: a system server over the
+    # planner registry, scraped over HTTP at the end of the leg
+    system = SystemServer(port=0, registry=pm.registry)
+    system.health.register("planner", ready=True)
+    await system.start()
+
+    fe = None
+    replicas_peak = 0
+    stats = {"issued": 0, "completed": 0, "shed": 0, "lost": 0}
+    errors: list = []
+    ttfts: list = []
+    inflight = 0
+    events: dict = {}
+
+    async def poll(cond, timeout, what):
+        t0 = time.monotonic()
+        while not cond():
+            if time.monotonic() - t0 > timeout:
+                raise TimeoutError(f"fleet leg: timed out waiting for {what}")
+            await asyncio.sleep(0.1)
+
+    try:
+        # bootstrap: one decode replica, readiness-gated before any traffic
+        await conn.scale(0, 1)
+        await conn.wait_ready("decode", 1, timeout=120)
+        fe = await DistributedRuntime.create(coordinator=pair.addresses)
+        client = await (fe.namespace("dynamo").component("fleet")
+                        .endpoint("generate").client())
+        await client.wait_for_instances(1, timeout=30)
+        card = make_test_card(name="mock-model", kv_cache_block_size=4)
+        pipeline = RemotePipeline(card, PushRouter(client), migration_limit=5)
+
+        def to_request(row, idx):
+            isl = min(int(row["input_length"]), 12_000)
+            osl = max(1, min(int(row["output_length"]), FLEET_TOKEN_CAP))
+            s = row.get("sampling") or {}
+            guided = None
+            rf = s.get("response_format")
+            if isinstance(rf, dict) and rf.get("type") == "json_object":
+                guided = {"mode": "json"}
+            req = PreprocessedRequest(
+                token_ids=[(i * 7 + idx) % 29_000 + 1 for i in range(isl)],
+                request_id=f"fleet-{idx}",
+                stop_conditions=StopConditions(max_tokens=osl,
+                                               ignore_eos=True),
+                sampling_options=SamplingOptions(
+                    temperature=s.get("temperature"),
+                    frequency_penalty=s.get("frequency_penalty"),
+                    presence_penalty=s.get("presence_penalty"),
+                    guided=guided))
+            return req, isl, osl
+
+        async def drive_one(row, idx):
+            nonlocal inflight
+            stats["issued"] += 1
+            if inflight >= FLEET_INFLIGHT_CAP:
+                stats["shed"] += 1
+                return
+            inflight += 1
+            req, isl, osl = to_request(row, idx)
+            source.record(isl, osl)
+            t0 = time.perf_counter()
+            first = None
+            toks = 0
+            try:
+                async for out in pipeline.engine_stream(req):
+                    if out.token_ids and first is None:
+                        first = time.perf_counter() - t0
+                    toks += len(out.token_ids)
+                if toks >= osl:
+                    stats["completed"] += 1
+                    if first is not None:
+                        ttfts.append(first)
+                else:
+                    stats["lost"] += 1
+                    errors.append(f"short stream {req.request_id}: "
+                                  f"{toks}/{osl}")
+            except Exception as e:  # noqa: BLE001 — a lost stream is data
+                stats["lost"] += 1
+                errors.append(f"{req.request_id}: {str(e)[:120]}")
+            finally:
+                inflight -= 1
+
+        async def chaos_script():
+            """The event sequence, pegged to fleet state (not wall time):
+            scale-up observed -> worker kill -9 -> heal observed ->
+            coordinator kill -9 -> promotion observed."""
+            await poll(lambda: conn.counts()["decode"] >= 2,
+                       timeout=high_end + 30,
+                       what="planner scale-up to >=2 ready replicas")
+            events["scale_up_replicas"] = conn.counts()["decode"]
+
+            victims = [h for h in conn._fleets["decode"]
+                       if h.ready and not h.stopping]
+            victim = victims[0]
+            victim.proc.kill()  # kill -9: no drain, streams must replay
+            events["killed_worker"] = f"decode-g{victim.gen}"
+            crash_floor = crashes0 + 1
+            await poll(lambda: (pm.worker_crashes_total.labels("decode")
+                                ._value.get() >= crash_floor),
+                       timeout=30, what="supervisor to log the kill -9")
+            await poll(lambda: conn.counts()["decode"] >= 2,
+                       timeout=60, what="crash-heal respawn to readiness")
+            events["healed"] = True
+
+            t0 = time.perf_counter()
+            await pair.kill9_primary()
+            await pair.wait_promoted(timeout=30)
+            events["promote_s"] = round(time.perf_counter() - t0, 3)
+
+        planner.start()
+        chaos = asyncio.ensure_future(chaos_script())
+        tasks = []
+        t_start = time.monotonic()
+        for idx, row in enumerate(trace):
+            delay = t_start + row["timestamp"] / 1000.0 - time.monotonic()
+            if delay > 0:
+                await asyncio.sleep(delay)
+            replicas_peak = max(replicas_peak, conn.counts()["decode"])
+            tasks.append(asyncio.ensure_future(drive_one(row, idx)))
+        trace_wall = time.monotonic() - t_start
+        await asyncio.wait_for(asyncio.gather(*tasks), timeout=120)
+        await asyncio.wait_for(chaos, timeout=60)
+
+        # the burst is over: the planner must now drain the fleet back
+        # down to 1 replica (graceful scale-down, not a kill)
+        await poll(lambda: conn.alive_counts()["decode"] <= 1,
+                   timeout=30, what="planner-driven drain scale-down")
+        await conn.quiesce()
+        events["drained_to"] = conn.counts()["decode"]
+        await planner.stop()
+
+        # migration replays absorbed by the survivors, from their own
+        # worker /metrics (the connector gave each worker a system port)
+        replays = 0.0
+        async with aiohttp.ClientSession() as http:
+            for h in conn._fleets["decode"]:
+                try:
+                    async with http.get(
+                            f"http://127.0.0.1:{h.port}/metrics",
+                            timeout=aiohttp.ClientTimeout(total=3)) as r:
+                        body = await r.text()
+                    for line in body.splitlines():
+                        if (line.startswith(
+                                "dynamo_worker_migration_replays_total")
+                                and not line.startswith("#")):
+                            replays += float(line.rsplit(" ", 1)[1])
+                except Exception:  # noqa: BLE001 — scrape is best-effort
+                    pass
+            async with http.get(
+                    f"http://127.0.0.1:{system.port}/metrics",
+                    timeout=aiohttp.ClientTimeout(total=3)) as r:
+                planner_scrape = await r.text()
+
+        ttfts.sort()
+        result = {
+            "phases": FLEET_PHASES,
+            "requests": stats["issued"],
+            "completed": stats["completed"],
+            "shed": stats["shed"],
+            "streams_lost": stats["lost"],
+            "sustained_rps": round(stats["completed"] / max(trace_wall, 1e-9),
+                                   2),
+            "ttft_p99_s": (round(ttfts[int(len(ttfts) * 0.99) - 1], 3)
+                           if ttfts else None),
+            "replicas_peak": replicas_peak,
+            "scale_up_replicas": events.get("scale_up_replicas"),
+            "healed_crashes": int(
+                pm.worker_crashes_total.labels("decode")._value.get()
+                - crashes0),
+            "crash_loop_holds": int(
+                pm.crash_loop_holds_total._value.get() - holds0),
+            "decisions_up": int(
+                pm.decisions_total.labels("up")._value.get() - ups0),
+            "decisions_down": int(
+                pm.decisions_total.labels("down")._value.get() - downs0),
+            "promote_s": events.get("promote_s"),
+            "drained_to": events.get("drained_to"),
+            "migration_replays": int(replays),
+            "planner_metrics_on_http": (
+                "dynamo_planner_replicas" in planner_scrape
+                and "dynamo_planner_worker_crashes_total" in planner_scrape),
+            "errors": errors[:5],
+        }
+        _ckpt("fleet", **{k: v for k, v in result.items() if k != "errors"})
+        return result
+    finally:
+        with contextlib.suppress(Exception):
+            await planner.stop()
+        with contextlib.suppress(Exception):
+            await conn.close(force=True)
+        if fe is not None:
+            with contextlib.suppress(Exception):
+                await fe.close()
+        with contextlib.suppress(Exception):
+            await system.stop()
+        with contextlib.suppress(Exception):
+            await pair.stop()
+
+
 async def run_attempt(args) -> dict:
     """The whole attempt, one process: build -> prime -> measure ->
     transports -> optional attn-impl A/B. ``jax_init`` already happened in
@@ -1376,6 +1690,15 @@ async def run_attempt(args) -> dict:
         result["coord_failover"] = await _measure_coord_failover(wd)
     except Exception as e:  # noqa: BLE001 — best-effort extra data
         result["coord_failover"] = {"error": str(e)[:300]}
+    print(json.dumps(result), flush=True)
+
+    # fleet-supervisor leg: planner-driven autoscaling over a live mocker
+    # fleet — burst scale-up, worker kill -9 auto-healed, coordinator
+    # kill -9 absorbed, drain scale-down; streams_lost must be 0 for all
+    try:
+        result["fleet"] = await _measure_fleet(wd)
+    except Exception as e:  # noqa: BLE001 — best-effort extra data
+        result["fleet"] = {"error": str(e)[:300]}
     print(json.dumps(result), flush=True)
 
     # attn-impl A/B in the SAME process (round-4 open question:

@@ -8,15 +8,18 @@ worker processes directly (no circus), and the k8s connector publishes
 desired counts to the coordinator KV for an operator to reconcile.
 """
 
+from dynamo_tpu.planner.connectors import KvConnector, LocalConnector
 from dynamo_tpu.planner.load_predictor import (
     ConstantPredictor,
     EwmaPredictor,
     TrendPredictor,
     make_predictor,
 )
+from dynamo_tpu.planner.metrics import PlannerMetrics, get_planner_metrics
 from dynamo_tpu.planner.perf_interpolation import PerfInterpolator
 from dynamo_tpu.planner.planner_core import Planner, PlannerConfig, SloSpec
 
 __all__ = ["ConstantPredictor", "EwmaPredictor", "TrendPredictor",
            "make_predictor", "PerfInterpolator", "Planner", "PlannerConfig",
-           "SloSpec"]
+           "SloSpec", "LocalConnector", "KvConnector", "PlannerMetrics",
+           "get_planner_metrics"]

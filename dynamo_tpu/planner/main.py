@@ -40,6 +40,19 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--coordinator", default=None,
                    help="coordinator address (kv connector)")
     p.add_argument("--namespace", default="dynamo")
+    # fleet-supervisor knobs (local connector)
+    p.add_argument("--no-heal", action="store_true",
+                   help="disable crash-healing (supervise counts only)")
+    p.add_argument("--term-grace-s", type=float, default=None,
+                   help="SIGKILL escalation deadline for a drain-down "
+                        "(clamped up to DYN_DRAIN_TIMEOUT_S + margin)")
+    p.add_argument("--crash-loop-threshold", type=int, default=5,
+                   help="crashes inside the window that trip hold-down")
+    p.add_argument("--crash-loop-window-s", type=float, default=60.0)
+    p.add_argument("--crash-loop-hold-s", type=float, default=60.0)
+    p.add_argument("--worker-log-dir", default=None,
+                   help="directory for per-worker log files (default: "
+                        "a fresh temp dir)")
     return p
 
 
@@ -51,8 +64,13 @@ async def amain(args: argparse.Namespace) -> None:
     if args.connector == "local":
         if not args.prefill_cmd or not args.decode_cmd:
             raise SystemExit("--prefill-cmd/--decode-cmd required for local")
-        connector = LocalConnector(shlex.split(args.prefill_cmd),
-                                   shlex.split(args.decode_cmd))
+        connector = LocalConnector(
+            shlex.split(args.prefill_cmd), shlex.split(args.decode_cmd),
+            term_grace_s=args.term_grace_s, heal=not args.no_heal,
+            crash_loop_threshold=args.crash_loop_threshold,
+            crash_loop_window_s=args.crash_loop_window_s,
+            crash_loop_hold_s=args.crash_loop_hold_s,
+            log_dir=args.worker_log_dir)
     else:
         from dynamo_tpu.planner.metrics_source import QueueAwareSource
         from dynamo_tpu.runtime.runtime import DistributedRuntime
@@ -68,8 +86,28 @@ async def amain(args: argparse.Namespace) -> None:
                       max_decode=args.max_decode),
         SloSpec(ttft_s=args.ttft_slo, itl_s=args.itl_slo),
         interp, source, connector)
+    # the planner's own system server (DYN_SYSTEM_ENABLED=1): replicas,
+    # decision counts, crash/hold counters on /metrics
+    from dynamo_tpu.planner.metrics import get_planner_metrics
+    from dynamo_tpu.runtime.system_server import SystemServer
+    system = SystemServer.from_env(registry=get_planner_metrics().registry)
+    if system is not None:
+        system.health.register("planner", ready=True)
+        await system.start()
     print("planner running", flush=True)
-    await planner.run()
+    try:
+        # bootstrap the fleet to the configured floor: Planner.step only
+        # calls the connector when a decision DIFFERS from current, and
+        # current starts at (min_prefill, min_decode) — without this, an
+        # idle start would never spawn the first worker
+        await connector.scale(args.min_prefill, args.min_decode)
+        await planner.run()
+    finally:
+        if system is not None:
+            await system.stop()
+        close = getattr(connector, "close", None)
+        if close is not None:
+            await close()
 
 
 def main() -> None:

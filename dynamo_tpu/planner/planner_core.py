@@ -196,11 +196,44 @@ class Planner:
 
     # -- the loop ----------------------------------------------------------
 
+    def _count_decision(self, decision: PlanDecision) -> None:
+        """Best-effort ``dynamo_planner_decisions_total{action}`` accounting
+        (a mixed decision — one pool up, the other down — counts both)."""
+        from dynamo_tpu.planner.metrics import count_metric
+        grew = (decision.prefill > self.current.prefill
+                or decision.decode > self.current.decode)
+        shrank = (decision.prefill < self.current.prefill
+                  or decision.decode < self.current.decode)
+        if grew:
+            count_metric("decisions_total", "up")
+        if shrank:
+            count_metric("decisions_total", "down")
+        if not grew and not shrank:
+            if (decision.prefill_config != self.current.prefill_config
+                    or decision.decode_config != self.current.decode_config):
+                count_metric("decisions_total", "reconfig")
+            else:
+                count_metric("decisions_total", "hold")
+
+    def _export_replicas(self) -> None:
+        """Mirror the connector's READY counts onto the replicas gauge (a
+        connector without ``counts()`` — e.g. ``KvConnector`` — exports the
+        desired counts instead: the operator owns observed state there)."""
+        from dynamo_tpu.planner.metrics import set_replicas
+        counts = getattr(self.connector, "counts", None)
+        if callable(counts):
+            for role, n in counts().items():
+                set_replicas(role, n)
+        else:
+            set_replicas("prefill", self.current.prefill)
+            set_replicas("decode", self.current.decode)
+
     async def step(self) -> Optional[PlanDecision]:
         sample = await self.source.sample()
         if sample is None:
             return None
         decision = self.decide(sample)
+        self._count_decision(decision)
         if (decision.prefill != self.current.prefill
                 or decision.decode != self.current.decode
                 or decision.prefill_config != self.current.prefill_config
@@ -216,6 +249,7 @@ class Planner:
                 prefill_config=decision.prefill_config,
                 decode_config=decision.decode_config)
         self.current = decision
+        self._export_replicas()
         return decision
 
     async def run(self) -> None:

@@ -464,5 +464,71 @@ class WorkerDrain:
                 await stop()
 
 
+def stub_worker_cmd(ready_after_s: float = 0.0,
+                    exit_after_s: Optional[float] = None,
+                    exit_code: int = 1,
+                    drain_s: float = 0.0,
+                    ignore_term: bool = False,
+                    banner: str = "stub worker up") -> list:
+    """Command line for a scripted minimal fake worker — the fleet
+    supervisor's unit-test counterpart to the mocker.
+
+    The child honors the supervisor contract without importing anything
+    heavy: it serves ``/healthz/ready`` on ``DYN_SYSTEM_PORT`` (503 until
+    ``ready_after_s`` has elapsed), answers ``POST /drain`` with 202 and
+    exits 0 after ``drain_s`` (how long its pretend migration takes),
+    treats SIGTERM the same way (or ignores it with ``ignore_term`` — the
+    SIGKILL-escalation drill), prints ``banner`` to stdout (log-capture
+    assertions), and optionally crashes with ``exit_code`` after
+    ``exit_after_s``.
+    """
+    import sys as _sys
+    script = f"""
+import http.server, os, signal, sys, threading, time
+T0 = time.monotonic()
+def bail(code, delay=0.0):
+    def run():
+        time.sleep(delay); os._exit(code)
+    threading.Thread(target=run, daemon=True).start()
+class H(http.server.BaseHTTPRequestHandler):
+    def log_message(self, *a): pass
+    def do_GET(self):
+        if self.path == '/healthz/ready':
+            ok = time.monotonic() - T0 >= {ready_after_s!r}
+            self.send_response(200 if ok else 503); self.end_headers()
+            self.wfile.write(b'ready' if ok else b'not ready')
+        else:
+            self.send_response(404); self.end_headers()
+    def do_POST(self):
+        if self.path == '/drain':
+            self.send_response(202); self.end_headers()
+            self.wfile.write(b'draining')
+            if not {ignore_term!r}:
+                bail(0, {drain_s!r})
+        else:
+            self.send_response(404); self.end_headers()
+port = int(os.environ.get('DYN_SYSTEM_PORT', '0') or 0)
+if port:
+    srv = http.server.ThreadingHTTPServer(('127.0.0.1', port), H)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+if {ignore_term!r}:
+    signal.signal(signal.SIGTERM, signal.SIG_IGN)
+else:
+    signal.signal(signal.SIGTERM, lambda *a: bail(0, {drain_s!r}))
+print({banner!r}, flush=True)
+"""
+    if exit_after_s is not None:
+        script += f"""
+time.sleep({exit_after_s!r})
+print('stub worker exiting rc={exit_code}', flush=True)
+sys.exit({exit_code!r})
+"""
+    script += """
+while True:
+    time.sleep(3600)
+"""
+    return [_sys.executable, "-c", script]
+
+
 __all__ = ["ChaosProxy", "CoordinatorOutage", "CoordinatorPair",
-           "WorkerDrain"]
+           "WorkerDrain", "stub_worker_cmd"]

@@ -27,6 +27,9 @@ def test_single_child_attempt_chain():
     # short long-context leg so the smoke chain stays inside its budget
     # (the default 4k/16k/32k curve is the real bench's)
     env["BENCH_LONGCTX"] = "4096,8192"
+    # short fleet phases so the supervisor leg (a ~30s trace at the real
+    # bench's defaults) stays inside the smoke chain's budget
+    env["BENCH_FLEET_PHASES"] = "2rps:4s,10rps:8s,2rps:5s"
     env.pop("JAX_PLATFORMS", None)
     r = subprocess.run(
         [sys.executable, BENCH, "--budget", "420", "--tier", "tiny"],
@@ -65,6 +68,20 @@ def test_single_child_attempt_chain():
     assert cf["streams_lost"] == 0
     assert cf["lease_regrants"] == 0
     assert 0 < cf["ready_s"] < cf["pr3_cold_restart_ref_s"]
+    # fleet-supervisor leg: planner scale-up on the burst, worker kill -9
+    # auto-healed, coordinator kill -9 absorbed, drain scale-down — and
+    # not one stream lost across any of those events
+    fl = result["fleet"]
+    assert "error" not in fl, fl
+    assert fl["streams_lost"] == 0, fl
+    assert fl["completed"] == fl["requests"] - fl["shed"]
+    assert fl["replicas_peak"] >= 2
+    assert fl["healed_crashes"] >= 1
+    assert fl["crash_loop_holds"] == 0
+    assert fl["drained_to"] == 1
+    assert fl["decisions_up"] >= 1 and fl["decisions_down"] >= 1
+    assert fl["promote_s"] is not None and fl["promote_s"] < 10
+    assert fl["planner_metrics_on_http"] is True
     # the continuous-arrival mixed-vs-legacy A/B ran on both engines.
     # jax sub-leg: CPU dispatch overhead is ~0, so only liveness is
     # asserted (the throughput separation is the on-chip/mocker story).

@@ -16,7 +16,13 @@ from dynamo_tpu.http.client import HttpClientError, OpenAIClient
 from dynamo_tpu.http.service import HttpService
 from dynamo_tpu.llm.model_manager import ModelManager
 from dynamo_tpu.llm.pipeline import LocalEnginePipeline
-from dynamo_tpu.trace_gen import TraceConfig, generate, prefix_share_ratio
+from dynamo_tpu.trace_gen import (
+    TraceConfig,
+    default_cohorts,
+    generate,
+    parse_phases,
+    prefix_share_ratio,
+)
 from dynamo_tpu.utils.testing import make_test_card
 
 
@@ -99,6 +105,66 @@ class TestTraceGen:
                                          zipf_a=5.0, shared_blocks=1,
                                          seed=1)))
         assert prefix_share_ratio(lone) < ratio
+
+    def test_parse_phases(self):
+        assert parse_phases("8rps:30s,40rps:60s,8:30") == [
+            (8.0, 30.0), (40.0, 60.0), (8.0, 30.0)]
+        with pytest.raises(ValueError):
+            parse_phases("fast:30s")
+        with pytest.raises(ValueError):
+            parse_phases("8rps")
+
+    def test_phased_arrivals_follow_schedule(self):
+        cfg = TraceConfig(num_requests=100_000, seed=3,
+                          phases=[(5.0, 20.0), (50.0, 10.0), (5.0, 20.0)])
+        rows = list(generate(cfg))
+        ts = [r["timestamp"] for r in rows]
+        assert ts == sorted(ts)
+        assert ts[-1] <= 50_000  # all arrivals inside the schedule
+        by_phase = [0, 0, 0]
+        for t in ts:
+            by_phase[0 if t < 20_000 else (1 if t < 30_000 else 2)] += 1
+        # burst phase: 10x the rate over half the window of a low phase
+        # -> must dominate each low phase by well over the Poisson noise
+        assert by_phase[1] > 2.5 * by_phase[0]
+        assert by_phase[1] > 2.5 * by_phase[2]
+        # low phases: ~100 expected each; loose 3-sigma-ish band
+        assert 60 < by_phase[0] < 150
+        assert 60 < by_phase[2] < 150
+
+    def test_cohorts_tag_rows_and_keep_prefixes_disjoint(self):
+        cohorts = default_cohorts()
+        cfg = TraceConfig(num_requests=300, requests_per_s=50.0, seed=5,
+                          cohorts=cohorts)
+        rows = list(generate(cfg))
+        names = {r["cohort"] for r in rows}
+        assert names == {c.name for c in cohorts}
+        # every row carries its cohort's sampling params (the guided
+        # cohort must reach the constrained-decoding surface)
+        for r in rows:
+            assert "sampling" in r
+        guided = [r for r in rows if r["cohort"] == "guided"]
+        assert guided and all(
+            r["sampling"].get("response_format", {}).get("type")
+            == "json_object" for r in guided)
+        # shared-prefix id spaces must not collide across cohorts: a
+        # short_chat prefix block reused by long_context would fake
+        # cross-cohort KV hits the router could never see in production
+        prefix_blocks = {}
+        for r in rows:
+            spec = next(c for c in cohorts if c.name == r["cohort"])
+            for h in r["hash_ids"][:spec.shared_blocks]:
+                prefix_blocks.setdefault(h, set()).add(r["cohort"])
+        assert all(len(v) == 1 for v in prefix_blocks.values())
+
+    def test_legacy_output_unchanged_by_cohort_machinery(self):
+        # the flat-rate path must stay byte-identical: downstream bench
+        # legs pin numbers against traces generated before cohorts landed
+        cfg = TraceConfig(num_requests=50, seed=42)
+        rows = list(generate(cfg))
+        assert all("cohort" not in r and "sampling" not in r for r in rows)
+        assert {"timestamp", "input_length", "output_length",
+                "hash_ids"} == set(rows[0])
 
     def test_cli_writes_jsonl(self, tmp_path):
         out = tmp_path / "trace.jsonl"

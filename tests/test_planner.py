@@ -5,6 +5,9 @@ Model: reference ``components/planner/test/*`` (mocked connectors/metrics).
 
 import asyncio
 import json
+import os
+import sys
+import time
 
 import pytest
 
@@ -18,8 +21,24 @@ from dynamo_tpu.planner import (
     TrendPredictor,
     make_predictor,
 )
-from dynamo_tpu.planner.connectors import KvConnector, planner_desired_key
+from dynamo_tpu.planner.connectors import (
+    KvConnector,
+    LocalConnector,
+    planner_desired_key,
+)
+from dynamo_tpu.planner.metrics import get_planner_metrics
 from dynamo_tpu.planner.planner_core import TrafficSample
+from dynamo_tpu.utils.faults import stub_worker_cmd
+
+
+async def poll_until(cond, timeout=10.0, msg="condition"):
+    """Event-gated wait: poll a predicate under a deadline (no fixed
+    sleeps — the PR 7 deflake pattern)."""
+    deadline = asyncio.get_running_loop().time() + timeout
+    while not cond():
+        if asyncio.get_running_loop().time() >= deadline:
+            raise TimeoutError(f"{msg} never became true")
+        await asyncio.sleep(0.02)
 
 PROFILE = {
     "prefill": [
@@ -255,3 +274,252 @@ class TestMultiConfigPlanning:
         assert d.decode_config == {"tp": 4, "sp": 1}
         # the connector saw the chosen configs
         assert conn.calls[-1][3] == {"tp": 4, "sp": 1}
+
+
+def fast_connector(prefill_cmd, decode_cmd, **kw):
+    """LocalConnector tuned for event-gated tests: tight supervise/probe
+    cadence, tiny restart backoff."""
+    defaults = dict(supervise_interval_s=0.02, probe_interval_s=0.02,
+                    backoff_base_s=0.01, backoff_cap_s=0.05,
+                    drain_margin_s=0.2)
+    defaults.update(kw)
+    return LocalConnector(prefill_cmd, decode_cmd, **defaults)
+
+
+class TestFleetSupervisor:
+    """The LocalConnector as a fleet supervisor: readiness gating,
+    drain-aware shrink, crash-healing, crash-loop hold-down — against
+    scripted stub workers (``utils/faults.stub_worker_cmd``)."""
+
+    async def test_readiness_gates_counts(self, monkeypatch):
+        monkeypatch.setenv("DYN_DRAIN_TIMEOUT_S", "0.2")
+        c = fast_connector(stub_worker_cmd(ready_after_s=0.4),
+                           stub_worker_cmd())
+        try:
+            await c.scale(1, 1)
+            # both alive immediately; only the instantly-ready decode
+            # counts until the prefill's /healthz/ready flips to 200
+            assert c.alive_counts() == {"prefill": 1, "decode": 1}
+            assert c.counts()["prefill"] == 0
+            await c.wait_ready("decode", 1, timeout=10)
+            assert c.counts()["prefill"] == 0  # still compiling
+            await c.wait_ready("prefill", 1, timeout=10)
+            assert c.counts() == {"prefill": 1, "decode": 1}
+        finally:
+            await c.close(force=True)
+
+    async def test_drain_aware_scale_down_is_not_a_crash(self, monkeypatch):
+        monkeypatch.setenv("DYN_DRAIN_TIMEOUT_S", "0.5")
+        crashes0 = get_planner_metrics().worker_crashes_total.labels(
+            "decode")._value.get()
+        c = fast_connector(stub_worker_cmd(),
+                           stub_worker_cmd(drain_s=0.05))
+        try:
+            await c.scale(0, 2)
+            await c.wait_ready("decode", 2, timeout=10)
+            await c.scale(0, 1)
+            await c.quiesce()
+            await poll_until(lambda: c.alive_counts()["decode"] == 1,
+                             msg="shrink to 1")
+            # the worker drained and exited 0 on request: NOT a crash, and
+            # the supervisor must not "heal" the slot back
+            assert get_planner_metrics().worker_crashes_total.labels(
+                "decode")._value.get() == crashes0
+            assert c.counts()["decode"] == 1
+        finally:
+            await c.close(force=True)
+
+    async def test_sigkill_escalation_waits_out_drain_budget(
+            self, monkeypatch):
+        """Regression for the SIGKILL-during-drain race: an explicit
+        term_grace_s BELOW the drain budget must be clamped up, so a
+        worker mid-migration is never killed inside the budget."""
+        monkeypatch.setenv("DYN_DRAIN_TIMEOUT_S", "0.6")
+        c = fast_connector(stub_worker_cmd(),
+                           stub_worker_cmd(ignore_term=True),
+                           term_grace_s=0.05, heal=False)
+        assert c.effective_term_grace_s() == pytest.approx(0.8)
+        try:
+            await c.scale(0, 1)
+            await c.wait_ready("decode", 1, timeout=10)
+            t0 = time.monotonic()
+            await c.scale(0, 0)
+            await c.quiesce()
+            elapsed = time.monotonic() - t0
+            # the stub ignores drain AND SIGTERM: the kill may only land
+            # after the full budget+margin, never at term_grace_s=0.05
+            assert elapsed >= 0.6, elapsed
+            assert c.alive_counts()["decode"] == 0
+        finally:
+            await c.close(force=True)
+
+    async def test_term_grace_default_tracks_drain_budget(self, monkeypatch):
+        monkeypatch.setenv("DYN_DRAIN_TIMEOUT_S", "7.5")
+        c = LocalConnector(["x"], ["y"])  # default margin 5s
+        assert c.effective_term_grace_s() == pytest.approx(12.5)
+        # an explicit grace ABOVE the budget is honored as-is
+        c2 = LocalConnector(["x"], ["y"], term_grace_s=60.0)
+        assert c2.effective_term_grace_s() == pytest.approx(60.0)
+
+    async def test_crash_heal_with_backoff(self, monkeypatch):
+        monkeypatch.setenv("DYN_DRAIN_TIMEOUT_S", "0.2")
+        crashes = get_planner_metrics().worker_crashes_total.labels("decode")
+        crashes0 = crashes._value.get()
+        c = fast_connector(stub_worker_cmd(),
+                           stub_worker_cmd(exit_after_s=0.05, exit_code=2),
+                           crash_loop_threshold=1000)
+        try:
+            await c.scale(0, 1)
+            # every replacement also dies -> the supervisor keeps healing
+            # under backoff; two healed crashes prove the respawn loop
+            await poll_until(lambda: crashes._value.get() >= crashes0 + 2,
+                             timeout=15, msg="two healed crashes")
+            assert c._backoff["decode"] > 0  # jittered backoff engaged
+            assert not c.held_roles()
+        finally:
+            await c.close(force=True)
+
+    async def test_crash_loop_hold_down(self, monkeypatch):
+        monkeypatch.setenv("DYN_DRAIN_TIMEOUT_S", "0.2")
+        pm = get_planner_metrics()
+        holds0 = pm.crash_loop_holds_total._value.get()
+        c = fast_connector(stub_worker_cmd(),
+                           stub_worker_cmd(exit_after_s=0.02, exit_code=3),
+                           crash_loop_threshold=3, crash_loop_window_s=10.0,
+                           crash_loop_hold_s=120.0)
+        try:
+            await c.scale(0, 1)
+            await poll_until(lambda: "decode" in c.held_roles(),
+                             timeout=15, msg="crash-loop hold-down")
+            assert pm.crash_loop_holds_total._value.get() >= holds0 + 1
+            # held: no fork bomb — the pool stays empty and the crash
+            # counter stops moving
+            crashes_at_hold = pm.worker_crashes_total.labels(
+                "decode")._value.get()
+            await asyncio.sleep(0.3)  # bounded negative check
+            assert c.alive_counts()["decode"] == 0
+            assert pm.worker_crashes_total.labels(
+                "decode")._value.get() == crashes_at_hold
+        finally:
+            await c.close(force=True)
+
+    async def test_worker_output_captured_and_exit_logged(self, monkeypatch):
+        """Satellite bugfix: no more DEVNULL — stdout/stderr land in a
+        per-worker log file and a nonzero exit is logged with its code."""
+        monkeypatch.setenv("DYN_DRAIN_TIMEOUT_S", "0.2")
+        c = fast_connector(stub_worker_cmd(),
+                           stub_worker_cmd(exit_after_s=0.05, exit_code=9,
+                                           banner="hello from the worker"),
+                           heal=False)
+        try:
+            await c.scale(0, 1)
+            await poll_until(lambda: c.alive_counts()["decode"] == 0,
+                             msg="stub exit")
+            logs = [open(os.path.join(c.log_dir, f)).read()
+                    for f in os.listdir(c.log_dir)]
+            assert any("hello from the worker" in t for t in logs)
+            assert any("rc=9" in t for t in logs)
+        finally:
+            await c.close(force=True)
+
+
+class TestPlannerDecisionMetrics:
+    async def test_decisions_counted_by_direction(self):
+        pm = get_planner_metrics()
+
+        def counts():
+            return {a: pm.decisions_total.labels(a)._value.get()
+                    for a in ("up", "down", "hold")}
+
+        before = counts()
+        heavy = TrafficSample(request_rate=200, avg_isl=1024, avg_osl=256)
+        idle = TrafficSample(request_rate=0.01, avg_isl=64, avg_osl=16)
+        planner, _ = make_planner([heavy, heavy, idle])
+        await planner.step()   # (1,1) -> big: up
+        await planner.step()   # unchanged: hold
+        await planner.step()   # back to min: down
+        after = counts()
+        assert after["up"] >= before["up"] + 1
+        assert after["hold"] >= before["hold"] + 1
+        assert after["down"] >= before["down"] + 1
+
+
+@pytest.mark.chaos
+class TestMockerFleetSupervision:
+    """The supervisor against a REAL mocker fleet: readiness-gated
+    scale-up, then a planner-driven drain scale-down with an in-flight
+    stream surviving through the migration path."""
+
+    async def test_drain_scale_down_stream_survives(self, monkeypatch):
+        monkeypatch.setenv("DYN_DRAIN_TIMEOUT_S", "10")
+        from dynamo_tpu.llm.pipeline import RemotePipeline
+        from dynamo_tpu.protocols.common import (FinishReason,
+                                                 PreprocessedRequest,
+                                                 SamplingOptions,
+                                                 StopConditions)
+        from dynamo_tpu.runtime.coordinator import Coordinator
+        from dynamo_tpu.runtime.push_router import PushRouter
+        from dynamo_tpu.runtime.runtime import DistributedRuntime
+        from dynamo_tpu.utils.testing import make_test_card
+
+        def make_req(tokens, rid, max_tokens):
+            return PreprocessedRequest(
+                token_ids=list(tokens), request_id=rid,
+                stop_conditions=StopConditions(max_tokens=max_tokens,
+                                               ignore_eos=True),
+                sampling_options=SamplingOptions(temperature=0.0))
+
+        async def drive(pipeline, req, started):
+            frames = []
+            async for out in pipeline.engine_stream(req):
+                frames.append(out)
+                if sum(len(f.token_ids) for f in frames) >= 2:
+                    started.set()
+            started.set()
+            return frames
+
+        coord = await Coordinator(port=0).start()
+        conn = fe = None
+        try:
+            mocker_cmd = [sys.executable, "-m", "dynamo_tpu.mocker.main",
+                          "--coordinator", coord.address,
+                          "--speedup-ratio", "1", "--page-size", "4"]
+            conn = fast_connector(stub_worker_cmd(), mocker_cmd,
+                                  extra_env={"JAX_PLATFORMS": "cpu"},
+                                  supervise_interval_s=0.1)
+            await conn.scale(0, 2)
+            # readiness-gated: neither counts until its system server's
+            # /healthz/ready (registration + coordinator link) goes 200
+            assert conn.counts()["decode"] == 0
+            await conn.wait_ready("decode", 2, timeout=90)
+            fe = await DistributedRuntime.create(coordinator=coord.address)
+            client = await (fe.namespace("dynamo").component("mocker")
+                            .endpoint("generate").client())
+            await client.wait_for_instances(2, timeout=15)
+            card = make_test_card(name="mock-model", kv_cache_block_size=4)
+            pipeline = RemotePipeline(card, PushRouter(client),
+                                      migration_limit=3)
+            # one stream per worker (round-robin over two instances)
+            reqs = [make_req(range(1 + i, 10 + i), f"fleet{i}",
+                             max_tokens=80) for i in range(2)]
+            events = [asyncio.Event() for _ in reqs]
+            tasks = [asyncio.ensure_future(drive(pipeline, r, ev))
+                     for r, ev in zip(reqs, events)]
+            await asyncio.gather(*[asyncio.wait_for(ev.wait(), 30)
+                                   for ev in events])
+            # the planner decides to shrink: the drained worker's stream
+            # must ride the migration path onto the survivor — zero lost
+            await conn.scale(0, 1)
+            all_frames = await asyncio.gather(*tasks)
+            for req, frames in zip(reqs, all_frames):
+                toks = [t for f in frames for t in f.token_ids]
+                assert len(toks) == 80, (req.request_id, len(toks))
+                assert frames[-1].finish_reason == FinishReason.LENGTH
+            await conn.quiesce()
+            assert conn.alive_counts()["decode"] == 1
+        finally:
+            if conn is not None:
+                await conn.close(force=True)
+            if fe is not None:
+                await fe.close()
+            await coord.stop()

@@ -31,6 +31,7 @@ def registered_metric_names() -> "set[str]":
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     from dynamo_tpu.http.metrics import (CoordClientMetrics,
                                          CoordinatorMetrics, FrontendMetrics)
+    from dynamo_tpu.planner.metrics import PlannerMetrics
     from dynamo_tpu.worker.metrics import WorkerMetrics
 
     names: set = set()
@@ -43,7 +44,8 @@ def registered_metric_names() -> "set[str]":
     CoordinatorMetrics(types.SimpleNamespace(
         role="primary", failovers_total=0, replication_lag_ops=0,
         standbys_attached=0), registry=fm.registry)
-    for registry in (fm.registry, WorkerMetrics().registry):
+    for registry in (fm.registry, WorkerMetrics().registry,
+                     PlannerMetrics().registry):
         for family in registry.collect():
             if family.type == "counter":
                 names.add(f"{family.name}_total")
