@@ -1493,6 +1493,231 @@ async def _measure_fleet(wd=None) -> dict:
             await pair.stop()
 
 
+ROUTING_REQS = int(os.environ.get("BENCH_ROUTING_REQS", "32"))
+ROUTING_CONC = int(os.environ.get("BENCH_ROUTING_CONC", "8"))
+ROUTING_STALL = os.environ.get("BENCH_ROUTING_STALL", "0.25,0.45")
+
+
+async def _measure_routing(wd=None) -> dict:
+    """Failure-aware routing leg: a same-run cost-vs-round-robin A/B over
+    a 4-worker mocker fleet where one worker sits behind a ChaosProxy in
+    per-connection tail-latency mode (``delay_jitter`` — the slow-but-
+    alive worker keepalive cannot see).  The round-robin leg keeps
+    sending it every 4th request and eats the stalls; the cost leg
+    hedges the slow first token, learns the worker's EWMA TTFT from the
+    lost race, opens its breaker via slow-call accounting, and routes
+    around it.  Headline: cost p99 TTFT < RR p99 TTFT in the same run,
+    with zero lost streams on both legs, the breaker open/close visible
+    on /metrics, and the decision's score inputs retrievable from
+    /v1/traces."""
+    import socket
+
+    import aiohttp
+
+    from dynamo_tpu.http.service import HttpService
+    from dynamo_tpu.llm.model_manager import ModelManager, ModelWatcher
+    from dynamo_tpu.llm.register import register_llm, serve_engine
+    from dynamo_tpu.mocker.engine import MockEngineArgs, MockerEngine
+    from dynamo_tpu.runtime.coordinator import Coordinator
+    from dynamo_tpu.runtime.push_router import RouterMode
+    from dynamo_tpu.runtime.resilience import (
+        RouterPolicyConfig, get_router_stats)
+    from dynamo_tpu.runtime.runtime import DistributedRuntime
+    from dynamo_tpu.utils.faults import ChaosProxy
+    from dynamo_tpu.utils.testing import make_test_card
+
+    if wd is not None:
+        wd.arm("measure:routing", STAGE_BUDGETS["measure"])
+
+    smin, smax = (float(x) for x in ROUTING_STALL.split(","))
+    coord = await Coordinator(port=0).start()
+    drts: list = []
+    engines: list = []
+    proxy = None
+
+    async def start_worker(env=None):
+        saved = {}
+        if env:
+            for k, v in env.items():
+                saved[k] = os.environ.get(k)
+                os.environ[k] = v
+        try:
+            drt = await DistributedRuntime.create(coordinator=coord.address)
+            drts.append(drt)
+            engine = MockerEngine(MockEngineArgs(
+                num_pages=2048, page_size=4, max_num_seqs=16,
+                max_prefill_chunk=64, max_context=2048,
+                speedup_ratio=100.0))
+            engines.append(engine)
+            ep = (drt.namespace("dynamo").component("routing")
+                  .endpoint("generate"))
+            await serve_engine(
+                ep, engine,
+                stats_provider=lambda e=engine: e.stats().to_dict())
+            await register_llm(drt, ep, make_test_card(
+                name="mock-model", kv_cache_block_size=4))
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+    async def run_leg(mode, policy_config=None):
+        fe = await DistributedRuntime.create(coordinator=coord.address)
+        manager = ModelManager()
+        watcher = ModelWatcher(fe, manager, router_mode=mode,
+                               policy_config=policy_config)
+        await watcher.start()
+        service = await HttpService(manager, host="127.0.0.1",
+                                    port=0).start()
+        base = f"http://127.0.0.1:{service.port}"
+        ttfts: list = []
+        errors: list = []
+        lost = 0
+        sem = asyncio.Semaphore(ROUTING_CONC)
+
+        async def one(i, session):
+            nonlocal lost
+            # leg-distinct prompts so the KV-free mocker never shortcuts
+            body = {"model": "mock-model",
+                    "messages": [{"role": "user",
+                                  "content": f"{mode.value} probe {i} "
+                                             + "lorem ipsum dolor " * 4}],
+                    "max_tokens": 4, "stream": True}
+            async with sem:
+                t0 = time.perf_counter()
+                first = None
+                try:
+                    async with session.post(
+                            f"{base}/v1/chat/completions", json=body,
+                            timeout=aiohttp.ClientTimeout(total=90)) as r:
+                        async for line in r.content:
+                            if (line.startswith(b"data:")
+                                    and b"[DONE]" not in line
+                                    and first is None):
+                                first = time.perf_counter() - t0
+                    if first is None:
+                        lost += 1
+                    else:
+                        ttfts.append(first)
+                except Exception as e:  # noqa: BLE001 — a lost stream is data
+                    lost += 1
+                    errors.append(f"{mode.value}-{i}: {str(e)[:120]}")
+
+        scrape = {"metrics": "", "trace_attrs_ok": False}
+        try:
+            async with aiohttp.ClientSession() as session:
+                await asyncio.gather(*[one(i, session)
+                                       for i in range(ROUTING_REQS)])
+                async with session.get(
+                        f"{base}/metrics",
+                        timeout=aiohttp.ClientTimeout(total=5)) as r:
+                    scrape["metrics"] = await r.text()
+                # decision score inputs must be retrievable post-hoc from
+                # the flight recorder
+                async with session.get(
+                        f"{base}/v1/traces?limit=5",
+                        timeout=aiohttp.ClientTimeout(total=5)) as r:
+                    summaries = (await r.json()).get("traces", [])
+                for s in summaries:
+                    async with session.get(
+                            f"{base}/v1/traces/{s['trace_id']}",
+                            timeout=aiohttp.ClientTimeout(total=5)) as r:
+                        detail = await r.text()
+                    if '"router.policy"' in detail and \
+                            '"router.instance"' in detail:
+                        scrape["trace_attrs_ok"] = True
+                        break
+        finally:
+            await service.stop()
+            await watcher.stop()
+            await fe.close()
+        ttfts.sort()
+        pick = lambda q: (round(ttfts[min(len(ttfts) - 1,  # noqa: E731
+                                          int(len(ttfts) * q))], 3)
+                          if ttfts else None)
+        return {"completed": len(ttfts), "streams_lost": lost,
+                "ttft_p50_s": pick(0.50), "ttft_p95_s": pick(0.95),
+                "ttft_p99_s": pick(0.99), "errors": errors[:3]}, scrape
+
+    try:
+        for _ in range(3):
+            await start_worker()
+        # the slow worker: RPC pinned to a pre-picked port, announcing the
+        # ChaosProxy's address instead (DYN_RPC_ADVERTISE) so every RPC —
+        # requests, stats scrapes — pays the proxy's per-connection stall
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        upstream_port = s.getsockname()[1]
+        s.close()
+        proxy = await ChaosProxy(f"127.0.0.1:{upstream_port}").start()
+        await start_worker(env={
+            "DYN_RPC_PORT": str(upstream_port),
+            "DYN_RPC_ADVERTISE": f"127.0.0.1:{proxy.port}"})
+        proxy.delay_jitter(1.0, smin, smax, seed=9)
+
+        rr, _ = await run_leg(RouterMode.ROUND_ROBIN)
+
+        st = get_router_stats()
+        tr0 = dict(st.breaker_transitions)
+        hg0 = dict(st.hedges)
+        rt0 = dict(st.retries)
+        # slow-call threshold == hedge delay: a primary that loses the
+        # hedge race has by construction been silent longer than the
+        # delay, so one lost race opens its breaker (failures=1) — while
+        # healthy first tokens (~tens of ms) stay far below it
+        hedge_delay = max(0.1, smin * 0.5)
+        cost_cfg = RouterPolicyConfig(
+            breaker_failures=1, breaker_cooldown_s=2.0,
+            breaker_slow_ttft_s=hedge_delay,
+            retry_budget_ratio=0.2, hedge=True,
+            hedge_delay_s=hedge_delay, stats_interval_s=0.3)
+        cost, scrape = await run_leg(RouterMode.COST, cost_cfg)
+
+        st = get_router_stats()
+        result = {
+            "requests_per_leg": ROUTING_REQS,
+            "stall_s": [smin, smax],
+            "rr": rr,
+            "cost": cost,
+            "breaker_opens": (st.breaker_transitions.get("open", 0)
+                              - tr0.get("open", 0)),
+            "hedges": {k: st.hedges.get(k, 0) - hg0.get(k, 0)
+                       for k in ("fired", "won", "lost", "denied",
+                                 "expired")},
+            "retries": {k: st.retries.get(k, 0) - rt0.get(k, 0)
+                        for k in ("connect", "denied")},
+            "breaker_metric_seen": (
+                "dynamo_frontend_router_breaker_state" in scrape["metrics"]
+                and "dynamo_frontend_router_breaker_transitions_total"
+                in scrape["metrics"]),
+            "trace_attrs_ok": scrape["trace_attrs_ok"],
+            "cost_vs_rr_p99": (round(rr["ttft_p99_s"] / cost["ttft_p99_s"], 2)
+                               if rr["ttft_p99_s"] and cost["ttft_p99_s"]
+                               else None),
+        }
+        _ckpt("routing", **{k: v for k, v in result.items()
+                            if k not in ("rr", "cost")})
+        out_path = os.environ.get("BENCH_ROUTING_OUT")
+        if out_path:
+            with open(out_path, "w") as f:
+                json.dump(result, f, indent=2, sort_keys=True)
+        return result
+    finally:
+        if proxy is not None:
+            with contextlib.suppress(Exception):
+                await proxy.stop()
+        for e in engines:
+            with contextlib.suppress(Exception):
+                await e.stop()
+        for d in drts:
+            with contextlib.suppress(Exception):
+                await d.close()
+        with contextlib.suppress(Exception):
+            await coord.stop()
+
+
 async def run_attempt(args) -> dict:
     """The whole attempt, one process: build -> prime -> measure ->
     transports -> optional attn-impl A/B. ``jax_init`` already happened in
@@ -1699,6 +1924,15 @@ async def run_attempt(args) -> dict:
         result["fleet"] = await _measure_fleet(wd)
     except Exception as e:  # noqa: BLE001 — best-effort extra data
         result["fleet"] = {"error": str(e)[:300]}
+    print(json.dumps(result), flush=True)
+
+    # failure-aware routing leg: cost-vs-RR A/B over a mocker fleet with
+    # one ChaosProxy-slowed worker — tail TTFT must improve, streams_lost
+    # must be 0, the breaker must open, decisions must be traceable
+    try:
+        result["routing"] = await _measure_routing(wd)
+    except Exception as e:  # noqa: BLE001 — best-effort extra data
+        result["routing"] = {"error": str(e)[:300]}
     print(json.dumps(result), flush=True)
 
     # attn-impl A/B in the SAME process (round-4 open question:

@@ -15,6 +15,7 @@ import logging
 from dynamo_tpu.http.service import HttpService
 from dynamo_tpu.llm.model_manager import ModelManager, ModelWatcher
 from dynamo_tpu.runtime.push_router import RouterMode
+from dynamo_tpu.runtime.resilience import RouterPolicyConfig
 from dynamo_tpu.runtime.runtime import DEFAULT_COORDINATOR, DistributedRuntime
 from dynamo_tpu.utils.config import RuntimeConfig
 from dynamo_tpu.utils.logging import configure_logging
@@ -30,7 +31,7 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--http-host", default="0.0.0.0")
     parser.add_argument("--http-port", type=int, default=8080)
     parser.add_argument("--router-mode", default="round-robin",
-                        choices=["round-robin", "random", "kv"])
+                        choices=["round-robin", "random", "kv", "cost"])
     parser.add_argument("--kv-overlap-score-weight", type=float, default=1.0)
     parser.add_argument("--router-temperature", type=float, default=0.0)
     parser.add_argument("--no-kv-events", action="store_true",
@@ -62,6 +63,35 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--shed-retry-after-s", type=float,
                         default=cfg.http_shed_retry_after_s,
                         help="Retry-After hint on shed responses")
+    # failure-aware routing knobs (cost + kv modes; see docs/deployment.md
+    # "Failure-aware routing")
+    parser.add_argument("--breaker-failures", type=int,
+                        default=cfg.router_breaker_failures,
+                        help="consecutive failures that open an instance's "
+                             "circuit breaker")
+    parser.add_argument("--breaker-cooldown-s", type=float,
+                        default=cfg.router_breaker_cooldown_s,
+                        help="breaker open -> half-open probe dwell "
+                             "(doubles per re-open)")
+    parser.add_argument("--breaker-slow-ttft-s", type=float,
+                        default=cfg.router_breaker_slow_ttft_s,
+                        help="TTFT at or above this counts as a breaker "
+                             "failure (0 disables slow-call accounting)")
+    parser.add_argument("--retry-budget", type=float,
+                        default=cfg.router_retry_budget,
+                        help="retry-budget tokens earned per request (~max "
+                             "fraction of requests that may retry/hedge)")
+    parser.add_argument("--hedge", action="store_true",
+                        default=cfg.router_hedge,
+                        help="hedge slow first tokens on the next-best "
+                             "instance (first winner cancels the loser)")
+    parser.add_argument("--hedge-delay-s", type=float,
+                        default=cfg.router_hedge_delay_s,
+                        help="fixed hedge delay (0 = observed p95 TTFT)")
+    parser.add_argument("--router-stats-interval-s", type=float,
+                        default=cfg.router_stats_interval_s,
+                        help="worker __stats__ scrape period for the cost "
+                             "score")
     return parser
 
 
@@ -69,6 +99,14 @@ async def amain(args: argparse.Namespace) -> None:
     drt = await DistributedRuntime.create(
         coordinator=args.coordinator, standalone=args.standalone)
     manager = ModelManager()
+    policy_config = RouterPolicyConfig(
+        breaker_failures=args.breaker_failures,
+        breaker_cooldown_s=args.breaker_cooldown_s,
+        breaker_slow_ttft_s=args.breaker_slow_ttft_s,
+        retry_budget_ratio=args.retry_budget,
+        hedge=args.hedge,
+        hedge_delay_s=args.hedge_delay_s,
+        stats_interval_s=args.router_stats_interval_s)
     watcher = ModelWatcher(
         drt, manager,
         router_mode=RouterMode(args.router_mode),
@@ -76,7 +114,8 @@ async def amain(args: argparse.Namespace) -> None:
             "overlap_score_weight": args.kv_overlap_score_weight,
             "temperature": args.router_temperature,
             "use_kv_events": not args.no_kv_events,
-        })
+        },
+        policy_config=policy_config)
     await watcher.start()
     service = HttpService(
         manager, host=args.http_host, port=args.http_port,

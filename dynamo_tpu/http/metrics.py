@@ -94,6 +94,10 @@ class FrontendMetrics:
         # per-stage latency breakdown from trace spans; HttpService attaches
         # the process tracer at start and detaches at stop
         self.stage = StageMetrics(self.registry)
+        # failure-aware routing counters/gauges, sampled from the process-
+        # wide RouterStats book at scrape time (routers live in ModelWatcher,
+        # outside this registry's reach)
+        self.router = RouterMetricsCollector(self.registry)
 
     def attach_coord(self, coord) -> "CoordClientMetrics":
         """Expose the process's coordinator-connection health next to the
@@ -188,6 +192,74 @@ class CoordinatorMetrics:
             value=float(c.standbys_attached))
 
 
+class RouterMetricsCollector:
+    """Custom collector over the process-wide failure-aware-routing book
+    (``runtime/resilience.get_router_stats``).
+
+    Series: ``dynamo_frontend_router_decisions_total{policy}``,
+    ``dynamo_frontend_router_retries_total{reason}``,
+    ``dynamo_frontend_router_hedges_total{outcome}``,
+    ``dynamo_frontend_router_breaker_transitions_total{state}``,
+    ``dynamo_frontend_router_breaker_state{instance}`` (0 closed /
+    0.5 half-open / 1 open), ``dynamo_frontend_router_retry_budget_balance``
+    and ``dynamo_frontend_router_retry_budget_exhausted_total``."""
+
+    def __init__(self, registry: Optional[CollectorRegistry] = None):
+        if registry is not None:
+            registry.register(self)
+
+    def collect(self):
+        from prometheus_client.core import (
+            CounterMetricFamily,
+            GaugeMetricFamily,
+        )
+        from dynamo_tpu.runtime.resilience import get_router_stats
+        s = get_router_stats()
+        dec = CounterMetricFamily(
+            "dynamo_frontend_router_decisions",
+            "Routing decisions by policy", labels=["policy"])
+        for policy, n in s.decisions.items():
+            dec.add_metric([policy], float(n))
+        yield dec
+        ret = CounterMetricFamily(
+            "dynamo_frontend_router_retries",
+            "Re-dispatches (failover retries) by reason; 'denied' counts "
+            "retries refused by the budget", labels=["reason"])
+        for reason, n in s.retries.items():
+            ret.add_metric([reason], float(n))
+        yield ret
+        hed = CounterMetricFamily(
+            "dynamo_frontend_router_hedges",
+            "Hedged dispatches by outcome "
+            "(fired|won|lost|denied|expired)", labels=["outcome"])
+        for outcome, n in s.hedges.items():
+            hed.add_metric([outcome], float(n))
+        yield hed
+        tr = CounterMetricFamily(
+            "dynamo_frontend_router_breaker_transitions",
+            "Circuit-breaker state transitions by entered state",
+            labels=["state"])
+        for state, n in s.breaker_transitions.items():
+            tr.add_metric([state], float(n))
+        yield tr
+        st = GaugeMetricFamily(
+            "dynamo_frontend_router_breaker_state",
+            "Per-instance breaker state: 0 closed, 0.5 half-open, 1 open",
+            labels=["instance"])
+        for iid, v in s.breaker_states.items():
+            st.add_metric([iid], v)
+        yield st
+        yield GaugeMetricFamily(
+            "dynamo_frontend_router_retry_budget_balance",
+            "Retry-budget tokens currently available",
+            value=float(s.budget_balance))
+        ex = CounterMetricFamily(
+            "dynamo_frontend_router_retry_budget_exhausted",
+            "Retry/hedge attempts refused because the budget was empty")
+        ex.add_metric([], float(s.budget_exhausted))
+        yield ex
+
+
 class RequestTimer:
     """Tracks one request's TTFT/ITL/duration and reports on completion."""
 
@@ -227,4 +299,4 @@ class RequestTimer:
 
 
 __all__ = ["FrontendMetrics", "CoordClientMetrics", "CoordinatorMetrics",
-           "RequestTimer", "StageMetrics"]
+           "RequestTimer", "RouterMetricsCollector", "StageMetrics"]

@@ -56,7 +56,8 @@ class KvPushRouter:
                  temperature: float = 0.0,
                  use_kv_events: bool = True,
                  stats_interval: float = 1.0,
-                 selector: Optional[WorkerSelector] = None):
+                 selector: Optional[WorkerSelector] = None,
+                 policy=None):
         self.drt = drt
         self.client = client
         self.block_size = card.kv_cache_block_size
@@ -64,10 +65,14 @@ class KvPushRouter:
         self.stats_interval = stats_interval
         self.indexer = (KvIndexer(self.block_size) if use_kv_events
                         else ApproxKvIndexer(self.block_size))
+        # optional RouterPolicy (runtime/resilience.py) shared with the
+        # inner PushRouter: breakers/budget/latency book apply to the
+        # pinned dispatch, the scheduler blends its cost bias
+        self.policy = policy
         self.scheduler = KvScheduler(
             self.block_size, overlap_score_weight=overlap_score_weight,
-            temperature=temperature, selector=selector)
-        self.inner = PushRouter(client, RouterMode.DIRECT)
+            temperature=temperature, selector=selector, policy=policy)
+        self.inner = PushRouter(client, RouterMode.DIRECT, policy=policy)
         self._namespace = client.endpoint.namespace
         self._component = client.endpoint.component
         self._event_sub = None
@@ -88,6 +93,7 @@ class KvPushRouter:
     async def close(self) -> None:
         await reap_task(self._event_task)
         await reap_task(self._stats_task)
+        await self.inner.close()
         if self._event_sub is not None:
             try:
                 await self._event_sub.cancel()
@@ -121,6 +127,9 @@ class KvPushRouter:
                         metrics[iid] = ForwardPassMetrics.from_dict(data)
                 self.scheduler.update_metrics(metrics)
                 live = set(self.client.instance_ids())
+                if self.policy is not None:
+                    self.policy.ingest_scrape(scraped, ep_path)
+                    self.policy.prune(live)
                 for wid in [w for w in self._known_workers() if w not in live]:
                     self.indexer.remove_worker(wid)
                     self.scheduler.remove_worker(wid)
@@ -134,6 +143,27 @@ class KvPushRouter:
         if isinstance(self.indexer, KvIndexer):
             return self.indexer.workers()
         return []
+
+    def _export_decision(self, worker: int, overlap: int, isl_blocks: int,
+                         explain: Optional[Dict[int, Dict]]) -> None:
+        """KV routing decision trace attrs on the request's current span —
+        the prefix-overlap/cost inputs, plus the policy's failure-aware
+        inputs when attached (retrievable post-hoc from /v1/traces)."""
+        span = PushRouter._current_span()
+        if span is None:
+            return
+        span.set_attr("router.policy", "kv")
+        span.set_attr("router.instance", f"{worker:x}")
+        span.set_attr("router.overlap_blocks", overlap)
+        span.set_attr("router.isl_blocks", isl_blocks)
+        chosen = (explain or {}).get(worker)
+        if chosen:
+            span.set_attr("router.cost", chosen.get("cost"))
+            span.set_attr("router.active_blocks", chosen.get("active_blocks"))
+        if self.policy is not None:
+            _, inputs = self.policy.score(worker)
+            for key in ("ewma_ttft_s", "inflight", "queue_depth", "breaker"):
+                span.set_attr(f"router.{key}", inputs.get(key))
 
     # -- routing -----------------------------------------------------------
 
@@ -155,8 +185,15 @@ class KvPushRouter:
         hashes = compute_block_hash_for_seq(token_ids, self.block_size)
         if instance_id is None:
             overlaps = self.indexer.find_matches(hashes)
+            explain: Optional[Dict[int, Dict]] = (
+                {} if self.policy is not None else None)
             worker, overlap = self.scheduler.select(
-                self.client.instance_ids(), overlaps, len(hashes))
+                self.client.instance_ids(), overlaps, len(hashes),
+                explain=explain)
+            if self.policy is not None:
+                self.policy.budget.deposit()
+                self.policy.stats.decisions["kv"] += 1
+                self._export_decision(worker, overlap, len(hashes), explain)
         else:
             worker, overlap = instance_id, 0
         payload = dict(payload)

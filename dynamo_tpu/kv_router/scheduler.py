@@ -45,11 +45,16 @@ class KvScheduler:
 
     def __init__(self, block_size: int, overlap_score_weight: float = 1.0,
                  temperature: float = 0.0,
-                 selector: Optional[WorkerSelector] = None):
+                 selector: Optional[WorkerSelector] = None,
+                 policy=None):
         self.block_size = block_size
         self.overlap_score_weight = overlap_score_weight
         self.temperature = temperature
         self.selector = selector
+        # optional RouterPolicy (runtime/resilience.py): adds the failure-
+        # aware terms — EWMA-TTFT penalty + router-side in-flight — to the
+        # block cost, and filters breaker-open workers out of selection
+        self.policy = policy
         self._workers: Dict[int, _WorkerState] = {}
         self._seqs: Dict[str, _ActiveSeq] = {}
 
@@ -110,19 +115,39 @@ class KvScheduler:
             # blend in the worker's own view: waiting requests mean queued
             # prefill work this prediction can't see
             potential_decode += st.metrics.worker_stats.num_requests_waiting
+        bias = 0.0
+        if self.policy is not None:
+            # queue depth is already priced above via num_requests_waiting;
+            # cost_bias adds only the terms this model lacks (in-flight,
+            # observed-latency penalty)
+            bias = self.policy.cost_bias(worker)
         return (self.overlap_score_weight * potential_prefill
-                + potential_decode)
+                + potential_decode + bias)
 
     def select(self, candidates: List[int], overlaps: Dict[int, int],
-               isl_blocks: int) -> Tuple[int, int]:
-        """Pick a worker; returns (worker_id, its overlap blocks)."""
+               isl_blocks: int,
+               explain: Optional[Dict[int, Dict]] = None) -> Tuple[int, int]:
+        """Pick a worker; returns (worker_id, its overlap blocks).  When
+        ``explain`` is passed, it is filled with each candidate's score
+        inputs (for the routing-decision trace attrs)."""
         if not candidates:
             raise ConnectionError("no workers available for KV routing")
+        if self.policy is not None:
+            allowed = [w for w in candidates if self.policy.breakers.allow(w)]
+            # all breakers open: degrade to the full set rather than refuse
+            candidates = allowed or candidates
         if self.selector is not None:
             chosen = self.selector(candidates, overlaps, isl_blocks, self)
             return chosen, overlaps.get(chosen, 0)
         costs = [self.cost(w, overlaps.get(w, 0), isl_blocks)
                  for w in candidates]
+        if explain is not None:
+            for w, c in zip(candidates, costs):
+                explain[w] = {"cost": round(c, 4),
+                              "overlap_blocks": overlaps.get(w, 0),
+                              "active_blocks":
+                                  self._workers[w].active_blocks
+                                  if w in self._workers else 0}
         if self.temperature <= 0.0:
             best = min(costs)
             chosen = random.choice(

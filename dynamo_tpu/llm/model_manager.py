@@ -54,15 +54,21 @@ class ModelWatcher:
 
     def __init__(self, drt: DistributedRuntime, manager: ModelManager,
                  router_mode: RouterMode = RouterMode.ROUND_ROBIN,
-                 kv_router_config: Optional[dict] = None):
+                 kv_router_config: Optional[dict] = None,
+                 policy_config=None):
         self.drt = drt
         self.manager = manager
         self.router_mode = router_mode
         self.kv_router_config = kv_router_config or {}
+        # RouterPolicyConfig for the failure-aware modes (cost, kv); the
+        # legacy round-robin/random modes never build a policy, keeping the
+        # fallback path byte-stable
+        self.policy_config = policy_config
         self._task: Optional[asyncio.Task] = None
         self._watch = None
         self._model_instances: Dict[str, set] = {}
         self._clients: Dict[str, object] = {}
+        self._routers: Dict[str, object] = {}
         self.ready = asyncio.Event()
 
     async def start(self) -> "ModelWatcher":
@@ -85,6 +91,12 @@ class ModelWatcher:
                 await self._watch.cancel()
             except Exception:
                 pass
+        for name, router in list(self._routers.items()):
+            # PushRouter.close reaps the cost-mode stats loop; the KV
+            # router's own close is driven via its client below
+            if isinstance(router, PushRouter):
+                await router.close()
+        self._routers.clear()
         for client in self._clients.values():
             await client.close()  # type: ignore[attr-defined]
         self._clients.clear()
@@ -123,12 +135,21 @@ class ModelWatcher:
                     .component(entry.component).endpoint(entry.endpoint))
         client = await endpoint.client()
         self._clients[entry.name] = client
+        policy = None
+        if self.router_mode in (RouterMode.KV, RouterMode.COST):
+            from dynamo_tpu.runtime.resilience import (
+                RouterPolicy,
+                RouterPolicyConfig,
+            )
+            policy = RouterPolicy(self.policy_config or RouterPolicyConfig())
         if self.router_mode == RouterMode.KV:
             from dynamo_tpu.kv_router import KvPushRouter
             router = await KvPushRouter.create(
-                self.drt, client, entry.card, **self.kv_router_config)
+                self.drt, client, entry.card, policy=policy,
+                **self.kv_router_config)
         else:
-            router = PushRouter(client, self.router_mode)
+            router = PushRouter(client, self.router_mode, policy=policy)
+        self._routers[entry.name] = router
         from dynamo_tpu.llm.register import AUX_ENDPOINT
         aux_ep = (self.drt.namespace(entry.namespace)
                   .component(entry.component).endpoint(AUX_ENDPOINT))
@@ -147,6 +168,9 @@ class ModelWatcher:
                 logger.info("last instance of model %s gone; removing", name)
                 self.manager.remove(name)
                 self._model_instances.pop(name, None)
+                router = self._routers.pop(name, None)
+                if isinstance(router, PushRouter):
+                    await router.close()
                 client = self._clients.pop(name, None)
                 if client is not None:
                     await client.close()  # type: ignore[attr-defined]

@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 from dynamo_tpu.runtime.component import Endpoint, Instance
 from dynamo_tpu.runtime.rpc import ResponseStream
@@ -30,6 +30,9 @@ class Client:
         self._watch = None
         self._watch_task: Optional[asyncio.Task] = None
         self._changed = asyncio.Event()
+        # instance-id down listeners (router policy breakers): fired once per
+        # report, from both the keepalive path and explicit error reports
+        self._down_listeners: List[Callable[[int], None]] = []
 
     @classmethod
     async def create(cls, drt, endpoint: Endpoint, static: bool = False) -> "Client":
@@ -114,6 +117,23 @@ class Client:
             inst = self._instances.get(instance_id)
             if inst is not None:
                 self._drt.rpc_pool.drop(inst.address)
+            for cb in list(self._down_listeners):
+                try:
+                    cb(instance_id)
+                except Exception:
+                    logger.exception("instance-down listener failed")
+
+    def add_down_listener(self, cb: Callable[[int], None]) -> None:
+        """Subscribe to instance-down reports (called with the instance id).
+        Both keepalive miss-budget exhaustion and router error reports
+        funnel through ``report_instance_down``, so one hook sees both."""
+        self._down_listeners.append(cb)
+
+    def remove_down_listener(self, cb: Callable[[int], None]) -> None:
+        try:
+            self._down_listeners.remove(cb)
+        except ValueError:
+            pass
 
     def _on_address_down(self, address: str) -> None:
         """Pool notification: a connection died unexpectedly (remote crash or
@@ -141,6 +161,25 @@ class Client:
         return self.instances()
 
     # -- request issuing ---------------------------------------------------
+
+    async def scrape_stats(self) -> Dict[int, Any]:
+        """Poll the ``__stats__`` plane of every live instance (queue depth /
+        in-flight for the routing cost model).  Unreachable instances are
+        simply absent from the result — the scorer treats missing stats as
+        unknown, and the request path's own error handling marks them down."""
+        out: Dict[int, Any] = {}
+        for inst in self.instances():
+            try:
+                conn = await self._drt.rpc_pool.get(inst.address)
+                stream = await conn.request("__stats__", None)
+                async for item in stream:
+                    out[inst.instance_id] = item
+                    break
+                if not stream.finished:
+                    await stream.cancel()
+            except Exception:
+                continue
+        return out
 
     async def direct(self, payload: Any, instance_id: int,
                      headers: Optional[Dict[str, Any]] = None) -> ResponseStream:

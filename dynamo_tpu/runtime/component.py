@@ -15,6 +15,7 @@ import asyncio
 import dataclasses
 import json
 import logging
+import os
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional, TYPE_CHECKING
 
@@ -184,10 +185,14 @@ class Endpoint:
         rpc_name = f"{self.path}"
         server.register(rpc_name, handler, stats_provider)
         lease = await drt.primary_lease()
+        # DYN_RPC_ADVERTISE lets a worker announce an address other than its
+        # listening socket — e.g. a ChaosProxy in front of it (fault drills)
+        # or a NAT'd / port-forwarded address in containerized deployments
+        advertise = os.environ.get("DYN_RPC_ADVERTISE") or server.address
         inst = Instance(
             namespace=self.namespace, component=self.component,
             endpoint=self.name, instance_id=lease.lease_id,
-            address=server.address, bulk_address=bulk_address,
+            address=advertise, bulk_address=bulk_address,
             direct_address=direct_address)
         await drt.coord.put(inst.etcd_key, inst.to_json(), lease_id=lease.lease_id)
         logger.info("serving endpoint %s as instance %x at %s",

@@ -88,6 +88,28 @@ class RuntimeConfig:
     # (short-form env DYN_DECODE_PROGRESS wins); 0 disables
     decode_progress_every: int = 2
 
+    # -- failure-aware routing (runtime/resilience.py; cost + kv modes) ---
+    # consecutive failures (connect errors, stream drops, timeouts, slow
+    # TTFT) that open an instance's circuit breaker
+    router_breaker_failures: int = 3
+    # open -> half-open probe dwell (doubles per re-open, capped in code)
+    router_breaker_cooldown_s: float = 1.0
+    # TTFT at or above this counts as a breaker failure — routes around a
+    # slow-but-alive worker before keepalive declares it dead (0 disables)
+    router_breaker_slow_ttft_s: float = 0.0
+    # retry-budget tokens earned per first attempt (~ the max fraction of
+    # requests that may retry or hedge; brownouts can't amplify)
+    router_retry_budget: float = 0.1
+    # hedged dispatch: fire a second attempt on the next-best instance when
+    # the first token is slower than the hedge delay (first winner cancels
+    # the loser; hedges spend the retry budget)
+    router_hedge: bool = False
+    # fixed hedge delay in seconds; 0 derives it from the observed fleet
+    # p95 TTFT
+    router_hedge_delay_s: float = 0.0
+    # __stats__ scrape period feeding queue depth into the cost score
+    router_stats_interval_s: float = 1.0
+
     @classmethod
     def load(cls, path: Optional[str] = None,
              env: Optional[Dict[str, str]] = None) -> "RuntimeConfig":

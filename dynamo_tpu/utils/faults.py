@@ -9,6 +9,13 @@ provoke on a real socket pair:
   network partition with open TCP).  Stream-drop detection never fires; only
   keepalive probing or request deadlines can catch it.
 - ``set_delay(s)``: add latency to every forwarded chunk (slow network).
+- ``delay_jitter(p, min_s, max_s)``: tail-latency mode — each CONNECTION
+  independently draws (with probability ``p``) a random stall in
+  [min_s, max_s] applied to its forwarded chunks, while other connections
+  run at full speed.  Distinct from the blanket ``delay``: this is the
+  slow-but-alive worker whose victims are only some callers — the case a
+  failure-aware router must route around rather than merely detect.
+  Seedable for deterministic chaos tests.
 - ``heal()``: resume forwarding (bytes held during the blackhole flow again).
 - ``corrupt(after_bytes, nbytes)``: flip (XOR 0xFF) ``nbytes`` of the
   forwarded byte stream starting at absolute offset ``after_bytes`` — the
@@ -64,6 +71,8 @@ class ChaosProxy:
         self._forwarding = asyncio.Event()
         self._forwarding.set()
         self._delay_s = 0.0
+        # tail-latency jitter: (p, min_s, max_s, rng); None = off
+        self._jitter: Optional[tuple] = None
         self._tasks: Set[asyncio.Task] = set()
         self._writers: Set[asyncio.StreamWriter] = set()
         self.bytes_forwarded = 0
@@ -116,6 +125,21 @@ class ChaosProxy:
         """Add per-chunk forwarding latency (0 restores full speed)."""
         self._delay_s = max(0.0, seconds)
 
+    def delay_jitter(self, p: float, min_s: float, max_s: float,
+                     seed: Optional[int] = None) -> None:
+        """Tail-latency mode: each connection draws — with probability
+        ``p``, at its first forwarded chunk after arming — a random stall
+        in [min_s, max_s] it then applies to every chunk it forwards.
+        Unlucky connections are consistently slow, the rest run at full
+        speed (per-connection, unlike the blanket ``set_delay``).  Pass
+        ``seed`` for a deterministic draw sequence; ``delay_jitter(0, 0,
+        0)`` disarms."""
+        if p <= 0:
+            self._jitter = None
+            return
+        self._jitter = (min(1.0, p), max(0.0, min_s),
+                        max(0.0, min_s, max_s), random.Random(seed))
+
     def corrupt(self, after_bytes: int = 0, nbytes: int = 1,
                 direction: str = "down") -> None:
         """Flip ``nbytes`` of the ``direction`` byte stream starting at
@@ -146,9 +170,13 @@ class ChaosProxy:
             cwriter.close()
             return
         self._writers.update((cwriter, uwriter))
-        up = asyncio.create_task(self._pump(creader, uwriter, "up", cwriter))
+        # per-connection jitter state, shared by both pump directions so a
+        # slow connection is slow both ways (one stall draw per connection)
+        conn: dict = {}
+        up = asyncio.create_task(self._pump(creader, uwriter, "up", cwriter,
+                                            conn))
         down = asyncio.create_task(self._pump(ureader, cwriter, "down",
-                                              uwriter))
+                                              uwriter, conn))
         for t in (up, down):
             self._tasks.add(t)
             t.add_done_callback(self._tasks.discard)
@@ -189,10 +217,27 @@ class ChaosProxy:
         self._dir_bytes[direction] += len(data)
         return data, cut
 
+    def _jitter_stall(self, conn: Optional[dict]) -> float:
+        """This connection's stall for the armed jitter config.  Drawn
+        lazily at the first chunk after arming (a pooled connection opened
+        before ``delay_jitter`` still participates), atomically between
+        awaits, once per (connection, arming)."""
+        jit = self._jitter
+        if jit is None or conn is None:
+            return 0.0
+        key = id(jit)  # re-arming re-draws
+        if conn.get("jitter_key") != key:
+            p, min_s, max_s, rng = jit
+            conn["jitter_key"] = key
+            conn["stall"] = (rng.uniform(min_s, max_s)
+                             if rng.random() < p else 0.0)
+        return conn.get("stall", 0.0)
+
     async def _pump(self, reader: asyncio.StreamReader,
                     writer: asyncio.StreamWriter,
                     direction: str = "down",
-                    peer_writer: "asyncio.StreamWriter" = None) -> None:
+                    peer_writer: "asyncio.StreamWriter" = None,
+                    conn: Optional[dict] = None) -> None:
         try:
             while True:
                 data = await reader.read(64 * 1024)
@@ -200,6 +245,9 @@ class ChaosProxy:
                     break
                 if self._delay_s:
                     await asyncio.sleep(self._delay_s)
+                stall = self._jitter_stall(conn)
+                if stall:
+                    await asyncio.sleep(stall)
                 # blackhole: hold the chunk here — the connection stays
                 # open and silent, exactly like a frozen remote
                 await self._forwarding.wait()

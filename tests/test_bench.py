@@ -30,6 +30,10 @@ def test_single_child_attempt_chain():
     # short fleet phases so the supervisor leg (a ~30s trace at the real
     # bench's defaults) stays inside the smoke chain's budget
     env["BENCH_FLEET_PHASES"] = "2rps:4s,10rps:8s,2rps:5s"
+    # short routing leg (fewer requests per A/B side, milder stall) so the
+    # cost-vs-RR comparison stays inside the smoke chain's budget
+    env["BENCH_ROUTING_REQS"] = "16"
+    env["BENCH_ROUTING_STALL"] = "0.25,0.4"
     env.pop("JAX_PLATFORMS", None)
     r = subprocess.run(
         [sys.executable, BENCH, "--budget", "420", "--tier", "tiny"],
@@ -82,6 +86,20 @@ def test_single_child_attempt_chain():
     assert fl["decisions_up"] >= 1 and fl["decisions_down"] >= 1
     assert fl["promote_s"] is not None and fl["promote_s"] < 10
     assert fl["planner_metrics_on_http"] is True
+    # failure-aware routing leg: same-run cost-vs-RR A/B with one worker
+    # behind a ChaosProxy tail-latency stall — the cost router must beat
+    # round-robin on tail TTFT without losing a stream, open the slow
+    # worker's breaker (visible on /metrics), and leave the decision's
+    # score inputs retrievable from the flight recorder
+    rt = result["routing"]
+    assert "error" not in rt, rt
+    assert rt["rr"]["streams_lost"] == 0, rt
+    assert rt["cost"]["streams_lost"] == 0, rt
+    assert rt["cost"]["ttft_p99_s"] < rt["rr"]["ttft_p99_s"], rt
+    assert rt["breaker_opens"] >= 1, rt
+    assert rt["hedges"]["fired"] >= 1 and rt["hedges"]["won"] >= 1, rt
+    assert rt["breaker_metric_seen"] is True
+    assert rt["trace_attrs_ok"] is True
     # the continuous-arrival mixed-vs-legacy A/B ran on both engines.
     # jax sub-leg: CPU dispatch overhead is ~0, so only liveness is
     # asserted (the throughput separation is the on-chip/mocker story).

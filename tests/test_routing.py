@@ -1,0 +1,688 @@
+"""Failure-aware routing: breaker lifecycle, retry budget, hedged dispatch,
+cost-model selection, and the round-robin byte-stability regression.
+
+The unit layer drives ``runtime/resilience.py`` + ``PushRouter`` against
+fake clients/streams (injected clocks make breaker dwells instant); the
+integration layer scrapes a live mocker worker's ``__stats__`` plane into
+the scorer (satellite: routing chaos runs TPU-free) and exercises
+``ChaosProxy.delay_jitter`` (the slow-but-alive worker, per connection).
+"""
+
+import asyncio
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from dynamo_tpu.runtime.push_router import PushRouter, RouterMode
+from dynamo_tpu.runtime.resilience import (
+    BreakerState,
+    CircuitBreaker,
+    LatencyBook,
+    RetryBudget,
+    RouterPolicy,
+    RouterPolicyConfig,
+    get_router_stats,
+)
+from dynamo_tpu.runtime.rpc import (
+    DEADLINE_HEADER,
+    DeadlineExceededError,
+    StreamEndedError,
+)
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+class FakeStream:
+    """Duck-typed ResponseStream: fixed items, optional first-frame delay,
+    optional terminal error."""
+
+    def __init__(self, items, first_delay=0.0, error=None):
+        self._items = list(items)
+        self.first_delay = first_delay
+        self.error = error
+        self.finished = False
+        self.cancelled = False
+        self._i = 0
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self):
+        if self._i == 0 and self.first_delay:
+            await asyncio.sleep(self.first_delay)
+        if self._i < len(self._items):
+            item = self._items[self._i]
+            self._i += 1
+            return item
+        if self.error is not None:
+            raise self.error
+        self.finished = True
+        raise StopAsyncIteration
+
+    async def cancel(self):
+        self.cancelled = True
+        self.finished = True
+
+
+class FakeClient:
+    """Duck-typed runtime Client: static instance set, scripted streams."""
+
+    def __init__(self, ids, streams=None, sticky=False):
+        self.endpoint = SimpleNamespace(path="ns/comp/gen", namespace="ns",
+                                        component="comp")
+        self._ids = list(ids)
+        # iid -> FakeStream | Exception | zero-arg factory
+        self.streams = streams or {}
+        # sticky: instances stay selectable after report_instance_down
+        # (transient fleet-wide brownout, instances still registered)
+        self.sticky = sticky
+        self.down = []
+        self.direct_calls = []
+        self._listeners = []
+
+    def instance_ids(self):
+        if self.sticky:
+            return list(self._ids)
+        return [i for i in self._ids if i not in self.down]
+
+    def report_instance_down(self, iid):
+        if iid not in self.down:
+            self.down.append(iid)
+            for cb in list(self._listeners):
+                cb(iid)
+
+    def add_down_listener(self, cb):
+        self._listeners.append(cb)
+
+    def remove_down_listener(self, cb):
+        if cb in self._listeners:
+            self._listeners.remove(cb)
+
+    async def direct(self, payload, iid, headers=None):
+        self.direct_calls.append(iid)
+        source = self.streams.get(iid)
+        if source is None:
+            raise ConnectionError(f"no route to {iid}")
+        if callable(source) and not isinstance(source, FakeStream):
+            source = source()
+        if isinstance(source, Exception):
+            raise source
+        return source
+
+
+def pcfg(**kw):
+    kw.setdefault("stats_interval_s", 0.0)  # no scrape loop against fakes
+    return RouterPolicyConfig(**kw)
+
+
+def snapshot():
+    s = get_router_stats()
+    return {"retries": dict(s.retries), "hedges": dict(s.hedges),
+            "decisions": dict(s.decisions),
+            "transitions": dict(s.breaker_transitions),
+            "exhausted": s.budget_exhausted}
+
+
+def delta(before, field, key=None):
+    s = get_router_stats()
+    now = {"retries": s.retries, "hedges": s.hedges,
+           "decisions": s.decisions,
+           "transitions": s.breaker_transitions}[field] if key is not None \
+        else None
+    if key is None:
+        return s.budget_exhausted - before["exhausted"]
+    return now.get(key, 0) - before[field].get(key, 0)
+
+
+class TestCircuitBreaker:
+    def test_open_after_consecutive_failures(self):
+        clock = FakeClock()
+        br = CircuitBreaker(failures=3, cooldown_s=1.0, clock=clock)
+        assert br.state is BreakerState.CLOSED
+        br.record_failure()
+        br.record_success()  # success resets the consecutive count
+        br.record_failure()
+        br.record_failure()
+        assert br.state is BreakerState.CLOSED
+        assert br.record_failure() is True
+        assert br.state is BreakerState.OPEN
+        assert not br.allow()
+
+    def test_half_open_probe_closes_on_success(self):
+        clock = FakeClock()
+        br = CircuitBreaker(failures=1, cooldown_s=1.0, clock=clock)
+        br.record_failure()
+        assert not br.allow()
+        clock.advance(1.1)
+        assert br.allow()  # cooldown elapsed: one probe allowed
+        br.on_dispatch()
+        assert br.state is BreakerState.HALF_OPEN
+        assert not br.allow()  # single probe in flight
+        assert br.record_success() is True
+        assert br.state is BreakerState.CLOSED
+        assert br.allow()
+
+    def test_failed_probe_reopens_with_doubled_cooldown(self):
+        clock = FakeClock()
+        br = CircuitBreaker(failures=1, cooldown_s=1.0, cooldown_cap_s=30.0,
+                            clock=clock)
+        br.record_failure()
+        clock.advance(1.1)
+        br.on_dispatch()
+        br.record_failure()  # probe failed
+        assert br.state is BreakerState.OPEN
+        clock.advance(1.1)
+        assert not br.allow()  # dwell doubled to 2s
+        clock.advance(1.0)
+        assert br.allow()
+        # success after the next probe resets the dwell to base
+        br.on_dispatch()
+        br.record_success()
+        assert br._cooldown == 1.0
+
+    def test_force_open_is_immediate(self):
+        br = CircuitBreaker(failures=5, clock=FakeClock())
+        assert br.force_open() is True
+        assert br.state is BreakerState.OPEN
+        assert br.opens == 1
+
+
+class TestPolicyFeeds:
+    def test_keepalive_down_report_opens_breaker(self):
+        # the existing error funnel (keepalive miss-budget, connect errors)
+        # feeds the breaker through the client's down listener — the breaker
+        # opens the moment the report lands, before lease expiry
+        pol = RouterPolicy(pcfg(breaker_failures=5))
+        client = FakeClient([1, 2], streams={1: FakeStream(["x"])})
+        pol.attach_client(client)
+        client.report_instance_down(2)
+        assert pol.breakers.state(2) is BreakerState.OPEN
+
+    def test_slow_ttft_counts_as_failure(self):
+        pol = RouterPolicy(pcfg(breaker_failures=2, breaker_slow_ttft_s=0.5))
+        pol.observe_ttft(1, 0.6)
+        pol.observe_ttft(1, 0.7)
+        assert pol.breakers.state(1) is BreakerState.OPEN
+        # fast worker stays closed
+        pol.observe_ttft(2, 0.1)
+        pol.observe_ttft(2, 0.1)
+        assert pol.breakers.state(2) is BreakerState.CLOSED
+
+    def test_ingest_scrape_parses_stats_plane(self):
+        pol = RouterPolicy(pcfg())
+        scraped = {7: {"ns/comp/gen": {
+            "requests": 3, "active": 2, "errors": 0,
+            "data": {"worker_stats": {"request_active_slots": 1,
+                                      "request_total_slots": 8,
+                                      "num_requests_waiting": 4}}}}}
+        pol.ingest_scrape(scraped, "ns/comp/gen")
+        assert pol.worker_stats[7] == {"queue_depth": 4.0,
+                                       "active_slots": 1.0, "active": 2.0}
+
+
+class TestRetryBudget:
+    def test_spend_bounded_by_deposits(self):
+        b = RetryBudget(ratio=0.25, floor=1.0)
+        assert b.try_spend()
+        assert not b.try_spend()
+        for _ in range(4):
+            b.deposit()
+        assert b.try_spend()
+        assert not b.try_spend()
+
+    def test_balance_capped(self):
+        b = RetryBudget(ratio=1.0, floor=1.0)
+        for _ in range(100):
+            b.deposit()
+        assert b.balance <= b.cap
+
+
+class TestLatencyBook:
+    def test_ewma_and_p95(self):
+        book = LatencyBook(alpha=0.5)
+        book.observe_ttft(1, 1.0)
+        book.observe_ttft(1, 0.0)
+        assert book.ttft(1) == pytest.approx(0.5)
+        for _ in range(19):
+            book.observe_ttft(2, 0.1)
+        book.observe_ttft(2, 5.0)
+        assert book.ttft_p95() >= 0.1
+
+
+class TestCostSelection:
+    def test_prefers_fast_and_idle(self):
+        pol = RouterPolicy(pcfg())
+        pol.observe_ttft(1, 1.0)   # slow worker
+        chosen, inputs = pol.select([1, 2])
+        assert chosen == 2
+        assert inputs["candidates"] == 2
+        pol.begin(2)
+        pol.begin(2)
+        pol.observe_ttft(1, 0.0)   # decays; 2 now carries inflight
+        for _ in range(20):
+            pol.observe_ttft(1, 0.0)
+        chosen, _ = pol.select([1, 2])
+        assert chosen == 1
+
+    def test_queue_depth_feeds_score(self):
+        pol = RouterPolicy(pcfg())
+        pol.update_worker_stats(1, queue_depth=10)
+        chosen, inputs = pol.select([1, 2])
+        assert chosen == 2
+        score1, in1 = pol.score(1)
+        assert in1["queue_depth"] == 10.0
+        assert score1 > pol.score(2)[0]
+
+    def test_breaker_filters_selection(self):
+        client = FakeClient([1, 2, 3],
+                            streams={i: FakeStream(["x"]) for i in (1, 2, 3)})
+        router = PushRouter(client, RouterMode.COST,
+                            policy=RouterPolicy(pcfg()))
+        router.policy.breakers.force_open(2)
+        picks = {router.select_instance() for _ in range(10)}
+        assert 2 not in picks
+        # all breakers open: degrade to the full set rather than refuse
+        router.policy.breakers.force_open(1)
+        router.policy.breakers.force_open(3)
+        assert router.select_instance() in (1, 2, 3)
+
+
+class TestRoundRobinByteStable:
+    def test_no_policy_round_robin_sequence(self):
+        # regression: the fallback RouterMode stays byte-stable — sorted
+        # ids, modular cursor, no policy object attached
+        client = FakeClient([3, 1, 2])
+        router = PushRouter(client)
+        assert router.policy is None
+        assert [router.select_instance() for _ in range(7)] == \
+            [1, 2, 3, 1, 2, 3, 1]
+
+    async def test_legacy_stream_path_unchanged(self):
+        stream = FakeStream(["a", "b"])
+        client = FakeClient([1], streams={1: stream})
+        router = PushRouter(client)
+        items = [i async for i in router.generate_stream({"x": 1})]
+        assert items == ["a", "b"]
+        assert client.direct_calls == [1]
+        assert not stream.cancelled
+
+    def test_cost_mode_available_in_enum(self):
+        assert RouterMode("cost") is RouterMode.COST
+        assert RouterMode("round-robin") is RouterMode.ROUND_ROBIN
+
+
+class TestBrownoutNoStorm:
+    async def test_retry_budget_prevents_storm(self):
+        # fleet-wide brownout: every dispatch fails at connect.  Legacy
+        # failover would burn retries*N attempts; the budget caps the total
+        # at N + floor + ratio*N.
+        before = snapshot()
+        n = 50
+        client = FakeClient([1, 2, 3], streams={}, sticky=True)
+        pol = RouterPolicy(pcfg(retry_budget_ratio=0.1))
+        router = PushRouter(client, RouterMode.COST, retries=3, policy=pol,
+                            backoff_base_s=0.0)
+        failures = 0
+        for _ in range(n):
+            with pytest.raises((ConnectionError, DeadlineExceededError)):
+                async for _item in router.generate_stream({"p": 1}):
+                    pass
+            failures += 1
+        assert failures == n
+        # 50 first attempts + (floor 3 + 0.1*50 = 8) budgeted retries
+        assert n <= len(client.direct_calls) <= n + 10
+        assert delta(before, "retries", "denied") > 0
+        assert get_router_stats().budget_balance < 1.0
+
+    async def test_single_fault_still_retries(self):
+        # the budget exists to stop storms, not to break normal failover:
+        # one dead instance, healthy fleet -> the retry lands elsewhere
+        stream = FakeStream(["ok"])
+        client = FakeClient([1, 2], streams={2: stream}, sticky=True)
+        router = PushRouter(client, RouterMode.COST, retries=3,
+                            policy=RouterPolicy(pcfg()), backoff_base_s=0.0)
+        # force the first pick onto the dead instance
+        router.policy.update_worker_stats(2, queue_depth=5)
+        items = [i async for i in router.generate_stream({"p": 1})]
+        assert items == ["ok"]
+        assert client.direct_calls == [1, 2]
+
+
+class TestDeadlineGuards:
+    async def test_no_redispatch_past_deadline_budget(self):
+        # satellite bugfix: a retry whose target's EWMA TTFT exceeds the
+        # remaining deadline is never dispatched
+        client = FakeClient([1, 2], streams={}, sticky=True)
+        pol = RouterPolicy(pcfg())
+        pol.lat.observe_ttft(1, 10.0)
+        pol.lat.observe_ttft(2, 10.0)
+        router = PushRouter(client, RouterMode.COST, retries=3, policy=pol,
+                            backoff_base_s=0.0)
+        headers = {DEADLINE_HEADER: time.time() + 1.0}
+        with pytest.raises(DeadlineExceededError):
+            async for _ in router.generate_stream({"p": 1}, headers=headers):
+                pass
+        assert len(client.direct_calls) == 1  # first attempt only
+
+    def test_can_redispatch_semantics(self):
+        pol = RouterPolicy(pcfg())
+        assert pol.can_redispatch(1, None)
+        pol.lat.observe_ttft(1, 5.0)
+        assert not pol.can_redispatch(1, time.time() + 1.0)
+        assert pol.can_redispatch(1, time.time() + 30.0)
+
+
+class TestHedgedDispatch:
+    async def test_hedge_winner_cancels_loser(self):
+        before = snapshot()
+        slow = FakeStream(["slow"], first_delay=5.0)
+        fast = FakeStream(["fast1", "fast2"])
+        client = FakeClient([1, 2], streams={1: slow, 2: fast})
+        pol = RouterPolicy(pcfg(hedge=True, hedge_delay_s=0.05))
+        # pin the primary choice onto the slow worker
+        pol.update_worker_stats(2, queue_depth=5)
+        router = PushRouter(client, RouterMode.COST, policy=pol)
+        items = [i async for i in router.generate_stream({"p": 1})]
+        assert items == ["fast1", "fast2"]
+        assert client.direct_calls == [1, 2]
+        assert slow.cancelled  # loser cancelled, no orphan stream
+        assert delta(before, "hedges", "fired") == 1
+        assert delta(before, "hedges", "won") == 1
+        assert pol.inflight == {}  # both sides settled
+        # the losing primary is penalized with the elapsed time as a TTFT
+        # lower bound (so the scorer learns to avoid it); the hedge winner
+        # records its own dispatch-relative TTFT, not the hedge delay
+        assert pol.lat.ttft(1) >= 0.04
+        assert pol.lat.ttft(2) < 0.04
+
+    async def test_primary_win_cancels_hedge(self):
+        before = snapshot()
+        primary = FakeStream(["p1"], first_delay=0.15)
+        hedge = FakeStream(["h1"], first_delay=5.0)
+        client = FakeClient([1, 2], streams={1: primary, 2: hedge})
+        pol = RouterPolicy(pcfg(hedge=True, hedge_delay_s=0.05))
+        pol.update_worker_stats(2, queue_depth=5)
+        router = PushRouter(client, RouterMode.COST, policy=pol)
+        items = [i async for i in router.generate_stream({"p": 1})]
+        assert items == ["p1"]
+        assert hedge.cancelled
+        assert delta(before, "hedges", "lost") == 1
+        assert pol.inflight == {}
+
+    async def test_expired_hedge_never_dispatched(self):
+        before = snapshot()
+        slow = FakeStream(["late"], first_delay=0.2)
+        client = FakeClient([1, 2],
+                            streams={1: slow, 2: FakeStream(["h"])})
+        pol = RouterPolicy(pcfg(hedge=True, hedge_delay_s=0.02))
+        pol.update_worker_stats(2, queue_depth=5)   # primary = 1
+        pol.lat.observe_ttft(2, 60.0)  # alt can't beat any sane deadline
+        router = PushRouter(client, RouterMode.COST, policy=pol)
+        headers = {DEADLINE_HEADER: time.time() + 5.0}
+        items = [i async for i in router.generate_stream({"p": 1},
+                                                         headers=headers)]
+        assert items == ["late"]  # primary still completes
+        assert client.direct_calls == [1]  # hedge was suppressed
+        assert delta(before, "hedges", "expired") == 1
+        assert delta(before, "hedges", "fired") == 0
+
+    async def test_hedge_denied_when_budget_empty(self):
+        before = snapshot()
+        slow = FakeStream(["late"], first_delay=0.2)
+        client = FakeClient([1, 2],
+                            streams={1: slow, 2: FakeStream(["h"])})
+        pol = RouterPolicy(pcfg(hedge=True, hedge_delay_s=0.02,
+                                retry_budget_ratio=0.0,
+                                retry_budget_floor=0.0))
+        pol.update_worker_stats(2, queue_depth=5)
+        router = PushRouter(client, RouterMode.COST, policy=pol)
+        items = [i async for i in router.generate_stream({"p": 1})]
+        assert items == ["late"]
+        assert client.direct_calls == [1]
+        assert delta(before, "hedges", "denied") == 1
+
+    async def test_migration_replay_never_hedged(self):
+        before = snapshot()
+        slow = FakeStream(["r"], first_delay=0.15)
+        client = FakeClient([1, 2],
+                            streams={1: slow, 2: FakeStream(["h"])})
+        pol = RouterPolicy(pcfg(hedge=True, hedge_delay_s=0.02))
+        pol.update_worker_stats(2, queue_depth=5)
+        router = PushRouter(client, RouterMode.COST, policy=pol)
+        payload = {"p": 1, "migration_attempt": 1, "request_id": "r~m1"}
+        items = [i async for i in router.generate_stream(payload)]
+        assert items == ["r"]
+        assert client.direct_calls == [1]  # no second dispatch
+        assert delta(before, "hedges", "fired") == 0
+
+    async def test_hedge_request_id_derived(self):
+        # the hedge attempt must not collide with the primary's request id
+        # (worker-side bookkeeping, migration accounting)
+        seen = []
+
+        class RecordingClient(FakeClient):
+            async def direct(self, payload, iid, headers=None):
+                seen.append(payload.get("request_id"))
+                return await super().direct(payload, iid, headers)
+
+        slow = FakeStream(["s"], first_delay=5.0)
+        client = RecordingClient([1, 2],
+                                 streams={1: slow, 2: FakeStream(["h"])})
+        pol = RouterPolicy(pcfg(hedge=True, hedge_delay_s=0.02))
+        pol.update_worker_stats(2, queue_depth=5)
+        router = PushRouter(client, RouterMode.COST, policy=pol)
+        items = [i async for i in router.generate_stream(
+            {"p": 1, "request_id": "req-1"})]
+        assert items == ["h"]
+        assert seen == ["req-1", "req-1~h1"]
+
+
+class TestStreamDropFeedsBreaker:
+    async def test_stream_drop_counts_failure_and_reraises(self):
+        stream = FakeStream(["a"], error=StreamEndedError("dropped"))
+        client = FakeClient([1], streams={1: stream})
+        pol = RouterPolicy(pcfg(breaker_failures=1))
+        router = PushRouter(client, RouterMode.COST, policy=pol)
+        with pytest.raises(StreamEndedError):
+            async for _ in router.generate_stream({"p": 1}):
+                pass
+        assert pol.breakers.state(1) is BreakerState.OPEN
+        assert client.down == [1]
+
+
+class TestKvSchedulerPolicyBlend:
+    def test_policy_bias_steers_selection(self):
+        from dynamo_tpu.kv_router.scheduler import KvScheduler
+        pol = RouterPolicy(pcfg())
+        s = KvScheduler(block_size=4, policy=pol)
+        # equal block cost; worker 1 is slow by EWMA -> bias pushes to 2
+        for _ in range(3):
+            pol.lat.observe_ttft(1, 1.0)
+        w, _ = s.select([1, 2], {}, isl_blocks=4)
+        assert w == 2
+
+    def test_breaker_open_excludes_worker(self):
+        from dynamo_tpu.kv_router.scheduler import KvScheduler
+        pol = RouterPolicy(pcfg())
+        s = KvScheduler(block_size=4, policy=pol)
+        pol.breakers.force_open(1)
+        # worker 1 holds the whole prefix, but its breaker is open
+        w, ov = s.select([1, 2], {1: 8}, isl_blocks=8)
+        assert (w, ov) == (2, 0)
+        # all open: degrade to the full candidate set
+        pol.breakers.force_open(2)
+        w, _ = s.select([1, 2], {1: 8}, isl_blocks=8)
+        assert w in (1, 2)
+
+    def test_explain_exposes_score_inputs(self):
+        from dynamo_tpu.kv_router.scheduler import KvScheduler
+        s = KvScheduler(block_size=4, policy=RouterPolicy(pcfg()))
+        explain = {}
+        w, _ = s.select([1, 2], {1: 3}, isl_blocks=4, explain=explain)
+        assert set(explain) == {1, 2}
+        assert explain[1]["overlap_blocks"] == 3
+        assert "cost" in explain[w]
+
+    def test_positional_select_still_works(self):
+        # regression: pre-policy callers use positional (candidates,
+        # overlaps, isl_blocks)
+        from dynamo_tpu.kv_router.scheduler import KvScheduler
+        s = KvScheduler(block_size=4, overlap_score_weight=1.0)
+        w, ov = s.select([1, 2], {1: 5}, 8)
+        assert (w, ov) == (1, 5)
+
+
+class TestDecisionTraceAttrs:
+    async def test_score_inputs_on_current_span(self):
+        from dynamo_tpu.utils.tracing import get_tracer
+        tracer = get_tracer()
+        client = FakeClient([1, 2], streams={1: FakeStream(["a"]),
+                                             2: FakeStream(["a"])})
+        pol = RouterPolicy(pcfg())
+        pol.update_worker_stats(1, queue_depth=2)
+        router = PushRouter(client, RouterMode.COST, policy=pol)
+        root = tracer.start_trace("http_request", attrs={"request_id": "t1"})
+        try:
+            items = [i async for i in router.generate_stream({"p": 1})]
+        finally:
+            root.finish()
+        assert items == ["a"]
+        assert root.attrs.get("router.policy") == "cost"
+        assert root.attrs.get("router.instance") == "2"
+        for key in ("router.score", "router.ewma_ttft_s", "router.inflight",
+                    "router.queue_depth", "router.breaker",
+                    "router.candidates"):
+            assert key in root.attrs, key
+
+
+class TestMetricsExport:
+    def test_router_families_on_frontend_registry(self):
+        from dynamo_tpu.http.metrics import FrontendMetrics
+        pol = RouterPolicy(pcfg(breaker_failures=1))
+        pol.on_failure(0xabc, "connect")
+        get_router_stats().decisions["cost"] += 1
+        text = FrontendMetrics().render().decode()
+        assert "dynamo_frontend_router_decisions_total" in text
+        assert 'dynamo_frontend_router_breaker_state{instance="abc"} 1.0' \
+            in text
+        assert "dynamo_frontend_router_retry_budget_balance" in text
+
+    def test_check_metrics_docs_green(self):
+        import subprocess
+        import sys
+        import os
+        r = subprocess.run(
+            [sys.executable, os.path.join(os.path.dirname(__file__), "..",
+                                          "tools", "check_metrics_docs.py")],
+            capture_output=True, timeout=120)
+        assert r.returncode == 0, r.stdout.decode() + r.stderr.decode()
+
+
+class TestChaosProxyDelayJitter:
+    async def test_per_connection_stall_seeded(self):
+        from dynamo_tpu.utils.faults import ChaosProxy
+
+        async def echo(reader, writer):
+            while data := await reader.read(1024):
+                writer.write(data)
+                await writer.drain()
+
+        server = await asyncio.start_server(echo, "127.0.0.1", 0)
+        port = server.sockets[0].getsockname()[1]
+        proxy = await ChaosProxy(f"127.0.0.1:{port}").start()
+        try:
+            async def rtt():
+                r, w = await asyncio.open_connection("127.0.0.1", proxy.port)
+                t0 = time.perf_counter()
+                w.write(b"ping")
+                await w.drain()
+                await r.readexactly(4)
+                dt = time.perf_counter() - t0
+                w.close()
+                return dt
+
+            assert await rtt() < 0.15  # unarmed: fast
+
+            proxy.delay_jitter(1.0, 0.2, 0.3, seed=9)
+            slow = await rtt()
+            # the stall applies in both pump directions (one draw per
+            # connection), so RTT >= 2 * min_s
+            assert slow >= 0.4
+
+            proxy.delay_jitter(0, 0, 0)  # disarm
+            assert await rtt() < 0.15
+
+            # p=0.0 via probability: no connection ever stalls
+            proxy.delay_jitter(0.0, 5.0, 5.0, seed=1)
+            assert await rtt() < 0.15
+        finally:
+            await proxy.stop()
+            server.close()
+            await server.wait_closed()
+
+
+@pytest.mark.e2e
+class TestMockerStatsPlane:
+    async def test_scrape_feeds_scorer_same_schema_as_worker(self):
+        # satellite: the mocker serves the queue-depth/in-flight payload the
+        # scorer consumes, so routing chaos tests run TPU-free
+        from dynamo_tpu.llm.register import register_llm, serve_engine
+        from dynamo_tpu.mocker import MockEngineArgs, MockerEngine
+        from dynamo_tpu.runtime.coordinator import Coordinator
+        from dynamo_tpu.runtime.runtime import DistributedRuntime
+        from dynamo_tpu.utils.testing import make_test_card
+
+        coord = await Coordinator(port=0).start()
+        drts, engine = [], None
+        try:
+            drt = await DistributedRuntime.create(coordinator=coord.address)
+            drts.append(drt)
+            engine = MockerEngine(MockEngineArgs(
+                num_pages=64, page_size=4, max_num_seqs=8,
+                max_prefill_chunk=16, max_context=256,
+                speedup_ratio=1000.0))
+            endpoint = (drt.namespace("ns").component("mock")
+                        .endpoint("generate"))
+            await serve_engine(endpoint, engine,
+                               stats_provider=lambda: engine.stats().to_dict())
+            await register_llm(drt, endpoint,
+                               make_test_card(name="mock-model",
+                                              kv_cache_block_size=4))
+
+            frontend = await DistributedRuntime.create(
+                coordinator=coord.address)
+            drts.append(frontend)
+            client = await (frontend.namespace("ns").component("mock")
+                            .endpoint("generate")).client()
+            insts = await client.wait_for_instances(1, timeout=10)
+            iid = insts[0].instance_id
+
+            scraped = await client.scrape_stats()
+            assert iid in scraped
+            ep = scraped[iid][client.endpoint.path]
+            assert "active" in ep
+            ws = ep["data"]["worker_stats"]
+            for key in ("request_active_slots", "request_total_slots",
+                        "num_requests_waiting"):
+                assert key in ws, ws
+
+            pol = RouterPolicy(pcfg())
+            pol.ingest_scrape(scraped, client.endpoint.path)
+            assert pol.worker_stats[iid]["queue_depth"] == 0.0
+            assert pol.worker_stats[iid]["active_slots"] == 0.0
+        finally:
+            if engine is not None:
+                await engine.stop()
+            for d in drts:
+                await d.close()
+            await coord.stop()
