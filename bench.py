@@ -1718,6 +1718,161 @@ async def _measure_routing(wd=None) -> dict:
             await coord.stop()
 
 
+# step-flight-recorder leg geometry: generated tokens per row, A/B rounds
+STEPTRACE_GEN = int(os.environ.get("BENCH_STEPTRACE_GEN", "48"))
+STEPTRACE_ROUNDS = int(os.environ.get("BENCH_STEPTRACE_ROUNDS", "5"))
+STEPTRACE_REPS = int(os.environ.get("BENCH_STEPTRACE_REPS", "6"))
+
+
+async def _measure_steptrace(wd=None) -> dict:
+    """Step flight recorder leg (observability PR): fused decode on a
+    tiny engine with the per-dispatch ring (``engine/steptrace.py``)
+    capturing every step.
+
+    Three phases on one engine:
+
+    1. warm a small cohort's jit buckets, then RERUN the same shape on a
+       fresh recorder — zero compile events expected (detection must not
+       false-positive on warmed buckets);
+    2. drive a cohort shape the engine has NEVER seen (bigger batch,
+       longer prompts) mid-trace — the cold prefill/decode buckets must
+       surface as compile events attributable to specific StepRecords;
+    3. on-vs-off A/B on the now-warm big cohort, rounds interleaved so
+       clock drift hits both arms: recorder overhead must stay under the
+       ISSUE's 2% tok/s budget (it is one lock + in-place slot writes
+       per DISPATCH, not per token — fused width 8 amortises it 8x).
+
+    Results land in the attempt JSON (``steptrace``) and — when
+    ``BENCH_STEPTRACE_OUT`` names a path — in a standalone artifact
+    (``BENCH_steptrace_r10.json``)."""
+    import numpy as np
+
+    from dynamo_tpu.engine.jax_engine import JaxEngine, JaxEngineConfig
+    from dynamo_tpu.engine.steptrace import StepRecorder
+    from dynamo_tpu.protocols.common import (
+        PreprocessedRequest, SamplingOptions, StopConditions)
+
+    if wd is not None:
+        wd.arm("measure:steptrace", STAGE_BUDGETS["measure"])
+    gen = STEPTRACE_GEN
+    from dynamo_tpu.models.config import ModelConfig
+    cfg = ModelConfig.tiny()
+    engine = JaxEngine.random_init(cfg, JaxEngineConfig(
+        num_pages=160, page_size=4, max_num_seqs=6, max_prefill_chunk=32,
+        max_prefill_seqs=6, max_context=128, min_prefill_bucket=8,
+        decode_multistep=8))
+    rng = np.random.default_rng(11)
+
+    async def drive(rid: str, prompt: list, n_gen: int) -> int:
+        req = PreprocessedRequest(
+            token_ids=prompt, request_id=rid,
+            stop_conditions=StopConditions(max_tokens=n_gen,
+                                           ignore_eos=True),
+            sampling_options=SamplingOptions(temperature=0.0))
+        n = 0
+        async for out in engine.generate(req):
+            n += len(out.token_ids)
+        return n
+
+    async def cohort(label: str, n_seqs: int, prompt_len: int):
+        prompts = [rng.integers(1, cfg.vocab_size, size=prompt_len).tolist()
+                   for _ in range(n_seqs)]
+        t0 = time.perf_counter()
+        counts = await asyncio.gather(*[
+            drive(f"st-{label}-{i}", p, gen)
+            for i, p in enumerate(prompts)])
+        return sum(counts), time.perf_counter() - t0
+
+    try:
+        # phase 1: warm the small-cohort buckets (prefill bucket 8,
+        # decode batch 2), then rerun the SAME shape on a fresh recorder
+        await cohort("warm", 2, 8)
+        trace = StepRecorder(capacity=4096, enabled=True)
+        engine.steptrace = trace
+        await cohort("rerun", 2, 8)
+        warm_rerun_events = sum(trace.compile_events.values())
+
+        # phase 2: a shape the engine has NEVER run — bigger batch and a
+        # longer prompt cross into cold prefill/decode buckets, so the
+        # first dispatches compile MID-TRACE on the live recorder
+        await cohort("cold", 6, 24)
+        agg = trace.aggregates()
+        midrun_events = (sum(agg["compile_events"].values())
+                         - warm_rerun_events)
+        snap = trace.snapshot(limit=4096)
+        compile_recs = [r for r in snap["records"] if r["compile_ms"] > 0]
+        compile_info = {
+            "warm_rerun_events": warm_rerun_events,
+            "midrun_events": midrun_events,
+            "midrun_compile_ms_max": round(max(
+                (r["compile_ms"] for r in compile_recs), default=0.0), 1),
+            "compile_records": len(compile_recs),
+            "compile_kinds": sorted({r["kind"] for r in compile_recs}),
+        }
+        aggregates_info = {
+            "records": snap["total"],
+            "kinds": sorted(agg["duration"].keys()),
+            "occupancy_samples": sum(
+                c for _, _, c in agg["occupancy"].values()),
+            "gap_samples": agg["gap"][2],
+            "pool_free": agg["pool_free"],
+            "pool_pinned": agg["pool_pinned"],
+        }
+
+        # phase 3: on-vs-off A/B on the now-warm big cohort. A single
+        # cohort is ~60ms of wall on CPU and jitters +-10% round to
+        # round, so the A/B is PAIRED: each round runs both arms
+        # back-to-back (order alternating so drift cannot favour one),
+        # each arm repeats the cohort STEPTRACE_REPS times to widen the
+        # window, and the reported overhead is the MEDIAN of the
+        # per-round paired differences — robust to the one round a GC
+        # pause lands in.
+        async def ab_arm(enabled: bool) -> float:
+            engine.steptrace = StepRecorder(capacity=4096, enabled=enabled)
+            tokens = 0
+            wall = 0.0
+            for _ in range(STEPTRACE_REPS):
+                t, w = await cohort("ab", 6, 24)
+                tokens += t
+                wall += w
+            return tokens / wall if wall > 0 else 0.0
+
+        await ab_arm(True)  # settle: any residual compile lands here
+        offs: list = []
+        ons: list = []
+        for r in range(STEPTRACE_ROUNDS):
+            if r % 2 == 0:
+                offs.append(await ab_arm(False))
+                ons.append(await ab_arm(True))
+            else:
+                ons.append(await ab_arm(True))
+                offs.append(await ab_arm(False))
+        diffs = sorted((o - n) / o * 100
+                       for o, n in zip(offs, ons) if o > 0)
+        overhead_pct = (round(diffs[len(diffs) // 2], 2)
+                        if diffs else 0.0)
+        med = lambda xs: sorted(xs)[len(xs) // 2] if xs else 0.0  # noqa: E731
+        ab_info = {"off_tok_s": round(med(offs), 1),
+                   "on_tok_s": round(med(ons), 1),
+                   "overhead_pct": overhead_pct,
+                   "rounds": STEPTRACE_ROUNDS, "reps": STEPTRACE_REPS}
+
+        result = {"compile": compile_info, "aggregates": aggregates_info,
+                  "ab": ab_info}
+        _ckpt("steptrace", midrun_compiles=midrun_events,
+              warm_rerun_events=warm_rerun_events,
+              overhead_pct=overhead_pct, off_tok_s=ab_info["off_tok_s"],
+              on_tok_s=ab_info["on_tok_s"])
+        out_path = os.environ.get("BENCH_STEPTRACE_OUT")
+        if out_path:
+            with open(out_path, "w") as f:
+                json.dump(result, f, indent=2, sort_keys=True)
+        return result
+    finally:
+        with contextlib.suppress(Exception):
+            await engine.stop()
+
+
 async def run_attempt(args) -> dict:
     """The whole attempt, one process: build -> prime -> measure ->
     transports -> optional attn-impl A/B. ``jax_init`` already happened in
@@ -1933,6 +2088,16 @@ async def run_attempt(args) -> dict:
         result["routing"] = await _measure_routing(wd)
     except Exception as e:  # noqa: BLE001 — best-effort extra data
         result["routing"] = {"error": str(e)[:300]}
+    print(json.dumps(result), flush=True)
+
+    # step-flight-recorder leg: fused decode with a deliberately cold
+    # jit bucket mid-trace — the compile must surface as attributable
+    # StepRecords, and the recorder's on-vs-off tok/s overhead must stay
+    # under the 2% budget
+    try:
+        result["steptrace"] = await _measure_steptrace(wd)
+    except Exception as e:  # noqa: BLE001 — best-effort extra data
+        result["steptrace"] = {"error": str(e)[:300]}
     print(json.dumps(result), flush=True)
 
     # attn-impl A/B in the SAME process (round-4 open question:

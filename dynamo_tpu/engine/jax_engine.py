@@ -30,6 +30,7 @@ import functools
 import logging
 import os
 import threading
+import time
 from dataclasses import dataclass
 from typing import Callable, Dict, Optional, Tuple
 
@@ -446,6 +447,16 @@ class JaxEngine(ScheduledEngineBase):
         self._moe_drops_lock = threading.Lock()
         self._moe_dispatch_active = (
             getattr(model_cfg, "moe_backend", "") == "dispatch")
+        # compile-event detection (engine/steptrace.py): the first call on
+        # a fresh (jit program, B, S) bucket ALWAYS traces+compiles, so
+        # its dispatch wall IS the compile cost — no threshold guessing.
+        # Seen keys use id(fn) (not the kind name) so the mixed-step alias
+        # of _jit_step shares its buckets (same trace, zero extra
+        # compiles). Appends happen on the step worker thread, the loop
+        # drains on the event-loop thread (the _moe_drops idiom).
+        self._jit_seen: set = set()
+        self._pending_compiles: list = []
+        self._compile_lock = threading.Lock()
         # multi-host: called with (kind, arrays, step) right before each
         # dispatch so rank 0 can broadcast the step to follower ranks
         # (parallel/multihost.py); None on single-host workers
@@ -1873,6 +1884,9 @@ class JaxEngine(ScheduledEngineBase):
                 pcarry = self._fresh_pcarry(seqs, B, samp)
         plan._step_id = self._step_counter
         fn = self._get_jit_multistep(w)
+        _ckey = (id(fn), B, w, pcarry is not None)
+        _fresh = _ckey not in self._jit_seen
+        _t0 = time.perf_counter() if _fresh else 0.0
         self.pages, packed_block, carry, drops = fn(
             self.params, self.pages, jnp.asarray(tok), jnp.asarray(pos),
             jnp.asarray(table), jnp.asarray(total), jnp.asarray(alive),
@@ -1890,6 +1904,10 @@ class JaxEngine(ScheduledEngineBase):
         self._step_counter += w
         self.decode_dispatches += 1
         self.multistep_blocks += 1
+        self.last_padded = (B, w)
+        if _fresh:
+            self._mark_compile(_ckey, "multistep", B, w,
+                               time.perf_counter() - _t0)
         return (packed_block, carry)
 
     def prime_multistep(self, B: int, widths=None):
@@ -1911,6 +1929,10 @@ class JaxEngine(ScheduledEngineBase):
         out = None
         for w in widths:
             fn = self._get_jit_multistep(w)
+            # priming IS the compile: mark the bucket seen so serving's
+            # first dispatch at this (B, w) is not misreported as a
+            # mid-run compile event
+            self._jit_seen.add((id(fn), B, w, False))
             self.pages, out, _carry, _drops = fn(
                 self.params, self.pages,
                 jnp.zeros((B, 1), jnp.int32), jnp.zeros((B, 1), jnp.int32),
@@ -1951,6 +1973,26 @@ class JaxEngine(ScheduledEngineBase):
             return None  # follower-side page IO (gather/scatter): no packed
         return self.fetch_packed(out)
 
+    def _mark_compile(self, ckey, kind: str, batch: int, width: int,
+                      seconds: float) -> None:
+        """Record one fresh-jit-bucket first call (== a compile) for the
+        step flight recorder; the loop drains these after the dispatch
+        and attributes them to the step's record + live request traces."""
+        self._jit_seen.add(ckey)
+        with self._compile_lock:
+            self._pending_compiles.append(
+                {"kind": kind, "batch": batch, "width": width,
+                 "seconds": seconds})
+            if len(self._pending_compiles) > 256:
+                # bounded: nothing is draining (no loop running — raw
+                # execute_arrays callers); keep the freshest
+                del self._pending_compiles[:-64]
+
+    def drain_compile_events(self) -> list:
+        with self._compile_lock:
+            ev, self._pending_compiles = self._pending_compiles, []
+        return ev
+
     def _invoke_step(self, kind: str, a: dict, step: int, prev_packed=None,
                      seqs=None):
         """Dispatch ONE jitted step of any family; returns the on-device
@@ -1985,6 +2027,20 @@ class JaxEngine(ScheduledEngineBase):
             self.pages = self._jit_scatter_pages(
                 self.pages, jnp.asarray(a["ids"]), jnp.asarray(a["vals"]))
             return None
+        _shape = (a["toks"] if "toks" in a else a["pos"]).shape
+        _B, _S = int(_shape[0]), int(_shape[1]) if len(_shape) > 1 else 1
+        if kind == "spec":
+            _fn = self._jit_spec
+        elif kind == "chained":
+            _fn = self._jit_chained
+        else:
+            _fn = {"ring": self._jit_ring_step,
+                   "mixed": self._jit_mixed}.get(kind, self._jit_step)
+        # the with-mask and without-mask pen pytrees are distinct traces
+        # (see _pen_arg) — a bucket per variant, like the jit cache itself
+        _ckey = (id(_fn), _B, _S, a.get("mask_words") is not None)
+        _fresh = _ckey not in self._jit_seen
+        _t0 = time.perf_counter() if _fresh else 0.0
         if kind == "spec":
             # shares the post-step aux handling below: a MoE family's
             # verify step reports dispatch drops like any other step
@@ -2026,6 +2082,10 @@ class JaxEngine(ScheduledEngineBase):
                 # bounded memory: drain all but the freshest few (those may
                 # still be in flight; everything older has long completed)
                 self._drain_moe_drops(keep_last=8)
+        self.last_padded = (_B, _S)
+        if _fresh:
+            self._mark_compile(_ckey, kind, _B, _S,
+                               time.perf_counter() - _t0)
         self._last_packed = packed
         return packed
 

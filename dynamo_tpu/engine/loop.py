@@ -32,6 +32,7 @@ import numpy as np
 
 from dynamo_tpu.engine.base import EngineBase
 from dynamo_tpu.engine.pages import PageAllocator
+from dynamo_tpu.engine.steptrace import get_step_recorder
 from dynamo_tpu.engine.scheduler import (
     DecodeBatch,
     MixedStepBatch,
@@ -126,6 +127,14 @@ class ScheduledEngineBase(EngineBase):
         # drain controller waits on exactly those (not unrelated exports).
         self.draining = False
         self._drain_leases: List[int] = []
+        # step flight recorder: every dispatch stamps one StepRecord into
+        # the process-wide ring (engine/steptrace.py); subclasses report
+        # their padded shapes via ``last_padded`` and first-call jit
+        # compiles via ``drain_compile_events`` so both occupancy and
+        # mid-run compiles are attributable from GET /v1/steptrace
+        self.steptrace = get_step_recorder()
+        self.last_padded: Optional[Tuple[int, int]] = None
+        self._last_dispatch_end: Optional[float] = None
 
     # -- subclass hook -----------------------------------------------------
 
@@ -183,6 +192,81 @@ class ScheduledEngineBase(EngineBase):
     def fetch_packed_block(self, handle):           # pragma: no cover - hook
         raise NotImplementedError
 
+    def drain_compile_events(self) -> List[dict]:
+        """Buffered first-call jit-compile events since the last drain
+        (``{"kind", "batch", "width", "seconds"}`` dicts). The jit engine
+        overrides this; engines with no compile step have none."""
+        return []
+
+    # -- step flight recorder ----------------------------------------------
+
+    def _stamp_dispatch(self, kind: str, plan, t_d0: float,
+                        plan_ms: float = 0.0, fallback: str = "",
+                        chained: bool = False):
+        """Stamp one dispatch into the step ring: queue/pool pressure at
+        plan time, real-vs-padded tokens (``last_padded`` from the
+        subclass), the gap since the previous dispatch returned (host
+        overhead between dispatches), and any compile events the engine
+        buffered during this dispatch — those also land on every live
+        request the step served (``Sequence.compile_ms``), so a mid-run
+        compile shows up in the request's own trace. Returns the live
+        ring record (or None when disabled)."""
+        st = self.steptrace
+        t_d1 = time.perf_counter()
+        gap_ms = 0.0
+        if self._last_dispatch_end is not None:
+            gap_ms = max(0.0, (t_d0 - self._last_dispatch_end) * 1000.0)
+        self._last_dispatch_end = t_d1
+        seqs = getattr(plan, "seqs", ()) if plan is not None else ()
+        rec = None
+        if st.enabled:
+            rows = len(seqs)
+            width = getattr(plan, "width", 0) or 0
+            if kind == "multistep":
+                tokens_real = rows * width
+            elif kind in ("prefill", "mixed"):
+                chunks = getattr(plan, "chunks", ()) or ()
+                dec = getattr(plan, "decode_seqs", ()) or ()
+                rows = len(chunks) + len(dec)
+                tokens_real = sum(c.length for c in chunks) + len(dec)
+            elif kind == "spec":
+                drafts = getattr(plan, "drafts", None)
+                k = drafts.shape[1] if drafts is not None else 0
+                tokens_real = rows * (k + 1)
+            else:
+                tokens_real = rows
+            padded = self.last_padded
+            if padded is not None:
+                batch = padded[0]
+                tokens_padded = padded[0] * padded[1]
+            else:
+                batch = rows
+                tokens_padded = tokens_real
+            mgr = getattr(self, "_export_leases", None)
+            rec = st.record(
+                kind, width=width, rows=rows, batch=batch,
+                tokens_real=tokens_real, tokens_padded=tokens_padded,
+                queue_depth=len(self.scheduler.waiting),
+                running=len(self.scheduler.active),
+                pool_free=self.allocator.num_free,
+                pool_pinned=mgr.pinned_pages if mgr is not None else 0,
+                plan_ms=plan_ms, dispatch_ms=(t_d1 - t_d0) * 1000.0,
+                gap_ms=gap_ms, fallback=fallback, chained=chained)
+        self.last_padded = None
+        for ev in self.drain_compile_events():
+            st.note_compile(ev.get("kind", kind), ev["seconds"], rec)
+            for seq in seqs:
+                seq.compile_ms += ev["seconds"] * 1000.0
+                seq.compile_events += 1
+        if plan is not None:
+            plan._steprec = rec
+        return rec
+
+    def _consume_fallback(self) -> str:
+        fb = getattr(self.scheduler, "last_fallback", "")
+        self.scheduler.last_fallback = ""
+        return fb
+
     # -- frame emission ----------------------------------------------------
 
     def _emit(self, seq: Sequence, out: LLMEngineOutput) -> None:
@@ -198,6 +282,14 @@ class ScheduledEngineBase(EngineBase):
                 t["admitted_unix"] = seq.admitted_unix
             if seq.cached_tokens:
                 t["cached_tokens"] = float(seq.cached_tokens)
+            if seq.compile_ms:
+                # a jit compile stalled this request before first token
+                # (cold bucket): ship-and-clear so a later decode-path
+                # compile isn't double counted on the final frame
+                t["compile_ms"] = seq.compile_ms
+                t["compile_events"] = float(seq.compile_events)
+                seq.compile_ms = 0.0
+                seq.compile_events = 0
             if out.timings:
                 # a final frame that is ALSO the first (1-token streams)
                 # carries both the stage stamps and the decode counters
@@ -238,6 +330,16 @@ class ScheduledEngineBase(EngineBase):
                 # the worker counter
                 out.timings["multistep_fallbacks"] = float(
                     seq.multistep_fallbacks)
+        if seq.compile_ms and seq.timings_sent:
+            # compile landed AFTER the first frame (a cold decode/fused
+            # bucket mid-stream): ride the final frame's timings — when
+            # this IS the first frame _emit ships it instead
+            if out.timings is None:
+                out.timings = {}
+            out.timings["compile_ms"] = seq.compile_ms
+            out.timings["compile_events"] = float(seq.compile_events)
+            seq.compile_ms = 0.0
+            seq.compile_events = 0
         self._emit(seq, out)
 
     def release_request(self, request_id: str) -> None:
@@ -531,6 +633,7 @@ class ScheduledEngineBase(EngineBase):
             fn, args, fut = self._exclusive.popleft()
             if fut.done():
                 continue
+            t_d0 = time.perf_counter()
             try:
                 res = await asyncio.to_thread(fn, *args)
             except asyncio.CancelledError:
@@ -545,6 +648,10 @@ class ScheduledEngineBase(EngineBase):
             else:
                 if not fut.done():
                     fut.set_result(res)
+            # exclusive-window work (KV export gathers, tier offload,
+            # drain freezes) shows up on the step timeline as its own
+            # kind, so a stalled KV pull is visible as the gap's cause
+            self._stamp_dispatch("gather", None, t_d0)
 
     # -- the engine loop ---------------------------------------------------
 
@@ -630,12 +737,16 @@ class ScheduledEngineBase(EngineBase):
                 return
             plan, handle = pending
             pending = None
+            t_u0 = time.perf_counter()
             try:
                 result = await asyncio.to_thread(fetch_fn(plan), handle)
             except Exception as e:  # noqa: BLE001
                 self._fail_plan(plan, e)
                 return
             process_fn(plan)(plan, *result)
+            self.steptrace.note_unpack(
+                getattr(plan, "_steprec", None),
+                (time.perf_counter() - t_u0) * 1000.0)
 
         while not self._stopping:
             if self._exclusive:
@@ -643,19 +754,24 @@ class ScheduledEngineBase(EngineBase):
                 await self._drain_exclusive()
             if pending is not None:
                 prev_plan, prev_handle = pending
+                t_p0 = time.perf_counter()
                 if isinstance(prev_plan, MultiStepBatch):
                     chained = (self.scheduler.plan_multistep_chained(prev_plan)
                                if self.supports_multistep else None)
                 else:
                     chained = (self.scheduler.plan_chained(prev_plan)
                                if self.supports_pipelining else None)
+                plan_ms = (time.perf_counter() - t_p0) * 1000.0
                 if chained is not None:
                     pending = None
+                    t_d0 = time.perf_counter()
                     try:
                         if isinstance(chained, MultiStepBatch):
+                            kind = "multistep"
                             handle = await asyncio.to_thread(
                                 self.dispatch_multistep, chained, prev_handle)
                         else:
+                            kind = "chained"
                             handle = await asyncio.to_thread(
                                 self.dispatch_chained, chained, prev_handle)
                     except Exception as e:  # noqa: BLE001
@@ -669,9 +785,12 @@ class ScheduledEngineBase(EngineBase):
                             self._fail_plan(prev_plan, e2)
                         self._fail_plan(chained, e)
                         continue
+                    self._stamp_dispatch(kind, chained, t_d0,
+                                         plan_ms=plan_ms, chained=True)
                     pending = (chained, handle)
                     # overlap: unpack step/block N (streaming its tokens
                     # out) while N+1 runs on device
+                    t_u0 = time.perf_counter()
                     try:
                         result = await asyncio.to_thread(
                             fetch_fn(prev_plan), prev_handle)
@@ -679,8 +798,12 @@ class ScheduledEngineBase(EngineBase):
                         self._fail_plan(prev_plan, e)
                         continue
                     process_fn(prev_plan)(prev_plan, *result)
+                    self.steptrace.note_unpack(
+                        getattr(prev_plan, "_steprec", None),
+                        (time.perf_counter() - t_u0) * 1000.0)
                     continue
                 await flush()
+            t_p0 = time.perf_counter()
             plan = self.scheduler.schedule()
             self._drain_reaped()
             if plan is None:
@@ -696,8 +819,10 @@ class ScheduledEngineBase(EngineBase):
                         continue
                     # cache full; yield to let running streams drain, retry
                     await asyncio.sleep(0.005)
+                    self._last_dispatch_end = None  # idle, not a stall
                     continue
                 await self._work.wait()
+                self._last_dispatch_end = None      # idle, not a stall
                 continue
             if isinstance(plan, DecodeBatch):
                 ms = None
@@ -707,31 +832,54 @@ class ScheduledEngineBase(EngineBase):
                     reason = self.multistep_unsupported_reason
                     if reason is not None:
                         self.scheduler.record_fallback(reason, plan.seqs)
+                plan_ms = (time.perf_counter() - t_p0) * 1000.0
                 if ms is not None:
+                    t_d0 = time.perf_counter()
                     try:
                         handle = await asyncio.to_thread(
                             self.dispatch_multistep, ms, None)
                     except Exception as e:  # noqa: BLE001
                         self._fail_plan(ms, e)
                         continue
+                    self._stamp_dispatch("multistep", ms, t_d0,
+                                         plan_ms=plan_ms)
                     pending = (ms, handle)
                     continue
                 if self.supports_pipelining:
+                    t_d0 = time.perf_counter()
                     try:
                         handle = await asyncio.to_thread(
                             self.dispatch_decode, plan)
                     except Exception as e:  # noqa: BLE001
                         self._fail_plan(plan, e)
                         continue
+                    self._stamp_dispatch("decode", plan, t_d0,
+                                         plan_ms=plan_ms,
+                                         fallback=self._consume_fallback())
                     pending = (plan, handle)
                     continue
+            plan_ms = (time.perf_counter() - t_p0) * 1000.0
+            if isinstance(plan, SpecDecodeBatch):
+                kind = "spec"
+            elif isinstance(plan, MixedStepBatch):
+                kind = "mixed"
+            elif isinstance(plan, PrefillBatch):
+                kind = "prefill"
+            else:
+                kind = "decode"
+            t_d0 = time.perf_counter()
             try:
                 result = await asyncio.to_thread(self._execute_plan, plan)
             except Exception as e:  # noqa: BLE001 — engine must not die silently
                 self._fail_plan(plan, e)
                 continue
+            rec = self._stamp_dispatch(kind, plan, t_d0, plan_ms=plan_ms,
+                                       fallback=self._consume_fallback())
             sampled, logprobs, extras = result
+            t_u0 = time.perf_counter()
             self._process(plan, sampled, logprobs, extras)
+            self.steptrace.note_unpack(
+                rec, (time.perf_counter() - t_u0) * 1000.0)
 
     async def start(self) -> None:
         if self._loop_task is None:

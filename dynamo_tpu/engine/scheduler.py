@@ -69,7 +69,7 @@ class Sequence:
                  "phase", "cancelled", "arrival", "salt_hash",
                  "enqueued_unix", "admitted_unix", "timings_sent",
                  "decode_steps", "decode_dispatches", "table_version",
-                 "multistep_fallbacks")
+                 "multistep_fallbacks", "compile_ms", "compile_events")
 
     def __init__(self, request: PreprocessedRequest, page_size: int,
                  salt_hash: int = 0):
@@ -107,6 +107,12 @@ class Sequence:
         # fused-decode refusals that touched this sequence (the trace
         # layer ships the count as a decode-span attr)
         self.multistep_fallbacks = 0
+        # jit compiles this sequence waited behind (fresh-bucket first
+        # calls, engine/steptrace.py): shipped on the first frame that
+        # follows (or the final frame for post-first-token compiles) so
+        # the request trace carries an xla_compile event
+        self.compile_ms = 0.0
+        self.compile_events = 0
 
     def pages_changed(self) -> None:
         self.table_version += 1
@@ -334,6 +340,9 @@ class Scheduler:
         # dynamo_worker_multistep_fallback_total{reason=...} so the
         # "fallback-reason near zero" roadmap criterion is measurable
         self.multistep_fallbacks: Dict[str, int] = {}
+        # most recent fallback reason, consumed by the engine loop so the
+        # demoted dispatch's StepRecord carries WHY it left the fast path
+        self.last_fallback = ""
         # consecutive scheduled steps that advanced NO decode row (the
         # decode-progress guarantee counter)
         self._steps_since_decode = 0
@@ -345,6 +354,7 @@ class Scheduler:
         touched so the trace layer can attribute it."""
         self.multistep_fallbacks[reason] = (
             self.multistep_fallbacks.get(reason, 0) + 1)
+        self.last_fallback = reason
         for seq in seqs:
             seq.multistep_fallbacks += 1
 

@@ -63,6 +63,16 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--shed-retry-after-s", type=float,
                         default=cfg.http_shed_retry_after_s,
                         help="Retry-After hint on shed responses")
+    # SLO targets for goodput accounting (docs/observability.md "Step
+    # timeline & goodput"): dynamo_frontend_slo_total judgments per
+    # request plus dynamo_frontend_goodput_tokens_total for tokens from
+    # requests inside every enabled target
+    parser.add_argument("--slo-ttft-s", type=float, default=0.0,
+                        help="TTFT SLO target in seconds (0 disables)")
+    parser.add_argument("--slo-itl-s", type=float, default=0.0,
+                        help="inter-token-latency SLO target in seconds, "
+                             "judged against each request's worst "
+                             "per-token gap (0 disables)")
     # failure-aware routing knobs (cost + kv modes; see docs/deployment.md
     # "Failure-aware routing")
     parser.add_argument("--breaker-failures", type=int,
@@ -122,7 +132,8 @@ async def amain(args: argparse.Namespace) -> None:
         request_timeout_s=args.request_timeout_s,
         max_inflight=args.max_inflight,
         max_model_inflight=args.max_model_inflight,
-        shed_retry_after_s=args.shed_retry_after_s)
+        shed_retry_after_s=args.shed_retry_after_s,
+        slo_ttft_s=args.slo_ttft_s, slo_itl_s=args.slo_itl_s)
     # control-plane health rides the same /metrics page as request metrics
     # (dynamo_coord_connected, dynamo_coord_reconnects_total, ...) and
     # gates GET /healthz/ready (503 while disconnected, so load balancers

@@ -63,8 +63,14 @@ class StageMetrics:
 
 
 class FrontendMetrics:
-    def __init__(self, registry: Optional[CollectorRegistry] = None):
+    def __init__(self, registry: Optional[CollectorRegistry] = None,
+                 slo_ttft_s: float = 0.0, slo_itl_s: float = 0.0):
         self.registry = registry or CollectorRegistry()
+        # SLO targets for goodput accounting (0.0 = target disabled).
+        # Judged per request at completion: TTFT against slo_ttft_s, the
+        # request's WORST per-token gap against slo_itl_s.
+        self.slo_ttft_s = float(slo_ttft_s)
+        self.slo_itl_s = float(slo_itl_s)
         ns = "dynamo_frontend"
         self.requests_total = Counter(
             f"{ns}_requests_total", "HTTP requests",
@@ -91,6 +97,24 @@ class FrontendMetrics:
             f"{ns}_requests_shed_total",
             "Requests shed at admission (503) by overload protection",
             ["model", "endpoint", "reason"], registry=self.registry)
+        # -- SLO / goodput ----------------------------------------------
+        self.slo_total = Counter(
+            f"{ns}_slo_total",
+            "Per-request SLO judgments by target (ttft, itl) and outcome: "
+            "'met'/'violated' judged at completion (itl against the "
+            "request's WORST per-token gap), 'shed' counted at admission "
+            "refusal — a shed request is an SLO miss the backlog never "
+            "sees. Zero unless --slo-ttft-s/--slo-itl-s enable the target.",
+            ["target", "outcome"], registry=self.registry)
+        self.goodput_tokens = Counter(
+            f"{ns}_goodput_tokens_total",
+            "Generated tokens from requests that met EVERY enabled SLO "
+            "target — goodput vs. raw dynamo_frontend_output_tokens_total "
+            "throughput. Zero while no SLO target is configured.",
+            ["model"], registry=self.registry)
+        for target in ("ttft", "itl"):
+            for outcome in ("met", "violated", "shed"):
+                self.slo_total.labels(target, outcome)
         # per-stage latency breakdown from trace spans; HttpService attaches
         # the process tracer at start and detaches at stop
         self.stage = StageMetrics(self.registry)
@@ -103,6 +127,15 @@ class FrontendMetrics:
         """Expose the process's coordinator-connection health next to the
         request metrics (``dynamo_coord_*`` series on the same /metrics)."""
         return CoordClientMetrics(coord, registry=self.registry)
+
+    def record_slo_shed(self) -> None:
+        """Count an admission-shed request against every enabled SLO
+        target: the client saw a 503 instead of tokens, which is an SLO
+        miss regardless of how fast the backlog would have drained."""
+        if self.slo_ttft_s > 0:
+            self.slo_total.labels("ttft", "shed").inc()
+        if self.slo_itl_s > 0:
+            self.slo_total.labels("itl", "shed").inc()
 
     def render(self) -> bytes:
         return generate_latest(self.registry)
@@ -271,6 +304,8 @@ class RequestTimer:
         self.last_token: Optional[float] = None
         self.first_token: Optional[float] = None
         self._done = False
+        self._ntokens = 0
+        self._itl_max_s: Optional[float] = None
         self.m.inflight.labels(model).inc()
 
     def on_token(self, n: int = 1) -> None:
@@ -281,9 +316,13 @@ class RequestTimer:
             self.first_token = now
             self.m.ttft.labels(self.model).observe(now - self.start)
         elif self.last_token is not None and n:
-            self.m.itl.labels(self.model).observe((now - self.last_token) / n)
+            itl = (now - self.last_token) / n
+            self.m.itl.labels(self.model).observe(itl)
+            if self._itl_max_s is None or itl > self._itl_max_s:
+                self._itl_max_s = itl
         self.last_token = now
         if n:
+            self._ntokens += n
             self.m.output_tokens.labels(self.model).inc(n)
 
     def done(self, status: str, prompt_tokens: int = 0) -> None:
@@ -296,6 +335,25 @@ class RequestTimer:
             time.perf_counter() - self.start)
         if prompt_tokens:
             self.m.input_tokens.labels(self.model).inc(prompt_tokens)
+        # SLO judgment + goodput: only requests that produced tokens are
+        # judged (an errored stream with no first token has nothing to
+        # measure and contributes zero goodput either way)
+        slo_ok = True
+        judged = False
+        if self.m.slo_ttft_s > 0 and self.first_token is not None:
+            met = (self.first_token - self.start) <= self.m.slo_ttft_s
+            self.m.slo_total.labels(
+                "ttft", "met" if met else "violated").inc()
+            slo_ok = slo_ok and met
+            judged = True
+        if self.m.slo_itl_s > 0 and self._itl_max_s is not None:
+            met = self._itl_max_s <= self.m.slo_itl_s
+            self.m.slo_total.labels(
+                "itl", "met" if met else "violated").inc()
+            slo_ok = slo_ok and met
+            judged = True
+        if judged and slo_ok and self._ntokens:
+            self.m.goodput_tokens.labels(self.model).inc(self._ntokens)
 
 
 __all__ = ["FrontendMetrics", "CoordClientMetrics", "CoordinatorMetrics",

@@ -103,11 +103,19 @@ class HttpService:
                  port: int = 8080, metrics: Optional[FrontendMetrics] = None,
                  request_timeout_s: float = 0.0,
                  max_inflight: int = 0, max_model_inflight: int = 0,
-                 shed_retry_after_s: float = 1.0):
+                 shed_retry_after_s: float = 1.0,
+                 slo_ttft_s: float = 0.0, slo_itl_s: float = 0.0):
         self.manager = manager
         self.host = host
         self.port = port
-        self.metrics = metrics or FrontendMetrics()
+        self.metrics = metrics or FrontendMetrics(
+            slo_ttft_s=slo_ttft_s, slo_itl_s=slo_itl_s)
+        # SLO targets apply to a caller-supplied FrontendMetrics too —
+        # the service flags are authoritative when set
+        if slo_ttft_s > 0:
+            self.metrics.slo_ttft_s = float(slo_ttft_s)
+        if slo_itl_s > 0:
+            self.metrics.slo_itl_s = float(slo_itl_s)
         # request-lifecycle robustness knobs (see utils/config.RuntimeConfig):
         # default end-to-end deadline (0 = none) and overload high-water
         # marks (0 = unlimited) for total / per-model concurrent requests
@@ -247,6 +255,9 @@ class HttpService:
             return None
         self.metrics.shed_total.labels(model, endpoint, reason).inc()
         self.metrics.requests_total.labels(model, endpoint, "503").inc()
+        # a shed request is an SLO miss for goodput accounting — the
+        # client got a 503 instead of tokens
+        self.metrics.record_slo_shed()
         resp = _error(503, "server overloaded; retry later", "overloaded")
         resp.headers["Retry-After"] = str(
             max(1, math.ceil(self.shed_retry_after_s)))

@@ -83,7 +83,11 @@ async def amain(args: argparse.Namespace) -> None:
 
     from dynamo_tpu.worker.metrics import engine_dispatch_stats
     wm.engine.attach(partial(engine_dispatch_stats, engine))
-    system = SystemServer.from_env(registry=wm.registry, tracer=tracer)
+    # step flight recorder parity with the real worker: the mocker's
+    # simulated dispatches stamp the same ring via ScheduledEngineBase
+    wm.steptrace.attach(engine.steptrace.aggregates)
+    system = SystemServer.from_env(registry=wm.registry, tracer=tracer,
+                                   steptrace=engine.steptrace)
     if system is not None:
         system.health.register("engine", ready=True)
         system.attach_coord(drt.coord)  # 503 /healthz/ready in an outage

@@ -8,8 +8,10 @@ is "ready" once every registered subsystem reports ready.
 
 When constructed with a ``tracer`` (``utils/tracing.Tracer``) the server
 also exposes that process's flight recorder: ``GET /v1/traces`` (newest
-first, ``?limit=&offset=`` pagination) and ``GET /v1/traces/{trace_id}``
-(the full span tree) — see ``docs/observability.md``.
+first, ``?limit=&offset=&request_id=`` pagination/lookup) and
+``GET /v1/traces/{trace_id}`` (the full span tree); with a ``steptrace``
+(``engine/steptrace.StepRecorder``) it exposes the engine step timeline
+on ``GET /v1/steptrace`` — see ``docs/observability.md``.
 """
 
 from __future__ import annotations
@@ -65,11 +67,12 @@ class SystemServer:
                  registry: Optional[CollectorRegistry] = None,
                  extra_metrics: Optional[Callable[[], bytes]] = None,
                  host: str = "0.0.0.0", port: int = 0,
-                 tracer=None):
+                 tracer=None, steptrace=None):
         self.health = health or SystemHealth()
         self.registry = registry
         self.extra_metrics = extra_metrics
         self.tracer = tracer
+        self.steptrace = steptrace
         self.host = host
         self.port = port
         self.app = web.Application()
@@ -80,6 +83,7 @@ class SystemServer:
         self.app.router.add_get("/metrics", self.handle_metrics)
         self.app.router.add_get("/v1/traces", self.handle_traces)
         self.app.router.add_get("/v1/traces/{trace_id}", self.handle_trace)
+        self.app.router.add_get("/v1/steptrace", self.handle_steptrace)
         self.app.router.add_post("/drain", self.handle_drain)
         # graceful-drain hook (worker/drain.DrainController): POST /drain
         # triggers it; absent on processes with nothing to drain
@@ -181,6 +185,9 @@ class SystemServer:
         return trace_get_response(self.tracer,
                                   request.match_info["trace_id"])
 
+    async def handle_steptrace(self, request: web.Request) -> web.Response:
+        return steptrace_response(self.steptrace, request)
+
 
 def trace_list_response(tracer, request: web.Request) -> web.Response:
     """``GET /v1/traces`` body from a flight recorder — shared between the
@@ -194,7 +201,26 @@ def trace_list_response(tracer, request: web.Request) -> web.Response:
     except ValueError:
         return web.json_response(
             {"error": "limit/offset must be integers"}, status=400)
-    return web.json_response(tracer.traces(limit=limit, offset=offset))
+    return web.json_response(tracer.traces(
+        limit=limit, offset=offset,
+        request_id=request.query.get("request_id", "")))
+
+
+def steptrace_response(recorder, request: web.Request) -> web.Response:
+    """``GET /v1/steptrace`` body from an engine step flight recorder
+    (``engine/steptrace.StepRecorder``): newest-first StepRecords with
+    ``?limit=&offset=`` pagination."""
+    if recorder is None:
+        return web.json_response(
+            {"error": "step tracing is not enabled on this process"},
+            status=404)
+    try:
+        limit = int(request.query.get("limit", "100"))
+        offset = int(request.query.get("offset", "0"))
+    except ValueError:
+        return web.json_response(
+            {"error": "limit/offset must be integers"}, status=400)
+    return web.json_response(recorder.snapshot(limit=limit, offset=offset))
 
 
 def trace_get_response(tracer, trace_id: str) -> web.Response:
@@ -210,4 +236,5 @@ def trace_get_response(tracer, trace_id: str) -> web.Response:
 
 
 __all__ = ["SystemServer", "SystemHealth", "coord_ready_reasons",
-           "trace_list_response", "trace_get_response"]
+           "trace_list_response", "trace_get_response",
+           "steptrace_response"]

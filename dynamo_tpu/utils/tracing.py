@@ -226,6 +226,12 @@ class Tracer:
         self._ring: "OrderedDict[str, Dict[str, Any]]" = OrderedDict()
         self.dropped_traces = 0     # sampled out or buffer-evicted
         self._last_finalized: Optional[Dict[str, Any]] = None
+        # keep-last-K side ring: the most recent finalized traces, kept
+        # even when slow-trace sampling (DYN_TRACE_SLOW_S) drops them from
+        # the main ring — "the request I JUST sent" stays findable via
+        # /v1/traces?request_id= without turning sampling off fleet-wide
+        self.keep_last = max(0, _env_int("DYN_TRACE_KEEP_LAST", 64))
+        self._keep_last: "OrderedDict[str, Dict[str, Any]]" = OrderedDict()
         self._listeners: List[Callable[[Span], None]] = []
 
     # -- span creation -----------------------------------------------------
@@ -404,6 +410,12 @@ class Tracer:
             "spans": spans,
         }
         self._last_finalized = record
+        if self.keep_last:
+            # before the sampling decision: fast traces stay findable
+            self._keep_last.pop(root.trace_id, None)
+            self._keep_last[root.trace_id] = record
+            while len(self._keep_last) > self.keep_last:
+                self._keep_last.popitem(last=False)
         if self.slow_s > 0 and root.duration_s < self.slow_s and not errored:
             self.dropped_traces += 1
             return
@@ -432,11 +444,20 @@ class Tracer:
 
     # -- flight-recorder queries (the /v1/traces surface) ------------------
 
-    def traces(self, limit: int = 50, offset: int = 0) -> Dict[str, Any]:
-        """Newest-first summaries with offset pagination."""
+    def traces(self, limit: int = 50, offset: int = 0,
+               request_id: str = "") -> Dict[str, Any]:
+        """Newest-first summaries with offset pagination; ``request_id``
+        filters by exact request id across BOTH the main ring and the
+        keep-last ring (so sampled-out fast traces are still findable)."""
         limit = max(1, min(int(limit), self.capacity))
         offset = max(0, int(offset))
         all_traces = list(reversed(self._ring.values()))
+        if request_id:
+            seen = {t["trace_id"] for t in all_traces}
+            all_traces += [t for t in reversed(self._keep_last.values())
+                           if t["trace_id"] not in seen]
+            all_traces = [t for t in all_traces
+                          if t.get("request_id") == request_id]
         page = all_traces[offset:offset + limit]
         return {
             "total": len(all_traces),
@@ -454,10 +475,12 @@ class Tracer:
         }
 
     def get_trace(self, trace_id: str) -> Optional[Dict[str, Any]]:
-        return self._ring.get(trace_id)
+        rec = self._ring.get(trace_id)
+        return rec if rec is not None else self._keep_last.get(trace_id)
 
     def clear(self) -> None:
         self._ring.clear()
+        self._keep_last.clear()
         self._live.clear()
 
 
@@ -533,6 +556,14 @@ class StageStitcher:
                 # dynamo_worker_multistep_fallback_total{reason})
                 self.decode_attrs["multistep_fallbacks"] = int(
                     timings["multistep_fallbacks"])
+        if timings and "compile_ms" in timings and self.parent is not None:
+            # a fresh-jit-bucket compile stalled this request (engine
+            # steptrace detection): an event on the hop span so the stall
+            # is attributable from the request's own trace, not just the
+            # worker-wide compile counter
+            self.parent.add_event(
+                "xla_compile", ms=round(float(timings["compile_ms"]), 3),
+                count=int(timings.get("compile_events", 1)))
         if self.first_unix is not None:
             return
         if not timings:

@@ -21,8 +21,9 @@ import asyncio
 import json
 import logging
 import os
+import threading
 import time
-from typing import Any, AsyncIterator, Optional
+from typing import Any, AsyncIterator, Dict, Optional
 
 from dynamo_tpu.engine.jax_engine import JaxEngine
 from dynamo_tpu.engine.transfer import (
@@ -56,6 +57,53 @@ logger = logging.getLogger(__name__)
 KV_EXPORT_ENDPOINT = "kv_export"
 
 
+class KvBandwidthBook:
+    """Per-plane KV-transfer bandwidth EWMAs (bulk / rpc / direct).
+
+    Each completed pull leg contributes one (bytes, wall-seconds) sample
+    for the plane that served it; the EWMA smooths transient dips while
+    tracking a degrading link within a few pulls. Surfaced on the worker
+    ``__stats__`` plane (``worker/main.worker_stats`` merges
+    ``snapshot()`` as ``kv_transfer``) so the frontend cost router and
+    fleet tooling see per-plane transfer health alongside queue depth —
+    no Prometheus scrape in the routing path."""
+
+    _ALPHA = 0.3  # weight of the newest sample
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._ewma: Dict[str, float] = {}
+        self._bytes: Dict[str, int] = {}
+        self._samples: Dict[str, int] = {}
+
+    def note(self, plane: str, nbytes: int, seconds: float) -> None:
+        if nbytes <= 0 or seconds <= 0:
+            return  # empty or unmeasured leg: no bandwidth information
+        bw = nbytes / seconds
+        with self._lock:
+            prev = self._ewma.get(plane)
+            self._ewma[plane] = bw if prev is None else (
+                self._ALPHA * bw + (1.0 - self._ALPHA) * prev)
+            self._bytes[plane] = self._bytes.get(plane, 0) + int(nbytes)
+            self._samples[plane] = self._samples.get(plane, 0) + 1
+
+    def snapshot(self) -> Dict[str, dict]:
+        with self._lock:
+            return {p: {"bw_bytes_per_s": round(self._ewma[p], 1),
+                        "bytes_total": self._bytes[p],
+                        "samples": self._samples[p]}
+                    for p in sorted(self._ewma)}
+
+
+_kv_bw_book: Optional[KvBandwidthBook] = None
+
+
+def get_kv_bandwidth_book() -> KvBandwidthBook:
+    """Process-wide bandwidth book (pull paths write, __stats__ reads)."""
+    global _kv_bw_book
+    if _kv_bw_book is None:
+        _kv_bw_book = KvBandwidthBook()
+    return _kv_bw_book
 
 
 def make_device_transfer_plane(engine: JaxEngine):
@@ -471,8 +519,11 @@ class KvBlockPuller:
                     data = await asyncio.wait_for(
                         asyncio.to_thread(self.direct_plane.pull, offer),
                         timeout=self.direct_pull_timeout)
-                    phases["recv_s"] += time.perf_counter() - t0
+                    _dt = time.perf_counter() - t0
+                    phases["recv_s"] += _dt
                     _count_bytes(getattr(data, "nbytes", 0), "direct")
+                    get_kv_bandwidth_book().note(
+                        "direct", getattr(data, "nbytes", 0), _dt)
                     # commit in bounded windows, one minimal exclusive
                     # scatter each: decode steps interleave with a large
                     # direct-plane inject instead of stalling behind it
@@ -531,10 +582,12 @@ class KvBlockPuller:
                                       len(want))
                 pipe = InjectPipeline(self.engine)
                 seen_windows: set = set()
+                bulk_bytes = [0]  # wire bytes this attempt, for the EWMA
 
                 def on_meta(meta, nbytes):
                     nonlocal total
                     _count_bytes(nbytes, "bulk")
+                    bulk_bytes[0] += int(nbytes)
                     self._note_shard_bytes(kv_span, meta, nbytes)
                     if meta.get("shard") is not None:
                         # count each block window once, not per shard slice
@@ -551,12 +604,15 @@ class KvBlockPuller:
                     # A sharded cache advertises its shard layout so a
                     # same-layout exporter streams per-shard frames
                     # (wire v5) instead of host-gathered merged frames.
-                    phases["recv_s"] += await pump_bulk_frames(
+                    _recv = await pump_bulk_frames(
                         pipe, bulk_address, KV_EXPORT_ENDPOINT,
                         {"block_hashes": want,
                          "wire": FRAME_WIRE_VERSION,
                          **kv_shard_payload(self.engine)},
                         f"{iid:x}", 60.0, on_meta)
+                    phases["recv_s"] += _recv
+                    get_kv_bandwidth_book().note(
+                        "bulk", bulk_bytes[0], _recv)
                     injected += await pipe.finish()
                     bulk_done = True
                     break
@@ -655,12 +711,17 @@ class KvBlockPuller:
         # per-block schema ride the same pipeline via add_blocks.
         pipe = InjectPipeline(self.engine)
         seen_windows: set = set()
+        rpc_bytes = 0
+        rpc_recv = 0.0
         try:
             t0 = time.perf_counter()
             async for frame in kv_stream:
-                phases["recv_s"] += time.perf_counter() - t0
+                _dt = time.perf_counter() - t0
+                rpc_recv += _dt
+                phases["recv_s"] += _dt
                 if "_raw" in frame:
                     _count_bytes(len(frame["_raw"]), "rpc")
+                    rpc_bytes += len(frame["_raw"])
                     if kv_span is not None:
                         self._note_shard_bytes(kv_span, frame,
                                                len(frame["_raw"]))
@@ -681,6 +742,7 @@ class KvBlockPuller:
                         [BlockPayload.from_wire(frame)])
                 t0 = time.perf_counter()
             note_injected(await pipe.finish())
+            get_kv_bandwidth_book().note("rpc", rpc_bytes, rpc_recv)
         except BaseException:
             note_injected(await pipe.drain())
             raise

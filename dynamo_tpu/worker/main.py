@@ -338,10 +338,17 @@ async def amain(args: argparse.Namespace) -> None:
         tiered.enable_peer_fetch(await g4_ep.client(),
                                  self_instance_id=g4_lease.lease_id)
 
+    from dynamo_tpu.worker.disagg import get_kv_bandwidth_book
+
     def worker_stats() -> dict:
         d = engine.stats().to_dict()
         if tiered is not None:
             d["kvbm"] = tiered.kvbm_stats()
+        # per-plane KV-transfer bandwidth EWMAs (bulk/rpc/direct) so the
+        # frontend cost router sees transfer health without a scrape
+        bw = get_kv_bandwidth_book().snapshot()
+        if bw:
+            d["kv_transfer"] = bw
         return d
 
     if multihost:
@@ -520,7 +527,11 @@ async def amain(args: argparse.Namespace) -> None:
     from dynamo_tpu.worker.metrics import engine_dispatch_stats
     import functools as _functools
     wm.engine.attach(_functools.partial(engine_dispatch_stats, engine))
-    system = SystemServer.from_env(registry=wm.registry, tracer=tracer)
+    # step flight recorder: duration/occupancy/step-gap histograms +
+    # compile counters on /metrics, raw timeline on /v1/steptrace
+    wm.steptrace.attach(engine.steptrace.aggregates)
+    system = SystemServer.from_env(registry=wm.registry, tracer=tracer,
+                                   steptrace=engine.steptrace)
     if system is not None:
         system.health.register("engine", ready=True)
         # /healthz/ready turns 503 while the coordinator connection is

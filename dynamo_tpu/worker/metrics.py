@@ -35,7 +35,8 @@ from __future__ import annotations
 from typing import Callable, Dict, Optional
 
 from prometheus_client import CollectorRegistry, Counter, Gauge
-from prometheus_client.core import CounterMetricFamily, GaugeMetricFamily
+from prometheus_client.core import (CounterMetricFamily, GaugeMetricFamily,
+                                    HistogramMetricFamily)
 
 from dynamo_tpu.http.metrics import StageMetrics
 
@@ -195,6 +196,105 @@ class EngineDispatchCollector:
         yield fb
 
 
+class StepTraceCollector:
+    """Scrape-time collector rendering the engine step flight recorder's
+    inline aggregates (``engine/steptrace.StepRecorder.aggregates()``) as
+    the fleet accounting layer: per-kind step duration / batch-occupancy
+    histograms, the step-gap histogram (host overhead between
+    dispatches), page-pool pressure gauges, and compile-event counters.
+
+    Registered UNCONDITIONALLY (zero-valued until a recorder is attached)
+    so the metrics<->docs drift gate always sees the schema. The recorder
+    does the bucketing inline on the hot path; this collector only
+    re-renders plain dicts at scrape time — a scrape never touches the
+    step loop."""
+
+    # the dispatch families the loop stamps; pre-seeded so dashboards can
+    # reference every kind before the first dispatch of that kind runs
+    KINDS = ("prefill", "decode", "chained", "multistep", "mixed", "spec",
+             "gather")
+
+    def __init__(self, registry: CollectorRegistry):
+        self._source = None
+        registry.register(self)
+
+    def attach(self, source) -> None:
+        """Point the collector at a live recorder's ``aggregates``."""
+        self._source = source
+
+    @staticmethod
+    def _zero_hist(bounds) -> list:
+        return [(str(b), 0) for b in bounds] + [("+Inf", 0)]
+
+    def collect(self):
+        agg: Dict[str, object] = {}
+        if self._source is not None:
+            try:
+                agg = self._source() or {}
+            except Exception:  # noqa: BLE001 — a scrape must never fail
+                import logging
+                logging.getLogger(__name__).debug(
+                    "steptrace aggregate sample failed", exc_info=True)
+        from dynamo_tpu.engine.steptrace import (_DUR_BOUNDS, _GAP_BOUNDS,
+                                                 _OCC_BOUNDS)
+        dur = HistogramMetricFamily(
+            "dynamo_worker_step_duration_seconds",
+            "Engine dispatch wall time by kind (prefill/decode/chained/"
+            "multistep/mixed/spec/gather) — the host-side dispatch call, "
+            "which includes compile time on a fresh jit bucket",
+            labels=["kind"])
+        occ = HistogramMetricFamily(
+            "dynamo_worker_step_occupancy",
+            "Batch occupancy per dispatch: real tokens / padded tokens "
+            "(bucket-padding waste is 1 - occupancy), by kind",
+            labels=["kind"])
+        durs = dict(agg.get("duration") or {})
+        occs = dict(agg.get("occupancy") or {})
+        for kind in sorted(set(self.KINDS) | set(durs) | set(occs)):
+            b, s, _n = durs.get(kind) or (self._zero_hist(_DUR_BOUNDS),
+                                          0.0, 0)
+            dur.add_metric([kind], buckets=b, sum_value=s)
+            b, s, _n = occs.get(kind) or (self._zero_hist(_OCC_BOUNDS),
+                                          0.0, 0)
+            occ.add_metric([kind], buckets=b, sum_value=s)
+        yield dur
+        yield occ
+        gap = HistogramMetricFamily(
+            "dynamo_worker_step_gap_seconds",
+            "Host time between the end of one dispatch and the start of "
+            "the next while work was available (scheduler planning, token "
+            "processing, exclusive-window stalls — idle waits excluded)")
+        gb, gs, _gn = (agg.get("gap")
+                       or (self._zero_hist(_GAP_BOUNDS), 0.0, 0))
+        gap.add_metric([], buckets=gb, sum_value=gs)
+        yield gap
+        yield GaugeMetricFamily(
+            "dynamo_worker_page_pool_free_pages",
+            "Free KV pages at the most recent dispatch's plan time",
+            value=float(agg.get("pool_free", 0)))
+        yield GaugeMetricFamily(
+            "dynamo_worker_page_pool_pinned_pages",
+            "KV pages pinned under export leases at the most recent "
+            "dispatch's plan time",
+            value=float(agg.get("pool_pinned", 0)))
+        ev = CounterMetricFamily(
+            "dynamo_worker_compile_events",
+            "XLA compiles detected mid-run (first call on a fresh "
+            "(kind, batch, seq) jit bucket), by dispatch kind",
+            labels=["kind"])
+        secs = CounterMetricFamily(
+            "dynamo_worker_compile_seconds",
+            "Wall seconds spent in mid-run XLA compiles, by dispatch kind",
+            labels=["kind"])
+        cev = dict(agg.get("compile_events") or {})
+        csec = dict(agg.get("compile_seconds") or {})
+        for kind in sorted(set(self.KINDS) | set(cev) | set(csec)):
+            ev.add_metric([kind], float(cev.get(kind, 0)))
+            secs.add_metric([kind], float(csec.get(kind, 0.0)))
+        yield ev
+        yield secs
+
+
 def engine_dispatch_stats(engine) -> Dict[str, object]:
     """The ``EngineDispatchCollector.attach`` source for a
     ``ScheduledEngineBase`` engine (JaxEngine and the mocker both carry
@@ -298,6 +398,10 @@ class WorkerMetrics:
         # decode dispatch taps, sampled at scrape time from the engine's
         # counters once attached (zero-valued until then)
         self.engine = EngineDispatchCollector(self.registry)
+        # step flight recorder aggregates (duration/occupancy/gap
+        # histograms, pool gauges, compile counters), sampled at scrape
+        # time once attached (zero-valued until then)
+        self.steptrace = StepTraceCollector(self.registry)
 
     def attach_tracer(self, tracer) -> None:
         """Observe stage spans finished in this process into the stage
@@ -333,4 +437,5 @@ def count_metric(name: str, *labels: str, inc: float = 1) -> None:
 
 
 __all__ = ["WorkerMetrics", "KvbmStatsCollector", "EngineDispatchCollector",
-           "engine_dispatch_stats", "get_worker_metrics", "count_metric"]
+           "StepTraceCollector", "engine_dispatch_stats",
+           "get_worker_metrics", "count_metric"]

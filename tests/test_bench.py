@@ -34,6 +34,11 @@ def test_single_child_attempt_chain():
     # cost-vs-RR comparison stays inside the smoke chain's budget
     env["BENCH_ROUTING_REQS"] = "16"
     env["BENCH_ROUTING_STALL"] = "0.25,0.4"
+    # short steptrace leg (fewer generated tokens, fewer A/B rounds) so
+    # the recorder-overhead A/B stays inside the smoke chain's budget
+    env["BENCH_STEPTRACE_GEN"] = "24"
+    env["BENCH_STEPTRACE_ROUNDS"] = "3"
+    env["BENCH_STEPTRACE_REPS"] = "2"
     env.pop("JAX_PLATFORMS", None)
     r = subprocess.run(
         [sys.executable, BENCH, "--budget", "420", "--tier", "tiny"],
@@ -100,6 +105,22 @@ def test_single_child_attempt_chain():
     assert rt["hedges"]["fired"] >= 1 and rt["hedges"]["won"] >= 1, rt
     assert rt["breaker_metric_seen"] is True
     assert rt["trace_attrs_ok"] is True
+    # step flight recorder leg: a warmed-shape rerun must produce ZERO
+    # compile events (no false positives), the deliberately cold cohort
+    # must surface mid-trace compiles attributable to StepRecords, and
+    # the recorder's on-vs-off overhead must stay inside the 2% budget
+    # (loose CI bound: CPU wall-clock jitters, the sign can flip)
+    stp = result["steptrace"]
+    assert "error" not in stp, stp
+    assert stp["compile"]["warm_rerun_events"] == 0, stp
+    assert stp["compile"]["midrun_events"] >= 1, stp
+    assert stp["compile"]["compile_records"] >= 1, stp
+    assert "prefill" in stp["compile"]["compile_kinds"], stp
+    assert stp["aggregates"]["records"] > 0
+    assert stp["aggregates"]["occupancy_samples"] > 0
+    assert stp["aggregates"]["gap_samples"] > 0
+    assert stp["ab"]["on_tok_s"] > 0 and stp["ab"]["off_tok_s"] > 0
+    assert stp["ab"]["overhead_pct"] < 5.0, stp
     # the continuous-arrival mixed-vs-legacy A/B ran on both engines.
     # jax sub-leg: CPU dispatch overhead is ~0, so only liveness is
     # asserted (the throughput separation is the on-chip/mocker story).

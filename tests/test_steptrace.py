@@ -1,0 +1,421 @@
+"""Engine step flight recorder: ring bounds/eviction/knobs, compile-event
+detection on fresh jit buckets, fleet-accounting metric rendering, frontend
+SLO/goodput outcomes, mocker parity, the /v1/steptrace endpoint, and the
+Perfetto step-timeline merge.
+"""
+
+import asyncio
+import json
+import os
+import sys
+import time
+
+import aiohttp
+import pytest
+
+from dynamo_tpu.engine.steptrace import (
+    StepRecorder,
+    get_step_recorder,
+    set_step_recorder,
+)
+
+
+@pytest.fixture(autouse=True)
+def fresh_recorder():
+    """Each test gets its own process step recorder (engines pick up the
+    global singleton at construction)."""
+    rec = StepRecorder(capacity=256, enabled=True)
+    set_step_recorder(rec)
+    yield rec
+    set_step_recorder(None)
+
+
+def stamp(rec, kind="decode", **kw):
+    defaults = dict(rows=2, batch=4, tokens_real=2, tokens_padded=4,
+                    dispatch_ms=5.0)
+    defaults.update(kw)
+    return rec.record(kind, **defaults)
+
+
+# -- unit: the ring ---------------------------------------------------------
+
+
+class TestRing:
+    def test_bounds_and_newest_first_pagination(self):
+        rec = StepRecorder(capacity=4, enabled=True)
+        for i in range(10):
+            stamp(rec, rows=i)
+        snap = rec.snapshot(limit=100)
+        assert snap["total"] == 10 and snap["capacity"] == 4
+        assert snap["count"] == 4  # oldest 6 overwritten
+        assert [r["seq"] for r in snap["records"]] == [9, 8, 7, 6]
+        page = rec.snapshot(limit=2, offset=2)
+        assert [r["seq"] for r in page["records"]] == [7, 6]
+        assert rec.snapshot(limit=2, offset=100)["records"] == []
+
+    def test_slots_reused_in_place(self):
+        rec = StepRecorder(capacity=2, enabled=True)
+        r0 = stamp(rec, fallback="pages")
+        rec.note_compile("decode", 1.2, r0)
+        stamp(rec)
+        stamp(rec)  # wraps onto r0's slot
+        assert r0.seq == 2
+        # wrap must clear the per-dispatch patch fields, not inherit them
+        assert r0.compile_ms == 0.0 and r0.fallback == ""
+
+    def test_env_knobs(self, monkeypatch):
+        monkeypatch.setenv("DYN_STEPTRACE_RING", "7")
+        assert StepRecorder().capacity == 7
+        monkeypatch.setenv("DYN_STEPTRACE_DISABLE", "1")
+        rec = StepRecorder()
+        assert rec.record("decode", dispatch_ms=1.0) is None
+        rec.note_compile("decode", 1.0)
+        snap = rec.snapshot()
+        assert snap["enabled"] is False and snap["total"] == 0
+        assert rec.aggregates()["compile_events"] == {}
+
+    def test_unpack_and_compile_patching(self):
+        rec = StepRecorder(capacity=8, enabled=True)
+        r = stamp(rec, kind="multistep", width=8, gap_ms=2.0)
+        rec.note_unpack(r, 0.7)
+        rec.note_compile("multistep", 2.5, r)
+        d = rec.snapshot(limit=1)["records"][0]
+        assert d["unpack_ms"] == 0.7 and d["compile_ms"] == 2500.0
+        rec.note_unpack(None, 1.0)  # disabled/absent record is a no-op
+
+    def test_aggregates_shape(self):
+        rec = StepRecorder(capacity=8, enabled=True)
+        stamp(rec, kind="decode", tokens_real=2, tokens_padded=4,
+              gap_ms=1.0, pool_free=33, pool_pinned=3)
+        # no occupancy sample for an unpadded dispatch; pool gauges track
+        # the most recent dispatch's plan-time state
+        stamp(rec, kind="prefill", tokens_padded=0, pool_free=33,
+              pool_pinned=3)
+        agg = rec.aggregates()
+        cum, s, n = agg["duration"]["decode"]
+        assert cum[-1] == ("+Inf", 1) and n == 1 and s == pytest.approx(0.005)
+        assert "prefill" not in agg["occupancy"]
+        _, osum, on = agg["occupancy"]["decode"]
+        assert on == 1 and osum == pytest.approx(0.5)
+        assert agg["gap"][2] == 1
+        assert agg["pool_free"] == 33 and agg["pool_pinned"] == 3
+
+
+# -- fleet accounting on /metrics -------------------------------------------
+
+
+def test_metric_rendering():
+    from prometheus_client import generate_latest
+
+    from dynamo_tpu.worker.metrics import WorkerMetrics
+    wm = WorkerMetrics()
+    # pre-attach: full schema, zero-valued (dashboards + docs drift gate)
+    out = generate_latest(wm.registry).decode()
+    assert ('dynamo_worker_step_duration_seconds_bucket'
+            '{kind="multistep",le="+Inf"} 0.0') in out
+    assert 'dynamo_worker_compile_events_total{kind="prefill"} 0.0' in out
+    assert 'dynamo_worker_step_gap_seconds_count 0.0' in out
+    rec = StepRecorder(capacity=8, enabled=True)
+    r = stamp(rec, kind="multistep", width=8, tokens_real=16,
+              tokens_padded=64, gap_ms=0.3, pool_free=50, pool_pinned=5)
+    rec.note_compile("multistep", 2.0, r)
+    wm.steptrace.attach(rec.aggregates)
+    out = generate_latest(wm.registry).decode()
+    assert ('dynamo_worker_step_duration_seconds_count'
+            '{kind="multistep"} 1.0') in out
+    assert ('dynamo_worker_step_occupancy_bucket'
+            '{kind="multistep",le="0.25"} 1.0') in out
+    assert 'dynamo_worker_step_gap_seconds_count 1.0' in out
+    assert 'dynamo_worker_page_pool_free_pages 50.0' in out
+    assert 'dynamo_worker_page_pool_pinned_pages 5.0' in out
+    assert 'dynamo_worker_compile_events_total{kind="multistep"} 1.0' in out
+    assert 'dynamo_worker_compile_seconds_total{kind="multistep"} 2.0' in out
+
+
+# -- compile detection on a real engine -------------------------------------
+
+
+from dynamo_tpu.protocols.common import (  # noqa: E402
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+
+
+def make_req(tokens, rid, max_tokens=6):
+    return PreprocessedRequest(
+        token_ids=list(tokens), request_id=rid,
+        stop_conditions=StopConditions(max_tokens=max_tokens),
+        sampling_options=SamplingOptions(temperature=0.0))
+
+
+async def collect(engine, req):
+    return [f async for f in engine.generate(req)]
+
+
+class TestCompileDetection:
+    async def test_fresh_jit_bucket_becomes_compile_event(self, fresh_recorder):
+        from dynamo_tpu.engine.jax_engine import JaxEngine, JaxEngineConfig
+        from dynamo_tpu.models.config import ModelConfig
+        eng = JaxEngine.random_init(
+            ModelConfig.tiny(),
+            JaxEngineConfig(num_pages=64, page_size=4, max_num_seqs=4,
+                            max_prefill_chunk=16, max_context=64,
+                            min_prefill_bucket=4))
+        try:
+            frames = await collect(eng, make_req([1, 2, 3, 4, 5], "c1"))
+            rec = eng.steptrace
+            assert rec is fresh_recorder
+            assert rec.total > 0
+            kinds = {r["kind"] for r in rec.snapshot(limit=256)["records"]}
+            assert "prefill" in kinds
+            # the very first prefill/decode dispatches compiled their jit
+            # buckets: events counted AND attributed to step records
+            assert sum(rec.compile_events.values()) >= 1
+            assert sum(rec.compile_seconds.values()) > 0
+            assert any(r["compile_ms"] > 0
+                       for r in rec.snapshot(limit=256)["records"])
+            # ... and to the request's frames (StageStitcher turns these
+            # into an xla_compile span event on the stitched trace)
+            timed = [f.timings for f in frames if f.timings]
+            assert any("compile_ms" in t for t in timed)
+            assert any(t.get("compile_events", 0) >= 1 for t in timed)
+            events_before = dict(rec.compile_events)
+            # an identical-shape request hits every warmed bucket: no new
+            # compile events (the detector keys on (fn, B, S), not calls)
+            await collect(eng, make_req([9, 8, 7, 6, 5], "c2"))
+            assert rec.compile_events == events_before
+        finally:
+            await eng.stop()
+
+    async def test_records_carry_plan_and_gap(self, fresh_recorder):
+        from dynamo_tpu.engine.jax_engine import JaxEngine, JaxEngineConfig
+        from dynamo_tpu.models.config import ModelConfig
+        eng = JaxEngine.random_init(
+            ModelConfig.tiny(),
+            JaxEngineConfig(num_pages=64, page_size=4, max_num_seqs=4,
+                            max_prefill_chunk=16, max_context=64,
+                            min_prefill_bucket=4))
+        try:
+            await collect(eng, make_req([1, 2, 3], "g1", max_tokens=8))
+            recs = fresh_recorder.snapshot(limit=256)["records"]
+            assert all(r["dispatch_ms"] > 0 for r in recs)
+            # consecutive dispatches of one request measure the host gap
+            assert any(r["gap_ms"] > 0 for r in recs)
+            assert any(r["tokens_padded"] >= r["tokens_real"] > 0
+                       for r in recs)
+        finally:
+            await eng.stop()
+
+
+# -- mocker parity + endpoint -----------------------------------------------
+
+
+class TestMockerParityAndEndpoint:
+    async def test_mocker_stamps_the_same_ring(self, fresh_recorder):
+        from dynamo_tpu.mocker.engine import MockEngineArgs, MockerEngine
+        eng = MockerEngine(MockEngineArgs(
+            num_pages=64, page_size=4, max_num_seqs=8, max_context=256,
+            speedup_ratio=1000.0))
+        try:
+            await collect(eng, make_req(range(1, 10), "m1", max_tokens=8))
+            assert eng.steptrace is fresh_recorder
+            snap = fresh_recorder.snapshot(limit=256)
+            assert snap["total"] > 0
+            kinds = {r["kind"] for r in snap["records"]}
+            assert "prefill" in kinds
+        finally:
+            await eng.stop()
+
+    async def test_v1_steptrace_endpoint(self, fresh_recorder):
+        from dynamo_tpu.runtime.system_server import SystemServer
+        stamp(fresh_recorder, kind="prefill")
+        stamp(fresh_recorder, kind="decode", fallback="pages")
+        server = await SystemServer(port=0,
+                                    steptrace=fresh_recorder).start()
+        try:
+            async with aiohttp.ClientSession() as s:
+                url = f"http://127.0.0.1:{server.port}/v1/steptrace"
+                async with s.get(url, params={"limit": "1"}) as r:
+                    assert r.status == 200
+                    body = await r.json()
+                assert body["total"] == 2 and body["count"] == 1
+                assert body["records"][0]["kind"] == "decode"
+                assert body["records"][0]["fallback"] == "pages"
+                async with s.get(url, params={"limit": "x"}) as r:
+                    assert r.status == 400
+        finally:
+            await server.stop()
+
+    async def test_endpoint_404_without_recorder(self):
+        from dynamo_tpu.runtime.system_server import SystemServer
+        server = await SystemServer(port=0).start()
+        try:
+            async with aiohttp.ClientSession() as s:
+                url = f"http://127.0.0.1:{server.port}/v1/steptrace"
+                async with s.get(url) as r:
+                    assert r.status == 404
+        finally:
+            await server.stop()
+
+
+# -- frontend SLO / goodput -------------------------------------------------
+
+
+class TestSloOutcomes:
+    def _timer(self, m, model="m"):
+        from dynamo_tpu.http.metrics import RequestTimer
+        return RequestTimer(m, model, "chat")
+
+    def _count(self, m, target, outcome):
+        return m.registry.get_sample_value(
+            "dynamo_frontend_slo_total",
+            {"target": target, "outcome": outcome})
+
+    def test_met_and_goodput(self):
+        from dynamo_tpu.http.metrics import FrontendMetrics
+        m = FrontendMetrics(slo_ttft_s=5.0, slo_itl_s=5.0)
+        t = self._timer(m)
+        t.on_token(1)
+        t.on_token(2)
+        t.done("200")
+        assert self._count(m, "ttft", "met") == 1
+        assert self._count(m, "itl", "met") == 1
+        assert m.registry.get_sample_value(
+            "dynamo_frontend_goodput_tokens_total", {"model": "m"}) == 3
+
+    def test_violated_worst_gap_no_goodput(self):
+        from dynamo_tpu.http.metrics import FrontendMetrics
+        m = FrontendMetrics(slo_ttft_s=5.0, slo_itl_s=0.005)
+        t = self._timer(m)
+        t.on_token(1)
+        time.sleep(0.02)  # one slow gap in an otherwise instant stream
+        t.on_token(1)
+        t.on_token(1)
+        t.done("200")
+        assert self._count(m, "ttft", "met") == 1
+        assert self._count(m, "itl", "violated") == 1
+        assert not m.registry.get_sample_value(
+            "dynamo_frontend_goodput_tokens_total", {"model": "m"})
+
+    def test_shed_counts_against_enabled_targets(self):
+        from dynamo_tpu.http.metrics import FrontendMetrics
+        m = FrontendMetrics(slo_ttft_s=1.0)  # itl target disabled
+        m.record_slo_shed()
+        assert self._count(m, "ttft", "shed") == 1
+        assert self._count(m, "itl", "shed") == 0
+
+    def test_disabled_targets_judge_nothing(self):
+        from dynamo_tpu.http.metrics import FrontendMetrics
+        m = FrontendMetrics()  # bare: the check_metrics_docs contract
+        t = self._timer(m)
+        t.on_token(1)
+        t.on_token(1)
+        t.done("200")
+        for target in ("ttft", "itl"):
+            for outcome in ("met", "violated", "shed"):
+                assert self._count(m, target, outcome) == 0
+        assert not m.registry.get_sample_value(
+            "dynamo_frontend_goodput_tokens_total", {"model": "m"})
+
+    def test_http_service_threads_slo_and_sheds(self):
+        from dynamo_tpu.http.service import HttpService
+        from dynamo_tpu.llm.model_manager import ModelManager
+        svc = HttpService(ModelManager(), slo_ttft_s=0.5, slo_itl_s=0.05,
+                          max_inflight=1)
+        assert svc.metrics.slo_ttft_s == 0.5
+        svc._shed_or_admit("m", "chat")       # admitted
+        resp = svc._shed_or_admit("m", "chat")  # shed at high water
+        assert resp is not None and resp.status == 503
+        assert self._count(svc.metrics, "ttft", "shed") == 1
+        assert self._count(svc.metrics, "itl", "shed") == 1
+
+
+# -- trace keep-last + request_id lookup ------------------------------------
+
+
+class TestTraceKeepLast:
+    def test_request_id_lookup_survives_sampling(self):
+        from dynamo_tpu.utils.tracing import Tracer
+        t = Tracer(service="t", capacity=8, slow_s=60.0)  # drops everything
+        root = t.start_trace("http_request",
+                             attrs={"request_id": "rid-fast"})
+        root.finish()
+        assert t.traces()["total"] == 0  # sampled out of the main ring
+        hits = t.traces(request_id="rid-fast")
+        assert hits["total"] == 1
+        assert hits["traces"][0]["request_id"] == "rid-fast"
+        # the full tree is retrievable too
+        assert t.get_trace(root.trace_id) is not None
+        assert t.traces(request_id="rid-other")["total"] == 0
+
+    def test_keep_last_ring_bounded(self, monkeypatch):
+        monkeypatch.setenv("DYN_TRACE_KEEP_LAST", "3")
+        from dynamo_tpu.utils.tracing import Tracer
+        t = Tracer(service="t", capacity=8, slow_s=60.0)
+        assert t.keep_last == 3
+        roots = []
+        for i in range(5):
+            r = t.start_trace("http_request",
+                              attrs={"request_id": f"r{i}"})
+            r.finish()
+            roots.append(r)
+        assert len(t._keep_last) == 3
+        assert t.traces(request_id="r0")["total"] == 0  # evicted
+        assert t.traces(request_id="r4")["total"] == 1
+
+    def test_no_double_listing_when_in_both_rings(self):
+        from dynamo_tpu.utils.tracing import Tracer
+        t = Tracer(service="t", capacity=8, slow_s=0.0)  # ring keeps it
+        root = t.start_trace("http_request",
+                             attrs={"request_id": "rid-slow"})
+        root.finish()
+        assert t.traces(request_id="rid-slow")["total"] == 1
+
+
+# -- perfetto merge ---------------------------------------------------------
+
+
+def test_perfetto_steptrace_merge(tmp_path, fresh_recorder):
+    from dynamo_tpu.utils.tracing import Tracer
+    tracer = Tracer(service="frontend", capacity=8)
+    root = tracer.start_trace("http_request", attrs={"request_id": "p1"})
+    with tracer.span("decode"):
+        pass
+    root.finish()
+    src = tmp_path / "traces.jsonl"
+    src.write_text(json.dumps(tracer.get_trace(root.trace_id)) + "\n")
+
+    r1 = stamp(fresh_recorder, kind="multistep", width=8, gap_ms=0.4)
+    fresh_recorder.note_compile("multistep", 1.5, r1)
+    stamp(fresh_recorder, kind="decode", fallback="guided")
+    steps = tmp_path / "steps.json"
+    steps.write_text(json.dumps(fresh_recorder.snapshot(limit=10)))
+
+    out = tmp_path / "merged.json"
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tools"))
+    import trace2perfetto
+    assert trace2perfetto.main([str(src), "--steptrace", str(steps),
+                                "-o", str(out)]) == 0
+    events = json.loads(out.read_text())["traceEvents"]
+    procs = [e for e in events if e.get("name") == "process_name"]
+    assert any(e["args"]["name"] == "engine-steps" for e in procs)
+    step_pid = next(e["pid"] for e in procs
+                    if e["args"]["name"] == "engine-steps")
+    span_pids = {e["pid"] for e in procs if e["args"]["name"] == "frontend"}
+    assert step_pid not in span_pids  # own track, shared timeline
+    steps_x = [e for e in events
+               if e["ph"] == "X" and e["pid"] == step_pid]
+    assert len(steps_x) == 2
+    by_name = {e["name"]: e for e in steps_x}
+    comp = by_name["multistepx8"]
+    assert "compile" in comp["cat"] and comp["args"]["compile_ms"] == 1500.0
+    fb = by_name["decode"]
+    assert "fallback" in fb["cat"] and fb["args"]["fallback"] == "guided"
+    # step events share the wall-clock timeline with the request spans
+    rec = fresh_recorder.snapshot(limit=10)["records"][0]
+    assert any(e["ts"] == pytest.approx(rec["t_unix"] * 1e6)
+               for e in steps_x)
+    # newest-first record maps dur = dispatch_ms in microseconds
+    assert all(e["dur"] == pytest.approx(5.0 * 1e3) for e in steps_x)

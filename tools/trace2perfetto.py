@@ -9,9 +9,20 @@ renders as a flame chart: the frontend's ``http_request`` root on one
 process track, each worker's hop + queue/prefill/kv_transfer/decode spans on
 their own tracks, all on one shared timeline.
 
+With ``--steptrace`` the engine's step flight recorder (a saved
+``GET /v1/steptrace`` body, see ``dynamo_tpu/engine/steptrace.py``) merges
+onto the same timeline as an ``engine-steps`` process track: every dispatch
+(prefill/decode/chained/multistep/mixed/spec/gather) renders as a complete
+event whose args carry rows/tokens/queue-depth/page-pool state, with compile
+time and fallback demotions flagged — so a TTFT spike in the request flame
+chart lines up against the exact engine step (and compile, and pool
+pressure) that caused it.
+
 Usage:
     python tools/trace2perfetto.py traces.jsonl -o trace.json
     python tools/trace2perfetto.py traces.jsonl --trace-id <id> -o one.json
+    python tools/trace2perfetto.py traces.jsonl --steptrace steps.json \
+        -o merged.json    # steps.json = curl worker:PORT/v1/steptrace
 
 Worked example (single machine, see docs/observability.md):
     DYN_TRACE_EXPORT=/tmp/traces.jsonl python -m dynamo_tpu.frontend.main ...
@@ -37,6 +48,49 @@ def _iter_traces(path: str):
                 yield json.loads(line)
             except json.JSONDecodeError:
                 continue  # truncated tail of a live export
+
+
+def _load_steptrace(path: str) -> list:
+    """StepRecords from a saved ``/v1/steptrace`` body (or a bare list)."""
+    with open(path) as f:
+        body = json.load(f)
+    return body.get("records", body) if isinstance(body, dict) else body
+
+
+def step_events(records, pid: int) -> list:
+    """StepRecords -> complete events on one ``engine-steps`` process
+    track, one thread per dispatch kind (dispatches of one kind never
+    overlap — the engine loop serialises them — so time containment
+    cannot mis-stack)."""
+    events = [{"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+               "args": {"name": "engine-steps"}}]
+    kinds = {}
+    for r in records:
+        kind = r.get("kind", "?")
+        if kind not in kinds:
+            kinds[kind] = len(kinds) + 1
+            events.append({"name": "thread_name", "ph": "M", "pid": pid,
+                           "tid": kinds[kind], "args": {"name": kind}})
+        cat = "step"
+        if r.get("compile_ms"):
+            cat += ",compile"
+        if r.get("fallback"):
+            cat += ",fallback"
+        args = {k: r[k] for k in
+                ("seq", "width", "rows", "batch", "tokens_real",
+                 "tokens_padded", "queue_depth", "running", "pool_free",
+                 "pool_pinned", "plan_ms", "unpack_ms", "gap_ms",
+                 "compile_ms", "fallback", "chained") if r.get(k)}
+        events.append({
+            "name": (f"{kind}x{r['width']}" if r.get("width")
+                     else kind),
+            "cat": cat, "ph": "X",
+            "ts": float(r.get("t_unix", 0.0)) * 1e6,
+            "dur": max(0.0, float(r.get("dispatch_ms", 0.0))) * 1e3,
+            "pid": pid, "tid": kinds[kind],
+            "args": args,
+        })
+    return events
 
 
 def convert(traces) -> dict:
@@ -107,6 +161,9 @@ def main(argv=None) -> int:
     p.add_argument("-o", "--output", default="trace.json")
     p.add_argument("--trace-id", default=None,
                    help="convert only this trace")
+    p.add_argument("--steptrace", default=None,
+                   help="saved GET /v1/steptrace body to merge as an "
+                        "engine-steps track on the same timeline")
     args = p.parse_args(argv)
     traces = list(_iter_traces(args.input))
     if args.trace_id:
@@ -115,15 +172,23 @@ def main(argv=None) -> int:
             print(f"trace {args.trace_id} not found in {args.input}",
                   file=sys.stderr)
             return 1
-    if not traces:
+    if not traces and not args.steptrace:
         print(f"no traces in {args.input}", file=sys.stderr)
         return 1
     out = convert(traces)
+    n_steps = 0
+    if args.steptrace:
+        records = _load_steptrace(args.steptrace)
+        n_steps = len(records)
+        # pid after every span-track pid: convert() numbers services 1..N
+        used = {e["pid"] for e in out["traceEvents"]}
+        out["traceEvents"].extend(
+            step_events(records, pid=max(used, default=0) + 1))
     with open(args.output, "w") as f:
         json.dump(out, f)
     n_spans = sum(len(t.get("spans", [])) for t in traces)
     print(f"wrote {len(out['traceEvents'])} events ({len(traces)} traces, "
-          f"{n_spans} spans) to {args.output}")
+          f"{n_spans} spans, {n_steps} steps) to {args.output}")
     return 0
 
 
