@@ -37,6 +37,23 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--no-kv-events", action="store_true",
                         help="KV router predicts cache contents instead of "
                              "subscribing to worker events")
+    # fleet-wide KV reuse (docs/deployment.md "Fleet-wide KV reuse"):
+    # consult the coordinator-backed global prefix index so prefix-heavy
+    # requests route to holders anywhere in the fleet, priced against the
+    # kv_transfer plane bandwidth EWMAs
+    parser.add_argument("--kv-global-index", action="store_true",
+                        help="kv mode: merge the coordinator-backed global "
+                             "prefix index into routing, so remote holders "
+                             "compete with local cache hits")
+    parser.add_argument("--kv-block-bytes", type=int, default=0,
+                        help="estimated KV bytes per block for pricing "
+                             "remote-prefix transfers (0 disables the "
+                             "net-cost credit; set to the workers' "
+                             "per-block KV footprint)")
+    parser.add_argument("--kv-net-cost-weight", type=float, default=25.0,
+                        help="weight of the estimated transfer-seconds term "
+                             "when pricing a remote prefix hit against "
+                             "local recompute")
     # request-lifecycle robustness knobs; defaults layer through
     # RuntimeConfig (dataclass defaults -> TOML -> DYN_RUNTIME_* env)
     try:
@@ -116,7 +133,8 @@ async def amain(args: argparse.Namespace) -> None:
         retry_budget_ratio=args.retry_budget,
         hedge=args.hedge,
         hedge_delay_s=args.hedge_delay_s,
-        stats_interval_s=args.router_stats_interval_s)
+        stats_interval_s=args.router_stats_interval_s,
+        net_weight=args.kv_net_cost_weight)
     watcher = ModelWatcher(
         drt, manager,
         router_mode=RouterMode(args.router_mode),
@@ -124,6 +142,9 @@ async def amain(args: argparse.Namespace) -> None:
             "overlap_score_weight": args.kv_overlap_score_weight,
             "temperature": args.router_temperature,
             "use_kv_events": not args.no_kv_events,
+            "use_global_index": args.kv_global_index,
+            "kv_block_bytes": args.kv_block_bytes,
+            "net_weight": args.kv_net_cost_weight,
         },
         policy_config=policy_config)
     await watcher.start()

@@ -188,9 +188,11 @@ class CoordinatorMetrics:
     Series: ``dynamo_coord_role`` (1 acting primary / 0 standby /
     -1 deposed), ``dynamo_coord_failovers_total`` (promotions this process
     performed), ``dynamo_coord_replication_lag_ops`` (log entries queued to
-    the slowest attached standby; 0 = caught up or none attached) and
-    ``dynamo_coord_standbys_attached``.  Exposed by the standalone
-    coordinator's system server (``DYN_SYSTEM_ENABLED=1``)."""
+    the slowest attached standby; 0 = caught up or none attached),
+    ``dynamo_coord_standbys_attached`` and
+    ``dynamo_coord_prefix_index_entries`` (live worker snapshots in the
+    fleet KV prefix index).  Exposed by the standalone coordinator's
+    system server (``DYN_SYSTEM_ENABLED=1``)."""
 
     _ROLES = {"primary": 1.0, "standby": 0.0, "deposed": -1.0}
 
@@ -223,6 +225,12 @@ class CoordinatorMetrics:
             "dynamo_coord_standbys_attached",
             "Hot standbys currently attached to this coordinator",
             value=float(c.standbys_attached))
+        yield GaugeMetricFamily(
+            "dynamo_coord_prefix_index_entries",
+            "Live worker holder-snapshots in the fleet-wide KV prefix "
+            "index (kvstore/prefix_index/ entries whose TTL envelope has "
+            "not expired; each is one worker's published block-hash set)",
+            value=float(getattr(c, "prefix_index_entries", 0)))
 
 
 class RouterMetricsCollector:
@@ -234,8 +242,11 @@ class RouterMetricsCollector:
     ``dynamo_frontend_router_hedges_total{outcome}``,
     ``dynamo_frontend_router_breaker_transitions_total{state}``,
     ``dynamo_frontend_router_breaker_state{instance}`` (0 closed /
-    0.5 half-open / 1 open), ``dynamo_frontend_router_retry_budget_balance``
-    and ``dynamo_frontend_router_retry_budget_exhausted_total``."""
+    0.5 half-open / 1 open), ``dynamo_frontend_router_retry_budget_balance``,
+    ``dynamo_frontend_router_retry_budget_exhausted_total``, and the
+    NetKV pricing family: ``dynamo_frontend_router_net_priced_total``
+    {outcome}, ``dynamo_frontend_router_net_cost_seconds_total`` and
+    ``dynamo_frontend_router_net_priced_decisions_total``."""
 
     def __init__(self, registry: Optional[CollectorRegistry] = None):
         if registry is not None:
@@ -291,6 +302,29 @@ class RouterMetricsCollector:
             "Retry/hedge attempts refused because the budget was empty")
         ex.add_metric([], float(s.budget_exhausted))
         yield ex
+        np_ = CounterMetricFamily(
+            "dynamo_frontend_router_net_priced",
+            "KV routing decisions where a fleet-held prefix was priced "
+            "against the measured kv_transfer bandwidth, by outcome: "
+            "'credit' (transfer beats recompute), 'no_credit' (recompute "
+            "wins), 'no_path' (no bandwidth ever measured)",
+            labels=["outcome"])
+        for outcome in ("credit", "no_credit", "no_path"):
+            np_.add_metric([outcome], float(s.net_priced.get(outcome, 0)))
+        yield np_
+        nc = CounterMetricFamily(
+            "dynamo_frontend_router_net_cost_seconds",
+            "Estimated KV-transfer seconds behind net-priced decisions "
+            "(est_transfer_bytes / plane bandwidth EWMA); _count is the "
+            "decisions priced")
+        nc.add_metric([], float(s.net_cost_seconds_sum))
+        yield nc
+        ncc = CounterMetricFamily(
+            "dynamo_frontend_router_net_priced_decisions",
+            "Net-priced decisions counted into "
+            "dynamo_frontend_router_net_cost_seconds")
+        ncc.add_metric([], float(s.net_cost_seconds_count))
+        yield ncc
 
 
 class RequestTimer:

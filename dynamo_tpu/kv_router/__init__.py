@@ -19,10 +19,15 @@ Components:
 """
 
 from dynamo_tpu.kv_router.approx import ApproxKvIndexer
+from dynamo_tpu.kv_router.global_index import (
+    GlobalPrefixIndexReader,
+    GlobalPrefixPublisher,
+)
 from dynamo_tpu.kv_router.indexer import KvIndexer
 from dynamo_tpu.kv_router.recorder import KvRecorder, replay
 from dynamo_tpu.kv_router.router import KvPushRouter
 from dynamo_tpu.kv_router.scheduler import KvScheduler, WorkerSelector
 
 __all__ = ["KvIndexer", "ApproxKvIndexer", "KvScheduler", "WorkerSelector",
-           "KvPushRouter", "KvRecorder", "replay"]
+           "KvPushRouter", "KvRecorder", "replay",
+           "GlobalPrefixPublisher", "GlobalPrefixIndexReader"]

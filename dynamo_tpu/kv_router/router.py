@@ -25,6 +25,7 @@ import logging
 from typing import Any, AsyncIterator, Dict, List, Optional, Tuple
 
 from dynamo_tpu.kv_router.approx import ApproxKvIndexer
+from dynamo_tpu.kv_router.global_index import GlobalPrefixIndexReader
 from dynamo_tpu.kv_router.indexer import KvIndexer
 from dynamo_tpu.kv_router.scheduler import KvScheduler, WorkerSelector
 from dynamo_tpu.model_card import ModelDeploymentCard
@@ -57,7 +58,10 @@ class KvPushRouter:
                  use_kv_events: bool = True,
                  stats_interval: float = 1.0,
                  selector: Optional[WorkerSelector] = None,
-                 policy=None):
+                 policy=None,
+                 use_global_index: bool = False,
+                 kv_block_bytes: int = 0,
+                 net_weight: float = 25.0):
         self.drt = drt
         self.client = client
         self.block_size = card.kv_cache_block_size
@@ -71,13 +75,18 @@ class KvPushRouter:
         self.policy = policy
         self.scheduler = KvScheduler(
             self.block_size, overlap_score_weight=overlap_score_weight,
-            temperature=temperature, selector=selector, policy=policy)
+            temperature=temperature, selector=selector, policy=policy,
+            block_bytes=kv_block_bytes, net_weight=net_weight)
         self.inner = PushRouter(client, RouterMode.DIRECT, policy=policy)
         self._namespace = client.endpoint.namespace
         self._component = client.endpoint.component
         self._event_sub = None
         self._event_task: Optional[asyncio.Task] = None
         self._stats_task: Optional[asyncio.Task] = None
+        # fleet-wide prefix index (coordinator kv-store mirror): lets the
+        # scheduler see holders behind OTHER frontends and price onboarding
+        self.use_global_index = use_global_index
+        self.global_index: Optional[GlobalPrefixIndexReader] = None
 
     @classmethod
     async def create(cls, drt, client, card: ModelDeploymentCard,
@@ -87,12 +96,17 @@ class KvPushRouter:
             self._event_sub = await drt.subscribe_events(
                 kv_events_subject(self._namespace, self._component))
             self._event_task = asyncio.create_task(self._event_loop())
+        if self.use_global_index:
+            self.global_index = GlobalPrefixIndexReader(drt.kv_store())
+            await self.global_index.start()
         self._stats_task = asyncio.create_task(self._stats_loop())
         return self
 
     async def close(self) -> None:
         await reap_task(self._event_task)
         await reap_task(self._stats_task)
+        if self.global_index is not None:
+            await self.global_index.close()
         await self.inner.close()
         if self._event_sub is not None:
             try:
@@ -145,7 +159,8 @@ class KvPushRouter:
         return []
 
     def _export_decision(self, worker: int, overlap: int, isl_blocks: int,
-                         explain: Optional[Dict[int, Dict]]) -> None:
+                         explain: Optional[Dict[int, Dict]],
+                         fleet_best: int = 0) -> None:
         """KV routing decision trace attrs on the request's current span —
         the prefix-overlap/cost inputs, plus the policy's failure-aware
         inputs when attached (retrievable post-hoc from /v1/traces)."""
@@ -156,10 +171,13 @@ class KvPushRouter:
         span.set_attr("router.instance", f"{worker:x}")
         span.set_attr("router.overlap_blocks", overlap)
         span.set_attr("router.isl_blocks", isl_blocks)
+        span.set_attr("router.fleet_best_blocks", fleet_best)
         chosen = (explain or {}).get(worker)
         if chosen:
             span.set_attr("router.cost", chosen.get("cost"))
             span.set_attr("router.active_blocks", chosen.get("active_blocks"))
+            span.set_attr("router.net_cost", chosen.get("net_cost"))
+            span.set_attr("router.net_credit", chosen.get("net_credit"))
         if self.policy is not None:
             _, inputs = self.policy.score(worker)
             for key in ("ewma_ttft_s", "inflight", "queue_depth", "breaker"):
@@ -167,14 +185,33 @@ class KvPushRouter:
 
     # -- routing -----------------------------------------------------------
 
+    def _fleet_view(self, hashes: List[int],
+                    overlaps: Dict[int, int]) -> Tuple[Dict[int, int], int]:
+        """Merge the global index into the local overlap map.  Returns the
+        merged per-candidate overlaps plus ``fleet_best`` — the longest
+        leading run held by ANY worker fleet-wide (the onboarding source),
+        which prices the scheduler's net credit."""
+        if self.global_index is None:
+            return overlaps, 0
+        fleet = self.global_index.find_holders(hashes)
+        if not fleet:
+            return overlaps, 0
+        live = set(self.client.instance_ids())
+        merged = dict(overlaps)
+        for w, n in fleet.items():
+            if w in live and n > merged.get(w, 0):
+                merged[w] = n
+        return merged, max(fleet.values())
+
     def find_best_match(self, token_ids: List[int]) -> Tuple[int, int]:
         """(worker_id, overlap_blocks) for a prompt — the routing decision
         without routing (parity: ``query_instance_id`` annotation,
         ``kv_router.rs:331-337``)."""
         hashes = compute_block_hash_for_seq(token_ids, self.block_size)
         overlaps = self.indexer.find_matches(hashes)
+        overlaps, fleet_best = self._fleet_view(hashes, overlaps)
         return self.scheduler.select(self.client.instance_ids(), overlaps,
-                                     len(hashes))
+                                     len(hashes), fleet_best=fleet_best)
 
     async def generate_stream(self, payload: Dict[str, Any],
                               instance_id: Optional[int] = None,
@@ -185,15 +222,17 @@ class KvPushRouter:
         hashes = compute_block_hash_for_seq(token_ids, self.block_size)
         if instance_id is None:
             overlaps = self.indexer.find_matches(hashes)
+            overlaps, fleet_best = self._fleet_view(hashes, overlaps)
             explain: Optional[Dict[int, Dict]] = (
                 {} if self.policy is not None else None)
             worker, overlap = self.scheduler.select(
                 self.client.instance_ids(), overlaps, len(hashes),
-                explain=explain)
+                explain=explain, fleet_best=fleet_best)
             if self.policy is not None:
                 self.policy.budget.deposit()
                 self.policy.stats.decisions["kv"] += 1
-                self._export_decision(worker, overlap, len(hashes), explain)
+                self._export_decision(worker, overlap, len(hashes), explain,
+                                      fleet_best=fleet_best)
         else:
             worker, overlap = instance_id, 0
         payload = dict(payload)
@@ -206,15 +245,25 @@ class KvPushRouter:
             KVHitRateEvent(worker_id=worker, isl_blocks=len(hashes),
                            overlap_blocks=overlap).to_dict()),
             name="kv-hit-rate")
+        generated: List[int] = []
         try:
             async for item in self.inner.generate_stream(
                     payload, instance_id=worker, headers=headers):
                 ntok = len(item.get("token_ids") or []) if isinstance(item, dict) else 0
                 if ntok:
                     self.scheduler.push(rid, ntok)
+                    generated.extend(item["token_ids"])
                 yield item
         finally:
             self.scheduler.free(rid)
+            if isinstance(self.indexer, ApproxKvIndexer) and generated:
+                # parity with the event-driven index: the worker's
+                # allocator commits DECODE-generated blocks too, so the
+                # approx view must observe the full prompt+output chain —
+                # not just the prompt hashes recorded at routing time
+                self.indexer.record_routing(
+                    worker, compute_block_hash_for_seq(
+                        list(token_ids) + generated, self.block_size))
 
 
 __all__ = ["KvPushRouter", "kv_events_subject", "kv_hit_rate_subject"]

@@ -46,7 +46,9 @@ class KvScheduler:
     def __init__(self, block_size: int, overlap_score_weight: float = 1.0,
                  temperature: float = 0.0,
                  selector: Optional[WorkerSelector] = None,
-                 policy=None):
+                 policy=None,
+                 block_bytes: int = 0,
+                 net_weight: float = 25.0):
         self.block_size = block_size
         self.overlap_score_weight = overlap_score_weight
         self.temperature = temperature
@@ -55,6 +57,14 @@ class KvScheduler:
         # aware terms — EWMA-TTFT penalty + router-side in-flight — to the
         # block cost, and filters breaker-open workers out of selection
         self.policy = policy
+        # NetKV-style pricing of fleet-held prefixes: when the global index
+        # says some worker holds ``fleet_best`` leading blocks, every
+        # candidate can ONBOARD the blocks it lacks from that peer instead
+        # of recomputing — worth overlap_weight * blocks, costing
+        # net_weight * (bytes / measured plane bandwidth). block_bytes=0
+        # disables the credit (no way to size the move).
+        self.block_bytes = block_bytes
+        self.net_weight = net_weight
         self._workers: Dict[int, _WorkerState] = {}
         self._seqs: Dict[str, _ActiveSeq] = {}
 
@@ -107,7 +117,27 @@ class KvScheduler:
 
     # -- selection ---------------------------------------------------------
 
-    def cost(self, worker: int, overlap_blocks: int, isl_blocks: int) -> float:
+    def net_credit(self, worker: int, overlap_blocks: int, isl_blocks: int,
+                   fleet_best: int) -> Tuple[float, float, int]:
+        """(credit, net_cost_s, onboardable) for pulling the blocks this
+        worker lacks (up to the fleet's best-held prefix) from a peer.
+        The credit is the recompute cost avoided minus the network price;
+        it never goes negative — a slow plane simply earns nothing and
+        local recompute wins on the undiscounted score."""
+        onboardable = max(0, min(fleet_best, isl_blocks) - overlap_blocks)
+        if (onboardable <= 0 or self.block_bytes <= 0
+                or self.policy is None):
+            return 0.0, 0.0, onboardable
+        net_cost_s = self.policy.net_cost_s(
+            worker, onboardable * self.block_bytes)
+        if net_cost_s == float("inf"):
+            return 0.0, net_cost_s, onboardable
+        saved = self.overlap_score_weight * onboardable
+        credit = max(0.0, saved - self.net_weight * net_cost_s)
+        return credit, net_cost_s, onboardable
+
+    def cost(self, worker: int, overlap_blocks: int, isl_blocks: int,
+             fleet_best: int = 0) -> float:
         st = self._workers.setdefault(worker, _WorkerState())
         potential_prefill = max(0, isl_blocks - overlap_blocks)
         potential_decode = st.active_blocks
@@ -121,15 +151,20 @@ class KvScheduler:
             # cost_bias adds only the terms this model lacks (in-flight,
             # observed-latency penalty)
             bias = self.policy.cost_bias(worker)
+        credit, _, _ = self.net_credit(worker, overlap_blocks, isl_blocks,
+                                       fleet_best)
         return (self.overlap_score_weight * potential_prefill
-                + potential_decode + bias)
+                + potential_decode + bias - credit)
 
     def select(self, candidates: List[int], overlaps: Dict[int, int],
                isl_blocks: int,
-               explain: Optional[Dict[int, Dict]] = None) -> Tuple[int, int]:
+               explain: Optional[Dict[int, Dict]] = None,
+               fleet_best: int = 0) -> Tuple[int, int]:
         """Pick a worker; returns (worker_id, its overlap blocks).  When
         ``explain`` is passed, it is filled with each candidate's score
-        inputs (for the routing-decision trace attrs)."""
+        inputs (for the routing-decision trace attrs).  ``fleet_best`` is
+        the global index's best-held leading-block count, enabling the
+        net-priced onboarding credit."""
         if not candidates:
             raise ConnectionError("no workers available for KV routing")
         if self.policy is not None:
@@ -139,15 +174,23 @@ class KvScheduler:
         if self.selector is not None:
             chosen = self.selector(candidates, overlaps, isl_blocks, self)
             return chosen, overlaps.get(chosen, 0)
-        costs = [self.cost(w, overlaps.get(w, 0), isl_blocks)
+        costs = [self.cost(w, overlaps.get(w, 0), isl_blocks,
+                           fleet_best=fleet_best)
                  for w in candidates]
         if explain is not None:
             for w, c in zip(candidates, costs):
+                credit, net_cost_s, onboardable = self.net_credit(
+                    w, overlaps.get(w, 0), isl_blocks, fleet_best)
                 explain[w] = {"cost": round(c, 4),
                               "overlap_blocks": overlaps.get(w, 0),
                               "active_blocks":
                                   self._workers[w].active_blocks
-                                  if w in self._workers else 0}
+                                  if w in self._workers else 0,
+                              "net_cost": (round(net_cost_s, 6)
+                                           if net_cost_s != float("inf")
+                                           else -1.0),
+                              "net_credit": round(credit, 4),
+                              "onboardable_blocks": onboardable}
         if self.temperature <= 0.0:
             best = min(costs)
             chosen = random.choice(
@@ -157,6 +200,16 @@ class KvScheduler:
             lo = min(costs)
             weights = [math.exp(-(c - lo) / self.temperature) for c in costs]
             chosen = random.choices(candidates, weights=weights, k=1)[0]
+        if self.policy is not None and fleet_best > 0:
+            credit, net_cost_s, onboardable = self.net_credit(
+                chosen, overlaps.get(chosen, 0), isl_blocks, fleet_best)
+            if onboardable > 0:
+                if net_cost_s == float("inf"):
+                    self.policy.stats.note_net_priced("no_path", 0.0)
+                elif credit > 0:
+                    self.policy.stats.note_net_priced("credit", net_cost_s)
+                else:
+                    self.policy.stats.note_net_priced("no_credit", net_cost_s)
         return chosen, overlaps.get(chosen, 0)
 
 

@@ -95,7 +95,14 @@ class TieredEngine(EngineBase):
         self._spill_thread: Optional[threading.Thread] = None
         self._peer_client = None          # G4 (enable_peer_fetch)
         self._self_instance_id = -1
+        self._global_index = None         # fleet prefix index (holder order)
         self.peer_onboarded = 0
+        # admission-path onboard accounting: blocks/bytes served by a peer
+        # pull vs left for local recompute (the fleet-KV-reuse A/B signal)
+        self.onboard_peer_blocks = 0
+        self.onboard_peer_bytes = 0
+        self.onboard_recompute_blocks = 0
+        self.onboard_recompute_bytes = 0
         engine.allocator.on_evict = self._on_evict
 
     # -- offload (G1 -> G2 -> G3) -----------------------------------------
@@ -264,10 +271,44 @@ class TieredEngine(EngineBase):
         self._self_instance_id = self_instance_id
         self.peer_onboarded = 0
 
+    def enable_global_index(self, reader) -> None:
+        """Attach a fleet prefix-index mirror
+        (``kv_router.global_index.GlobalPrefixIndexReader``): peer pulls
+        walk KNOWN HOLDERS in overlap order instead of every live
+        instance blindly."""
+        self._global_index = reader
+
+    def _peer_order(self, hashes: List[int]) -> List[int]:
+        """Pull order over live peers: global-index holders first (longest
+        overlap first), then the unindexed rest as a blind fallback."""
+        live = [iid for iid in self._peer_client.instance_ids()
+                if iid != self._self_instance_id]
+        if self._global_index is None:
+            return live
+        ranked = [iid for iid in self._global_index.holder_order(
+                      hashes, exclude=(self._self_instance_id,))
+                  if iid in set(live)]
+        seen = set(ranked)
+        return ranked + [iid for iid in live if iid not in seen]
+
     async def _onboard_from_peers(self, token_ids: List[int]) -> int:
-        """Fetch the first-missing chain suffix from any live peer."""
+        """Fetch the first-missing chain suffix from peer workers —
+        holders first — with the export-lease/resume ladder: each pull
+        asks the exporter to pin the served blocks under a TTL'd lease
+        (acked once committed), a broken stream keeps its landed blocks
+        and RESUMES (same peer once, then the next holder) re-pulling
+        only what is still missing, and whatever no peer can serve is
+        left for local recompute — with both halves (peer-onboarded vs
+        recomputed blocks AND bytes) recorded on the ``kv_transfer`` span
+        and the ``dynamo_worker_kv_onboard_*`` counters."""
+        import time as _time
+
         from dynamo_tpu.engine.transfer import (
-            FRAME_WIRE_VERSION, InjectPipeline)
+            FRAME_WIRE_VERSION, InjectPipeline, kv_shard_payload)
+        from dynamo_tpu.kvbm.prefetch import _block_bytes
+        from dynamo_tpu.utils.tracing import get_tracer
+        from dynamo_tpu.worker.disagg import get_kv_bandwidth_book
+        from dynamo_tpu.worker.metrics import count_metric
 
         page_size = self.engine.allocator.page_size
         hashes = compute_block_hash_for_seq(token_ids, page_size)
@@ -282,48 +323,131 @@ class TieredEngine(EngineBase):
         if missing_from is None:
             return 0
         want = hashes[missing_from:]
+        block_bytes = _block_bytes(self.engine)
+        span = get_tracer().start_span(
+            "kv_transfer", attrs={"path": "admission_onboard",
+                                  "blocks": len(want)})
         injected = 0
-        for iid in self._peer_client.instance_ids():
-            if iid == self._self_instance_id:
-                continue
-            # resume across peers: blocks a previous (partially failed)
-            # peer fetch already committed are content-addressed resident
-            # — the next peer only serves what is still missing
-            want = [h for h in want if h not in resident]
-            if not want:
-                break
-            pipe = None
-            try:
-                from dynamo_tpu.runtime.codec import release_buffer
-                stream = await self._peer_client.direct(
-                    {"block_hashes": want, "wire": FRAME_WIRE_VERSION},
-                    iid)
-                # staged pipeline: frames batch into bounded donated
-                # scatters, so a big onboard doesn't stall decode steps
-                pipe = InjectPipeline(self.engine)
-                async for frame in stream:
-                    if "_raw" not in frame:
+        pulled_bytes = 0
+        try:
+            for iid in self._peer_order(hashes):
+                # resume across peers: blocks a previous (partially
+                # failed) peer fetch already committed are content-
+                # addressed resident — the next peer only serves what is
+                # still missing. One same-peer resume first (the PR 6
+                # ladder): a transient stream break re-pulls the tail
+                # before the walk moves on.
+                for attempt in range(2):
+                    want = [h for h in want if h not in resident]
+                    if not want:
+                        break
+                    if attempt:
+                        span.add_event("pull_resumed", plane="rpc",
+                                       peer=f"{iid:x}",
+                                       remaining=len(want))
+                        count_metric("kv_pull_resumes")
+                    pipe = None
+                    lease = None
+                    nbytes = 0
+                    t0 = _time.perf_counter()
+                    try:
+                        from dynamo_tpu.runtime.codec import release_buffer
+                        # wire-v5 pull: shard negotiation rides the
+                        # payload (tiered exporters answer merged frames;
+                        # a same-layout HBM exporter streams per-shard),
+                        # and want_lease pins the served blocks on the
+                        # exporter until the commit ack below
+                        stream = await self._peer_client.direct(
+                            {"block_hashes": want,
+                             "wire": FRAME_WIRE_VERSION,
+                             "want_lease": 1,
+                             **kv_shard_payload(self.engine)}, iid)
+                        # staged pipeline: frames batch into bounded
+                        # donated scatters, so a big onboard doesn't
+                        # stall decode steps
+                        pipe = InjectPipeline(self.engine)
+                        async for frame in stream:
+                            if frame.get("lease") is not None:
+                                lease = int(frame["lease"])
+                                span.set_attr("kv_export_lease", lease)
+                                continue
+                            if "_raw" not in frame:
+                                continue
+                            nbytes += len(frame["_raw"])
+                            # pipeline recycles the pooled trailer once
+                            # consumed
+                            await pipe.add_frame(frame,
+                                                 release=release_buffer)
+                        injected += await pipe.finish()
+                        dt = _time.perf_counter() - t0
+                        pulled_bytes += nbytes
+                        if nbytes:
+                            # admission pulls ride the RPC plane: feed the
+                            # same bandwidth EWMA the router prices with
+                            get_kv_bandwidth_book().note("rpc", nbytes, dt)
+                        break
+                    except BaseException as e:  # incl. CancelledError —
+                        # the pipeline's in-flight commits must be reaped
+                        # either way
+                        if pipe is not None:
+                            # reap in-flight commits (no leaked task
+                            # exceptions) and keep what landed: content-
+                            # addressed blocks from a broken stream are
+                            # still good prefix the resume dedups against
+                            injected += await pipe.drain()
+                        pulled_bytes += nbytes
+                        if not isinstance(e, Exception):
+                            raise  # cancellation propagates after the reap
+                        logger.debug("G4 peer %x fetch failed: %s", iid, e)
                         continue
-                    # pipeline recycles the pooled trailer once consumed
-                    await pipe.add_frame(frame, release=release_buffer)
-                injected += await pipe.finish()
-            except BaseException as e:  # including CancelledError — the
-                # pipeline's in-flight commits must be reaped either way
-                if pipe is not None:
-                    # reap in-flight commits (no leaked task exceptions)
-                    # and keep what landed: content-addressed blocks from
-                    # a broken stream are still good prefix
-                    injected += await pipe.drain()
-                if not isinstance(e, Exception):
-                    raise  # cancellation propagates after the reap
-                logger.debug("G4 peer %x fetch failed: %s", iid, e)
-                continue
-            # no break on success: a peer that cleanly served only part of
-            # the chain (the rest fell out of its tiers) is not the end —
-            # the top-of-loop want-filter stops the walk once nothing is
-            # missing, and otherwise the next peer serves the remainder
-        self.peer_onboarded += injected
+                    finally:
+                        if lease is not None:
+                            # commit/abandon ack either way: the exporter
+                            # unpins now instead of waiting out the TTL
+                            acked = await self._ack_peer_lease(iid, lease)
+                            span.set_attr("lease_acked", acked)
+                # no break on clean partial service: a peer that served
+                # only part of the chain (the rest fell out of its tiers)
+                # is not the end — the want-filter stops the walk once
+                # nothing is missing, otherwise the next holder serves
+                # the remainder
+                want = [h for h in want if h not in resident]
+                if not want:
+                    break
+        finally:
+            # the recompute-vs-onboard split this admission decided:
+            # whatever no peer could serve is prefill work
+            recompute = len([h for h in want if h not in resident])
+            self.peer_onboarded += injected
+            self.onboard_peer_blocks += injected
+            self.onboard_peer_bytes += pulled_bytes
+            self.onboard_recompute_blocks += recompute
+            self.onboard_recompute_bytes += recompute * block_bytes
+            span.set_attr("onboarded_blocks", injected)
+            span.set_attr("onboarded_bytes", pulled_bytes)
+            span.set_attr("recompute_blocks", recompute)
+            span.set_attr("recompute_bytes", recompute * block_bytes)
+            span.finish()
+            if injected:
+                count_metric("kv_onboard", "peer", inc=injected)
+                count_metric("kv_onboard_bytes", "peer", inc=pulled_bytes)
+            if recompute:
+                count_metric("kv_onboard", "recompute", inc=recompute)
+                count_metric("kv_onboard_bytes", "recompute",
+                             inc=recompute * block_bytes)
         return injected
+
+    async def _ack_peer_lease(self, iid: int, lease: int) -> bool:
+        try:
+            stream = await self._peer_client.direct(
+                {"ack_lease": int(lease)}, iid)
+            async for _ in stream:
+                pass
+            return True
+        except Exception as e:  # noqa: BLE001 — the exporter's TTL covers
+            logger.debug("onboard lease %s ack to %x failed (%s); TTL "
+                         "covers", lease, iid, e)
+            return False
 
     # -- EngineBase --------------------------------------------------------
 
@@ -383,6 +507,11 @@ class TieredEngine(EngineBase):
                 "kvbm_host_bytes": self.host.used,
                 "kvbm_pending_spills": self._spills.qsize(),
                 "kvbm_peer_onboarded_blocks": self.peer_onboarded,
+                "kvbm_onboard_peer_bytes": self.onboard_peer_bytes,
+                "kvbm_onboard_recompute_blocks":
+                    self.onboard_recompute_blocks,
+                "kvbm_onboard_recompute_bytes":
+                    self.onboard_recompute_bytes,
             }
             if self.disk is not None:
                 out["kvbm_disk_blocks"] = len(self.disk)
@@ -454,7 +583,11 @@ def serve_tiered_kv_export(tiered: TieredEngine):
     """RPC handler: like ``transfer.serve_kv_export`` but also serves
     blocks held only in this worker's G2/G3 tiers — the provider side of
     the G4 remote tier (peers fetch what fell out of our HBM)."""
-    from dynamo_tpu.engine.transfer import release_export_lease, resolve_wire
+    from dynamo_tpu.engine.transfer import (
+        grant_export_lease,
+        release_export_lease,
+        resolve_wire,
+    )
 
     async def handler(payload, ctx):
         payload = payload or {}
@@ -466,6 +599,16 @@ def serve_tiered_kv_export(tiered: TieredEngine):
             yield {"acked": bool(ok)}
             return
         hashes = list(payload.get("block_hashes", []))
+        if payload.get("want_lease"):
+            # puller-initiated pulls (admission onboarding) have no
+            # advertise step to grant a lease through: grant one here so
+            # the HBM-resident slice of the chain can't be evicted out
+            # from under the stream; tier-resident blocks need no pin.
+            # The puller acks {"ack_lease": id} once committed; the TTL
+            # GC covers a lost ack.
+            lease = await grant_export_lease(tiered.engine, hashes)
+            if lease is not None:
+                yield {"lease": int(lease)}
         if int(payload.get("wire", 1)) >= 2:
             # tiered exports serve merged frames regardless of the shard
             # negotiation: tier-resident blocks live as unsharded host

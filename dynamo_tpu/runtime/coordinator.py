@@ -265,6 +265,31 @@ class Coordinator:
     def standbys_attached(self) -> int:
         return len(self._standbys)
 
+    @property
+    def prefix_index_entries(self) -> int:
+        """Live holder snapshots in the fleet prefix index
+        (``kvstore/prefix_index/`` keys whose kv-store TTL envelope has
+        not expired) — the ``dynamo_coord_prefix_index_entries`` gauge.
+        The envelope is the client-side ``_CoordBucket`` format
+        ({"e": expiry, "v": value, "t": ttl}); an undecodable entry
+        counts as live (the reader, not this gauge, is the authority)."""
+        import time as _time
+
+        from dynamo_tpu.runtime import codec as _codec
+        n = 0
+        now = _time.time()
+        for key, e in self._kv.items():
+            if not key.startswith("kvstore/prefix_index/"):
+                continue
+            try:
+                env = _codec.unpack(e.value)
+                if env.get("e") and env["e"] <= now:
+                    continue
+            except Exception:  # noqa: BLE001 — count it, don't crash scrape
+                pass
+            n += 1
+        return n
+
     # -- lifecycle ---------------------------------------------------------
 
     async def start(self) -> "Coordinator":

@@ -63,6 +63,16 @@ class RouterStats:
         self.breaker_states: Dict[str, float] = {}             # by instance hex
         self.budget_balance: float = 0.0
         self.budget_exhausted: int = 0
+        # NetKV-style pricing: decisions where a remote prefix hit was
+        # priced against the measured kv_transfer plane bandwidth
+        self.net_priced: Dict[str, int] = defaultdict(int)     # by outcome
+        self.net_cost_seconds_sum: float = 0.0
+        self.net_cost_seconds_count: int = 0
+
+    def note_net_priced(self, outcome: str, net_cost_s: float) -> None:
+        self.net_priced[outcome] += 1
+        self.net_cost_seconds_sum += max(0.0, net_cost_s)
+        self.net_cost_seconds_count += 1
 
 
 _STATS = RouterStats()
@@ -88,6 +98,7 @@ class RouterPolicyConfig:
     hedge_delay_s: float = 0.0         # fixed hedge delay (0 = p95-based)
     hedge_delay_floor_s: float = 0.02  # lower bound on the p95-based delay
     ttft_weight: float = 25.0          # score units per second of EWMA TTFT
+    net_weight: float = 25.0           # score units per second of KV transfer
     ewma_alpha: float = 0.3            # EWMA smoothing for TTFT/latency
     stats_interval_s: float = 1.0      # __stats__ scrape period (COST mode)
 
@@ -329,6 +340,9 @@ class RouterPolicy:
         self.inflight: Dict[int, int] = defaultdict(int)
         # scraped worker-side view: iid -> {queue_depth, active_slots, active}
         self.worker_stats: Dict[int, Dict[str, float]] = {}
+        # scraped kv_transfer bandwidth book: iid -> {plane -> bytes/s EWMA}
+        # (what workers publish from KvBandwidthBook.snapshot())
+        self.net_bw: Dict[int, Dict[str, float]] = {}
 
     # -- client wiring -----------------------------------------------------
 
@@ -390,6 +404,16 @@ class RouterPolicy:
                 "active_slots": float(ws.get("request_active_slots", 0) or 0),
                 "active": float(ep.get("active", 0) or 0),
             }
+            kt = data.get("kv_transfer") if isinstance(
+                data.get("kv_transfer"), dict) else {}
+            planes = {}
+            for plane, snap in kt.items():
+                if isinstance(snap, dict):
+                    bw = float(snap.get("bw_bytes_per_s", 0) or 0)
+                    if bw > 0:
+                        planes[str(plane)] = bw
+            if planes:
+                self.net_bw[iid] = planes
 
     def update_worker_stats(self, iid: int, queue_depth: float,
                             active_slots: float = 0.0,
@@ -398,6 +422,22 @@ class RouterPolicy:
                                   "active_slots": float(active_slots),
                                   "active": float(active)}
 
+    def plane_bw(self, iid: int) -> float:
+        """Best measured kv_transfer bandwidth (bytes/s EWMA) toward a
+        worker, across planes — 0.0 when no transfer has been observed
+        (an unmeasured path earns no remote-hit credit)."""
+        planes = self.net_bw.get(iid)
+        return max(planes.values()) if planes else 0.0
+
+    def net_cost_s(self, iid: int, est_transfer_bytes: float) -> float:
+        """NetKV-style network price: seconds to move the missing prefix
+        over the best measured plane.  ``inf`` when bytes must move but no
+        bandwidth has ever been observed."""
+        if est_transfer_bytes <= 0:
+            return 0.0
+        bw = self.plane_bw(iid)
+        return (est_transfer_bytes / bw) if bw > 0 else float("inf")
+
     def prune(self, live: set) -> None:
         self.breakers.prune(live)
         self.lat.prune(live)
@@ -405,19 +445,28 @@ class RouterPolicy:
             del self.worker_stats[iid]
         for iid in [i for i in self.inflight if i not in live]:
             del self.inflight[iid]
+        for iid in [i for i in self.net_bw if i not in live]:
+            del self.net_bw[iid]
 
     # -- scoring -----------------------------------------------------------
 
-    def score(self, iid: int) -> Tuple[float, Dict[str, Any]]:
+    def score(self, iid: int,
+              est_transfer_bytes: float = 0.0) -> Tuple[float, Dict[str, Any]]:
         """Cost of routing one more request to ``iid``, with the inputs —
         the per-decision trace attrs the ROADMAP's "debuggable post-hoc"
-        requirement asks for."""
+        requirement asks for.  ``est_transfer_bytes`` is the KV volume a
+        remote placement would have to move to this worker; it is priced
+        at the measured per-plane bandwidth EWMA (``net_cost`` term)."""
         ws = self.worker_stats.get(iid, {})
         inflight = self.inflight.get(iid, 0)
         queue = ws.get("queue_depth", 0.0)
         active = ws.get("active_slots", 0.0)
         ewma = self.lat.ttft(iid, 0.0)
-        total = inflight + queue + active + self.cfg.ttft_weight * ewma
+        net_cost = self.net_cost_s(iid, est_transfer_bytes)
+        net_term = (self.cfg.net_weight * net_cost
+                    if net_cost not in (0.0, float("inf")) else 0.0)
+        total = (inflight + queue + active + self.cfg.ttft_weight * ewma
+                 + net_term)
         state = self.breakers.state(iid)
         return total, {
             "score": round(total, 4),
@@ -425,6 +474,8 @@ class RouterPolicy:
             "inflight": inflight,
             "queue_depth": queue,
             "active_slots": active,
+            "net_cost": (round(net_cost, 6)
+                         if net_cost != float("inf") else -1.0),
             "breaker": state.value,
         }
 

@@ -308,6 +308,7 @@ async def amain(args: argparse.Namespace) -> None:
     engine.scheduler.dp_rank = args.data_parallel_rank
 
     tiered = None
+    prefix_reader = None
     if args.host_cache_bytes > 0 or args.disk_cache_bytes > 0:
         # multihost OK: tier gathers/scatters ride the broadcast step
         # stream (engine.dispatch_gather_pages / scatter_pages_host), so
@@ -337,6 +338,13 @@ async def amain(args: argparse.Namespace) -> None:
         g4_lease = await drt.primary_lease()
         tiered.enable_peer_fetch(await g4_ep.client(),
                                  self_instance_id=g4_lease.lease_id)
+        # fleet-wide KV reuse: mirror the coordinator-backed global prefix
+        # index so admission onboarding pulls from the best-overlap holder
+        # first instead of probing peers blindly
+        from dynamo_tpu.kv_router.global_index import GlobalPrefixIndexReader
+        prefix_reader = GlobalPrefixIndexReader(drt.kv_store())
+        await prefix_reader.start()
+        tiered.enable_global_index(prefix_reader)
 
     from dynamo_tpu.worker.disagg import get_kv_bandwidth_book
 
@@ -366,11 +374,25 @@ async def amain(args: argparse.Namespace) -> None:
                     args.num_nodes - 1)
 
     event_pump: asyncio.Task | None = None
+    prefix_pub = None
     if not args.no_kv_events:
         lease = await drt.primary_lease()
-        engine.kv_event_cb, event_pump = ordered_kv_publisher(
+        publish_kv, event_pump = ordered_kv_publisher(
             drt, kv_events_subject(args.namespace, args.component),
             lease.lease_id)
+        # the same event stream also feeds the fleet-wide prefix index:
+        # batched/deduped holder snapshots in the coordinator kv-store so
+        # OTHER frontends and peers see this worker's cache contents
+        from dynamo_tpu.kv_router.global_index import GlobalPrefixPublisher
+        prefix_pub = GlobalPrefixPublisher(drt.kv_store(), lease.lease_id)
+        await prefix_pub.start()
+
+        def _kv_event_cb(events, _pub=publish_kv, _gp=prefix_pub):
+            _pub(events)
+            for ev in events:
+                _gp.apply_event(ev)
+
+        engine.kv_event_cb = _kv_event_cb
 
     handler = None
     prefill_first = args.disagg_strategy == "prefill_first"
@@ -570,6 +592,10 @@ async def amain(args: argparse.Namespace) -> None:
             await handler.stop()
         if event_pump is not None:
             event_pump.cancel()
+        if prefix_pub is not None:
+            await prefix_pub.close()
+        if prefix_reader is not None:
+            await prefix_reader.close()
         await engine.stop()
         await drt.close()
 

@@ -386,6 +386,23 @@ class WorkerMetrics:
             "KV block pulls resumed after a mid-pull failure, re-pulling "
             "only the blocks not yet committed",
             registry=self.registry)
+        # -- fleet-wide KV reuse (admission onboarding) -------------------
+        self.kv_onboard = Counter(
+            f"{ns}_kv_onboard_total",
+            "Prompt blocks the admission path had to source beyond the "
+            "local tiers, by source: 'peer' onboarded from another "
+            "worker's KV export, 'recompute' left for local prefill "
+            "(no peer held them, or every pull failed)",
+            ["source"], registry=self.registry)
+        self.kv_onboard_bytes = Counter(
+            f"{ns}_kv_onboard_bytes_total",
+            "KV bytes behind those admission decisions, by source: 'peer' "
+            "counts wire bytes pulled, 'recompute' the cache bytes the "
+            "local prefill will regenerate",
+            ["source"], registry=self.registry)
+        for source in ("peer", "recompute"):
+            self.kv_onboard.labels(source)
+            self.kv_onboard_bytes.labels(source)
         self.prefill_failovers = Counter(
             f"{ns}_prefill_failovers_total",
             "Remote-prefill retries on an alternate prefill instance "

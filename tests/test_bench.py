@@ -39,6 +39,12 @@ def test_single_child_attempt_chain():
     env["BENCH_STEPTRACE_GEN"] = "24"
     env["BENCH_STEPTRACE_ROUNDS"] = "3"
     env["BENCH_STEPTRACE_REPS"] = "2"
+    # short shared-prefix leg (fewer requests/groups, shorter prefixes)
+    # so the three-arm hot/cold-on/cold-off comparison stays inside the
+    # smoke chain's budget
+    env["BENCH_SHARED_REQS"] = "6"
+    env["BENCH_SHARED_GROUPS"] = "2"
+    env["BENCH_SHARED_BLOCKS"] = "24"
     env.pop("JAX_PLATFORMS", None)
     r = subprocess.run(
         [sys.executable, BENCH, "--budget", "420", "--tier", "tiny"],
@@ -121,6 +127,23 @@ def test_single_child_attempt_chain():
     assert stp["aggregates"]["gap_samples"] > 0
     assert stp["ab"]["on_tok_s"] > 0 and stp["ab"]["off_tok_s"] > 0
     assert stp["ab"]["overhead_pct"] < 5.0, stp
+    # fleet-wide KV reuse leg: the cold index-on worker really onboarded
+    # its prefixes over G4 peer pulls (blocks + bytes recorded, the
+    # admission_onboard kv_transfer spans landed in the flight recorder)
+    # against a populated global index. TTFT RATIOS are the artifact
+    # run's acceptance (BENCH_shared_prefix_r11.json) — on a loaded CI
+    # box with the smoke's one-chunk prompts, wall-clock ratios jitter,
+    # so the smoke pins the structure, not the separation
+    sp = result["shared_prefix"]
+    assert "error" not in sp, sp
+    assert sp["hot_ttft_p50_s"] > 0, sp
+    assert sp["cold_on_ttft_p50_s"] > 0 and sp["cold_off_ttft_p50_s"] > 0
+    assert sp["first_touch"] >= 1, sp
+    assert sp["peer_onboarded_blocks"] > 0, sp
+    assert sp["peer_onboarded_bytes"] > 0, sp
+    assert sp["index_workers"] >= 1 and sp["index_blocks"] > 0, sp
+    assert sp["onboard_spans"] >= 1, sp
+    assert "cold_within_1p5x_hot" in sp and "on_beats_off" in sp
     # the continuous-arrival mixed-vs-legacy A/B ran on both engines.
     # jax sub-leg: CPU dispatch overhead is ~0, so only liveness is
     # asserted (the throughput separation is the on-chip/mocker story).

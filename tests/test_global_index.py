@@ -1,0 +1,222 @@
+"""Fleet-wide prefix index tests (ISSUE 20).
+
+Unit coverage over the in-memory store (publish/evict/dedupe/TTL/size-cap),
+lease-expiry pruning through the coordinator backend, failover continuity
+across a standby promotion (the resync replay registry re-puts each live
+worker's snapshot), and the coordinator's ``prefix_index_entries`` gauge.
+"""
+
+import asyncio
+
+from dynamo_tpu.kv_router.global_index import (
+    GlobalPrefixIndexReader,
+    GlobalPrefixPublisher,
+    consecutive_overlaps,
+)
+from dynamo_tpu.protocols.events import KvCacheEvent, KvCacheStoredBlock
+from dynamo_tpu.runtime.kv_store import MemoryKeyValueStore
+
+
+def stored(event_id, hashes):
+    return KvCacheEvent(
+        event_id=event_id,
+        stored_blocks=[KvCacheStoredBlock(block_hash=h, tokens_hash=h)
+                       for h in hashes])
+
+
+def removed(event_id, hashes):
+    return KvCacheEvent(event_id=event_id,
+                        removed_block_hashes=list(hashes))
+
+
+async def make_pair(store, worker_id, **kw):
+    """Publisher (no background loop — tests drive flush()) + reader."""
+    pub = GlobalPrefixPublisher(store, worker_id, **kw)
+    pub._bucket = await store.bucket("prefix_index", ttl=pub.ttl)
+    reader = GlobalPrefixIndexReader(store)
+    reader._bucket = await store.bucket("prefix_index")
+    return pub, reader
+
+
+class TestConsecutiveOverlaps:
+    def test_run_walk_matches_indexer_semantics(self):
+        by_hash = {10: {1, 2}, 11: {1}, 12: {1, 2}}
+        assert consecutive_overlaps([10, 11, 12, 13], by_hash) == {1: 3, 2: 1}
+
+    def test_missing_head_matches_nothing(self):
+        assert consecutive_overlaps([99, 10], {10: {1}}) == {}
+
+
+class TestPublisherReader:
+    async def test_publish_and_match(self):
+        store = MemoryKeyValueStore()
+        pub, reader = await make_pair(store, 0xA)
+        pub.apply_event(stored(0, [10, 11, 12]))
+        await pub.flush()
+        await reader.refresh()
+        assert reader.find_holders([10, 11, 12, 13]) == {0xA: 3}
+        assert reader.best_overlap([10, 11]) == (0xA, 2)
+        assert reader.num_blocks(0xA) == 3
+
+    async def test_evict_prunes_holder(self):
+        store = MemoryKeyValueStore()
+        pub, reader = await make_pair(store, 0xA)
+        pub.apply_event(stored(0, [10, 11, 12]))
+        await pub.flush()
+        pub.apply_event(removed(1, [11, 12]))
+        await pub.flush()
+        await reader.refresh()
+        assert reader.find_holders([10, 11, 12]) == {0xA: 1}
+
+    async def test_store_evict_within_interval_never_published(self):
+        """The batching window dedupes: a block stored then evicted before
+        the flush never reaches the coordinator at all."""
+        store = MemoryKeyValueStore()
+        pub, reader = await make_pair(store, 0xA)
+        pub.apply_event(stored(0, [10]))
+        pub.apply_event(stored(1, [77]))
+        pub.apply_event(removed(2, [77]))
+        await pub.flush()
+        await reader.refresh()
+        assert reader.find_holders([77]) == {}
+        assert reader.find_holders([10]) == {0xA: 1}
+
+    async def test_all_blocks_cleared(self):
+        store = MemoryKeyValueStore()
+        pub, reader = await make_pair(store, 0xA)
+        pub.apply_event(stored(0, [10, 11]))
+        await pub.flush()
+        pub.apply_event(KvCacheEvent(event_id=1, all_blocks_cleared=True))
+        await pub.flush()
+        await reader.refresh()
+        assert reader.find_holders([10, 11]) == {}
+        assert pub.held_count() == 0
+
+    async def test_snapshot_cap_drops_oldest(self):
+        store = MemoryKeyValueStore()
+        pub, reader = await make_pair(store, 0xA, max_hashes=2)
+        pub.apply_event(stored(0, [10, 11, 12]))
+        await pub.flush()
+        await reader.refresh()
+        # oldest-stored (10) dropped from the published view; the run walk
+        # then can't start at 10
+        assert reader.find_holders([10, 11, 12]) == {}
+        assert reader.find_holders([11, 12]) == {0xA: 2}
+
+    async def test_clean_flush_skipped_until_refresh_due(self):
+        store = MemoryKeyValueStore()
+        pub, _ = await make_pair(store, 0xA, ttl=1000.0)
+        pub.apply_event(stored(0, [10]))
+        await pub.flush()
+        n = pub.publishes
+        await pub.flush()  # clean, refresh not due for ~333s
+        assert pub.publishes == n
+
+    async def test_lease_expiry_prunes_dead_worker(self):
+        """A worker that stops refreshing (crash / lease expiry) vanishes
+        from the index after its TTL — no tombstone protocol."""
+        store = MemoryKeyValueStore()
+        pub, reader = await make_pair(store, 0xA, ttl=0.2)
+        live, _ = await make_pair(store, 0xB, ttl=1000.0)
+        pub.apply_event(stored(0, [10]))
+        live.apply_event(stored(0, [10]))
+        await pub.flush()
+        await live.flush()
+        await reader.refresh()
+        assert reader.find_holders([10]) == {0xA: 1, 0xB: 1}
+        await asyncio.sleep(0.3)  # 0xA's envelope expires; 0xB's does not
+        await reader.refresh()
+        assert reader.find_holders([10]) == {0xB: 1}
+
+    async def test_close_deletes_entry(self):
+        store = MemoryKeyValueStore()
+        pub, reader = await make_pair(store, 0xA, ttl=1000.0)
+        pub.apply_event(stored(0, [10]))
+        await pub.flush()
+        await pub.close()
+        await reader.refresh()
+        assert reader.find_holders([10]) == {}
+
+    async def test_holder_order_by_overlap(self):
+        store = MemoryKeyValueStore()
+        p1, reader = await make_pair(store, 1)
+        p2, _ = await make_pair(store, 2)
+        p3, _ = await make_pair(store, 3)
+        p1.apply_event(stored(0, [10]))
+        p2.apply_event(stored(0, [10, 11, 12]))
+        p3.apply_event(stored(0, [10, 11]))
+        for p in (p1, p2, p3):
+            await p.flush()
+        await reader.refresh()
+        assert reader.holder_order([10, 11, 12]) == [2, 3, 1]
+        assert reader.holder_order([10, 11, 12], exclude=(2,)) == [3, 1]
+
+    async def test_start_close_lifecycle(self):
+        """The real start() path: background publish + refresh loops."""
+        store = MemoryKeyValueStore()
+        pub = GlobalPrefixPublisher(store, 0xC, interval=0.02)
+        reader = GlobalPrefixIndexReader(store, refresh_interval=0.02)
+        await pub.start()
+        await reader.start()
+        pub.apply_event(stored(0, [40, 41]))
+        for _ in range(100):
+            await asyncio.sleep(0.02)
+            if reader.find_holders([40, 41]):
+                break
+        assert reader.find_holders([40, 41]) == {0xC: 2}
+        await pub.close()
+        await reader.close()
+
+
+class TestCoordinatorBacked:
+    async def test_index_survives_failover(self):
+        """Kill the primary mid-flight: after the standby promotes, the
+        kv-store replay registry re-puts the worker's snapshot, so the
+        reader's next refresh still sees the holder (PR 3/15 resync)."""
+        from dynamo_tpu.runtime.kv_store import CoordKeyValueStore
+        from dynamo_tpu.utils.faults import CoordinatorPair
+
+        pair = await CoordinatorPair(promote_after_s=0.4).start()
+        from dynamo_tpu.runtime.coordinator import CoordClient
+        c = None
+        try:
+            c = await CoordClient(pair.addresses,
+                                  reconnect_base_s=0.02).connect()
+            store = CoordKeyValueStore(c)
+            pub = GlobalPrefixPublisher(store, 0xA, ttl=30.0)
+            pub._bucket = await store.bucket("prefix_index", ttl=30.0)
+            reader = GlobalPrefixIndexReader(store)
+            reader._bucket = await store.bucket("prefix_index")
+            pub.apply_event(stored(0, [10, 11]))
+            await pub.flush()
+            await reader.refresh()
+            assert reader.find_holders([10, 11]) == {0xA: 2}
+            await pair.wait_caught_up()
+            await pair.kill9_primary()
+            await pair.wait_promoted()
+            await c.wait_connected(timeout=10)
+            assert pair.standby.role == "primary"
+            await reader.refresh()
+            assert reader.find_holders([10, 11]) == {0xA: 2}
+        finally:
+            if c is not None:
+                await c.close()
+            await pair.stop()
+
+    async def test_coordinator_entries_gauge(self):
+        from dynamo_tpu.runtime.coordinator import Coordinator
+        from dynamo_tpu.runtime.runtime import DistributedRuntime
+
+        async with Coordinator() as coord:
+            drt = await DistributedRuntime.create(coordinator=coord.address)
+            try:
+                store = drt.kv_store()
+                pub = GlobalPrefixPublisher(store, 0xA, ttl=30.0)
+                pub._bucket = await store.bucket("prefix_index", ttl=30.0)
+                pub.apply_event(stored(0, [10]))
+                await pub.flush()
+                assert coord.prefix_index_entries == 1
+                await pub.close()
+                assert coord.prefix_index_entries == 0
+            finally:
+                await drt.close()

@@ -85,6 +85,27 @@ class TestApproxIndexer:
         idx2.record_routing(7, [1, 2])
         assert idx2.find_matches([1, 2]) == {}
 
+    def test_chain_hash_parity_with_event_index(self):
+        """The approx indexer must observe the SAME chain-hash space as the
+        event-driven index: fed the hashes of one decode-committed sequence,
+        both answer identically for any prefix-extension query — so a
+        frontend can flip between them (or run one of each) without the
+        global prefix index seeing two hash vocabularies."""
+        tokens = list(range(1, 22))  # 21 tokens -> 5 complete blocks
+        hashes = compute_block_hash_for_seq(tokens, 4)
+        event_idx = KvIndexer(block_size=4)
+        event_idx.apply_event(stored(7, 0, hashes))
+        approx_idx = ApproxKvIndexer(block_size=4, ttl=1000.0)
+        approx_idx.record_routing(7, hashes)
+        longer = compute_block_hash_for_seq(tokens + [50, 51, 52, 53], 4)
+        for query in (hashes, hashes[:2], longer):
+            assert event_idx.find_matches(query) == \
+                approx_idx.find_matches(query)
+        # diverging continuations stop matching at the shared prefix in both
+        other = compute_block_hash_for_seq(tokens[:19] + [999], 4)
+        assert event_idx.find_matches(other) == \
+            approx_idx.find_matches(other) == {7: 4}
+
 
 class TestKvScheduler:
     def test_prefers_overlap(self):
@@ -117,6 +138,80 @@ class TestKvScheduler:
         s = KvScheduler(block_size=4, selector=lambda c, o, i, sch: c[-1])
         w, _ = s.select([1, 2, 3], {}, 4)
         assert w == 3
+
+
+def _net_sched(bw_by_worker, overlap_score_weight=3.0, block_bytes=1024):
+    """Scheduler + policy with per-worker kv_transfer bandwidth installed
+    (what ingest_scrape would have learned from __stats__)."""
+    from dynamo_tpu.runtime.resilience import RouterPolicy, RouterPolicyConfig
+    policy = RouterPolicy(RouterPolicyConfig())
+    for wid, bw in bw_by_worker.items():
+        policy.net_bw[wid] = {"bulk": bw}
+    s = KvScheduler(block_size=4, overlap_score_weight=overlap_score_weight,
+                    policy=policy, block_bytes=block_bytes)
+    return s, policy
+
+
+class TestNetPricedRouting:
+    """The global-index credit: a remote prefix hit only wins when moving
+    the bytes beats recomputing them (ISSUE 20 satellite)."""
+
+    def test_fast_plane_credit_routes_to_onboarder(self):
+        # worker 2 holds the whole prefix but is loaded; worker 1 is idle
+        # and sits on a fast measured plane — onboarding from the holder
+        # beats queueing behind it
+        s, policy = _net_sched({1: 1e9, 2: 1e9})
+        s.begin("busy", 2, isl_blocks=20, overlap_blocks=0)
+        explain = {}
+        w, _ = s.select([1, 2], {2: 8}, isl_blocks=8, explain=explain,
+                        fleet_best=8)
+        assert w == 1
+        assert explain[1]["net_credit"] > 0
+        assert explain[1]["onboardable_blocks"] == 8
+        assert policy.stats.net_priced["credit"] == 1
+
+    def test_slow_plane_holder_loses_to_local_recompute(self):
+        # same shape, but worker 1's measured plane crawls: the credit is
+        # priced to zero, so the request stays on the (loaded) holder —
+        # equivalently, a cold candidate would recompute rather than pull
+        s, policy = _net_sched({1: 1.0, 2: 1.0})  # 1 byte/s
+        s.begin("busy", 2, isl_blocks=20, overlap_blocks=0)
+        explain = {}
+        w, _ = s.select([1, 2], {2: 8}, isl_blocks=8, explain=explain,
+                        fleet_best=8)
+        assert w == 2
+        assert explain[1]["net_credit"] == 0.0
+        # scoring still happened — the outcome is recorded as priced-out
+        credit, net_cost_s, onboardable = s.net_credit(1, 0, 8, 8)
+        assert credit == 0.0 and onboardable == 8
+        assert net_cost_s > 1000  # 8 blocks * 1 KiB at 1 B/s
+
+    def test_unmeasured_plane_earns_nothing(self):
+        s, policy = _net_sched({})  # nobody scraped yet: no bandwidth book
+        credit, net_cost_s, onboardable = s.net_credit(1, 0, 8, 8)
+        assert credit == 0.0 and net_cost_s == float("inf")
+        explain = {}
+        w, _ = s.select([1], {}, isl_blocks=8, explain=explain, fleet_best=8)
+        assert explain[1]["net_cost"] == -1.0  # inf encoded for the span
+        assert policy.stats.net_priced["no_path"] == 1
+
+    def test_zero_block_bytes_disables_credit(self):
+        s, _ = _net_sched({1: 1e9}, block_bytes=0)
+        assert s.net_credit(1, 0, 8, 8) == (0.0, 0.0, 8)
+        assert s.cost(1, 0, 8, fleet_best=8) == s.cost(1, 0, 8, fleet_best=0)
+
+    def test_policy_score_carries_net_term(self):
+        from dynamo_tpu.runtime.resilience import RouterPolicy, RouterPolicyConfig
+        policy = RouterPolicy(RouterPolicyConfig(net_weight=10.0))
+        policy.net_bw[1] = {"bulk": 100.0, "rpc": 50.0}
+        assert policy.plane_bw(1) == 100.0  # best plane prices the move
+        base, _ = policy.score(1)
+        total, inputs = policy.score(1, est_transfer_bytes=200.0)
+        assert total == base + 10.0 * 2.0  # 200 B / 100 B/s, weighted
+        assert inputs["net_cost"] == 2.0
+        # unmeasured: the term is excluded (inf would poison every score)
+        total2, inputs2 = policy.score(2, est_transfer_bytes=200.0)
+        assert inputs2["net_cost"] == -1.0
 
 
 def tiny_engine_cfg():

@@ -628,6 +628,13 @@ class TestG4PeerTier:
             assert got == want
             assert b_tiered.peer_onboarded >= 3
             assert frames[-1].cached_tokens == 12  # prefix hit via G4
+            # the onboard split was accounted: all peer, nothing recomputed
+            assert b_tiered.onboard_peer_blocks >= 3
+            assert b_tiered.onboard_peer_bytes > 0
+            assert b_tiered.onboard_recompute_blocks == 0
+            stats = b_tiered.kvbm_stats()
+            assert stats["kvbm_onboard_peer_bytes"] == \
+                b_tiered.onboard_peer_bytes
             await client.close()
         finally:
             for d in drts:
@@ -635,3 +642,126 @@ class TestG4PeerTier:
             await coord.stop()
             await a_tiered.stop()
             await b_tiered.stop()
+
+    async def test_holder_killed_mid_pull_resumes_then_recomputes(
+            self, monkeypatch):
+        """ISSUE 20 chaos leg: the holder dies mid-stream on EVERY pull.
+        The resume ladder keeps the blocks that landed (content-addressed),
+        re-pulls the tail once from the same peer, and leaves whatever no
+        peer could serve to local recompute — the request still completes
+        with tokens matching a hot run (no lost stream)."""
+        from dynamo_tpu.kvbm.manager import serve_tiered_kv_export
+        from dynamo_tpu.runtime.coordinator import Coordinator
+        from dynamo_tpu.runtime.runtime import DistributedRuntime
+        from dynamo_tpu.worker.disagg import KV_EXPORT_ENDPOINT
+
+        # one block per wire frame, so "die after the first data frame"
+        # leaves the chain genuinely incomplete (default frame packing
+        # would ship all 3 blocks in one frame and nothing would break)
+        monkeypatch.setenv("DYN_KV_FRAME_BLOCKS", "1")
+        prompt = list(range(1, 14))  # 3 complete blocks at page_size=4
+        hot = JaxEngine.random_init(ModelConfig.tiny(), JaxEngineConfig(
+            num_pages=32, page_size=4, max_num_seqs=2,
+            max_prefill_chunk=8, max_context=32, min_prefill_bucket=4))
+        try:
+            want = [t for f in await collect(hot, make_req(prompt, "w"))
+                    for t in f.token_ids]
+        finally:
+            await hot.stop()
+
+        coord = await Coordinator(port=0).start()
+        drts = []
+        try:
+            a_drt = await DistributedRuntime.create(coordinator=coord.address)
+            drts.append(a_drt)
+            a_tiered, a_eng = tiny_tiered(num_pages=32)
+            await collect(a_tiered, make_req(prompt, "warm"))
+            inner = serve_tiered_kv_export(a_tiered)
+            pulls = {"n": 0}
+
+            async def dying_holder(payload, ctx):
+                # serve the lease + ONE data frame, then die mid-stream
+                is_pull = bool((payload or {}).get("block_hashes"))
+                if is_pull:
+                    pulls["n"] += 1
+                served = 0
+                async for frame in inner(payload, ctx):
+                    yield frame
+                    if not isinstance(frame, dict):
+                        served += 1
+                        if served >= 1:
+                            # NOT RuntimeError: the rpc server treats that
+                            # as "connection gone" and sends no err frame
+                            raise ValueError("holder crashed mid-pull")
+
+            ep_a = (a_drt.namespace("ns").component("tpu")
+                    .endpoint(KV_EXPORT_ENDPOINT))
+            await ep_a.serve(dying_holder)
+
+            b_drt = await DistributedRuntime.create(coordinator=coord.address)
+            drts.append(b_drt)
+            b_tiered, b_eng = tiny_tiered(num_pages=32)
+            ep_b = (b_drt.namespace("ns").component("tpu")
+                    .endpoint(KV_EXPORT_ENDPOINT))
+            await ep_b.serve(serve_tiered_kv_export(b_tiered))
+            b_lease = await b_drt.primary_lease()
+            client = await ep_b.client()
+            await client.wait_for_instances(2, timeout=10)
+            b_tiered.enable_peer_fetch(client,
+                                       self_instance_id=b_lease.lease_id)
+
+            frames = await collect(b_tiered, make_req(prompt, "cold"))
+            got = [t for f in frames for t in f.token_ids]
+            assert got == want  # the stream was never lost
+            assert pulls["n"] >= 2  # the same-peer resume fired
+            # every wanted block is accounted exactly once, peer or local
+            assert (b_tiered.onboard_peer_blocks
+                    + b_tiered.onboard_recompute_blocks) == 3
+            assert b_tiered.onboard_peer_blocks >= 1  # landed frames kept
+            assert b_tiered.onboard_recompute_blocks >= 1  # the tail
+            assert b_tiered.onboard_recompute_bytes > 0
+            await client.close()
+        finally:
+            for d in drts:
+                await d.close()
+            await coord.stop()
+            await a_tiered.stop()
+            await b_tiered.stop()
+
+    async def test_global_index_orders_peer_pulls(self):
+        """With a fleet index attached, the pull walk visits known holders
+        longest-overlap-first, then the unindexed rest as blind fallback."""
+        import types
+
+        from dynamo_tpu.kv_router.global_index import (
+            GlobalPrefixIndexReader, GlobalPrefixPublisher)
+        from dynamo_tpu.protocols.events import (
+            KvCacheEvent, KvCacheStoredBlock)
+        from dynamo_tpu.runtime.kv_store import MemoryKeyValueStore
+
+        tiered, eng = tiny_tiered()
+        try:
+            tiered.enable_peer_fetch(
+                types.SimpleNamespace(instance_ids=lambda: [1, 2, 3, 4]),
+                self_instance_id=1)
+            hashes = compute_block_hash_for_seq(list(range(1, 14)), 4)
+            store = MemoryKeyValueStore()
+            reader = GlobalPrefixIndexReader(store)
+            reader._bucket = await store.bucket("prefix_index")
+            for wid, held in ((2, hashes[:1]), (3, hashes), (1, hashes)):
+                pub = GlobalPrefixPublisher(store, wid)
+                pub._bucket = await store.bucket("prefix_index", ttl=30.0)
+                pub.apply_event(KvCacheEvent(
+                    event_id=0,
+                    stored_blocks=[KvCacheStoredBlock(block_hash=h,
+                                                      tokens_hash=h)
+                                   for h in held]))
+                await pub.flush()
+            await reader.refresh()
+            # blind order without the index; ranked holders (minus self)
+            # first once attached, unindexed peer 4 trails
+            assert tiered._peer_order(hashes) == [2, 3, 4]
+            tiered.enable_global_index(reader)
+            assert tiered._peer_order(hashes) == [3, 2, 4]
+        finally:
+            await tiered.stop()
